@@ -1,0 +1,110 @@
+"""Job tracking — analog of `water/Job.java` (565 LoC).
+
+The reference Job is a keyed, DKV-resident progress/cancel handle polled by
+clients via `/3/Jobs` (`water/Job.java:199-224`). Here a Job wraps a Python
+worker thread; progress is a float in [0,1] updated by the running builder, stop
+requests are cooperative (builders poll ``stop_requested`` between iterations —
+the same contract as `Job.stop_requested()` in the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+from .kvstore import Keyed, STORE
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class Job(Keyed):
+    CREATED = "CREATED"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    def __init__(self, description: str = "", work: float = 1.0, dest_key: str | None = None):
+        super().__init__(prefix="job")
+        self.description = description
+        self.dest_key = dest_key
+        self.status = Job.CREATED
+        self.exception: BaseException | None = None
+        self.traceback: str | None = None
+        self._work_total = max(work, 1e-12)
+        self._worked = 0.0
+        self.progress_msg = ""
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self._stop_requested = False
+        self._thread: threading.Thread | None = None
+        self.result: Any = None
+        STORE.put_keyed(self)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, fn: Callable[[], Any], background: bool = True) -> "Job":
+        def _run():
+            self.status = Job.RUNNING
+            self.start_time = time.time()
+            try:
+                self.result = fn()
+                self.status = Job.CANCELLED if self._stop_requested else Job.DONE
+            except JobCancelled:
+                self.status = Job.CANCELLED
+            except BaseException as e:  # noqa: BLE001 - mirror of Job exception capture
+                self.exception = e
+                self.traceback = traceback.format_exc()
+                self.status = Job.FAILED
+            finally:
+                self.end_time = time.time()
+
+        if background:
+            self._thread = threading.Thread(target=_run, daemon=True, name=self.key)
+            self._thread.start()
+        else:
+            _run()
+        return self
+
+    def join(self, timeout: float | None = None) -> Any:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.status == Job.FAILED and self.exception is not None:
+            raise self.exception
+        return self.result
+
+    # -- progress / cancel ---------------------------------------------------
+    @property
+    def progress(self) -> float:
+        if self.status == Job.DONE:
+            return 1.0
+        return min(1.0, self._worked / self._work_total)
+
+    def update(self, worked: float, msg: str = "") -> None:
+        self._worked += worked
+        if msg:
+            self.progress_msg = msg
+
+    def stop(self) -> None:
+        """Request cooperative cancellation (`Job.stop_requested` contract)."""
+        self._stop_requested = True
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested
+
+    def check_cancelled(self) -> None:
+        """Builders call this between iterations; raises to unwind the driver."""
+        if self._stop_requested:
+            raise JobCancelled(self.key)
+
+    @property
+    def run_time(self) -> float:
+        end = self.end_time or time.time()
+        return end - self.start_time if self.start_time else 0.0
+
+    def is_running(self) -> bool:
+        return self.status in (Job.CREATED, Job.RUNNING)

@@ -1,0 +1,242 @@
+"""Vec — a distributed column held in TPU HBM.
+
+Reference: `water/fvec/Vec.java` (1,783 LoC) — a chunked, compressed, typed
+distributed column with lazy rollup stats. The TPU-native design (SURVEY.md §7.2):
+
+- storage is ONE row-sharded ``jax.Array`` (float32), padded to an equal-shard
+  length; padding rows and missing values are both NaN. This replaces the 21
+  per-chunk compression codecs (`water/fvec/C*.java`) — a deliberate divergence:
+  HBM arrays want fixed-width vectorizable layouts, and bf16/int8 casts at the
+  point of use recover the bandwidth that byte-packing bought on the JVM.
+- the chunk layout / ESPC machinery (`fvec/Vec.java:152-166`) becomes "equal
+  padded shards + global nrow"; per-row masks are derived on device.
+- types mirror the reference (`fvec/Vec.java:12-103`): numeric, int, categorical
+  (int codes + host-side domain), time (ms since epoch), string (host-side —
+  variable-length data has no business in HBM).
+- rollups (min/max/mean/sigma/NA-count/zero-count) are computed lazily by one
+  fused device reduction and cached until the data version changes — mirroring
+  `water/fvec/RollupStats.java` (572 LoC) without the volatile-task dance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.kvstore import Keyed, make_key
+from ..parallel import mesh as meshmod
+
+T_NUM = "real"
+T_INT = "int"
+T_CAT = "enum"
+T_TIME = "time"
+T_STR = "string"
+T_UUID = "uuid"
+T_BAD = "bad"  # all-NA column
+
+NUMERIC_TYPES = (T_NUM, T_INT, T_CAT, T_TIME, T_BAD)
+
+
+class Rollups:
+    """Cached summary stats — analog of `water/fvec/RollupStats.java`."""
+
+    __slots__ = ("mins", "maxs", "mean", "sigma", "nacnt", "zerocnt", "nrow", "is_int")
+
+    def __init__(self, mins, maxs, mean, sigma, nacnt, zerocnt, nrow, is_int):
+        self.mins = mins
+        self.maxs = maxs
+        self.mean = mean
+        self.sigma = sigma
+        self.nacnt = nacnt
+        self.zerocnt = zerocnt
+        self.nrow = nrow
+        self.is_int = is_int
+
+
+@jax.jit
+def _rollup_kernel(data: jax.Array):
+    """Fused rollup pass; NaN rows (NA + padding) drop out.
+
+    Variance is computed centered (two reductions inside one XLA program) —
+    the E[x²]−mean² shortcut cancels catastrophically in f32 for columns with
+    large mean relative to spread (time columns, IDs). The reference computes
+    rollups in double (`water/fvec/RollupStats.java`); centering buys the same
+    robustness without f64 on the MXU.
+    """
+    ok = ~jnp.isnan(data)
+    x = jnp.where(ok, data, 0.0)
+    n = jnp.sum(ok)
+    mean = jnp.sum(x) / jnp.maximum(n, 1)
+    d = jnp.where(ok, data - mean, 0.0)
+    var = jnp.sum(d * d) / jnp.maximum(n, 1)
+    return dict(
+        mins=jnp.min(jnp.where(ok, data, jnp.inf)),
+        maxs=jnp.max(jnp.where(ok, data, -jnp.inf)),
+        mean=mean,
+        var=jnp.maximum(var, 0.0),
+        n=n,
+        zerocnt=jnp.sum(ok & (data == 0.0)),
+        isint=jnp.all(jnp.where(ok, data == jnp.floor(data), True)),
+    )
+
+
+class Vec(Keyed):
+    def __init__(
+        self,
+        data: jax.Array,
+        nrow: int,
+        type: str = T_NUM,
+        domain: list[str] | None = None,
+        key: str | None = None,
+        host_data: np.ndarray | None = None,
+        exact_data: np.ndarray | None = None,
+    ):
+        super().__init__(key=key, prefix="vec")
+        self.data = data  # padded, row-sharded float32 (None for string vecs)
+        self.nrow = int(nrow)
+        self.type = type
+        self.domain = domain  # categorical level names (host-side)
+        self.host_data = host_data  # for T_STR/T_UUID: numpy object array
+        self.exact_data = exact_data  # exact int64/f64 copy when f32 is lossy
+        self._rollups: Rollups | None = None
+        self._version = 0
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, type: str | None = None,
+                   domain: list[str] | None = None, mesh=None) -> "Vec":
+        """Build a row-sharded Vec from host data (the ingest endpoint)."""
+        arr = np.asarray(arr)
+        nrow = arr.shape[0]
+        if arr.dtype == object or arr.dtype.kind in "US":
+            return Vec(None, nrow, type=T_STR, host_data=np.asarray(arr, dtype=object))
+        plen = meshmod.padded_len(nrow, mesh)
+        buf = np.full(plen, np.nan, dtype=np.float32)
+        f32 = arr.astype(np.float32)
+        buf[:nrow] = f32
+        if type is None:
+            if domain is not None:
+                type = T_CAT
+            elif arr.dtype.kind in "iu" or (arr.dtype.kind == "b"):
+                type = T_INT
+            else:
+                type = T_NUM
+        # f32 is lossy above 2^24 (big int ids, ms-since-epoch times). Device
+        # compute stays f32 (MXU wants it) but the exact values are retained
+        # host-side so the logical column (to_numpy/at/export) is never corrupted
+        # — the f32 HBM copy is then a compute projection, like the reference's
+        # scaled-decimal codecs are a storage projection (`fvec/C2SChunk.java`).
+        exact = None
+        if arr.dtype.kind in "iuf" and arr.dtype.itemsize > 4 and nrow:
+            back = f32.astype(arr.dtype) if arr.dtype.kind in "iu" else f32.astype(np.float64)
+            with np.errstate(invalid="ignore"):
+                lossy = ~np.isclose(back, arr, rtol=0, atol=0, equal_nan=True)
+            if lossy.any():
+                exact = arr.copy()
+        data = jax.device_put(buf, meshmod.row_sharding(mesh))
+        return Vec(data, nrow, type=type, domain=domain, exact_data=exact)
+
+    @staticmethod
+    def from_device(data: jax.Array, nrow: int, type: str = T_NUM,
+                    domain: list[str] | None = None) -> "Vec":
+        return Vec(data, nrow, type=type, domain=domain)
+
+    # -- basic props ---------------------------------------------------------
+    def __len__(self) -> int:
+        return self.nrow
+
+    @property
+    def plen(self) -> int:
+        return self.nrow if self.data is None else self.data.shape[0]
+
+    def is_numeric(self) -> bool:
+        return self.type in (T_NUM, T_INT)
+
+    def is_categorical(self) -> bool:
+        return self.type == T_CAT
+
+    def is_string(self) -> bool:
+        return self.type == T_STR
+
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain else -1
+
+    # -- data access ---------------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Gather the logical column to host (NA as NaN)."""
+        if self.data is None:
+            return self.host_data
+        if self.exact_data is not None:
+            return self.exact_data
+        return np.asarray(self.data)[: self.nrow]
+
+    def at(self, i: int):
+        """Single-element read — `Chunk.at()` analog; O(1) but host-syncing."""
+        if not -self.nrow <= i < self.nrow:
+            raise IndexError(f"row {i} out of range for Vec of {self.nrow} rows")
+        if i < 0:
+            i += self.nrow
+        if self.data is None:
+            return self.host_data[i]
+        if self.exact_data is not None:
+            return self.exact_data[i]
+        return float(self.data[i])
+
+    def modified(self) -> None:
+        """Invalidate cached rollups after an in-place-style update."""
+        self._rollups = None
+        self._version += 1
+
+    # -- rollups -------------------------------------------------------------
+    def rollups(self) -> Rollups:
+        if self._rollups is None:
+            if self.data is None:
+                nacnt = int(sum(1 for v in self.host_data if v is None))
+                self._rollups = Rollups(np.nan, np.nan, np.nan, np.nan,
+                                        nacnt, 0, self.nrow, False)
+            else:
+                r = jax.device_get(_rollup_kernel(self.data))
+                n = int(r["n"])
+                var = float(r["var"]) * (n / max(n - 1, 1))  # sample variance
+                self._rollups = Rollups(
+                    mins=float(r["mins"]) if n else np.nan,
+                    maxs=float(r["maxs"]) if n else np.nan,
+                    mean=float(r["mean"]) if n else np.nan,
+                    sigma=float(np.sqrt(var)) if n else np.nan,
+                    nacnt=self.nrow - n,
+                    zerocnt=int(r["zerocnt"]),
+                    nrow=self.nrow,
+                    is_int=bool(r["isint"]),
+                )
+        return self._rollups
+
+    def mean(self) -> float:
+        return self.rollups().mean
+
+    def sigma(self) -> float:
+        return self.rollups().sigma
+
+    def min(self) -> float:
+        return self.rollups().mins
+
+    def max(self) -> float:
+        return self.rollups().maxs
+
+    def nacnt(self) -> int:
+        return self.rollups().nacnt
+
+    # -- transforms ----------------------------------------------------------
+    def with_data(self, data: jax.Array, type: str | None = None,
+                  domain: Any = "__same__") -> "Vec":
+        return Vec(data, self.nrow, type=type or self.type,
+                   domain=self.domain if domain == "__same__" else domain)
+
+    def astype_cat(self, domain: list[str]) -> "Vec":
+        return Vec(self.data, self.nrow, type=T_CAT, domain=domain)
+
+    def __repr__(self) -> str:
+        dom = f", card={len(self.domain)}" if self.domain else ""
+        return f"Vec({self.key}, nrow={self.nrow}, type={self.type}{dom})"

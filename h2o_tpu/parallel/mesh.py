@@ -1,0 +1,98 @@
+"""Device-mesh management — the TPU-native replacement for H2O's cluster model.
+
+The reference builds a "cloud" of symmetric JVM nodes with gossip heartbeats and
+quorum consensus (`water/H2O.java`, `water/Paxos.java:10-33`). On TPU the set of
+devices is fixed at process start and coordinated by the JAX runtime, so the whole
+membership machinery collapses into a `jax.sharding.Mesh`. We keep a single global
+mesh with two named axes:
+
+- ``"rows"``  — the data-parallel axis. Frames are sharded along rows on this axis
+  (the analog of H2O chunk distribution, `water/Key.java:108-120`).
+- ``"cols"``  — an optional model/feature-parallel axis, used for wide-feature work
+  (Gram accumulation over huge one-hot domains, SURVEY.md §5.7).
+
+The mesh is lazily constructed over all available devices as a 1-D ``rows`` mesh by
+default; tests and multi-chip dry-runs install explicit meshes via ``use_mesh``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS = "rows"
+COLS = "cols"
+
+_active_mesh: Mesh | None = None
+
+
+def make_mesh(devices=None, row_parallel: int | None = None) -> Mesh:
+    """Build a (rows, cols) mesh over ``devices`` (default: all local devices).
+
+    By default all devices go on the ``rows`` axis — H2O's only parallelism axis is
+    rows (chunk distribution), so that is the right default here too.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    rp = n if row_parallel is None else row_parallel
+    if n % rp != 0:
+        raise ValueError(f"row_parallel={rp} does not divide device count {n}")
+    grid = devices.reshape(rp, n // rp)
+    return Mesh(grid, (ROWS, COLS))
+
+
+def default_mesh() -> Mesh:
+    global _active_mesh
+    if _active_mesh is None:
+        _active_mesh = make_mesh()
+    return _active_mesh
+
+
+def set_mesh(mesh: Mesh | None) -> None:
+    global _active_mesh
+    _active_mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    global _active_mesh
+    prev = _active_mesh
+    _active_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _active_mesh = prev
+
+
+def n_row_shards(mesh: Mesh | None = None) -> int:
+    mesh = mesh or default_mesh()
+    return mesh.shape[ROWS]
+
+
+def row_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    """Sharding for a per-row array: rows split over the ``rows`` axis."""
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P(ROWS))
+
+
+def replicated(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P())
+
+
+def padded_len(nrow: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
+    """Padded row count: divisible by the row-shard count and a lane multiple.
+
+    This is the ESPC analog (`water/fvec/Vec.java:152-166`): instead of a vector of
+    per-chunk start offsets we use equal-size shards plus a global row count; rows
+    beyond ``nrow`` are padding and masked out of every computation.
+    """
+    shards = n_row_shards(mesh)
+    q = shards * multiple
+    return int(math.ceil(max(nrow, 1) / q) * q)

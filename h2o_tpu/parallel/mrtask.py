@@ -1,0 +1,115 @@
+"""mr_task — the TPU-native MRTask (`water/MRTask.java`, 989 LoC).
+
+The reference's compute engine is a distributed map/reduce: ``map(Chunk[])`` runs
+data-local on every chunk's home node, partial results ``reduce`` pairwise up a
+binary RPC tree over nodes and a fork-join tree within nodes
+(`water/MRTask.java:94-119, 740-759, 855-926`). On TPU the entire mechanism —
+task fan-out, data-locality, tree reduction — collapses into one SPMD program:
+``shard_map`` runs the map on every device against its local row shard, and the
+reduction is an XLA collective over ICI (`psum`/`pmin`/`pmax`), which subsumes
+H2O's two-level reduce tree (SURVEY.md §2.4.2).
+
+Two entry points:
+
+- ``mr_reduce``  — map each shard to a pytree of partials, combine across shards
+  with a named monoid per call (the `map`+`reduce` path).
+- ``mr_map``     — map rows to new row-aligned outputs (the `outputFrame` path,
+  `water/MRTask.java:226-251`): returns new sharded per-row arrays.
+
+Map functions receive ``(local_cols, rows)`` where ``rows`` carries the global
+row ids and validity mask for the shard — the analog of `Chunk.start()` plus the
+ESPC row accounting. Padding rows (beyond the frame's nrow) must contribute the
+monoid identity; ``rows.mask`` makes that a one-liner.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import ROWS, default_mesh, row_sharding
+
+_REDUCERS = {
+    "sum": jax.lax.psum,
+    "min": jax.lax.pmin,
+    "max": jax.lax.pmax,
+}
+
+
+@dataclass
+class RowInfo:
+    """Per-shard row accounting handed to map functions inside shard_map."""
+
+    ids: jax.Array  # (shard_rows,) int32 global row indices
+    mask: jax.Array  # (shard_rows,) bool, False on padding rows
+    nrow: int  # global logical row count
+
+    def maskf(self, dtype=jnp.float32) -> jax.Array:
+        return self.mask.astype(dtype)
+
+
+def _row_info(shard_rows: int, nrow: int) -> RowInfo:
+    idx = jax.lax.axis_index(ROWS)
+    ids = idx * shard_rows + jnp.arange(shard_rows, dtype=jnp.int32)
+    return RowInfo(ids=ids, mask=ids < nrow, nrow=nrow)
+
+
+def mr_reduce(
+    map_fn: Callable[[Sequence[jax.Array], RowInfo], Any],
+    arrays: Sequence[jax.Array],
+    nrow: int,
+    reduce: str | dict[str, str] = "sum",
+    mesh: Mesh | None = None,
+):
+    """Distributed map/reduce over row-sharded columns.
+
+    ``map_fn(local_arrays, rows) -> pytree`` runs per shard; leaves are combined
+    across the ``rows`` mesh axis with the given monoid ("sum"|"min"|"max", or a
+    dict keyed by top-level output name for mixed reductions). The result is
+    replicated (every shard returns the full reduction) and returned to host.
+    """
+    mesh = mesh or default_mesh()
+    arrays = tuple(arrays)
+    shard_rows = arrays[0].shape[0] // mesh.shape[ROWS]
+
+    def spmd(*cols):
+        rows = _row_info(shard_rows, nrow)
+        out = map_fn(cols, rows)
+        if isinstance(reduce, str):
+            return jax.tree.map(lambda x: _REDUCERS[reduce](x, ROWS), out)
+        return {k: jax.tree.map(lambda x: _REDUCERS[reduce[k]](x, ROWS), v)
+                for k, v in out.items()}
+
+    in_specs = tuple(P(ROWS) + P(*([None] * (a.ndim - 1))) for a in arrays)
+    fn = shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return jax.jit(fn)(*arrays)
+
+
+def mr_map(
+    map_fn: Callable[[Sequence[jax.Array], RowInfo], Any],
+    arrays: Sequence[jax.Array],
+    nrow: int,
+    mesh: Mesh | None = None,
+):
+    """Row-to-row distributed map producing new row-sharded arrays.
+
+    This is the `outputFrame` path: map returns one or more per-row arrays
+    (same leading dim as the shard); outputs stay sharded on the rows axis.
+    """
+    mesh = mesh or default_mesh()
+    arrays = tuple(arrays)
+    shard_rows = arrays[0].shape[0] // mesh.shape[ROWS]
+
+    def spmd(*cols):
+        rows = _row_info(shard_rows, nrow)
+        return map_fn(cols, rows)
+
+    in_specs = tuple(P(ROWS) + P(*([None] * (a.ndim - 1))) for a in arrays)
+    fn = shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=P(ROWS))
+    return jax.jit(fn)(*arrays)

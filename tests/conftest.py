@@ -1,0 +1,30 @@
+"""Test harness — the analog of H2O's multi-JVM-on-one-host trick.
+
+The reference runs distributed tests by forking 4 H2O JVMs on localhost
+(`gradle/multiNodeTesting.gradle:34-53`, `multiNodeUtils.sh:22-27`) so the real
+RPC stack is exercised without a cluster. Here we force an 8-device virtual CPU
+mesh (`--xla_force_host_platform_device_count=8`), so every test exercises real
+sharding + collectives without TPU hardware (SURVEY.md §4 "lesson").
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins JAX_PLATFORMS=axon (real TPU); tests always run on
+# the virtual CPU mesh, so override at the config level too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def cloud():
+    """stall_till_cloudsize analog: assert the virtual mesh came up with 8 devices."""
+    assert len(jax.devices()) == 8, f"expected 8 virtual devices, got {len(jax.devices())}"
+    yield
