@@ -1,0 +1,227 @@
+"""Ingest: file → sharded Frame. Analog of `water/parser/` (7,837 LoC).
+
+The reference runs a 2-pass distributed parse: `ParseSetup` samples the file to
+guess separator/header/column types (`water/parser/ParseSetup.java`, 901 LoC),
+then `MultiFileParseTask` — an MRTask over file chunks — tokenizes bytes into
+`NewChunk`s with distributed categorical interning
+(`water/parser/ParseDataset.java:260,689,502-601`).
+
+TPU-native design (SURVEY.md §7.4): tokenization is a host problem — Arrow's
+multithreaded CSV/Parquet readers replace the hand-rolled byte tokenizer
+(`water/parser/CsvParser.java`), and the columnar batches are then padded,
+NA-normalized, interned, and device_put as row-sharded arrays. Type-guessing
+heuristics mirror ParseSetup: NA-string vocabulary, header detection, numeric /
+categorical / time promotion. Categorical interning uses Arrow dictionary
+encoding + a lexicographic renumber — the single-process equivalent of the
+cluster-wide per-node-map merge (`ParseDataset.java:502-601`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ..backend.kvstore import STORE
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, T_INT, T_NUM, T_STR, T_TIME, Vec
+
+#: NA token vocabulary — mirrors `water/parser/ParseSetup` NA string handling.
+DEFAULT_NA_STRINGS = ["", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "?", "None"]
+
+
+class ParseSetup:
+    """Parse configuration, guessed from a sample or user-overridden.
+
+    Mirrors the role (not the mechanics) of `water/parser/ParseSetup.java`.
+    """
+
+    def __init__(
+        self,
+        separator: str | None = None,
+        header: bool | None = None,
+        column_names: Sequence[str] | None = None,
+        column_types: dict | None = None,  # name -> h2o type str
+        na_strings: Sequence[str] | None = None,
+        skipped_columns: Sequence[str] | None = None,
+    ):
+        self.separator = separator
+        self.header = header
+        self.column_names = list(column_names) if column_names else None
+        self.column_types = dict(column_types or {})
+        self.na_strings = list(na_strings if na_strings is not None else DEFAULT_NA_STRINGS)
+        self.skipped_columns = list(skipped_columns or [])
+
+
+def guess_setup(path: str, setup: ParseSetup | None = None) -> ParseSetup:
+    """Sample the file head and guess separator/header (ParseSetup pass 1)."""
+    setup = setup or ParseSetup()
+    if path.endswith((".parquet", ".pq", ".orc", ".avro", ".svm", ".svmlight")):
+        return setup
+    with open(path, "rb") as f:
+        head = f.read(1 << 16).decode("utf-8", errors="replace")
+    lines = [ln for ln in head.splitlines() if ln.strip()][:50]
+    if not lines:
+        return setup
+    if setup.separator is None:
+        counts = {sep: lines[0].count(sep) for sep in [",", "\t", ";", "|"]}
+        best = max(counts, key=counts.get)
+        setup.separator = best if counts[best] > 0 else ","
+    if setup.header is None:
+        # Header heuristic: first row tokens are non-numeric, second row has numerics.
+        first = lines[0].split(setup.separator)
+        setup.header = not any(_is_number(t) for t in first)
+    return setup
+
+
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok.strip().strip('"'))
+        return True
+    except ValueError:
+        return False
+
+
+def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
+               dest_key: str | None = None) -> Frame:
+    """Parse one file into a sharded Frame (the ParseDataset.parse analog)."""
+    import pyarrow as pa
+
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".parquet", ".pq"):
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+    elif ext == ".orc":
+        import pyarrow.orc as orc
+
+        table = orc.ORCFile(path).read()
+    elif ext == ".avro":
+        raise NotImplementedError("avro ingest requires fastavro (not in image); "
+                                  "convert to parquet/csv")
+    elif ext in (".svm", ".svmlight"):
+        return _parse_svmlight(path, mesh=mesh, dest_key=dest_key)
+    else:
+        table = _read_csv(path, guess_setup(path, setup))
+    return _table_to_frame(table, setup or ParseSetup(), mesh=mesh, dest_key=dest_key)
+
+
+def _read_csv(path: str, setup: ParseSetup):
+    import pyarrow.csv as pacsv
+
+    read_opts = pacsv.ReadOptions(
+        autogenerate_column_names=(setup.header is False),
+    )
+    if setup.column_names:
+        read_opts.column_names = setup.column_names
+    parse_opts = pacsv.ParseOptions(delimiter=setup.separator or ",")
+    conv_opts = pacsv.ConvertOptions(null_values=setup.na_strings,
+                                     strings_can_be_null=True)
+    if path.endswith(".gz") or path.endswith(".zip"):
+        import pyarrow as pa
+
+        return pacsv.read_csv(pa.input_stream(path, compression="gzip"),
+                              read_options=read_opts, parse_options=parse_opts,
+                              convert_options=conv_opts)
+    return pacsv.read_csv(path, read_options=read_opts, parse_options=parse_opts,
+                          convert_options=conv_opts)
+
+
+def _table_to_frame(table, setup: ParseSetup, mesh=None, dest_key=None) -> Frame:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    names, vecs = [], []
+    for name in table.column_names:
+        if name in setup.skipped_columns:
+            continue
+        col = table.column(name).combine_chunks()
+        want = setup.column_types.get(name)
+        t = col.type
+        if want == T_STR:
+            vecs.append(Vec(None, len(col), type=T_STR,
+                            host_data=np.asarray(col.to_pylist(), dtype=object)))
+        elif want == T_CAT or (want is None and (pa.types.is_string(t) or
+                                                 pa.types.is_large_string(t) or
+                                                 pa.types.is_dictionary(t))):
+            vecs.append(_intern_categorical(col, mesh))
+        elif pa.types.is_timestamp(t) or pa.types.is_date(t) or want == T_TIME:
+            ms = pc.cast(pc.cast(col, pa.timestamp("ms")), pa.int64())
+            arr = ms.to_numpy(zero_copy_only=False).astype(np.float64)
+            arr[np.asarray(pc.is_null(col))] = np.nan
+            vecs.append(Vec.from_numpy(arr, type=T_TIME, mesh=mesh))
+        elif pa.types.is_boolean(t):
+            arr = col.to_numpy(zero_copy_only=False).astype(np.float32)
+            vecs.append(Vec.from_numpy(arr, type=T_INT, mesh=mesh))
+        else:
+            arr = col.to_numpy(zero_copy_only=False)
+            if want == T_NUM:
+                vecs.append(Vec.from_numpy(arr.astype(np.float64), type=T_NUM, mesh=mesh))
+            else:
+                vecs.append(Vec.from_numpy(arr, mesh=mesh))
+        names.append(name)
+    fr = Frame(names, vecs, key=dest_key)
+    STORE.put_keyed(fr)
+    return fr
+
+
+def _intern_categorical(col, mesh) -> Vec:
+    """Dictionary-encode + lexicographic renumber (categorical interning).
+
+    The reference merges per-node categorical maps then renumbers globally
+    (`water/parser/ParseDataset.java:502-601`); Arrow dictionary encoding plus a
+    sorted-domain permutation gives identical domains/codes in one process.
+    """
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if not pa.types.is_dictionary(col.type):
+        col = pc.dictionary_encode(col)
+    dic = [str(x) for x in col.dictionary.to_pylist()]
+    codes = col.indices.to_numpy(zero_copy_only=False).astype(np.float32)
+    null_mask = np.asarray(pc.is_null(col))
+    order = np.argsort(np.asarray(dic, dtype=object), kind="stable")
+    remap = np.empty(len(dic), dtype=np.float32)
+    remap[order] = np.arange(len(dic), dtype=np.float32)
+    out = remap[codes.astype(np.int64)] if len(dic) else codes
+    out[null_mask] = np.nan
+    return Vec.from_numpy(out, type=T_CAT, domain=[dic[i] for i in order], mesh=mesh)
+
+
+def _parse_svmlight(path: str, mesh=None, dest_key=None) -> Frame:
+    """Minimal SVMLight reader (`water/parser/SVMLightParser.java` analog)."""
+    rows, targets, max_idx = [], [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            targets.append(float(parts[0]))
+            kv = {}
+            for p in parts[1:]:
+                k, v = p.split(":")
+                k = int(k)
+                kv[k] = float(v)
+                max_idx = max(max_idx, k)
+            rows.append(kv)
+    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float32)
+    for i, kv in enumerate(rows):
+        for k, v in kv.items():
+            mat[i, k] = v
+    cols = {"target": np.asarray(targets, dtype=np.float32)}
+    for j in range(max_idx + 1):
+        cols[f"C{j}"] = mat[:, j]
+    return Frame.from_dict(cols, mesh=mesh, key=dest_key)
+
+
+def import_file(path: str, destination_frame: str | None = None,
+                header: bool | None = None, sep: str | None = None,
+                col_names: Sequence[str] | None = None,
+                col_types: dict | None = None,
+                na_strings: Sequence[str] | None = None, mesh=None) -> Frame:
+    """Public ingest entry — mirrors `h2o.import_file` (`h2o-py/h2o/h2o.py:323`)."""
+    setup = ParseSetup(separator=sep, header=header, column_names=col_names,
+                       column_types=col_types, na_strings=na_strings)
+    return parse_file(path, setup, mesh=mesh, dest_key=destination_frame)

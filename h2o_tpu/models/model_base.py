@@ -1,0 +1,331 @@
+"""Model / ModelBuilder / Parameters — analog of `hex/Model.java` (3,535 LoC),
+`hex/ModelBuilder.java` (2,198 LoC) and the per-algo `Model.Parameters` Iced
+objects.
+
+Semantics preserved from the reference:
+- ``Parameters`` is a plain dataclass mirroring the REST-schema field names
+  (training_frame, response_column, ignored_columns, weights_column, nfolds,
+  seed, distribution, ...) so the h2o-py estimator surface maps 1:1.
+- ``ModelBuilder.train()`` returns a Job running the driver on a worker thread
+  (`hex/ModelBuilder.java:381-398` trainModel → Driver), cooperatively
+  cancellable; ``train_model()`` is the blocking convenience.
+- N-fold cross-validation orchestration (`hex/ModelBuilder.java:614`
+  computeCrossValidation): fold assignment (random / modulo / stratified), one
+  model per fold on the complement, holdout metrics, then the final model on
+  the full frame. Fold builds are embarrassingly parallel across mesh slices in
+  principle; here they run sequentially on the single controller (the mesh is
+  busy either way).
+- ``Model.score()`` adapts the test frame to training domains
+  (`hex/Model.java:1638` adaptTestForTrain) then runs one device-side batch
+  prediction — the BigScore MRTask analog (`hex/Model.java:2232`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..backend.kvstore import Keyed, STORE
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from .metrics import (make_binomial_metrics, make_multinomial_metrics,
+                      make_regression_metrics)
+
+
+@dataclass
+class Parameters:
+    """Common hyperparameters — `hex/Model.java` Model.Parameters."""
+
+    training_frame: Optional[Frame] = None
+    validation_frame: Optional[Frame] = None
+    response_column: Optional[str] = None
+    ignored_columns: list = field(default_factory=list)
+    weights_column: Optional[str] = None
+    offset_column: Optional[str] = None
+    fold_column: Optional[str] = None
+    nfolds: int = 0
+    fold_assignment: str = "AUTO"  # AUTO|Random|Modulo|Stratified
+    keep_cross_validation_models: bool = True
+    keep_cross_validation_predictions: bool = False
+    seed: int = -1
+    max_runtime_secs: float = 0.0
+    distribution: str = "AUTO"
+    categorical_encoding: str = "AUTO"
+    ignore_const_cols: bool = True
+    balance_classes: bool = False
+    stopping_rounds: int = 0
+    stopping_metric: str = "AUTO"
+    stopping_tolerance: float = 1e-3
+
+    def clone(self, **overrides):
+        return dataclasses.replace(self, **overrides)
+
+
+class ModelOutput:
+    """Analog of `hex/Model.Output` — everything the trained model publishes."""
+
+    def __init__(self):
+        self.names: list[str] = []
+        self.domains: dict[str, list | None] = {}
+        self.response_domain: list | None = None
+        self.model_category = "Regression"  # Regression|Binomial|Multinomial|Clustering|...
+        self.training_metrics = None
+        self.validation_metrics = None
+        self.cross_validation_metrics = None
+        self.scoring_history: list[dict] = []
+        self.variable_importances: dict | None = None
+        self.run_time_ms = 0
+        self.cv_models: list = []
+
+
+class Model(Keyed):
+    algo_name = "base"
+
+    def __init__(self, params: Parameters, output: ModelOutput, key=None):
+        super().__init__(key=key, prefix=f"{self.algo_name}_model")
+        self.params = params
+        self.output = output
+        STORE.put_keyed(self)
+
+    # -- prediction ----------------------------------------------------------
+    def score0(self, X: jax.Array) -> jax.Array:
+        """Raw per-row prediction on a dense feature matrix — per-algo override
+        (the `hex/Model.java:2232` score0 contract). Returns (n,) for
+        regression, (n, 1+K) [label, p0..pK-1] for classification."""
+        raise NotImplementedError
+
+    def adapt_frame(self, fr: Frame) -> jax.Array:
+        """adaptTestForTrain analog: select training columns in order, remap
+        categorical codes onto the training domain (unseen levels → NaN)."""
+        cols = []
+        for name in self.output.names:
+            v = fr.vec(name)
+            train_dom = self.output.domains.get(name)
+            if train_dom is not None and v.domain != train_dom:
+                remap = {lvl: i for i, lvl in enumerate(train_dom)}
+                codes = np.full(len(v.domain or []), np.nan, dtype=np.float32)
+                for i, lvl in enumerate(v.domain or []):
+                    if lvl in remap:
+                        codes[i] = remap[lvl]
+                host = v.to_numpy()
+                ok = ~np.isnan(host)
+                newc = np.full(host.shape, np.nan, dtype=np.float32)
+                newc[ok] = codes[host[ok].astype(np.int64)]
+                v = Vec.from_numpy(newc, type=T_CAT, domain=train_dom)
+            cols.append(v)
+        tmp = Frame([n for n in self.output.names], cols)
+        return tmp.as_matrix()
+
+    def predict(self, fr: Frame) -> Frame:
+        X = self.adapt_frame(fr)
+        raw = self.score0(X)
+        return self._predictions_frame(raw, fr.nrow)
+
+    def _predictions_frame(self, raw, nrow) -> Frame:
+        cat = self.output.model_category
+        if cat == "Regression":
+            return Frame(["predict"], [Vec.from_device(raw, nrow)])
+        dom = self.output.response_domain or [str(i) for i in range(raw.shape[1] - 1)]
+        names = ["predict"] + [f"p{d}" for d in dom]
+        vecs = [Vec.from_device(raw[:, 0], nrow, type=T_CAT, domain=list(dom))]
+        for j in range(1, raw.shape[1]):
+            vecs.append(Vec.from_device(raw[:, j], nrow))
+        return Frame(names, vecs)
+
+    # -- metrics -------------------------------------------------------------
+    def model_performance(self, fr: Frame | None = None):
+        if fr is None:
+            return self.output.training_metrics
+        X = self.adapt_frame(fr)
+        raw = self.score0(X)
+        y = _response_device(fr, self.params.response_column, self.output.response_domain)
+        w = fr.vec(self.params.weights_column).data if self.params.weights_column else None
+        return make_metrics(self.output.model_category, y, raw, w)
+
+    def auc(self):
+        return getattr(self.output.training_metrics, "auc", None)
+
+    def remove_impl(self, store):
+        for m in self.output.cv_models:
+            store.remove(m.key)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self.key}, {self.output.model_category})\n"
+                f"{self.output.training_metrics!r}")
+
+
+def make_metrics(category, y, raw, weights=None):
+    if category == "Binomial":
+        return make_binomial_metrics(y, raw[:, 2], weights)
+    if category == "Multinomial":
+        return make_multinomial_metrics(y, raw[:, 1:], weights)
+    return make_regression_metrics(y, raw, weights)
+
+
+def _response_device(fr: Frame, response: str, train_dom) -> jax.Array:
+    v = fr.vec(response)
+    if train_dom is not None and v.domain is not None and v.domain != list(train_dom):
+        remap = {lvl: i for i, lvl in enumerate(train_dom)}
+        host = v.to_numpy()
+        out = np.full(host.shape, np.nan, dtype=np.float32)
+        ok = ~np.isnan(host)
+        out[ok] = [remap.get((v.domain)[int(c)], np.nan) for c in host[ok]]
+        return Vec.from_numpy(out).data
+    return v.data
+
+
+class ModelBuilder:
+    """Per-algo builders subclass this and implement ``build_impl``."""
+
+    algo_name = "base"
+    supervised = True
+
+    def __init__(self, params: Parameters):
+        self.params = params
+        self.job: Job | None = None
+        self._validate()
+
+    # -- validation (init(expensive) analog) ---------------------------------
+    def _validate(self):
+        p = self.params
+        if p.training_frame is None:
+            raise ValueError("training_frame is required")
+        if self.supervised:
+            if not p.response_column:
+                raise ValueError(f"{self.algo_name}: response_column is required")
+            if p.training_frame.find(p.response_column) < 0:
+                raise ValueError(f"response_column '{p.response_column}' not in frame")
+
+    # -- feature selection ----------------------------------------------------
+    def feature_names(self) -> list[str]:
+        p = self.params
+        skip = set(p.ignored_columns) | {p.response_column, p.weights_column,
+                                         p.offset_column, p.fold_column, None}
+        out = []
+        for name in p.training_frame.names:
+            if name in skip:
+                continue
+            v = p.training_frame.vec(name)
+            if v.is_string():
+                continue
+            if p.ignore_const_cols and v.data is not None:
+                r = v.rollups()
+                if r.nacnt == v.nrow or (r.mins == r.maxs):
+                    continue
+            out.append(name)
+        return out
+
+    def response_info(self):
+        """(y array, model_category, response domain)."""
+        p = self.params
+        v = p.training_frame.vec(p.response_column)
+        if v.is_categorical():
+            k = len(v.domain)
+            cat = "Binomial" if k == 2 else "Multinomial"
+            return v.data, cat, v.domain
+        dist = (p.distribution or "AUTO").lower()
+        if dist in ("bernoulli", "quasibinomial"):
+            return v.data, "Binomial", ["0", "1"]
+        if dist == "multinomial":
+            k = int(v.max()) + 1
+            return v.data, "Multinomial", [str(i) for i in range(k)]
+        return v.data, "Regression", None
+
+    # -- training ------------------------------------------------------------
+    def build_impl(self, job: Job) -> Model:
+        raise NotImplementedError
+
+    def train(self, background: bool = True) -> Job:
+        """trainModel analog — returns the running Job."""
+        self.job = Job(f"{self.algo_name} training", work=1.0)
+
+        def run():
+            t0 = time.time()
+            if self.params.nfolds >= 2 or self.params.fold_column:
+                model = self._train_with_cv(self.job)
+            else:
+                model = self.build_impl(self.job)
+            model.output.run_time_ms = int((time.time() - t0) * 1000)
+            self.job.dest_key = model.key
+            return model
+
+        self.job.start(run, background=background)
+        return self.job
+
+    def train_model(self) -> Model:
+        return self.train(background=False).join()
+
+    # -- cross-validation (`hex/ModelBuilder.java:614`) -----------------------
+    def _train_with_cv(self, job: Job) -> Model:
+        p = self.params
+        fr = p.training_frame
+        folds = self._fold_assignment(fr)
+        nf = int(folds.max()) + 1
+        cv_models, holdout_metrics = [], []
+        host = {n: fr.vec(n) for n in fr.names}
+        for f in range(nf):
+            job.check_cancelled()
+            tr_idx = np.where(folds != f)[0]
+            va_idx = np.where(folds == f)[0]
+            tr = _subset_frame(fr, tr_idx)
+            va = _subset_frame(fr, va_idx)
+            sub = type(self)(p.clone(training_frame=tr, validation_frame=None,
+                                     nfolds=0, fold_column=None))
+            m = sub.build_impl(Job(f"cv_{f}", work=1.0))
+            holdout_metrics.append(m.model_performance(va))
+            cv_models.append(m)
+        main = self.build_impl(job)
+        main.output.cross_validation_metrics = _mean_metrics(holdout_metrics)
+        if p.keep_cross_validation_models:
+            main.output.cv_models = cv_models
+        return main
+
+    def _fold_assignment(self, fr: Frame) -> np.ndarray:
+        p = self.params
+        if p.fold_column:
+            return fr.vec(p.fold_column).to_numpy().astype(np.int64)
+        n = fr.nrow
+        scheme = p.fold_assignment.upper()
+        rng = np.random.default_rng(None if p.seed in (-1, None) else p.seed)
+        if scheme == "MODULO":
+            return np.arange(n) % p.nfolds
+        if scheme == "STRATIFIED" and self.supervised:
+            y = fr.vec(p.response_column).to_numpy()
+            out = np.zeros(n, dtype=np.int64)
+            for cls in np.unique(y[~np.isnan(y)]):
+                idx = np.where(y == cls)[0]
+                out[idx] = rng.permutation(len(idx)) % p.nfolds
+            return out
+        return rng.integers(0, p.nfolds, size=n)
+
+
+def _subset_frame(fr: Frame, idx: np.ndarray) -> Frame:
+    cols = {}
+    for name in fr.names:
+        v = fr.vec(name)
+        if v.is_string():
+            cols[name] = Vec(None, len(idx), type=v.type, host_data=v.host_data[idx])
+        else:
+            sub = v.to_numpy()[idx]
+            cols[name] = Vec.from_numpy(sub, type=v.type, domain=v.domain)
+    return Frame(list(cols), list(cols.values()))
+
+
+def _mean_metrics(ms: list):
+    if not ms:
+        return None
+    out = ms[0]
+    for fname in ("mse", "rmse", "mae", "auc", "logloss", "r2",
+                  "mean_per_class_error"):
+        vals = [getattr(m, fname) for m in ms if hasattr(m, fname)]
+        vals = [v for v in vals if v is not None and not np.isnan(v)]
+        if vals and hasattr(out, fname):
+            setattr(out, fname, float(np.mean(vals)))
+    return out

@@ -1,0 +1,67 @@
+"""Feature binning for the histogram tree engine.
+
+The reference re-bins every (leaf, column) pair adaptively per tree level
+(`hex/tree/DHistogram.java:19-99` UniformAdaptive). That design needs per-level
+host decisions and dynamic bin ranges — poison for XLA (recompilation storms,
+SURVEY.md §7 "hard parts"). We instead bin once per training run on global
+quantiles (the LightGBM/XGBoost-hist design, and what H2O itself does in
+`histogram_type="QuantilesGlobal"` — `hex/tree/DHistogram.java` quantiles mode),
+which keeps every downstream shape static. Deliberate divergence, documented.
+
+Layout:
+- ``edges``  (F, nbins-1) float32 — right-inclusive cut points per feature.
+  For categorical columns the "edges" are the category codes 0..card-2, so a
+  bin IS a category and split thresholds stay meaningful on raw codes.
+- binned matrix (R, F) int8/int32 — bin index in [0, nbins-1]; missing values
+  get the dedicated NA bin ``nbins`` (the DHistogram NA bucket analog).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
+                      sample: int = 200_000, seed: int = 1234) -> np.ndarray:
+    """Quantile-based global bin edges per feature.
+
+    X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles are taken on a
+    host-side row sample (the reference's QuantilesGlobal mode also samples).
+    Returns (F, nbins-1) float32 edges, NaN-padded where a feature has fewer
+    distinct cut points.
+    """
+    R, F = X.shape
+    if R > sample:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(R, size=sample, replace=False)
+        Xs = np.asarray(X[np.sort(idx)])
+    else:
+        Xs = np.asarray(X)
+    edges = np.full((F, nbins - 1), np.nan, dtype=np.float32)
+    qs = np.linspace(0, 1, nbins + 1)[1:-1]
+    for f in range(F):
+        col = Xs[:, f]
+        col = col[~np.isnan(col)]
+        if col.size == 0:
+            continue
+        if is_cat[f]:
+            card = int(col.max()) + 1
+            cuts = np.arange(min(card - 1, nbins - 1), dtype=np.float32)
+        else:
+            cuts = np.unique(np.quantile(col, qs).astype(np.float32))
+        edges[f, : len(cuts)] = cuts
+    return edges
+
+
+@jax.jit
+def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
+    """Map raw values to bin indices: bin = #edges < x; NA -> nbins (NA bucket).
+
+    One vectorized compare-and-sum — (R, F, nbins-1) broadcast, XLA fuses it.
+    """
+    nbins = edges.shape[1] + 1
+    cmp = X[:, :, None] > edges[None, :, :]  # NaN compares false
+    b = jnp.sum(cmp, axis=2, dtype=jnp.int32)
+    return jnp.where(jnp.isnan(X), nbins, b).astype(jnp.int32)

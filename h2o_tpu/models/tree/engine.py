@@ -1,0 +1,325 @@
+"""Shared tree engine — the TPU-native `hex/tree/SharedTree.java` +
+`ScoreBuildHistogram2` + `DTree` + `DHistogram`.
+
+Reference hot loop (`hex/tree/ScoreBuildHistogram2.java:16-62`): per tree level,
+one cluster-wide MRTask walks every row to its current leaf and accumulates
+per-(leaf, column) histograms of {w, wY, wYY}; private per-thread copies avoid
+CAS; reductions ship histogram arrays up the RPC tree. Split finding then runs
+on the driver (`hex/tree/DTree.java` DecidedNode).
+
+TPU-native redesign (SURVEY.md §7.6a):
+- The ENTIRE multi-tree training loop is ONE XLA program: jit(shard_map(scan
+  over trees)); there are no per-level host round-trips at all.
+- Histogram accumulation is a one-hot matmul on the MXU — rows × small
+  (node-count × 3) left operand against rows × (features × bins) one-hot right
+  operand, blocked over rows via lax.scan so the one-hots live in VMEM and never
+  materialize in HBM. This is the no-scatter, no-CAS design: the matmul IS the
+  private-copy merge.
+- Cross-device reduction is a single psum over the `rows` mesh axis per level
+  (replacing `water/MRTask.java:855-926`'s two-level reduce tree).
+- Split finding is vectorized over (feature, node, bin, NA-direction) on
+  device, replicated on every shard (cheap; avoids a broadcast).
+- Trees use a full-binary-tree layout (node i -> children 2i+1/2i+2) with
+  static shapes, so deeper trees are masked work, never a recompile.
+- Histograms accumulate {w, g, h} (weight/gradient/hessian) rather than
+  {w, wY, wYY}: equivalent for gaussian and generalizes every distribution to
+  Newton leaf values, which is how the XGBoost-equivalent backend (`hex/tree/
+  xgboost`) also scores splits.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.mesh import ROWS, default_mesh
+
+
+@dataclass(frozen=True)
+class TreeConfig:
+    ntrees: int = 50
+    max_depth: int = 5
+    nbins: int = 20              # real-value bins; bin index nbins = NA bucket
+    min_rows: float = 10.0
+    learn_rate: float = 0.1
+    reg_lambda: float = 0.0      # Newton denominator regularizer (0 = H2O SE gain)
+    min_split_improvement: float = 1e-5
+    sample_rate: float = 1.0     # per-tree row subsample
+    col_sample_rate: float = 1.0         # per-split (level) column subsample
+    col_sample_rate_per_tree: float = 1.0
+    mtries: int = -1             # DRF: cols per split; -1 = auto
+    drf_mode: bool = False       # trees fit at f=0, averaged at predict
+    nclass: int = 1              # trees per iteration (multinomial K)
+    block_rows: int = 8192       # row-block size for the histogram scan
+
+    @property
+    def n_nodes(self) -> int:
+        return 2 ** (self.max_depth + 1) - 1
+
+
+def _block_rows(rl: int, want: int) -> int:
+    if rl % want == 0:
+        return want
+    # largest power-of-two divisor of rl up to `want`
+    b = 1
+    while b * 2 <= want and rl % (b * 2) == 0:
+        b *= 2
+    return b if rl % b == 0 else rl
+
+
+# ---------------------------------------------------------------------------
+# Histogram build (the ScoreBuildHistogram2 analog) — runs inside shard_map.
+# ---------------------------------------------------------------------------
+def _build_level_hist(Xb, node, vals3, offset, n_lv, nbins_tot, block):
+    """Accumulate hist (F, n_lv, nbins_tot, 3) for nodes [offset, offset+n_lv).
+
+    Xb: (Rl, F) int32 bins; node: (Rl,) int32 global node ids; vals3: (Rl, 3)
+    [w, g, h] already zeroed for inactive rows.
+    """
+    Rl, F = Xb.shape
+    rb = _block_rows(Rl, block)
+    nblk = Rl // rb
+
+    local = node - offset
+    active = (local >= 0) & (local < n_lv)
+    lc = jnp.clip(local, 0, n_lv - 1)
+    v = jnp.where(active[:, None], vals3, 0.0)
+
+    Xb_r = Xb.reshape(nblk, rb, F)
+    lc_r = lc.reshape(nblk, rb)
+    v_r = v.reshape(nblk, rb, 3)
+
+    def body(acc, blk):
+        xb, l, vv = blk
+        n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)          # (rb, n_lv)
+        a = jnp.einsum("rn,rv->rnv", n_oh, vv)                      # (rb, n_lv, 3)
+        b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)     # (rb, F, B)
+        acc = acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh)
+        return acc, None
+
+    init = jnp.zeros((F, n_lv, nbins_tot, 3), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
+    return jax.lax.psum(hist, ROWS)
+
+
+# ---------------------------------------------------------------------------
+# Split finding (DTree.DecidedNode analog), vectorized on device.
+# ---------------------------------------------------------------------------
+def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig):
+    """hist: (F, n_lv, B, 3). Returns per-node best (gain, feat, bin, nan_left).
+
+    Candidates: split at bin b (left = bins <= b), b in 0..nb-2, NA bucket sent
+    left or right (`hex/tree/DHistogram.java` NA bucket; direction chosen by
+    gain like the reference's NASplitDir).
+    """
+    nb = cfg.nbins
+    W, G, H = hist[..., 0], hist[..., 1], hist[..., 2]
+    lam = cfg.reg_lambda
+    Wt = jnp.sum(W, axis=2)[0]  # (n_lv,) — identical across features
+    Gt = jnp.sum(G, axis=2)[0]
+    Ht = jnp.sum(H, axis=2)[0]
+
+    cw = jnp.cumsum(W[:, :, :nb], axis=2)[:, :, :-1]  # (F, n_lv, nb-1)
+    cg = jnp.cumsum(G[:, :, :nb], axis=2)[:, :, :-1]
+    ch = jnp.cumsum(H[:, :, :nb], axis=2)[:, :, :-1]
+    wna = W[:, :, nb][:, :, None]
+    gna = G[:, :, nb][:, :, None]
+    hna = H[:, :, nb][:, :, None]
+
+    def gain_of(wl, gl, hl):
+        wr = Wt[None, :, None] - wl
+        gr = Gt[None, :, None] - gl
+        hr = Ht[None, :, None] - hl
+        g = (gl * gl / (hl + lam + 1e-10) + gr * gr / (hr + lam + 1e-10)
+             - (Gt * Gt / (Ht + lam + 1e-10))[None, :, None])
+        ok = (wl >= cfg.min_rows) & (wr >= cfg.min_rows)
+        return jnp.where(ok, g, -jnp.inf)
+
+    gain_nar = gain_of(cw, cg, ch)                      # NA right
+    gain_nal = gain_of(cw + wna, cg + gna, ch + hna)    # NA left
+    gains = jnp.stack([gain_nar, gain_nal], axis=3)     # (F, n_lv, nb-1, 2)
+    gains = jnp.where(colmask[:, :, None, None], gains, -jnp.inf)
+    gains = jnp.where(edge_ok[:, None, :, None], gains, -jnp.inf)
+
+    F, n_lv = gains.shape[0], gains.shape[1]
+    flat = jnp.transpose(gains, (1, 0, 2, 3)).reshape(n_lv, -1)  # (n_lv, F*(nb-1)*2)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    per_f = (nb - 1) * 2
+    bf = (best // per_f).astype(jnp.int32)
+    bb = ((best % per_f) // 2).astype(jnp.int32)
+    bnal = (best % 2).astype(jnp.bool_)
+    return best_gain, bf, bb, bnal, Wt
+
+
+# ---------------------------------------------------------------------------
+# Grow one tree fully on device (shard-local function; psums inside).
+# ---------------------------------------------------------------------------
+def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
+    """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,))."""
+    Rl, F = Xb.shape
+    N = cfg.n_nodes
+    B = cfg.nbins + 1
+
+    feat = jnp.full((N,), -1, dtype=jnp.int32)
+    thr = jnp.zeros((N,), dtype=jnp.float32)
+    nanL = jnp.zeros((N,), dtype=jnp.bool_)
+    garr = jnp.zeros((N,), dtype=jnp.float32)  # split gains (variable importance)
+    node = jnp.zeros((Rl,), dtype=jnp.int32)
+    vals3 = jnp.stack([w, g, h], axis=1)
+
+    # per-tree column subsample (same on all shards: colkey is not axis-folded)
+    tree_cols = (jax.random.uniform(jax.random.fold_in(colkey, 997), (F,))
+                 < cfg.col_sample_rate_per_tree)
+    tree_cols = jnp.where(jnp.any(tree_cols), tree_cols, True)
+
+    for level in range(cfg.max_depth):
+        n_lv = 2 ** level
+        offset = n_lv - 1
+        hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B, cfg.block_rows)
+
+        lkey = jax.random.fold_in(colkey, level)
+        if cfg.mtries > 0:
+            u = jax.random.uniform(lkey, (F, n_lv))
+            kth = jnp.sort(u, axis=0)[min(cfg.mtries, F) - 1]
+            cmask = u <= kth[None, :]
+        elif cfg.col_sample_rate < 1.0:
+            cmask = jax.random.uniform(lkey, (F, n_lv)) < cfg.col_sample_rate
+            cmask = jnp.where(jnp.any(cmask, axis=0, keepdims=True), cmask, True)
+        else:
+            cmask = jnp.ones((F, n_lv), dtype=jnp.bool_)
+        cmask = cmask & tree_cols[:, None]
+
+        gain, bf, bb, bnal, Wt = _find_splits(hist, cmask, edge_ok, cfg)
+        do_split = (gain > cfg.min_split_improvement) & (Wt >= 2 * cfg.min_rows)
+
+        feat = jax.lax.dynamic_update_slice(
+            feat, jnp.where(do_split, bf, -1), (offset,))
+        thr = jax.lax.dynamic_update_slice(
+            thr, edges[bf, bb], (offset,))
+        nanL = jax.lax.dynamic_update_slice(nanL, bnal, (offset,))
+        garr = jax.lax.dynamic_update_slice(
+            garr, jnp.where(do_split, gain, 0.0).astype(jnp.float32), (offset,))
+
+        # route rows: only rows at split nodes of this level descend
+        local = node - offset
+        active = (local >= 0) & (local < n_lv)
+        lc = jnp.clip(local, 0, n_lv - 1)
+        row_bf = bf[lc]
+        row_bb = bb[lc]
+        row_nal = bnal[lc]
+        row_split = do_split[lc] & active
+        rb_val = jnp.take_along_axis(Xb, row_bf[:, None], axis=1)[:, 0]
+        go_right = jnp.where(rb_val == cfg.nbins, ~row_nal, rb_val > row_bb)
+        node = jnp.where(row_split, 2 * node + 1 + go_right.astype(jnp.int32), node)
+
+    # Leaf/stop-node values from one final per-node accumulation (covers both
+    # max-depth leaves and early-stopped internal nodes).
+    rb = _block_rows(Rl, cfg.block_rows)
+    nblk = Rl // rb
+
+    def body(acc, blk):
+        nd, vv = blk
+        n_oh = jax.nn.one_hot(nd, N, dtype=jnp.float32)
+        return acc + jnp.einsum("rn,rv->nv", n_oh, vv), None
+
+    tot, _ = jax.lax.scan(body, jnp.zeros((N, 3), jnp.float32),
+                          (node.reshape(nblk, rb), vals3.reshape(nblk, rb, 3)))
+    tot = jax.lax.psum(tot, ROWS)
+    scale = 1.0 if cfg.drf_mode else cfg.learn_rate
+    val = jnp.where(tot[:, 0] > 0,
+                    -tot[:, 1] / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0) * scale
+    return feat, thr, nanL, val, garr, node
+
+
+def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None):
+    """Build the jitted multi-tree trainer.
+
+    grad_fn(y, f, w) -> (g, h) with f the running link-scale prediction carried
+    through the scan; for ``nclass > 1`` shapes grow a leading K axis and the
+    per-class trees of one iteration are vmapped — the analog of the fused
+    K-trees-per-iteration pass (`hex/tree/SharedTree.java:361-363`).
+
+    Returns train(Xb, y, w, f0, edges, edge_ok, key, ntrees_chunk) ->
+    (f, (feat, thr, nanL, val) stacked over trees).
+    """
+    mesh = mesh or default_mesh()
+    K = cfg.nclass
+
+    def spmd(Xb, y, w, f, edges, edge_ok, keys):
+        def tree_step(f, key):
+            rowkey = jax.random.fold_in(key, jax.lax.axis_index(ROWS))
+            if cfg.sample_rate < 1.0:
+                s = (jax.random.uniform(rowkey, w.shape[-1:]) < cfg.sample_rate
+                     ).astype(jnp.float32)
+            else:
+                s = jnp.ones(w.shape[-1:], jnp.float32)
+            g, h = grad_fn(y, f, w)
+            if K == 1:
+                ft, th, nl, vl, ga, node = _grow_tree(
+                    Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg)
+                delta = vl[node]
+            else:
+                grow = jax.vmap(
+                    lambda gk, hk, ck: _grow_tree(Xb, gk * s, hk * s, w * s,
+                                                  edges, edge_ok, ck, cfg))
+                ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
+                ft, th, nl, vl, ga, node = grow(g, h, ckeys)
+                delta = jnp.take_along_axis(vl, node, axis=1)
+            f = f + delta
+            return f, (ft, th, nl, vl, ga)
+
+        f, trees = jax.lax.scan(tree_step, f, keys)
+        return f, trees
+
+    fspec = P(ROWS) if K == 1 else P(None, ROWS)
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P()),
+        out_specs=(fspec, (P(), P(), P(), P(), P())),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Forest prediction (vectorized CompressedTree traversal; `hex/tree/
+# CompressedTree.java` score0 analog).
+# ---------------------------------------------------------------------------
+def predict_forest(X, feat, thr, nanL, val, max_depth: int):
+    """X: (R, F) raw values. feat/thr/nanL/val: (T, [K,] N). Returns summed
+    tree outputs (R,) or (R, K)."""
+    multi = feat.ndim == 3
+
+    def one_tree(acc, tree):
+        ft, th, nl, vl = tree
+
+        def traverse(ftk, thk, nlk, vlk):
+            node = jnp.zeros(X.shape[0], dtype=jnp.int32)
+            for _ in range(max_depth):
+                nf = ftk[node]
+                is_leaf = nf < 0
+                x = jnp.take_along_axis(X, jnp.clip(nf, 0)[:, None], axis=1)[:, 0]
+                go_right = jnp.where(jnp.isnan(x), ~nlk[node], x > thk[node])
+                nxt = 2 * node + 1 + go_right.astype(jnp.int32)
+                node = jnp.where(is_leaf, node, nxt)
+            return vlk[node]
+
+        if multi:
+            out = jax.vmap(traverse)(ft, th, nl, vl).T  # (R, K)
+        else:
+            out = traverse(ft, th, nl, vl)
+        return acc + out, None
+
+    K = feat.shape[1] if multi else None
+    init = jnp.zeros((X.shape[0], K) if multi else (X.shape[0],), jnp.float32)
+    out, _ = jax.lax.scan(one_tree, init, (feat, thr, nanL, val))
+    return out

@@ -86,13 +86,19 @@ def replicated(mesh: Mesh | None = None) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def padded_len(nrow: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
+def padded_len(nrow: int, mesh: Mesh | None = None, multiple: int | None = None) -> int:
     """Padded row count: divisible by the row-shard count and a lane multiple.
 
     This is the ESPC analog (`water/fvec/Vec.java:152-166`): instead of a vector of
     per-chunk start offsets we use equal-size shards plus a global row count; rows
     beyond ``nrow`` are padding and masked out of every computation.
+
+    The per-shard multiple scales with nrow (8 for small frames, 8192 for large)
+    so the tree engine's row-block scan always gets evenly divisible shards
+    without wasting memory on tiny frames.
     """
     shards = n_row_shards(mesh)
+    if multiple is None:
+        multiple = 8192 if nrow >= 1_000_000 else (256 if nrow >= 10_000 else 8)
     q = shards * multiple
     return int(math.ceil(max(nrow, 1) / q) * q)

@@ -1,0 +1,126 @@
+"""GBM/DRF tests — analog of `h2o-algos/src/test/java/hex/tree/gbm/GBMTest.java`
+(accuracy-style assertions on synthetic data, not bit-exactness)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.models.gbm import GBM, GBMParameters
+
+
+def _regression_frame(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.uniform(-2, 2, size=n)
+    x3 = rng.integers(0, 4, size=n).astype(float)
+    y = 3 * x1 - 2 * x2 ** 2 + x3 + rng.normal(0, 0.1, size=n)
+    return Frame.from_dict({"x1": x1, "x2": x2, "x3": x3, "y": y})
+
+
+def _binomial_frame(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    logit = 2 * x1 - 1.5 * x2
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(int)
+    import pandas as pd
+
+    return Frame.from_pandas(pd.DataFrame(
+        {"x1": x1, "x2": x2, "y": pd.Categorical(np.where(y == 1, "yes", "no"))}))
+
+
+def test_gbm_regression_learns():
+    fr = _regression_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=20, max_depth=4, seed=42)).train_model()
+    tm = m.output.training_metrics
+    var_y = fr.vec("y").sigma() ** 2
+    assert tm.mse < 0.5 * var_y, f"GBM failed to learn: mse={tm.mse} var={var_y}"
+    # predictions frame
+    preds = m.predict(fr)
+    assert preds.names == ["predict"]
+    assert preds.nrow == fr.nrow
+    p = preds.vec("predict").to_numpy()
+    y = fr.vec("y").to_numpy()
+    assert np.corrcoef(p, y)[0, 1] > 0.9
+
+
+def test_gbm_binomial_auc():
+    fr = _binomial_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=30, max_depth=3, seed=42)).train_model()
+    tm = m.output.training_metrics
+    assert m.output.model_category == "Binomial"
+    assert tm.auc > 0.85, f"AUC too low: {tm.auc}"
+    assert tm.logloss < 0.55
+    preds = m.predict(fr)
+    assert preds.names == ["predict", "pno", "pyes"]
+    p1 = preds.vec("pyes").to_numpy()
+    assert (p1 >= 0).all() and (p1 <= 1).all()
+
+
+def test_gbm_multinomial():
+    rng = np.random.default_rng(3)
+    n = 1500
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    cls = np.where(x1 + x2 > 0.7, 2, np.where(x1 - x2 > 0.3, 1, 0))
+    import pandas as pd
+
+    fr = Frame.from_pandas(pd.DataFrame(
+        {"x1": x1, "x2": x2,
+         "y": pd.Categorical.from_codes(cls, categories=["a", "b", "c"])}))
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=20, max_depth=3, seed=1)).train_model()
+    tm = m.output.training_metrics
+    assert m.output.model_category == "Multinomial"
+    assert tm.logloss < 0.45, tm.logloss
+    cm = tm.confusion_matrix
+    acc = np.diag(cm).sum() / cm.sum()
+    assert acc > 0.85
+
+
+def test_gbm_nas_and_weights():
+    fr = _regression_frame()
+    x1 = fr.vec("x1").to_numpy().copy()
+    x1[::7] = np.nan
+    from h2o_tpu.frame.vec import Vec
+
+    fr.replace("x1", Vec.from_numpy(x1))
+    fr.add("w", Vec.from_numpy(np.ones(fr.nrow, dtype=np.float32)))
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          weights_column="w", ntrees=10, max_depth=3,
+                          seed=0)).train_model()
+    assert np.isfinite(m.output.training_metrics.mse)
+
+
+def test_gbm_varimp_and_history():
+    fr = _regression_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=12,
+                          score_tree_interval=4, seed=0)).train_model()
+    vi = m.output.variable_importances
+    assert vi is not None and set(vi["variable"]) == {"x1", "x2", "x3"}
+    assert vi["percentage"].sum() == pytest.approx(1.0, abs=1e-5)
+    assert len(m.output.scoring_history) == 3
+    mses = [h["training_metrics"].mse for h in m.output.scoring_history]
+    assert mses[-1] < mses[0]
+
+
+def test_gbm_sampling_and_early_stopping():
+    fr = _regression_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=40,
+                          sample_rate=0.7, col_sample_rate=0.8,
+                          score_tree_interval=5, stopping_rounds=2,
+                          stopping_tolerance=0.5, seed=0)).train_model()
+    # aggressive tolerance must trigger an early stop
+    assert m.ntrees < 40
+
+
+def test_drf_classification():
+    from h2o_tpu.models.drf import DRF, DRFParameters
+
+    fr = _binomial_frame()
+    m = DRF(DRFParameters(training_frame=fr, response_column="y", ntrees=25,
+                          max_depth=8, seed=7)).train_model()
+    assert m.output.training_metrics.auc > 0.8
+    p = m.predict(fr).vec("pyes").to_numpy()
+    assert (p >= 0).all() and (p <= 1).all()

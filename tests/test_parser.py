@@ -1,0 +1,88 @@
+"""Parser tests — analog of the reference's ParserTest / ParseSetup tests
+(`h2o-core/src/test/java/water/parser/`)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from h2o_tpu.io.parser import guess_setup, import_file, ParseSetup
+
+
+CSV = """sepal_len,sepal_wid,species,when,flag
+5.1,3.5,setosa,2024-01-01,true
+4.9,NA,setosa,2024-01-02,false
+6.3,3.3,virginica,2024-01-03,true
+5.8,2.7,virginica,,false
+"""
+
+
+@pytest.fixture
+def csv_path(tmp_path):
+    p = tmp_path / "iris.csv"
+    p.write_text(CSV)
+    return str(p)
+
+
+def test_guess_setup(csv_path):
+    s = guess_setup(csv_path)
+    assert s.separator == ","
+    assert s.header is True
+
+
+def test_import_csv(csv_path):
+    fr = import_file(csv_path)
+    assert fr.nrow == 4 and fr.ncol == 5
+    assert fr.types()["sepal_len"] == "real"
+    assert fr.types()["species"] == "enum"
+    assert fr.types()["when"] == "time"
+    assert fr.types()["flag"] == "int"
+    assert fr.vec("species").domain == ["setosa", "virginica"]
+    np.testing.assert_array_equal(fr.vec("species").to_numpy(), [0, 0, 1, 1])
+    assert fr.vec("sepal_wid").nacnt() == 1
+    assert fr.vec("when").nacnt() == 1
+    np.testing.assert_allclose(fr.vec("sepal_len").to_numpy(), [5.1, 4.9, 6.3, 5.8],
+                               rtol=1e-6)
+
+
+def test_import_headerless_tsv(tmp_path):
+    p = tmp_path / "x.tsv"
+    p.write_text("1\t2\t3\n4\t5\t6\n")
+    fr = import_file(str(p))
+    assert fr.nrow == 2 and fr.ncol == 3
+
+
+def test_import_gzip(tmp_path):
+    p = tmp_path / "x.csv.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("a,b\n1,x\n2,y\n")
+    fr = import_file(str(p))
+    assert fr.nrow == 2
+    assert fr.vec("b").domain == ["x", "y"]
+
+
+def test_import_parquet(tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1.5, 2.5, np.nan], "b": ["u", "v", "u"]})
+    p = tmp_path / "x.parquet"
+    df.to_parquet(p)
+    fr = import_file(str(p))
+    assert fr.nrow == 3
+    assert fr.vec("a").nacnt() == 1
+    assert fr.vec("b").domain == ["u", "v"]
+
+
+def test_import_svmlight(tmp_path):
+    p = tmp_path / "x.svm"
+    p.write_text("1 0:1.5 3:2.0\n-1 1:0.5\n")
+    fr = import_file(str(p))
+    assert fr.nrow == 2
+    assert fr.vec("target").to_numpy()[1] == -1
+    assert fr.vec("C3").to_numpy()[0] == 2.0
+
+
+def test_col_types_override(csv_path):
+    fr = import_file(csv_path, col_types={"species": "string"})
+    assert fr.vec("species").is_string()
