@@ -1,0 +1,121 @@
+"""DataInfo — the modeling row codec. Analog of `hex/DataInfo.java` (~2,500 LoC).
+
+Expands a Frame into the dense design matrix algorithms consume: categorical
+one-hot blocks first then numeric columns (the reference's layout,
+`hex/DataInfo.java:24,113-229`), with optional standardization of numerics,
+``use_all_factor_levels`` control (drop-first by default, as GLM does), and
+missing-value handling (MeanImputation: numeric -> mean, categorical -> mode;
+or Skip: rows weighted out).
+
+The expansion runs on device: one_hot per categorical + concat — categorical
+codes are already in HBM, so wide one-hot blocks are produced where they are
+consumed (feeding the Gram matmul) instead of shipping expanded rows around.
+Means/sigmas/modes are frozen at train time and replayed at score time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+
+
+@dataclass
+class DataInfo:
+    names: list                      # source column names (feature order)
+    is_cat: np.ndarray               # per source column
+    domains: dict                    # name -> domain (cats)
+    cat_modes: dict                  # name -> mode code (imputation)
+    num_means: dict                  # name -> mean
+    num_sigmas: dict                 # name -> sigma
+    use_all_factor_levels: bool
+    standardize: bool
+    missing_values_handling: str      # MeanImputation | Skip
+    expanded_names: list = field(default_factory=list)
+
+    @property
+    def ncols_expanded(self) -> int:
+        return len(self.expanded_names)
+
+    @staticmethod
+    def make(fr: Frame, names, standardize=True, use_all_factor_levels=False,
+             missing_values_handling="MeanImputation") -> "DataInfo":
+        # categoricals first, then numerics — mirrors DataInfo column ordering
+        cats = [n for n in names if fr.vec(n).is_categorical()]
+        nums = [n for n in names if not fr.vec(n).is_categorical()]
+        ordered = cats + nums
+        is_cat = np.array([True] * len(cats) + [False] * len(nums))
+        domains, modes, means, sigmas = {}, {}, {}, {}
+        expanded = []
+        for n in cats:
+            v = fr.vec(n)
+            domains[n] = list(v.domain)
+            host = v.to_numpy()
+            ok = host[~np.isnan(host)].astype(np.int64)
+            modes[n] = int(np.bincount(ok).argmax()) if ok.size else 0
+            lo = 0 if use_all_factor_levels else 1
+            expanded += [f"{n}.{v.domain[i]}" for i in range(lo, len(v.domain))]
+        for n in nums:
+            r = fr.vec(n).rollups()
+            means[n] = float(np.nan_to_num(r.mean))
+            sg = float(r.sigma)
+            sigmas[n] = sg if np.isfinite(sg) and sg > 0 else 1.0
+            expanded.append(n)
+        return DataInfo(ordered, is_cat, domains, modes, means, sigmas,
+                        use_all_factor_levels, standardize,
+                        missing_values_handling, expanded)
+
+    # -- device expansion -----------------------------------------------------
+    def expand(self, fr: Frame):
+        """Frame -> (X (plen, P) device matrix, valid_row mask (plen,)).
+
+        Rows with NAs are imputed (MeanImputation) or flagged invalid (Skip).
+        Unseen categorical levels at score time behave like NAs.
+        """
+        blocks = []
+        valid = None
+        for n in self.names:
+            v = fr.vec(n)
+            col = v.data
+            if n in self.domains:
+                dom = self.domains[n]
+                if v.domain != dom and v.domain is not None:
+                    col = _remap_codes(v, dom)
+                card = len(dom)
+                isna = jnp.isnan(col) | (col >= card)
+                if self.missing_values_handling == "Skip":
+                    valid = isna if valid is None else (valid | isna)
+                codes = jnp.where(isna, self.cat_modes[n], col).astype(jnp.int32)
+                oh = jax.nn.one_hot(codes, card, dtype=jnp.float32)
+                lo = 0 if self.use_all_factor_levels else 1
+                blocks.append(oh[:, lo:])
+            else:
+                isna = jnp.isnan(col)
+                if self.missing_values_handling == "Skip":
+                    valid = isna if valid is None else (valid | isna)
+                x = jnp.where(isna, self.num_means[n], col)
+                if self.standardize:
+                    x = (x - self.num_means[n]) / self.num_sigmas[n]
+                blocks.append(x[:, None])
+        X = jnp.concatenate(blocks, axis=1)
+        bad = valid if valid is not None else jnp.zeros(X.shape[0], jnp.bool_)
+        return X, ~bad
+
+
+def _remap_codes(v, train_dom):
+    remap = {lvl: i for i, lvl in enumerate(train_dom)}
+    codes = np.full(len(v.domain), np.nan, dtype=np.float32)
+    for i, lvl in enumerate(v.domain):
+        if lvl in remap:
+            codes[i] = remap[lvl]
+    host = v.to_numpy()
+    out = np.full(v.plen, np.nan, dtype=np.float32)
+    ok = ~np.isnan(host)
+    out[: len(host)][ok] = codes[host[ok].astype(np.int64)]
+    from ..frame.vec import Vec
+
+    return Vec.from_numpy(out[: len(host)]).data
