@@ -33,9 +33,11 @@ class DataInfo:
     num_means: dict                  # name -> mean
     num_sigmas: dict                 # name -> sigma
     use_all_factor_levels: bool
-    standardize: bool
+    standardize: bool                 # divide numerics by sigma
     missing_values_handling: str      # MeanImputation | Skip
     expanded_names: list = field(default_factory=list)
+    center: bool = True               # subtract numeric means (independent of
+                                      # imputation, which always uses the mean)
 
     @property
     def ncols_expanded(self) -> int:
@@ -99,7 +101,9 @@ class DataInfo:
                     valid = isna if valid is None else (valid | isna)
                 x = jnp.where(isna, self.num_means[n], col)
                 if self.standardize:
-                    x = (x - self.num_means[n]) / self.num_sigmas[n]
+                    if self.center:
+                        x = x - self.num_means[n]
+                    x = x / self.num_sigmas[n]
                 blocks.append(x[:, None])
         X = jnp.concatenate(blocks, axis=1)
         bad = valid if valid is not None else jnp.zeros(X.shape[0], jnp.bool_)

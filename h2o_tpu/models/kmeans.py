@@ -1,0 +1,255 @@
+"""KMeans — Lloyd iterations as fused device map/reduce.
+
+Analog of `hex/kmeans/KMeans.java` (~2,378 LoC): Lloyd's algorithm where each
+iteration is one distributed pass (assign rows to nearest center + partial
+per-center sums reduce), k-means‖-style seeding, optional standardization,
+categorical one-hot expansion, and `estimate_k` (grow k while the total
+within-SS improves, the reference's Xmeans-ish heuristic).
+
+TPU-native structure: one jitted step does assignment (a (rows, k) distance
+matmul on the MXU — ||x||² − 2·X·Cᵀ + ||c||²) and the per-center {sum, count,
+withinss} accumulation as one-hot matmuls; XLA all-reduces the partials across
+the row-sharded mesh. The host loop only checks convergence per iteration
+(mirroring the reference's per-iteration Job update, `hex/kmeans/KMeans.java`
+Lloyds loop).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from .datainfo import DataInfo
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters
+
+
+@dataclass
+class KMeansParameters(Parameters):
+    """Mirrors `hex/schemas/KMeansV3` / KMeansModel.KMeansParameters."""
+
+    k: int = 1
+    max_iterations: int = 10
+    init: str = "Furthest"  # Random | PlusPlus | Furthest | User
+    user_points: np.ndarray | None = None
+    standardize: bool = True
+    estimate_k: bool = False
+
+
+class ClusteringMetrics:
+    """ModelMetricsClustering analog: within/between/total sums of squares."""
+
+    def __init__(self, totss, tot_withinss, withinss, sizes):
+        self.totss = float(totss)
+        self.tot_withinss = float(tot_withinss)
+        self.betweenss = self.totss - self.tot_withinss
+        self.withinss = np.asarray(withinss)
+        self.sizes = np.asarray(sizes)
+
+    def __repr__(self):
+        return (f"ClusteringMetrics(totss={self.totss:.4f}, "
+                f"tot_withinss={self.tot_withinss:.4f}, "
+                f"betweenss={self.betweenss:.4f}, sizes={self.sizes.tolist()})")
+
+
+def _pairwise_d2(X, centers):
+    """(rows, k) squared distances — one MXU matmul + broadcasts."""
+    return jnp.maximum(
+        jnp.sum(X * X, axis=1, keepdims=True)
+        - 2.0 * X @ centers.T
+        + jnp.sum(centers * centers, axis=1)[None, :], 0.0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(X, wmask, centers, k: int):
+    """One Lloyd iteration: assign + accumulate. Returns (new_centers, stats)."""
+    d2 = _pairwise_d2(X, centers)
+    assign = jnp.argmin(d2, axis=1)
+    best = jnp.take_along_axis(d2, assign[:, None], axis=1)[:, 0]
+    oh = jax.nn.one_hot(assign, k, dtype=jnp.float32) * wmask[:, None]
+    counts = jnp.sum(oh, axis=0)
+    sums = oh.T @ X
+    withinss = oh.T @ best
+    new_centers = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts[:, None], 1.0), centers)
+    return new_centers, dict(assign=assign, counts=counts, withinss=withinss,
+                             tot_withinss=jnp.sum(withinss))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _assign_only(X, centers, k: int):
+    d2 = _pairwise_d2(X, centers)
+    return jnp.argmin(d2, axis=1), jnp.min(d2, axis=1)
+
+
+class KMeansModel(Model):
+    algo_name = "kmeans"
+
+    def __init__(self, params, output, centers, centers_std, dinfo, key=None):
+        self.centers = centers          # de-standardized (k, P) np array
+        self.centers_std = centers_std  # standardized device array used to score
+        self.dinfo = dinfo
+        super().__init__(params, output, key=key)
+
+    @property
+    def k(self):
+        return self.centers.shape[0]
+
+    def predict(self, fr: Frame) -> Frame:
+        X, _ = self.dinfo.expand(fr)
+        assign, _ = _assign_only(X, self.centers_std, self.k)
+        return Frame(["predict"],
+                     [Vec.from_device(assign.astype(jnp.float32), fr.nrow,
+                                      type=T_CAT,
+                                      domain=[str(i) for i in range(self.k)])])
+
+    def model_performance(self, fr: Frame | None = None):
+        if fr is None:
+            return self.output.training_metrics
+        X, ok = self.dinfo.expand(fr)
+        wmask = _row_mask(X, fr.nrow) * ok.astype(jnp.float32)
+        _, stats = _lloyd_step(X, wmask, self.centers_std, self.k)
+        mu = jnp.sum(X * wmask[:, None], axis=0) / jnp.maximum(jnp.sum(wmask), 1.0)
+        totss = float(jnp.sum(wmask * jnp.sum((X - mu) ** 2, axis=1)))
+        return ClusteringMetrics(totss, float(stats["tot_withinss"]),
+                                 stats["withinss"], stats["counts"])
+
+
+def _row_mask(X, nrow):
+    return (jnp.arange(X.shape[0]) < nrow).astype(jnp.float32)
+
+
+class KMeans(ModelBuilder):
+    algo_name = "kmeans"
+    supervised = False
+
+    def build_impl(self, job: Job) -> KMeansModel:
+        p: KMeansParameters = self.params
+        fr = p.training_frame
+        names = self.feature_names()
+        dinfo = DataInfo.make(fr, names, standardize=p.standardize,
+                              use_all_factor_levels=True)
+        X, okrows = dinfo.expand(fr)
+        wmask = _row_mask(X, fr.nrow) * okrows.astype(jnp.float32)
+        seed = p.seed if p.seed not in (-1, None) else 1234
+        key = jax.random.PRNGKey(seed)
+
+        if p.estimate_k:
+            model_stats = self._estimate_k(X, wmask, p, key, job)
+        else:
+            centers = self._init_centers(X, wmask, p.k, p.init, key, p, dinfo)
+            model_stats = self._lloyd(X, wmask, centers, p.k, p.max_iterations, job)
+        centers, stats, history = model_stats
+        k = centers.shape[0]
+
+        mu = jnp.sum(X * wmask[:, None], axis=0) / jnp.maximum(jnp.sum(wmask), 1.0)
+        totss = float(jnp.sum(wmask * jnp.sum((X - mu) ** 2, axis=1)))
+
+        output = ModelOutput()
+        output.names = names
+        output.domains = {n: fr.vec(n).domain for n in names}
+        output.model_category = "Clustering"
+        output.training_metrics = ClusteringMetrics(
+            totss, float(stats["tot_withinss"]), stats["withinss"], stats["counts"])
+        output.scoring_history = history
+
+        # de-standardize centers back to the input scale for reporting
+        centers_np = np.asarray(centers)
+        denorm = centers_np.copy()
+        col = 0
+        for n in dinfo.names:
+            if n in dinfo.domains:
+                col += len(dinfo.domains[n])
+            else:
+                if dinfo.standardize:
+                    denorm[:, col] = (centers_np[:, col] * dinfo.num_sigmas[n]
+                                      + dinfo.num_means[n])
+                col += 1
+        return KMeansModel(p, output, denorm, centers, dinfo)
+
+    # -- seeding (`hex/kmeans/KMeans.java` initial_points) --------------------
+    def _init_centers(self, X, wmask, k, init, key, p, dinfo):
+        init = (init or "Furthest").lower()
+        if init == "user":
+            # user_points is (k, n_source_cols) in SOURCE column order —
+            # categorical entries are level codes; expand to model space.
+            pts = np.asarray(p.user_points, dtype=np.float32)
+            if pts.shape != (k, len(dinfo.names)):
+                raise ValueError(
+                    f"user_points must be ({k}, {len(dinfo.names)}), got {pts.shape}")
+            blocks = []
+            for j, n in enumerate(dinfo.names):
+                if n in dinfo.domains:
+                    card = len(dinfo.domains[n])
+                    oh = np.zeros((k, card), dtype=np.float32)
+                    oh[np.arange(k), pts[:, j].astype(np.int64)] = 1.0
+                    blocks.append(oh)
+                else:
+                    x = pts[:, j]
+                    if dinfo.standardize:
+                        if dinfo.center:
+                            x = x - dinfo.num_means[n]
+                        x = x / dinfo.num_sigmas[n]
+                    blocks.append(x[:, None])
+            return jnp.asarray(np.concatenate(blocks, axis=1))
+        probs = wmask / jnp.sum(wmask)
+        if init == "random":
+            idx = jax.random.choice(key, X.shape[0], shape=(k,), replace=False,
+                                    p=probs)
+            return X[idx]
+        # PlusPlus / Furthest: iterative farthest/d²-sampled seeding
+        i0 = jax.random.choice(key, X.shape[0], p=probs)
+        centers = [X[i0]]
+        d2 = jnp.sum((X - centers[0]) ** 2, axis=1)
+        for j in range(1, k):
+            d2m = jnp.where(wmask > 0, d2, 0.0)
+            if init == "plusplus":
+                pr = d2m / jnp.maximum(jnp.sum(d2m), 1e-12)
+                idx = jax.random.choice(jax.random.fold_in(key, j),
+                                        X.shape[0], p=pr)
+            else:  # furthest
+                idx = jnp.argmax(d2m)
+            c = X[idx]
+            centers.append(c)
+            d2 = jnp.minimum(d2, jnp.sum((X - c) ** 2, axis=1))
+        return jnp.stack(centers)
+
+    # -- Lloyd loop -----------------------------------------------------------
+    def _lloyd(self, X, wmask, centers, k, max_iter, job, tol=1e-6):
+        history = []
+        prev = np.inf
+        for it in range(max(max_iter, 1)):
+            job.check_cancelled()
+            centers, stats = _lloyd_step(X, wmask, centers, k)
+            tw = float(stats["tot_withinss"])
+            history.append({"iteration": it, "tot_withinss": tw})
+            if prev - tw <= tol * max(abs(prev), 1.0):
+                break
+            prev = tw
+        # one final assignment pass so the reported stats match the RETURNED
+        # centers (the loop's stats were measured against the pre-update ones)
+        _, stats = _lloyd_step(X, wmask, centers, k)
+        stats = {kk: np.asarray(v) for kk, v in stats.items() if kk != "assign"}
+        return centers, stats, history
+
+    def _estimate_k(self, X, wmask, p, key, job):
+        """Grow k while total within-SS improves markedly (estimate_k mode)."""
+        best = None
+        prev_tw = None
+        for k in range(1, max(p.k, 2) + 1):
+            centers = self._init_centers(X, wmask, k, "furthest",
+                                         jax.random.fold_in(key, k), p, None) \
+                if k > 1 else jnp.sum(X * wmask[:, None], axis=0,
+                                      keepdims=True) / jnp.sum(wmask)
+            res = self._lloyd(X, wmask, centers, k, p.max_iterations, job)
+            tw = res[1]["tot_withinss"]
+            if prev_tw is not None and tw > 0.9 * prev_tw:
+                break
+            best, prev_tw = res, tw
+        return best

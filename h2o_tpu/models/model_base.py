@@ -220,6 +220,11 @@ class ModelBuilder:
                 if r.nacnt == v.nrow or (r.mins == r.maxs):
                     continue
             out.append(name)
+        if not out:
+            raise ValueError(
+                f"{self.algo_name}: no usable feature columns (all constant, "
+                "all-NA, string, or ignored) — set ignore_const_cols=False to "
+                "keep constant columns")
         return out
 
     def response_info(self):

@@ -1,0 +1,107 @@
+"""KMeans / PCA / SVD tests — analog of `hex/kmeans`, `hex/pca`, `hex/svd`
+JUnit suites (KMeansTest.java, PCATest.java)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.models.kmeans import KMeans, KMeansParameters
+from h2o_tpu.models.pca import PCA, PCAParameters, SVD, SVDParameters
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(0)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], dtype=np.float32)
+    pts = np.concatenate([rng.normal(c, 0.5, size=(200, 2)) for c in centers])
+    labels = np.repeat([0, 1, 2], 200)
+    perm = rng.permutation(len(pts))
+    return pts[perm].astype(np.float32), labels[perm]
+
+
+def test_kmeans_recovers_blobs(blobs):
+    pts, labels = blobs
+    fr = Frame.from_dict({"x": pts[:, 0], "y": pts[:, 1]})
+    m = KMeans(KMeansParameters(training_frame=fr, k=3, max_iterations=20,
+                                standardize=False, seed=42)).train_model()
+    tm = m.output.training_metrics
+    assert tm.tot_withinss < 0.05 * tm.totss  # tight, well-separated clusters
+    assert sorted(tm.sizes.tolist()) == [200, 200, 200]
+    # predicted assignment must be consistent with true labels up to relabeling
+    pred = m.predict(fr).vec("predict").to_numpy().astype(int)
+    for c in range(3):
+        assert len(np.unique(pred[labels == c])) == 1
+
+
+def test_kmeans_standardize_and_init_modes(blobs):
+    pts, _ = blobs
+    fr = Frame.from_dict({"x": pts[:, 0], "y": pts[:, 1]})
+    for init in ("Random", "PlusPlus", "Furthest"):
+        m = KMeans(KMeansParameters(training_frame=fr, k=3, init=init,
+                                    max_iterations=25, seed=7)).train_model()
+        tm = m.output.training_metrics
+        assert tm.tot_withinss < tm.totss
+
+
+def test_kmeans_user_points(blobs):
+    pts, _ = blobs
+    fr = Frame.from_dict({"x": pts[:, 0], "y": pts[:, 1]})
+    user = np.array([[0, 0], [10, 10], [-10, 10]], dtype=np.float32)
+    m = KMeans(KMeansParameters(training_frame=fr, k=3, init="User",
+                                user_points=user, standardize=False,
+                                max_iterations=10, seed=1)).train_model()
+    got = np.sort(np.round(m.centers).astype(int), axis=0)
+    assert np.allclose(got, np.sort(user, axis=0), atol=1)
+
+
+def test_pca_matches_numpy():
+    rng = np.random.default_rng(3)
+    # low-rank + noise
+    B = rng.normal(size=(500, 2)) @ rng.normal(size=(2, 6))
+    X = (B + 0.01 * rng.normal(size=B.shape)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(6)})
+    m = PCA(PCAParameters(training_frame=fr, k=3, transform="DEMEAN",
+                          pca_method="GramSVD")).train_model()
+    sdev = m.output.variable_importances["std_deviation"]
+    Xc = X - X.mean(axis=0)
+    ref = np.linalg.svd(Xc, compute_uv=False) / np.sqrt(len(X) - 1)
+    assert np.allclose(sdev, ref[:3], rtol=2e-2)
+    # top-2 PCs capture essentially all variance
+    assert m.output.variable_importances["cumulative_proportion"][1] > 0.999
+    proj = m.predict(fr)
+    assert proj.ncol == 3 and proj.nrow == 500
+
+
+def test_pca_randomized_close_to_exact():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 10)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(10)})
+    exact = PCA(PCAParameters(training_frame=fr, k=2, transform="DEMEAN",
+                              pca_method="GramSVD")).train_model()
+    rand = PCA(PCAParameters(training_frame=fr, k=2, transform="DEMEAN",
+                             pca_method="Randomized", seed=5)).train_model()
+    a = exact.output.variable_importances["std_deviation"]
+    b = rand.output.variable_importances["std_deviation"]
+    assert np.allclose(a, b, rtol=5e-2)
+
+
+def test_svd_singular_values():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 5)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(5)})
+    m = SVD(SVDParameters(training_frame=fr, nv=3, transform="NONE")).train_model()
+    ref = np.linalg.svd(X, compute_uv=False)
+    assert np.allclose(m.singular_values, ref[:3], rtol=2e-2)
+
+
+def test_pca_with_categoricals():
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(6)
+    codes = np.array([0, 1, 2] * 33 + [0], dtype=np.float32)
+    fr = Frame.from_dict({
+        "num": rng.normal(size=100).astype(np.float32),
+        "cat": Vec.from_numpy(codes, type=T_CAT, domain=["a", "b", "c"]),
+    })
+    m = PCA(PCAParameters(training_frame=fr, k=2)).train_model()
+    assert m.predict(fr).ncol == 2
