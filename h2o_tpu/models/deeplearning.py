@@ -252,7 +252,6 @@ class DeepLearning(ModelBuilder):
         steps_per_epoch = max(plen // batch, 1)
         total_steps = max(int(p.epochs * steps_per_epoch), 1)
         perm_key = jax.random.fold_in(key, 1)
-        history = []
         for s in range(total_steps):
             if s % steps_per_epoch == 0:
                 job.check_cancelled()
@@ -271,8 +270,7 @@ class DeepLearning(ModelBuilder):
         output = ModelOutput()
         output.names = names
         output.domains = {n: fr.vec(n).domain for n in names}
-        output.model_category = (category if category != "AutoEncoder"
-                                 else "AutoEncoder")
+        output.model_category = category
         if not p.autoencoder:
             output.response_domain = list(resp_domain) if resp_domain else None
         model = DeepLearningModel(p, output, net, dinfo, loss_kind)

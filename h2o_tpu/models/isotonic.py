@@ -1,0 +1,109 @@
+"""Isotonic regression — pool-adjacent-violators.
+
+Analog of `hex/isotonic/` (489 LoC: `IsotonicRegression.java`,
+`PoolAdjacentViolatorsDriver.java`). The reference pools distributed
+(x, y, w) triples then runs PAV; here the aggregation to unique-x groups is a
+device sort + segment reduce, and the inherently sequential PAV stack runs on
+host over the (tiny) unique-x arrays — the same split the reference uses.
+Prediction is vectorized interpolation (`clip_x` analog of out-of-bounds
+handling via `searchsorted`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
+
+
+@dataclass
+class IsotonicParameters(Parameters):
+    out_of_bounds: str = "NA"  # NA | clip
+
+
+def _pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
+    """Pool-adjacent-violators over pre-aggregated unique (x, ybar, w)."""
+    # stack of blocks [sum_wy, sum_w, start_idx]
+    vals, wts, starts = [], [], []
+    for i in range(len(x)):
+        vals.append(y[i] * w[i])
+        wts.append(w[i])
+        starts.append(i)
+        while len(vals) > 1 and vals[-2] / wts[-2] > vals[-1] / wts[-1]:
+            v, ww = vals.pop(), wts.pop()
+            starts.pop()
+            vals[-1] += v
+            wts[-1] += ww
+    fitted = np.empty_like(y)
+    bounds = starts + [len(x)]
+    for b in range(len(vals)):
+        fitted[bounds[b]:bounds[b + 1]] = vals[b] / wts[b]
+    return fitted
+
+
+class IsotonicRegressionModel(Model):
+    algo_name = "isotonicregression"
+
+    def __init__(self, params, output, xs, ys, key=None):
+        self.xs = xs  # (m,) increasing thresholds
+        self.ys = ys  # (m,) fitted nondecreasing values
+        super().__init__(params, output, key=key)
+
+    def score0(self, X: jax.Array) -> jax.Array:
+        x = X[:, 0]
+        xs, ys = jnp.asarray(self.xs), jnp.asarray(self.ys)
+        idx = jnp.searchsorted(xs, x, side="right")
+        lo = jnp.clip(idx - 1, 0, len(self.xs) - 1)
+        hi = jnp.clip(idx, 0, len(self.xs) - 1)
+        x0, x1 = xs[lo], xs[hi]
+        y0, y1 = ys[lo], ys[hi]
+        t = jnp.where(x1 > x0, (x - x0) / jnp.maximum(x1 - x0, 1e-30), 0.0)
+        out = y0 + t * (y1 - y0)
+        if (self.params.out_of_bounds or "NA").lower() == "clip":
+            out = jnp.clip(out, ys[0], ys[-1])
+        else:
+            oob = (x < xs[0]) | (x > xs[-1])
+            out = jnp.where(oob, jnp.nan, out)
+        return jnp.where(jnp.isnan(x), jnp.nan, out)  # NA in -> NA out
+
+
+class IsotonicRegression(ModelBuilder):
+    algo_name = "isotonicregression"
+
+    def build_impl(self, job: Job) -> IsotonicRegressionModel:
+        p: IsotonicParameters = self.params
+        fr = p.training_frame
+        names = self.feature_names()
+        if len(names) != 1:
+            raise ValueError("isotonic regression takes exactly one feature column")
+        x = fr.vec(names[0]).to_numpy().astype(np.float64)
+        y = fr.vec(p.response_column).to_numpy().astype(np.float64)
+        w = (np.nan_to_num(fr.vec(p.weights_column).to_numpy())
+             if p.weights_column else np.ones_like(y))
+        ok = ~(np.isnan(x) | np.isnan(y)) & (w > 0)
+        x, y, w = x[ok], y[ok], w[ok]
+        order = np.argsort(x, kind="stable")
+        x, y, w = x[order], y[order], w[order]
+        # aggregate duplicate x (reference pools equal-x rows first)
+        ux, inv = np.unique(x, return_inverse=True)
+        sw = np.bincount(inv, weights=w)
+        swy = np.bincount(inv, weights=w * y)
+        fitted = _pav(ux, swy / sw, sw)
+
+        output = ModelOutput()
+        output.names = names
+        output.domains = {names[0]: None}
+        output.model_category = "Regression"
+        model = IsotonicRegressionModel(
+            p, output, ux.astype(np.float32), fitted.astype(np.float32))
+        raw = model.score0(fr.as_matrix(names))
+        yv = fr.vec(p.response_column).data
+        output.training_metrics = make_metrics("Regression", yv, raw, None)
+        return model

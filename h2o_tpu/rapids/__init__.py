@@ -1,0 +1,21 @@
+"""Rapids — frame algebra. TPU-native analog of `water/rapids/` (24,566 LoC).
+
+The reference evaluates client-submitted Lisp ASTs (`Rapids.exec`,
+`water/rapids/Rapids.java:60,86`) over ~200 primitive ops. Here the same
+operations are plain Python functions over device-resident Vecs/Frames —
+the lazy-AST layer exists client-side in h2o-py only because every op was a
+REST round-trip; in-process there is nothing to batch (deliberate divergence,
+SURVEY.md §7 "client compatibility").
+"""
+
+from .ops import (binop, cumulative, hist, ifelse, reduce_op, round_digits,
+                  signif, table, time_part, unique, unop)
+from .groupby import group_by
+from .merge import merge, sort
+from . import strings
+
+__all__ = [
+    "binop", "unop", "reduce_op", "cumulative", "ifelse", "table", "unique",
+    "hist", "round_digits", "signif", "time_part", "group_by", "merge",
+    "sort", "strings",
+]

@@ -1,0 +1,132 @@
+"""GroupBy — `water/rapids/ast/prims/mungers/AstGroup` analog.
+
+The reference hashes group keys into per-node maps then merges them across the
+cluster. TPU-native: group keys are factorized into dense group ids (host pass
+over the key columns — the categorical-interning analog), then EVERY aggregate
+is one `jax.ops.segment_sum`-family reduction over the row-sharded data. All
+aggregates for all columns run in one jitted program.
+
+Supported aggs mirror AstGroup: nrow (count), sum, mean, min, max, sd/var,
+sumSquares, mode (categorical); NA handling per-agg: "all" (NAs poison),
+"rm" (drop), "ignore" (== rm for these aggs).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, T_INT, Vec
+
+AGGS = ("nrow", "sum", "mean", "min", "max", "sd", "var", "sumSquares", "mode")
+
+
+@partial(jax.jit, static_argnames=("ngroups",))
+def _group_reduce(gid, inmask, cols, ngroups: int):
+    """gid (R,), cols (R, C). Returns per-group {count, sum, sumsq, min, max,
+    nacnt} for every column in one pass."""
+    seg = partial(jax.ops.segment_sum, num_segments=ngroups)
+    ok = ~jnp.isnan(cols) & inmask[:, None]
+    x = jnp.where(ok, cols, 0.0)
+    okf = ok.astype(jnp.float32)
+    count = seg(okf, gid)
+    nacnt = seg(jnp.isnan(cols).astype(jnp.float32)
+                * inmask[:, None].astype(jnp.float32), gid)
+    s = seg(x, gid)
+    ss = seg(x * x, gid)
+    mn = jax.ops.segment_min(jnp.where(ok, cols, jnp.inf), gid,
+                             num_segments=ngroups)
+    mx = jax.ops.segment_max(jnp.where(ok, cols, -jnp.inf), gid,
+                             num_segments=ngroups)
+    rows = seg(inmask.astype(jnp.float32), gid)
+    return dict(count=count, nacnt=nacnt, sum=s, sumsq=ss, min=mn, max=mx,
+                rows=rows)
+
+
+def group_by(fr: Frame, by: list[str], aggs: list[tuple]) -> Frame:
+    """aggs: [(op, col, na_handling), ...]; returns one row per group, sorted
+    by group key (H2O returns groups sorted)."""
+    # ---- factorize keys (host; the distributed-interning analog) ----------
+    key_cols = [fr.vec(b).to_numpy() for b in by]
+    n = fr.nrow
+    # NA key sentinel: +inf (cannot collide with real data, unlike -1)
+    keys = np.stack([np.where(np.isnan(c), np.inf, c) for c in key_cols], axis=1)
+    uniq, inv = np.unique(keys, axis=0, return_inverse=True)
+    ngroups = len(uniq)
+
+    gid_padded = np.zeros(fr.vec(by[0]).plen, dtype=np.int32)
+    gid_padded[:n] = inv
+    inmask = np.zeros(fr.vec(by[0]).plen, dtype=bool)
+    inmask[:n] = True
+
+    # ---- one fused device reduction over all aggregated columns -----------
+    value_cols = sorted({c for _, c, *_ in aggs if c})
+    if value_cols:
+        cols = jnp.stack([fr.vec(c).data for c in value_cols], axis=1)
+    else:
+        cols = jnp.zeros((len(gid_padded), 1), jnp.float32)
+    stats = _group_reduce(jnp.asarray(gid_padded), jnp.asarray(inmask),
+                          cols, ngroups)
+    stats = {k: np.asarray(v) for k, v in stats.items()}
+    colix = {c: i for i, c in enumerate(value_cols)}
+
+    # ---- assemble output frame --------------------------------------------
+    out_names, out_vecs = [], []
+    for j, b in enumerate(by):
+        v = fr.vec(b)
+        vals = uniq[:, j].astype(np.float32)
+        vals[np.isinf(uniq[:, j])] = np.nan
+        out_names.append(b)
+        out_vecs.append(Vec.from_numpy(vals, type=v.type, domain=v.domain))
+
+    for spec in aggs:
+        op, col, *rest = spec
+        na = rest[0] if rest else "rm"
+        if op == "nrow":
+            out_names.append("nrow")
+            out_vecs.append(Vec.from_numpy(stats["rows"].astype(np.float32),
+                                           type=T_INT))
+            continue
+        i = colix[col]
+        cnt = stats["count"][:, i]
+        nac = stats["nacnt"][:, i]
+        with np.errstate(invalid="ignore", divide="ignore"):
+            if op == "sum":
+                vals = stats["sum"][:, i]
+            elif op == "sumSquares":
+                vals = stats["sumsq"][:, i]
+            elif op == "mean":
+                vals = stats["sum"][:, i] / cnt
+            elif op == "min":
+                vals = np.where(cnt > 0, stats["min"][:, i], np.nan)
+            elif op == "max":
+                vals = np.where(cnt > 0, stats["max"][:, i], np.nan)
+            elif op in ("sd", "var"):
+                m = stats["sum"][:, i] / cnt
+                var = np.maximum(stats["sumsq"][:, i] / cnt - m * m, 0.0)
+                var = var * cnt / np.maximum(cnt - 1, 1)
+                vals = np.sqrt(var) if op == "sd" else var
+            elif op == "mode":
+                vals = _group_mode(fr, col, inv, ngroups, n)
+            else:
+                raise ValueError(f"unknown agg {op!r}")
+        if na == "all":
+            vals = np.where(nac > 0, np.nan, vals)
+        out_names.append(f"{op}_{col}")
+        out_vecs.append(Vec.from_numpy(vals.astype(np.float32)))
+    return Frame(out_names, out_vecs)
+
+
+def _group_mode(fr: Frame, col: str, inv: np.ndarray, ngroups: int, n: int):
+    host = fr.vec(col).to_numpy()[:n]
+    out = np.full(ngroups, np.nan, dtype=np.float32)
+    ok = ~np.isnan(host)
+    for g in range(ngroups):
+        vals = host[(inv == g) & ok].astype(np.int64)
+        if vals.size:
+            out[g] = np.bincount(vals).argmax()
+    return out

@@ -1,0 +1,156 @@
+"""String ops — `water/rapids/ast/prims/string/` analog (toupper, tolower,
+sub/gsub, trim, strsplit, nchar, substring, grep/countmatches, replaceall...).
+
+String Vecs live host-side (variable-length data has no place in HBM —
+SURVEY.md §7.2); these ops are vectorized numpy-object passes. Categorical
+Vecs get the op applied to their DOMAIN only (the reference does exactly this:
+string ops on enums rewrite the domain, `AstToUpper` etc.), which is O(levels)
+instead of O(rows) — the win of the domain representation.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..frame.vec import T_CAT, T_INT, T_STR, Vec
+
+
+def _apply(v: Vec, fn) -> Vec:
+    if v.is_categorical():
+        return Vec(v.data, v.nrow, type=T_CAT,
+                   domain=[fn(d) for d in v.domain])
+    if not v.is_string():
+        raise TypeError(f"string op on {v.type} Vec")
+    out = np.array([None if s is None else fn(str(s)) for s in v.host_data],
+                   dtype=object)
+    return Vec(None, v.nrow, type=T_STR, host_data=out)
+
+
+def toupper(v): return _apply(v, str.upper)
+def tolower(v): return _apply(v, str.lower)
+def trim(v): return _apply(v, str.strip)
+def lstrip(v, chars=None): return _apply(v, lambda s: s.lstrip(chars))
+def rstrip(v, chars=None): return _apply(v, lambda s: s.rstrip(chars))
+
+
+def sub(v, pattern, replacement, ignore_case=False):
+    flags = re.IGNORECASE if ignore_case else 0
+    rx = re.compile(pattern, flags)
+    return _apply(v, lambda s: rx.sub(replacement, s, count=1))
+
+
+def gsub(v, pattern, replacement, ignore_case=False):
+    flags = re.IGNORECASE if ignore_case else 0
+    rx = re.compile(pattern, flags)
+    return _apply(v, lambda s: rx.sub(replacement, s))
+
+
+def substring(v, start, end=None):
+    return _apply(v, lambda s: s[start:end])
+
+
+def replaceall(v, pattern, replacement):  # alias used by h2o-py
+    return gsub(v, pattern, replacement)
+
+
+def nchar(v: Vec) -> Vec:
+    if v.is_categorical():
+        lens = np.array([len(d) for d in v.domain], dtype=np.float32)
+        host = v.to_numpy()
+        out = np.full(host.shape, np.nan, dtype=np.float32)
+        ok = ~np.isnan(host)
+        out[ok] = lens[host[ok].astype(np.int64)]
+        return Vec.from_numpy(out, type=T_INT)
+    out = np.array([np.nan if s is None else float(len(str(s)))
+                    for s in v.host_data], dtype=np.float32)
+    return Vec.from_numpy(out, type=T_INT)
+
+
+def countmatches(v: Vec, patterns) -> Vec:
+    if isinstance(patterns, str):
+        patterns = [patterns]
+    rxs = [re.compile(p) for p in patterns]
+
+    def cnt(s):
+        return float(sum(len(r.findall(s)) for r in rxs))
+
+    if v.is_categorical():
+        per_level = np.array([cnt(d) for d in v.domain], dtype=np.float32)
+        host = v.to_numpy()
+        out = np.full(host.shape, np.nan, dtype=np.float32)
+        ok = ~np.isnan(host)
+        out[ok] = per_level[host[ok].astype(np.int64)]
+        return Vec.from_numpy(out, type=T_INT)
+    out = np.array([np.nan if s is None else cnt(str(s)) for s in v.host_data],
+                   dtype=np.float32)
+    return Vec.from_numpy(out, type=T_INT)
+
+
+def grep(v: Vec, pattern, ignore_case=False, invert=False, output_logical=True) -> Vec:
+    """`AstGrep` — logical (or index) match vector over a string/cat column."""
+    flags = re.IGNORECASE if ignore_case else 0
+    rx = re.compile(pattern, flags)
+
+    def hit(s):
+        return rx.search(s) is not None
+
+    if v.is_categorical():
+        per_level = np.array([hit(d) for d in v.domain])
+        host = v.to_numpy()
+        ok = ~np.isnan(host)
+        m = np.zeros(host.shape, dtype=bool)
+        m[ok] = per_level[host[ok].astype(np.int64)]
+    else:
+        m = np.array([False if s is None else hit(str(s)) for s in v.host_data])
+    if invert:
+        m = ~m
+    if output_logical:
+        return Vec.from_numpy(m.astype(np.float32), type=T_INT)
+    return Vec.from_numpy(np.where(m)[0].astype(np.float32), type=T_INT)
+
+
+def strsplit(v: Vec, pattern) -> list[Vec]:
+    """Split into N string columns (ragged padded with None) — `AstStrSplit`."""
+    if v.is_categorical():
+        host = np.array([None if np.isnan(c) else v.domain[int(c)]
+                         for c in v.to_numpy()], dtype=object)
+    else:
+        host = v.host_data
+    rx = re.compile(pattern)
+    parts = [None if s is None else rx.split(str(s)) for s in host]
+    width = max((len(p) for p in parts if p), default=0)
+    cols = []
+    for j in range(width):
+        cols.append(Vec(None, v.nrow, type=T_STR, host_data=np.array(
+            [p[j] if p and j < len(p) else None for p in parts], dtype=object)))
+    return cols
+
+
+def ascharacter(v: Vec) -> Vec:
+    """enum -> string column."""
+    host = v.to_numpy()
+    out = np.array([None if np.isnan(c) else v.domain[int(c)] for c in host],
+                   dtype=object)
+    return Vec(None, v.nrow, type=T_STR, host_data=out)
+
+
+def asfactor(v: Vec) -> Vec:
+    """string/int -> enum (sorted-domain interning, ParseDataset analog)."""
+    if v.is_categorical():
+        return v
+    if v.is_string():
+        vals = [None if s is None else str(s) for s in v.host_data]
+        dom = sorted({s for s in vals if s is not None})
+        lookup = {d: i for i, d in enumerate(dom)}
+        codes = np.array([np.nan if s is None else lookup[s] for s in vals],
+                         dtype=np.float32)
+        return Vec.from_numpy(codes, type=T_CAT, domain=dom)
+    host = v.to_numpy()
+    ok = ~np.isnan(host)
+    lv = np.unique(host[ok]).astype(np.int64)
+    lookup = {x: i for i, x in enumerate(lv)}
+    codes = np.full(host.shape, np.nan, dtype=np.float32)
+    codes[ok] = [lookup[int(x)] for x in host[ok]]
+    return Vec.from_numpy(codes, type=T_CAT, domain=[str(x) for x in lv])

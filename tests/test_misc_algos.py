@@ -1,0 +1,158 @@
+"""NaiveBayes / Isotonic / Quantile / IsolationForest tests — analogs of
+`hex/naivebayes/NaiveBayesTest.java`, `hex/isotonic/`, `hex/quantile/
+QuantileTest.java`, `hex/tree/isofor/IsolationForestTest.java`."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.naivebayes import NaiveBayes, NaiveBayesParameters
+from h2o_tpu.models.isotonic import IsotonicRegression, IsotonicParameters
+from h2o_tpu.models.quantile import frame_quantiles
+from h2o_tpu.models.isofor import (ExtendedIsolationForest, IsolationForest,
+                                   IsolationForestParameters)
+
+
+def test_naivebayes_gaussian_and_categorical():
+    rng = np.random.default_rng(0)
+    n = 900
+    y = rng.integers(0, 2, n)
+    num = np.where(y == 1, rng.normal(3, 1, n), rng.normal(-3, 1, n)).astype(np.float32)
+    cat = np.where(y == 1, rng.integers(0, 2, n), rng.integers(1, 3, n)).astype(np.float32)
+    fr = Frame.from_dict({
+        "num": num,
+        "cat": Vec.from_numpy(cat, type=T_CAT, domain=["a", "b", "c"]),
+    })
+    fr.add("y", Vec.from_numpy(y.astype(np.float32), type=T_CAT, domain=["no", "yes"]))
+    m = NaiveBayes(NaiveBayesParameters(training_frame=fr, response_column="y",
+                                        laplace=1.0)).train_model()
+    assert m.output.training_metrics.auc > 0.97
+    # conditional table shape/normalization
+    tab = np.asarray(m.tables["cat"])
+    assert tab.shape == (2, 3)
+    assert np.allclose(tab.sum(axis=1), 1.0, atol=1e-5)
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "pno", "pyes"]
+
+
+def test_naivebayes_na_rows_skip_term():
+    rng = np.random.default_rng(1)
+    n = 200
+    y = rng.integers(0, 2, n)
+    x = np.where(y == 1, 2.0, -2.0).astype(np.float32)
+    x[::7] = np.nan
+    fr = Frame.from_dict({"x": x})
+    fr.add("y", Vec.from_numpy(y.astype(np.float32), type=T_CAT, domain=["0", "1"]))
+    m = NaiveBayes(NaiveBayesParameters(training_frame=fr, response_column="y",
+                                        ignore_const_cols=False)).train_model()
+    assert m.output.training_metrics.auc > 0.95
+
+
+def test_isotonic_recovers_monotone_fit():
+    rng = np.random.default_rng(2)
+    n = 500
+    x = rng.uniform(0, 10, n).astype(np.float32)
+    y = (np.sqrt(x) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = IsotonicRegression(IsotonicParameters(
+        training_frame=fr, response_column="y")).train_model()
+    # fitted thresholds must be nondecreasing
+    assert np.all(np.diff(m.ys) >= -1e-6)
+    assert m.output.training_metrics.rmse < 0.15
+    pred = m.predict(fr).vec("predict").to_numpy()
+    order = np.argsort(x)
+    assert np.all(np.diff(pred[order]) >= -1e-5)
+
+
+def test_isotonic_out_of_bounds():
+    fr = Frame.from_dict({"x": np.array([1, 2, 3], np.float32),
+                          "y": np.array([1, 2, 3], np.float32)})
+    m = IsotonicRegression(IsotonicParameters(
+        training_frame=fr, response_column="y", out_of_bounds="NA")).train_model()
+    test = Frame.from_dict({"x": np.array([0.0, 2.5, 9.0], np.float32)})
+    got = m.predict(test).vec("predict").to_numpy()
+    assert np.isnan(got[0]) and np.isnan(got[2])
+    assert abs(got[1] - 2.5) < 1e-5
+    m2 = IsotonicRegression(IsotonicParameters(
+        training_frame=fr, response_column="y", out_of_bounds="clip")).train_model()
+    got2 = m2.predict(test).vec("predict").to_numpy()
+    assert got2[0] == 1.0 and got2[2] == 3.0
+
+
+def test_quantiles_match_numpy():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=5001).astype(np.float32)
+    x[::13] = np.nan
+    fr = Frame.from_dict({"x": x})
+    probs = (0.1, 0.5, 0.9)
+    q = frame_quantiles(fr, probs)["x"]
+    ref = np.nanquantile(x, probs)
+    assert np.allclose(q, ref, atol=1e-3)
+
+
+def test_quantiles_weighted():
+    # weight 2 on value 10, weight 1 on value 0 -> median is 10
+    fr = Frame.from_dict({"x": np.array([0.0, 10.0], np.float32),
+                          "w": np.array([1.0, 2.0], np.float32)})
+    from h2o_tpu.models.quantile import QuantileBuilder, QuantileParameters
+    m = QuantileBuilder(QuantileParameters(training_frame=fr, probs=(0.5,),
+                                           weights_column="w")).train_model()
+    assert m.quantiles["x"][0] == 10.0
+
+
+def test_isolation_forest_separates_outliers():
+    rng = np.random.default_rng(4)
+    inliers = rng.normal(0, 1, size=(800, 4)).astype(np.float32)
+    outliers = rng.normal(0, 1, size=(20, 4)).astype(np.float32) + 8.0
+    X = np.concatenate([inliers, outliers])
+    fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(4)})
+    m = IsolationForest(IsolationForestParameters(
+        training_frame=fr, ntrees=60, seed=5)).train_model()
+    pred = m.predict(fr)
+    scores = pred.vec("predict").to_numpy()
+    assert scores[800:].mean() > scores[:800].mean() + 0.1
+    # AUC of outlier detection should be near-perfect on this easy split
+    lab = np.concatenate([np.zeros(800), np.ones(20)])
+    order = np.argsort(scores)
+    ranks = np.empty_like(order, dtype=float)
+    ranks[order] = np.arange(len(scores))
+    auc = (ranks[lab == 1].mean() - (20 - 1) / 2) / 800
+    assert auc > 0.95
+
+
+def test_extended_isolation_forest_runs():
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(3)})
+    m = ExtendedIsolationForest(IsolationForestParameters(
+        training_frame=fr, ntrees=20, extension_level=2, seed=7)).train_model()
+    pred = m.predict(fr)
+    s = pred.vec("predict").to_numpy()
+    assert np.all((s > 0) & (s < 1))
+
+
+def test_isotonic_na_input_gives_na():
+    fr = Frame.from_dict({"x": np.array([1, 2, 3], np.float32),
+                          "y": np.array([1, 2, 3], np.float32)})
+    for oob in ("NA", "clip"):
+        m = IsotonicRegression(IsotonicParameters(
+            training_frame=fr, response_column="y", out_of_bounds=oob)).train_model()
+        test = Frame.from_dict({"x": np.array([np.nan, 2.0], np.float32)})
+        got = m.predict(test).vec("predict").to_numpy()
+        assert np.isnan(got[0]) and got[1] == 2.0
+
+
+def test_extended_if_extension_level_changes_model():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(200, 6)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(6)})
+    import numpy as _np
+    ms = [ExtendedIsolationForest(IsolationForestParameters(
+        training_frame=fr, ntrees=5, extension_level=lv, seed=9)).train_model()
+        for lv in (1, 5)]
+    w1, w5 = (_np.asarray(m.forest[0]) for m in ms)
+    nnz1 = (_np.abs(w1) > 0).sum(axis=2)[w1.any(axis=2).nonzero()]
+    assert nnz1.max() <= 2  # extension_level=1 -> at most 2 nonzero components
+    nnz5 = (_np.abs(w5) > 0).sum(axis=2)[w5.any(axis=2).nonzero()]
+    assert nnz5.max() == 6  # level >= F-1 -> dense hyperplanes
