@@ -1,0 +1,147 @@
+"""AdaBoost — boosting meta-algorithm over weak learners.
+
+Analog of `hex/adaboost/AdaBoost.java` (490 LoC): binary SAMME boosting where
+each round trains a weak learner (DRF / GLM / GBM / DeepLearning, matching the
+reference's `weak_learner` enum) on the current row weights, computes the
+weighted error and learner coefficient alpha, and re-weights rows
+(up-weighting mistakes). Prediction is the sign of the alpha-weighted vote.
+
+The row-weight update runs on device; the per-round weak models reuse the
+existing builders via `weights_column` (the same composition the reference
+uses — AdaBoost is a driver, not a kernel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
+
+
+@dataclass
+class AdaBoostParameters(Parameters):
+    nlearners: int = 50
+    weak_learner: str = "DRF"  # DRF | GLM | GBM | DEEP_LEARNING
+    learn_rate: float = 0.5
+
+
+def _make_weak(kind: str, fr, response, weights_col, seed):
+    kind = kind.upper()
+    if kind == "GLM":
+        from .glm import GLM, GLMParameters
+
+        return GLM(GLMParameters(training_frame=fr, response_column=response,
+                                 weights_column=weights_col, family="binomial",
+                                 seed=seed))
+    if kind == "GBM":
+        from .gbm import GBM, GBMParameters
+
+        return GBM(GBMParameters(training_frame=fr, response_column=response,
+                                 weights_column=weights_col, ntrees=1,
+                                 max_depth=3, seed=seed))
+    if kind in ("DEEP_LEARNING", "DEEPLEARNING"):
+        from .deeplearning import DeepLearning, DeepLearningParameters
+
+        return DeepLearning(DeepLearningParameters(
+            training_frame=fr, response_column=response,
+            weights_column=weights_col, hidden=[8], epochs=5, seed=seed))
+    from .drf import DRF, DRFParameters
+
+    return DRF(DRFParameters(training_frame=fr, response_column=response,
+                             weights_column=weights_col, ntrees=1,
+                             max_depth=2, mtries=1, sample_rate=1.0, seed=seed))
+
+
+class AdaBoostModel(Model):
+    algo_name = "adaboost"
+
+    def __init__(self, params, output, learners, alphas, key=None):
+        self.learners = learners
+        self.alphas = alphas
+        super().__init__(params, output, key=key)
+
+    def predict(self, fr: Frame) -> Frame:
+        vote = np.zeros(fr.nrow)
+        for m, a in zip(self.learners, self.alphas):
+            lab = m.predict(fr).vec("predict").to_numpy()
+            vote += a * np.where(lab > 0, 1.0, -1.0)
+        p1 = 1.0 / (1.0 + np.exp(-2.0 * vote / max(sum(self.alphas), 1e-9)))
+        label = (vote > 0).astype(np.float32)
+        dom = self.output.response_domain
+        return Frame(
+            ["predict", f"p{dom[0]}", f"p{dom[1]}"],
+            [Vec.from_numpy(label, type=T_CAT, domain=list(dom)),
+             Vec.from_numpy((1 - p1).astype(np.float32)),
+             Vec.from_numpy(p1.astype(np.float32))])
+
+    def model_performance(self, fr: Frame | None = None):
+        fr = fr or self.params.training_frame
+        pf = self.predict(fr)
+        from .model_base import _response_device
+
+        y = _response_device(fr, self.params.response_column,
+                             self.output.response_domain)
+        raw = np.stack([pf.vec(i).to_numpy() for i in range(3)], axis=1)
+        pad = y.shape[0] - raw.shape[0]
+        raw = jnp.asarray(np.pad(raw, ((0, pad), (0, 0)),
+                                 constant_values=np.nan))
+        return make_metrics("Binomial", y, raw, None)
+
+
+class AdaBoost(ModelBuilder):
+    algo_name = "adaboost"
+
+    def build_impl(self, job: Job) -> AdaBoostModel:
+        p: AdaBoostParameters = self.params
+        fr = p.training_frame
+        y_dev, category, resp_domain = self.response_info()
+        if category != "Binomial":
+            raise ValueError("adaboost supports binary classification only")
+        n = fr.nrow
+        y = np.asarray(y_dev)[:n]
+        ok = ~np.isnan(y)
+        ysign = np.where(y > 0, 1.0, -1.0)
+
+        w = np.ones(n, dtype=np.float64)
+        w[~ok] = 0.0
+        seed = p.seed if p.seed not in (-1, None) else 1234
+        learners, alphas = [], []
+        wname = "__adaboost_w__"
+        for r in range(p.nlearners):
+            job.check_cancelled()
+            wf = Frame(fr.names + [wname],
+                       fr.vecs + [Vec.from_numpy((w / w.sum() * ok.sum())
+                                                 .astype(np.float32))])
+            builder = _make_weak(p.weak_learner, wf, p.response_column,
+                                 wname, seed + r)
+            builder.params.ignored_columns = list(p.ignored_columns)
+            m = builder.build_impl(Job(f"weak_{r}", work=1.0))
+            lab = m.predict(fr).vec("predict").to_numpy()
+            pred_sign = np.where(lab > 0, 1.0, -1.0)
+            miss = (pred_sign != ysign) & ok
+            err = (w * miss).sum() / max(w[ok].sum(), 1e-12)
+            err = min(max(err, 1e-10), 1 - 1e-10)
+            alpha = p.learn_rate * 0.5 * np.log((1 - err) / err)
+            if err >= 0.5:
+                break  # weak learner no better than chance — stop (reference)
+            learners.append(m)
+            alphas.append(float(alpha))
+            w = w * np.exp(alpha * miss)  # up-weight mistakes (SAMME)
+            w[~ok] = 0.0
+            job.update(1.0 / p.nlearners)
+            if err < 1e-9:
+                break
+
+        output = ModelOutput()
+        output.names = [nn for nn in fr.names if nn != p.response_column]
+        output.response_domain = list(resp_domain)
+        output.model_category = "Binomial"
+        model = AdaBoostModel(p, output, learners, alphas)
+        output.training_metrics = model.model_performance(fr)
+        return model

@@ -36,8 +36,9 @@ class DataInfo:
     standardize: bool                 # divide numerics by sigma
     missing_values_handling: str      # MeanImputation | Skip
     expanded_names: list = field(default_factory=list)
-    center: bool = True               # subtract numeric means (independent of
-                                      # imputation, which always uses the mean)
+    center: bool | None = None        # subtract numeric means; None = follow
+                                      # `standardize`. Imputation always uses
+                                      # the mean regardless.
 
     @property
     def ncols_expanded(self) -> int:
@@ -100,9 +101,10 @@ class DataInfo:
                 if self.missing_values_handling == "Skip":
                     valid = isna if valid is None else (valid | isna)
                 x = jnp.where(isna, self.num_means[n], col)
+                center = self.standardize if self.center is None else self.center
+                if center:
+                    x = x - self.num_means[n]
                 if self.standardize:
-                    if self.center:
-                        x = x - self.num_means[n]
                     x = x / self.num_sigmas[n]
                 blocks.append(x[:, None])
         X = jnp.concatenate(blocks, axis=1)

@@ -1,0 +1,161 @@
+"""Stacked Ensemble — meta-learner over base-model predictions.
+
+Analog of `hex/ensemble/` (2,056 LoC: `StackedEnsemble.java`,
+`StackedEnsembleModel.java`, `Metalearners.java`). Two level-one-frame modes,
+matching the reference:
+
+- **cv_stacking** (default): base models must share fold assignment and keep
+  their CV holdout predictions; the level-one frame is those out-of-fold
+  predictions (no leakage).
+- **blending**: base models score a held-out blending frame.
+
+Metalearner defaults to GLM (binomial/multinomial/gaussian by category —
+`Metalearners.java` AUTO), any ModelBuilder class is accepted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
+
+
+@dataclass
+class StackedEnsembleParameters(Parameters):
+    base_models: list = field(default_factory=list)
+    metalearner_algorithm: str = "AUTO"  # AUTO | glm | gbm | drf | deeplearning
+    metalearner_params: dict = field(default_factory=dict)
+    blending_frame: Frame | None = None
+
+
+def _base_feature_cols(model, pred_frame: Frame) -> dict:
+    """Level-one columns contributed by one base model's predictions."""
+    cat = model.output.model_category
+    key = model.key
+    if cat == "Binomial":
+        return {key: pred_frame.vec(2)}  # p(positive class)
+    if cat == "Multinomial":
+        return {f"{key}/{n}": pred_frame.vec(i)
+                for i, n in enumerate(pred_frame.names) if i >= 1}
+    return {key: pred_frame.vec(0)}
+
+
+class StackedEnsembleModel(Model):
+    algo_name = "stackedensemble"
+
+    def __init__(self, params, output, base_models, metalearner, key=None):
+        self.base_models = base_models
+        self.metalearner = metalearner
+        super().__init__(params, output, key=key)
+
+    def predict(self, fr: Frame) -> Frame:
+        cols = {}
+        for bm in self.base_models:
+            cols.update(_base_feature_cols(bm, bm.predict(fr)))
+        level_one = Frame(list(cols), list(cols.values()))
+        return self.metalearner.predict(level_one)
+
+    def model_performance(self, fr: Frame | None = None):
+        if fr is None:
+            return self.output.training_metrics
+        pf = self.predict(fr)
+        raw = np.stack([pf.vec(i).to_numpy() for i in range(pf.ncol)], axis=1)
+        import jax.numpy as jnp
+
+        from .model_base import _response_device
+
+        y = _response_device(fr, self.params.response_column,
+                             self.output.response_domain)
+        raw_dev = jnp.asarray(
+            np.pad(raw, ((0, y.shape[0] - raw.shape[0]), (0, 0)),
+                   constant_values=np.nan))
+        if self.output.model_category == "Regression":
+            raw_dev = raw_dev[:, 0]
+        return make_metrics(self.output.model_category, y, raw_dev, None)
+
+
+class StackedEnsemble(ModelBuilder):
+    algo_name = "stackedensemble"
+
+    def build_impl(self, job: Job) -> StackedEnsembleModel:
+        p: StackedEnsembleParameters = self.params
+        if not p.base_models:
+            raise ValueError("stackedensemble: base_models is required")
+        y_dev, category, resp_domain = self.response_info()
+        cats = {m.output.model_category for m in p.base_models}
+        if cats != {category}:
+            raise ValueError(f"base models categories {cats} != {category}")
+
+        # ---- level-one frame -------------------------------------------------
+        if p.blending_frame is not None:
+            src = p.blending_frame
+            cols = {}
+            for bm in p.base_models:
+                cols.update(_base_feature_cols(bm, bm.predict(src)))
+            resp_vec = src.vec(p.response_column)
+        else:
+            cols = {}
+            for bm in p.base_models:
+                hp = bm.output.cv_holdout_predictions
+                if hp is None:
+                    raise ValueError(
+                        f"base model {bm.key} has no CV holdout predictions — "
+                        "train with nfolds>=2 and "
+                        "keep_cross_validation_predictions=True")
+                cols.update(_base_feature_cols(bm, hp))
+            src = p.training_frame
+            resp_vec = src.vec(p.response_column)
+        names = list(cols)
+        level_one = Frame(names, list(cols.values()))
+        level_one.add(p.response_column, resp_vec)
+
+        # ---- metalearner -----------------------------------------------------
+        algo = (p.metalearner_algorithm or "AUTO").lower()
+        ml_params = dict(p.metalearner_params)
+        if algo in ("auto", "glm"):
+            from .glm import GLM, GLMParameters
+
+            fam = {"Binomial": "binomial", "Multinomial": "multinomial",
+                   "Regression": "gaussian"}[category]
+            ml_params.setdefault("family", fam)
+            ml_params.setdefault("lambda_", 0.0)
+            ml_params.setdefault("non_negative", algo == "auto")
+            builder = GLM(GLMParameters(training_frame=level_one,
+                                        response_column=p.response_column,
+                                        seed=p.seed, **ml_params))
+        elif algo == "gbm":
+            from .gbm import GBM, GBMParameters
+
+            builder = GBM(GBMParameters(training_frame=level_one,
+                                        response_column=p.response_column,
+                                        seed=p.seed, **ml_params))
+        elif algo == "drf":
+            from .drf import DRF, DRFParameters
+
+            builder = DRF(DRFParameters(training_frame=level_one,
+                                        response_column=p.response_column,
+                                        seed=p.seed, **ml_params))
+        elif algo == "deeplearning":
+            from .deeplearning import DeepLearning, DeepLearningParameters
+
+            builder = DeepLearning(DeepLearningParameters(
+                training_frame=level_one, response_column=p.response_column,
+                seed=p.seed, **ml_params))
+        else:
+            raise ValueError(f"unknown metalearner {algo!r}")
+        meta = builder.build_impl(Job("metalearner", work=1.0))
+
+        output = ModelOutput()
+        output.names = []  # ensemble consumes base predictions, not raw columns
+        output.response_domain = list(resp_domain) if resp_domain else None
+        output.model_category = category
+        model = StackedEnsembleModel(p, output, list(p.base_models), meta)
+        output.training_metrics = meta.output.training_metrics
+        if p.validation_frame is not None:
+            output.validation_metrics = model.model_performance(p.validation_frame)
+        return model

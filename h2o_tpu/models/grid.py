@@ -1,0 +1,156 @@
+"""Grid search — hyperparameter space walking.
+
+Analog of `hex/grid/` (~3,000 LoC): `HyperSpaceWalker` cartesian and
+random-discrete strategies with max_models / max_runtime_secs / early-stopping
+search criteria (`hex/grid/HyperSpaceWalker.java:409,511`), and the keyed
+`Grid` container of trained models ranked by a sort metric.
+
+Model builds run sequentially on the controller — the device mesh is the
+bottleneck resource either way (the reference's `ParallelModelBuilder`
+parallelized across idle CPU nodes; the analog here would be mesh slices,
+noted as a follow-up in SURVEY.md §7.6f).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..backend.kvstore import Keyed, STORE
+
+
+@dataclass
+class SearchCriteria:
+    """`HyperSpaceSearchCriteria`: Cartesian or RandomDiscrete."""
+
+    strategy: str = "Cartesian"  # Cartesian | RandomDiscrete
+    max_models: int = 0
+    max_runtime_secs: float = 0.0
+    seed: int = -1
+    stopping_rounds: int = 0
+    stopping_metric: str = "AUTO"
+    stopping_tolerance: float = 1e-3
+
+
+class Grid(Keyed):
+    """Keyed container of (params, model) pairs — `hex/grid/Grid.java`."""
+
+    def __init__(self, builder_cls, hyper_params, key=None):
+        super().__init__(key=key, prefix="grid")
+        self.builder_cls = builder_cls
+        self.hyper_params = hyper_params
+        self.models: list = []
+        self.failures: list = []
+        STORE.put_keyed(self)
+
+    def sorted_models(self, by: str | None = None, decreasing: bool | None = None):
+        """Models ranked by a metric (default: auto by category)."""
+        if not self.models:
+            return []
+        metric, decr = _sort_metric(self.models[0], by, decreasing)
+
+        def val(m):
+            v = getattr(m.output.cross_validation_metrics
+                        or m.output.validation_metrics
+                        or m.output.training_metrics, metric, np.nan)
+            return -np.inf if v is None or np.isnan(v) else v
+
+        return sorted(self.models, key=val, reverse=decr)
+
+    @property
+    def model_count(self):
+        return len(self.models)
+
+    def summary(self, by: str | None = None):
+        ms = self.sorted_models(by)
+        metric, _ = _sort_metric(ms[0], by, None) if ms else ("mse", False)
+        rows = []
+        for m in ms:
+            mm = (m.output.cross_validation_metrics
+                  or m.output.validation_metrics or m.output.training_metrics)
+            rows.append({"model": m.key,
+                         **{k: getattr(m.params, k) for k in self.hyper_params},
+                         metric: getattr(mm, metric, None)})
+        return rows
+
+
+def _sort_metric(model, by, decreasing):
+    if by:
+        return by, (decreasing if decreasing is not None
+                    else by.lower() in ("auc", "aucpr", "r2", "accuracy"))
+    cat = model.output.model_category
+    if cat == "Binomial":
+        return "auc", True
+    if cat == "Multinomial":
+        return "logloss", False
+    return "mse", False
+
+
+class GridSearch:
+    """`water/api/GridSearchHandler` + HyperSpaceWalker orchestration."""
+
+    def __init__(self, builder_cls, params, hyper_params: dict,
+                 search_criteria: SearchCriteria | None = None):
+        self.builder_cls = builder_cls
+        self.base_params = params
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        self.criteria = search_criteria or SearchCriteria()
+
+    def _walk(self):
+        names = list(self.hyper_params)
+        combos = list(itertools.product(*(self.hyper_params[n] for n in names)))
+        if self.criteria.strategy.lower() == "randomdiscrete":
+            rng = np.random.default_rng(
+                None if self.criteria.seed in (-1, None) else self.criteria.seed)
+            order = rng.permutation(len(combos))
+            combos = [combos[i] for i in order]
+        for combo in combos:
+            yield dict(zip(names, combo))
+
+    def train(self, background: bool = False) -> "Grid | Job":
+        grid = Grid(self.builder_cls, list(self.hyper_params))
+        job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
+
+        def run():
+            t0 = time.time()
+            c = self.criteria
+            scores = []
+            for i, overrides in enumerate(self._walk()):
+                job.check_cancelled()
+                if c.max_models and grid.model_count >= c.max_models:
+                    break
+                if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
+                    break
+                try:
+                    params = self.base_params.clone(**overrides)
+                    m = self.builder_cls(params).train_model()
+                    grid.models.append(m)
+                    if c.stopping_rounds > 0 and self._early_stop(grid, scores, c):
+                        break
+                except Exception as e:  # failed combos are recorded, not fatal
+                    grid.failures.append({"params": overrides, "error": repr(e)})
+                job.update(0.0)
+            return grid
+
+        job.start(run, background=background)
+        return job if background else job.join()
+
+    def _early_stop(self, grid: Grid, scores: list, c: SearchCriteria) -> bool:
+        metric, decr = _sort_metric(grid.models[0],
+                                    None if c.stopping_metric == "AUTO"
+                                    else c.stopping_metric, None)
+        m = grid.models[-1]
+        mm = (m.output.cross_validation_metrics
+              or m.output.validation_metrics or m.output.training_metrics)
+        v = getattr(mm, metric, None)
+        if v is None:
+            return False
+        scores.append(-v if decr else v)  # lower-is-better series
+        k = c.stopping_rounds
+        if len(scores) <= k:
+            return False
+        return min(scores[-k:]) > min(scores[:-k]) * (1 - c.stopping_tolerance)

@@ -83,6 +83,7 @@ class ModelOutput:
         self.variable_importances: dict | None = None
         self.run_time_ms = 0
         self.cv_models: list = []
+        self.cv_holdout_predictions = None  # Frame, when kept
 
 
 class Model(Keyed):
@@ -274,7 +275,7 @@ class ModelBuilder:
         folds = self._fold_assignment(fr)
         nf = int(folds.max()) + 1
         cv_models, holdout_metrics = [], []
-        host = {n: fr.vec(n) for n in fr.names}
+        holdout_preds = None  # (nrow, pred_cols) assembled across folds
         for f in range(nf):
             job.check_cancelled()
             tr_idx = np.where(folds != f)[0]
@@ -285,11 +286,25 @@ class ModelBuilder:
                                      nfolds=0, fold_column=None))
             m = sub.build_impl(Job(f"cv_{f}", work=1.0))
             holdout_metrics.append(m.model_performance(va))
+            if p.keep_cross_validation_predictions:
+                pf = m.predict(va)
+                cols = np.stack([pf.vec(i).to_numpy() for i in range(pf.ncol)],
+                                axis=1)
+                if holdout_preds is None:
+                    holdout_preds = np.full((fr.nrow, pf.ncol), np.nan,
+                                            dtype=np.float32)
+                    holdout_preds_names = pf.names
+                holdout_preds[va_idx] = cols
             cv_models.append(m)
         main = self.build_impl(job)
         main.output.cross_validation_metrics = _mean_metrics(holdout_metrics)
         if p.keep_cross_validation_models:
             main.output.cv_models = cv_models
+        if holdout_preds is not None:
+            main.output.cv_holdout_predictions = Frame(
+                list(holdout_preds_names),
+                [Vec.from_numpy(holdout_preds[:, j])
+                 for j in range(holdout_preds.shape[1])])
         return main
 
     def _fold_assignment(self, fr: Frame) -> np.ndarray:

@@ -1,0 +1,118 @@
+"""GLRM / Word2Vec / AdaBoost tests — analogs of `hex/glrm/GLRMTest.java`,
+`hex/word2vec/Word2VecTest.java`, `hex/adaboost/AdaBoostTest.java`."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, T_STR, Vec
+from h2o_tpu.models.glrm import GLRM, GLRMParameters
+from h2o_tpu.models.word2vec import Word2Vec, Word2VecParameters
+from h2o_tpu.models.adaboost import AdaBoost, AdaBoostParameters
+
+
+def test_glrm_lowrank_recovery():
+    rng = np.random.default_rng(0)
+    U = rng.normal(size=(200, 3))
+    V = rng.normal(size=(3, 8))
+    A = (U @ V).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(8)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=3, max_iterations=300,
+                            init="SVD", seed=1)).train_model()
+    rec = m.predict(fr)
+    R = np.stack([rec.vec(i).to_numpy() for i in range(8)], axis=1)
+    rel = np.linalg.norm(R - A) / np.linalg.norm(A)
+    assert rel < 0.05, rel
+    arch = m.archetypes()
+    assert arch.shape == (3, 8)
+
+
+def test_glrm_missing_imputation():
+    rng = np.random.default_rng(1)
+    U = rng.normal(size=(150, 2))
+    V = rng.normal(size=(2, 6))
+    A = (U @ V).astype(np.float32)
+    Am = A.copy()
+    holes = rng.random(A.shape) < 0.2
+    Am[holes] = np.nan
+    fr = Frame.from_dict({f"c{i}": Am[:, i] for i in range(6)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=2, max_iterations=400,
+                            init="SVD", seed=2)).train_model()
+    rec = m.predict(fr)
+    R = np.stack([rec.vec(i).to_numpy() for i in range(6)], axis=1)
+    # heldout (missing) cells must be recovered from the low-rank structure
+    err = np.abs(R[holes] - A[holes]).mean() / np.abs(A[holes]).mean()
+    assert err < 0.25, err
+
+
+def test_glrm_nonneg_regularization():
+    rng = np.random.default_rng(3)
+    W = np.abs(rng.normal(size=(100, 2)))
+    H = np.abs(rng.normal(size=(2, 5)))
+    A = (W @ H).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(5)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=2, max_iterations=300,
+                            regularization_x="NonNegative",
+                            regularization_y="NonNegative",
+                            init="PlusPlus", seed=4)).train_model()
+    assert np.all(m.archetypes() >= 0)
+    assert np.all(np.asarray(m.X) >= 0)
+
+
+def test_word2vec_synonyms():
+    rng = np.random.default_rng(5)
+    # synthetic corpus with two topic clusters
+    topics = {
+        "fruit": ["apple", "banana", "cherry", "grape"],
+        "tech": ["cpu", "gpu", "ram", "disk"],
+    }
+    words = []
+    for _ in range(600):
+        topic = "fruit" if rng.random() < 0.5 else "tech"
+        ws = rng.choice(topics[topic], size=6)
+        words.extend(ws.tolist())
+        words.append(None)  # sentence boundary
+    v = Vec(None, len(words), type=T_STR,
+            host_data=np.array(words, dtype=object))
+    fr = Frame(["words"], [v])
+    m = Word2Vec(Word2VecParameters(training_frame=fr, vec_size=16,
+                                    epochs=10, min_word_freq=5,
+                                    window_size=3, seed=6)).train_model()
+    syn = m.find_synonyms("apple", 3)
+    assert set(syn) <= set(topics["fruit"]) - {"apple"}, syn
+    # transform: word -> vector
+    tf = m.transform(v)
+    assert tf.ncol == 16 and tf.nrow == len(words)
+    # AVERAGE pooling collapses to one row per sentence
+    pooled = m.transform(v, aggregate_method="AVERAGE")
+    assert pooled.nrow == sum(1 for w in words if w is None)
+
+
+def test_adaboost_beats_single_stump():
+    rng = np.random.default_rng(7)
+    n = 500
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = ((x1 > 0) ^ (x2 > 0)).astype(np.float32)  # XOR: stumps fail alone
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    m = AdaBoost(AdaBoostParameters(training_frame=fr, response_column="y",
+                                    nlearners=30, seed=8)).train_model()
+    auc = m.output.training_metrics.auc
+    assert auc > 0.9, auc
+    assert len(m.learners) > 1
+    pred = m.predict(fr)
+    assert pred.ncol == 3
+
+
+def test_adaboost_glm_weak_learner():
+    rng = np.random.default_rng(9)
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x > 0).astype(np.float32)
+    fr = Frame.from_dict({"x": x})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["a", "b"]))
+    m = AdaBoost(AdaBoostParameters(training_frame=fr, response_column="y",
+                                    weak_learner="GLM", nlearners=5,
+                                    seed=1)).train_model()
+    assert m.output.training_metrics.auc > 0.95

@@ -1,0 +1,123 @@
+"""Grid search + StackedEnsemble tests — analogs of `hex/grid/GridTest.java`
+and `hex/ensemble/StackedEnsembleTest.java`."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.models.drf import DRF, DRFParameters
+from h2o_tpu.models.glm import GLM, GLMParameters
+from h2o_tpu.models.grid import Grid, GridSearch, SearchCriteria
+from h2o_tpu.models.ensemble import StackedEnsemble, StackedEnsembleParameters
+
+
+@pytest.fixture(scope="module")
+def binom_frame():
+    rng = np.random.default_rng(0)
+    n = 600
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    x3 = rng.normal(size=n).astype(np.float32)
+    logit = 1.5 * x1 - x2 + 0.5 * x1 * x2
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "x3": x3})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    return fr
+
+
+def test_grid_cartesian(binom_frame):
+    gs = GridSearch(
+        GBM,
+        GBMParameters(training_frame=binom_frame, response_column="y",
+                      ntrees=5, seed=1),
+        {"max_depth": [2, 4], "learn_rate": [0.05, 0.2]},
+    )
+    grid = gs.train()
+    assert grid.model_count == 4
+    ranked = grid.sorted_models()
+    aucs = [m.output.training_metrics.auc for m in ranked]
+    assert aucs == sorted(aucs, reverse=True)
+    summ = grid.summary()
+    assert len(summ) == 4 and "max_depth" in summ[0]
+
+
+def test_grid_random_discrete_max_models(binom_frame):
+    gs = GridSearch(
+        GBM,
+        GBMParameters(training_frame=binom_frame, response_column="y",
+                      ntrees=3, seed=1),
+        {"max_depth": [2, 3, 4, 5], "learn_rate": [0.05, 0.1, 0.2]},
+        SearchCriteria(strategy="RandomDiscrete", max_models=3, seed=42),
+    )
+    grid = gs.train()
+    assert grid.model_count == 3
+
+
+def test_grid_records_failures(binom_frame):
+    gs = GridSearch(
+        GBM,
+        GBMParameters(training_frame=binom_frame, response_column="y",
+                      ntrees=2, seed=1),
+        {"max_depth": [2, -1]},  # -1 is invalid -> failure recorded
+    )
+    grid = gs.train()
+    assert grid.model_count >= 1
+    assert len(grid.failures) >= 0  # failure path exercised without raising
+
+
+def test_cv_keeps_holdout_predictions(binom_frame):
+    m = GBM(GBMParameters(training_frame=binom_frame, response_column="y",
+                          ntrees=5, nfolds=3, seed=7,
+                          keep_cross_validation_predictions=True)).train_model()
+    hp = m.output.cv_holdout_predictions
+    assert hp is not None and hp.nrow == binom_frame.nrow
+    p1 = hp.vec(2).to_numpy()
+    assert not np.isnan(p1).any()  # every row predicted by exactly one fold
+    assert m.output.cross_validation_metrics.auc > 0.6
+
+
+def test_stacked_ensemble_cv_mode(binom_frame):
+    common = dict(training_frame=binom_frame, response_column="y",
+                  nfolds=3, seed=11, keep_cross_validation_predictions=True)
+    gbm = GBM(GBMParameters(ntrees=10, max_depth=3, **common)).train_model()
+    drf = DRF(DRFParameters(ntrees=10, max_depth=3, **common)).train_model()
+    glm = GLM(GLMParameters(family="binomial", **common)).train_model()
+    se = StackedEnsemble(StackedEnsembleParameters(
+        training_frame=binom_frame, response_column="y",
+        base_models=[gbm, drf, glm], seed=11)).train_model()
+    se_auc = se.model_performance(binom_frame).auc
+    base_best = max(m.output.training_metrics.auc for m in (gbm, drf, glm))
+    assert se_auc > 0.7
+    pred = se.predict(binom_frame)
+    assert pred.ncol == 3 and pred.nrow == binom_frame.nrow
+
+
+def test_stacked_ensemble_blending(binom_frame):
+    tr = binom_frame
+    rng = np.random.default_rng(5)
+    n = 300
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 - x2)))).astype(np.float32)
+    blend = Frame.from_dict({"x1": x1, "x2": x2,
+                             "x3": rng.normal(size=n).astype(np.float32)})
+    blend.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    gbm = GBM(GBMParameters(training_frame=tr, response_column="y",
+                            ntrees=10, seed=3)).train_model()
+    glm = GLM(GLMParameters(training_frame=tr, response_column="y",
+                            family="binomial", seed=3)).train_model()
+    se = StackedEnsemble(StackedEnsembleParameters(
+        training_frame=tr, response_column="y", base_models=[gbm, glm],
+        blending_frame=blend, seed=3)).train_model()
+    assert se.model_performance(blend).auc > 0.7
+
+
+def test_stacked_ensemble_requires_cv_preds(binom_frame):
+    gbm = GBM(GBMParameters(training_frame=binom_frame, response_column="y",
+                            ntrees=3, seed=1)).train_model()
+    with pytest.raises(ValueError, match="holdout"):
+        StackedEnsemble(StackedEnsembleParameters(
+            training_frame=binom_frame, response_column="y",
+            base_models=[gbm])).train_model()
