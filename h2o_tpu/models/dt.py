@@ -1,0 +1,50 @@
+"""DT — single (exact) decision tree.
+
+Analog of `hex/tree/dt/` (1,999 LoC; `hex/tree/dt/DT.java` builds one binary
+classification tree with exact binomial splits). TPU-native structure: one tree
+grown by the shared histogram engine (one jitted scan level pass, psum over the
+rows mesh axis) — the same quantile-binned split search, with leaf values fit
+as class probabilities. The reference limits DT to binomial classification;
+we additionally allow regression (leaf = mean) since the engine gives it for
+free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from .drf import DRF
+from .gbm import GBMParameters
+
+
+@dataclass
+class DTParameters(GBMParameters):
+    """Mirrors `hex/schemas/DTV3` (max_depth, min_rows)."""
+
+    def __post_init__(self):
+        self.ntrees = 1
+        self.sample_rate = 1.0
+        self.col_sample_rate = 1.0
+        self.col_sample_rate_per_tree = 1.0
+        self.mtries = 0
+
+
+class DT(DRF):
+    """One unsampled DRF tree == a single exact-greedy decision tree: DRF mode
+    fits leaves at f=0 (per-leaf weighted response means / class frequencies,
+    the `hex/tree/dt/DT.java` leaf rule), and with sample_rate=1, mtries=all
+    there is no randomization left."""
+
+    algo_name = "dt"
+
+    def _tree_config(self, K):
+        import dataclasses
+        cfg = super()._tree_config(K)
+        return dataclasses.replace(cfg, ntrees=1, sample_rate=1.0,
+                                   col_sample_rate=1.0,
+                                   col_sample_rate_per_tree=1.0, mtries=-2)

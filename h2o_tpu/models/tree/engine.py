@@ -51,6 +51,7 @@ class TreeConfig:
     min_rows: float = 10.0
     learn_rate: float = 0.1
     reg_lambda: float = 0.0      # Newton denominator regularizer (0 = H2O SE gain)
+    reg_alpha: float = 0.0       # L1 on leaf values (xgboost-style soft threshold)
     min_split_improvement: float = 1e-5
     sample_rate: float = 1.0     # per-tree row subsample
     col_sample_rate: float = 1.0         # per-split (level) column subsample
@@ -78,36 +79,70 @@ def _block_rows(rl: int, want: int) -> int:
 # ---------------------------------------------------------------------------
 # Histogram build (the ScoreBuildHistogram2 analog) — runs inside shard_map.
 # ---------------------------------------------------------------------------
-def _build_level_hist(Xb, node, vals3, offset, n_lv, nbins_tot, block):
-    """Accumulate hist (F, n_lv, nbins_tot, 3) for nodes [offset, offset+n_lv).
+def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block):
+    """Accumulate hist (F, n_lv, nbins_tot, V) for nodes [offset, offset+n_lv).
 
-    Xb: (Rl, F) int32 bins; node: (Rl,) int32 global node ids; vals3: (Rl, 3)
-    [w, g, h] already zeroed for inactive rows.
+    Xb: (Rl, F) int32 bins; node: (Rl,) int32 global node ids; vals: (Rl, V)
+    accumulated channels ([w, g, h] for GBM; [wt, wty, wc, wcy] for uplift),
+    already zeroed for inactive rows.
     """
     Rl, F = Xb.shape
+    V = vals.shape[1]
     rb = _block_rows(Rl, block)
     nblk = Rl // rb
 
     local = node - offset
     active = (local >= 0) & (local < n_lv)
     lc = jnp.clip(local, 0, n_lv - 1)
-    v = jnp.where(active[:, None], vals3, 0.0)
+    v = jnp.where(active[:, None], vals, 0.0)
 
     Xb_r = Xb.reshape(nblk, rb, F)
     lc_r = lc.reshape(nblk, rb)
-    v_r = v.reshape(nblk, rb, 3)
+    v_r = v.reshape(nblk, rb, V)
 
     def body(acc, blk):
         xb, l, vv = blk
         n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)          # (rb, n_lv)
-        a = jnp.einsum("rn,rv->rnv", n_oh, vv)                      # (rb, n_lv, 3)
+        a = jnp.einsum("rn,rv->rnv", n_oh, vv)                      # (rb, n_lv, V)
         b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)     # (rb, F, B)
         acc = acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh)
         return acc, None
 
-    init = jnp.zeros((F, n_lv, nbins_tot, 3), dtype=jnp.float32)
+    init = jnp.zeros((F, n_lv, nbins_tot, V), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
     return jax.lax.psum(hist, ROWS)
+
+
+def _node_totals(node, vals, n_nodes, block):
+    """Per-node channel totals (n_nodes, V) via the same blocked one-hot scan."""
+    Rl = node.shape[0]
+    V = vals.shape[1]
+    rb = _block_rows(Rl, block)
+    nblk = Rl // rb
+
+    def body(acc, blk):
+        nd, vv = blk
+        n_oh = jax.nn.one_hot(nd, n_nodes, dtype=jnp.float32)
+        return acc + jnp.einsum("rn,rv->nv", n_oh, vv), None
+
+    tot, _ = jax.lax.scan(body, jnp.zeros((n_nodes, V), jnp.float32),
+                          (node.reshape(nblk, rb), vals.reshape(nblk, rb, V)))
+    return jax.lax.psum(tot, ROWS)
+
+
+def _level_col_mask(lkey, F, n_lv, cfg: "TreeConfig", tree_cols):
+    """Per-(feature, node) sampling mask for one level: mtries k-of-F draw
+    (DRF, `hex/tree/drf/DRF.java` mtry) or Bernoulli col_sample_rate (GBM)."""
+    if cfg.mtries > 0:
+        u = jax.random.uniform(lkey, (F, n_lv))
+        kth = jnp.sort(u, axis=0)[min(cfg.mtries, F) - 1]
+        cmask = u <= kth[None, :]
+    elif cfg.col_sample_rate < 1.0:
+        cmask = jax.random.uniform(lkey, (F, n_lv)) < cfg.col_sample_rate
+        cmask = jnp.where(jnp.any(cmask, axis=0, keepdims=True), cmask, True)
+    else:
+        cmask = jnp.ones((F, n_lv), dtype=jnp.bool_)
+    return cmask & tree_cols[:, None]
 
 
 # ---------------------------------------------------------------------------
@@ -134,12 +169,19 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig):
     gna = G[:, :, nb][:, :, None]
     hna = H[:, :, nb][:, :, None]
 
+    alpha = cfg.reg_alpha
+
+    def _soft(g):
+        # xgboost-style L1 soft threshold on score numerators (no-op at α=0)
+        return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0) if alpha > 0 else g
+
     def gain_of(wl, gl, hl):
         wr = Wt[None, :, None] - wl
         gr = Gt[None, :, None] - gl
         hr = Ht[None, :, None] - hl
-        g = (gl * gl / (hl + lam + 1e-10) + gr * gr / (hr + lam + 1e-10)
-             - (Gt * Gt / (Ht + lam + 1e-10))[None, :, None])
+        gl_, gr_, gt_ = _soft(gl), _soft(gr), _soft(Gt)
+        g = (gl_ * gl_ / (hl + lam + 1e-10) + gr_ * gr_ / (hr + lam + 1e-10)
+             - (gt_ * gt_ / (Ht + lam + 1e-10))[None, :, None])
         ok = (wl >= cfg.min_rows) & (wr >= cfg.min_rows)
         return jnp.where(ok, g, -jnp.inf)
 
@@ -186,17 +228,8 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
         offset = n_lv - 1
         hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B, cfg.block_rows)
 
-        lkey = jax.random.fold_in(colkey, level)
-        if cfg.mtries > 0:
-            u = jax.random.uniform(lkey, (F, n_lv))
-            kth = jnp.sort(u, axis=0)[min(cfg.mtries, F) - 1]
-            cmask = u <= kth[None, :]
-        elif cfg.col_sample_rate < 1.0:
-            cmask = jax.random.uniform(lkey, (F, n_lv)) < cfg.col_sample_rate
-            cmask = jnp.where(jnp.any(cmask, axis=0, keepdims=True), cmask, True)
-        else:
-            cmask = jnp.ones((F, n_lv), dtype=jnp.bool_)
-        cmask = cmask & tree_cols[:, None]
+        cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
+                                cfg, tree_cols)
 
         gain, bf, bb, bnal, Wt = _find_splits(hist, cmask, edge_ok, cfg)
         do_split = (gain > cfg.min_split_improvement) & (Wt >= 2 * cfg.min_rows)
@@ -223,20 +256,13 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
 
     # Leaf/stop-node values from one final per-node accumulation (covers both
     # max-depth leaves and early-stopped internal nodes).
-    rb = _block_rows(Rl, cfg.block_rows)
-    nblk = Rl // rb
-
-    def body(acc, blk):
-        nd, vv = blk
-        n_oh = jax.nn.one_hot(nd, N, dtype=jnp.float32)
-        return acc + jnp.einsum("rn,rv->nv", n_oh, vv), None
-
-    tot, _ = jax.lax.scan(body, jnp.zeros((N, 3), jnp.float32),
-                          (node.reshape(nblk, rb), vals3.reshape(nblk, rb, 3)))
-    tot = jax.lax.psum(tot, ROWS)
+    tot = _node_totals(node, vals3, N, cfg.block_rows)
     scale = 1.0 if cfg.drf_mode else cfg.learn_rate
+    gleaf = tot[:, 1]
+    if cfg.reg_alpha > 0:
+        gleaf = jnp.sign(gleaf) * jnp.maximum(jnp.abs(gleaf) - cfg.reg_alpha, 0.0)
     val = jnp.where(tot[:, 0] > 0,
-                    -tot[:, 1] / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0) * scale
+                    -gleaf / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0) * scale
     return feat, thr, nanL, val, garr, node
 
 

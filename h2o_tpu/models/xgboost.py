@@ -1,0 +1,88 @@
+"""XGBoost-equivalent — the `hex/tree/xgboost` parameter surface retargeted
+onto our own histogram tree engine.
+
+The reference wraps the native xgboost4j JNI build behind a full ModelBuilder
+(`h2o-extensions/xgboost/src/main/java/hex/tree/xgboost/XGBoostModel.java:67,148`
+— params eta/subsample/colsample_bytree/... aliased to the H2O names, backends
+auto/CPU/GPU with `tree_method=gpu_hist`). Per SURVEY.md §7.6e the TPU rebuild
+does NOT wrap native XGBoost; `tree_method=hist` semantics (global quantile
+binning, Newton leaf values -G/(H+λ), L1 soft-thresholding via α) are exactly
+what our shared tree engine implements, so this builder is a parameter-mapping
+layer over it — the same relationship the reference has between its H2O-facing
+params and the rabit workers, minus the JNI.
+
+Supported param aliases (mirroring `XGBoostV3.XGBoostParametersV3`):
+  ntrees/n_estimators, eta/learn_rate, max_depth, min_child_weight/min_rows,
+  subsample/sample_rate, colsample_bytree/col_sample_rate_per_tree,
+  colsample_bylevel/col_sample_rate, reg_lambda, reg_alpha, max_bins,
+  booster (gbtree|dart — dart falls back to gbtree), tree_method (ignored:
+  always hist), backend (ignored: always TPU).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .gbm import GBM, GBMParameters
+
+
+@dataclass
+class XGBoostParameters(GBMParameters):
+    """Mirrors `hex/tree/xgboost/XGBoostModel.XGBoostParameters` field names.
+
+    xgboost-default field overrides (eta 0.3, min_child_weight 1, lambda 1)
+    are declared as dataclass defaults; the xgboost-native spellings below are
+    sentinel-valued aliases that, when set, overwrite their H2O-named twin and
+    then reset to the sentinel — so ``dataclasses.replace`` (clone / grid
+    search) re-running ``__post_init__`` is a no-op and an explicitly-passed
+    H2O-named value is never clobbered.
+    """
+
+    learn_rate: float = 0.3   # xgboost default eta
+    min_rows: float = 1.0     # xgboost default min_child_weight
+    reg_lambda: float = 1.0   # xgboost default lambda
+    reg_alpha: float = 0.0
+
+    # xgboost-native spellings; sentinel = "not set"
+    n_estimators: int = 0          # alias of ntrees
+    eta: float = -1.0              # alias of learn_rate
+    min_child_weight: float = -1.0  # alias of min_rows
+    subsample: float = -1.0        # alias of sample_rate
+    colsample_bytree: float = -1.0  # alias of col_sample_rate_per_tree
+    colsample_bylevel: float = -1.0  # alias of col_sample_rate
+    max_bins: int = 0              # alias of nbins
+    gamma: float = -1.0            # min split loss == min_split_improvement
+    booster: str = "gbtree"
+    tree_method: str = "hist"
+    backend: str = "auto"
+
+    def __post_init__(self):
+        # resolve aliases the way the reference resolves dual-named params,
+        # then park the alias back at its sentinel (idempotent re-init)
+        if self.n_estimators > 0:
+            self.ntrees, self.n_estimators = self.n_estimators, 0
+        if self.eta >= 0:
+            self.learn_rate, self.eta = self.eta, -1.0
+        if self.min_child_weight >= 0:
+            self.min_rows, self.min_child_weight = self.min_child_weight, -1.0
+        if self.subsample > 0:
+            self.sample_rate, self.subsample = self.subsample, -1.0
+        if self.colsample_bytree > 0:
+            self.col_sample_rate_per_tree, self.colsample_bytree = \
+                self.colsample_bytree, -1.0
+        if self.colsample_bylevel > 0:
+            self.col_sample_rate, self.colsample_bylevel = \
+                self.colsample_bylevel, -1.0
+        if self.max_bins > 0:
+            self.nbins, self.max_bins = self.max_bins, 0
+        if self.gamma >= 0:
+            self.min_split_improvement, self.gamma = self.gamma, -1.0
+
+
+class XGBoost(GBM):
+    algo_name = "xgboost"
+
+    def _tree_config(self, K):
+        import dataclasses
+        cfg = super()._tree_config(K)
+        return dataclasses.replace(cfg, reg_alpha=self.params.reg_alpha)
