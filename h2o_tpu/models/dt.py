@@ -13,25 +13,24 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from ..backend.jobs import Job
 from .drf import DRF
 from .gbm import GBMParameters
 
 
 @dataclass
 class DTParameters(GBMParameters):
-    """Mirrors `hex/schemas/DTV3` (max_depth, min_rows)."""
+    """Mirrors `hex/schemas/DTV3` (max_depth, min_rows). The pinning below is
+    the single source of truth: a DT is one unsampled tree, so the
+    ntrees/sampling knobs inherited from GBMParameters are forced off — the
+    reference's DTV3 simply has no such fields. mtries=-2 means all columns
+    (H2O's mtries=-2 convention)."""
 
     def __post_init__(self):
         self.ntrees = 1
         self.sample_rate = 1.0
         self.col_sample_rate = 1.0
         self.col_sample_rate_per_tree = 1.0
-        self.mtries = 0
+        self.mtries = -2
 
 
 class DT(DRF):
@@ -41,10 +40,3 @@ class DT(DRF):
     there is no randomization left."""
 
     algo_name = "dt"
-
-    def _tree_config(self, K):
-        import dataclasses
-        cfg = super()._tree_config(K)
-        return dataclasses.replace(cfg, ntrees=1, sample_rate=1.0,
-                                   col_sample_rate=1.0,
-                                   col_sample_rate_per_tree=1.0, mtries=-2)

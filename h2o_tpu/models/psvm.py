@@ -1,0 +1,185 @@
+"""PSVM — support vector machine classification.
+
+Analog of `hex/psvm/` (2,100 LoC): the reference implements primal-dual SVM
+with a Gaussian kernel (ICF-factorized kernel matrix + parallel interior
+point, `hex/psvm/PSVM.java`). TPU-native redesign: the ICF low-rank kernel
+factorization is replaced by a **Nyström feature map** — pick m landmark rows,
+Φ = K(X, L) K(L, L)^(−1/2) — after which the decision function is linear in Φ
+and the primal squared-hinge objective is smooth, so the fit is a handful of
+Newton steps where each Hessian/gradient is one sharded einsum over rows (the
+same Gram pattern as GLM; `hex/gram/Gram.java`). `kernel_type=linear` skips
+the feature map entirely. Both paths are exact in the linear case and a
+documented low-rank approximation in the Gaussian case (rank = min(rank_ratio
+· n, 500), mirroring the reference's ICF rank parameter `rank_ratio`).
+
+Outputs mirror `PSVMModel`: decision_function scores, ±1 labels, and the
+support-vector count (rows with margin < 1)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .datainfo import DataInfo
+from .model_base import (Model, ModelBuilder, ModelOutput, Parameters,
+                         make_metrics)
+
+
+@dataclass
+class SVMParameters(Parameters):
+    """Mirrors `hex/schemas/PSVMV3` (hyper_param C, gamma, kernel_type,
+    rank_ratio, positive_weight/negative_weight, sv_threshold)."""
+
+    hyper_param: float = 1.0        # C
+    kernel_type: str = "gaussian"   # gaussian | linear
+    gamma: float = -1.0             # -1 = 1/#features
+    rank_ratio: float = -1.0        # landmark fraction; -1 = auto
+    positive_weight: float = 1.0
+    negative_weight: float = 1.0
+    sv_threshold: float = 1e-4
+    max_iterations: int = 30
+
+
+@jax.jit
+def _sq_hinge_grad_hess(Phi, y, w, beta):
+    """Squared-hinge primal: L = Σ w·max(0, 1 − y·f)² with f = Φβ.
+    Returns (grad (P,), Gram-weighted Hessian (P,P), loss) — one sharded pass."""
+    f = Phi @ beta
+    m = 1.0 - y * f
+    active = (m > 0).astype(jnp.float32) * w
+    g = -2.0 * Phi.T @ (active * y * m)
+    H = jnp.einsum("rp,rq->pq", Phi * (2.0 * active)[:, None], Phi)
+    loss = jnp.sum(w * jnp.maximum(m, 0.0) ** 2)
+    return g, H, loss
+
+
+class SVMModel(Model):
+    algo_name = "psvm"
+
+    def __init__(self, params, output, dinfo, landmarks, whiten, gamma, beta,
+                 bias, sv_count, key=None):
+        self.dinfo = dinfo
+        self.landmarks = landmarks    # (m, P) or None for linear
+        self.whiten = whiten          # (m, m) K_mm^(-1/2) or None
+        self.gamma = gamma
+        self.beta = beta              # (P_phi,)
+        self.bias = bias
+        self.sv_count = sv_count
+        super().__init__(params, output, key=key)
+
+    def _features(self, X):
+        if self.landmarks is None:
+            return X
+        d2 = (jnp.sum(X * X, axis=1, keepdims=True)
+              - 2.0 * X @ self.landmarks.T
+              + jnp.sum(self.landmarks * self.landmarks, axis=1)[None, :])
+        K = jnp.exp(-self.gamma * jnp.maximum(d2, 0.0))
+        return K @ self.whiten
+
+    def adapt_frame(self, fr: Frame):
+        X, _ = self.dinfo.expand(fr)
+        return X
+
+    def decision_function(self, X):
+        return self._features(X) @ self.beta + self.bias
+
+    def score0(self, X):
+        f = self.decision_function(X)
+        label = (f > 0).astype(jnp.float32)
+        # probability surrogate via the margin (Platt scaling is a follow-up)
+        p1 = 1.0 / (1.0 + jnp.exp(-2.0 * f))
+        return jnp.stack([label, 1 - p1, p1], axis=1)
+
+
+class PSVM(ModelBuilder):
+    algo_name = "psvm"
+
+    def build_impl(self, job: Job) -> SVMModel:
+        p = self.params
+        fr = p.training_frame
+        names = self.feature_names()
+        y_dev, category, resp_domain = self.response_info()
+        if category != "Binomial":
+            raise ValueError("psvm requires a binary response "
+                             "(`hex/psvm/PSVM.java` binomial-only)")
+
+        dinfo = DataInfo.make(fr, names, standardize=True)
+        X, okrow = dinfo.expand(fr)
+        y01 = jnp.nan_to_num(y_dev)
+        ypm = 2.0 * y01 - 1.0                      # ±1 labels
+        w = (~jnp.isnan(y_dev)).astype(jnp.float32) * okrow.astype(jnp.float32)
+        w = w * (jnp.arange(X.shape[0]) < fr.nrow)
+        w = w * jnp.where(ypm > 0, p.positive_weight, p.negative_weight)
+        if p.weights_column:
+            w = w * jnp.nan_to_num(fr.vec(p.weights_column).data)
+
+        gamma = p.gamma if p.gamma > 0 else 1.0 / max(X.shape[1], 1)
+        landmarks = whiten = None
+        Phi = X
+        if p.kernel_type.lower() == "gaussian":
+            n = fr.nrow
+            m = int(min(500, max(32, (p.rank_ratio if p.rank_ratio > 0 else 0.1)
+                                 * n)))
+            m = min(m, n)
+            rng = np.random.default_rng(p.seed if p.seed not in (-1, None)
+                                        else 1234)
+            idx = rng.choice(n, size=m, replace=False)
+            L = np.asarray(X)[np.sort(idx)]
+            landmarks = jnp.asarray(L)
+            d2 = (np.sum(L * L, axis=1, keepdims=True) - 2.0 * L @ L.T
+                  + np.sum(L * L, axis=1)[None, :])
+            Kmm = np.exp(-gamma * np.maximum(d2, 0.0))
+            evals, evecs = np.linalg.eigh(Kmm + 1e-6 * np.eye(m))
+            whiten = jnp.asarray(
+                (evecs / np.sqrt(np.maximum(evals, 1e-10))) @ evecs.T,
+                jnp.float32)
+
+        output = ModelOutput()
+        output.names = names
+        output.domains = {n: fr.vec(n).domain for n in names}
+        output.response_domain = list(resp_domain)
+        output.model_category = "Binomial"
+        model = SVMModel(p, output, dinfo, landmarks, whiten, gamma, None,
+                         0.0, 0, key=None)
+        Phi = model._features(X)
+
+        # Newton on the regularized squared-hinge primal:
+        # ½‖β‖² + C·Σ w·max(0, 1−y f)², f = Φβ + b (bias via appended column)
+        Pphi = Phi.shape[1]
+        Phib = jnp.concatenate([Phi, jnp.ones((Phi.shape[0], 1), jnp.float32)],
+                               axis=1)
+        C = p.hyper_param
+        beta = jnp.zeros((Pphi + 1,), jnp.float32)
+        reg = np.eye(Pphi + 1)
+        reg[-1, -1] = 0.0  # bias unpenalized
+        prev = np.inf
+        for it in range(p.max_iterations):
+            job.check_cancelled()
+            g, H, loss = _sq_hinge_grad_hess(Phib, ypm, w, beta)
+            obj = float(loss) * C + 0.5 * float(jnp.sum(beta[:-1] ** 2))
+            gn = C * np.asarray(g, np.float64) + reg @ np.asarray(beta, np.float64)
+            Hn = C * np.asarray(H, np.float64) + reg + 1e-8 * np.eye(Pphi + 1)
+            stepv = np.linalg.solve(Hn, gn)
+            beta = beta - jnp.asarray(stepv, jnp.float32)
+            if abs(prev - obj) < 1e-8 * max(abs(obj), 1.0):
+                break
+            prev = obj
+
+        f = Phib @ beta
+        margins = ypm * f
+        sv_count = int(jnp.sum((margins < 1.0 - p.sv_threshold) & (w > 0)))
+        model.beta = beta[:-1]
+        model.bias = float(beta[-1])
+        model.sv_count = sv_count
+
+        raw = model.score0(X)
+        ym = jnp.where(w > 0, y01, jnp.nan)
+        output.training_metrics = make_metrics("Binomial", ym, raw, None)
+        job.update(1.0)
+        return model

@@ -1,0 +1,260 @@
+"""RuleFit — rules from a tree ensemble + sparse linear model.
+
+Analog of `hex/rulefit/` (1,574 LoC): `RuleFit.java` trains depth-varying tree
+models (`min_rule_length..max_rule_length`), extracts every root→node path as a
+binary rule (`RuleExtractor.java`), deduplicates, then fits an L1 GLM over
+[rules | linear terms] (`model_type` RULES / LINEAR / RULES_AND_LINEAR) and
+reports the surviving rules by |coef|·support (`Rule.java` importance).
+
+TPU-native structure: the ensembles come from our shared tree engine (forests
+are already (T, N) device arrays); path extraction walks those arrays
+host-side (tiny); rule evaluation — every rule over every row — is ONE jitted
+pass of chained comparisons (rules × rows broadcast), and the sparse linear fit
+reuses the GLM elastic-net path (sharded Gram + ADMM).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .drf import DRF, DRFParameters
+from .gbm import GBM, GBMParameters
+from .glm import GLM, GLMParameters
+from .model_base import Model, ModelBuilder, ModelOutput, make_metrics
+
+
+@dataclass
+class RuleFitParameters(GLMParameters):
+    """Mirrors `hex/schemas/RuleFitV3`."""
+
+    algorithm: str = "AUTO"        # AUTO(=DRF) | DRF | GBM
+    min_rule_length: int = 3
+    max_rule_length: int = 3
+    max_num_rules: int = -1        # -1 = no cap (reference default)
+    model_type: str = "rules_and_linear"  # rules_and_linear | rules | linear
+    rule_generation_ntrees: int = 50
+
+
+class Rule:
+    """A conjunction of (feature, op, threshold[, na_goes]) conditions."""
+
+    __slots__ = ("conds", "support", "coef", "rule_id")
+
+    def __init__(self, conds, rule_id):
+        self.conds = conds          # list of (fidx, '<='|'>', thr, na_left)
+        self.support = 0.0
+        self.coef = 0.0
+        self.rule_id = rule_id
+
+    def describe(self, names):
+        parts = []
+        for fidx, op, thr, _ in self.conds:
+            parts.append(f"({names[fidx]} {op} {thr:.6g})")
+        return " & ".join(parts)
+
+
+def extract_rules(forest: dict, max_depth: int, min_len: int, max_len: int):
+    """Walk the (T, N) full-binary-tree arrays; emit one rule per internal
+    path of length in [min_len, max_len] (`hex/rulefit/RuleExtractor.java`)."""
+    feat = np.asarray(forest["feat"])
+    thr = np.asarray(forest["thr"])
+    nanL = np.asarray(forest["nanL"])
+    if feat.ndim == 3:  # multinomial (T, K, N) -> flatten classes
+        T, K, N = feat.shape
+        feat = feat.reshape(T * K, N)
+        thr = thr.reshape(T * K, N)
+        nanL = nanL.reshape(T * K, N)
+    rules = []
+    seen = set()
+    for t in range(feat.shape[0]):
+        stack = [(0, [])]
+        while stack:
+            node, conds = stack.pop()
+            if conds and min_len <= len(conds) <= max_len:
+                key = tuple(conds)
+                if key not in seen:
+                    seen.add(key)
+                    rules.append(Rule(list(conds), len(rules)))
+            f = feat[t, node]
+            if f < 0 or len(conds) >= max_len:
+                continue
+            c_left = (int(f), "<=", float(thr[t, node]), bool(nanL[t, node]))
+            c_right = (int(f), ">", float(thr[t, node]), bool(nanL[t, node]))
+            stack.append((2 * node + 1, conds + [c_left]))
+            stack.append((2 * node + 2, conds + [c_right]))
+    return rules
+
+
+def _rules_tensor(rules, F):
+    """Pack rules into device arrays: per (rule, cond-slot): fidx, thr, is_gt,
+    na_left, active. Max conds padded."""
+    L = max(len(r.conds) for r in rules)
+    R = len(rules)
+    fidx = np.zeros((R, L), np.int32)
+    thr = np.zeros((R, L), np.float32)
+    is_gt = np.zeros((R, L), bool)
+    na_left = np.zeros((R, L), bool)
+    act = np.zeros((R, L), bool)
+    for i, r in enumerate(rules):
+        for j, (f, op, t, nl) in enumerate(r.conds):
+            fidx[i, j] = f
+            thr[i, j] = t
+            is_gt[i, j] = op == ">"
+            na_left[i, j] = nl
+            act[i, j] = True
+    return tuple(map(jnp.asarray, (fidx, thr, is_gt, na_left, act)))
+
+
+@jax.jit
+def eval_rules(X, fidx, thr, is_gt, na_left, act):
+    """(rows, rules) 0/1 membership: every condition of the rule holds."""
+    xv = X[:, fidx]                       # (rows, R, L)
+    isna = jnp.isnan(xv)
+    le = jnp.where(isna, na_left, xv <= thr)
+    cond = jnp.where(is_gt, ~le, le)
+    cond = jnp.where(act, cond, True)
+    return jnp.all(cond, axis=2).astype(jnp.float32)
+
+
+class RuleFitModel(Model):
+    algo_name = "rulefit"
+
+    def __init__(self, params, output, rules, rule_arrays, lin_names,
+                 lin_stats, glm_model, key=None):
+        self.rules = rules
+        self.rule_arrays = rule_arrays    # packed tensors or None
+        self.lin_names = lin_names        # linear-term feature names
+        self.lin_stats = lin_stats        # (means, sigmas) for linear terms
+        self.glm_model = glm_model        # fitted GLM over [rules|linear]
+        super().__init__(params, output, key=key)
+
+    def _design(self, fr: Frame):
+        blocks = []
+        if self.rule_arrays is not None:
+            X = fr.as_matrix(self.output.names)
+            blocks.append(eval_rules(X, *self.rule_arrays))
+        if self.lin_names:
+            means, sigmas = self.lin_stats
+            cols = []
+            for n, mu, sg in zip(self.lin_names, means, sigmas):
+                col = jnp.nan_to_num(fr.vec(n).data, nan=mu)
+                cols.append((col - mu) / sg)
+            blocks.append(jnp.stack(cols, axis=1))
+        return jnp.concatenate(blocks, axis=1)
+
+    def adapt_frame(self, fr: Frame):
+        return self._design(fr)
+
+    def score0(self, X):
+        return self.glm_model.score0(X)
+
+    def rule_importance(self):
+        """Rules the L1 fit kept, ranked by |coef| (`Rule.java` importance)."""
+        names = self.output.names
+        rows = []
+        for r in self.rules:
+            if abs(r.coef) > 1e-8:
+                rows.append({"rule": r.describe(names), "coefficient": r.coef,
+                             "support": r.support})
+        rows.sort(key=lambda d: -abs(d["coefficient"]))
+        return rows
+
+
+class RuleFit(ModelBuilder):
+    algo_name = "rulefit"
+
+    def build_impl(self, job: Job) -> RuleFitModel:
+        p = self.params
+        fr = p.training_frame
+        names = self.feature_names()
+        y_dev, category, resp_domain = self.response_info()
+        model_type = p.model_type.lower()
+
+        rules, rule_arrays = [], None
+        if "rules" in model_type:
+            # depth-varying ensembles (`RuleFit.java` treeParameters loop)
+            depths = range(p.min_rule_length, p.max_rule_length + 1)
+            ntrees = max(p.rule_generation_ntrees // max(len(list(depths)), 1), 5)
+            for depth in range(p.min_rule_length, p.max_rule_length + 1):
+                job.check_cancelled()
+                algo = (p.algorithm or "AUTO").upper()
+                common = dict(training_frame=fr, response_column=p.response_column,
+                              weights_column=p.weights_column, ntrees=ntrees,
+                              max_depth=depth, seed=p.seed,
+                              distribution=p.distribution)
+                if algo in ("AUTO", "DRF"):
+                    sub = DRF(DRFParameters(**common))
+                else:
+                    sub = GBM(GBMParameters(**common))
+                m = sub.build_impl(Job(f"rulefit_trees_d{depth}", 1.0))
+                rules += extract_rules(m.forest, m.cfg.max_depth,
+                                       p.min_rule_length, p.max_rule_length)
+            if p.max_num_rules > 0:
+                rules = rules[: p.max_num_rules]
+            for i, r in enumerate(rules):
+                r.rule_id = i
+            rule_arrays = _rules_tensor(rules, len(names)) if rules else None
+
+        lin_names, lin_stats = [], None
+        if "linear" in model_type:
+            lin_names = [n for n in names if not fr.vec(n).is_categorical()]
+            means = [float(np.nan_to_num(fr.vec(n).rollups().mean))
+                     for n in lin_names]
+            sigmas = [max(float(np.nan_to_num(fr.vec(n).rollups().sigma)), 1e-6)
+                      for n in lin_names]
+            lin_stats = (means, sigmas)
+
+        output = ModelOutput()
+        output.names = names
+        output.domains = {n: fr.vec(n).domain for n in names}
+        output.response_domain = list(resp_domain) if resp_domain else None
+        output.model_category = category
+
+        model = RuleFitModel(p, output, rules, rule_arrays, lin_names,
+                             lin_stats, None)
+        Xd = model._design(fr)
+
+        # L1 GLM over the rule/linear design (`RuleFit.java` glmParameters:
+        # alpha=1, lambda_search)
+        design = Frame([f"c{i}" for i in range(Xd.shape[1])],
+                       [Vec.from_device(Xd[:, i], fr.nrow)
+                        for i in range(Xd.shape[1])])
+        design.add(p.response_column, fr.vec(p.response_column))
+        if p.weights_column:
+            design.add(p.weights_column, fr.vec(p.weights_column))
+        gp = GLMParameters(
+            training_frame=design, response_column=p.response_column,
+            weights_column=p.weights_column, alpha=1.0,
+            lambda_search=p.lambda_search or p.lambda_ is None,
+            lambda_=p.lambda_, nlambdas=min(p.nlambdas, 20),
+            standardize=False, family=p.family, seed=p.seed,
+            max_iterations=p.max_iterations)
+        glm_model = GLM(gp).build_impl(Job("rulefit_glm", 1.0))
+        model.glm_model = glm_model
+
+        # pull coefficients back onto rules; support = rule frequency
+        beta = np.asarray(glm_model.beta)
+        n_rules = len(rules)
+        if rules:
+            memb = np.asarray(eval_rules(fr.as_matrix(names), *rule_arrays))
+            sup = memb[: fr.nrow].mean(axis=0)
+            for i, r in enumerate(rules):
+                r.coef = float(beta[i])
+                r.support = float(sup[i])
+
+        raw = model.score0(Xd)
+        y = jnp.nan_to_num(y_dev)
+        ym = jnp.where(jnp.isnan(y_dev), jnp.nan, y)
+        wm = (jnp.nan_to_num(fr.vec(p.weights_column).data)
+              if p.weights_column else None)
+        output.training_metrics = make_metrics(category, ym, raw, wm)
+        output.variable_importances = None
+        job.update(1.0)
+        return model

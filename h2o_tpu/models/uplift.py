@@ -312,7 +312,12 @@ class UpliftDRF(ModelBuilder):
         is_cat = np.array([fr.vec(n).is_categorical() for n in names])
         tvec = fr.vec(p.treatment_column)
         tvals = tvec.to_numpy()
-        uniq = np.unique(tvals[~np.isnan(tvals)])
+        if np.isnan(tvals).any():
+            raise ValueError(
+                f"upliftdrf: treatment_column '{p.treatment_column}' has "
+                f"{int(np.isnan(tvals).sum())} missing values; treatment "
+                "assignment must be known for every row")
+        uniq = np.unique(tvals)
         if not np.isin(uniq, (0.0, 1.0)).all():
             # the reference requires a 2-level categorical treatment
             # (`hex/tree/uplift/UpliftDRF.java` init checks)

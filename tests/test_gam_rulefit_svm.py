@@ -1,0 +1,137 @@
+"""Tests for GAM, RuleFit, PSVM, ANOVA GLM, ModelSelection.
+
+Modeled on the reference pyunits (`h2o-py/tests/testdir_algos/{gam,rulefit,
+psvm,anovaglm,modelselection}`)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu import Frame
+
+
+def test_gam_fits_nonlinearity():
+    from h2o_tpu.models.gam import GAM, GAMParameters
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    x = rng.uniform(-3, 3, n).astype(np.float32)
+    z = rng.normal(size=n).astype(np.float32)
+    y = (np.sin(x) * 2 + 0.5 * z + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "z": z, "y": y})
+    p = GAMParameters(training_frame=fr, response_column="y",
+                      gam_columns=["x"], num_knots=10, scale=0.1,
+                      family="gaussian", lambda_=0.0, alpha=0.0)
+    m = GAM(p).train_model()
+    r2 = m.output.training_metrics.r2
+    assert r2 > 0.9, f"GAM should capture sin(x): r2={r2}"
+    # a plain linear GLM can't get close on sin(x)
+    from h2o_tpu.models.glm import GLM, GLMParameters
+    lm = GLM(GLMParameters(training_frame=fr, response_column="y",
+                           family="gaussian", lambda_=0.0)).train_model()
+    assert r2 > lm.output.training_metrics.r2 + 0.2
+    # predict on fresh data follows the curve
+    x2 = np.linspace(-2, 2, 50).astype(np.float32)
+    fr2 = Frame.from_dict({"x": x2, "z": np.zeros(50, np.float32)})
+    pred = m.predict(fr2).vec("predict").to_numpy()
+    assert np.corrcoef(pred, np.sin(x2) * 2)[0, 1] > 0.95
+
+
+def test_gam_binomial():
+    from h2o_tpu.models.gam import GAM, GAMParameters
+
+    rng = np.random.default_rng(1)
+    n = 2000
+    x = rng.uniform(-3, 3, n).astype(np.float32)
+    pr = 1 / (1 + np.exp(-3 * np.sin(x)))
+    y = (rng.random(n) < pr).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    fr.replace("y", fr.vec("y").astype_cat(["0", "1"]))
+    m = GAM(GAMParameters(training_frame=fr, response_column="y",
+                          gam_columns=["x"], family="binomial",
+                          num_knots=8, scale=0.5)).train_model()
+    assert m.output.training_metrics.auc > 0.7
+
+
+def test_rulefit_rules_and_importance():
+    from h2o_tpu.models.rulefit import RuleFit, RuleFitParameters
+
+    rng = np.random.default_rng(2)
+    n = 3000
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    y = ((a > 0.5) & (b < 0.0)).astype(np.float32)  # a sharp rule
+    fr = Frame.from_dict({"a": a, "b": b, "y": y})
+    fr.replace("y", fr.vec("y").astype_cat(["0", "1"]))
+    p = RuleFitParameters(training_frame=fr, response_column="y",
+                          min_rule_length=2, max_rule_length=3,
+                          rule_generation_ntrees=20, seed=5,
+                          family="binomial", model_type="rules_and_linear")
+    m = RuleFit(p).train_model()
+    assert m.output.training_metrics.auc > 0.95
+    imp = m.rule_importance()
+    assert len(imp) > 0
+    assert "a" in imp[0]["rule"] or "b" in imp[0]["rule"]
+    # prediction on a fresh frame
+    pred = m.predict(fr)
+    assert pred.nrow == n
+
+
+@pytest.mark.parametrize("kernel", ["linear", "gaussian"])
+def test_psvm(kernel):
+    from h2o_tpu.models.psvm import PSVM, SVMParameters
+
+    rng = np.random.default_rng(3)
+    n = 1500
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    if kernel == "linear":
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    else:
+        y = (np.sqrt((x ** 2).sum(1)) < 1.1).astype(np.float32)  # circle
+    fr = Frame.from_dict({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    fr.replace("y", fr.vec("y").astype_cat(["0", "1"]))
+    m = PSVM(SVMParameters(training_frame=fr, response_column="y",
+                           kernel_type=kernel, hyper_param=1.0,
+                           seed=4)).train_model()
+    acc = (m.predict(fr).vec("predict").to_numpy() == y).mean()
+    assert acc > 0.9, f"{kernel} svm acc={acc}"
+    assert m.sv_count > 0
+
+
+def test_anovaglm_table():
+    from h2o_tpu.models.anovaglm import ANOVAGLM, ANOVAGLMParameters
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    noise = rng.normal(size=n).astype(np.float32)
+    y = (2 * a + 0.0 * b + 0.3 * noise).astype(np.float32)
+    fr = Frame.from_dict({"a": a, "b": b, "y": y})
+    m = ANOVAGLM(ANOVAGLMParameters(
+        training_frame=fr, response_column="y", family="gaussian",
+        lambda_=0.0, alpha=0.0, highest_interaction_term=1)).train_model()
+    tbl = {r["term"]: r for r in m.result()}
+    assert tbl["a"]["p_value"] < 0.01        # a matters
+    assert tbl["b"]["p_value"] > 0.05        # b doesn't
+    assert tbl["a"]["deviance"] > tbl["b"]["deviance"]
+
+
+@pytest.mark.parametrize("mode", ["forward", "backward", "maxr", "allsubsets"])
+def test_modelselection_finds_true_predictors(mode):
+    from h2o_tpu.models.modelselection import (ModelSelection,
+                                               ModelSelectionParameters)
+
+    rng = np.random.default_rng(5)
+    n = 1500
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (3 * X[:, 0] - 2 * X[:, 3] + 0.2 * rng.normal(size=n)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    fr = Frame.from_dict(cols | {"y": y})
+    m = ModelSelection(ModelSelectionParameters(
+        training_frame=fr, response_column="y", mode=mode,
+        max_predictor_number=3, family="gaussian")).train_model()
+    res = m.result()
+    two = next(r for r in res if len(r["predictors"]) == 2)
+    assert set(two["predictors"]) == {"x0", "x3"}, \
+        f"{mode} picked {two['predictors']}"
+    assert two["r2"] > 0.95
