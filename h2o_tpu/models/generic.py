@@ -1,0 +1,70 @@
+"""Generic model — import an arbitrary MOJO as a first-class scoring model.
+
+Analog of `hex/generic/` (GenericModel/GenericModelBuilder, 1,456 LoC): the
+MOJO zip is parsed by the standalone reader (`..mojo.reader.MojoModel`) and
+wrapped in the engine's `Model` interface so `predict()` / metrics work over
+Frames like any trained model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from ..mojo.reader import MojoModel as _MojoScorer
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters
+
+
+@dataclass
+class GenericParameters(Parameters):
+    path: str | None = None  # `hex/generic/GenericModelParameters` _mojo_key
+
+
+class GenericModel(Model):
+    algo_name = "generic"
+
+    def __init__(self, params, output, scorer: _MojoScorer, key=None):
+        self.scorer = scorer
+        super().__init__(params, output, key=key)
+
+    def predict(self, fr: Frame) -> Frame:
+        raw = self.scorer.predict(fr)
+        if raw.ndim == 1:  # regression value or cluster label
+            return Frame(["predict"], [Vec.from_numpy(raw.astype(np.float32))])
+        return self._predictions_frame(raw.astype(np.float32), fr.nrow)
+
+    def score0(self, X):
+        return self.scorer.score(np.asarray(X))
+
+
+class Generic(ModelBuilder):
+    """`hex/generic/Generic.java` — builds a model from a MOJO file."""
+
+    algo_name = "generic"
+    supervised = False
+
+    def _validate(self):
+        if not getattr(self.params, "path", None):
+            raise ValueError("generic: 'path' to a MOJO file is required")
+
+    def build_impl(self, job: Job) -> Model:
+        scorer = _MojoScorer.load(self.params.path)
+        output = ModelOutput()
+        feats = (scorer.columns[:-1] if scorer.supervised
+                 else list(scorer.columns))
+        output.names = feats
+        output.domains = {n: scorer.domains[i] for i, n in enumerate(feats)}
+        if scorer.supervised:
+            output.response_domain = scorer.domains[len(scorer.columns) - 1]
+            self.params.response_column = scorer.response_column
+        output.model_category = scorer.category
+        return GenericModel(self.params, output, scorer)
+
+
+def import_mojo(path: str) -> GenericModel:
+    """`h2o.import_mojo` analog."""
+    return Generic(GenericParameters(path=path)).train_model()

@@ -153,6 +153,14 @@ class Model(Keyed):
     def auc(self):
         return getattr(self.output.training_metrics, "auc", None)
 
+    # -- export (`hex/ModelMojoWriter.java` hook) -----------------------------
+    def save_mojo(self, path: str) -> str:
+        from ..mojo.writer import export_mojo
+
+        return export_mojo(self, path)
+
+    download_mojo = save_mojo  # h2o-py surface alias
+
     def remove_impl(self, store):
         for m in self.output.cv_models:
             store.remove(m.key)

@@ -1,0 +1,10 @@
+"""MOJO import/export — the `h2o-genmodel` (25k LoC) analog: a standalone,
+engine-independent scoring format compatible with the reference's zip layout.
+"""
+
+from .format import decode_tree, encode_tree, score_tree
+from .reader import MojoModel
+from .writer import export_mojo
+
+__all__ = ["MojoModel", "export_mojo", "encode_tree", "decode_tree",
+           "score_tree"]

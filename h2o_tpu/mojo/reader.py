@@ -1,0 +1,237 @@
+"""Standalone MOJO scorer — `hex/genmodel/MojoModel.java` +
+`EasyPredictModelWrapper` analog, pure numpy (zero engine/JAX dependencies,
+mirroring h2o-genmodel's zero-h2o-core-deps property).
+
+`MojoModel.load(path)` parses the zip (`ModelMojoReader.java:291` model.ini
+grammar) and dispatches on `algo` to a scorer implementing the same
+prediction-combination rules as the reference readers:
+- gbm: accumulate tree sums, apply init_f + inverse link / GBM_rescale
+  (`hex/genmodel/algos/gbm/GbmMojoModel.java:43-62`).
+- drf: average over tree groups, p1 = 1 - p0 for binomial
+  (`hex/genmodel/algos/drf/DrfMojoModel.java:38-58`).
+- glm: categorical offset indexing + dense dot + inverse link
+  (`hex/genmodel/algos/glm/GlmMojoModel.java:33-66`).
+- kmeans: standardize then nearest center
+  (`hex/genmodel/algos/kmeans/KMeansMojoModel.java`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .format import (MojoZipReader, decode_tree, parse_kv, parse_model_ini,
+                     score_tree, unescape_line)
+
+
+class MojoModel:
+    """A loaded MOJO: metadata + a batch scorer over raw feature rows."""
+
+    def __init__(self, info, columns, domains):
+        self.info = info
+        self.columns = columns          # feature columns + response (if sup.)
+        self.domains = domains          # aligned with columns
+        self.algo = info["algo"]
+        self.category = info["category"]
+        self.supervised = parse_kv(info.get("supervised"), False)
+        self.n_features = parse_kv(info.get("n_features"))
+        self.n_classes = parse_kv(info.get("n_classes"), 1)
+        self.response_column = columns[-1] if self.supervised else None
+
+    # -- loading -------------------------------------------------------------
+    @staticmethod
+    def load(path: str) -> "MojoModel":
+        zr = MojoZipReader(path)
+        try:
+            info, columns, dommap = parse_model_ini(zr.text("model.ini"))
+            domains = [None] * len(columns)
+            for ci, fname in dommap.items():
+                lines = zr.text(f"domains/{fname}").splitlines()
+                domains[ci] = [unescape_line(s) for s in lines]
+            algo = info.get("algo")
+            cls = {"gbm": _TreeMojo, "drf": _TreeMojo, "glm": _GlmMojo,
+                   "kmeans": _KMeansMojo}.get(algo)
+            if cls is None:
+                raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
+            model = cls(info, columns, domains)
+            model._read(zr)
+            return model
+        finally:
+            zr.close()
+
+    def _read(self, zr: MojoZipReader):
+        raise NotImplementedError
+
+    # -- scoring -------------------------------------------------------------
+    def score(self, X: np.ndarray) -> np.ndarray:
+        """X: (R, n_features) raw values (categoricals as domain codes).
+        Returns (R,) regression / cluster labels, or (R, 1+K) [label, p...]."""
+        raise NotImplementedError
+
+    def feature_frame_matrix(self, fr) -> np.ndarray:
+        """Adapt an engine Frame (or dict of numpy columns) to this model's
+        feature order/domains — the EasyPredictModelWrapper role."""
+        feats = self.columns[:-1] if self.supervised else self.columns
+        cols = []
+        for ci, name in enumerate(feats):
+            if isinstance(fr, dict):
+                x = np.asarray(fr[name], dtype=np.float64)
+            else:
+                v = fr.vec(name)
+                x = v.to_numpy().astype(np.float64)
+                dom = self.domains[ci]
+                if dom is not None and v.domain is not None \
+                        and list(v.domain) != dom:
+                    remap = {lvl: i for i, lvl in enumerate(dom)}
+                    codes = np.array([remap.get(l, np.nan)
+                                      for l in v.domain])
+                    ok = ~np.isnan(x)
+                    y = np.full_like(x, np.nan)
+                    y[ok] = codes[x[ok].astype(np.int64)]
+                    x = y
+            cols.append(x)
+        return np.stack(cols, axis=1)
+
+    def predict(self, fr) -> np.ndarray:
+        return self.score(self.feature_frame_matrix(fr))
+
+
+# ---------------------------------------------------------------------------
+class _TreeMojo(MojoModel):
+    def _read(self, zr):
+        self.n_groups = parse_kv(self.info.get("n_trees"))
+        self.tpc = parse_kv(self.info.get("n_trees_per_class"), 1)
+        self.init_f = parse_kv(self.info.get("init_f"), 0.0)
+        self.distribution = self.info.get("distribution", "gaussian")
+        self.link = self.info.get("link_function", "identity")
+        self.trees = []  # [group][class] -> decoded root
+        for j in range(self.n_groups):
+            row = []
+            for i in range(self.tpc):
+                name = f"trees/t{i:02d}_{j:03d}.bin"
+                row.append(decode_tree(zr.blob(name)) if zr.exists(name)
+                           else None)
+            self.trees.append(row)
+
+    def _tree_sums(self, X):
+        sums = np.zeros((X.shape[0], self.tpc))
+        for row in self.trees:
+            for i, root in enumerate(row):
+                if root is not None:
+                    sums[:, i] += score_tree(root, X, self.domains)
+        return sums
+
+    def _linkinv(self, f):
+        if self.link == "logit":
+            return 1.0 / (1.0 + np.exp(-f))
+        if self.link in ("log", "tweedie"):
+            return np.exp(f)
+        if self.link == "inverse":
+            return 1.0 / np.where(np.abs(f) < 1e-12, 1e-12, f)
+        return f
+
+    def score(self, X):
+        s = self._tree_sums(X)
+        R = X.shape[0]
+        if self.algo == "gbm":
+            if self.category == "Regression":
+                return self._linkinv(s[:, 0] + self.init_f)
+            if self.category == "Binomial":
+                p1 = self._linkinv(s[:, 0] + self.init_f)
+                return np.stack([(p1 > 0.5).astype(np.float64), 1 - p1, p1],
+                                axis=1)
+            # multinomial: GBM_rescale = softmax over per-class sums
+            m = s - s.max(axis=1, keepdims=True)
+            e = np.exp(m)
+            p = e / e.sum(axis=1, keepdims=True)
+            return np.concatenate(
+                [p.argmax(axis=1)[:, None].astype(np.float64), p], axis=1)
+        # drf
+        if self.category == "Regression":
+            return s[:, 0] / self.n_groups
+        if self.category == "Binomial" and self.tpc == 1:
+            p0 = s[:, 0] / self.n_groups
+            p1 = 1.0 - p0
+            return np.stack([(p1 > 0.5).astype(np.float64), p0, p1], axis=1)
+        tot = s.sum(axis=1, keepdims=True)
+        p = np.where(tot > 0, s / np.where(tot == 0, 1, tot), 0.0)
+        return np.concatenate(
+            [p.argmax(axis=1)[:, None].astype(np.float64), p], axis=1)
+
+
+# ---------------------------------------------------------------------------
+class _GlmMojo(MojoModel):
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.use_all = g("use_all_factor_levels", False)
+        self.cats = g("cats", 0)
+        self.cat_modes = np.asarray(g("cat_modes", []), dtype=np.int64)
+        self.cat_offsets = np.asarray(g("cat_offsets", [0]), dtype=np.int64)
+        self.nums = g("nums", 0)
+        self.num_means = np.asarray(g("num_means", []), dtype=np.float64)
+        self.mean_imputation = g("mean_imputation", False)
+        self.beta = np.asarray(g("beta"), dtype=np.float64)
+        self.family = self.info.get("family", "gaussian")
+        self.link = self.info.get("link", "identity")
+        self.tweedie_link_power = g("tweedie_link_power", 0.0)
+
+    def score(self, X):
+        X = np.asarray(X, dtype=np.float64).copy()
+        if self.mean_imputation:
+            for i in range(self.cats):
+                X[np.isnan(X[:, i]), i] = self.cat_modes[i]
+            for i in range(self.nums):
+                c = self.cats + i
+                X[np.isnan(X[:, c]), c] = self.num_means[i]
+        eta = np.zeros(X.shape[0])
+        skip = 0 if self.use_all else 1
+        for i in range(self.cats):
+            ival = X[:, i].astype(np.int64) - skip + self.cat_offsets[i]
+            ok = (ival >= self.cat_offsets[i]) & (ival < self.cat_offsets[i + 1])
+            eta += np.where(ok, self.beta[np.clip(ival, 0, len(self.beta) - 1)],
+                            0.0)
+        ncat = self.cat_offsets[self.cats]
+        num_beta = self.beta[ncat:-1]
+        eta += X[:, self.cats:self.cats + self.nums] @ num_beta
+        eta += self.beta[-1]
+        mu = self._linkinv(eta)
+        if self.category == "Binomial":
+            return np.stack([(mu > 0.5).astype(np.float64), 1 - mu, mu],
+                            axis=1)
+        return mu
+
+    def _linkinv(self, eta):
+        if self.link == "logit":
+            return 1.0 / (1.0 + np.exp(-eta))
+        if self.link == "log":
+            return np.exp(eta)
+        if self.link == "inverse":
+            x = np.where(np.abs(eta) < 1e-12, 1e-12, eta)
+            return 1.0 / x
+        if self.link == "tweedie":
+            lp = self.tweedie_link_power
+            return np.exp(eta) if lp == 0 else np.power(eta, 1.0 / lp)
+        return eta
+
+
+# ---------------------------------------------------------------------------
+class _KMeansMojo(MojoModel):
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.standardize = g("standardize", False)
+        means = g("standardize_means")
+        self.means = (np.asarray(means, dtype=np.float64)
+                      if means is not None else None)
+        if self.standardize:
+            self.mults = np.asarray(g("standardize_mults"), dtype=np.float64)
+        self.centers = np.asarray(
+            [g(f"center_{i}") for i in range(g("center_num"))],
+            dtype=np.float64)
+
+    def score(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        if self.means is not None:  # engine imputes NAs with means
+            X = np.where(np.isnan(X), self.means, X)
+        if self.standardize:
+            X = (X - self.means) * self.mults
+        d2 = ((X[:, None, :] - self.centers[None, :, :]) ** 2).sum(axis=2)
+        return d2.argmin(axis=1).astype(np.float64)

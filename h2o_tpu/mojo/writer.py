@@ -1,0 +1,249 @@
+"""MOJO export — `hex/ModelMojoWriter.java` + per-algo writers analog.
+
+Produces zips readable by the reference's standalone scorers
+(`hex/genmodel/algos/{gbm,drf,glm,kmeans}`): GBM/DRF tree bytecode + aux
+blobs named `trees/t%02d_%03d.bin` (`hex/tree/SharedTreeMojoWriter.java:81`),
+GLM coefficient kv layout (`hex/genmodel/algos/glm/GlmMojoReader.java:19-41`),
+KMeans standardized centers (`hex/genmodel/algos/kmeans/KMeansMojoReader.java`).
+
+Conversion notes (engine -> MOJO semantics):
+- Engine trees send x <= thr left; MOJO sends x >= splitVal right, so
+  splitVal = nextafter(thr, +inf) (see format.encode_tree).
+- DRF: the MOJO scorer averages raw leaf sums and sets p0 = preds[1]/T
+  (`hex/genmodel/algos/drf/DrfMojoModel.java:38-58`), while the engine stores
+  class-1 leaf probabilities plus a shared intercept f0 — leaves are
+  rewritten (1 - leaf - f0 for binomial, leaf + f0 otherwise) so both paths
+  produce identical numbers.
+- Multinomial GBM: the per-class intercept f0[k] is folded into the first
+  tree group's leaves (softmax is not shift-invariant per class, so the
+  fold-in must happen exactly once).
+- GLM: engine beta lives on the standardized scale; exported beta is
+  destandardized (beta/sigma, intercept -= sum(beta*mean/sigma)) because the
+  MOJO scorer only mean-imputes, never standardizes.
+"""
+
+from __future__ import annotations
+
+import uuid as _uuid
+
+import numpy as np
+
+from .format import MojoZipWriter, build_model_ini, encode_tree, escape_line
+
+_GBM_LINKS = {
+    "bernoulli": "logit", "quasibinomial": "logit",
+    "poisson": "log", "gamma": "log", "tweedie": "tweedie",
+    "negativebinomial": "log",
+}
+_GLM_LINKS = {  # family link name -> LinkFunctionType name
+    "identity": "identity", "logit": "logit", "log": "log",
+    "inverse": "inverse", "tweedie": "tweedie",
+}
+
+
+def export_mojo(model, path: str) -> str:
+    """Write `model` to `path` as a MOJO zip; returns the path."""
+    algo = model.algo_name
+    if algo in ("gbm", "drf", "xrt"):
+        _write_tree_mojo(model, path)
+    elif algo == "glm":
+        _write_glm_mojo(model, path)
+    elif algo == "kmeans":
+        _write_kmeans_mojo(model, path)
+    else:
+        raise NotImplementedError(f"MOJO export not implemented for '{algo}'")
+    return path
+
+
+# ---------------------------------------------------------------------------
+def _common_info(model, algo, algo_full, category, n_classes, columns,
+                 domains, mojo_version):
+    return {
+        "h2o_version": "tpu-0.1.0",
+        "mojo_version": mojo_version,
+        "license": "Apache License Version 2.0",
+        "algo": algo,
+        "algorithm": algo_full,
+        "endianness": "LITTLE_ENDIAN",
+        "category": category,
+        "uuid": str(_uuid.uuid4()),
+        "supervised": category != "Clustering",
+        "n_features": len(columns) - (0 if category == "Clustering" else 1),
+        "n_classes": n_classes,
+        "n_columns": len(columns),
+        "n_domains": sum(d is not None for d in domains),
+        "balance_classes": False,
+        "default_threshold": 0.5,
+        "prior_class_distrib": "null",
+        "model_class_distrib": "null",
+        "timestamp": "1970-01-01 00:00:00",
+        "escape_domain_values": True,
+    }
+
+
+def _write_common(zw, info, columns, domains):
+    zw.write_text("model.ini", build_model_ini(info, columns, domains))
+    di = 0
+    for dom in domains:
+        if dom is not None:
+            zw.write_text(f"domains/d{di:03d}.txt",
+                          "\n".join(escape_line(str(x)) for x in dom) + "\n")
+            di += 1
+
+
+def _supervised_columns(model):
+    names = list(model.output.names)
+    resp = model.params.response_column
+    columns = names + [resp]
+    domains = [model.output.domains.get(n) for n in names]
+    domains.append(model.output.response_domain)
+    return columns, domains
+
+
+# ---------------------------------------------------------------------------
+def _write_tree_mojo(model, path: str):
+    out = model.output
+    category = out.model_category
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
+    columns, domains = _supervised_columns(model)
+
+    feat = np.asarray(model.forest["feat"])
+    thr = np.asarray(model.forest["thr"])
+    nanL = np.asarray(model.forest["nanL"])
+    val = np.asarray(model.forest["val"]).astype(np.float64)
+    multi = feat.ndim == 3
+    T = feat.shape[0]
+    K = feat.shape[1] if multi else 1
+    drf = model.cfg.drf_mode
+    f0 = np.asarray(model.f0, dtype=np.float64)
+
+    # Rewrite leaves so the reference scorer's combination rule reproduces
+    # the engine's predictions exactly (see module docstring).
+    leaves = feat < 0
+    if drf:
+        if category == "Binomial":
+            val = np.where(leaves, 1.0 - val - float(f0), val)
+        else:  # regression mean / multinomial per-class probs
+            val = np.where(leaves, val + (f0[None, :, None] if multi else
+                                          float(f0)), val)
+        init_f = 0.0
+    elif multi:  # multinomial GBM: fold f0[k] into the first tree group
+        val = val.copy()
+        val[0] = np.where(leaves[0], val[0] + f0[:, None], val[0])
+        init_f = 0.0
+    else:
+        init_f = float(f0)
+
+    algo = "drf" if drf else "gbm"
+    full = "Distributed Random Forest" if drf else "Gradient Boosting Machine"
+    info = _common_info(model, algo, full, category, n_classes, columns,
+                        domains, mojo_version=1.30)
+    info["n_trees"] = T
+    info["n_trees_per_class"] = K
+    if drf:
+        info["binomial_double_trees"] = False
+    else:
+        info["distribution"] = model.dist.name
+        info["init_f"] = init_f
+        info["link_function"] = _GBM_LINKS.get(model.dist.name, "identity")
+
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    for j in range(T):
+        for i in range(K):
+            tree = (feat[j, i], thr[j, i], nanL[j, i], val[j, i]) if multi \
+                else (feat[j], thr[j], nanL[j], val[j])
+            blob, aux = encode_tree(*tree)
+            zw.write_blob(f"trees/t{i:02d}_{j:03d}.bin", blob)
+            zw.write_blob(f"trees/t{i:02d}_{j:03d}_aux.bin", aux)
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_glm_mojo(model, path: str):
+    out = model.output
+    category = out.model_category
+    if category == "Multinomial":
+        raise NotImplementedError("multinomial GLM MOJO export: follow-up")
+    di = model.dinfo
+    cats = [n for n, c in zip(di.names, di.is_cat) if c]
+    nums = [n for n, c in zip(di.names, di.is_cat) if not c]
+    columns = cats + nums + [model.params.response_column]
+    domains = [di.domains[n] for n in cats] + [None] * len(nums)
+    domains.append(out.response_domain)
+
+    lo = 0 if di.use_all_factor_levels else 1
+    cat_offsets = [0]
+    for n in cats:
+        cat_offsets.append(cat_offsets[-1] + len(di.domains[n]) - lo)
+    ncat_coefs = cat_offsets[-1]
+
+    beta = np.asarray(model.beta, dtype=np.float64).copy()
+    sigmas = np.array([di.num_sigmas[n] for n in nums])
+    means = np.array([di.num_means[n] for n in nums])
+    num_beta = beta[ncat_coefs:-1]
+    intercept = beta[-1]
+    center = di.standardize if di.center is None else di.center
+    if di.standardize:
+        num_beta = num_beta / sigmas
+    if center:
+        intercept = intercept - float(np.sum(num_beta * means))
+    beta_out = np.concatenate([beta[:ncat_coefs], num_beta, [intercept]])
+
+    info = _common_info(model, "glm", "Generalized Linear Modeling", category,
+                        2 if category == "Binomial" else 1, columns, domains,
+                        mojo_version=1.00)
+    info.update({
+        "use_all_factor_levels": di.use_all_factor_levels,
+        "cats": len(cats),
+        "cat_modes": [di.cat_modes[n] for n in cats],
+        "cat_offsets": cat_offsets,
+        "nums": len(nums),
+        "num_means": list(means),
+        # The engine always imputes at predict time (DataInfo.expand imputes
+        # in both MeanImputation and Skip modes; Skip only downweights
+        # training rows) — so the standalone scorer must impute too.
+        "mean_imputation": True,
+        "beta": list(beta_out),
+        "family": model.family.name,
+        "link": _GLM_LINKS.get(model.family.link_name, "identity"),
+        "tweedie_link_power": getattr(model.family, "tweedie_link_power", 0.0),
+        "dispersion_estimated": 1.0,
+    })
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_kmeans_mojo(model, path: str):
+    di = model.dinfo
+    if any(di.is_cat):
+        raise NotImplementedError(
+            "KMeans MOJO export supports numeric features only (categorical "
+            "columns use one-hot distance in the engine, which has no "
+            "equivalent in the reference's kmeans MOJO scorer)")
+    columns = list(di.names)
+    domains = [None] * len(columns)
+    info = _common_info(model, "kmeans", "K-means", "Clustering", 1,
+                        columns, domains, mojo_version=1.00)
+    info["supervised"] = False
+    info["n_features"] = len(columns)
+    centers = np.asarray(model.centers_std, dtype=np.float64)
+    info["standardize"] = di.standardize
+    # Means are written even without standardization: the engine imputes NAs
+    # with column means at predict time regardless (DataInfo.expand), so the
+    # standalone scorer needs them to reproduce engine behavior. The
+    # reference reader only consumes them when standardize=true; ours uses
+    # them for imputation in both modes.
+    info["standardize_means"] = [di.num_means[n] for n in columns]
+    info["standardize_modes"] = [-1] * len(columns)
+    if di.standardize:
+        info["standardize_mults"] = [1.0 / di.num_sigmas[n] for n in columns]
+    info["center_num"] = centers.shape[0]
+    for i in range(centers.shape[0]):
+        info[f"center_{i}"] = list(centers[i])
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.finish(path)
