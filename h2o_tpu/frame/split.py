@@ -1,0 +1,54 @@
+"""Frame splitters — analog of `hex/splitframe/` (ShuffleSplitFrame.java,
+SplitFrame.java) and the h2o-py `H2OFrame.split_frame` surface.
+
+H2O's split is probabilistic, not exact: each row draws a uniform and lands in
+the first split whose cumulative ratio exceeds it (`ShuffleSplitFrame`'s
+per-chunk random assignment). ``split_frame`` reproduces that; ``split_exact``
+gives deterministic contiguous-shuffled splits for tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .frame import Frame
+from .vec import Vec
+
+
+def _take(fr: Frame, idx: np.ndarray) -> Frame:
+    cols = {}
+    for name in fr.names:
+        v = fr.vec(name)
+        if v.is_string():
+            cols[name] = Vec(None, len(idx), type=v.type, host_data=v.host_data[idx])
+        else:
+            cols[name] = Vec.from_numpy(v.to_numpy()[idx], type=v.type, domain=v.domain)
+    return Frame(list(cols), list(cols.values()))
+
+
+def split_frame(fr: Frame, ratios=(0.75,), seed: int | None = None) -> list[Frame]:
+    """Random split by per-row uniform draw; len(ratios)+1 frames, the last
+    takes the remainder (h2o-py semantics: ratios must sum to < 1)."""
+    ratios = list(ratios)
+    if sum(ratios) >= 1.0:
+        raise ValueError("ratios must sum to less than 1.0")
+    rng = np.random.default_rng(None if seed in (None, -1) else seed)
+    u = rng.random(fr.nrow)
+    bounds = np.cumsum(ratios + [1.0 - sum(ratios)])
+    which = np.searchsorted(bounds, u, side="right")
+    which = np.minimum(which, len(bounds) - 1)
+    return [_take(fr, np.where(which == k)[0]) for k in range(len(bounds))]
+
+
+def split_exact(fr: Frame, ratios=(0.75,), seed: int | None = None) -> list[Frame]:
+    """Deterministic row-count splits after a shuffle."""
+    ratios = list(ratios)
+    rng = np.random.default_rng(None if seed in (None, -1) else seed)
+    perm = rng.permutation(fr.nrow)
+    counts = [int(r * fr.nrow) for r in ratios]
+    counts.append(fr.nrow - sum(counts))
+    out, s = [], 0
+    for c in counts:
+        out.append(_take(fr, np.sort(perm[s:s + c])))
+        s += c
+    return out

@@ -44,6 +44,13 @@ class DataInfo:
     def ncols_expanded(self) -> int:
         return len(self.expanded_names)
 
+    @property
+    def effective_center(self) -> bool:
+        """Whether numeric columns are mean-centered (center defaults to
+        following `standardize`). The single source of truth for expand(),
+        GLM coef() destandardization, and the MOJO writer."""
+        return self.standardize if self.center is None else self.center
+
     @staticmethod
     def make(fr: Frame, names, standardize=True, use_all_factor_levels=False,
              missing_values_handling="MeanImputation") -> "DataInfo":
@@ -101,8 +108,7 @@ class DataInfo:
                 if self.missing_values_handling == "Skip":
                     valid = isna if valid is None else (valid | isna)
                 x = jnp.where(isna, self.num_means[n], col)
-                center = self.standardize if self.center is None else self.center
-                if center:
+                if self.effective_center:
                     x = x - self.num_means[n]
                 if self.standardize:
                     x = x / self.num_sigmas[n]

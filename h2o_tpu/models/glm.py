@@ -268,6 +268,25 @@ class GLMParameters(Parameters):
     compute_p_values: bool = False
 
 
+def _destandardize(beta: np.ndarray, di) -> np.ndarray:
+    """Map coefficients from the standardized training scale back to the
+    original feature scale: b → b/s, intercept → intercept − Σ b·m/s.
+    Accepts (P+1,) or multinomial (K, P+1) [classes × coefs, intercept last]."""
+    beta = beta.copy()
+    if not (di.standardize or di.effective_center):
+        return beta
+    B = beta[None, :] if beta.ndim == 1 else beta
+    shift = np.zeros(B.shape[0])
+    for j, n in enumerate(di.expanded_names):
+        if n in di.num_means:  # numeric (one-hot names never collide)
+            s = di.num_sigmas[n] if di.standardize else 1.0
+            m = di.num_means[n] if di.effective_center else 0.0
+            B[:, j] = B[:, j] / s
+            shift += B[:, j] * m
+    B[:, -1] -= shift
+    return B[0] if beta.ndim == 1 else B
+
+
 class GLMModel(Model):
     algo_name = "glm"
 
@@ -278,11 +297,20 @@ class GLMModel(Model):
         super().__init__(params, output, key=key)
 
     def coef(self) -> dict:
+        """Coefficients on the ORIGINAL feature scale (`GLMModel.coefficients()`).
+
+        beta is stored on the (possibly standardized) training scale used by
+        score0; numeric columns were transformed x → (x−m)/s, so the original
+        scale is b/s with the intercept absorbing Σ b·m/s.
+        """
         names = self.dinfo.expanded_names + ["Intercept"]
-        return dict(zip(names, np.asarray(self.beta)))
+        beta = _destandardize(np.asarray(self.beta, dtype=np.float64), self.dinfo)
+        return dict(zip(names, beta))
 
     def coef_norm(self) -> dict:
-        return self.coef()  # beta is stored on the standardized scale's inverse
+        """Coefficients on the standardized scale (`coefficients(standardize=True)`)."""
+        names = self.dinfo.expanded_names + ["Intercept"]
+        return dict(zip(names, np.asarray(self.beta)))
 
     def adapt_frame(self, fr: Frame):
         X, ok = self.dinfo.expand(fr)
@@ -485,6 +513,20 @@ class GLM(ModelBuilder):
 
 
 class GLMMultinomialModel(GLMModel):
+    def coef(self) -> dict:
+        """Per-class coefficient maps — h2o-py's coef() multinomial shape:
+        {class_name: {coef_name: value}} on the original feature scale."""
+        names = self.dinfo.expanded_names + ["Intercept"]
+        B = _destandardize(np.asarray(self.beta, dtype=np.float64), self.dinfo)
+        classes = self.output.response_domain or [str(k) for k in range(B.shape[0])]
+        return {str(c): dict(zip(names, B[k])) for k, c in enumerate(classes)}
+
+    def coef_norm(self) -> dict:
+        names = self.dinfo.expanded_names + ["Intercept"]
+        B = np.asarray(self.beta)
+        classes = self.output.response_domain or [str(k) for k in range(B.shape[0])]
+        return {str(c): dict(zip(names, B[k])) for k, c in enumerate(classes)}
+
     def score0(self, X):
         B = jnp.asarray(self.beta, jnp.float32)  # (K, P+1)
         eta = X @ B[:, :-1].T + B[:, -1][None, :]

@@ -195,6 +195,8 @@ class ModelBuilder:
 
     algo_name = "base"
     supervised = True
+    supports_cv = True  # False for transformers that consume fold_column
+                        # themselves (TargetEncoder's KFold strategy)
 
     def __init__(self, params: Parameters):
         self.params = params
@@ -262,7 +264,8 @@ class ModelBuilder:
 
         def run():
             t0 = time.time()
-            if self.params.nfolds >= 2 or self.params.fold_column:
+            if self.supports_cv and (self.params.nfolds >= 2
+                                     or self.params.fold_column):
                 model = self._train_with_cv(self.job)
             else:
                 model = self.build_impl(self.job)

@@ -1,0 +1,152 @@
+"""Segment models — train one model per segment (partition) of a frame.
+
+Analog of `hex/segments/` (`SegmentModelsBuilder.java:15-170`,
+`SegmentModels.java`): a "blueprint" set of parameters is re-trained once per
+unique combination of the segment columns; results are collected into a keyed
+`SegmentModels` container with per-segment status/errors and a results table.
+
+The reference fans segment builds out over the cluster via an MRTask over the
+segments frame (`SegmentModelsBuilder.java:127` MultiNodeRunner) with a
+`WorkAllocator`; here the single-controller model makes this a host loop (each
+build already saturates the mesh), optionally thread-parallel via
+``parallelism`` like the reference's `build_segment_models(parallelism=)`.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..backend.kvstore import Keyed, STORE
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+
+
+@dataclass
+class SegmentModelsParameters:
+    """`SegmentModelsBuilder.SegmentModelsParameters` (:171)."""
+
+    segment_columns: list = field(default_factory=list)
+    segments: Frame | None = None  # explicit segments frame (unique combos)
+    parallelism: int = 1
+
+
+class SegmentModels(Keyed):
+    """Keyed result container — `hex/segments/SegmentModels.java`."""
+
+    def __init__(self, segments: Frame, key: str | None = None):
+        super().__init__(key=key, prefix="segment_models")
+        self.segments = segments          # one row per segment
+        self.results: list[dict] = []     # {segment, model, status, errors, warnings}
+        STORE.put_keyed(self)
+
+    def as_frame(self) -> Frame:
+        """Results table: segment values + model key + status + errors."""
+        cols: dict[str, list] = {n: [] for n in self.segments.names}
+        cols["model"], cols["status"], cols["errors"] = [], [], []
+        for r in self.results:
+            for n, v in r["segment"].items():
+                cols[n].append(v)
+            cols["model"].append(r["model"].key if r["model"] else None)
+            cols["status"].append(r["status"])
+            cols["errors"].append(r["errors"])
+        names, vecs = [], []
+        for n, vals in cols.items():
+            arr = np.asarray(vals, dtype=object)
+            names.append(n)
+            vecs.append(Vec(None, len(vals), type="string",
+                            host_data=arr))
+        return Frame(names, vecs)
+
+    def models(self) -> list:
+        return [r["model"] for r in self.results if r["model"] is not None]
+
+
+def _unique_segments(fr: Frame, seg_cols: list[str]) -> list[dict]:
+    """Distinct combos of the segment columns, in first-appearance order —
+    the `makeSegmentsFrame` analog (`SegmentModelsBuilder.java:35`)."""
+    host = {c: fr.vec(c).to_numpy() for c in seg_cols}
+    doms = {c: fr.vec(c).domain for c in seg_cols}
+    seen, out = set(), []
+    n = fr.nrow
+    for i in range(n):
+        combo = tuple(host[c][i] for c in seg_cols)
+        if any(isinstance(v, float) and np.isnan(v) for v in combo):
+            continue
+        if combo not in seen:
+            seen.add(combo)
+            disp = {}
+            for c, v in zip(seg_cols, combo):
+                d = doms[c]
+                disp[c] = d[int(v)] if d is not None else v
+            out.append({"mask_vals": combo, "display": disp})
+    return out
+
+
+class SegmentModelsBuilder:
+    def __init__(self, builder_cls, params, segment_params: SegmentModelsParameters):
+        self.builder_cls = builder_cls
+        self.params = params
+        self.seg = segment_params
+        if not self.seg.segment_columns and self.seg.segments is None:
+            raise ValueError("segment_columns or segments frame required")
+
+    def build_segment_models(self) -> SegmentModels:
+        fr = self.params.training_frame
+        seg_cols = list(self.seg.segment_columns)
+        if not seg_cols and self.seg.segments is not None:
+            seg_cols = self.seg.segments.names
+        combos = _unique_segments(fr, seg_cols)
+        if self.seg.segments is not None:
+            # keep only requested combos, in the segments frame's order
+            want = []
+            host = {c: self.seg.segments.vec(c).to_numpy() for c in seg_cols}
+            sdoms = {c: self.seg.segments.vec(c).domain for c in seg_cols}
+            by_disp = {tuple(c["display"][k] for k in seg_cols): c for c in combos}
+            for i in range(self.seg.segments.nrow):
+                disp = tuple(
+                    (sdoms[c][int(host[c][i])] if sdoms[c] is not None else host[c][i])
+                    for c in seg_cols)
+                if disp in by_disp:
+                    want.append(by_disp[disp])
+            combos = want
+
+        seg_frame_cols = {c: [co["display"][c] for co in combos] for c in seg_cols}
+        seg_frame = Frame(
+            list(seg_frame_cols),
+            [Vec(None, len(combos), type="string",
+                 host_data=np.asarray(v, dtype=object))
+             for v in seg_frame_cols.values()])
+        out = SegmentModels(seg_frame)
+        host = {c: fr.vec(c).to_numpy() for c in seg_cols}
+
+        def build_one(combo):
+            mask = np.ones(fr.nrow, dtype=bool)
+            for c, v in zip(seg_cols, combo["mask_vals"]):
+                mask &= host[c] == v
+            idx = np.where(mask)[0]
+            from .model_base import _subset_frame
+
+            sub_fr = _subset_frame(fr, idx)
+            drop = [c for c in seg_cols if c in sub_fr.names]
+            p = self.params.clone(
+                training_frame=sub_fr,
+                ignored_columns=list(self.params.ignored_columns) + drop)
+            try:
+                m = self.builder_cls(p).build_impl(Job("segment", work=1.0))
+                return {"segment": combo["display"], "model": m,
+                        "status": "SUCCEEDED", "errors": ""}
+            except Exception as e:  # per-segment failure is data, not a crash
+                return {"segment": combo["display"], "model": None,
+                        "status": "FAILED", "errors": str(e)}
+
+        par = max(1, int(self.seg.parallelism))
+        if par > 1:
+            with ThreadPoolExecutor(max_workers=par) as ex:
+                out.results = list(ex.map(build_one, combos))
+        else:
+            out.results = [build_one(c) for c in combos]
+        return out

@@ -1,0 +1,249 @@
+"""TargetEncoder — supervised categorical encoding.
+
+Analog of `h2o-extensions/target-encoder/` (9,644 LoC,
+`ai/h2o/targetencoding/TargetEncoderModel.java`,`TargetEncoderHelper.java`):
+replace each categorical column with the (blended) per-level mean of the target,
+with leakage control:
+
+- ``None``        — encode with the full-data per-level mean.
+- ``LeaveOneOut`` — each row is encoded excluding its own target
+  (`(sum − yᵢ)/(cnt − 1)`).
+- ``KFold``       — rows in fold f are encoded from the other folds'
+  sums (`TargetEncoderModel.java:331`).
+
+Blending (`TargetEncoderHelper.java:236-247`):
+``P = 𝝺(n)·ȳ_level + (1−𝝺(n))·ȳ  with  𝝺(n) = 1/(1+exp((k−n)/f))``
+(k = inflection_point, f = smoothing). Optional uniform noise in
+[−noise, +noise] on training transforms (`TargetEncoderModel.java:44-47`).
+
+TPU-native structure: per-level {sum, count} aggregation is one
+``segment_sum`` per (column × fold) on device — the groupby MRTask
+(`TargetEncoderHelper` group-by) collapses into a scatter-add; the encode
+step is a gather + elementwise blend, fused by XLA. Multiclass targets get
+one encoded column per non-baseline class (as in the reference since 3.32).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters
+
+NO_FOLD = -1
+
+
+@dataclass
+class TargetEncoderParameters(Parameters):
+    columns_to_encode: list = field(default_factory=list)  # default: all cats
+    data_leakage_handling: str = "None"  # None|LeaveOneOut|KFold
+    blending: bool = False
+    inflection_point: float = 10.0
+    smoothing: float = 20.0
+    noise: float = 0.01
+    keep_original_categorical_columns: bool = True
+
+
+class TargetEncoderModel(Model):
+    algo_name = "targetencoder"
+
+    def __init__(self, params, output, encodings, prior, target_domain,
+                 nfolds_seen, key=None):
+        super().__init__(params, output, key=key)
+        # encodings[col] = dict(num=(card, C), den=(card,), per-fold variants)
+        self.encodings = encodings
+        self.prior = prior  # (C,) per target-class prior mean (C=1 regression)
+        self.target_domain = target_domain
+        self.nfolds_seen = nfolds_seen
+
+    # -- encoding table as a Frame (the reference's encoding map frames) ------
+    def encoding_map_frame(self, col: str) -> Frame:
+        e = self.encodings[col]
+        dom = self.output.domains[col]
+        cols = {col: Vec.from_numpy(np.arange(len(dom), dtype=np.float32),
+                                    type=T_CAT, domain=dom),
+                "numerator": Vec.from_numpy(np.asarray(e["num"][:, 0])),
+                "denominator": Vec.from_numpy(np.asarray(e["den"]))}
+        return Frame(list(cols), list(cols.values()))
+
+    def _encoded_names(self, col: str) -> list[str]:
+        if len(self.target_domain or []) > 2:
+            return [f"{col}_{c}_te" for c in self.target_domain[1:]]
+        return [f"{col}_te"]
+
+    def transform(self, fr: Frame, as_training: bool = False,
+                  noise: float | None = None) -> Frame:
+        """Apply encodings. ``as_training`` honours the leakage strategy
+        (LOO/KFold need the target/fold columns present); otherwise `None`
+        strategy is forced (`TargetEncoderModel.java:324`)."""
+        p: TargetEncoderParameters = self.params
+        strategy = p.data_leakage_handling if as_training else "None"
+        noise = p.noise if noise is None else noise
+        if not as_training:
+            noise = 0.0
+        rng = np.random.default_rng(p.seed if p.seed not in (-1, None) else None)
+
+        out = Frame(fr.names, fr.vecs)
+        y = oky = fold = None
+        if strategy in ("LeaveOneOut", "KFold"):
+            y = self._target_matrix(fr)
+            oky = ~np.isnan(fr.vec(p.response_column).to_numpy())
+        if strategy == "KFold":
+            fold = fr.vec(p.fold_column).to_numpy().astype(np.int64)
+
+        for col in self.encodings:
+            enc = self.encodings[col]
+            v = fr.vec(col)
+            codes_np = v.to_numpy()
+            train_dom = self.output.domains[col]
+            if v.domain != train_dom and v.domain is not None:
+                remap = {l: i for i, l in enumerate(train_dom)}
+                lut = np.array([remap.get(l, -1) for l in v.domain], dtype=np.float32)
+                ok = ~np.isnan(codes_np)
+                codes_np = np.where(ok, lut[np.where(ok, codes_np, 0).astype(np.int64)],
+                                    np.nan)
+                codes_np[codes_np < 0] = np.nan
+            card = len(train_dom)
+            ok = ~np.isnan(codes_np)
+            codes = np.where(ok, codes_np, card).astype(np.int64)  # card = NA slot
+
+            num, den = np.asarray(enc["num"]), np.asarray(enc["den"])
+            if strategy == "KFold" and enc.get("fold_num") is not None:
+                f_num, f_den = enc["fold_num"], enc["fold_den"]  # (F, card, C),(F, card)
+                fidx = np.clip(fold, 0, f_num.shape[0] - 1)
+                # per-row out-of-fold sums: total − own fold
+                row_num = num[codes] - f_num[fidx, codes]
+                row_den = den[codes] - f_den[fidx, codes]
+            elif strategy == "LeaveOneOut":
+                sub = ok & oky  # only rows that contributed to the sums
+                row_num = num[codes] - np.where(sub[:, None], np.nan_to_num(y), 0.0)
+                row_den = den[codes] - sub.astype(np.float64)
+            else:
+                row_num = num[codes]
+                row_den = den[codes]
+
+            row_den = row_den[:, None]  # (n, 1) against row_num's (n, C)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                post = row_num / np.maximum(row_den, 1e-300)
+            k, f, use_b = self.params.inflection_point, self.params.smoothing, \
+                self.params.blending
+            prior = self.prior
+            if use_b:
+                lam = 1.0 / (1.0 + np.exp(np.clip((k - row_den) / max(f, 1e-12),
+                                                  -60, 60)))
+                val = lam * post + (1.0 - lam) * prior[None, :]
+            else:
+                val = post
+            val = np.where(row_den > 0, val, prior[None, :])
+            # NA level gets the prior unless it was seen in training (NA slot
+            # is part of the table, matching the reference's NA-as-level).
+            if noise and noise > 0:
+                val = val + rng.uniform(-noise, noise, size=val.shape)
+            names = self._encoded_names(col)
+            for j, name in enumerate(names):
+                out.add(name, Vec.from_numpy(val[:, j].astype(np.float32)))
+            if not self.params.keep_original_categorical_columns:
+                out.remove(col)
+        return out
+
+    def transform_training(self, fr: Frame) -> Frame:
+        return self.transform(fr, as_training=True)
+
+    def _target_matrix(self, fr: Frame) -> np.ndarray:
+        yv = fr.vec(self.params.response_column)
+        y = yv.to_numpy()
+        if self.target_domain and len(self.target_domain) > 2:
+            C = len(self.target_domain) - 1
+            out = np.zeros((len(y), C))
+            for c in range(C):
+                out[:, c] = (y == c + 1).astype(np.float64)
+            return out
+        if self.target_domain:
+            return (y == 1).astype(np.float64)[:, None]
+        return y.astype(np.float64)[:, None]
+
+    def score0(self, X):
+        raise NotImplementedError("TargetEncoder transforms frames; use transform()")
+
+    def predict(self, fr: Frame) -> Frame:
+        return self.transform(fr, as_training=False)
+
+
+class TargetEncoder(ModelBuilder):
+    algo_name = "targetencoder"
+    supports_cv = False  # fold_column feeds the KFold leakage strategy
+
+    def build_impl(self, job: Job) -> TargetEncoderModel:
+        p: TargetEncoderParameters = self.params
+        fr = p.training_frame
+        cols = list(p.columns_to_encode) or [
+            n for n in fr.names
+            if fr.vec(n).is_categorical() and n not in
+            (p.response_column, p.fold_column)]
+        if p.data_leakage_handling == "KFold" and not p.fold_column:
+            raise ValueError("KFold leakage handling requires fold_column")
+
+        yv = fr.vec(p.response_column)
+        target_domain = list(yv.domain) if yv.is_categorical() else None
+        y_np = yv.to_numpy()
+        if target_domain and len(target_domain) > 2:
+            C = len(target_domain) - 1
+            Y = np.stack([(y_np == c + 1).astype(np.float64) for c in range(C)],
+                         axis=1)
+        elif target_domain:
+            Y = (y_np == 1).astype(np.float64)[:, None]
+        else:
+            Y = y_np.astype(np.float64)[:, None]
+        ok_y = ~np.isnan(y_np)
+        prior = Y[ok_y].mean(axis=0)
+
+        fold = None
+        nfolds = 0
+        if p.data_leakage_handling == "KFold":
+            fold = fr.vec(p.fold_column).to_numpy().astype(np.int64)
+            nfolds = int(fold.max()) + 1
+
+        encodings = {}
+        doms = {}
+        for col in cols:
+            v = fr.vec(col)
+            if not v.is_categorical():
+                continue
+            dom = list(v.domain)
+            card = len(dom)
+            codes_np = v.to_numpy()
+            okc = ~np.isnan(codes_np) & ok_y
+            codes = np.where(okc, codes_np, card).astype(np.int64)
+            # device scatter-add: per-level target sums + counts in one pass
+            seg = jnp.asarray(codes)
+            Yd = jnp.asarray(np.where(okc[:, None], Y, 0.0))
+            num = jax.ops.segment_sum(Yd, seg, num_segments=card + 1)
+            den = jax.ops.segment_sum(jnp.asarray(okc.astype(np.float64)), seg,
+                                      num_segments=card + 1)
+            enc = {"num": np.asarray(num), "den": np.asarray(den)}
+            if nfolds:
+                f_num = np.zeros((nfolds, card + 1, Y.shape[1]))
+                f_den = np.zeros((nfolds, card + 1))
+                seg2 = jnp.asarray(codes + np.clip(fold, 0, nfolds - 1) * (card + 1))
+                fn = jax.ops.segment_sum(Yd, seg2, num_segments=nfolds * (card + 1))
+                fd = jax.ops.segment_sum(jnp.asarray(okc.astype(np.float64)), seg2,
+                                         num_segments=nfolds * (card + 1))
+                enc["fold_num"] = np.asarray(fn).reshape(nfolds, card + 1, -1)
+                enc["fold_den"] = np.asarray(fd).reshape(nfolds, card + 1)
+            else:
+                enc["fold_num"] = enc["fold_den"] = None
+            encodings[col] = enc
+            doms[col] = dom
+
+        out = ModelOutput()
+        out.model_category = "TargetEncoder"
+        out.names = list(encodings)
+        out.domains = doms
+        out.response_domain = target_domain
+        return TargetEncoderModel(p, out, encodings, prior, target_domain, nfolds)

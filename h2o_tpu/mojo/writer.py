@@ -179,17 +179,10 @@ def _write_glm_mojo(model, path: str):
         cat_offsets.append(cat_offsets[-1] + len(di.domains[n]) - lo)
     ncat_coefs = cat_offsets[-1]
 
-    beta = np.asarray(model.beta, dtype=np.float64).copy()
-    sigmas = np.array([di.num_sigmas[n] for n in nums])
+    from ..models.glm import _destandardize
+
+    beta_out = _destandardize(np.asarray(model.beta, dtype=np.float64), di)
     means = np.array([di.num_means[n] for n in nums])
-    num_beta = beta[ncat_coefs:-1]
-    intercept = beta[-1]
-    center = di.standardize if di.center is None else di.center
-    if di.standardize:
-        num_beta = num_beta / sigmas
-    if center:
-        intercept = intercept - float(np.sum(num_beta * means))
-    beta_out = np.concatenate([beta[:ncat_coefs], num_beta, [intercept]])
 
     info = _common_info(model, "glm", "Generalized Linear Modeling", category,
                         2 if category == "Binomial" else 1, columns, domains,

@@ -1,0 +1,199 @@
+"""TargetEncoder, Aggregator, SegmentModels, split_frame."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.split import split_exact, split_frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.aggregator import Aggregator, AggregatorParameters
+from h2o_tpu.models.segments import (SegmentModelsBuilder,
+                                     SegmentModelsParameters)
+from h2o_tpu.models.target_encoder import (TargetEncoder,
+                                           TargetEncoderParameters)
+
+
+def _te_frame(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, 4, size=n)
+    y = (rng.random(n) < (0.2 + 0.2 * cat)).astype(np.float32)
+    fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32)})
+    fr.add("c", Vec.from_numpy(cat.astype(np.float32), type=T_CAT,
+                               domain=["a", "b", "c", "d"]))
+    fr.add("fold", Vec.from_numpy((np.arange(n) % 3).astype(np.float32)))
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["no", "yes"]))
+    return fr, cat, y
+
+
+class TestTargetEncoder:
+    def test_none_strategy_exact_means(self):
+        fr, cat, y = _te_frame()
+        p = TargetEncoderParameters(training_frame=fr, response_column="y",
+                                    columns_to_encode=["c"], noise=0.0)
+        m = TargetEncoder(p).train_model()
+        enc = m.transform(fr)
+        te = enc.vec("c_te").to_numpy()
+        for lvl in range(4):
+            expect = y[cat == lvl].mean()
+            got = te[cat == lvl]
+            assert np.allclose(got, expect, atol=1e-6), (lvl, got[0], expect)
+
+    def test_blending_shrinks_to_prior(self):
+        fr, cat, y = _te_frame()
+        prior = y.mean()
+        p = TargetEncoderParameters(training_frame=fr, response_column="y",
+                                    columns_to_encode=["c"], noise=0.0,
+                                    blending=True, inflection_point=1e7,
+                                    smoothing=1.0)
+        m = TargetEncoder(p).train_model()
+        te = m.transform(fr).vec("c_te").to_numpy()
+        # with k >> n, lambda ~ 0 → everything collapses to the prior
+        assert np.allclose(te, prior, atol=1e-5)
+
+    def test_loo_excludes_own_row(self):
+        fr, cat, y = _te_frame(n=50)
+        p = TargetEncoderParameters(training_frame=fr, response_column="y",
+                                    columns_to_encode=["c"], noise=0.0,
+                                    data_leakage_handling="LeaveOneOut")
+        m = TargetEncoder(p).train_model()
+        te = m.transform(fr, as_training=True, noise=0.0).vec("c_te").to_numpy()
+        i = 0
+        lvl = cat[i]
+        mask = (cat == lvl)
+        mask[i] = False
+        assert np.isclose(te[i], y[mask].mean(), atol=1e-6)
+
+    def test_kfold_out_of_fold(self):
+        fr, cat, y = _te_frame(n=120)
+        fold = np.arange(120) % 3
+        p = TargetEncoderParameters(training_frame=fr, response_column="y",
+                                    columns_to_encode=["c"], noise=0.0,
+                                    fold_column="fold",
+                                    data_leakage_handling="KFold")
+        m = TargetEncoder(p).train_model()
+        te = m.transform(fr, as_training=True, noise=0.0).vec("c_te").to_numpy()
+        i = 5
+        mask = (cat == cat[i]) & (fold != fold[i])
+        assert np.isclose(te[i], y[mask].mean(), atol=1e-6)
+
+    def test_new_level_gets_prior_and_transform_is_leak_free(self):
+        fr, cat, y = _te_frame()
+        p = TargetEncoderParameters(training_frame=fr, response_column="y",
+                                    columns_to_encode=["c"], noise=0.0)
+        m = TargetEncoder(p).train_model()
+        test = Frame.from_dict({"x": np.zeros(3, np.float32)})
+        test.add("c", Vec.from_numpy(np.array([0, 1, 2], np.float32), type=T_CAT,
+                                     domain=["a", "b", "zzz"]))
+        te = m.transform(test).vec("c_te").to_numpy()
+        assert np.isclose(te[2], y.mean(), atol=1e-6)  # unseen level → prior
+
+    def test_multiclass_encodes_k_minus_1_columns(self):
+        rng = np.random.default_rng(1)
+        n = 200
+        cat = rng.integers(0, 3, n)
+        y = rng.integers(0, 3, n)
+        fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32)})
+        fr.add("c", Vec.from_numpy(cat.astype(np.float32), type=T_CAT,
+                                   domain=["a", "b", "c"]))
+        fr.add("y", Vec.from_numpy(y.astype(np.float32), type=T_CAT,
+                                   domain=["r", "g", "b"]))
+        p = TargetEncoderParameters(training_frame=fr, response_column="y",
+                                    columns_to_encode=["c"], noise=0.0)
+        m = TargetEncoder(p).train_model()
+        enc = m.transform(fr)
+        assert "c_g_te" in enc.names and "c_b_te" in enc.names
+        tg = enc.vec("c_g_te").to_numpy()
+        expect = (y[cat == 0] == 1).mean()
+        assert np.isclose(tg[cat == 0][0], expect, atol=1e-6)
+
+
+class TestAggregator:
+    def test_reduces_to_target(self):
+        rng = np.random.default_rng(0)
+        n = 3000
+        X = rng.normal(size=(n, 3)).astype(np.float32)
+        fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(3)})
+        p = AggregatorParameters(training_frame=fr, target_num_exemplars=100,
+                                 rel_tol_num_exemplars=0.5)
+        m = Aggregator(p).train_model()
+        agg = m.aggregated_frame
+        assert "counts" in agg.names
+        counts = agg.vec("counts").to_numpy()
+        assert counts.sum() == n  # every row mapped to an exemplar
+        assert 30 <= agg.nrow <= 200  # within rel_tol of target
+
+    def test_target_equals_nrow_is_identity(self):
+        rng = np.random.default_rng(0)
+        n = 57
+        fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32)})
+        p = AggregatorParameters(training_frame=fr, target_num_exemplars=n)
+        m = Aggregator(p).train_model()
+        assert m.aggregated_frame.nrow == n
+        assert (m.aggregated_frame.vec("counts").to_numpy() == 1).all()
+
+
+class TestSegmentModels:
+    def test_one_model_per_segment(self):
+        from h2o_tpu.models.glm import GLM, GLMParameters
+
+        rng = np.random.default_rng(0)
+        n = 300
+        seg = rng.integers(0, 3, n)
+        x = rng.normal(size=n).astype(np.float32)
+        y = (2.0 + seg) * x + 0.01 * rng.normal(size=n).astype(np.float32)
+        fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+        fr.add("seg", Vec.from_numpy(seg.astype(np.float32), type=T_CAT,
+                                     domain=["s0", "s1", "s2"]))
+        p = GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0)
+        sm = SegmentModelsBuilder(
+            GLM, p, SegmentModelsParameters(segment_columns=["seg"])
+        ).build_segment_models()
+        assert len(sm.results) == 3
+        assert all(r["status"] == "SUCCEEDED" for r in sm.results)
+        # per-segment slope ≈ 2 + segment id
+        slopes = []
+        for r in sm.results:
+            m = r["model"]
+            slopes.append(float(m.coef()["x"]))
+        assert np.allclose(sorted(slopes), [2.0, 3.0, 4.0], atol=0.1)
+        tbl = sm.as_frame()
+        assert tbl.nrow == 3 and "status" in tbl.names
+
+    def test_failed_segment_reported_not_raised(self):
+        from h2o_tpu.models.glm import GLM, GLMParameters
+
+        n = 40
+        seg = np.array([0] * 20 + [1] * 20)
+        x = np.ones(n, np.float32)  # constant → no usable features
+        x[:20] = np.arange(20)
+        y = x * 2
+        fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+        fr.add("seg", Vec.from_numpy(seg.astype(np.float32), type=T_CAT,
+                                     domain=["ok", "bad"]))
+        p = GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0)
+        sm = SegmentModelsBuilder(
+            GLM, p, SegmentModelsParameters(segment_columns=["seg"])
+        ).build_segment_models()
+        status = {r["segment"]["seg"]: r["status"] for r in sm.results}
+        assert status["ok"] == "SUCCEEDED"
+        assert status["bad"] == "FAILED"
+
+
+class TestSplitFrame:
+    def test_split_frame_ratios(self):
+        rng = np.random.default_rng(0)
+        fr = Frame.from_dict({"x": rng.normal(size=10_000).astype(np.float32)})
+        a, b = split_frame(fr, ratios=[0.75], seed=42)
+        assert a.nrow + b.nrow == 10_000
+        assert abs(a.nrow / 10_000 - 0.75) < 0.02  # probabilistic split
+        with pytest.raises(ValueError):
+            split_frame(fr, ratios=[0.7, 0.4])
+
+    def test_split_exact(self):
+        fr = Frame.from_dict({"x": np.arange(100, dtype=np.float32)})
+        a, b, c = split_exact(fr, ratios=[0.5, 0.3], seed=1)
+        assert (a.nrow, b.nrow, c.nrow) == (50, 30, 20)
+        allv = np.concatenate([f.vec("x").to_numpy() for f in (a, b, c)])
+        assert sorted(allv) == list(range(100))
