@@ -1,0 +1,176 @@
+"""Persistence: binary model export/import, frame save/load, recovery dirs.
+
+Analog of the reference's checkpoint/persist layer (SURVEY.md §5.4):
+- binary model export/import (`hex/Model.java` exportBinaryModel /
+  `water/api/ModelsHandler` importModel),
+- frame save/load (`water/fvec/persist/FramePersist.java`),
+- the auto-recovery directory protocol used by grid search
+  (`hex/faulttolerance/Recovery.java:20-40`).
+
+Formats are host-side and self-contained: frames go to one ``.npz`` (columns)
+plus a JSON sidecar (names/types/domains); models are pickles whose device
+arrays were pulled back to numpy and whose attached Frames (training/validation
+— cluster state, not model state) are stripped, mirroring the reference, which
+also persists models without their training data. A persisted model scores
+after load in a fresh process: jnp ops consume the numpy arrays directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# frames (`water/fvec/persist/FramePersist.java`)
+# ---------------------------------------------------------------------------
+def save_frame(fr, path: str) -> str:
+    """Write a Frame to ``path`` (.npz + .json sidecar). Returns path."""
+    from ..frame.vec import T_STR
+
+    base = path[:-4] if path.endswith(".npz") else path
+    arrays, meta = {}, {"names": fr.names, "nrow": fr.nrow, "cols": []}
+    for i, name in enumerate(fr.names):
+        v = fr.vec(name)
+        cmeta = {"name": name, "type": v.type, "domain": v.domain}
+        if v.is_string():
+            arrays[f"c{i}"] = np.asarray(
+                ["" if x is None else str(x) for x in v.host_data])
+            arrays[f"c{i}_na"] = np.asarray(
+                [x is None for x in v.host_data], dtype=bool)
+        else:
+            arrays[f"c{i}"] = v.to_numpy()
+        meta["cols"].append(cmeta)
+    np.savez_compressed(base + ".npz", **arrays)
+    with open(base + ".json", "w") as f:
+        json.dump(meta, f)
+    return base + ".npz"
+
+
+def load_frame(path: str, key: str | None = None):
+    from ..frame.frame import Frame
+    from ..frame.vec import T_STR, Vec
+
+    base = path[:-4] if path.endswith(".npz") else path
+    with open(base + ".json") as f:
+        meta = json.load(f)
+    data = np.load(base + ".npz", allow_pickle=False)
+    vecs = []
+    for i, cmeta in enumerate(meta["cols"]):
+        arr = data[f"c{i}"]
+        if cmeta["type"] == T_STR:
+            na = data[f"c{i}_na"]
+            host = np.asarray([None if na[j] else str(x)
+                               for j, x in enumerate(arr)], dtype=object)
+            vecs.append(Vec(None, meta["nrow"], type=T_STR, host_data=host))
+        else:
+            vecs.append(Vec.from_numpy(arr, type=cmeta["type"],
+                                       domain=cmeta["domain"]))
+    fr = Frame(meta["names"], vecs, key=key)
+    from .kvstore import STORE
+
+    STORE.put_keyed(fr)
+    return fr
+
+
+# ---------------------------------------------------------------------------
+# models (binary export/import)
+# ---------------------------------------------------------------------------
+def _to_host(obj):
+    """Recursively pull jax.Arrays back to numpy so pickles are portable."""
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    return obj
+
+
+def save_model(model, path: str) -> str:
+    """Binary model export. Frames on the params are replaced by their keys."""
+    state = dict(model.__dict__)
+    params = state.get("params")
+    if params is not None:
+        import dataclasses
+
+        from ..frame.frame import Frame
+
+        reps = {f.name: getattr(params, f.name).key
+                for f in dataclasses.fields(params)
+                if isinstance(getattr(params, f.name), Frame)}
+        params = dataclasses.replace(
+            params, **{k: None for k in reps})
+        state["params"] = params
+        state["__frame_keys__"] = reps
+    state = _to_host(state)
+    payload = {"class_module": type(model).__module__,
+               "class_name": type(model).__name__,
+               "state": state}
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+    return path
+
+
+def load_model(path: str):
+    """Binary model import — registers the model back into the store."""
+    import importlib
+
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    cls = getattr(importlib.import_module(payload["class_module"]),
+                  payload["class_name"])
+    model = object.__new__(cls)
+    state = payload["state"]
+    state.pop("__frame_keys__", None)
+    model.__dict__.update(state)
+    from .kvstore import STORE
+
+    STORE.put_keyed(model)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# auto-recovery dir (`hex/faulttolerance/Recovery.java`)
+# ---------------------------------------------------------------------------
+class Recovery:
+    """Persists a grid search's progress so a fresh process can auto-resume,
+    skipping already-trained models — the reference's `-auto_recovery_dir`
+    protocol (exercised by `test_grid_auto_recover.py:50`)."""
+
+    MANIFEST = "recovery.json"
+
+    def __init__(self, dir: str):
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, self.MANIFEST)
+
+    def read(self) -> dict | None:
+        if not os.path.exists(self._manifest_path()):
+            return None
+        with open(self._manifest_path()) as f:
+            return json.load(f)
+
+    def write(self, manifest: dict) -> None:
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self._manifest_path())  # atomic wrt crashes
+
+    def save_training_frame(self, fr) -> None:
+        p = os.path.join(self.dir, "training_frame.npz")
+        if not os.path.exists(p):
+            save_frame(fr, p)
+
+    def load_training_frame(self):
+        return load_frame(os.path.join(self.dir, "training_frame.npz"))
+
+    def model_path(self, i: int) -> str:
+        return os.path.join(self.dir, f"model_{i}.bin")

@@ -1,0 +1,382 @@
+"""AutoML — automatic model search and ensembling.
+
+Analog of `h2o-automl/` (10,222 LoC): `ai/h2o/automl/AutoML.java` orchestrates a
+modeling plan of steps (`ModelingPlans.java:57-69` DEFAULT order: XGBoost
+defaults, GLM, DRF, GBM defaults, DeepLearning, grids, then StackedEnsembles),
+under time/model budgets (`WorkAllocations.java`), ranks everything on a
+`Leaderboard` (`hex/leaderboard/Leaderboard.java:256` default sort: binomial →
+auc, multinomial → mean_per_class_error, regression → mean_residual_deviance)
+and records an `EventLog` (`ai/h2o/automl/events/`).
+
+Step parameter presets mirror the reference's providers
+(`modeling/GBMStepsProvider.java:81-123` def_1..def_5 max_depth 6/7/8/10/15,
+`DRFStepsProvider` def + XRT, `DeepLearningStepsProvider` 1-3 layer presets,
+`GLMStepsProvider` lambda search, `StackedEnsembleStepsProvider` best-of-family
++ all). Every base model trains with k-fold CV and kept holdout predictions so
+the ensembles stack leak-free — same contract as the reference.
+
+The executor here is a host loop: each build already saturates the mesh, so the
+reference's cluster-parallel step executor collapses to sequential dispatch
+with budget checks between steps.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..backend.kvstore import Keyed, STORE
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .model_base import ModelBuilder, Parameters
+
+
+# ---------------------------------------------------------------------------
+# Event log (`ai/h2o/automl/events/EventLog.java`)
+# ---------------------------------------------------------------------------
+class EventLog:
+    def __init__(self):
+        self.events: list[dict] = []
+
+    def log(self, stage: str, message: str, level: str = "Info"):
+        self.events.append({"timestamp": time.time(), "level": level,
+                            "stage": stage, "message": message})
+
+    def as_frame(self) -> Frame:
+        cols = {k: np.asarray([e[k] for e in self.events], dtype=object)
+                for k in ("level", "stage", "message")}
+        cols["timestamp"] = np.asarray(
+            [e["timestamp"] for e in self.events], dtype=object)
+        names = ["timestamp", "level", "stage", "message"]
+        return Frame(names, [Vec(None, len(self.events), type="string",
+                                 host_data=cols[n]) for n in names])
+
+
+# ---------------------------------------------------------------------------
+# Leaderboard (`hex/leaderboard/Leaderboard.java`)
+# ---------------------------------------------------------------------------
+_HIGHER_BETTER = {"auc", "aucpr", "r2", "accuracy"}
+
+
+def _default_sort_metric(category: str) -> str:
+    if category == "Binomial":
+        return "auc"
+    if category == "Multinomial":
+        return "mean_per_class_error"
+    return "rmse"  # stand-in for mean_residual_deviance (equal ranking for
+                   # gaussian; the reference uses deviance here)
+
+
+class Leaderboard:
+    METRIC_COLS = {
+        "Binomial": ["auc", "logloss", "aucpr", "mean_per_class_error", "rmse", "mse"],
+        "Multinomial": ["mean_per_class_error", "logloss", "rmse", "mse"],
+        "Regression": ["rmse", "mse", "mae", "r2"],
+    }
+
+    def __init__(self, category: str, sort_metric: str | None = None):
+        self.category = category
+        self.sort_metric = (sort_metric or _default_sort_metric(category)).lower()
+        self.models: list = []
+
+    def add(self, model):
+        self.models.append(model)
+        self.models = self.sorted()
+
+    def _metric(self, m, name):
+        mm = (m.output.cross_validation_metrics or m.output.validation_metrics
+              or m.output.training_metrics)
+        v = getattr(mm, name, None)
+        return None if v is None or (isinstance(v, float) and np.isnan(v)) else v
+
+    def sorted(self):
+        decr = self.sort_metric in _HIGHER_BETTER
+        worst = -np.inf if decr else np.inf
+
+        def key(m):
+            v = self._metric(m, self.sort_metric)
+            return worst if v is None else v
+
+        return sorted(self.models, key=key, reverse=decr)
+
+    @property
+    def leader(self):
+        return self.models[0] if self.models else None
+
+    def as_frame(self) -> Frame:
+        cols: dict[str, list] = {"model_id": []}
+        metric_names = self.METRIC_COLS.get(self.category,
+                                            self.METRIC_COLS["Regression"])
+        for n in metric_names:
+            cols[n] = []
+        for m in self.models:
+            cols["model_id"].append(m.key)
+            for n in metric_names:
+                v = self._metric(m, n)
+                cols[n].append(np.nan if v is None else float(v))
+        vecs = [Vec(None, len(self.models), type="string",
+                    host_data=np.asarray(cols["model_id"], dtype=object))]
+        names = ["model_id"] + metric_names
+        for n in metric_names:
+            vecs.append(Vec.from_numpy(np.asarray(cols[n], dtype=np.float32)))
+        return Frame(names, vecs)
+
+
+# ---------------------------------------------------------------------------
+# Modeling steps (`ai/h2o/automl/modeling/*StepsProvider.java`)
+# ---------------------------------------------------------------------------
+@dataclass
+class Step:
+    algo: str              # GBM | DRF | XRT | GLM | DeepLearning | XGBoost |
+                           # StackedEnsemble | grid variants
+    id: str                # def_1, grid_1, best_of_family, ...
+    make: Callable         # (automl) -> model(s) | None
+    weight: int = 10       # relative work allocation (`WorkAllocations`)
+
+
+def _default_plan() -> list[Step]:
+    """The DEFAULT modeling plan order (`ModelingPlans.java:57-69`)."""
+    plan: list[Step] = []
+    # XGBoost defaults (our XGBoost is the retargeted histogram engine)
+    for sid, over in (("def_2", dict(max_depth=10, min_rows=5)),
+                      ("def_1", dict(max_depth=5, min_rows=10)),
+                      ("def_3", dict(max_depth=15, min_rows=3))):
+        plan.append(Step("XGBoost", sid,
+                         _model_step("xgboost", dict(ntrees=50, **over))))
+    plan.append(Step("GLM", "def_1", _glm_step()))
+    plan.append(Step("DRF", "def_1", _model_step("drf", dict(ntrees=50))))
+    # GBM def_1..def_5 (`GBMStepsProvider.java:81-123`)
+    for sid, depth in (("def_1", 6), ("def_2", 7), ("def_3", 8),
+                       ("def_4", 10), ("def_5", 15)):
+        plan.append(Step("GBM", sid, _model_step(
+            "gbm", dict(ntrees=50, max_depth=depth, sample_rate=0.8,
+                        col_sample_rate_per_tree=0.8))))
+    plan.append(Step("DeepLearning", "def_1", _model_step(
+        "deeplearning", dict(hidden=[10, 10, 10], epochs=10))))
+    plan.append(Step("XRT", "def_1", _model_step("xrt", dict(ntrees=50))))
+    # one random grid over GBM (`GBMStepsProvider.java:137` search space)
+    plan.append(Step("GBM", "grid_1", _gbm_grid_step(), weight=60))
+    plan.append(Step("StackedEnsemble", "best_of_family", _se_step(True), weight=5))
+    plan.append(Step("StackedEnsemble", "all", _se_step(False), weight=10))
+    return plan
+
+
+def _builder_for(algo: str):
+    from . import deeplearning, drf, gbm, glm, xgboost
+
+    return {
+        "gbm": (gbm.GBM, gbm.GBMParameters),
+        "drf": (drf.DRF, drf.DRFParameters),
+        "xrt": (drf.XRT, drf.XRTParameters),
+        "xgboost": (xgboost.XGBoost, xgboost.XGBoostParameters),
+        "glm": (glm.GLM, glm.GLMParameters),
+        "deeplearning": (deeplearning.DeepLearning,
+                         deeplearning.DeepLearningParameters),
+    }[algo]
+
+
+def _model_step(algo: str, overrides: dict):
+    def make(aml: "H2OAutoML"):
+        cls, pcls = _builder_for(algo)
+        valid = {f.name for f in __import__("dataclasses").fields(pcls)}
+        params = pcls(**aml._common_params(),
+                      **{k: v for k, v in overrides.items() if k in valid})
+        return [cls(params).train_model()]
+    return make
+
+
+def _glm_step():
+    def make(aml: "H2OAutoML"):
+        from .glm import GLM, GLMParameters
+
+        params = GLMParameters(**aml._common_params(), lambda_search=True,
+                               nlambdas=10)
+        return [GLM(params).train_model()]
+    return make
+
+
+def _gbm_grid_step():
+    def make(aml: "H2OAutoML"):
+        from .gbm import GBM, GBMParameters
+        from .grid import GridSearch, SearchCriteria
+
+        base = GBMParameters(**aml._common_params(), ntrees=50)
+        hyper = {"max_depth": [3, 5, 7, 9, 11, 13, 15, 17],
+                 "sample_rate": [0.5, 0.8, 1.0],
+                 "col_sample_rate": [0.4, 0.7, 1.0],
+                 "min_rows": [1, 5, 10, 15, 30]}
+        budget = aml._remaining_budget()
+        criteria = SearchCriteria(
+            strategy="RandomDiscrete",
+            max_models=max(1, (aml.max_models - aml._model_count())
+                           if aml.max_models else 5),
+            max_runtime_secs=budget if budget is not None else 0.0,
+            seed=aml.seed if aml.seed is not None else -1)
+        grid = GridSearch(GBM, base, hyper, criteria).train()
+        return grid.models
+    return make
+
+
+def _se_step(best_of_family: bool):
+    def make(aml: "H2OAutoML"):
+        from .ensemble import StackedEnsemble, StackedEnsembleParameters
+
+        bases = [m for m in aml.leaderboard.models
+                 if m.algo_name != "stackedensemble"
+                 and m.output.cv_holdout_predictions is not None]
+        if best_of_family:
+            seen, picked = set(), []
+            for m in aml.leaderboard.sorted():
+                if m.algo_name == "stackedensemble":
+                    continue
+                if m.output.cv_holdout_predictions is None:
+                    continue
+                if m.algo_name not in seen:
+                    seen.add(m.algo_name)
+                    picked.append(m)
+            bases = picked
+        if len(bases) < 2:
+            return None
+        params = StackedEnsembleParameters(
+            training_frame=aml.training_frame, response_column=aml.y,
+            base_models=bases, seed=aml.seed if aml.seed is not None else -1)
+        return [StackedEnsemble(params).train_model()]
+    return make
+
+
+# ---------------------------------------------------------------------------
+# The orchestrator (h2o-py `H2OAutoML` surface over `AutoML.java`)
+# ---------------------------------------------------------------------------
+class H2OAutoML(Keyed):
+    def __init__(self, max_models: int = 0, max_runtime_secs: float = 0.0,
+                 max_runtime_secs_per_model: float = 0.0, nfolds: int = 5,
+                 seed: int | None = None, project_name: str | None = None,
+                 include_algos: list | None = None,
+                 exclude_algos: list | None = None,
+                 sort_metric: str | None = None,
+                 stopping_rounds: int = 3, stopping_tolerance: float = 1e-3,
+                 stopping_metric: str = "AUTO",
+                 keep_cross_validation_predictions: bool = True,
+                 modeling_plan: list | None = None):
+        super().__init__(key=project_name, prefix="automl")
+        if not max_models and not max_runtime_secs:
+            max_runtime_secs = 3600.0  # the reference's default total budget
+        self.max_models = max_models
+        self.max_runtime_secs = max_runtime_secs
+        self.max_runtime_secs_per_model = max_runtime_secs_per_model
+        self.nfolds = nfolds
+        self.seed = seed
+        self.include_algos = include_algos
+        self.exclude_algos = exclude_algos or []
+        self.sort_metric = sort_metric
+        self.stopping_rounds = stopping_rounds
+        self.stopping_tolerance = stopping_tolerance
+        self.stopping_metric = stopping_metric
+        self.keep_cv_preds = keep_cross_validation_predictions
+        self.plan = modeling_plan or _default_plan()
+        self.event_log = EventLog()
+        self.leaderboard: Leaderboard | None = None
+        self.training_frame: Frame | None = None
+        self.y: str | None = None
+        self._t0 = None
+        self.job: Job | None = None
+        STORE.put_keyed(self)
+
+    # -- budget helpers ------------------------------------------------------
+    def _remaining_budget(self) -> float | None:
+        if not self.max_runtime_secs:
+            return None
+        return max(0.0, self.max_runtime_secs - (time.time() - self._t0))
+
+    def _model_count(self) -> int:
+        """Base-model count — Stacked Ensembles don't count toward max_models
+        (the reference's `max_models` contract)."""
+        if not self.leaderboard:
+            return 0
+        return sum(1 for m in self.leaderboard.models
+                   if m.algo_name != "stackedensemble")
+
+    def _budget_exhausted(self, step: "Step | None" = None) -> bool:
+        is_se = step is not None and step.algo == "StackedEnsemble"
+        if not is_se and self.max_models and self._model_count() >= self.max_models:
+            return True
+        rem = self._remaining_budget()
+        return rem is not None and rem <= 0
+
+    def _common_params(self) -> dict:
+        return dict(training_frame=self.training_frame, response_column=self.y,
+                    nfolds=self.nfolds,
+                    keep_cross_validation_predictions=self.keep_cv_preds,
+                    fold_assignment="Modulo",  # shared folds → stackable
+                    seed=self.seed if self.seed is not None else -1,
+                    max_runtime_secs=self.max_runtime_secs_per_model,
+                    stopping_rounds=self.stopping_rounds,
+                    stopping_tolerance=self.stopping_tolerance,
+                    stopping_metric=self.stopping_metric)
+
+    def _algo_allowed(self, algo: str) -> bool:
+        fam = {"XRT": "DRF"}.get(algo, algo)
+        if self.include_algos is not None:
+            return fam in self.include_algos or algo in self.include_algos
+        return fam not in self.exclude_algos and algo not in self.exclude_algos
+
+    # -- train (the h2o-py surface) ------------------------------------------
+    def train(self, y: str | None = None, training_frame: Frame | None = None,
+              **kw) -> "H2OAutoML":
+        if training_frame is None or y is None:
+            raise ValueError("y and training_frame are required")
+        self.training_frame = training_frame
+        self.y = y
+        resp = training_frame.vec(y)
+        if resp.is_categorical():
+            category = "Binomial" if len(resp.domain) == 2 else "Multinomial"
+        else:
+            category = "Regression"
+        self.leaderboard = Leaderboard(category, self.sort_metric)
+        self._t0 = time.time()
+        log = self.event_log
+        log.log("Workflow", f"AutoML build started: {self.key}")
+        self.job = Job("AutoML", work=float(len(self.plan)))
+
+        for step in self.plan:
+            if self._budget_exhausted(step):
+                log.log("Workflow", f"budget exhausted; skipping {step.algo}_{step.id}")
+                continue  # later SE steps may still run (don't count to max_models)
+            if not self._algo_allowed(step.algo):
+                log.log("ModelBuilding", f"skipping {step.algo} ({step.id}): excluded")
+                continue
+            label = f"{step.algo}_{step.id}"
+            log.log("ModelBuilding", f"starting {label}")
+            try:
+                models = step.make(self)
+            except Exception as e:
+                log.log("ModelBuilding", f"{label} failed: {e!r}", level="Warn")
+                models = None
+            for m in models or []:
+                self.leaderboard.add(m)
+                log.log("ModelBuilding",
+                        f"{label} -> {m.key} "
+                        f"({self.leaderboard.sort_metric}="
+                        f"{self.leaderboard._metric(m, self.leaderboard.sort_metric)})")
+            self.job.update(1.0)
+        log.log("Workflow",
+                f"AutoML build done: {self._model_count()} models, "
+                f"leader={self.leader.key if self.leader else None}")
+        return self
+
+    # -- results -------------------------------------------------------------
+    @property
+    def leader(self):
+        return self.leaderboard.leader if self.leaderboard else None
+
+    def predict(self, fr: Frame) -> Frame:
+        if self.leader is None:
+            raise ValueError("no models trained")
+        return self.leader.predict(fr)
+
+    def get_leaderboard(self) -> Frame:
+        return self.leaderboard.as_frame()

@@ -176,12 +176,48 @@ class GBM(ModelBuilder):
             y_k = y
             f = jnp.full_like(y, f0, dtype=jnp.float32)
 
-        base_seed = p.seed if p.seed not in (-1, None) else 1234
-        all_keys = jax.random.split(jax.random.PRNGKey(base_seed), p.ntrees)
+        # checkpoint restart (`hex/tree/SharedTree.java:146,243,470`): resume
+        # the boosting sequence from a prior model's carried link predictions.
+        prior = None
+        prior_parts = []
+        if p.checkpoint is not None:
+            prior = self._resolve_checkpoint(p.checkpoint)
+            if p.ntrees <= prior.ntrees:
+                raise ValueError(
+                    f"checkpoint model already has {prior.ntrees} trees; "
+                    f"ntrees must exceed that (got {p.ntrees})")
+            # parameter-compatibility validation, up front (the reference
+            # validates before training, `SharedTree` checkpoint checks)
+            for fld, ours, theirs in (
+                    ("max_depth", p.max_depth, prior.cfg.max_depth),
+                    ("nbins", p.nbins, prior.cfg.nbins),
+                    ("nclasses", K, prior.cfg.nclass),
+                    ("drf_mode", self.drf_mode, prior.cfg.drf_mode)):
+                if ours != theirs:
+                    raise ValueError(
+                        f"checkpoint incompatible: {fld} differs "
+                        f"(checkpoint={theirs}, request={ours})")
+            # the stored params reference the prior by key, not by object —
+            # keeps binary export/import free of nested models/frames
+            p = self.params = dataclasses.replace(p, checkpoint=prior.key)
+            f0 = prior.f0
+            fprev = prior._raw_f(X)  # includes f0, link scale
+            f = fprev.T.astype(jnp.float32) if K > 1 else fprev.astype(jnp.float32)
+            if self.drf_mode:
+                # _raw_f averages DRF trees; the carried f is the raw sum
+                f = f * prior.ntrees
+            prior_parts = [tuple(prior.forest[k] for k in
+                                 ("feat", "thr", "nanL", "val", "gain"))]
 
-        interval = p.score_tree_interval or p.ntrees
-        interval = min(interval, p.ntrees)
-        chunks = [all_keys[i:i + interval] for i in range(0, p.ntrees, interval)]
+        n_prior = prior.ntrees if prior else 0
+        n_new = p.ntrees - n_prior
+        base_seed = p.seed if p.seed not in (-1, None) else 1234
+        all_keys = jax.random.split(jax.random.PRNGKey(base_seed),
+                                    p.ntrees)[n_prior:]
+
+        interval = p.score_tree_interval or n_new
+        interval = min(interval, n_new)
+        chunks = [all_keys[i:i + interval] for i in range(0, n_new, interval)]
 
         output = ModelOutput()
         output.names = names
@@ -189,7 +225,7 @@ class GBM(ModelBuilder):
         output.response_domain = list(resp_domain) if resp_domain else None
         output.model_category = category
 
-        parts = []
+        parts = list(prior_parts)
         history = []
         import time as _t
 
@@ -205,19 +241,53 @@ class GBM(ModelBuilder):
                              None if p.weights_column is None else w)
             history.append({"timestamp": _t.time(), "number_of_trees": ntrees_done,
                             "training_metrics": m})
-            job.update(len(keys) / p.ntrees)
+            job.update(len(keys) / max(n_new, 1))
+            if p.export_checkpoints_dir:
+                self._export_snapshot(p, output, parts, f0, dist, cfg, is_cat,
+                                      ntrees_done, m)
             if self._should_stop(m, stop_metric_series):
                 break
         output.scoring_history = history
         output.training_metrics = history[-1]["training_metrics"]
 
-        forest = {k: jnp.concatenate([t[i] for t in parts], axis=0)
-                  for i, k in enumerate(("feat", "thr", "nanL", "val", "gain"))}
+        forest = _assemble_forest(parts)
         output.variable_importances = self._varimp(forest, names)
         model = GBMModel(p, output, forest, f0, dist, cfg, is_cat)
         if p.validation_frame is not None:
             output.validation_metrics = model.model_performance(p.validation_frame)
         return model
+
+    @staticmethod
+    def _resolve_checkpoint(cp) -> "GBMModel":
+        from ..backend.kvstore import STORE
+
+        prior = STORE.get(cp) if isinstance(cp, str) else cp
+        if prior is None:
+            raise ValueError(f"checkpoint model '{cp}' not found")
+        return prior
+
+    def _export_snapshot(self, p, output, parts, f0, dist, cfg, is_cat,
+                         ntrees_done, metrics):
+        """In-training checkpoint to disk every scoring interval
+        (`hex/tree/SharedTree.java:164,202-204,515` _in_training_checkpoints)."""
+        import os
+
+        from ..backend.kvstore import STORE
+        from ..backend.persist import save_model
+
+        forest = _assemble_forest(parts)
+        snap_out = ModelOutput()
+        snap_out.__dict__.update(output.__dict__)
+        snap_out.training_metrics = metrics
+        snap = GBMModel(p, snap_out, forest, f0, dist, cfg, is_cat,
+                        key=f"{self.algo_name}_checkpoint_snapshot")
+        try:
+            os.makedirs(p.export_checkpoints_dir, exist_ok=True)
+            save_model(snap, os.path.join(
+                p.export_checkpoints_dir,
+                f"{self.algo_name}_{ntrees_done:05d}.bin"))
+        finally:
+            STORE.remove(snap.key, cascade=False)
 
     def _make_grad_fn(self, dist, K):
         if K == 1:
@@ -278,6 +348,12 @@ class GBM(ModelBuilder):
             "scaled_importance": rel[order],
             "percentage": (imp / imp.sum())[order],
         }
+
+
+def _assemble_forest(parts) -> dict:
+    """Stack per-chunk tree arrays into the model's forest dict."""
+    return {k: jnp.concatenate([t[i] for t in parts], axis=0)
+            for i, k in enumerate(("feat", "thr", "nanL", "val", "gain"))}
 
 
 def _metrics_raw(category, dist, f, drf_mode, ntrees):

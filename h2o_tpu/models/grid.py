@@ -14,6 +14,7 @@ noted as a follow-up in SURVEY.md §7.6f).
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -94,11 +95,15 @@ class GridSearch:
     """`water/api/GridSearchHandler` + HyperSpaceWalker orchestration."""
 
     def __init__(self, builder_cls, params, hyper_params: dict,
-                 search_criteria: SearchCriteria | None = None):
+                 search_criteria: SearchCriteria | None = None,
+                 recovery_dir: str | None = None):
         self.builder_cls = builder_cls
         self.base_params = params
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.criteria = search_criteria or SearchCriteria()
+        self.recovery_dir = recovery_dir
+        self._recovered_models: list = []
+        self._recovered_done: list = []
 
     def _walk(self):
         names = list(self.hyper_params)
@@ -113,7 +118,10 @@ class GridSearch:
 
     def train(self, background: bool = False) -> "Grid | Job":
         grid = Grid(self.builder_cls, list(self.hyper_params))
+        grid.models.extend(self._recovered_models)
         job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
+        rec = self._init_recovery() if self.recovery_dir else None
+        done = list(self._recovered_done)
 
         def run():
             t0 = time.time()
@@ -125,10 +133,15 @@ class GridSearch:
                     break
                 if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
                     break
+                key = _combo_key(overrides)
+                if key in self._recovered_done:
+                    continue  # already trained before the crash
                 try:
                     params = self.base_params.clone(**overrides)
                     m = self.builder_cls(params).train_model()
                     grid.models.append(m)
+                    if rec is not None:
+                        self._record(rec, done, key, m, len(grid.models) - 1)
                     if c.stopping_rounds > 0 and self._early_stop(grid, scores, c):
                         break
                 except Exception as e:  # failed combos are recorded, not fatal
@@ -138,6 +151,78 @@ class GridSearch:
 
         job.start(run, background=background)
         return job if background else job.join()
+
+    # -- auto-recovery (`hex/faulttolerance/Recovery.java`) -------------------
+    def _init_recovery(self):
+        import pickle
+
+        from ..backend.persist import Recovery
+
+        rec = Recovery(self.recovery_dir)
+        if rec.read() is None:
+            import dataclasses
+
+            from ..backend.persist import save_frame
+            from ..frame.frame import Frame
+
+            frame_fields = [f.name for f in dataclasses.fields(self.base_params)
+                            if isinstance(getattr(self.base_params, f.name), Frame)]
+            for fname in frame_fields:  # training, validation, blending, ...
+                save_frame(getattr(self.base_params, fname),
+                           os.path.join(self.recovery_dir, f"frame_{fname}.npz"))
+            spec = {"builder_module": self.builder_cls.__module__,
+                    "builder_name": self.builder_cls.__name__,
+                    "hyper_params": self.hyper_params,
+                    "criteria": self.criteria.__dict__,
+                    "frame_fields": frame_fields,
+                    "done": [], "models": []}
+            params = dataclasses.replace(self.base_params,
+                                         **{f: None for f in frame_fields})
+            with open(f"{self.recovery_dir}/base_params.pkl", "wb") as fh:
+                pickle.dump(params, fh)
+            rec.write(spec)
+        return rec
+
+    def _record(self, rec, done, key, model, idx):
+        from ..backend.persist import save_model
+
+        save_model(model, rec.model_path(idx))
+        done.append(key)
+        manifest = rec.read()
+        manifest["done"] = done
+        manifest["models"] = manifest.get("models", []) + [rec.model_path(idx)]
+        rec.write(manifest)
+
+    @classmethod
+    def resume(cls, recovery_dir: str) -> "GridSearch":
+        """Rebuild a GridSearch from a recovery dir after a crash; trained
+        models are reloaded and their hyperparameter combos skipped — the
+        reference's grid auto-resume (`test_grid_auto_recover.py:50-62`)."""
+        import pickle
+
+        from ..backend.persist import Recovery, load_frame, load_model
+
+        rec = Recovery(recovery_dir)
+        manifest = rec.read()
+        if manifest is None:
+            raise ValueError(f"no recovery manifest in {recovery_dir}")
+        import importlib
+
+        builder_cls = getattr(
+            importlib.import_module(manifest["builder_module"]),
+            manifest["builder_name"])
+        with open(f"{recovery_dir}/base_params.pkl", "rb") as fh:
+            params = pickle.load(fh)
+        for fname in manifest.get("frame_fields", ["training_frame"]):
+            setattr(params, fname, load_frame(
+                os.path.join(recovery_dir, f"frame_{fname}.npz")))
+        gs = cls(builder_cls, params, manifest["hyper_params"],
+                 SearchCriteria(**manifest["criteria"]),
+                 recovery_dir=recovery_dir)
+        gs._recovered_done = list(manifest["done"])
+        gs._recovered_models = [load_model(p) for p in manifest.get("models", [])]
+        return gs
+
 
     def _early_stop(self, grid: Grid, scores: list, c: SearchCriteria) -> bool:
         metric, decr = _sort_metric(grid.models[0],
@@ -154,3 +239,7 @@ class GridSearch:
         if len(scores) <= k:
             return False
         return min(scores[-k:]) > min(scores[:-k]) * (1 - c.stopping_tolerance)
+
+
+def _combo_key(overrides: dict) -> str:
+    return repr(sorted(overrides.items()))

@@ -1,0 +1,207 @@
+"""Infogram — admissible machine learning (AdmissibleML).
+
+Analog of `h2o-admissibleml/` (2,719 LoC, `hex/Infogram/Infogram.java`,
+`EstimateCMI.java`, `InfogramUtils.java`). Two modes:
+
+- **core infogram** (no protected columns): for each top-K predictor xⱼ train a
+  probe model on all predictors EXCEPT xⱼ, plus one full model; raw CMI is the
+  mean log-probability of the true class (`EstimateCMI.java:31-35`), and
+  ``cmi_raw[j] = max(0, full − without_j)`` — the information lost by dropping
+  xⱼ (`InfogramUtils.java:213-228` calculateFinalCMI, buildCore branch).
+  Relevance = the full model's variable importance.
+- **fair/safety infogram** (protected columns given): probe models are
+  {protected + xⱼ} vs protected-only; ``cmi_raw[j] = max(0, with_j −
+  protected_only)`` — the information xⱼ adds beyond the protected attributes
+  (`Infogram.java:540-556` frame construction). Relevance comes from a model on
+  all non-protected predictors.
+
+Both axes are normalized to max=1; predictors are *admissible* when both
+exceed their thresholds (`net_information_threshold` /
+`total_information_threshold`, default 0.1).
+
+Probe models are GBMs by default (`infogram_algorithm`); each probe saturates
+the mesh, so probes run as a host loop like the reference's parallel builder.
+Regression responses use the mean Gaussian log-density (−½log(2πe·MSE)) in
+place of log p(class) — a documented divergence (the reference's estimator is
+classification-only in practice).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .model_base import Model, ModelBuilder, ModelOutput, Parameters
+
+
+@dataclass
+class InfogramParameters(Parameters):
+    protected_columns: list = field(default_factory=list)
+    infogram_algorithm: str = "gbm"          # gbm | drf | glm | deeplearning
+    infogram_algorithm_params: dict = field(default_factory=dict)
+    top_n_features: int = 50
+    net_information_threshold: float = 0.1   # CMI axis (safety index in fair mode)
+    total_information_threshold: float = 0.1  # relevance axis
+    data_fraction: float = 1.0
+
+
+def _mean_log_prob(model, fr: Frame, response: str) -> float:
+    """EstimateCMI analog: (1/n)·Σ log p̂(yᵢ) over scorable rows."""
+    pred = model.predict(fr)
+    y = fr.vec(response).to_numpy()
+    ok = ~np.isnan(y)
+    if model.output.model_category in ("Binomial", "Multinomial"):
+        probs = np.stack([pred.vec(j).to_numpy()
+                          for j in range(1, pred.ncol)], axis=1)
+        yi = y[ok].astype(np.int64)
+        p = probs[ok, yi]
+        p = np.clip(p, 1e-10, 1.0)
+        return float(np.mean(np.log(p)))
+    mse = float(np.mean((pred.vec(0).to_numpy()[ok] - y[ok]) ** 2))
+    return -0.5 * math.log(2 * math.pi * math.e * max(mse, 1e-12))
+
+
+class InfogramModel(Model):
+    algo_name = "infogram"
+
+    def __init__(self, params, output, key=None):
+        super().__init__(params, output, key=key)
+        self.admissible_features: list[str] = []
+        self.cmi: dict[str, float] = {}
+        self.relevance: dict[str, float] = {}
+        self.cmi_raw: dict[str, float] = {}
+
+    def get_admissible_score_frame(self) -> Frame:
+        """c1:column c2:admissible c3:admissible_index c4:relevance c5:cmi
+        c6:cmi_raw (`InfogramUtils.java:194`)."""
+        names = list(self.cmi)
+        rel = np.array([self.relevance[n] for n in names])
+        cmi = np.array([self.cmi[n] for n in names])
+        adm = np.array([1.0 if n in self.admissible_features else 0.0
+                        for n in names])
+        # admissible_index: distance from the ideal (1,1) corner, scaled
+        idx = 1.0 - np.sqrt(((1 - rel) ** 2 + (1 - cmi) ** 2) / 2.0)
+        order = np.argsort(-idx)
+        cols = {
+            "column": Vec(None, len(names), type="string",
+                          host_data=np.asarray([names[i] for i in order],
+                                               dtype=object)),
+            "admissible": Vec.from_numpy(adm[order]),
+            "admissible_index": Vec.from_numpy(idx[order].astype(np.float32)),
+            "relevance": Vec.from_numpy(rel[order].astype(np.float32)),
+            "cmi": Vec.from_numpy(cmi[order].astype(np.float32)),
+            "cmi_raw": Vec.from_numpy(
+                np.array([self.cmi_raw[names[i]] for i in order],
+                         dtype=np.float32)),
+        }
+        return Frame(list(cols), list(cols.values()))
+
+    def score0(self, X):
+        raise NotImplementedError("Infogram produces an admissibility analysis, "
+                                  "not row scores")
+
+    def predict(self, fr):
+        raise NotImplementedError("use get_admissible_score_frame()")
+
+
+class Infogram(ModelBuilder):
+    algo_name = "infogram"
+
+    def _probe_builder(self):
+        from . import deeplearning, drf, gbm, glm
+
+        name = (self.params.infogram_algorithm or "gbm").lower()
+        table = {"gbm": (gbm.GBM, gbm.GBMParameters),
+                 "drf": (drf.DRF, drf.DRFParameters),
+                 "glm": (glm.GLM, glm.GLMParameters),
+                 "deeplearning": (deeplearning.DeepLearning,
+                                  deeplearning.DeepLearningParameters)}
+        if name not in table:
+            raise ValueError(f"unsupported infogram_algorithm '{name}'")
+        return table[name]
+
+    def _train_probe(self, feats: list[str]) -> Model:
+        p = self.params
+        cls, pcls = self._probe_builder()
+        import dataclasses as dc
+
+        valid = {f.name for f in dc.fields(pcls)}
+        over = {k: v for k, v in p.infogram_algorithm_params.items()
+                if k in valid}
+        if "ntrees" in valid:
+            over.setdefault("ntrees", 10)
+            over.setdefault("max_depth", 5)
+        ignored = [n for n in p.training_frame.names
+                   if n not in feats and n != p.response_column]
+        params = pcls(training_frame=p.training_frame,
+                      response_column=p.response_column,
+                      ignored_columns=ignored,
+                      seed=p.seed, **over)
+        return cls(params).build_impl(Job("infogram probe", work=1.0))
+
+    def build_impl(self, job: Job) -> InfogramModel:
+        p: InfogramParameters = self.params
+        fr = p.training_frame
+        protected = list(p.protected_columns or [])
+        build_core = not protected  # `Infogram.java:182`
+        feats = [n for n in self.feature_names() if n not in protected]
+
+        # full / relevance model on all (non-protected) predictors
+        full = self._train_probe(feats)
+        vi = full.output.variable_importances
+        rel_raw = {n: 0.0 for n in feats}
+        if vi:
+            for n, v in zip(vi["variable"], vi["relative_importance"]):
+                base = n.split(".")[0]  # one-hot expanded names fold back
+                if base in rel_raw:
+                    rel_raw[base] += float(v)
+        max_rel = max(rel_raw.values()) or 1.0
+        relevance = {n: v / max_rel for n, v in rel_raw.items()}
+
+        # top-K by relevance (`extractTopKPredictors`)
+        k = min(p.top_n_features, len(feats))
+        top = sorted(feats, key=lambda n: -relevance[n])[:k]
+
+        if build_core:
+            base_cmi = _mean_log_prob(full, fr, p.response_column)
+        else:
+            protected_only = self._train_probe(protected)
+            base_cmi = _mean_log_prob(protected_only, fr, p.response_column)
+
+        cmi_raw = {}
+        for j, name in enumerate(top):
+            job.check_cancelled()
+            if build_core:
+                probe = self._train_probe([n for n in top if n != name])
+                raw = max(0.0, base_cmi - _mean_log_prob(probe, fr,
+                                                         p.response_column))
+            else:
+                probe = self._train_probe(protected + [name])
+                raw = max(0.0, _mean_log_prob(probe, fr, p.response_column)
+                          - base_cmi)
+            cmi_raw[name] = raw
+            job.update(1.0 / max(len(top), 1))
+
+        max_cmi = max(cmi_raw.values()) if cmi_raw else 0.0
+        scale = 1.0 / max_cmi if max_cmi > 0 else 0.0
+        cmi = {n: v * scale for n, v in cmi_raw.items()}
+
+        out = ModelOutput()
+        out.model_category = "Infogram"
+        out.names = top
+        out.domains = {n: fr.vec(n).domain for n in top}
+        model = InfogramModel(p, out)
+        model.cmi_raw = cmi_raw
+        model.cmi = cmi
+        model.relevance = {n: relevance[n] for n in top}
+        model.admissible_features = [
+            n for n in top
+            if cmi[n] >= p.net_information_threshold
+            and relevance[n] >= p.total_information_threshold]
+        model.output.variable_importances = vi
+        return model

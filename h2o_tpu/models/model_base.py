@@ -63,6 +63,8 @@ class Parameters:
     stopping_rounds: int = 0
     stopping_metric: str = "AUTO"
     stopping_tolerance: float = 1e-3
+    checkpoint: Any = None          # prior model (or its key) to continue from
+    export_checkpoints_dir: Optional[str] = None  # in-training snapshots
 
     def clone(self, **overrides):
         return dataclasses.replace(self, **overrides)
@@ -152,6 +154,12 @@ class Model(Keyed):
 
     def auc(self):
         return getattr(self.output.training_metrics, "auc", None)
+
+    # -- binary export/import (`hex/Model.java` exportBinaryModel) ------------
+    def save(self, path: str) -> str:
+        from ..backend.persist import save_model
+
+        return save_model(self, path)
 
     # -- export (`hex/ModelMojoWriter.java` hook) -----------------------------
     def save_mojo(self, path: str) -> str:

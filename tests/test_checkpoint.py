@@ -1,0 +1,137 @@
+"""Checkpoint/resume + fault tolerance: binary model export/import, frame
+save/load, GBM checkpoint continuation, in-training snapshots, grid recovery."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from h2o_tpu.backend.kvstore import STORE
+from h2o_tpu.backend.persist import load_frame, load_model, save_frame, save_model
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.models.glm import GLM, GLMParameters
+from h2o_tpu.models.grid import GridSearch, SearchCriteria
+
+
+def _frame(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(2 * x1 - x2)))).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["no", "yes"]))
+    return fr
+
+
+def test_frame_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    fr = Frame.from_dict({"num": rng.normal(size=20).astype(np.float32)})
+    fr.add("cat", Vec.from_numpy(np.array([0, 1] * 10, np.float32), type=T_CAT,
+                                 domain=["a", "b"]))
+    fr.add("s", Vec(None, 20, type="string",
+                    host_data=np.asarray(["t%d" % i for i in range(19)] + [None],
+                                         dtype=object)))
+    p = save_frame(fr, str(tmp_path / "fr"))
+    fr2 = load_frame(p)
+    assert fr2.nrow == 20 and fr2.names == fr.names
+    assert np.allclose(fr2.vec("num").to_numpy(), fr.vec("num").to_numpy())
+    assert fr2.vec("cat").domain == ["a", "b"]
+    assert fr2.vec("s").host_data[0] == "t0" and fr2.vec("s").host_data[19] is None
+
+
+def test_model_binary_roundtrip_scores_identically(tmp_path):
+    fr = _frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=5,
+                          max_depth=3, seed=7)).train_model()
+    path = m.save(str(tmp_path / "gbm.bin"))
+    before = m.predict(fr).vec(2).to_numpy()
+    STORE.remove(m.key)
+    m2 = load_model(path)
+    assert m2.params.training_frame is None  # frames are stripped
+    after = m2.predict(fr).vec(2).to_numpy()
+    assert np.allclose(before, after, atol=1e-6)
+    assert m2.ntrees == 5
+
+
+def test_gbm_checkpoint_matches_uninterrupted_run():
+    fr = _frame()
+    full = GBM(GBMParameters(training_frame=fr, response_column="y",
+                             ntrees=10, max_depth=3, seed=11)).train_model()
+    first = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=4, max_depth=3, seed=11)).train_model()
+    cont = GBM(GBMParameters(training_frame=fr, response_column="y",
+                             ntrees=10, max_depth=3, seed=11,
+                             checkpoint=first)).train_model()
+    assert cont.ntrees == 10
+    pf = full.predict(fr).vec(2).to_numpy()
+    pc = cont.predict(fr).vec(2).to_numpy()
+    # same seed → same tree key sequence → near-identical forests
+    assert np.allclose(pf, pc, atol=1e-4)
+
+
+def test_gbm_checkpoint_rejects_fewer_trees():
+    fr = _frame(n=200)
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=5,
+                          max_depth=3, seed=1)).train_model()
+    with pytest.raises(ValueError, match="ntrees must exceed"):
+        GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=5,
+                          max_depth=3, seed=1, checkpoint=m)).train_model()
+
+
+def test_gbm_checkpoint_rejects_incompatible_depth():
+    fr = _frame(n=200)
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=3,
+                          max_depth=3, seed=1)).train_model()
+    with pytest.raises(ValueError, match="max_depth differs"):
+        GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=6,
+                          max_depth=4, seed=1, checkpoint=m)).train_model()
+
+
+def test_checkpointed_model_saves_without_prior_object(tmp_path):
+    fr = _frame(n=200)
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=3,
+                          max_depth=3, seed=1)).train_model()
+    cont = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=6,
+                             max_depth=3, seed=1, checkpoint=m)).train_model()
+    assert cont.params.checkpoint == m.key  # key, not the model object
+    path = cont.save(str(tmp_path / "cont.bin"))
+    m2 = load_model(path)
+    assert m2.ntrees == 6
+
+
+def test_in_training_checkpoint_exports(tmp_path):
+    fr = _frame(n=200)
+    d = str(tmp_path / "cps")
+    GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=6,
+                      max_depth=3, seed=1, score_tree_interval=2,
+                      export_checkpoints_dir=d)).train_model()
+    snaps = sorted(glob.glob(os.path.join(d, "gbm_*.bin")))
+    assert len(snaps) == 3  # one per scoring interval
+    snap = load_model(snaps[0])
+    assert snap.ntrees == 2
+    assert snap.predict(fr).nrow == fr.nrow
+
+
+def test_grid_auto_recovery(tmp_path):
+    fr = _frame(n=300)
+    d = str(tmp_path / "rec")
+    valid = _frame(n=100, seed=9)
+    base = GLMParameters(training_frame=fr, response_column="y",
+                         validation_frame=valid, family="binomial")
+    hyper = {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.0, 0.01]}
+    # "crash" after 2 models (budget-limited first run)
+    g1 = GridSearch(GLM, base, hyper,
+                    SearchCriteria(max_models=2), recovery_dir=d).train()
+    assert g1.model_count == 2
+    # fresh process analog: resume from disk, finish the walk
+    gs2 = GridSearch.resume(d)
+    assert len(gs2._recovered_models) == 2
+    assert gs2.base_params.validation_frame is not None  # all frames restored
+    gs2.criteria.max_models = 0  # lift the budget for the re-run
+    g2 = gs2.train()
+    assert g2.model_count == 6  # 2 recovered + 4 newly trained
+    # recovered models are scoreable
+    assert gs2._recovered_models[0].predict(fr).nrow == fr.nrow

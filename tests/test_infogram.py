@@ -1,0 +1,63 @@
+"""Infogram (AdmissibleML): core and fair modes."""
+
+import numpy as np
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.infogram import Infogram, InfogramParameters
+
+
+def _frame(n=400, seed=0):
+    """y depends strongly on x_signal, weakly on x_weak, not at all on x_noise;
+    x_proxy is a noisy copy of the protected attribute."""
+    rng = np.random.default_rng(seed)
+    prot = rng.integers(0, 2, n).astype(np.float32)
+    x_signal = rng.normal(size=n).astype(np.float32)
+    x_weak = rng.normal(size=n).astype(np.float32)
+    x_noise = rng.normal(size=n).astype(np.float32)
+    x_proxy = (prot + 0.1 * rng.normal(size=n)).astype(np.float32)
+    logit = 2.5 * x_signal + 0.4 * x_weak + 1.5 * prot
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    fr = Frame.from_dict({"x_signal": x_signal, "x_weak": x_weak,
+                          "x_noise": x_noise, "x_proxy": x_proxy})
+    fr.add("prot", Vec.from_numpy(prot, type=T_CAT, domain=["a", "b"]))
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["no", "yes"]))
+    return fr
+
+
+def test_core_infogram_ranks_signal_first():
+    fr = _frame()
+    p = InfogramParameters(training_frame=fr, response_column="y",
+                           ignored_columns=["prot"], seed=42)
+    m = Infogram(p).train_model()
+    sf = m.get_admissible_score_frame()
+    assert set(sf.names) >= {"column", "admissible", "relevance", "cmi", "cmi_raw"}
+    # the strong signal column must be admissible with top relevance and cmi
+    assert "x_signal" in m.admissible_features
+    assert m.relevance["x_signal"] == 1.0 or m.cmi["x_signal"] == 1.0
+    # pure noise should score near zero on both axes
+    assert m.cmi.get("x_noise", 0) < 0.5
+    assert m.relevance.get("x_noise", 0) < 0.3
+
+
+def test_fair_infogram_flags_proxy():
+    fr = _frame()
+    p = InfogramParameters(training_frame=fr, response_column="y",
+                           protected_columns=["prot"], seed=42)
+    m = Infogram(p).train_model()
+    # proxy of the protected column: little info beyond protected → low cmi
+    # signal column: lots of info beyond protected → high cmi
+    assert m.cmi["x_signal"] > m.cmi["x_proxy"]
+    assert "x_signal" in m.admissible_features
+
+
+def test_infogram_regression_mode_runs():
+    rng = np.random.default_rng(1)
+    n = 300
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = 3 * x1 + 0.1 * rng.normal(size=n).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y.astype(np.float32)})
+    m = Infogram(InfogramParameters(training_frame=fr, response_column="y",
+                                    seed=1)).train_model()
+    assert m.cmi["x1"] >= m.cmi["x2"]
