@@ -1,0 +1,34 @@
+"""REST API + client — the L9/L10 layers.
+
+``h2o_tpu.api`` doubles as the `h2o` module surface: ``import h2o_tpu.api as
+h2o; h2o.init(); h2o.import_file(...)`` mirrors the h2o-py entry points
+(`h2o-py/h2o/h2o.py`), backed by the in-process REST server (server.py).
+"""
+
+from .client import (H2OConnection, H2OConnectionError, H2OEstimator,
+                     H2OFrame, H2OModelClient, cluster_status, connect,
+                     connection, get_frame, get_model, import_file, init, ls,
+                     rapids, remove, shutdown, upload_frame)
+from .client import (H2OAdaBoostEstimator, H2OANOVAGLMEstimator,
+                     H2OAggregatorEstimator,
+                     H2OCoxProportionalHazardsEstimator,
+                     H2ODecisionTreeEstimator, H2ODeepLearningEstimator,
+                     H2OExtendedIsolationForestEstimator,
+                     H2OGeneralizedAdditiveEstimator,
+                     H2OGeneralizedLinearEstimator,
+                     H2OGeneralizedLowRankEstimator,
+                     H2OGradientBoostingEstimator, H2OInfogram,
+                     H2OIsolationForestEstimator,
+                     H2OIsotonicRegressionEstimator, H2OKMeansEstimator,
+                     H2OModelSelectionEstimator, H2ONaiveBayesEstimator,
+                     H2OPrincipalComponentAnalysisEstimator,
+                     H2ORandomForestEstimator, H2ORuleFitEstimator,
+                     H2OSingularValueDecompositionEstimator,
+                     H2OStackedEnsembleEstimator,
+                     H2OSupportVectorMachineEstimator,
+                     H2OTargetEncoderEstimator,
+                     H2OUpliftRandomForestEstimator, H2OWord2vecEstimator,
+                     H2OXGBoostEstimator)
+from .server import H2OServer
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,517 @@
+"""h2o-py-compatible client — the L10 surface (`h2o-py/h2o/h2o.py`,
+`frame.py`, `estimators/`) speaking this package's REST API.
+
+Mirrors the reference's client architecture: a connection object wrapping the
+versioned JSON endpoints (`h2o-py/h2o/backend/connection.py:249,431`), module
+functions (``init/connect/import_file/get_frame/remove/rapids/shutdown``), an
+``H2OFrame`` handle whose operations compile to Rapids expressions posted to
+`/99/Rapids` (`h2o-py/h2o/expr.py:27-44` — the reference batches them lazily;
+here each op evaluates eagerly, a deliberate divergence since the server is
+in-process and round-trips are free), and estimator classes over
+`/3/ModelBuilders/{algo}` (`h2o-py/h2o/estimators/`).
+
+``init()`` with no running server boots an in-process `H2OServer` — the analog
+of h2o.init() spawning a local JVM (`h2o-py/h2o/h2o.py:287`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+_conn = None
+
+
+class H2OConnectionError(Exception):
+    pass
+
+
+class H2OConnection:
+    """REST transport — `h2o-py/h2o/backend/connection.py` analog."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.session_id: str | None = None
+
+    def request(self, method: str, path: str, data: dict | None = None,
+                params: dict | None = None) -> dict:
+        url = f"{self.url}{path}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        body = None
+        headers = {}
+        if data is not None:
+            body = json.dumps(data).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode())
+                raise H2OConnectionError(payload.get("msg", str(e)))
+            except (ValueError, KeyError):
+                raise H2OConnectionError(str(e))
+        except urllib.error.URLError as e:
+            raise H2OConnectionError(f"no H2O server at {self.url}: {e}")
+
+    # session for rapids temp management
+    def session(self) -> str:
+        if self.session_id is None:
+            self.session_id = self.request("POST", "/3/InitID")["session_key"]
+        return self.session_id
+
+
+def connection() -> H2OConnection:
+    if _conn is None:
+        raise H2OConnectionError("not connected; call h2o.init() first")
+    return _conn
+
+
+# ---------------------------------------------------------------------------
+# module surface (`h2o-py/h2o/h2o.py`)
+# ---------------------------------------------------------------------------
+def init(url: str | None = None, port: int = 54321, name: str = "h2o_tpu",
+         strict_version_check: bool = False, **kw):
+    """Connect to a running server, else boot one in-process
+    (`h2o-py/h2o/h2o.py:137` connect-or-spawn)."""
+    global _conn
+    if url is None:
+        url = f"http://127.0.0.1:{port}"
+    try:
+        _conn = H2OConnection(url)
+        _conn.request("GET", "/3/Cloud")
+        return _conn
+    except H2OConnectionError:
+        pass
+    from .server import H2OServer
+
+    server = H2OServer(port=port, name=name).start()
+    _conn = H2OConnection(server.url)
+    _conn._server = server  # keep alive / allow shutdown
+    cluster_status()
+    return _conn
+
+
+def connect(url: str, **kw):
+    global _conn
+    _conn = H2OConnection(url)
+    _conn.request("GET", "/3/Cloud")
+    return _conn
+
+
+def cluster_status() -> dict:
+    return connection().request("GET", "/3/Cloud")
+
+
+def shutdown(prompt: bool = False):
+    global _conn
+    connection().request("POST", "/3/Shutdown")
+    _conn = None
+
+
+def _poll_job(job_json: dict) -> dict:
+    key = job_json["job"]["key"]["name"]
+    while True:
+        j = connection().request("GET", f"/3/Jobs/{key}")["jobs"][0]
+        if j["status"] == "DONE":
+            return j
+        if j["status"] == "FAILED":
+            raise RuntimeError(f"job failed: {j.get('exception')}\n"
+                               f"{j.get('stacktrace', '')}")
+        if j["status"] == "CANCELLED":
+            raise RuntimeError(f"job {key} was cancelled")
+        time.sleep(0.05)
+
+
+def import_file(path: str, destination_frame: str | None = None) -> "H2OFrame":
+    """`h2o.import_file` — ImportFiles → ParseSetup → Parse → poll job."""
+    c = connection()
+    imp = c.request("GET", "/3/ImportFiles", params={"path": path})
+    if imp["fails"]:
+        raise FileNotFoundError(f"import failed for {imp['fails']}")
+    setup = c.request("POST", "/3/ParseSetup",
+                      data={"source_frames": imp["files"]})
+    dest = destination_frame or setup["destination_frame"]
+    job = c.request("POST", "/3/Parse",
+                    data={"source_frames": imp["files"],
+                          "destination_frame": dest})
+    done = _poll_job(job)
+    return H2OFrame._by_id(done["dest"]["name"])
+
+
+def upload_frame(python_obj, destination_frame: str | None = None) -> "H2OFrame":
+    """Build a frame from a dict/pandas object via a temp CSV round-trip —
+    the h2o.H2OFrame(python_obj) upload path."""
+    import os
+    import tempfile
+
+    import pandas as pd
+
+    df = python_obj if isinstance(python_obj, pd.DataFrame) \
+        else pd.DataFrame(python_obj)
+    fd, tmp = tempfile.mkstemp(suffix=".csv")
+    os.close(fd)
+    try:
+        df.to_csv(tmp, index=False)
+        return import_file(tmp, destination_frame=destination_frame)
+    finally:
+        os.unlink(tmp)
+
+
+def ls() -> list[str]:
+    frames = connection().request("GET", "/3/Frames")["frames"]
+    return [f["frame_id"]["name"] for f in frames]
+
+
+def get_frame(frame_id: str) -> "H2OFrame":
+    return H2OFrame._by_id(frame_id)
+
+
+def get_model(model_id: str) -> "H2OModelClient":
+    j = connection().request("GET", f"/3/Models/{urllib.parse.quote(model_id)}")
+    return H2OModelClient(model_id, j["models"][0])
+
+
+def remove(key: str):
+    c = connection()
+    try:
+        c.request("DELETE", f"/3/Frames/{urllib.parse.quote(key)}")
+    except H2OConnectionError:
+        c.request("DELETE", f"/3/Models/{urllib.parse.quote(key)}")
+
+
+def rapids(expr: str) -> dict:
+    c = connection()
+    return c.request("POST", "/99/Rapids",
+                     data={"ast": expr, "session_id": c.session()})
+
+
+# ---------------------------------------------------------------------------
+# H2OFrame handle (`h2o-py/h2o/frame.py`)
+# ---------------------------------------------------------------------------
+class H2OFrame:
+    def __init__(self, python_obj=None, destination_frame: str | None = None):
+        if python_obj is not None:
+            other = upload_frame(python_obj, destination_frame)
+            self.frame_id = other.frame_id
+            self._schema = other._schema
+        else:
+            self.frame_id = None
+            self._schema = None
+
+    @classmethod
+    def _by_id(cls, frame_id: str) -> "H2OFrame":
+        fr = cls()
+        fr.frame_id = frame_id
+        return fr
+
+    # -- metadata ------------------------------------------------------------
+    def _summary(self) -> dict:
+        if self._schema is None:
+            self._schema = connection().request(
+                "GET", f"/3/Frames/{urllib.parse.quote(self.frame_id)}/summary"
+            )["frames"][0]
+        return self._schema
+
+    def refresh(self):
+        self._schema = None
+
+    @property
+    def nrow(self) -> int:
+        return self._summary()["rows"]
+
+    @property
+    def ncol(self) -> int:
+        return self._summary()["num_columns"]
+
+    @property
+    def columns(self) -> list[str]:
+        return [c["label"] for c in self._summary()["columns"]]
+
+    names = columns
+
+    @property
+    def types(self) -> dict:
+        return {c["label"]: c["type"] for c in self._summary()["columns"]}
+
+    def __len__(self):
+        return self.nrow
+
+    # -- rapids-backed ops ---------------------------------------------------
+    def _exec(self, expr: str) -> "H2OFrame | float | str":
+        res = rapids(expr)
+        if res.get("key"):
+            return H2OFrame._by_id(res["key"]["name"])
+        if res.get("scalar") is not None:
+            return res["scalar"]
+        if res.get("values") is not None:
+            return res["values"]
+        return res.get("string")
+
+    def _quoted(self) -> str:
+        return self.frame_id
+
+    def __getitem__(self, sel):
+        if isinstance(sel, str):
+            return self._exec(f"(cols {self.frame_id} '{sel}')")
+        if isinstance(sel, int):
+            return self._exec(f"(cols {self.frame_id} {sel})")
+        if isinstance(sel, list):
+            inner = " ".join(f"'{s}'" if isinstance(s, str) else str(s)
+                             for s in sel)
+            return self._exec(f"(cols {self.frame_id} [{inner}])")
+        if isinstance(sel, H2OFrame):  # boolean mask frame
+            return self._exec(f"(rows {self.frame_id} (cols {sel.frame_id} 0))")
+        raise TypeError(f"bad selector {sel!r}")
+
+    def _binop(self, op, other, reverse=False):
+        rhs = other.frame_id if isinstance(other, H2OFrame) else repr(float(other))
+        lhs = self.frame_id
+        if reverse:
+            lhs, rhs = rhs, lhs
+        return self._exec(f"({op} {lhs} {rhs})")
+
+    def __add__(self, o):
+        return self._binop("+", o)
+
+    def __radd__(self, o):
+        return self._binop("+", o, True)
+
+    def __sub__(self, o):
+        return self._binop("-", o)
+
+    def __mul__(self, o):
+        return self._binop("*", o)
+
+    def __truediv__(self, o):
+        return self._binop("/", o)
+
+    def __gt__(self, o):
+        return self._binop(">", o)
+
+    def __ge__(self, o):
+        return self._binop(">=", o)
+
+    def __lt__(self, o):
+        return self._binop("<", o)
+
+    def __le__(self, o):
+        return self._binop("<=", o)
+
+    def __eq__(self, o):  # noqa: comparing frames builds a frame, like h2o-py
+        return self._binop("==", o)
+
+    def __ne__(self, o):
+        return self._binop("!=", o)
+
+    __hash__ = None
+
+    def mean(self, na_rm=True):
+        return self._exec(f"(mean {self.frame_id} {'true' if na_rm else 'false'})")
+
+    def sum(self, na_rm=True):
+        return self._exec(f"(sum {self.frame_id} {'true' if na_rm else 'false'})")
+
+    def min(self):
+        return self._exec(f"(min {self.frame_id} true)")
+
+    def max(self):
+        return self._exec(f"(max {self.frame_id} true)")
+
+    def sd(self):
+        return self._exec(f"(sd {self.frame_id} true)")
+
+    def asfactor(self) -> "H2OFrame":
+        return self._exec(f"(as.factor {self.frame_id})")
+
+    def asnumeric(self) -> "H2OFrame":
+        return self._exec(f"(as.numeric {self.frame_id})")
+
+    def unique(self) -> "H2OFrame":
+        return self._exec(f"(unique {self.frame_id})")
+
+    def table(self) -> "H2OFrame":
+        return self._exec(f"(table {self.frame_id})")
+
+    def cbind(self, other: "H2OFrame") -> "H2OFrame":
+        return self._exec(f"(cbind {self.frame_id} {other.frame_id})")
+
+    def rbind(self, other: "H2OFrame") -> "H2OFrame":
+        return self._exec(f"(rbind {self.frame_id} {other.frame_id})")
+
+    def set_names(self, names: list[str]) -> "H2OFrame":
+        """Rename columns in place (h2o-py semantics: the handle keeps
+        pointing at the renamed frame)."""
+        inner = " ".join(f"'{n}'" for n in names)
+        idx = " ".join(str(i) for i in range(len(names)))
+        out = self._exec(f"(colnames= {self.frame_id} [{idx}] [{inner}])")
+        self.frame_id = out.frame_id
+        self._schema = None
+        return self
+
+    # -- materialization -----------------------------------------------------
+    def as_data_frame(self, use_pandas: bool = True, rows: int | None = None):
+        j = connection().request(
+            "GET", f"/3/Frames/{urllib.parse.quote(self.frame_id)}",
+            params={"row_count": rows if rows is not None else self.nrow}
+        )["frames"][0]
+        cols = {}
+        for c in j["columns"]:
+            if c.get("string_data") is not None:
+                cols[c["label"]] = c["string_data"]
+            elif c["domain"]:
+                dom = c["domain"]
+                cols[c["label"]] = [None if v is None else dom[int(v)]
+                                    for v in (c["data"] or [])]
+            else:
+                cols[c["label"]] = c["data"] or []
+        if use_pandas:
+            import pandas as pd
+
+            return pd.DataFrame(cols)
+        return cols
+
+    def head(self, rows=10):
+        # only the first `rows` rows cross the wire (server-side preview cap)
+        return self.as_data_frame(rows=rows)
+
+    def __repr__(self):
+        return f"H2OFrame({self.frame_id}, {self.nrow}x{self.ncol})"
+
+
+# ---------------------------------------------------------------------------
+# estimators (`h2o-py/h2o/estimators/*` — thin generated layer)
+# ---------------------------------------------------------------------------
+class H2OModelClient:
+    """Client handle on a trained server-side model."""
+
+    def __init__(self, model_id: str, schema: dict):
+        self.model_id = schema["model_id"]["name"] if schema else model_id
+        self._schema = schema
+
+    @property
+    def key(self):
+        return self.model_id
+
+    def predict(self, frame: H2OFrame) -> H2OFrame:
+        j = connection().request(
+            "POST",
+            f"/3/Predictions/models/{urllib.parse.quote(self.model_id)}"
+            f"/frames/{urllib.parse.quote(frame.frame_id)}")
+        return H2OFrame._by_id(j["predictions_frame"]["name"])
+
+    def _metrics(self, kind="training_metrics") -> dict:
+        return (self._schema or {}).get("output", {}).get(kind) or {}
+
+    def auc(self, train=True, valid=False, xval=False):
+        kind = ("cross_validation_metrics" if xval else
+                "validation_metrics" if valid else "training_metrics")
+        return self._metrics(kind).get("AUC")
+
+    def rmse(self, train=True, valid=False, xval=False):
+        kind = ("cross_validation_metrics" if xval else
+                "validation_metrics" if valid else "training_metrics")
+        return self._metrics(kind).get("rmse")
+
+    def logloss(self, **kw):
+        return self._metrics().get("logloss")
+
+    def varimp(self, use_pandas=False):
+        vi = (self._schema or {}).get("output", {}).get("variable_importances")
+        if vi and use_pandas:
+            import pandas as pd
+
+            return pd.DataFrame(vi)
+        return vi
+
+    def download_mojo(self, path: str = ".") -> str:
+        j = connection().request(
+            "GET", f"/3/Models/{urllib.parse.quote(self.model_id)}/mojo",
+            params={"dir": path})
+        return j["dir"]
+
+    def __repr__(self):
+        return f"H2OModelClient({self.model_id})"
+
+
+class H2OEstimator:
+    """Base estimator: collects kwargs, posts to /3/ModelBuilders/{algo},
+    polls the job, exposes the trained model."""
+
+    algo = None
+
+    def __init__(self, **params):
+        self._params = params
+        self._model: H2OModelClient | None = None
+
+    def train(self, x=None, y=None, training_frame: H2OFrame | None = None,
+              validation_frame: H2OFrame | None = None, **kw):
+        body = dict(self._params)
+        body.update(kw)
+        if training_frame is not None:
+            body["training_frame"] = training_frame.frame_id
+        if validation_frame is not None:
+            body["validation_frame"] = validation_frame.frame_id
+        if y is not None:
+            body["response_column"] = y
+        if x is not None:
+            all_cols = training_frame.columns
+            # h2o-py accepts names or integer indices in x
+            keep = {all_cols[c] if isinstance(c, int) else c for c in x}
+            body["ignored_columns"] = [c for c in all_cols
+                                       if c not in keep and c != y]
+        job = connection().request("POST", f"/3/ModelBuilders/{self.algo}",
+                                   data=body)
+        done = _poll_job(job)
+        self._model = get_model(done["dest"]["name"])
+        return self
+
+    # delegate model accessors
+    def __getattr__(self, name):
+        if self._model is None:
+            raise AttributeError(f"train() first (no model for {name})")
+        return getattr(self._model, name)
+
+    @property
+    def model_id(self):
+        return self._model.model_id if self._model else None
+
+
+def _estimator(algo: str, clsname: str) -> type:
+    return type(clsname, (H2OEstimator,), {"algo": algo})
+
+
+# the h2o-py estimator names (`h2o-py/h2o/estimators/__init__.py`)
+H2OGradientBoostingEstimator = _estimator("gbm", "H2OGradientBoostingEstimator")
+H2ORandomForestEstimator = _estimator("drf", "H2ORandomForestEstimator")
+H2OXGBoostEstimator = _estimator("xgboost", "H2OXGBoostEstimator")
+H2OGeneralizedLinearEstimator = _estimator("glm", "H2OGeneralizedLinearEstimator")
+H2OGeneralizedAdditiveEstimator = _estimator("gam", "H2OGeneralizedAdditiveEstimator")
+H2ODeepLearningEstimator = _estimator("deeplearning", "H2ODeepLearningEstimator")
+H2OKMeansEstimator = _estimator("kmeans", "H2OKMeansEstimator")
+H2OPrincipalComponentAnalysisEstimator = _estimator("pca", "H2OPrincipalComponentAnalysisEstimator")
+H2OSingularValueDecompositionEstimator = _estimator("svd", "H2OSingularValueDecompositionEstimator")
+H2OGeneralizedLowRankEstimator = _estimator("glrm", "H2OGeneralizedLowRankEstimator")
+H2ONaiveBayesEstimator = _estimator("naivebayes", "H2ONaiveBayesEstimator")
+H2OIsolationForestEstimator = _estimator("isolationforest", "H2OIsolationForestEstimator")
+H2OExtendedIsolationForestEstimator = _estimator("extendedisolationforest", "H2OExtendedIsolationForestEstimator")
+H2OCoxProportionalHazardsEstimator = _estimator("coxph", "H2OCoxProportionalHazardsEstimator")
+H2OIsotonicRegressionEstimator = _estimator("isotonicregression", "H2OIsotonicRegressionEstimator")
+H2OStackedEnsembleEstimator = _estimator("stackedensemble", "H2OStackedEnsembleEstimator")
+H2ORuleFitEstimator = _estimator("rulefit", "H2ORuleFitEstimator")
+H2OSupportVectorMachineEstimator = _estimator("psvm", "H2OSupportVectorMachineEstimator")
+H2OWord2vecEstimator = _estimator("word2vec", "H2OWord2vecEstimator")
+H2OUpliftRandomForestEstimator = _estimator("upliftdrf", "H2OUpliftRandomForestEstimator")
+H2ODecisionTreeEstimator = _estimator("decisiontree", "H2ODecisionTreeEstimator")
+H2OAdaBoostEstimator = _estimator("adaboost", "H2OAdaBoostEstimator")
+H2OANOVAGLMEstimator = _estimator("anovaglm", "H2OANOVAGLMEstimator")
+H2OModelSelectionEstimator = _estimator("modelselection", "H2OModelSelectionEstimator")
+H2OTargetEncoderEstimator = _estimator("targetencoder", "H2OTargetEncoderEstimator")
+H2OAggregatorEstimator = _estimator("aggregator", "H2OAggregatorEstimator")
+H2OInfogram = _estimator("infogram", "H2OInfogram")

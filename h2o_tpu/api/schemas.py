@@ -1,0 +1,129 @@
+"""Schema v3 DTO builders — the wire shapes of `water/api/schemas3/`.
+
+The reference reflectively copies impl fields into versioned Schema objects
+(`water/api/Schema.java:23-45`); here each builder function produces the JSON
+dict for one schema class, keeping the reference's field names (frame_id,
+column `data`/`domain`, job `status`/`progress`, model `algo`/`output`) so a
+schema-v3 client recognises the payloads.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..backend.jobs import Job
+from ..frame.frame import Frame
+
+
+def _clean(x):
+    """JSON-safe scalar (NaN/inf → None, numpy → python)."""
+    if isinstance(x, (np.floating, float)):
+        x = float(x)
+        return None if (math.isnan(x) or math.isinf(x)) else x
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.bool_,)):
+        return bool(x)
+    if isinstance(x, np.ndarray):
+        return [_clean(v) for v in x.tolist()]
+    if isinstance(x, dict):
+        return {k: _clean(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_clean(v) for v in x]
+    return x
+
+
+def key_schema(key: str, type_: str = "Key") -> dict:
+    return {"name": key, "type": type_, "URL": None}
+
+
+def col_summary(name: str, vec, npreview: int = 0) -> dict:
+    """`water/api/schemas3/FrameV3.ColV3`."""
+    out = {"label": name, "type": vec.type, "missing_count": None,
+           "domain": vec.domain, "domain_cardinality": vec.cardinality(),
+           "mean": None, "sigma": None, "mins": [], "maxs": [], "data": None}
+    if vec.data is not None:
+        r = vec.rollups()
+        out.update(missing_count=int(r.nacnt), mean=_clean(r.mean),
+                   sigma=_clean(r.sigma), mins=[_clean(r.mins)],
+                   maxs=[_clean(r.maxs)])
+    else:
+        out["missing_count"] = int(sum(1 for x in vec.host_data if x is None))
+    if npreview:
+        if vec.is_string():
+            out["string_data"] = [None if x is None else str(x)
+                                  for x in vec.host_data[:npreview]]
+        else:
+            out["data"] = _clean(vec.to_numpy()[:npreview])
+    return out
+
+
+def frame_schema(fr: Frame, npreview: int = 0) -> dict:
+    """`water/api/schemas3/FrameV3` (summary form)."""
+    return {
+        "frame_id": key_schema(fr.key, "Key<Frame>"),
+        "rows": fr.nrow,
+        "num_columns": fr.ncol,
+        "byte_size": sum((v.plen * 4) for v in fr.vecs if v.data is not None),
+        "is_text": False,
+        "columns": [col_summary(n, fr.vec(n), npreview) for n in fr.names],
+    }
+
+
+def frame_base(fr: Frame) -> dict:
+    return {"frame_id": key_schema(fr.key, "Key<Frame>"), "rows": fr.nrow,
+            "num_columns": fr.ncol}
+
+
+def job_schema(job: Job) -> dict:
+    """`water/api/schemas3/JobV3` (status names match `water/Job.java`)."""
+    return {
+        "key": key_schema(job.key, "Key<Job>"),
+        "description": job.description,
+        "status": job.status,
+        "progress": _clean(job.progress),
+        "progress_msg": job.progress_msg,
+        "start_time": int(job.start_time * 1000),
+        "msec": int(((job.end_time or job.start_time) - job.start_time) * 1000),
+        "dest": key_schema(job.dest_key) if job.dest_key else None,
+        "exception": None if job.exception is None else repr(job.exception),
+        "stacktrace": job.traceback,
+    }
+
+
+def metrics_schema(m) -> dict | None:
+    if m is None:
+        return None
+    out = {}
+    for f in ("mse", "rmse", "mae", "r2", "auc", "aucpr", "logloss",
+              "mean_per_class_error", "null_deviance", "residual_deviance",
+              "aic"):
+        v = getattr(m, f, None)
+        if v is not None:
+            out[f.upper() if f in ("auc", "aucpr", "aic") else f] = _clean(v)
+    return out
+
+
+def model_schema(model) -> dict:
+    """`water/api/schemas3/ModelSchemaV3` (summary form)."""
+    o = model.output
+    return {
+        "model_id": key_schema(model.key, "Key<Model>"),
+        "algo": model.algo_name,
+        "algo_full_name": model.algo_name,
+        "response_column_name": getattr(model.params, "response_column", None),
+        "output": {
+            "model_category": o.model_category,
+            "names": o.names,
+            "domains": _clean([o.domains.get(n) for n in o.names]),
+            "response_domain": o.response_domain,
+            "training_metrics": metrics_schema(o.training_metrics),
+            "validation_metrics": metrics_schema(o.validation_metrics),
+            "cross_validation_metrics": metrics_schema(o.cross_validation_metrics),
+            "variable_importances": _clean(o.variable_importances),
+            "scoring_history_length": len(o.scoring_history),
+            "run_time_ms": o.run_time_ms,
+        },
+    }

@@ -1,0 +1,394 @@
+"""REST API server — analog of `water/api/RequestServer.java` (:56,80,157).
+
+Routes follow the reference's versioned URL scheme (`/3/...`, `/99/Rapids`;
+128 endpoints registered in `water/api/RegisterV3Api.java` — the subset here
+covers the paths the Python client actually drives: cloud status, import/
+parse, frames, model builders, models, predictions, jobs, rapids, logs,
+timeline, shutdown). Built on the stdlib ThreadingHTTPServer: the control
+plane is host-side Python; all bulk compute the handlers trigger runs on the
+device mesh (SURVEY.md §2.5 — REST/job control on host CPUs, data plane on
+ICI).
+
+Request/response bodies are schema-v3-shaped JSON (see schemas.py). Errors
+return the reference's H2OErrorV3 shape with http status codes
+(`water/api/RequestServer.java` error handling).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import __version__
+from ..backend.jobs import Job
+from ..backend.kvstore import STORE
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from ..models import registry
+from ..rapids.exec import Rapids, Session
+from . import schemas
+
+_SESSIONS: dict[str, Session] = {}
+
+
+class H2OServer:
+    """Server lifecycle — `water/H2O.main` + Jetty boot analog."""
+
+    def __init__(self, port: int = 54321, name: str = "h2o_tpu"):
+        self.port = port
+        self.name = name
+        self.httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "H2OServer":
+        handler = _make_handler(self)
+        # port scan upward like the reference (`NetworkInit` baseport search)
+        last_err = None
+        for port in range(self.port, self.port + 20):
+            try:
+                self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+                self.port = port
+                break
+            except OSError as e:
+                last_err = e
+        if self.httpd is None:
+            raise last_err
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="h2o-rest")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def _err(status: int, msg: str, **extra) -> tuple[int, dict]:
+    return status, {"__meta": {"schema_type": "H2OError"},
+                    "error_url": "", "msg": msg, "dev_msg": msg,
+                    "http_status": status, "exception_msg": msg, **extra}
+
+
+def _jobs_of(algo_cls, params_cls, body: dict) -> tuple[int, dict]:
+    import dataclasses
+
+    valid = {f.name for f in dataclasses.fields(params_cls)}
+    unknown = [k for k in body if k not in valid]
+    if unknown:  # reject typos like the reference's 412 on unknown params
+        raise ValueError(f"unknown parameter(s) {unknown} for this algorithm")
+    kwargs = {}
+    for k, v in body.items():
+        if k in ("training_frame", "validation_frame", "blending_frame"):
+            v = STORE.get(v)
+        kwargs[k] = v
+    builder = algo_cls(params_cls(**kwargs))
+    job = builder.train(background=True)
+    return 200, {"job": schemas.job_schema(job),
+                 "key": schemas.key_schema(job.key)}
+
+
+def _make_handler(server: H2OServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route to our logger, not stderr
+            from ..utils.log import debug
+
+            debug(f"REST {self.address_string()} {fmt % args}")
+
+        # -- plumbing --------------------------------------------------------
+        def _reply(self, status: int, payload: dict):
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n).decode() if n else ""
+            if not raw:
+                return {}
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                return json.loads(raw)
+            return {k: v[0] if len(v) == 1 else v
+                    for k, v in urllib.parse.parse_qs(raw).items()}
+
+        def _route(self, method: str):
+            parsed = urllib.parse.urlparse(self.path)
+            parts = [p for p in parsed.path.split("/") if p]
+            query = {k: v[0] if len(v) == 1 else v
+                     for k, v in urllib.parse.parse_qs(parsed.query).items()}
+            try:
+                status, payload = route(server, method, parts, query,
+                                        self._body() if method in ("POST", "PUT")
+                                        else {})
+            except KeyError as e:
+                status, payload = _err(404, str(e))
+            except (ValueError, TypeError) as e:
+                status, payload = _err(400, str(e))
+            except Exception as e:  # noqa: BLE001 — surface as H2OError
+                status, payload = _err(500, repr(e),
+                                       stacktrace=traceback.format_exc())
+            self._reply(status, payload)
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+    return Handler
+
+
+# ---------------------------------------------------------------------------
+# routing table (`RequestServer.java:157` route registration)
+# ---------------------------------------------------------------------------
+def route(server: H2OServer, method: str, parts: list[str], query: dict,
+          body: dict) -> tuple[int, dict]:
+    if not parts:
+        return 200, {"h2o": server.name, "version": __version__}
+    ver, rest = parts[0], parts[1:]
+    if ver not in ("3", "99", "4"):
+        return _err(404, f"unknown api version {ver}")
+    p = dict(query)
+    p.update(body)
+
+    if not rest:
+        return _err(404, "no route")
+    head = rest[0]
+
+    # -- cloud / about / shutdown -------------------------------------------
+    if head == "Cloud":
+        import jax
+
+        return 200, {
+            "version": __version__, "cloud_name": server.name,
+            "cloud_size": 1, "cloud_healthy": True, "consensus": True,
+            "locked": True,
+            "nodes": [{"h2o": server.url, "healthy": True,
+                       "num_cpus": len(jax.devices()),
+                       "backend": jax.default_backend()}],
+        }
+    if head == "About":
+        return 200, {"entries": [{"name": "Build version", "value": __version__},
+                                 {"name": "Backend", "value": "jax/tpu"}]}
+    if head == "Shutdown" and method == "POST":
+        threading.Thread(target=server.stop, daemon=True).start()
+        return 200, {}
+
+    # -- import / parse ------------------------------------------------------
+    if head == "ImportFiles":
+        path = p.get("path", "")
+        import glob as _glob
+        import os
+
+        hits = sorted(_glob.glob(path)) if any(c in path for c in "*?[") \
+            else ([path] if os.path.exists(path) else [])
+        return 200, {"files": hits, "destination_frames": hits,
+                     "fails": [] if hits else [path], "dels": []}
+    if head == "ParseSetup" and method == "POST":
+        from ..io.parser import guess_setup
+
+        paths = p.get("source_frames", [])
+        if isinstance(paths, str):
+            paths = [paths]
+        paths = [s.strip('"') for s in paths]
+        setup = guess_setup(paths[0])
+        ext = paths[0].rsplit(".", 1)[-1].lower()
+        ptype = {"parquet": "PARQUET", "pq": "PARQUET", "orc": "ORC",
+                 "svm": "SVMLight", "svmlight": "SVMLight"}.get(ext, "CSV")
+        return 200, {
+            "source_frames": [schemas.key_schema(s) for s in paths],
+            "parse_type": ptype,
+            "separator": ord(setup.separator or ","),
+            "check_header": 1 if setup.header else -1,
+            "column_names": setup.column_names,
+            "column_types": setup.column_types,
+            "number_columns": len(setup.column_names or []),
+            "destination_frame": _dest_name(paths[0]),
+        }
+    if head == "Parse" and method == "POST":
+        from ..io.parser import ParseSetup, parse_file
+
+        paths = p.get("source_frames", [])
+        if isinstance(paths, str):
+            paths = [paths]
+        paths = [s.strip('"') for s in paths]
+        dest = p.get("destination_frame") or _dest_name(paths[0])
+        job = Job(f"Parse {paths[0]}", work=1.0)
+
+        def run():
+            fr = parse_file(paths[0], dest_key=dest)
+            if paths[1:]:  # multi-file import: rbind the remaining files
+                rest_frames = [parse_file(q) for q in paths[1:]]
+                fr = fr.concat_rows(*rest_frames)
+                fr.key = dest
+                STORE.put(dest, fr)
+            job.dest_key = fr.key
+            return fr
+
+        job.start(run, background=True)
+        return 200, {"job": schemas.job_schema(job)}
+
+    # -- frames --------------------------------------------------------------
+    if head == "Frames":
+        if method == "GET" and not rest[1:]:
+            frames = STORE.values(Frame)
+            return 200, {"frames": [schemas.frame_base(f) for f in frames]}
+        fid = urllib.parse.unquote(rest[1]) if rest[1:] else None
+        fr = STORE.get(fid)
+        if not isinstance(fr, Frame):
+            return _err(404, f"frame {fid} not found")
+        if method == "DELETE":
+            STORE.remove(fid)
+            return 200, {}
+        if rest[2:] and rest[2] == "summary":
+            return 200, {"frames": [schemas.frame_schema(fr, npreview=0)]}
+        n = int(p.get("row_count", 10) or 10)
+        return 200, {"frames": [schemas.frame_schema(fr, npreview=n)]}
+
+    # -- model builders ------------------------------------------------------
+    if head == "ModelBuilders":
+        if method == "GET" and not rest[1:]:
+            return 200, {"model_builders": {
+                a: {"algo": a, "visibility": "Stable"}
+                for a in registry.algo_names()}}
+        algo = rest[1]
+        entry = registry.lookup(algo)
+        if entry is None:
+            return _err(404, f"unknown algorithm {algo}")
+        if method == "POST":
+            return _jobs_of(entry[0], entry[1], p)
+        return 200, {"algo": algo,
+                     "parameters": registry.param_metadata(algo)}
+
+    # -- models --------------------------------------------------------------
+    if head == "Models":
+        from ..models.model_base import Model
+
+        if method == "GET" and not rest[1:]:
+            return 200, {"models": [schemas.model_schema(m)
+                                    for m in STORE.values(Model)]}
+        mid = urllib.parse.unquote(rest[1]) if rest[1:] else None
+        m = STORE.get(mid)
+        if m is None:
+            return _err(404, f"model {mid} not found")
+        if method == "DELETE":
+            STORE.remove(mid)
+            return 200, {}
+        if rest[2:] and rest[2] == "mojo":
+            import os
+
+            path = p.get("dir") or "."
+            if os.path.isdir(path) or path.endswith(os.sep):
+                os.makedirs(path, exist_ok=True)
+                path = os.path.join(path, f"{mid}.zip")
+            return 200, {"dir": m.save_mojo(path)}
+        return 200, {"models": [schemas.model_schema(m)]}
+
+    # -- predictions ---------------------------------------------------------
+    if head == "Predictions" and method == "POST":
+        # /3/Predictions/models/{model}/frames/{frame}
+        mid = urllib.parse.unquote(rest[2])
+        fid = urllib.parse.unquote(rest[4])
+        model, fr = STORE.get(mid), STORE.get(fid)
+        if model is None:
+            return _err(404, f"model {mid} not found")
+        if fr is None:
+            return _err(404, f"frame {fid} not found")
+        pred = model.predict(fr)
+        dest = p.get("predictions_frame") or f"predictions_{mid}_{fid}"
+        pred.key = dest
+        STORE.put(dest, pred)
+        return 200, {"predictions_frame": schemas.key_schema(dest),
+                     "model_metrics": [{}]}
+
+    # -- jobs ----------------------------------------------------------------
+    if head == "Jobs":
+        if rest[1:]:
+            jid = urllib.parse.unquote(rest[1])
+            job = STORE.get(jid)
+            if not isinstance(job, Job):
+                return _err(404, f"job {jid} not found")
+            if rest[2:] and rest[2] == "cancel" and method == "POST":
+                job.stop()
+                return 200, {}
+            return 200, {"jobs": [schemas.job_schema(job)]}
+        return 200, {"jobs": [schemas.job_schema(j)
+                              for j in STORE.values(Job)]}
+
+    # -- rapids (`/99/Rapids`) ----------------------------------------------
+    if head == "Rapids" and method == "POST":
+        ast = p.get("ast", "")
+        sid = p.get("session_id", "default")
+        session = _SESSIONS.setdefault(sid, Session(sid))
+        result = Rapids(session).exec(ast)
+        return 200, _rapids_result(result)
+    if head == "InitID":
+        if method == "DELETE":
+            s = _SESSIONS.pop(rest[1] if rest[1:] else "default", None)
+            if s:
+                s.end()
+            return 200, {}
+        sid = f"_sid_{np.random.randint(1 << 30)}"
+        _SESSIONS[sid] = Session(sid)
+        return 200, {"session_key": sid}
+
+    # -- observability -------------------------------------------------------
+    if head == "Logs":
+        from ..utils.log import get_buffer
+
+        return 200, {"log": "\n".join(get_buffer())}
+    if head == "Timeline":
+        from ..utils.timeline import snapshot
+
+        return 200, {"events": snapshot()}
+
+    return _err(404, f"no route for {method} /{'/'.join(parts)}")
+
+
+def _dest_name(path: str) -> str:
+    import os
+
+    base = os.path.basename(path)
+    for ext in (".csv", ".gz", ".zip", ".parquet"):
+        base = base.replace(ext, "")
+    return base.replace(".", "_") + ".hex"
+
+
+def _rapids_result(result) -> dict:
+    """ValFrame/ValNum/ValStr serialization (`water/rapids/val/*`)."""
+    if isinstance(result, Frame):
+        STORE.put_keyed(result)
+        return {"key": schemas.key_schema(result.key),
+                "string": None, "scalar": None}
+    if isinstance(result, Vec):
+        fr = Frame([result.key or "C1"], [result])
+        STORE.put_keyed(fr)
+        return {"key": schemas.key_schema(fr.key),
+                "string": None, "scalar": None}
+    if isinstance(result, str):
+        return {"key": None, "string": result, "scalar": None}
+    if isinstance(result, (list, tuple)):
+        return {"key": None, "string": None, "scalar": None,
+                "values": schemas._clean(list(result))}
+    if result is None:
+        return {"key": None, "string": None, "scalar": None}
+    return {"key": None, "string": None, "scalar": schemas._clean(result)}

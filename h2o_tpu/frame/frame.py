@@ -138,6 +138,42 @@ class Frame(Keyed):
         self._names[self._names.index(old)] = new
         return self
 
+    def take(self, idx) -> "Frame":
+        """Row subset by integer index array — the shared helper behind
+        split_frame, CV fold slicing, and rapids row selection."""
+        import numpy as _np
+
+        idx = _np.asarray(idx)
+        cols = {}
+        for name in self._names:
+            v = self.vec(name)
+            if v.is_string():
+                cols[name] = Vec(None, len(idx), type=v.type,
+                                 host_data=v.host_data[idx])
+            else:
+                cols[name] = Vec.from_numpy(v.to_numpy()[idx], type=v.type,
+                                            domain=v.domain)
+        return Frame(list(cols), list(cols.values()))
+
+    def concat_rows(self, *others: "Frame") -> "Frame":
+        """Row-wise concatenation (rbind) preserving types/domains of self."""
+        import numpy as _np
+
+        cols = {}
+        for n in self._names:
+            v0 = self.vec(n)
+            if v0.is_string():
+                parts = [self.vec(n).host_data] + [o.vec(n).host_data
+                                                   for o in others]
+                cols[n] = Vec(None, sum(len(p) for p in parts), type=v0.type,
+                              host_data=_np.concatenate(parts))
+            else:
+                parts = [self.vec(n).to_numpy()] + [o.vec(n).to_numpy()
+                                                    for o in others]
+                cols[n] = Vec.from_numpy(_np.concatenate(parts), type=v0.type,
+                                         domain=v0.domain)
+        return Frame(list(cols), list(cols.values()))
+
     # -- device materialization ----------------------------------------------
     def as_matrix(self, names: Sequence[str] | None = None) -> jax.Array:
         """Stack columns into a row-sharded (plen, ncol) float32 matrix."""

@@ -12,18 +12,6 @@ from __future__ import annotations
 import numpy as np
 
 from .frame import Frame
-from .vec import Vec
-
-
-def _take(fr: Frame, idx: np.ndarray) -> Frame:
-    cols = {}
-    for name in fr.names:
-        v = fr.vec(name)
-        if v.is_string():
-            cols[name] = Vec(None, len(idx), type=v.type, host_data=v.host_data[idx])
-        else:
-            cols[name] = Vec.from_numpy(v.to_numpy()[idx], type=v.type, domain=v.domain)
-    return Frame(list(cols), list(cols.values()))
 
 
 def split_frame(fr: Frame, ratios=(0.75,), seed: int | None = None) -> list[Frame]:
@@ -37,7 +25,7 @@ def split_frame(fr: Frame, ratios=(0.75,), seed: int | None = None) -> list[Fram
     bounds = np.cumsum(ratios + [1.0 - sum(ratios)])
     which = np.searchsorted(bounds, u, side="right")
     which = np.minimum(which, len(bounds) - 1)
-    return [_take(fr, np.where(which == k)[0]) for k in range(len(bounds))]
+    return [fr.take(np.where(which == k)[0]) for k in range(len(bounds))]
 
 
 def split_exact(fr: Frame, ratios=(0.75,), seed: int | None = None) -> list[Frame]:
@@ -49,6 +37,6 @@ def split_exact(fr: Frame, ratios=(0.75,), seed: int | None = None) -> list[Fram
     counts.append(fr.nrow - sum(counts))
     out, s = [], 0
     for c in counts:
-        out.append(_take(fr, np.sort(perm[s:s + c])))
+        out.append(fr.take(np.sort(perm[s:s + c])))
         s += c
     return out

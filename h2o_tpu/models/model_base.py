@@ -346,15 +346,7 @@ class ModelBuilder:
 
 
 def _subset_frame(fr: Frame, idx: np.ndarray) -> Frame:
-    cols = {}
-    for name in fr.names:
-        v = fr.vec(name)
-        if v.is_string():
-            cols[name] = Vec(None, len(idx), type=v.type, host_data=v.host_data[idx])
-        else:
-            sub = v.to_numpy()[idx]
-            cols[name] = Vec.from_numpy(sub, type=v.type, domain=v.domain)
-    return Frame(list(cols), list(cols.values()))
+    return fr.take(idx)
 
 
 def _mean_metrics(ms: list):

@@ -1,0 +1,86 @@
+"""Algorithm registry — analog of `water/api/AlgoAbstractRegister.java` +
+the service/extension registration that exposes each ModelBuilder over REST
+(`/3/ModelBuilders/{algo}`).
+
+Lazy imports keep server startup fast; each entry maps the REST algo name to
+(builder class, parameters dataclass).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Optional
+
+_ALGOS = {
+    # rest-name: (module, builder class, params class)
+    "gbm": ("h2o_tpu.models.gbm", "GBM", "GBMParameters"),
+    "drf": ("h2o_tpu.models.drf", "DRF", "DRFParameters"),
+    "xrt": ("h2o_tpu.models.drf", "XRT", "XRTParameters"),
+    "xgboost": ("h2o_tpu.models.xgboost", "XGBoost", "XGBoostParameters"),
+    "glm": ("h2o_tpu.models.glm", "GLM", "GLMParameters"),
+    "gam": ("h2o_tpu.models.gam", "GAM", "GAMParameters"),
+    "deeplearning": ("h2o_tpu.models.deeplearning", "DeepLearning",
+                     "DeepLearningParameters"),
+    "kmeans": ("h2o_tpu.models.kmeans", "KMeans", "KMeansParameters"),
+    "pca": ("h2o_tpu.models.pca", "PCA", "PCAParameters"),
+    "svd": ("h2o_tpu.models.pca", "SVD", "SVDParameters"),
+    "glrm": ("h2o_tpu.models.glrm", "GLRM", "GLRMParameters"),
+    "naivebayes": ("h2o_tpu.models.naivebayes", "NaiveBayes",
+                   "NaiveBayesParameters"),
+    "isolationforest": ("h2o_tpu.models.isofor", "IsolationForest",
+                        "IsolationForestParameters"),
+    "extendedisolationforest": ("h2o_tpu.models.isofor",
+                                "ExtendedIsolationForest",
+                                "IsolationForestParameters"),
+    "coxph": ("h2o_tpu.models.coxph", "CoxPH", "CoxPHParameters"),
+    "isotonicregression": ("h2o_tpu.models.isotonic", "IsotonicRegression",
+                           "IsotonicParameters"),
+    "stackedensemble": ("h2o_tpu.models.ensemble", "StackedEnsemble",
+                        "StackedEnsembleParameters"),
+    "rulefit": ("h2o_tpu.models.rulefit", "RuleFit", "RuleFitParameters"),
+    "psvm": ("h2o_tpu.models.psvm", "PSVM", "SVMParameters"),
+    "word2vec": ("h2o_tpu.models.word2vec", "Word2Vec", "Word2VecParameters"),
+    "upliftdrf": ("h2o_tpu.models.uplift", "UpliftDRF", "UpliftDRFParameters"),
+    "decisiontree": ("h2o_tpu.models.dt", "DT", "DTParameters"),
+    "adaboost": ("h2o_tpu.models.adaboost", "AdaBoost", "AdaBoostParameters"),
+    "anovaglm": ("h2o_tpu.models.anovaglm", "ANOVAGLM", "ANOVAGLMParameters"),
+    "modelselection": ("h2o_tpu.models.modelselection", "ModelSelection",
+                       "ModelSelectionParameters"),
+    "targetencoder": ("h2o_tpu.models.target_encoder", "TargetEncoder",
+                      "TargetEncoderParameters"),
+    "aggregator": ("h2o_tpu.models.aggregator", "Aggregator",
+                   "AggregatorParameters"),
+    "infogram": ("h2o_tpu.models.infogram", "Infogram", "InfogramParameters"),
+    "generic": ("h2o_tpu.models.generic", "Generic", "GenericParameters"),
+}
+
+
+def algo_names() -> list[str]:
+    return sorted(_ALGOS)
+
+
+def lookup(algo: str) -> Optional[tuple]:
+    entry = _ALGOS.get(algo.lower())
+    if entry is None:
+        return None
+    mod = importlib.import_module(entry[0])
+    return getattr(mod, entry[1]), getattr(mod, entry[2])
+
+
+def param_metadata(algo: str) -> list[dict]:
+    """Field metadata for `/3/ModelBuilders/{algo}` GET — the schema-metadata
+    payload that drives client codegen (`h2o-bindings/bin/gen_python.py`)."""
+    entry = lookup(algo)
+    if entry is None:
+        return []
+    out = []
+    for f in dataclasses.fields(entry[1]):
+        default = f.default
+        if default is dataclasses.MISSING:
+            default = None if f.default_factory is dataclasses.MISSING \
+                else f.default_factory()
+        if not isinstance(default, (int, float, str, bool, list, type(None))):
+            default = repr(default)
+        out.append({"name": f.name, "type": str(f.type), "default_value": default})
+    return out

@@ -1,0 +1,391 @@
+"""Rapids.exec — the Lisp-ish expression evaluator behind `/99/Rapids`.
+
+Analog of `water/rapids/Rapids.java:60,86` (tokenizer/parser) +
+`water/rapids/ast/AstExec.java` (apply) + `water/rapids/Session.java`
+(ref-counted result tracking). Clients submit strings like
+
+    (tmp= py_1 (cols_py higgs [0 3]))
+    (mean (cols frame_key 'x') true)
+    (:= fr (* (cols fr 'x') 2) 1 [])
+
+The grammar (`Rapids.java` class comment): ``( )`` applies a primitive;
+``[ ]`` is a number/string list; numbers, ``'str'``/``"str"`` strings, ids
+reference env/DKV objects; ``tmp=``/``:=`` assign.
+
+Primitives dispatch onto the device-side rapids ops (ops/groupby/merge/
+strings) — the evaluator is a thin host-side shim; all bulk work stays
+sharded on the mesh. The prim set covers what h2o-py's expr layer actually
+emits for core munging (SURVEY.md §7 scoping note).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend.kvstore import STORE
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from . import strings as strmod
+from .groupby import group_by
+from .merge import merge as merge_fn, sort as sort_fn
+from .ops import (binop, cumulative, ifelse, reduce_op, round_digits, signif,
+                  table, time_part, unique, unop)
+
+
+# ---------------------------------------------------------------------------
+# session (`water/rapids/Session.java`)
+# ---------------------------------------------------------------------------
+class Session:
+    """Holds temp results (`tmp=`) between Rapids calls; `end()` sweeps."""
+
+    def __init__(self, session_id: str | None = None):
+        self.id = session_id or f"session_{np.random.randint(1 << 30)}"
+        self.temps: dict[str, object] = {}
+
+    def lookup(self, name: str):
+        if name in self.temps:
+            return self.temps[name]
+        return STORE.get(name)
+
+    def assign(self, name: str, value):
+        self.temps[name] = value
+        if isinstance(value, (Frame, Vec)):
+            value.key = name
+            STORE.put(name, value)
+        return value
+
+    def end(self):
+        for k in self.temps:
+            STORE.remove(k, cascade=False)
+        self.temps.clear()
+
+
+# ---------------------------------------------------------------------------
+# tokenizer / parser (`Rapids.java:86` parse)
+# ---------------------------------------------------------------------------
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def peek(self):
+        while self.i < len(self.s) and self.s[self.i].isspace():
+            self.i += 1
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def parse(self):
+        c = self.peek()
+        if c == "(":
+            return self._list(")", "exec")
+        if c == "[":
+            return self._list("]", "list")
+        if c == "{":
+            return self._list("}", "fun")
+        if c in "'\"":
+            return self._string(c)
+        return self._token()
+
+    def _list(self, close, kind):
+        self.i += 1  # consume open
+        items = []
+        while self.peek() != close:
+            if self.peek() == "":
+                raise ValueError(f"unbalanced rapids expression: {self.s}")
+            items.append(self.parse())
+        self.i += 1  # consume close
+        return (kind, items)
+
+    def _string(self, q):
+        self.i += 1
+        out = []
+        while self.i < len(self.s) and self.s[self.i] != q:
+            if self.s[self.i] == "\\":
+                self.i += 1
+            out.append(self.s[self.i])
+            self.i += 1
+        self.i += 1
+        return ("str", "".join(out))
+
+    def _token(self):
+        j = self.i
+        while j < len(self.s) and not self.s[j].isspace() and self.s[j] not in "()[]{}":
+            j += 1
+        tok, self.i = self.s[self.i:j], j
+        if not tok:
+            raise ValueError(f"parse error at {self.i} in {self.s!r}")
+        try:
+            return ("num", float(tok))
+        except ValueError:
+            pass
+        if ":" in tok and tok not in (":=",):  # 0:10 span
+            lo, _, hi = tok.partition(":")
+            try:
+                return ("span", (int(lo), int(hi)))
+            except ValueError:
+                pass
+        return ("id", tok)
+
+
+# ---------------------------------------------------------------------------
+# evaluator
+# ---------------------------------------------------------------------------
+def _as_vec(x, nrow=None):
+    if isinstance(x, Frame):
+        if x.ncol != 1:
+            raise ValueError("expected a single-column frame")
+        return x.vec(0)
+    return x
+
+
+def _as_frame(x) -> Frame:
+    if isinstance(x, Vec):
+        return Frame([x.key or "C1"], [x])
+    if not isinstance(x, Frame):
+        raise ValueError(f"expected frame, got {type(x).__name__}")
+    return x
+
+
+def _col_indices(fr: Frame, sel) -> list[int]:
+    if isinstance(sel, float):
+        return [int(sel)]
+    if isinstance(sel, str):
+        return [fr.names.index(sel)]
+    if isinstance(sel, tuple) and len(sel) == 2:  # span
+        return list(range(sel[0], sel[1]))
+    if isinstance(sel, list):
+        out = []
+        for s in sel:
+            out.extend(_col_indices(fr, s))
+        return out
+    raise ValueError(f"bad column selector {sel!r}")
+
+
+def _row_mask(fr: Frame, sel) -> np.ndarray | None:
+    """None = all rows; else bool mask or index list."""
+    if isinstance(sel, list) and not sel:
+        return None
+    if isinstance(sel, Frame):
+        sel = _as_vec(sel)
+    if isinstance(sel, Vec):
+        m = sel.to_numpy()
+        if set(np.unique(m[~np.isnan(m)])) <= {0.0, 1.0}:
+            return ~np.isnan(m) & (m == 1.0)
+        return m[~np.isnan(m)].astype(np.int64)
+    if isinstance(sel, float):
+        return np.asarray([int(sel)])
+    if isinstance(sel, tuple):
+        return np.arange(sel[0], sel[1])
+    if isinstance(sel, list):
+        return np.asarray([int(_x) for _x in sel])
+    return None
+
+
+def _subset_rows(fr: Frame, rows) -> Frame:
+    if rows is None:
+        return fr
+    idx = np.where(rows)[0] if rows.dtype == bool else rows
+    return fr.take(idx)
+
+
+class Rapids:
+    """Evaluator instance bound to a Session."""
+
+    def __init__(self, session: Session | None = None):
+        self.session = session or Session()
+
+    # -- public entry (`Rapids.exec`) ----------------------------------------
+    def exec(self, expr: str):
+        ast = _Parser(expr).parse()
+        return self._eval(ast)
+
+    # -- eval ----------------------------------------------------------------
+    def _eval(self, node):
+        kind, val = node
+        if kind == "num":
+            return val
+        if kind == "str":
+            return val
+        if kind == "span":
+            return val
+        if kind == "list":
+            return [self._eval(x) for x in val]
+        if kind == "id":
+            lit = {"true": 1.0, "TRUE": 1.0, "True": 1.0,
+                   "false": 0.0, "FALSE": 0.0, "False": 0.0,
+                   "NA": float("nan"), "NaN": float("nan"),
+                   "null": None, "None": None}
+            if val in lit:
+                return lit[val]
+            obj = self.session.lookup(val)
+            if obj is None:
+                raise KeyError(f"rapids: unknown id '{val}'")
+            return obj
+        if kind == "exec":
+            if not val:
+                raise ValueError("empty () application")
+            opkind, opname = val[0]
+            if opkind != "id":
+                raise ValueError(f"cannot apply {val[0]!r}")
+            return self._apply(opname, val[1:])
+        raise ValueError(f"bad ast node {node!r}")
+
+    def _apply(self, op, raw_args):
+        # assignment forms keep their first arg un-evaluated (a fresh name)
+        if op in ("tmp=", "assign"):
+            name = raw_args[0][1]
+            value = self._eval(raw_args[1])
+            return self.session.assign(name, value)
+        if op == "rm":
+            name = raw_args[0][1]
+            self.session.temps.pop(name, None)
+            STORE.remove(name, cascade=False)
+            return None
+        args = [self._eval(a) for a in raw_args]
+        fn = _PRIMS.get(op)
+        if fn is None:
+            raise ValueError(f"rapids: unimplemented primitive '{op}'")
+        return fn(self, *args)
+
+
+# ---------------------------------------------------------------------------
+# primitive table (`water/rapids/ast/prims/**` subset)
+# ---------------------------------------------------------------------------
+def _prim_binop(op):
+    def fn(R, l, r):
+        return binop(op, _as_vec(l), _as_vec(r))
+    return fn
+
+
+def _prim_unop(op):
+    def fn(R, v):
+        return unop(op, _as_vec(v))
+    return fn
+
+
+def _prim_reduce(op):
+    def fn(R, v, na_rm=False):
+        fr = _as_frame(v)
+        vals = [reduce_op(op, fr.vec(i), na_rm=bool(na_rm))
+                for i in range(fr.ncol)]
+        return vals[0] if len(vals) == 1 else vals
+    return fn
+
+
+def _cols(R, fr, sel):
+    fr = _as_frame(fr)
+    idx = _col_indices(fr, sel)
+    return fr.subframe([fr.names[i] for i in idx])
+
+
+def _rows(R, fr, sel):
+    return _subset_rows(_as_frame(fr), _row_mask(_as_frame(fr), sel))
+
+
+def _cbind(R, *frs):
+    names, vecs = [], []
+    for f in frs:
+        f = _as_frame(f)
+        for n in f.names:
+            nm, k = n, 1
+            while nm in names:
+                nm, k = f"{n}{k}", k + 1
+            names.append(nm)
+            vecs.append(f.vec(n))
+    return Frame(names, vecs)
+
+
+def _rbind(R, *frs):
+    frs = [_as_frame(f) for f in frs]
+    return frs[0].concat_rows(*frs[1:])
+
+
+def _colnames(R, fr, idxs, names):
+    fr = _as_frame(fr)
+    idx = _col_indices(fr, idxs)
+    new = names if isinstance(names, list) else [names]
+    out = Frame(fr.names, fr.vecs)
+    for i, nm in zip(idx, new):
+        out._names[i] = str(nm)
+    return out
+
+
+def _group_by(R, fr, by, *aggspec):
+    fr = _as_frame(fr)
+    by_names = [fr.names[i] for i in _col_indices(fr, list(by))]
+    aggs = []
+    for i in range(0, len(aggspec), 3):
+        agg, col, na = aggspec[i], aggspec[i + 1], aggspec[i + 2]
+        col_name = fr.names[_col_indices(fr, col)[0]]
+        aggs.append((agg, col_name))
+    return group_by(fr, by_names, aggs)
+
+
+_PRIMS = {
+    # math / comparison
+    **{op: _prim_binop(op) for op in
+       ("+", "-", "*", "/", "^", "%%", "intDiv", "==", "!=", "<", "<=", ">",
+        ">=", "&", "|", "&&", "||")},
+    **{op: _prim_unop(op) for op in
+       ("abs", "ceiling", "floor", "trunc", "exp", "log", "log10", "log2",
+        "sqrt", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+        "tanh", "sign", "not")},
+    "is.na": lambda R, v: unop("isna", _as_vec(v)),
+    **{op: _prim_reduce(op) for op in
+       ("min", "max", "sum", "mean", "median", "sd", "var", "prod", "all",
+        "any")},
+    **{op: (lambda o: (lambda R, v: cumulative(o, _as_vec(v))))(op)
+       for op in ("cumsum", "cumprod", "cummin", "cummax")},
+    "round": lambda R, v, d=0: round_digits(_as_vec(v), int(d)),
+    "signif": lambda R, v, d=6: signif(_as_vec(v), int(d)),
+    "ifelse": lambda R, t, y, n: ifelse(_as_vec(t), _as_vec(y, 0) if isinstance(y, (Vec, Frame)) else y,
+                                        _as_vec(n, 0) if isinstance(n, (Vec, Frame)) else n),
+    "table": lambda R, v: table(_as_vec(v)),
+    "unique": lambda R, v: unique(_as_vec(v)),
+    # munging
+    "cols": _cols, "cols_py": _cols,
+    "rows": _rows,
+    "cbind": _cbind,
+    "rbind": _rbind,
+    "colnames=": _colnames,
+    "nrow": lambda R, fr: float(_as_frame(fr).nrow),
+    "ncol": lambda R, fr: float(_as_frame(fr).ncol),
+    "is.factor": lambda R, v: float(_as_vec(v).is_categorical()),
+    "as.factor": lambda R, v: _asfactor(_as_vec(v)),
+    "as.numeric": lambda R, v: _asnumeric(_as_vec(v)),
+    "GB": _group_by,
+    "merge": lambda R, l, r, all_l=False, all_r=False, by_l=None, by_r=None, method="auto":
+        merge_fn(_as_frame(l), _as_frame(r), all_left=bool(all_l), all_right=bool(all_r)),
+    "sort": lambda R, fr, by, asc=None: sort_fn(
+        _as_frame(fr),
+        [_as_frame(fr).names[i] for i in _col_indices(_as_frame(fr), by)],
+        None if asc is None else [bool(a) for a in (asc if isinstance(asc, list) else [asc])]),
+    # strings
+    "toupper": lambda R, v: strmod.toupper(_as_vec(v)),
+    "tolower": lambda R, v: strmod.tolower(_as_vec(v)),
+    "trim": lambda R, v: strmod.trim(_as_vec(v)),
+    "nchar": lambda R, v: strmod.nchar(_as_vec(v)),
+    "sub": lambda R, pat, rep, v, ic=False: strmod.sub(_as_vec(v), pat, rep, ignore_case=bool(ic)),
+    "gsub": lambda R, pat, rep, v, ic=False: strmod.gsub(_as_vec(v), pat, rep, ignore_case=bool(ic)),
+    "grep": lambda R, v, pat, ic=False, inv=False, ol=True: strmod.grep(
+        _as_vec(v), pat, ignore_case=bool(ic), invert=bool(inv),
+        output_logical=bool(ol)),
+    # time
+    **{part: (lambda p: (lambda R, v: time_part(_as_vec(v), p)))(part)
+       for part in ("year", "month", "day", "dayOfWeek", "hour", "minute",
+                    "second", "millis")},
+}
+
+
+def _asfactor(v: Vec) -> Vec:
+    return strmod.asfactor(v)
+
+
+def _asnumeric(v: Vec) -> Vec:
+    if not v.is_categorical():
+        return v
+    return Vec.from_numpy(v.to_numpy(), type="real")
+
+
+def rapids_exec(expr: str, session: Session | None = None):
+    """Module-level convenience — `Rapids.exec(String, Session)`."""
+    return Rapids(session).exec(expr)

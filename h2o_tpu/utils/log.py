@@ -1,0 +1,56 @@
+"""Logging facade — analog of `water/util/Log.java` (SURVEY.md §2.1 Logging).
+
+The reference buffers log lines until the log directory is known, writes
+per-level files, and serves the buffer cluster-wide via `/3/Logs`
+(`water/api/LogsHandler.java`). Here: a ring buffer (most recent N lines) on
+top of the stdlib logging module; `/3/Logs` reads the buffer.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+
+_LOGGER = logging.getLogger("h2o_tpu")
+_BUFFER: deque[str] = deque(maxlen=10_000)
+
+_LEVELS = {"TRACE": 5, "DEBUG": logging.DEBUG, "INFO": logging.INFO,
+           "WARN": logging.WARNING, "ERRR": logging.ERROR,
+           "FATAL": logging.CRITICAL}
+
+
+def _emit(level: str, msg: str):
+    line = (f"{time.strftime('%m-%d %H:%M:%S')} {level.ljust(5)} "
+            f"h2o_tpu: {msg}")
+    _BUFFER.append(line)
+    _LOGGER.log(_LEVELS.get(level, logging.INFO), msg)
+
+
+def trace(msg: str):
+    _emit("TRACE", msg)
+
+
+def debug(msg: str):
+    _emit("DEBUG", msg)
+
+
+def info(msg: str):
+    _emit("INFO", msg)
+
+
+def warn(msg: str):
+    _emit("WARN", msg)
+
+
+def err(msg: str):
+    _emit("ERRR", msg)
+
+
+def get_buffer() -> list[str]:
+    """Most recent log lines — the `/3/Logs` payload."""
+    return list(_BUFFER)
+
+
+def set_level(level: str):
+    _LOGGER.setLevel(_LEVELS.get(level.upper(), logging.INFO))

@@ -1,0 +1,38 @@
+"""Timeline — per-process event ring buffer. Analog of `water/TimeLine.java`
+(:12-40): a lock-free ring of the last 2048 events served at `/3/Timeline`.
+
+The reference records every RPC packet send/recv. The TPU-native equivalents
+are control-plane events: mr_task dispatches, job transitions, REST requests,
+device transfers. Recording is cheap (deque append) and always on, like the
+reference's always-on ring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+_RING: deque[dict] = deque(maxlen=2048)
+_LOCK = threading.Lock()
+
+
+def record(kind: str, what: str, **detail):
+    """Append one event; ns timestamps mirror TimeLine's nanotime entries."""
+    ev = {"ns": time.perf_counter_ns(), "ms": int(time.time() * 1000),
+          "kind": kind, "what": what}
+    if detail:
+        ev.update(detail)
+    with _LOCK:
+        _RING.append(ev)
+
+
+def snapshot() -> list[dict]:
+    """Ordered copy of the ring — the TimelineSnapshot/`/3/Timeline` payload."""
+    with _LOCK:
+        return sorted(_RING, key=lambda e: e["ns"])
+
+
+def clear():
+    with _LOCK:
+        _RING.clear()

@@ -1,0 +1,186 @@
+"""REST server + h2o-py-compatible client + Rapids string evaluator."""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o_tpu.api as h2o
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.rapids.exec import Rapids, Session
+
+
+@pytest.fixture(scope="module")
+def cloud():
+    conn = h2o.init(port=54555)
+    yield conn
+    try:
+        h2o.shutdown()
+    except Exception:
+        pass
+
+
+@pytest.fixture(scope="module")
+def csv_frame(cloud):
+    rng = np.random.default_rng(0)
+    n = 300
+    df = pd.DataFrame({"x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+    df["y"] = np.where(rng.random(n) < 1 / (1 + np.exp(-(2 * df.x1 - df.x2))),
+                       "yes", "no")
+    fd, tmp = tempfile.mkstemp(suffix=".csv")
+    os.close(fd)
+    df.to_csv(tmp, index=False)
+    fr = h2o.import_file(tmp)
+    yield fr, df
+    os.unlink(tmp)
+
+
+class TestRestApi:
+    def test_cloud_status(self, cloud):
+        c = h2o.cluster_status()
+        assert c["cloud_size"] == 1 and c["cloud_healthy"]
+
+    def test_import_parse(self, csv_frame):
+        fr, df = csv_frame
+        assert fr.nrow == len(df) and fr.ncol == 3
+        assert fr.columns == ["x1", "x2", "y"]
+        assert fr.types["y"] == "enum"
+
+    def test_frame_ops_via_rapids(self, csv_frame):
+        fr, df = csv_frame
+        assert np.isclose(fr["x1"].mean(), df.x1.mean(), atol=1e-5)
+        sub = fr[fr["x1"] > 0]
+        assert sub.nrow == int((df.x1 > 0).sum())
+        doubled = fr["x1"] * 2
+        assert np.isclose(doubled.mean(), 2 * df.x1.mean(), atol=1e-5)
+        tbl = fr["y"].table().as_data_frame()
+        assert set(tbl["row"]) == {"yes", "no"}
+
+    def test_train_predict_via_rest(self, csv_frame):
+        fr, df = csv_frame
+        m = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+        m.train(y="y", training_frame=fr)
+        assert m.auc() > 0.7
+        pred = m.predict(fr).as_data_frame()
+        assert list(pred.columns) == ["predict", "pno", "pyes"]
+        assert len(pred) == fr.nrow
+        vi = m.varimp()
+        assert vi["variable"][0] == "x1"
+
+    def test_train_with_x_subset(self, csv_frame):
+        fr, _ = csv_frame
+        m = h2o.H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+        m.train(x=["x1"], y="y", training_frame=fr)
+        assert m.auc() > 0.6
+
+    def test_model_listing_and_delete(self, csv_frame):
+        fr, _ = csv_frame
+        m = h2o.H2OGeneralizedLinearEstimator(family="binomial")
+        m.train(y="y", training_frame=fr)
+        models = h2o.connection().request("GET", "/3/Models")["models"]
+        assert any(x["model_id"]["name"] == m.model_id for x in models)
+        h2o.remove(m.model_id)
+        models = h2o.connection().request("GET", "/3/Models")["models"]
+        assert not any(x["model_id"]["name"] == m.model_id for x in models)
+
+    def test_job_failure_surfaces(self, csv_frame):
+        fr, _ = csv_frame
+        bad = h2o.H2OGradientBoostingEstimator(ntrees=3)
+        # parameter validation fails fast at POST (the reference's 412)
+        with pytest.raises((RuntimeError, h2o.H2OConnectionError),
+                           match="nonexistent_col"):
+            bad.train(y="nonexistent_col", training_frame=fr)
+
+    def test_404_for_unknown_frame(self, cloud):
+        with pytest.raises(h2o.H2OConnectionError, match="not found"):
+            h2o.connection().request("GET", "/3/Frames/no_such_frame")
+
+    def test_logs_and_timeline(self, cloud):
+        logs = h2o.connection().request("GET", "/3/Logs")
+        assert "log" in logs
+        tl = h2o.connection().request("GET", "/3/Timeline")
+        assert "events" in tl
+
+    def test_multi_file_import_rbinds(self, cloud, tmp_path):
+        for i in range(3):
+            pd.DataFrame({"a": [float(i)] * 10}).to_csv(
+                tmp_path / f"part_{i}.csv", index=False)
+        fr = h2o.import_file(str(tmp_path / "part_*.csv"))
+        assert fr.nrow == 30
+        assert np.isclose(fr["a"].mean(), 1.0, atol=1e-6)
+
+    def test_head_only_fetches_preview(self, csv_frame):
+        fr, _ = csv_frame
+        df = fr.head(7)
+        assert len(df) == 7
+
+    def test_train_with_int_x(self, csv_frame):
+        fr, _ = csv_frame
+        m = h2o.H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+        m.train(x=[0], y="y", training_frame=fr)  # index of x1
+        assert m.auc() > 0.6
+
+    def test_set_names_in_place(self, cloud):
+        fr = h2o.H2OFrame({"p": [1.0, 2.0], "q": [3.0, 4.0]})
+        fr.set_names(["r", "s"])
+        assert fr.columns == ["r", "s"]
+
+    def test_unknown_param_rejected(self, csv_frame):
+        fr, _ = csv_frame
+        bad = h2o.H2OGradientBoostingEstimator(learnrate=0.5)  # typo
+        with pytest.raises(h2o.H2OConnectionError, match="unknown parameter"):
+            bad.train(y="y", training_frame=fr)
+
+    def test_model_builders_metadata(self, cloud):
+        mb = h2o.connection().request("GET", "/3/ModelBuilders")
+        assert "gbm" in mb["model_builders"]
+        meta = h2o.connection().request("GET", "/3/ModelBuilders/gbm")
+        names = {p["name"] for p in meta["parameters"]}
+        assert {"ntrees", "max_depth", "learn_rate"} <= names
+
+
+class TestRapidsExec:
+    """Direct (no-HTTP) evaluator coverage."""
+
+    def setup_method(self):
+        self.R = Rapids(Session("t"))
+        rng = np.random.default_rng(1)
+        self.fr = Frame.from_dict(
+            {"a": np.arange(20, dtype=np.float32),
+             "b": rng.normal(size=20).astype(np.float32)}, key="rapids_fr")
+
+    def test_arith_and_reduce(self):
+        assert self.R.exec("(sum (cols rapids_fr 'a') true)") == 190.0
+        v = self.R.exec("(+ (cols rapids_fr 'a') 1)")
+        assert v.to_numpy()[0] == 1.0
+
+    def test_assign_and_reuse(self):
+        self.R.exec("(tmp= tt (* (cols rapids_fr 'a') 3))")
+        assert self.R.exec("(max tt true)") == 57.0
+        self.R.exec("(rm tt)")
+        with pytest.raises(KeyError):
+            self.R.exec("(mean tt true)")
+
+    def test_cbind_rbind_colnames(self):
+        out = self.R.exec("(cbind rapids_fr rapids_fr)")
+        assert out.ncol == 4
+        out = self.R.exec("(rbind rapids_fr rapids_fr)")
+        assert out.nrow == 40
+        out = self.R.exec("(colnames= rapids_fr [0] ['first'])")
+        assert out.names[0] == "first"
+
+    def test_ifelse_and_isna(self):
+        v = self.R.exec("(ifelse (> (cols rapids_fr 'a') 10) 1 0)")
+        assert v.to_numpy().sum() == 9
+        v = self.R.exec("(is.na (cols rapids_fr 'a'))")
+        assert v.to_numpy().sum() == 0
+
+    def test_span_selector(self):
+        out = self.R.exec("(rows rapids_fr 0:5)")
+        assert out.nrow == 5
+
+    def test_unbalanced_raises(self):
+        with pytest.raises(ValueError):
+            self.R.exec("(mean (cols rapids_fr 'a'")
