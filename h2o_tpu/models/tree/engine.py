@@ -60,6 +60,8 @@ class TreeConfig:
     drf_mode: bool = False       # trees fit at f=0, averaged at predict
     nclass: int = 1              # trees per iteration (multinomial K)
     block_rows: int = 8192       # row-block size for the histogram scan
+    use_pallas: bool | None = None  # fused VMEM histogram kernel; None = auto
+                                    # (on for TPU backend, XLA path elsewhere)
 
     @property
     def n_nodes(self) -> int:
@@ -76,16 +78,37 @@ def _block_rows(rl: int, want: int) -> int:
     return b if rl % b == 0 else rl
 
 
+def _onehot_pick(oh: jax.Array, v: jax.Array) -> jax.Array:
+    """dot(one_hot, v) that is (near-)exact for real-valued v on TPU.
+
+    The MXU multiplies in bf16 by default, so a plain dot returns bf16(v[j])
+    (2⁻⁹ relative error) even though the one-hot has a single exact 1.
+    Precision.HIGHEST fixes that but blocks fusion (measured 2.6x slower
+    end-to-end on v5e). Instead split v = hi + lo with hi bf16-representable:
+    dot(oh, hi) is exact, dot(oh, lo)'s error is ≤|v|·2⁻¹⁸ — f32-grade at
+    DEFAULT precision (two cheap matvecs)."""
+    hi = v.astype(jnp.bfloat16).astype(jnp.float32)
+    lo = v - hi
+    return (jnp.dot(oh, hi, preferred_element_type=jnp.float32)
+            + jnp.dot(oh, lo, preferred_element_type=jnp.float32))
+
+
 # ---------------------------------------------------------------------------
 # Histogram build (the ScoreBuildHistogram2 analog) — runs inside shard_map.
 # ---------------------------------------------------------------------------
-def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block):
+def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
+                      use_pallas: bool = False):
     """Accumulate hist (F, n_lv, nbins_tot, V) for nodes [offset, offset+n_lv).
 
     Xb: (Rl, F) int32 bins; node: (Rl,) int32 global node ids; vals: (Rl, V)
     accumulated channels ([w, g, h] for GBM; [wt, wty, wc, wcy] for uplift),
     already zeroed for inactive rows.
     """
+    if use_pallas:
+        from ...ops.histogram import build_level_hist_pallas
+
+        hist = build_level_hist_pallas(Xb, node, vals, offset, n_lv, nbins_tot)
+        return jax.lax.psum(hist, ROWS)
     Rl, F = Xb.shape
     V = vals.shape[1]
     rb = _block_rows(Rl, block)
@@ -223,10 +246,16 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
                  < cfg.col_sample_rate_per_tree)
     tree_cols = jnp.where(jnp.any(tree_cols), tree_cols, True)
 
+    use_pallas = cfg.use_pallas
+    if use_pallas is None:
+        from ...ops.histogram import use_pallas_default
+
+        use_pallas = use_pallas_default()
     for level in range(cfg.max_depth):
         n_lv = 2 ** level
         offset = n_lv - 1
-        hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B, cfg.block_rows)
+        hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B,
+                                 cfg.block_rows, use_pallas)
 
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
                                 cfg, tree_cols)
@@ -242,15 +271,29 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
         garr = jax.lax.dynamic_update_slice(
             garr, jnp.where(do_split, gain, 0.0).astype(jnp.float32), (offset,))
 
-        # route rows: only rows at split nodes of this level descend
+        # Route rows: only rows at split nodes of this level descend.
+        # Per-row dynamic gathers (bf[lc], Xb[r, bf]) are catastrophically
+        # slow on TPU (~20-40 ns/row on the VPU's serial gather path); instead
+        # every per-node quantity is broadcast to rows through one-hot
+        # matmuls, which ride the MXU (SURVEY.md §"hard parts" — TPUs lack
+        # fast generic scatter/gather).
         local = node - offset
         active = (local >= 0) & (local < n_lv)
         lc = jnp.clip(local, 0, n_lv - 1)
-        row_bf = bf[lc]
-        row_bb = bb[lc]
-        row_nal = bnal[lc]
-        row_split = do_split[lc] & active
-        rb_val = jnp.take_along_axis(Xb, row_bf[:, None], axis=1)[:, 0]
+        n_oh = jax.nn.one_hot(lc, n_lv, dtype=jnp.float32)        # (Rl, n_lv)
+        S = jax.nn.one_hot(bf, F, dtype=jnp.float32)              # (n_lv, F)
+        # TPU matmuls default to bf16 multiplies; these dots move small
+        # INTEGERS (bin ids < nbins, 0/1 flags) through 0/1 one-hots, which
+        # bf16 represents exactly up to 256 — above that, force full f32.
+        prec = (jax.lax.Precision.HIGHEST if cfg.nbins >= 255
+                else jax.lax.Precision.DEFAULT)
+        # bin of each row's split feature: Σ_n n_oh[r,n]·(Xb·Sᵀ)[r,n]
+        xbs = jnp.dot(Xb.astype(jnp.float32), S.T, precision=prec,
+                      preferred_element_type=jnp.float32)         # (Rl, n_lv)
+        rb_val = jnp.sum(xbs * n_oh, axis=1)
+        row_bb = jnp.dot(n_oh, bb.astype(jnp.float32), precision=prec)
+        row_nal = jnp.dot(n_oh, bnal.astype(jnp.float32)) > 0.5
+        row_split = (jnp.dot(n_oh, do_split.astype(jnp.float32)) > 0.5) & active
         go_right = jnp.where(rb_val == cfg.nbins, ~row_nal, rb_val > row_bb)
         node = jnp.where(row_split, 2 * node + 1 + go_right.astype(jnp.int32), node)
 
@@ -289,17 +332,25 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None):
             else:
                 s = jnp.ones(w.shape[-1:], jnp.float32)
             g, h = grad_fn(y, f, w)
+            # leaf-value broadcast rides the MXU too (vl[node] is a per-row
+            # dynamic gather otherwise — see the routing comment in _grow_tree)
+            def leaf_delta(vlk, nodek):
+                # leaf values are real f32 — hi/lo split keeps the carried
+                # residuals f32-grade without Precision.HIGHEST's fusion cost
+                oh = jax.nn.one_hot(nodek, cfg.n_nodes, dtype=jnp.float32)
+                return _onehot_pick(oh, vlk)
+
             if K == 1:
                 ft, th, nl, vl, ga, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg)
-                delta = vl[node]
+                delta = leaf_delta(vl, node)
             else:
                 grow = jax.vmap(
                     lambda gk, hk, ck: _grow_tree(Xb, gk * s, hk * s, w * s,
                                                   edges, edge_ok, ck, cfg))
                 ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
                 ft, th, nl, vl, ga, node = grow(g, h, ckeys)
-                delta = jnp.take_along_axis(vl, node, axis=1)
+                delta = jax.vmap(leaf_delta)(vl, node)
             f = f + delta
             return f, (ft, th, nl, vl, ga)
 
@@ -322,22 +373,39 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None):
 # ---------------------------------------------------------------------------
 def predict_forest(X, feat, thr, nanL, val, max_depth: int):
     """X: (R, F) raw values. feat/thr/nanL/val: (T, [K,] N). Returns summed
-    tree outputs (R,) or (R, K)."""
+    tree outputs (R,) or (R, K).
+
+    Traversal broadcasts per-node split params to rows through one-hot
+    matmuls instead of per-row gathers (same MXU-over-gather rationale as the
+    training-side routing in _grow_tree)."""
     multi = feat.ndim == 3
+    N = feat.shape[-1]
 
     def one_tree(acc, tree):
         ft, th, nl, vl = tree
 
         def traverse(ftk, thk, nlk, vlk):
             node = jnp.zeros(X.shape[0], dtype=jnp.int32)
+            S = jax.nn.one_hot(jnp.clip(ftk, 0), X.shape[1],
+                               dtype=jnp.float32)               # (N, F)
+            Xz = jnp.nan_to_num(X)
+            isnan_f = jnp.isnan(X).astype(jnp.float32)
             for _ in range(max_depth):
-                nf = ftk[node]
-                is_leaf = nf < 0
-                x = jnp.take_along_axis(X, jnp.clip(nf, 0)[:, None], axis=1)[:, 0]
-                go_right = jnp.where(jnp.isnan(x), ~nlk[node], x > thk[node])
+                n_oh = jax.nn.one_hot(node, N, dtype=jnp.float32)   # (R, N)
+                P_feat = jnp.dot(n_oh, S,
+                                 preferred_element_type=jnp.float32)  # (R, F)
+                x = jnp.sum(P_feat * Xz, axis=1)
+                x_nan = jnp.sum(P_feat * isnan_f, axis=1) > 0.5
+                is_leaf = jnp.dot(n_oh, (ftk < 0).astype(jnp.float32)) > 0.5
+                # thresholds are real f32 values: a plain bf16 multiply would
+                # misroute rows whose value falls inside the rounding gap
+                row_thr = _onehot_pick(n_oh, thk)
+                row_nal = jnp.dot(n_oh, nlk.astype(jnp.float32)) > 0.5
+                go_right = jnp.where(x_nan, ~row_nal, x > row_thr)
                 nxt = 2 * node + 1 + go_right.astype(jnp.int32)
                 node = jnp.where(is_leaf, node, nxt)
-            return vlk[node]
+            n_oh = jax.nn.one_hot(node, N, dtype=jnp.float32)
+            return _onehot_pick(n_oh, vlk)
 
         if multi:
             out = jax.vmap(traverse)(ft, th, nl, vl).T  # (R, K)

@@ -1,0 +1,139 @@
+"""Pallas TPU kernel: fused score+bin histogram accumulation.
+
+The GBM hot loop (`hex/tree/ScoreBuildHistogram2.java:16-62`) accumulates, per
+tree level, a {w, g, h} histogram over (feature, leaf, bin). The XLA path
+(`models/tree/engine.py:_build_level_hist`) expresses this as two one-hot
+expansions feeding one einsum per row-block inside a `lax.scan`.
+
+This kernel is the same contraction — lhs (rows, n_lv·V) = leaf-one-hot ⊗
+channel values, rhs (rows, F·B) = bin-one-hot, accumulated as an MXU matmul
+into a VMEM-resident (n_lv·V, F·B) histogram — but with both one-hots
+materialized ONLY in VMEM per row-tile and the accumulator pinned in VMEM
+across the whole row grid (the analog of ScoreBuildHistogram2's private
+per-thread histograms, merged for free because the TPU grid is sequential).
+Nothing but the final histogram touches HBM.
+
+MXU shape note: for V=3 channels the lhs sublane extent is n_lv·3 ≤ 96 up to
+depth 5, so the systolic array is well utilized; the bin one-hot's lane extent
+F·B is a multiple of 128 only by padding, which the compiler handles.
+
+Outside shard_map callers psum the result over the `rows` mesh axis exactly as
+the XLA path does — the kernel is shard-local.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel import mesh as meshmod
+
+
+def _row_tile(rl: int, want: int) -> int:
+    if rl % want == 0:
+        return want
+    b = 1
+    while b * 2 <= want and rl % (b * 2) == 0:
+        b *= 2
+    return b if rl % b == 0 else rl
+
+
+def _hist_kernel(xb_ref, lc_ref, vals_ref, out_ref, *, n_lv: int, B: int):
+    """All shapes stay 2-D (Mosaic rejects minor-dim reshapes): the ⊗ and
+    one-hot expansions are built with iota arithmetic + tiny selection-matrix
+    matmuls instead of reshape/tile."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xb = xb_ref[:]                     # (TR, F) int32 bin ids
+    lc = lc_ref[:]                     # (TR, 1) int32 local leaf ids
+    vals = vals_ref[:]                 # (TR, V) f32 channels (masked upstream)
+    TR, F = xb.shape
+    V = vals.shape[1]
+    M = n_lv * V
+
+    # lhs (TR, M): column c ≙ (leaf n = c//V, channel v = c%V);
+    # value = (lc == n) * vals[:, v]
+    c_m = jax.lax.broadcasted_iota(jnp.int32, (TR, M), 1)
+    n_oh = (lc == c_m // V).astype(jnp.float32)               # (TR, M)
+    sel_v = (jax.lax.broadcasted_iota(jnp.int32, (V, M), 1) % V
+             == jax.lax.broadcasted_iota(jnp.int32, (V, M), 0)
+             ).astype(jnp.float32)                            # (V, M) const
+    vals_exp = jnp.dot(vals, sel_v, preferred_element_type=jnp.float32)
+    lhs = n_oh * vals_exp
+
+    # rhs (TR, F*B): column c ≙ (feature f = c//B, bin b = c%B);
+    # value = (xb[:, f] == b)
+    FB = F * B
+    sel_f = (jax.lax.broadcasted_iota(jnp.int32, (F, FB), 1) // B
+             == jax.lax.broadcasted_iota(jnp.int32, (F, FB), 0)
+             ).astype(jnp.float32)                            # (F, FB) const
+    xb_exp = jnp.dot(xb.astype(jnp.float32), sel_f,
+                     preferred_element_type=jnp.float32)      # (TR, FB)
+    b_m = (jax.lax.broadcasted_iota(jnp.int32, (TR, FB), 1) % B
+           ).astype(jnp.float32)
+    rhs = (xb_exp == b_m).astype(jnp.float32)
+
+    out_ref[:] += jax.lax.dot_general(
+        lhs, rhs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # (M, F*B)
+
+
+@functools.partial(jax.jit, static_argnames=("n_lv", "B", "tile", "interpret"))
+def _hist_call(Xb, lc, vals, n_lv: int, B: int, tile: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Rl, F = Xb.shape
+    V = vals.shape[1]
+    TR = _row_tile(Rl, tile)
+    grid = (Rl // TR,)
+
+    kernel = functools.partial(_hist_kernel, n_lv=n_lv, B=B)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TR, F), lambda i: (i, 0)),
+            pl.BlockSpec((TR, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TR, V), lambda i: (i, 0)),
+        ],
+        # accumulator: same block every grid step → stays resident in VMEM
+        out_specs=pl.BlockSpec((n_lv * V, F * B), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_lv * V, F * B), jnp.float32),
+        interpret=interpret,
+    )(Xb, lc.reshape(-1, 1), vals)
+    # rows are (leaf-major, channel-minor): (n_lv*V, F*B) → (F, n_lv, B, V)
+    return out.reshape(n_lv, V, F, B).transpose(2, 0, 3, 1)
+
+
+def use_pallas_default() -> bool:
+    """Measured on v5e: the whole-shard one-hot einsum (XLA fuses it into a
+    single MXU contraction) beats this kernel once per-row gathers were
+    eliminated from routing (engine.py) — the kernel's per-grid-step overhead
+    dominates at histogram sizes. Kept opt-in (TreeConfig.use_pallas=True)
+    as the substrate for deeper-tree / wider-bin configs where the one-hot
+    HBM materialization starts to matter."""
+    return False
+
+
+def build_level_hist_pallas(Xb, node, vals, offset: int, n_lv: int, B: int,
+                            tile: int = 2048, interpret: bool | None = None):
+    """Drop-in for engine._build_level_hist's accumulation (pre-psum).
+
+    Xb (Rl, F) int32; node (Rl,) int32 global ids; vals (Rl, V) f32.
+    Rows outside [offset, offset+n_lv) contribute nothing.
+    """
+    if interpret is None:
+        # compile via Mosaic on TPU; emulate elsewhere (CPU test mesh)
+        interpret = jax.default_backend() != "tpu" 
+    local = node - offset
+    active = (local >= 0) & (local < n_lv)
+    lc = jnp.where(active, local, n_lv)  # n_lv = dead leaf id, matches no iota
+    v = jnp.where(active[:, None], vals, 0.0)
+    return _hist_call(Xb, lc.astype(jnp.int32), v, n_lv, B, tile, interpret)

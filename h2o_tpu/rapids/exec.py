@@ -354,7 +354,7 @@ _PRIMS = {
     "as.numeric": lambda R, v: _asnumeric(_as_vec(v)),
     "GB": _group_by,
     "merge": lambda R, l, r, all_l=False, all_r=False, by_l=None, by_r=None, method="auto":
-        merge_fn(_as_frame(l), _as_frame(r), all_left=bool(all_l), all_right=bool(all_r)),
+        merge_fn(_as_frame(l), _as_frame(r), all_x=bool(all_l), all_y=bool(all_r)),
     "sort": lambda R, fr, by, asc=None: sort_fn(
         _as_frame(fr),
         [_as_frame(fr).names[i] for i in _col_indices(_as_frame(fr), by)],

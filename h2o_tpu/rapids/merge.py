@@ -77,7 +77,11 @@ def merge(left: Frame, right: Frame, by: list[str] | None = None,
             rk[:, j] = np.array([remap.get(rv.domain[int(c)], -np.inf)
                                  if np.isfinite(c) else c for c in rk[:, j]])
 
-    r_order = np.lexsort(rk.T[::-1])
+    from ..backend.native import radix_lexsort
+
+    # native parallel radix (RadixOrder/BinaryMerge's role) above the
+    # size threshold; np.lexsort below it
+    r_order = radix_lexsort([rk[:, j] for j in range(rk.shape[1])])
     rk_s = rk[r_order]
 
     # for each left row: range of matching right rows in sorted order
