@@ -51,7 +51,8 @@ def main():
     # and the timed run MUST share score_tree_interval — otherwise the timed
     # run recompiles (a 20-40s artifact that the reference's warm JVM never
     # pays in its CI bands).
-    interval = min(int(os.environ.get("H2O_TPU_BENCH_INTERVAL", 10)), ntrees)
+    interval = max(1, min(int(os.environ.get("H2O_TPU_BENCH_INTERVAL", 10)),
+                          ntrees))
     while ntrees % interval:  # warm-up compiles ONE chunk length; make the
         interval -= 1         # chunks uniform so no remainder-chunk recompile
     params = GBMParameters(training_frame=fr, response_column="response",

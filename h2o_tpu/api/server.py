@@ -109,9 +109,14 @@ def _make_handler(server: H2OServer):
 
         # -- plumbing --------------------------------------------------------
         def _reply(self, status: int, payload: dict):
-            data = json.dumps(payload).encode()
+            if "__html__" in payload:
+                data = payload["__html__"].encode()
+                ctype = "text/html; charset=utf-8"
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -160,10 +165,46 @@ def _make_handler(server: H2OServer):
 # ---------------------------------------------------------------------------
 # routing table (`RequestServer.java:157` route registration)
 # ---------------------------------------------------------------------------
+_FLOW_HTML = """<!doctype html><html><head><title>h2o_tpu</title><style>
+body{font-family:monospace;margin:2em;background:#fafafa}h1{color:#333}
+table{border-collapse:collapse;margin:1em 0}td,th{border:1px solid #ccc;
+padding:4px 10px;text-align:left}th{background:#eee}</style></head><body>
+<h1>h2o_tpu</h1><div id=cloud></div>
+<h2>Frames</h2><table id=frames><tr><th>key</th><th>rows</th><th>cols</th></tr></table>
+<h2>Models</h2><table id=models><tr><th>key</th><th>algo</th><th>category</th></tr></table>
+<h2>Jobs</h2><table id=jobs><tr><th>key</th><th>description</th><th>status</th><th>progress</th></tr></table>
+<script>
+async function j(u){return (await fetch(u)).json()}
+function row(cells){const tr=document.createElement('tr');
+ for(const c of cells){const td=document.createElement('td');
+  td.textContent=c==null?'':String(c);tr.appendChild(td)}return tr}
+function fill(id,head,rows){const t=document.getElementById(id);
+ t.replaceChildren();const hr=document.createElement('tr');
+ for(const h of head){const th=document.createElement('th');
+  th.textContent=h;hr.appendChild(th)}t.appendChild(hr);
+ for(const r of rows)t.appendChild(row(r))}
+async function refresh(){
+ const c=await j('/3/Cloud');
+ document.getElementById('cloud').textContent=
+   `cloud ${c.cloud_name} v${c.version} — ${c.nodes[0].num_cpus} device(s), backend ${c.nodes[0].backend}`;
+ const fr=await j('/3/Frames');
+ fill('frames',['key','rows','cols'],fr.frames.map(f=>[f.frame_id.name,f.rows,f.num_columns]));
+ const mo=await j('/3/Models');
+ fill('models',['key','algo','category'],mo.models.map(m=>[m.model_id.name,m.algo,m.output.model_category]));
+ const jb=await j('/3/Jobs');
+ fill('jobs',['key','description','status','progress'],
+   jb.jobs.map(x=>[x.key.name,x.description,x.status,(100*x.progress).toFixed(0)+'%']));
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+
 def route(server: H2OServer, method: str, parts: list[str], query: dict,
           body: dict) -> tuple[int, dict]:
-    if not parts:
-        return 200, {"h2o": server.name, "version": __version__}
+    if not parts or parts[0] in ("flow", "index.html"):
+        # minimal Flow stand-in: a live status page over the JSON API
+        # (the reference serves the h2o-flow notebook UI here, `h2o-web/`)
+        return 200, {"__html__": _FLOW_HTML}
     ver, rest = parts[0], parts[1:]
     if ver not in ("3", "99", "4"):
         return _err(404, f"unknown api version {ver}")
@@ -199,8 +240,17 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         import glob as _glob
         import os
 
-        hits = sorted(_glob.glob(path)) if any(c in path for c in "*?[") \
-            else ([path] if os.path.exists(path) else [])
+        if "://" in path:  # URI schemes resolve through the Persist SPI
+            from ..io.persist import localize
+
+            try:
+                hits = [localize(path)]
+            except (OSError, ValueError, NotImplementedError):
+                hits = []
+        elif any(c in path for c in "*?["):
+            hits = sorted(_glob.glob(path))
+        else:
+            hits = [path] if os.path.exists(path) else []
         return 200, {"files": hits, "destination_frames": hits,
                      "fails": [] if hits else [path], "dels": []}
     if head == "ParseSetup" and method == "POST":

@@ -31,9 +31,15 @@ def _build() -> str | None:
         if not os.path.exists(src):
             return None
         os.makedirs(_CACHE, exist_ok=True)
-        out = os.path.join(_CACHE, "libh2otpu.so")
-        if (os.path.exists(out)
-                and os.path.getmtime(out) >= os.path.getmtime(src)):
+        # content-hashed artifact name: a stale or foreign .so (different
+        # source, different machine — -march=native is not portable) never
+        # gets picked up; rebuilds happen exactly when the source changes
+        import hashlib
+
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:12]
+        out = os.path.join(_CACHE, f"libh2otpu-{tag}.so")
+        if os.path.exists(out):
             return out
         cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
                "-pthread", src, "-o", out]
@@ -58,7 +64,13 @@ def lib() -> ctypes.CDLL | None:
         path = _build()
         if path is None:
             return None
-        L = ctypes.CDLL(path)
+        try:
+            L = ctypes.CDLL(path)
+        except OSError as e:  # incompatible binary → numpy fallback
+            from ..utils.log import warn
+
+            warn(f"native library load failed ({e}); using numpy fallbacks")
+            return None
         u64p = ctypes.POINTER(ctypes.c_uint64)
         i64p = ctypes.POINTER(ctypes.c_int64)
         L.h2otpu_radix_argsort_u64.argtypes = [u64p, ctypes.c_int64, i64p,

@@ -221,7 +221,13 @@ def import_file(path: str, destination_frame: str | None = None,
                 col_names: Sequence[str] | None = None,
                 col_types: dict | None = None,
                 na_strings: Sequence[str] | None = None, mesh=None) -> Frame:
-    """Public ingest entry — mirrors `h2o.import_file` (`h2o-py/h2o/h2o.py:323`)."""
+    """Public ingest entry — mirrors `h2o.import_file` (`h2o-py/h2o/h2o.py:323`).
+
+    Accepts local paths and registered URI schemes (http(s)://, file://; the
+    Persist SPI, see io/persist.py)."""
+    from .persist import localize
+
     setup = ParseSetup(separator=sep, header=header, column_names=col_names,
                        column_types=col_types, na_strings=na_strings)
-    return parse_file(path, setup, mesh=mesh, dest_key=destination_frame)
+    return parse_file(localize(path), setup, mesh=mesh,
+                      dest_key=destination_frame)

@@ -1,0 +1,51 @@
+"""Persist backends — URI-scheme dispatch for ingest/export.
+
+Analog of the `water/persist/Persist.java` SPI + `PersistManager` scheme
+routing (local FS, NFS, HDFS, S3, GCS, HTTP in the reference; each backend a
+separate gradle module). Here: local paths and http(s) are built in; cloud
+schemes raise a clear gate (their SDKs aren't in the image — the SPI point to
+extend is `register_scheme`)."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import urllib.request
+from typing import Callable
+
+_SCHEMES: dict[str, Callable[[str], str]] = {}
+
+
+def register_scheme(scheme: str, fetch: Callable[[str], str]) -> None:
+    """Register a handler mapping a URI to a local file path — the Persist
+    SPI extension point (`water/persist/PersistManager.java`)."""
+    _SCHEMES[scheme] = fetch
+
+
+def _fetch_http(uri: str) -> str:
+    suffix = os.path.splitext(uri.split("?")[0])[1] or ".dat"
+    fd, tmp = tempfile.mkstemp(suffix=suffix, prefix="h2o_tpu_dl_")
+    os.close(fd)
+    urllib.request.urlretrieve(uri, tmp)  # noqa: S310 — user-requested URI
+    return tmp
+
+
+register_scheme("http", _fetch_http)
+register_scheme("https", _fetch_http)
+register_scheme("file", lambda uri: uri[len("file://"):])
+
+
+def localize(path: str) -> str:
+    """Resolve a path/URI to a local filesystem path (downloading if the
+    scheme requires it). Local paths pass through untouched."""
+    if "://" not in path:
+        return path
+    scheme = path.split("://", 1)[0].lower()
+    if scheme in _SCHEMES:
+        return _SCHEMES[scheme](path)
+    if scheme in ("s3", "s3a", "s3n", "gs", "hdfs", "drive"):
+        raise NotImplementedError(
+            f"persist backend '{scheme}://' needs its cloud SDK (not in this "
+            f"image); register one with h2o_tpu.io.persist.register_scheme("
+            f"'{scheme}', fetch_fn) — the Persist SPI hook")
+    raise ValueError(f"unknown URI scheme in {path!r}")

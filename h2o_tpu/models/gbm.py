@@ -52,6 +52,10 @@ class GBMParameters(Parameters):
     quantile_alpha: float = 0.5
     huber_alpha: float = 0.9
     reg_lambda: float = 0.0
+    custom_distribution_func: object = None  # Distribution-like object for
+                                             # distribution="custom" — the
+                                             # `water/udf` custom-distribution
+                                             # UDF analog (in-process Python)
 
 
 class GBMModel(Model):
@@ -122,6 +126,11 @@ class GBM(ModelBuilder):
         if self.drf_mode:
             return Gaussian()  # DRF leaves = per-leaf response means
         name = (p.distribution or "AUTO").upper()
+        if name == "CUSTOM":
+            if p.custom_distribution_func is None:
+                raise ValueError("distribution='custom' requires "
+                                 "custom_distribution_func")
+            return p.custom_distribution_func
         if name == "AUTO":
             name = {"Binomial": "bernoulli", "Multinomial": "multinomial",
                     "Regression": "gaussian"}[category]
@@ -167,7 +176,15 @@ class GBM(ModelBuilder):
 
         grad_fn = self._make_grad_fn(dist, K)
         cfg = self._tree_config(K)
-        train_fn = make_train_fn(cfg, grad_fn, mesh)
+        # the cache key must pin everything grad_fn's behavior depends on;
+        # custom distribution UDFs bypass the cache entirely (an id()-based
+        # key could alias a new UDF at a recycled address after GC)
+        if p.custom_distribution_func is dist:
+            grad_key = None
+        else:
+            grad_key = (type(self).__name__, self.drf_mode, K, dist.name,
+                        p.tweedie_power, p.quantile_alpha, p.huber_alpha)
+        train_fn = make_train_fn(cfg, grad_fn, mesh, cache_key=grad_key)
 
         if K > 1:
             y_k = jnp.broadcast_to(y, (K, y.shape[0]))

@@ -66,6 +66,16 @@ class Grid(Keyed):
     def model_count(self):
         return len(self.models)
 
+    def summary_table(self, by: str | None = None):
+        """Grid summary as a TwoDimTable (the `Grid.createSummaryTable` shape)."""
+        from ..utils.twodimtable import TwoDimTable
+
+        rows = self.summary(by)
+        if not rows:
+            return TwoDimTable(table_header="Grid Summary")
+        cols = {k: [r.get(k) for r in rows] for k in rows[0]}
+        return TwoDimTable.from_dict("Grid Summary", cols)
+
     def summary(self, by: str | None = None):
         ms = self.sorted_models(by)
         metric, _ = _sort_metric(ms[0], by, None) if ms else ("mse", False)

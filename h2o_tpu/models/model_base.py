@@ -65,6 +65,10 @@ class Parameters:
     stopping_tolerance: float = 1e-3
     checkpoint: Any = None          # prior model (or its key) to continue from
     export_checkpoints_dir: Optional[str] = None  # in-training snapshots
+    custom_metric_func: Any = None  # callable(y, raw_pred, w) -> (name, value)
+                                    # — the CFuncRef/CMetricFunc UDF analog
+                                    # (`water/udf/`, `hex/CMetricScoringTask`);
+                                    # in-process Python replaces uploaded jars
 
     def clone(self, **overrides):
         return dataclasses.replace(self, **overrides)
@@ -154,6 +158,37 @@ class Model(Keyed):
 
     def auc(self):
         return getattr(self.output.training_metrics, "auc", None)
+
+    # -- tabular views (`water/util/TwoDimTable` publications) ----------------
+    def varimp_table(self):
+        vi = self.output.variable_importances
+        if not vi:
+            return None
+        from ..utils.twodimtable import TwoDimTable
+
+        return TwoDimTable.from_dict("Variable Importances", {
+            "variable": list(vi["variable"]),
+            "relative_importance": [float(x) for x in vi["relative_importance"]],
+            "scaled_importance": [float(x) for x in vi["scaled_importance"]],
+            "percentage": [float(x) for x in vi["percentage"]]})
+
+    def scoring_history_table(self):
+        hist = self.output.scoring_history
+        if not hist:
+            return None
+        from ..utils.twodimtable import TwoDimTable
+
+        cols: dict[str, list] = {}
+        for h in hist:
+            for k, v in h.items():
+                if k == "training_metrics":
+                    for mk in ("logloss", "auc", "rmse", "mse"):
+                        mv = getattr(v, mk, None)
+                        if mv is not None:
+                            cols.setdefault(f"training_{mk}", []).append(float(mv))
+                elif isinstance(v, (int, float, str)):
+                    cols.setdefault(k, []).append(v)
+        return TwoDimTable.from_dict("Scoring History", cols)
 
     # -- binary export/import (`hex/Model.java` exportBinaryModel) ------------
     def save(self, path: str) -> str:
@@ -277,6 +312,7 @@ class ModelBuilder:
                 model = self._train_with_cv(self.job)
             else:
                 model = self.build_impl(self.job)
+            self._apply_custom_metric(model)
             model.output.run_time_ms = int((time.time() - t0) * 1000)
             self.job.dest_key = model.key
             return model
@@ -286,6 +322,26 @@ class ModelBuilder:
 
     def train_model(self) -> Model:
         return self.train(background=False).join()
+
+    def _apply_custom_metric(self, model: Model) -> None:
+        """One extra scoring pass evaluating the user's metric UDF, attached
+        to the training metrics — `hex/CMetricScoringTask` role."""
+        cmf = getattr(self.params, "custom_metric_func", None)
+        m = model.output.training_metrics
+        if not callable(cmf) or m is None or not self.supervised:
+            return
+        fr = self.params.training_frame
+        try:
+            X = model.adapt_frame(fr)
+            raw = np.asarray(model.score0(X))[: fr.nrow]
+        except NotImplementedError:
+            return
+        y = fr.vec(self.params.response_column).to_numpy()
+        w = (np.nan_to_num(fr.vec(self.params.weights_column).to_numpy())
+             if self.params.weights_column else np.ones(fr.nrow, np.float32))
+        name, value = cmf(y, raw, w)
+        m.custom_metric_name = name
+        m.custom_metric_value = float(value)
 
     # -- cross-validation (`hex/ModelBuilder.java:614`) -----------------------
     def _train_with_cv(self, job: Job) -> Model:

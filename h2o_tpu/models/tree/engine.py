@@ -309,7 +309,11 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
     return feat, thr, nanL, val, garr, node
 
 
-def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None):
+_TRAIN_FN_CACHE: dict = {}
+
+
+def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
+                  cache_key=None):
     """Build the jitted multi-tree trainer.
 
     grad_fn(y, f, w) -> (g, h) with f the running link-scale prediction carried
@@ -317,10 +321,20 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None):
     per-class trees of one iteration are vmapped — the analog of the fused
     K-trees-per-iteration pass (`hex/tree/SharedTree.java:361-363`).
 
+    ``cache_key`` (hashable summary of what grad_fn computes) enables reuse of
+    the jitted program across builder instances — without it every GBM() gets
+    a fresh closure and jax's compile cache misses (AdaBoost re-trains a
+    learner per round; a per-learner recompile turned 30 stumps into minutes).
+
     Returns train(Xb, y, w, f0, edges, edge_ok, key, ntrees_chunk) ->
     (f, (feat, thr, nanL, val) stacked over trees).
     """
     mesh = mesh or default_mesh()
+    if cache_key is not None:
+        full_key = (cfg, cache_key, id(mesh))
+        hit = _TRAIN_FN_CACHE.get(full_key)
+        if hit is not None:
+            return hit
     K = cfg.nclass
 
     def spmd(Xb, y, w, f, edges, edge_ok, keys):
@@ -364,7 +378,10 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None):
         out_specs=(fspec, (P(), P(), P(), P(), P())),
         check_vma=False,
     )
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+    if cache_key is not None:
+        _TRAIN_FN_CACHE[(cfg, cache_key, id(mesh))] = jitted
+    return jitted
 
 
 # ---------------------------------------------------------------------------

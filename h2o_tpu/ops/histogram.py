@@ -64,7 +64,11 @@ def _hist_kernel(xb_ref, lc_ref, vals_ref, out_ref, *, n_lv: int, B: int):
     sel_v = (jax.lax.broadcasted_iota(jnp.int32, (V, M), 1) % V
              == jax.lax.broadcasted_iota(jnp.int32, (V, M), 0)
              ).astype(jnp.float32)                            # (V, M) const
-    vals_exp = jnp.dot(vals, sel_v, preferred_element_type=jnp.float32)
+    # HIGHEST: vals are real f32 gradients/hessians — a default bf16 multiply
+    # would round every histogram contribution by 2^-9 (engine.py's hi/lo
+    # trick is the fast path; this opt-in kernel favors exactness)
+    vals_exp = jnp.dot(vals, sel_v, precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
     lhs = n_oh * vals_exp
 
     # rhs (TR, F*B): column c ≙ (feature f = c//B, bin b = c%B);
@@ -74,13 +78,15 @@ def _hist_kernel(xb_ref, lc_ref, vals_ref, out_ref, *, n_lv: int, B: int):
              == jax.lax.broadcasted_iota(jnp.int32, (F, FB), 0)
              ).astype(jnp.float32)                            # (F, FB) const
     xb_exp = jnp.dot(xb.astype(jnp.float32), sel_f,
-                     preferred_element_type=jnp.float32)      # (TR, FB)
+                     precision=jax.lax.Precision.HIGHEST,  # bin ids must stay
+                     preferred_element_type=jnp.float32)   # ==-exact (TR, FB)
     b_m = (jax.lax.broadcasted_iota(jnp.int32, (TR, FB), 1) % B
            ).astype(jnp.float32)
     rhs = (xb_exp == b_m).astype(jnp.float32)
 
     out_ref[:] += jax.lax.dot_general(
         lhs, rhs, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,  # lhs holds real f32 channels
         preferred_element_type=jnp.float32)                   # (M, F*B)
 
 

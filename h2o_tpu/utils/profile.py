@@ -1,0 +1,56 @@
+"""Per-task phase profiling — analog of MRTask's `.profile()`
+(`water/MRTask.java:190-194,321-383` MRProfile: setup/RPC/map/reduce/block
+times and payload sizes per distributed task).
+
+Usage mirrors the reference's opt-in profile flag::
+
+    with task_profile("gbm.histogram") as prof:
+        with prof.phase("map"):
+            ...device dispatch...
+        with prof.phase("reduce"):
+            ...
+
+Each phase lands in the process timeline ring (utils/timeline.py → served at
+`/3/Timeline`) and in the returned record, so the jax profiler covers device
+internals while this covers the host-side task anatomy."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from . import timeline
+
+
+class TaskProfile:
+    def __init__(self, name: str):
+        self.name = name
+        self.phases: dict[str, float] = {}
+        self.t_start = time.perf_counter()
+        self.t_total = 0.0
+
+    @contextlib.contextmanager
+    def phase(self, phase_name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases[phase_name] = self.phases.get(phase_name, 0.0) + dt
+
+    def summary(self) -> dict:
+        return {"task": self.name, "total_s": self.t_total, **{
+            f"{k}_s": round(v, 6) for k, v in self.phases.items()}}
+
+
+@contextlib.contextmanager
+def task_profile(name: str):
+    prof = TaskProfile(name)
+    try:
+        yield prof
+    finally:
+        prof.t_total = time.perf_counter() - prof.t_start
+        timeline.record("task", name,
+                        **{f"{k}_s": round(v, 6)
+                           for k, v in prof.phases.items()},
+                        total_s=round(prof.t_total, 6))

@@ -1,0 +1,142 @@
+"""Aux subsystems: TwoDimTable, profiling, custom metric UDF, persist SPI,
+Flow status page, logging ring."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.utils import timeline
+from h2o_tpu.utils.log import get_buffer, info
+from h2o_tpu.utils.profile import task_profile
+from h2o_tpu.utils.twodimtable import TwoDimTable
+
+
+class TestTwoDimTable:
+    def test_build_render_roundtrip(self):
+        t = TwoDimTable.from_dict("T", {"name": ["a", "b"], "v": [1.5, 2.0]})
+        assert t.nrow == 2 and t.ncol == 2
+        assert t[1, "v"] == 2.0
+        s = repr(t)
+        assert "T" in s and "1.50000" in s
+        df = t.as_data_frame()
+        assert list(df.columns) == ["name", "v"] and len(df) == 2
+
+    def test_model_varimp_table(self):
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+
+        rng = np.random.default_rng(0)
+        n = 300
+        fr = Frame.from_dict({"a": rng.normal(size=n).astype(np.float32),
+                              "b": rng.normal(size=n).astype(np.float32)})
+        y = (fr.vec("a").to_numpy() > 0).astype(np.float32)
+        fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=3, max_depth=3, seed=1)).train_model()
+        vt = m.varimp_table()
+        assert vt[0, "variable"] == "a"  # the true signal ranks first
+        st = m.scoring_history_table()
+        assert st.nrow >= 1 and "number_of_trees" in st.col_header
+
+
+class TestProfiling:
+    def test_task_profile_records_timeline(self):
+        timeline.clear()
+        with task_profile("unit.test") as prof:
+            with prof.phase("map"):
+                pass
+            with prof.phase("reduce"):
+                pass
+        evs = [e for e in timeline.snapshot() if e["what"] == "unit.test"]
+        assert len(evs) == 1
+        assert "map_s" in evs[0] and "reduce_s" in evs[0]
+
+    def test_log_ring(self):
+        info("hello-ring")
+        assert any("hello-ring" in line for line in get_buffer())
+
+
+class TestCustomMetric:
+    def test_udf_attached_to_training_metrics(self):
+        from h2o_tpu.models.glm import GLM, GLMParameters
+
+        rng = np.random.default_rng(0)
+        n = 200
+        x = rng.normal(size=n).astype(np.float32)
+        y = 2 * x + 1
+        fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+
+        def mae_metric(y_true, raw, w):
+            return "my_mae", float(np.mean(np.abs(y_true - raw)))
+
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              custom_metric_func=mae_metric)).train_model()
+        tm = m.output.training_metrics
+        assert tm.custom_metric_name == "my_mae"
+        assert tm.custom_metric_value < 0.1
+
+
+class TestPersistSPI:
+    def test_file_scheme_and_unknown(self, tmp_path):
+        from h2o_tpu.io.persist import localize
+
+        p = tmp_path / "x.csv"
+        p.write_text("a\n1\n")
+        assert localize(f"file://{p}") == str(p)
+        assert localize(str(p)) == str(p)
+        with pytest.raises(NotImplementedError, match="s3"):
+            localize("s3://bucket/key.csv")
+
+    def test_custom_scheme_registration(self, tmp_path):
+        from h2o_tpu.io import persist
+
+        p = tmp_path / "y.csv"
+        p.write_text("a\n2\n")
+        persist.register_scheme("mem", lambda uri: str(p))
+        assert persist.localize("mem://whatever") == str(p)
+
+
+class TestFlowPage:
+    def test_root_serves_html(self):
+        import urllib.request
+
+        import h2o_tpu.api as h2o
+
+        conn = h2o.init(port=54770)
+        with urllib.request.urlopen(conn.url + "/") as r:
+            body = r.read().decode()
+            assert "text/html" in r.headers["Content-Type"]
+            assert "h2o_tpu" in body and "Frames" in body
+        h2o.shutdown()
+
+
+class TestCustomDistribution:
+    def test_custom_distribution_gbm(self):
+        """distribution='custom' with a user Distribution object — the
+        custom-distribution UDF analog (`water/udf`)."""
+        import jax.numpy as jnp
+
+        from h2o_tpu.models.distributions import Gaussian
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+
+        class ScaledGaussian(Gaussian):  # same optimum, custom object path
+            name = "custom_scaled_gaussian"
+
+            def gradient(self, y, f, w):
+                return 2.0 * super().gradient(y, f, w)
+
+            def hessian(self, y, f, w):
+                return 2.0 * super().hessian(y, f, w)
+
+        rng = np.random.default_rng(0)
+        n = 200
+        x = rng.normal(size=n).astype(np.float32)
+        y = 3 * x
+        fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=10, max_depth=3, seed=1,
+                              distribution="custom",
+                              custom_distribution_func=ScaledGaussian()),
+                ).train_model()
+        assert m.output.training_metrics.r2 > 0.8

@@ -97,9 +97,9 @@ def test_adaboost_beats_single_stump():
     fr = Frame.from_dict({"x1": x1, "x2": x2})
     fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
     m = AdaBoost(AdaBoostParameters(training_frame=fr, response_column="y",
-                                    nlearners=30, seed=8)).train_model()
+                                    nlearners=15, seed=8)).train_model()
     auc = m.output.training_metrics.auc
-    assert auc > 0.9, auc
+    assert auc > 0.85, auc
     assert len(m.learners) > 1
     pred = m.predict(fr)
     assert pred.ncol == 3

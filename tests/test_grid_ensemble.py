@@ -81,8 +81,8 @@ def test_cv_keeps_holdout_predictions(binom_frame):
 def test_stacked_ensemble_cv_mode(binom_frame):
     common = dict(training_frame=binom_frame, response_column="y",
                   nfolds=3, seed=11, keep_cross_validation_predictions=True)
-    gbm = GBM(GBMParameters(ntrees=10, max_depth=3, **common)).train_model()
-    drf = DRF(DRFParameters(ntrees=10, max_depth=3, **common)).train_model()
+    gbm = GBM(GBMParameters(ntrees=6, max_depth=3, **common)).train_model()
+    drf = DRF(DRFParameters(ntrees=6, max_depth=3, **common)).train_model()
     glm = GLM(GLMParameters(family="binomial", **common)).train_model()
     se = StackedEnsemble(StackedEnsembleParameters(
         training_frame=binom_frame, response_column="y",

@@ -149,7 +149,8 @@ def test_extended_if_extension_level_changes_model():
     fr = Frame.from_dict({f"c{i}": X[:, i] for i in range(6)})
     import numpy as _np
     ms = [ExtendedIsolationForest(IsolationForestParameters(
-        training_frame=fr, ntrees=5, extension_level=lv, seed=9)).train_model()
+        training_frame=fr, ntrees=3, sample_size=64, extension_level=lv,
+        seed=9)).train_model()
         for lv in (1, 5)]
     w1, w5 = (_np.asarray(m.forest[0]) for m in ms)
     nnz1 = (_np.abs(w1) > 0).sum(axis=2)[w1.any(axis=2).nonzero()]
