@@ -402,6 +402,19 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, {"session_key": sid}
 
     # -- observability -------------------------------------------------------
+    if head == "JStack":
+        # thread dumps — `water/api/JStackHandler` analog for the controller
+        import sys
+        import traceback as tb
+
+        frames = sys._current_frames()
+        threads = {t.ident: t.name for t in threading.enumerate()}
+        traces = []
+        for tid, frame in frames.items():
+            traces.append({
+                "thread": threads.get(tid, str(tid)),
+                "stack": "".join(tb.format_stack(frame))})
+        return 200, {"traces": traces}
     if head == "Logs":
         from ..utils.log import get_buffer
 

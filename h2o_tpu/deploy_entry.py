@@ -1,0 +1,54 @@
+"""Container entry point — the `water.H2OApp.main` analog
+(`h2o-app/src/main/java/water/H2OApp.java:7`).
+
+Two supported modes, honest about JAX's multi-controller SPMD model:
+
+- **Server mode (default, single host, any number of local chips)**: serve
+  the REST API + status page over the local-device mesh. This is the
+  `java -jar h2o.jar` experience.
+- **SPMD driver mode (multi-host)**: JAX is multi-controller — EVERY process
+  must issue the same computations, so a REST server on one pod cannot drive
+  remote pods' chips. Multi-host jobs therefore run as SPMD driver scripts:
+  the SAME Python program on every host, each calling
+  ``h2o_tpu.parallel.cluster.init_cluster()`` first (the k8s manifest's
+  headless service provides the coordinator address). Set
+  ``H2O_TPU_DRIVER=your_module`` and this entry imports and runs it on every
+  process after the cloud forms — the `hadoop jar h2odriver.jar` analog,
+  where the driver is shipped to the cluster instead of the cluster being
+  driven remotely."""
+
+from __future__ import annotations
+
+import importlib
+import os
+import time
+
+
+def main() -> None:
+    driver = os.environ.get("H2O_TPU_DRIVER")
+    if driver:
+        from .parallel.cluster import init_cluster
+        from .utils.log import info
+
+        init_cluster()
+        import jax
+
+        info(f"cloud up: process {jax.process_index()}/{jax.process_count()}, "
+             f"{len(jax.devices())} global devices; running driver {driver}")
+        mod = importlib.import_module(driver)
+        mod.main()
+        return
+
+    # server mode: single host, local chips only
+    from .api.server import H2OServer
+    from .utils.log import info
+
+    port = int(os.environ.get("H2O_TPU_REST_PORT", 54321))
+    server = H2OServer(port=port).start()
+    info(f"REST serving on {server.url}")
+    while True:
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,23 @@ def _is_number(tok: str) -> bool:
         return False
 
 
+#: device-memory guard — `water/FrameSizeMonitor.java:14-23` kills parses that
+#: would OOM the heap; here the budget is HBM per chip (v5e: 16 GB, default
+#: cap leaves headroom for training workspaces). Override via env.
+MAX_FRAME_BYTES = int(os.environ.get("H2O_TPU_MAX_FRAME_BYTES",
+                                     12 * 1024**3))
+
+
+def _check_frame_size(n_rows: int, n_cols: int) -> None:
+    est = n_rows * n_cols * 4  # f32 device columns
+    if est > MAX_FRAME_BYTES:
+        raise MemoryError(
+            f"parse would allocate ~{est / 1e9:.1f} GB in HBM "
+            f"({n_rows} rows x {n_cols} cols), over the "
+            f"{MAX_FRAME_BYTES / 1e9:.1f} GB budget — set "
+            f"H2O_TPU_MAX_FRAME_BYTES to raise it (FrameSizeMonitor analog)")
+
+
 def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
                dest_key: str | None = None) -> Frame:
     """Parse one file into a sharded Frame (the ParseDataset.parse analog)."""
@@ -132,6 +149,14 @@ def _table_to_frame(table, setup: ParseSetup, mesh=None, dest_key=None) -> Frame
     import pyarrow as pa
     import pyarrow.compute as pc
 
+    # budget only what lands in HBM as f32: skipped columns never materialize
+    # and explicit string columns stay host-side (categoricals DO become f32
+    # code columns on device, so they count)
+    n_device_cols = sum(
+        1 for name in table.column_names
+        if name not in setup.skipped_columns
+        and setup.column_types.get(name) != T_STR)
+    _check_frame_size(table.num_rows, n_device_cols)
     names, vecs = [], []
     for name in table.column_names:
         if name in setup.skipped_columns:
@@ -206,6 +231,7 @@ def _parse_svmlight(path: str, mesh=None, dest_key=None) -> Frame:
                 kv[k] = float(v)
                 max_idx = max(max_idx, k)
             rows.append(kv)
+    _check_frame_size(len(rows), max_idx + 2)  # +target column
     mat = np.zeros((len(rows), max_idx + 1), dtype=np.float32)
     for i, kv in enumerate(rows):
         for k, v in kv.items():

@@ -141,7 +141,7 @@ class DeepLearningModel(Model):
         """Feed score0 the DataInfo-expanded design, not raw columns —
         mirrors GLMModel; base Model.adapt_frame would hand the net an
         unexpanded/unstandardized matrix."""
-        X, _ = self.dinfo.expand(fr)
+        X, _ = self.dinfo.expand(self.pre_adapt(fr))
         return X
 
     def _raw(self, X):

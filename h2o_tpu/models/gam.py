@@ -127,7 +127,7 @@ class GAMModel(Model):
         return jnp.asarray(np.concatenate(blocks, axis=1))
 
     def adapt_frame(self, fr: Frame):
-        return self._design(fr)
+        return self._design(self.pre_adapt(fr))
 
     def score0(self, X):
         beta = jnp.asarray(self.beta, jnp.float32)

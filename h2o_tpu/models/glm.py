@@ -313,7 +313,7 @@ class GLMModel(Model):
         return dict(zip(names, np.asarray(self.beta)))
 
     def adapt_frame(self, fr: Frame):
-        X, ok = self.dinfo.expand(fr)
+        X, ok = self.dinfo.expand(self.pre_adapt(fr))
         return X
 
     def score0(self, X: jax.Array) -> jax.Array:
@@ -419,6 +419,18 @@ class GLM(ModelBuilder):
         else:
             lambdas = [p.lambda_ if p.lambda_ is not None else 0.0]
 
+        if p.solver and p.solver.upper() in ("L_BFGS", "LBFGS"):
+            # walk the full lambda path warm-started, like the IRLSM branch
+            iters_total = 0
+            result = None
+            for lam in lambdas:
+                job.check_cancelled()
+                result = self._fit_lbfgs(Xi, y, w, offset, family, beta,
+                                         float(lam), alpha, neff, nulldev, job)
+                beta = result[0]
+                iters_total += result[5]
+            return (*result[:5], iters_total)
+
         best = None
         iters_total = 0
         for lam in lambdas:
@@ -446,6 +458,59 @@ class GLM(ModelBuilder):
             best = (beta.copy(), float(lam), dev)
         beta, lam, dev = best
         return beta, lam, dev, nulldev, neff, iters_total
+
+    def _fit_lbfgs(self, Xi, y, w, offset, family, beta0, lam, alpha, neff,
+                   nulldev, job):
+        """L-BFGS solver — `hex/optimization/L_BFGS.java` + the GLM L_BFGS
+        path (`hex/glm/GLM.java:2130`). Minimizes ½·deviance + ½·λℓ₂‖β‖² on
+        device via optax.lbfgs (autodiff supplies the gradient the reference
+        derives per family by hand). Like the reference, only the ridge part
+        of the penalty applies (ℓ₁ needs IRLSM/COORDINATE_DESCENT)."""
+        import optax
+
+        p = self.params
+        l2 = (1.0 - alpha) * lam * neff if alpha < 1.0 else 0.0
+        if alpha > 0 and lam > 0:
+            from ..utils.log import warn
+
+            warn("L_BFGS ignores the l1 share of the penalty "
+                 "(reference behavior); use IRLSM for lasso paths")
+
+        def obj(b):
+            eta = Xi @ b + offset
+            mu = family.linkinv(eta)
+            dev = jnp.sum(family.deviance(y, mu, w))
+            return 0.5 * dev + 0.5 * l2 * jnp.sum(b[:-1] ** 2)
+
+        opt = optax.lbfgs()
+        beta = jnp.asarray(beta0, jnp.float32)
+        state = opt.init(beta)
+        vg = optax.value_and_grad_from_state(obj)
+
+        @jax.jit
+        def step(beta, state):
+            value, grad = vg(beta, state=state)
+            updates, state = opt.update(grad, state, beta, value=value,
+                                        grad=grad, value_fn=obj)
+            return optax.apply_updates(beta, updates), state, value, grad
+
+        prev = np.inf
+        iters = 0
+        for i in range(max(p.max_iterations, 1) * 4):  # cheap iterations
+            job.check_cancelled()
+            beta, state, value, grad = step(beta, state)
+            if p.non_negative:  # projected L-BFGS (IRLSM clips likewise)
+                beta = beta.at[:-1].set(jnp.clip(beta[:-1], 0, None))
+            iters += 1
+            v = float(value)
+            if abs(prev - v) < p.objective_epsilon * max(abs(nulldev), 1.0):
+                break
+            if float(jnp.max(jnp.abs(grad))) < p.beta_epsilon:
+                break
+            prev = v
+        mu = family.linkinv(Xi @ beta + offset)
+        dev = float(jnp.sum(family.deviance(y, mu, w)))
+        return (np.asarray(beta, np.float64), lam, dev, nulldev, neff, iters)
 
     def _build_multinomial(self, job, names, y_dev, resp_domain):
         """Per-class block IRLS — `hex/glm/GLM.java` multinomial loop analog."""

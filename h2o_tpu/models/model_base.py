@@ -108,9 +108,20 @@ class Model(Keyed):
         regression, (n, 1+K) [label, p0..pK-1] for classification."""
         raise NotImplementedError
 
+    def pre_adapt(self, fr: Frame) -> Frame:
+        """Replay the frozen categorical_encoding (if any) — every
+        adapt_frame override must route incoming frames through this."""
+        enc = getattr(self.output, "encoding_state", None)
+        if enc is None:
+            return fr
+        from ..utils.linalg import apply_encoding_state
+
+        return apply_encoding_state(fr, enc)
+
     def adapt_frame(self, fr: Frame) -> jax.Array:
         """adaptTestForTrain analog: select training columns in order, remap
         categorical codes onto the training domain (unseen levels → NaN)."""
+        fr = self.pre_adapt(fr)
         cols = []
         for name in self.output.names:
             v = fr.vec(name)
@@ -307,11 +318,16 @@ class ModelBuilder:
 
         def run():
             t0 = time.time()
+            enc_state = self._apply_categorical_encoding()
             if self.supports_cv and (self.params.nfolds >= 2
                                      or self.params.fold_column):
                 model = self._train_with_cv(self.job)
             else:
                 model = self.build_impl(self.job)
+            if enc_state is not None:
+                model.output.encoding_state = enc_state
+                for cv in model.output.cv_models:
+                    cv.output.encoding_state = enc_state
             self._apply_custom_metric(model)
             model.output.run_time_ms = int((time.time() - t0) * 1000)
             self.job.dest_key = model.key
@@ -322,6 +338,28 @@ class ModelBuilder:
 
     def train_model(self) -> Model:
         return self.train(background=False).join()
+
+    def _apply_categorical_encoding(self):
+        """Eigen/OneHotExplicit categorical_encoding: freeze the transform on
+        the training frame, swap the params to the encoded frames, and return
+        the state the trained model replays at score time
+        (`hex/Model.Parameters.CategoricalEncodingScheme` + ToEigenVec)."""
+        p = self.params
+        from ..utils.linalg import apply_encoding_state, build_encoding_state
+
+        skip = [p.response_column, p.weights_column, p.offset_column,
+                p.fold_column] + list(p.ignored_columns)
+        state = build_encoding_state(p.training_frame, p.categorical_encoding,
+                                     skip=[s for s in skip if s])
+        if state is None:
+            return None
+        updates = {"training_frame": apply_encoding_state(p.training_frame,
+                                                          state)}
+        if p.validation_frame is not None:
+            updates["validation_frame"] = apply_encoding_state(
+                p.validation_frame, state)
+        self.params = p.clone(**updates)
+        return state
 
     def _apply_custom_metric(self, model: Model) -> None:
         """One extra scoring pass evaluating the user's metric UDF, attached

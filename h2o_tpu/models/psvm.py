@@ -83,7 +83,7 @@ class SVMModel(Model):
         return K @ self.whiten
 
     def adapt_frame(self, fr: Frame):
-        X, _ = self.dinfo.expand(fr)
+        X, _ = self.dinfo.expand(self.pre_adapt(fr))
         return X
 
     def decision_function(self, X):

@@ -150,7 +150,7 @@ class RuleFitModel(Model):
         return jnp.concatenate(blocks, axis=1)
 
     def adapt_frame(self, fr: Frame):
-        return self._design(fr)
+        return self._design(self.pre_adapt(fr))
 
     def score0(self, X):
         return self.glm_model.score0(X)

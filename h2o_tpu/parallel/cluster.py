@@ -1,0 +1,58 @@
+"""Multi-host clustering — the deployment-layer analog of the reference's
+cloud formation (`water/init/NetworkInit.java` multicast/flatfile discovery,
+`h2o-k8s` headless-service DNS clouding, `h2o-hadoop-*` drivers).
+
+On TPU, membership and transport are the JAX distributed runtime's job: every
+host process calls :func:`init_cluster` with the same coordinator address
+(K8s: the headless service DNS of pod 0 — exactly the `h2o-k8s` lookup
+pattern), `jax.distributed.initialize` forms the "cloud", and the global mesh
+then spans every chip on every host; collectives ride ICI within a slice and
+DCN across slices. There is no Paxos, no heartbeat thread, no flatfile — the
+coordination service owns membership, and a lost host fails the job (the
+reference's frozen-membership semantics; recover via the checkpoint layer,
+`backend/persist.py`)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from . import mesh as meshmod
+
+
+def init_cluster(coordinator_address: str | None = None,
+                 num_processes: int | None = None,
+                 process_id: int | None = None) -> "jax.sharding.Mesh":
+    """Join (or form) the multi-host cloud, then build the global row mesh.
+
+    With no arguments, reads the standard JAX env vars / TPU metadata (on
+    Cloud TPU pods `jax.distributed.initialize()` autodetects everything —
+    the analog of `h2o.init()` joining the local cloud). Returns the global
+    mesh over ALL devices in the cloud; pass it to `use_mesh` or rely on it
+    being installed as the default.
+    """
+    if num_processes is None or num_processes > 1 or coordinator_address:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    m = meshmod.make_mesh()  # all devices across all processes
+    meshmod.set_mesh(m)
+    return m
+
+
+def cloud_size() -> int:
+    """Number of host processes in the cloud (`/3/Cloud` cloud_size role)."""
+    return jax.process_count()
+
+
+def stall_till_cloudsize(n: int, timeout_s: float = 300.0) -> None:
+    """Barrier until the cloud reaches ``n`` processes — the test-harness
+    primitive from the reference (`TestUtil.stall_till_cloudsize`,
+    `water/TestUtil.java:87-117`). Under `jax.distributed`, initialize()
+    already blocks until every process joins, so this only validates."""
+    if jax.process_count() < n:
+        raise RuntimeError(
+            f"cloud has {jax.process_count()} processes, need {n} — "
+            f"jax.distributed.initialize must be called on every host")

@@ -1,0 +1,117 @@
+"""Linear-algebra utilities — `hex/util/LinearAlgebraUtils.java` analog.
+
+`to_eigen_vec` is the ToEigenVec transform behind the reference's
+``categorical_encoding="Eigen"`` (`hex/util/LinearAlgebraUtils.toEigen`,
+used by Aggregator and tree algos): replace a categorical column with the
+per-level loading of the dominant eigenvector of the centered one-hot
+covariance — one numeric column instead of a k-wide one-hot block.
+
+For a one-hot indicator with level frequencies p, the centered covariance is
+``C = diag(p) − p pᵀ`` (k×k, tiny) — eigen-decomposed on host; no n-sized
+work beyond one counts pass."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import T_NUM, Vec
+
+
+def to_eigen_vec(v: Vec) -> Vec:
+    """Categorical Vec -> numeric Vec of per-level eigen loadings."""
+    if not v.is_categorical():
+        return v
+    host = v.to_numpy()
+    k = len(v.domain)
+    ok = ~np.isnan(host)
+    counts = np.bincount(host[ok].astype(np.int64), minlength=k).astype(np.float64)
+    n = max(counts.sum(), 1.0)
+    p = counts / n
+    C = np.diag(p) - np.outer(p, p)
+    vals, vecs = np.linalg.eigh(C)
+    v1 = vecs[:, -1]  # dominant eigenvector
+    if v1[np.argmax(np.abs(v1))] < 0:  # deterministic sign
+        v1 = -v1
+    out = np.full(host.shape, np.nan, dtype=np.float32)
+    out[ok] = v1[host[ok].astype(np.int64)]
+    return Vec.from_numpy(out, type=T_NUM)
+
+
+def _eigen_loadings(v: Vec) -> np.ndarray:
+    host = v.to_numpy()
+    k = len(v.domain)
+    ok = ~np.isnan(host)
+    counts = np.bincount(host[ok].astype(np.int64), minlength=k).astype(np.float64)
+    n = max(counts.sum(), 1.0)
+    p = counts / n
+    C = np.diag(p) - np.outer(p, p)
+    _, vecs = np.linalg.eigh(C)
+    v1 = vecs[:, -1]
+    if v1[np.argmax(np.abs(v1))] < 0:
+        v1 = -v1
+    return v1.astype(np.float32)
+
+
+def build_encoding_state(fr: Frame, encoding: str,
+                         skip: list[str] | None = None) -> dict | None:
+    """Freeze a categorical_encoding transform on the training frame so the
+    IDENTICAL mapping replays at score time (levels matched by name, unseen
+    levels → NA). Returns None for AUTO/Enum (builders one-hot internally via
+    DataInfo)."""
+    skip = set(skip or [])
+    enc = (encoding or "AUTO").lower()
+    if enc not in ("eigen", "onehotexplicit", "one_hot_explicit"):
+        return None
+    cols = {}
+    for name in fr.names:
+        v = fr.vec(name)
+        if v.is_categorical() and name not in skip:
+            cols[name] = {"domain": list(v.domain)}
+            if enc == "eigen":
+                cols[name]["loadings"] = _eigen_loadings(v)
+    if not cols:
+        return None
+    return {"encoding": "Eigen" if enc == "eigen" else "OneHotExplicit",
+            "columns": cols}
+
+
+def apply_encoding_state(fr: Frame, state: dict) -> Frame:
+    """Replay a frozen encoding on any frame (train or score time)."""
+    enc = state["encoding"]
+    names, vecs = [], []
+    for name in fr.names:
+        v = fr.vec(name)
+        spec = state["columns"].get(name)
+        if spec is None or not v.is_categorical():
+            names.append(name)
+            vecs.append(v)
+            continue
+        host = v.to_numpy()
+        # remap this frame's codes onto the TRAINING domain by level name
+        lut = {lvl: i for i, lvl in enumerate(spec["domain"])}
+        codes = np.full(host.shape, np.nan, dtype=np.float32)
+        ok = ~np.isnan(host)
+        codes[ok] = [lut.get((v.domain or [])[int(c)], np.nan)
+                     for c in host[ok]]
+        if enc == "Eigen":
+            load = np.asarray(spec["loadings"])
+            out = np.full(host.shape, np.nan, dtype=np.float32)
+            okc = ~np.isnan(codes)
+            out[okc] = load[codes[okc].astype(np.int64)]
+            names.append(name)
+            vecs.append(Vec.from_numpy(out, type=T_NUM))
+        else:  # OneHotExplicit
+            for j, lvl in enumerate(spec["domain"]):
+                col = np.where(np.isnan(codes), np.nan,
+                               (codes == j).astype(np.float32))
+                names.append(f"{name}.{lvl}")
+                vecs.append(Vec.from_numpy(col.astype(np.float32)))
+    return Frame(names, vecs)
+
+
+def apply_categorical_encoding(fr: Frame, encoding: str,
+                               skip: list[str] | None = None) -> Frame:
+    """One-shot frame transform (state built and applied on the same frame)."""
+    state = build_encoding_state(fr, encoding, skip)
+    return fr if state is None else apply_encoding_state(fr, state)

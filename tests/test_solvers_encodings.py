@@ -1,0 +1,164 @@
+"""GLM L-BFGS solver, eigen categorical encoding, frame-size guard, JStack."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.glm import GLM, GLMParameters
+from h2o_tpu.utils.linalg import apply_categorical_encoding, to_eigen_vec
+
+
+class TestLBFGS:
+    def test_gaussian_exact(self):
+        rng = np.random.default_rng(0)
+        n = 1000
+        x1 = rng.normal(size=n).astype(np.float32)
+        x2 = rng.normal(size=n).astype(np.float32)
+        y = 2 * x1 - 3 * x2 + 1
+        fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y.astype(np.float32)})
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", solver="L_BFGS",
+                              lambda_=0.0)).train_model()
+        c = m.coef()
+        assert abs(c["x1"] - 2) < 0.05 and abs(c["x2"] + 3) < 0.05
+
+    def test_binomial_matches_irlsm(self):
+        rng = np.random.default_rng(1)
+        n = 1500
+        x = rng.normal(size=n).astype(np.float32)
+        y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(np.float32)
+        fr = Frame.from_dict({"x": x})
+        fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["a", "b"]))
+        coefs = {}
+        for solver in ("IRLSM", "L_BFGS"):
+            m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                                  family="binomial", solver=solver,
+                                  lambda_=0.0)).train_model()
+            coefs[solver] = m.coef()["x"]
+        assert abs(coefs["IRLSM"] - coefs["L_BFGS"]) < 0.05
+
+    def test_ridge_penalty_applies(self):
+        rng = np.random.default_rng(2)
+        n = 400
+        x = rng.normal(size=n).astype(np.float32)
+        y = 5 * x
+        fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+        free = GLM(GLMParameters(training_frame=fr, response_column="y",
+                                 family="gaussian", solver="L_BFGS",
+                                 lambda_=0.0)).train_model().coef()["x"]
+        rid = GLM(GLMParameters(training_frame=fr, response_column="y",
+                                family="gaussian", solver="L_BFGS",
+                                alpha=0.0, lambda_=1.0)).train_model().coef()["x"]
+        assert rid < free  # shrinkage
+
+
+class TestEigenEncoding:
+    def test_levels_get_distinct_loadings(self):
+        codes = np.array([0, 0, 0, 1, 1, 2] * 10, dtype=np.float32)
+        v = Vec.from_numpy(codes, type=T_CAT, domain=["a", "b", "c"])
+        ev = to_eigen_vec(v)
+        vals = ev.to_numpy()
+        per_level = {int(c): vals[codes == c][0] for c in (0, 1, 2)}
+        assert len(set(np.round(list(per_level.values()), 6))) == 3
+        # same level → same value everywhere
+        for c, val in per_level.items():
+            assert np.allclose(vals[codes == c], val)
+
+    def test_na_stays_na_and_numeric_passthrough(self):
+        codes = np.array([0, np.nan, 1], dtype=np.float32)
+        v = Vec.from_numpy(codes, type=T_CAT, domain=["a", "b"])
+        ev = to_eigen_vec(v)
+        assert np.isnan(ev.to_numpy()[1])
+        num = Vec.from_numpy(np.array([1.0, 2.0], np.float32))
+        assert to_eigen_vec(num) is num
+
+    def test_frame_level_encoding(self):
+        fr = Frame.from_dict({"x": np.arange(6, dtype=np.float32)})
+        fr.add("c", Vec.from_numpy(np.array([0, 1, 2, 0, 1, 2], np.float32),
+                                   type=T_CAT, domain=["a", "b", "c"]))
+        out = apply_categorical_encoding(fr, "Eigen")
+        assert not out.vec("c").is_categorical()
+        oh = apply_categorical_encoding(fr, "OneHotExplicit")
+        assert "c.a" in oh.names and "c.c" in oh.names and oh.ncol == 4
+
+    def test_eigen_improves_glm_on_categoricals(self):
+        # sanity: eigen-encoded frame still trains
+        rng = np.random.default_rng(3)
+        n = 300
+        c = rng.integers(0, 4, n)
+        y = (c >= 2).astype(np.float32) + 0.01 * rng.normal(size=n).astype(np.float32)
+        fr = Frame.from_dict({"y": y.astype(np.float32)})
+        fr.add("c", Vec.from_numpy(c.astype(np.float32), type=T_CAT,
+                                   domain=list("abcd")))
+        enc = apply_categorical_encoding(fr, "Eigen", skip=["y"])
+        m = GLM(GLMParameters(training_frame=enc, response_column="y",
+                              family="gaussian", lambda_=0.0)).train_model()
+        assert m.output.training_metrics.r2 > 0.3
+
+
+class TestFrameSizeGuard:
+    def test_oversize_parse_rejected(self, tmp_path, monkeypatch):
+        import h2o_tpu.io.parser as parser
+
+        p = tmp_path / "small.csv"
+        p.write_text("a,b\n" + "\n".join(f"{i},{i}" for i in range(100)))
+        monkeypatch.setattr(parser, "MAX_FRAME_BYTES", 100)  # tiny budget
+        with pytest.raises(MemoryError, match="FrameSizeMonitor"):
+            parser.parse_file(str(p))
+        monkeypatch.setattr(parser, "MAX_FRAME_BYTES", 1 << 40)
+        assert parser.parse_file(str(p)).nrow == 100
+
+
+class TestJStack:
+    def test_jstack_route(self):
+        import h2o_tpu.api as h2o
+
+        conn = h2o.init(port=54890)
+        j = conn.request("GET", "/3/JStack")
+        assert any("MainThread" in t["thread"] for t in j["traces"])
+        h2o.shutdown()
+
+
+class TestEncodingWiredIntoBuilders:
+    def test_eigen_param_trains_and_scores(self):
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+
+        rng = np.random.default_rng(4)
+        n = 300
+        c = rng.integers(0, 4, n)
+        x = rng.normal(size=n).astype(np.float32)
+        y = ((c >= 2) ^ (x > 0)).astype(np.float32)
+        fr = Frame.from_dict({"x": x})
+        fr.add("c", Vec.from_numpy(c.astype(np.float32), type=T_CAT,
+                                   domain=list("abcd")))
+        fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=8, max_depth=3, seed=1,
+                              categorical_encoding="Eigen")).train_model()
+        assert m.output.encoding_state["encoding"] == "Eigen"
+        assert m.output.training_metrics.auc > 0.8
+        # score-time replay: a frame with a reordered + unseen domain
+        c2 = np.array([0, 1, 2], np.float32)
+        test = Frame.from_dict({"x": np.zeros(3, np.float32)})
+        test.add("c", Vec.from_numpy(c2, type=T_CAT, domain=["b", "zzz", "a"]))
+        pred = m.predict(test)
+        assert pred.nrow == 3  # unseen 'zzz' level routes as NA, no crash
+
+    def test_onehot_explicit_param(self):
+        from h2o_tpu.models.glm import GLM, GLMParameters
+
+        rng = np.random.default_rng(5)
+        n = 200
+        c = rng.integers(0, 3, n)
+        y = c.astype(np.float32) * 2.0
+        fr = Frame.from_dict({"y": y})
+        fr.add("c", Vec.from_numpy(c.astype(np.float32), type=T_CAT,
+                                   domain=list("abc")))
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              categorical_encoding="OneHotExplicit")
+                ).train_model()
+        assert "c.a" in m.output.names
+        pf = m.predict(fr)
+        assert np.allclose(pf.vec(0).to_numpy(), y, atol=0.1)
