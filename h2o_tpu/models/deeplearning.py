@@ -159,7 +159,7 @@ class DeepLearningModel(Model):
         return jnp.concatenate([label[:, None], probs], axis=1)
 
     def predict(self, fr: Frame) -> Frame:
-        X, _ = self.dinfo.expand(fr)
+        X = self.adapt_frame(fr)
         if self.params.autoencoder:
             out = self._raw(X)
             names = [f"reconstr_{n}" for n in self.dinfo.expanded_names]
@@ -169,7 +169,7 @@ class DeepLearningModel(Model):
 
     def anomaly(self, fr: Frame) -> Frame:
         """Per-row reconstruction MSE (autoencoder anomaly detection)."""
-        X, _ = self.dinfo.expand(fr)
+        X = self.adapt_frame(fr)
         out = self._raw(X)
         mse = jnp.mean((out - X) ** 2, axis=1)
         return Frame(["Reconstruction.MSE"], [Vec.from_device(mse, fr.nrow)])

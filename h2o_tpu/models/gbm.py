@@ -56,6 +56,9 @@ class GBMParameters(Parameters):
                                              # distribution="custom" — the
                                              # `water/udf` custom-distribution
                                              # UDF analog (in-process Python)
+    monotone_constraints: dict = None        # {col: +1|-1} — `hex/tree/
+                                             # Constraints.java` (h2o-py dict
+                                             # format); regression/binomial only
 
 
 class GBMModel(Model):
@@ -111,7 +114,11 @@ class GBM(ModelBuilder):
 
     def _tree_config(self, K) -> TreeConfig:
         p = self.params
+        if getattr(p, "monotone_constraints", None) and K > 1:
+            raise ValueError("monotone_constraints are not supported for "
+                             "multinomial models (reference restriction)")
         return TreeConfig(
+            use_monotone=bool(getattr(p, "monotone_constraints", None)),
             ntrees=p.ntrees, max_depth=p.max_depth, nbins=p.nbins,
             min_rows=p.min_rows, learn_rate=p.learn_rate,
             reg_lambda=getattr(p, "reg_lambda", 0.0),
@@ -161,6 +168,16 @@ class GBM(ModelBuilder):
                                      seed=p.seed if p.seed not in (-1, None) else 1234)
         mesh = default_mesh()
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf), replicated(mesh))
+        mono_np = np.zeros(len(names), dtype=np.float32)
+        for col, d in (getattr(p, "monotone_constraints", None) or {}).items():
+            if col not in names:
+                raise ValueError(f"monotone_constraints column '{col}' is not "
+                                 f"a feature")
+            if fr.vec(col).is_categorical():
+                raise ValueError(f"monotone_constraints on categorical column "
+                                 f"'{col}' (numeric only, as in the reference)")
+            mono_np[names.index(col)] = float(np.sign(d))
+        mono = jax.device_put(mono_np, replicated(mesh))
         edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))
         Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
 
@@ -205,11 +222,15 @@ class GBM(ModelBuilder):
                     f"ntrees must exceed that (got {p.ntrees})")
             # parameter-compatibility validation, up front (the reference
             # validates before training, `SharedTree` checkpoint checks)
+            prior_mono = getattr(prior.params, "monotone_constraints", None) or {}
             for fld, ours, theirs in (
                     ("max_depth", p.max_depth, prior.cfg.max_depth),
                     ("nbins", p.nbins, prior.cfg.nbins),
                     ("nclasses", K, prior.cfg.nclass),
-                    ("drf_mode", self.drf_mode, prior.cfg.drf_mode)):
+                    ("drf_mode", self.drf_mode, prior.cfg.drf_mode),
+                    ("monotone_constraints",
+                     dict(getattr(p, "monotone_constraints", None) or {}),
+                     dict(prior_mono))):
                 if ours != theirs:
                     raise ValueError(
                         f"checkpoint incompatible: {fld} differs "
@@ -249,7 +270,7 @@ class GBM(ModelBuilder):
         stop_metric_series = []
         for ci, keys in enumerate(chunks):
             job.check_cancelled()
-            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys)
+            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, mono)
             parts.append(trees)
             ntrees_done = sum(t[0].shape[0] for t in parts)
             m = make_metrics(category, jnp.where(ymask, y, jnp.nan),

@@ -62,6 +62,8 @@ class TreeConfig:
     block_rows: int = 8192       # row-block size for the histogram scan
     use_pallas: bool | None = None  # fused VMEM histogram kernel; None = auto
                                     # (on for TPU backend, XLA path elsewhere)
+    use_monotone: bool = False   # monotone_constraints active (static flag;
+                                 # the per-feature directions ride as an array)
 
     @property
     def n_nodes(self) -> int:
@@ -171,12 +173,15 @@ def _level_col_mask(lkey, F, n_lv, cfg: "TreeConfig", tree_cols):
 # ---------------------------------------------------------------------------
 # Split finding (DTree.DecidedNode analog), vectorized on device.
 # ---------------------------------------------------------------------------
-def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig):
-    """hist: (F, n_lv, B, 3). Returns per-node best (gain, feat, bin, nan_left).
+def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
+    """hist: (F, n_lv, B, 3). Returns per-node best (gain, feat, bin, nan_left,
+    node weight, left/right Newton values of the chosen split).
 
     Candidates: split at bin b (left = bins <= b), b in 0..nb-2, NA bucket sent
     left or right (`hex/tree/DHistogram.java` NA bucket; direction chosen by
-    gain like the reference's NASplitDir).
+    gain like the reference's NASplitDir). ``mono`` (F,) in {-1,0,1} kills
+    candidates whose child values violate the feature's monotone direction
+    (`hex/tree/Constraints.java` role).
     """
     nb = cfg.nbins
     W, G, H = hist[..., 0], hist[..., 1], hist[..., 2]
@@ -198,6 +203,13 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig):
         # xgboost-style L1 soft threshold on score numerators (no-op at α=0)
         return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0) if alpha > 0 else g
 
+    def child_vals(gl, hl):
+        gr = Gt[None, :, None] - gl
+        hr = Ht[None, :, None] - hl
+        vL = -_soft(gl) / (hl + lam + 1e-10)
+        vR = -_soft(gr) / (hr + lam + 1e-10)
+        return vL, vR
+
     def gain_of(wl, gl, hl):
         wr = Wt[None, :, None] - wl
         gr = Gt[None, :, None] - gl
@@ -211,6 +223,14 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig):
     gain_nar = gain_of(cw, cg, ch)                      # NA right
     gain_nal = gain_of(cw + wna, cg + gna, ch + hna)    # NA left
     gains = jnp.stack([gain_nar, gain_nal], axis=3)     # (F, n_lv, nb-1, 2)
+    vL_nar, vR_nar = child_vals(cg, ch)
+    vL_nal, vR_nal = child_vals(cg + gna, ch + hna)
+    vL = jnp.stack([vL_nar, vL_nal], axis=3)
+    vR = jnp.stack([vR_nar, vR_nal], axis=3)
+    if mono is not None:
+        m = mono[:, None, None, None]
+        viol = ((m > 0) & (vL > vR)) | ((m < 0) & (vL < vR))
+        gains = jnp.where(viol, -jnp.inf, gains)
     gains = jnp.where(colmask[:, :, None, None], gains, -jnp.inf)
     gains = jnp.where(edge_ok[:, None, :, None], gains, -jnp.inf)
 
@@ -218,18 +238,31 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig):
     flat = jnp.transpose(gains, (1, 0, 2, 3)).reshape(n_lv, -1)  # (n_lv, F*(nb-1)*2)
     best = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+
+    def pick(arr):  # chosen candidate's value per node (tiny gathers)
+        a = jnp.transpose(arr, (1, 0, 2, 3)).reshape(n_lv, -1)
+        return jnp.take_along_axis(a, best[:, None], axis=1)[:, 0]
+
+    best_vL, best_vR = pick(vL), pick(vR)
     per_f = (nb - 1) * 2
     bf = (best // per_f).astype(jnp.int32)
     bb = ((best % per_f) // 2).astype(jnp.int32)
     bnal = (best % 2).astype(jnp.bool_)
-    return best_gain, bf, bb, bnal, Wt
+    return best_gain, bf, bb, bnal, Wt, best_vL, best_vR
 
 
 # ---------------------------------------------------------------------------
 # Grow one tree fully on device (shard-local function; psums inside).
 # ---------------------------------------------------------------------------
-def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
-    """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,))."""
+def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
+               mono=None):
+    """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,)).
+
+    ``mono`` (F,) f32 in {-1,0,1}: monotone constraints. Split candidates
+    violating a direction are masked in _find_splits; per-node [lo, hi] value
+    bounds propagate to children through the split midpoint and clip leaf
+    values — together these make every tree (hence the additive model)
+    monotone in each constrained feature (`hex/tree/Constraints.java`)."""
     Rl, F = Xb.shape
     N = cfg.n_nodes
     B = cfg.nbins + 1
@@ -240,6 +273,9 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
     garr = jnp.zeros((N,), dtype=jnp.float32)  # split gains (variable importance)
     node = jnp.zeros((Rl,), dtype=jnp.int32)
     vals3 = jnp.stack([w, g, h], axis=1)
+    constrained = mono is not None
+    lo = jnp.full((N,), -jnp.inf, dtype=jnp.float32)
+    hi = jnp.full((N,), jnp.inf, dtype=jnp.float32)
 
     # per-tree column subsample (same on all shards: colkey is not axis-folded)
     tree_cols = (jax.random.uniform(jax.random.fold_in(colkey, 997), (F,))
@@ -260,8 +296,26 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
                                 cfg, tree_cols)
 
-        gain, bf, bb, bnal, Wt = _find_splits(hist, cmask, edge_ok, cfg)
+        gain, bf, bb, bnal, Wt, vLs, vRs = _find_splits(
+            hist, cmask, edge_ok, cfg, mono if constrained else None)
         do_split = (gain > cfg.min_split_improvement) & (Wt >= 2 * cfg.min_rows)
+
+        if constrained:
+            # bound propagation: children of a constrained split may not cross
+            # the split midpoint (clipped into the node's own bounds)
+            lo_n = jax.lax.dynamic_slice(lo, (offset,), (n_lv,))
+            hi_n = jax.lax.dynamic_slice(hi, (offset,), (n_lv,))
+            cbf = mono[bf]  # (n_lv,) tiny gather
+            mid = jnp.clip((vLs + vRs) * 0.5, lo_n, hi_n)
+            use = do_split & (cbf != 0)
+            left_hi = jnp.where(use & (cbf > 0), mid, hi_n)
+            left_lo = jnp.where(use & (cbf < 0), mid, lo_n)
+            right_lo = jnp.where(use & (cbf > 0), mid, lo_n)
+            right_hi = jnp.where(use & (cbf < 0), mid, hi_n)
+            child_lo = jnp.stack([left_lo, right_lo], axis=1).reshape(-1)
+            child_hi = jnp.stack([left_hi, right_hi], axis=1).reshape(-1)
+            lo = jax.lax.dynamic_update_slice(lo, child_lo, (2 * offset + 1,))
+            hi = jax.lax.dynamic_update_slice(hi, child_hi, (2 * offset + 1,))
 
         feat = jax.lax.dynamic_update_slice(
             feat, jnp.where(do_split, bf, -1), (offset,))
@@ -304,8 +358,11 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig):
     gleaf = tot[:, 1]
     if cfg.reg_alpha > 0:
         gleaf = jnp.sign(gleaf) * jnp.maximum(jnp.abs(gleaf) - cfg.reg_alpha, 0.0)
-    val = jnp.where(tot[:, 0] > 0,
-                    -gleaf / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0) * scale
+    newton = jnp.where(tot[:, 0] > 0,
+                       -gleaf / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0)
+    if constrained:
+        newton = jnp.clip(newton, lo, hi)
+    val = newton * scale
     return feat, thr, nanL, val, garr, node
 
 
@@ -337,7 +394,9 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             return hit
     K = cfg.nclass
 
-    def spmd(Xb, y, w, f, edges, edge_ok, keys):
+    def spmd(Xb, y, w, f, edges, edge_ok, keys, mono):
+        mono_arg = mono if cfg.use_monotone else None
+
         def tree_step(f, key):
             rowkey = jax.random.fold_in(key, jax.lax.axis_index(ROWS))
             if cfg.sample_rate < 1.0:
@@ -356,12 +415,14 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
 
             if K == 1:
                 ft, th, nl, vl, ga, node = _grow_tree(
-                    Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg)
+                    Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
+                    mono_arg)
                 delta = leaf_delta(vl, node)
             else:
                 grow = jax.vmap(
                     lambda gk, hk, ck: _grow_tree(Xb, gk * s, hk * s, w * s,
-                                                  edges, edge_ok, ck, cfg))
+                                                  edges, edge_ok, ck, cfg,
+                                                  mono_arg))
                 ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
                 ft, th, nl, vl, ga, node = grow(g, h, ckeys)
                 delta = jax.vmap(leaf_delta)(vl, node)
@@ -374,7 +435,7 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     fspec = P(ROWS) if K == 1 else P(None, ROWS)
     fn = shard_map(
         spmd, mesh=mesh,
-        in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P()),
+        in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P()),
         out_specs=(fspec, (P(), P(), P(), P(), P())),
         check_vma=False,
     )

@@ -49,7 +49,9 @@ class MojoModel:
                 domains[ci] = [unescape_line(s) for s in lines]
             algo = info.get("algo")
             cls = {"gbm": _TreeMojo, "drf": _TreeMojo, "glm": _GlmMojo,
-                   "kmeans": _KMeansMojo}.get(algo)
+                   "kmeans": _KMeansMojo, "deeplearning": _DeepLearningMojo,
+                   "isolationforest": _IsoForMojo,
+                   "extendedisolationforest": _IsoForMojo}.get(algo)
             if cls is None:
                 raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
             model = cls(info, columns, domains)
@@ -235,3 +237,130 @@ class _KMeansMojo(MojoModel):
             X = (X - self.means) * self.mults
         d2 = ((X[:, None, :] - self.centers[None, :, :]) ** 2).sum(axis=2)
         return d2.argmin(axis=1).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+class _DeepLearningMojo(MojoModel):
+    """`hex/genmodel/algos/deeplearning/DeeplearningMojoModel` role: numpy
+    forward pass over the stored layers, with the DataInfo input spec
+    (one-hot cats first, standardized numerics) replayed exactly."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.activation = self.info.get("activation", "Rectifier")
+        self.use_all = g("use_all_factor_levels", True)
+        self.cats = g("cats", 0)
+        self.cat_modes = np.asarray(g("cat_modes", []), dtype=np.int64)
+        self.cat_offsets = np.asarray(g("cat_offsets", [0]), dtype=np.int64)
+        self.nums = g("nums", 0)
+        self.num_means = np.asarray(g("num_means", []), dtype=np.float64)
+        self.num_sigmas = np.asarray(g("num_sigmas", []), dtype=np.float64)
+        self.standardize = g("standardize", True)
+        self.center = g("center", True)
+        n_layers = g("n_layers")
+        self.layers = []
+        for i in range(n_layers):
+            W = np.frombuffer(zr.blob(f"weights/w{i:02d}.bin"),
+                              dtype="<f4").astype(np.float64)
+            b = np.frombuffer(zr.blob(f"weights/b{i:02d}.bin"),
+                              dtype="<f4").astype(np.float64)
+            W = W.reshape(-1, b.shape[0])
+            self.layers.append((W, b))
+
+    def _expand(self, X):
+        """Raw (R, cats+nums) codes/values -> network input, mirroring
+        DataInfo.expand (impute, one-hot, standardize)."""
+        R = X.shape[0]
+        skip = 0 if self.use_all else 1
+        blocks = []
+        for i in range(self.cats):
+            col = X[:, i].copy()
+            card = int(self.cat_offsets[i + 1] - self.cat_offsets[i]) + skip
+            bad = np.isnan(col) | (col >= card)
+            col = np.where(bad, self.cat_modes[i], col).astype(np.int64)
+            oh = np.zeros((R, card), dtype=np.float64)
+            oh[np.arange(R), col] = 1.0
+            blocks.append(oh[:, skip:])
+        for i in range(self.nums):
+            col = X[:, self.cats + i].copy()
+            col = np.where(np.isnan(col), self.num_means[i], col)
+            if self.center:
+                col = col - self.num_means[i]
+            if self.standardize:
+                col = col / self.num_sigmas[i]
+            blocks.append(col[:, None])
+        return np.concatenate(blocks, axis=1)
+
+    def score(self, X):
+        h = self._expand(np.asarray(X, dtype=np.float64))
+        name = self.activation.lower().replace("withdropout", "")
+        L = len(self.layers)
+        for i, (W, b) in enumerate(self.layers):
+            z = h @ W + b
+            if i < L - 1:
+                if name == "maxout":
+                    z = z.reshape(z.shape[0], -1, 2).max(axis=2)
+                elif name == "tanh":
+                    z = np.tanh(z)
+                else:  # rectifier
+                    z = np.maximum(z, 0.0)
+            h = z
+        if self.category == "Regression":
+            return h[:, 0]
+        e = np.exp(h - h.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        label = p.argmax(axis=1).astype(np.float64)
+        return np.concatenate([label[:, None], p], axis=1)
+
+
+# ---------------------------------------------------------------------------
+class _IsoForMojo(MojoModel):
+    """`hex/genmodel/algos/isofor` role: hyperplane-tree traversal to average
+    path length, anomaly score 2^(−E[h]/c(n))."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        T, N = g("n_trees"), g("n_nodes")
+        F = g("n_features")
+        self.depth = g("max_depth")
+        self.sample_size = g("sample_size")
+        self.wvec = np.frombuffer(zr.blob("isofor/wvec.bin"),
+                                  dtype="<f4").reshape(T, N, F).astype(np.float64)
+        self.thr = np.frombuffer(zr.blob("isofor/thr.bin"),
+                                 dtype="<f4").reshape(T, N).astype(np.float64)
+        self.is_split = np.frombuffer(zr.blob("isofor/is_split.bin"),
+                                      dtype=np.uint8).reshape(T, N).astype(bool)
+        self.counts = np.frombuffer(zr.blob("isofor/counts.bin"),
+                                    dtype="<f4").reshape(T, N).astype(np.float64)
+
+    @staticmethod
+    def _avg_path(n):
+        n = np.maximum(n, 2.0)
+        H = np.log(n - 1.0) + 0.5772156649
+        return 2.0 * H - 2.0 * (n - 1.0) / n
+
+    def score(self, X):
+        X = np.nan_to_num(np.asarray(X, dtype=np.float64))
+        R = X.shape[0]
+        T = self.wvec.shape[0]
+        hsum = np.zeros(R)
+        for t in range(T):
+            node = np.zeros(R, dtype=np.int64)
+            depth_at = np.zeros(R)
+            for d in range(self.depth):
+                # a row parked at a non-split node stays parked: the
+                # traversal self-terminates, no done-mask needed
+                split = self.is_split[t, node]
+                proj = np.einsum("rf,rf->r", X, self.wvec[t, node])
+                right = proj > self.thr[t, node]
+                nxt = 2 * node + 1 + right.astype(np.int64)
+                node = np.where(split, nxt, node)
+                depth_at = np.where(split, depth_at + 1, depth_at)
+            # unresolved leaves contribute the subtree-size correction
+            c_term = np.where(self.counts[t, node] > 1,
+                              self._avg_path(self.counts[t, node]), 0.0)
+            hsum += depth_at + c_term
+        eh = hsum / T
+        cn = self._avg_path(np.asarray(float(self.sample_size)))
+        score = np.power(2.0, -eh / cn)
+        return score

@@ -50,6 +50,10 @@ def export_mojo(model, path: str) -> str:
         _write_glm_mojo(model, path)
     elif algo == "kmeans":
         _write_kmeans_mojo(model, path)
+    elif algo == "deeplearning":
+        _write_deeplearning_mojo(model, path)
+    elif algo in ("isolationforest", "extendedisolationforest"):
+        _write_isofor_mojo(model, path)
     else:
         raise NotImplementedError(f"MOJO export not implemented for '{algo}'")
     return path
@@ -239,4 +243,93 @@ def _write_kmeans_mojo(model, path: str):
         info[f"center_{i}"] = list(centers[i])
     zw = MojoZipWriter()
     _write_common(zw, info, columns, domains)
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_deeplearning_mojo(model, path: str):
+    """DeepLearning MOJO — the `hex/genmodel/algos/deeplearning/
+    DeeplearningMojoWriter` layout: per-layer weight/bias blobs plus the
+    input-normalization spec (cats offsets + numeric means/sigmas) so the
+    standalone scorer reproduces DataInfo.expand exactly."""
+    di = model.dinfo
+    out = model.output
+    category = out.model_category
+    if category == "AutoEncoder":
+        raise NotImplementedError("autoencoder MOJO export not supported "
+                                  "(the reference exports supervised DL only)")
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
+    cats = [n for n in di.names if n in di.domains]
+    nums = [n for n in di.names if n not in di.domains]
+    # columns in DataInfo order (cats first) — the scorer indexes by position
+    columns = cats + nums + [model.params.response_column]
+    domains = ([di.domains[n] for n in cats] + [None] * len(nums)
+               + [out.response_domain])
+    lo = 0 if di.use_all_factor_levels else 1
+    cat_offsets = [0]
+    for n in cats:
+        cat_offsets.append(cat_offsets[-1] + len(di.domains[n]) - lo)
+
+    net = model.net
+    info = _common_info(model, "deeplearning", "Deep Learning", category,
+                        n_classes, columns, domains, mojo_version=1.00)
+    info.update({
+        "activation": model.params.activation,
+        "n_layers": len(net),
+        # H2O-style layer widths: maxout layers report post-max units
+        "units": ([int(np.asarray(net[0]["W"]).shape[0])]
+                  + [int(np.asarray(l["b"]).shape[0])
+                     // (2 if (model.params.activation.lower()
+                               .startswith("maxout") and i < len(net) - 1)
+                         else 1)
+                     for i, l in enumerate(net)]),
+        "use_all_factor_levels": di.use_all_factor_levels,
+        "cats": len(cats),
+        "cat_modes": [di.cat_modes[n] for n in cats],
+        "cat_offsets": cat_offsets,
+        "nums": len(nums),
+        "num_means": [di.num_means[n] for n in nums],
+        "num_sigmas": [di.num_sigmas[n] for n in nums],
+        "standardize": di.standardize,
+        "center": di.effective_center,
+    })
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    for i, layer in enumerate(net):
+        zw.write_blob(f"weights/w{i:02d}.bin",
+                      np.asarray(layer["W"], dtype="<f4").tobytes())
+        zw.write_blob(f"weights/b{i:02d}.bin",
+                      np.asarray(layer["b"], dtype="<f4").tobytes())
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_isofor_mojo(model, path: str):
+    """Isolation Forest MOJO — `hex/genmodel/algos/isofor` role. The engine's
+    (extended) trees are hyperplane splits; blobs carry the per-node split
+    vectors/thresholds and per-node sample counts, and the scorer reproduces
+    2^(−E[pathlen]/c(n))."""
+    out = model.output
+    columns = list(out.names)
+    domains = [out.domains.get(n) for n in columns]
+    wvec, thr, is_split, counts = (np.asarray(a) for a in model.forest)
+    info = _common_info(model, model.algo_name, "Isolation Forest",
+                        "AnomalyDetection", 1, columns, domains,
+                        mojo_version=1.00)
+    info.update({
+        "supervised": False,
+        "n_features": len(columns),
+        "n_trees": int(wvec.shape[0]),
+        "n_nodes": int(wvec.shape[1]),
+        "max_depth": int(model.depth),
+        "sample_size": int(model.sample_size),
+    })
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_blob("isofor/wvec.bin", wvec.astype("<f4").tobytes())
+    zw.write_blob("isofor/thr.bin", thr.astype("<f4").tobytes())
+    zw.write_blob("isofor/is_split.bin",
+                  is_split.astype(np.uint8).tobytes())
+    zw.write_blob("isofor/counts.bin", counts.astype("<f4").tobytes())
     zw.finish(path)

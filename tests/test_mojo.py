@@ -130,3 +130,69 @@ def test_tree_bytecode_na_routing(tmp_path):
 
     engine = np.asarray(m.score0(jnp.asarray(X, jnp.float32)))
     np.testing.assert_allclose(engine, scorer.score(X), atol=1e-5, rtol=1e-4)
+
+
+def test_deeplearning_mojo_roundtrip(tmp_path):
+    """DL MOJO: standalone numpy scorer == engine predictions."""
+    from h2o_tpu.models.deeplearning import (DeepLearning,
+                                             DeepLearningParameters)
+    from h2o_tpu.mojo.reader import MojoModel
+
+    rng = np.random.default_rng(0)
+    n = 400
+    x1 = rng.normal(size=n).astype(np.float32)
+    c = rng.integers(0, 3, n)
+    y = (x1 + (c == 1) > 0.5).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1})
+    fr.add("c", Vec.from_numpy(c.astype(np.float32), type=T_CAT,
+                               domain=["a", "b", "cc"]))
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    m = DeepLearning(DeepLearningParameters(
+        training_frame=fr, response_column="y", hidden=[8, 8], epochs=5,
+        seed=1)).train_model()
+    path = m.save_mojo(str(tmp_path / "dl_test.zip"))
+    mojo = MojoModel.load(path)
+    engine_p = m.predict(fr).vec(2).to_numpy()
+    mojo_p = mojo.predict(fr)[:, 2]
+    assert np.allclose(engine_p, mojo_p, atol=1e-4), \
+        np.abs(engine_p - mojo_p).max()
+
+
+def test_dl_regression_mojo_roundtrip(tmp_path):
+    from h2o_tpu.models.deeplearning import (DeepLearning,
+                                             DeepLearningParameters)
+    from h2o_tpu.mojo.reader import MojoModel
+
+    rng = np.random.default_rng(1)
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = 2 * x + 1
+    fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+    m = DeepLearning(DeepLearningParameters(
+        training_frame=fr, response_column="y", hidden=[10], epochs=8,
+        seed=2, activation="Tanh")).train_model()
+    path = m.save_mojo(str(tmp_path / "dl_reg.zip"))
+    mojo = MojoModel.load(path)
+    assert np.allclose(m.predict(fr).vec(0).to_numpy(), mojo.predict(fr),
+                       atol=1e-4)
+
+
+def test_isolation_forest_mojo_roundtrip(tmp_path):
+    from h2o_tpu.models.isofor import (IsolationForest,
+                                       IsolationForestParameters)
+    from h2o_tpu.mojo.reader import MojoModel
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    X[:5] += 6.0  # obvious outliers
+    fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+    m = IsolationForest(IsolationForestParameters(
+        training_frame=fr, ntrees=10, sample_size=64, seed=3)).train_model()
+    path = m.save_mojo(str(tmp_path / "if_test.zip"))
+    mojo = MojoModel.load(path)
+    engine_s = m.predict(fr).vec(0).to_numpy()
+    mojo_s = mojo.predict(fr)
+    # scores must agree AND rank outliers on top in both
+    assert np.allclose(engine_s, mojo_s, atol=1e-3), \
+        np.abs(engine_s - mojo_s).max()
+    assert mojo_s[:5].mean() > mojo_s[5:].mean()
