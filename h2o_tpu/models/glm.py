@@ -331,6 +331,17 @@ class GLMModel(Model):
 class GLM(ModelBuilder):
     algo_name = "glm"
 
+    def _validate(self):
+        super()._validate()
+        p = self.params
+        if p.compute_p_values:  # reference: reject up front, before training
+            if p.lambda_search or (p.lambda_ is not None and p.lambda_ > 0):
+                raise ValueError("compute_p_values requires lambda = 0 / no "
+                                 "lambda_search (no regularization)")
+            if (p.family or "").lower() == "multinomial":
+                raise ValueError("compute_p_values is not supported for "
+                                 "multinomial family")
+
     def _family(self, category) -> Family:
         p = self.params
         name = (p.family or "AUTO").lower()
@@ -352,6 +363,9 @@ class GLM(ModelBuilder):
         names = self.feature_names()
         y_dev, category, resp_domain = self.response_info()
         if category == "Multinomial":
+            if p.compute_p_values:  # AUTO family resolving to multinomial
+                raise ValueError("compute_p_values is not supported for "
+                                 "multinomial family")
             return self._build_multinomial(job, names, y_dev, resp_domain)
         family = self._family(category)
 
@@ -387,9 +401,64 @@ class GLM(ModelBuilder):
         output.scoring_history = [{"iterations": iters, "lambda": lambda_used,
                                    "deviance": float(dev)}]
         output.variable_importances = self._varimp_from_beta(dinfo, beta)
+        if p.compute_p_values:
+            self._compute_p_values(model, X, y, w, offset, family, beta,
+                                   float(dev), float(neff))
         if p.validation_frame is not None:
             output.validation_metrics = model.model_performance(p.validation_frame)
         return model
+
+    def _compute_p_values(self, model, X, y, w, offset, family, beta,
+                          dev, neff):
+        """Std errors / z-values / p-values from the inverse Fisher
+        information at the solution (`hex/glm/GLM.java` computeSubmodel
+        p-values path). Unpenalized-fit requirement enforced in _validate."""
+        step = _make_irls_kernel(family)
+        ones = jnp.ones((X.shape[0], 1), jnp.float32)
+        Xi = jnp.concatenate([X, ones], axis=1)
+        G, _, _, _ = step(Xi, y, w, jnp.asarray(beta, jnp.float32), offset)
+        Gn = np.asarray(G, np.float64)
+        rank = len(beta)
+        gaussian = family.name == "gaussian"
+        dispersion = dev / max(neff - rank, 1.0) if gaussian else 1.0
+        try:
+            cov = np.linalg.inv(Gn + 1e-10 * np.eye(Gn.shape[0])) * dispersion
+        except np.linalg.LinAlgError:
+            return
+        # beta/cov live on the (possibly standardized) training scale, but
+        # coef() reports the ORIGINAL scale — transform the covariance with
+        # the same linear map beta_orig = A·beta_std so the reported
+        # (se, z, p) test the reported coefficients
+        di = model.dinfo
+        P1 = len(beta)
+        A = np.eye(P1)
+        if di.standardize or di.effective_center:
+            for j, n in enumerate(di.expanded_names):
+                if n in di.num_means:
+                    s = di.num_sigmas[n] if di.standardize else 1.0
+                    m = di.num_means[n] if di.effective_center else 0.0
+                    A[j, j] = 1.0 / s
+                    A[-1, j] = -m / s
+        cov = A @ cov @ A.T
+        beta_orig = A @ np.asarray(beta, np.float64)
+        se = np.sqrt(np.clip(np.diag(cov), 0, None))
+        z = np.where(se > 0, beta_orig / se, np.nan)
+        df = max(neff - rank, 1.0)
+        az = np.abs(np.nan_to_num(z))
+        if gaussian:  # t-tail via the regularized incomplete beta (no scipy)
+            import jax.scipy.special as jss
+
+            pvals = np.asarray(jss.betainc(df / 2.0, 0.5,
+                                           df / (df + az ** 2)))
+        else:  # two-sided z-test
+            import math
+
+            pvals = np.array([math.erfc(v / math.sqrt(2.0)) for v in az])
+        names = di.expanded_names + ["Intercept"]
+        model.std_errs = dict(zip(names, se))
+        model.z_values = dict(zip(names, z))
+        model.p_values = dict(zip(names, pvals))
+        model.dispersion = dispersion
 
     # -- the IRLS driver (`hex/glm/GLM.java:1682` GLMDriver.computeImpl) ------
     def _fit(self, X, y, w, offset, family, job):

@@ -51,7 +51,8 @@ class MojoModel:
             cls = {"gbm": _TreeMojo, "drf": _TreeMojo, "glm": _GlmMojo,
                    "kmeans": _KMeansMojo, "deeplearning": _DeepLearningMojo,
                    "isolationforest": _IsoForMojo,
-                   "extendedisolationforest": _IsoForMojo}.get(algo)
+                   "extendedisolationforest": _IsoForMojo,
+                   "pca": _PcaMojo}.get(algo)
             if cls is None:
                 raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
             model = cls(info, columns, domains)
@@ -245,9 +246,10 @@ class _DeepLearningMojo(MojoModel):
     forward pass over the stored layers, with the DataInfo input spec
     (one-hot cats first, standardized numerics) replayed exactly."""
 
-    def _read(self, zr):
+    def _read_datainfo_spec(self):
+        """Shared parse of the writer's _datainfo_spec keys (DL + PCA).
+        Writers always emit every key; defaults only guard hand-built zips."""
         g = lambda k, d=None: parse_kv(self.info.get(k), d)
-        self.activation = self.info.get("activation", "Rectifier")
         self.use_all = g("use_all_factor_levels", True)
         self.cats = g("cats", 0)
         self.cat_modes = np.asarray(g("cat_modes", []), dtype=np.int64)
@@ -257,6 +259,11 @@ class _DeepLearningMojo(MojoModel):
         self.num_sigmas = np.asarray(g("num_sigmas", []), dtype=np.float64)
         self.standardize = g("standardize", True)
         self.center = g("center", True)
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.activation = self.info.get("activation", "Rectifier")
+        self._read_datainfo_spec()
         n_layers = g("n_layers")
         self.layers = []
         for i in range(n_layers):
@@ -364,3 +371,21 @@ class _IsoForMojo(MojoModel):
         cn = self._avg_path(np.asarray(float(self.sample_size)))
         score = np.power(2.0, -eh / cn)
         return score
+
+
+# ---------------------------------------------------------------------------
+class _PcaMojo(_DeepLearningMojo):
+    """`hex/genmodel/algos/pca/PCAMojoModel` role. Reuses the DL reader's
+    DataInfo input replay (_expand); scores (expand(x) − μ) @ V."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self._read_datainfo_spec()
+        k = g("k")
+        self.V = np.frombuffer(zr.blob("pca/eigenvectors.bin"),
+                               dtype="<f8").reshape(-1, k)
+        self.mu = np.frombuffer(zr.blob("pca/mu.bin"), dtype="<f8")
+
+    def score(self, X):
+        Z = self._expand(np.asarray(X, dtype=np.float64))
+        return (Z - self.mu) @ self.V

@@ -54,6 +54,8 @@ def export_mojo(model, path: str) -> str:
         _write_deeplearning_mojo(model, path)
     elif algo in ("isolationforest", "extendedisolationforest"):
         _write_isofor_mojo(model, path)
+    elif algo == "pca":
+        _write_pca_mojo(model, path)
     else:
         raise NotImplementedError(f"MOJO export not implemented for '{algo}'")
     return path
@@ -102,6 +104,33 @@ def _supervised_columns(model):
     domains = [model.output.domains.get(n) for n in names]
     domains.append(model.output.response_domain)
     return columns, domains
+
+
+
+def _datainfo_spec(di) -> tuple[list, list, dict]:
+    """(cats+nums column order, domains, info keys) for writers that must
+    replay DataInfo.expand in the standalone scorer — single source of truth
+    shared by the GLM/DL/PCA writers."""
+    cats = [n for n in di.names if n in di.domains]
+    nums = [n for n in di.names if n not in di.domains]
+    lo = 0 if di.use_all_factor_levels else 1
+    cat_offsets = [0]
+    for n in cats:
+        cat_offsets.append(cat_offsets[-1] + len(di.domains[n]) - lo)
+    columns = cats + nums
+    domains = [di.domains[n] for n in cats] + [None] * len(nums)
+    info = {
+        "use_all_factor_levels": di.use_all_factor_levels,
+        "cats": len(cats),
+        "cat_modes": [di.cat_modes[n] for n in cats],
+        "cat_offsets": cat_offsets,
+        "nums": len(nums),
+        "num_means": [di.num_means[n] for n in nums],
+        "num_sigmas": [di.num_sigmas[n] for n in nums],
+        "standardize": di.standardize,
+        "center": di.effective_center,
+    }
+    return columns, domains, info
 
 
 # ---------------------------------------------------------------------------
@@ -260,20 +289,14 @@ def _write_deeplearning_mojo(model, path: str):
                                   "(the reference exports supervised DL only)")
     n_classes = {"Regression": 1, "Binomial": 2}.get(
         category, len(out.response_domain or []))
-    cats = [n for n in di.names if n in di.domains]
-    nums = [n for n in di.names if n not in di.domains]
     # columns in DataInfo order (cats first) — the scorer indexes by position
-    columns = cats + nums + [model.params.response_column]
-    domains = ([di.domains[n] for n in cats] + [None] * len(nums)
-               + [out.response_domain])
-    lo = 0 if di.use_all_factor_levels else 1
-    cat_offsets = [0]
-    for n in cats:
-        cat_offsets.append(cat_offsets[-1] + len(di.domains[n]) - lo)
-
+    feat_cols, feat_doms, di_info = _datainfo_spec(di)
+    columns = feat_cols + [model.params.response_column]
+    domains = feat_doms + [out.response_domain]
     net = model.net
     info = _common_info(model, "deeplearning", "Deep Learning", category,
                         n_classes, columns, domains, mojo_version=1.00)
+    info.update(di_info)
     info.update({
         "activation": model.params.activation,
         "n_layers": len(net),
@@ -284,15 +307,6 @@ def _write_deeplearning_mojo(model, path: str):
                                .startswith("maxout") and i < len(net) - 1)
                          else 1)
                      for i, l in enumerate(net)]),
-        "use_all_factor_levels": di.use_all_factor_levels,
-        "cats": len(cats),
-        "cat_modes": [di.cat_modes[n] for n in cats],
-        "cat_offsets": cat_offsets,
-        "nums": len(nums),
-        "num_means": [di.num_means[n] for n in nums],
-        "num_sigmas": [di.num_sigmas[n] for n in nums],
-        "standardize": di.standardize,
-        "center": di.effective_center,
     })
     zw = MojoZipWriter()
     _write_common(zw, info, columns, domains)
@@ -332,4 +346,31 @@ def _write_isofor_mojo(model, path: str):
     zw.write_blob("isofor/is_split.bin",
                   is_split.astype(np.uint8).tobytes())
     zw.write_blob("isofor/counts.bin", counts.astype("<f4").tobytes())
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_pca_mojo(model, path: str):
+    """PCA MOJO — `hex/genmodel/algos/pca/PCAMojoWriter` role: the expanded-
+    space eigenvector matrix + the DataInfo input spec, so the standalone
+    scorer reproduces `(expand(x) − μ) @ V`."""
+    di = model.dinfo
+    columns, domains, di_info = _datainfo_spec(di)
+
+    V = np.asarray(model.V, dtype=np.float64)      # (P, k)
+    mu = np.asarray(model.mu, dtype=np.float64)
+    if mu.ndim == 0:
+        mu = np.full(V.shape[0], float(mu))
+    info = _common_info(model, "pca", "Principal Components Analysis",
+                        "DimReduction", 1, columns, domains, mojo_version=1.00)
+    info.update(di_info)
+    info.update({
+        "supervised": False,
+        "n_features": len(columns),
+        "k": int(V.shape[1]),
+    })
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_blob("pca/eigenvectors.bin", V.astype("<f8").tobytes())
+    zw.write_blob("pca/mu.bin", mu.astype("<f8").tobytes())
     zw.finish(path)

@@ -126,3 +126,50 @@ def test_glm_multinomial():
     cm = tm.confusion_matrix
     acc = np.diag(cm).sum() / cm.sum()
     assert acc > 0.75, acc
+
+
+def test_glm_p_values_match_ols():
+    """compute_p_values: std errors equal the closed-form OLS covariance."""
+    rng = np.random.default_rng(0)
+    n = 500
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)  # noise
+    y = 2 * x1 + rng.normal(size=n).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y.astype(np.float32)})
+    # default standardize=True: the reported (se, z, p) must still be on the
+    # ORIGINAL coefficient scale (covariance transformed with the beta map)
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0,
+                          compute_p_values=True)).train_model()
+    assert m.p_values["x1"] < 1e-6 and m.p_values["x2"] > 0.01
+    X = np.stack([x1, x2, np.ones(n)], axis=1).astype(np.float64)
+    beta = np.linalg.lstsq(X, y.astype(np.float64), rcond=None)[0]
+    s2 = ((y - X @ beta) ** 2).sum() / (n - 3)
+    se = np.sqrt(np.diag(np.linalg.inv(X.T @ X)) * s2)
+    got = [m.std_errs[k] for k in ("x1", "x2", "Intercept")]
+    assert np.allclose(got, se, rtol=0.05)
+
+
+def test_glm_p_values_binomial_runs():
+    rng = np.random.default_rng(1)
+    n = 600
+    x = rng.normal(size=n).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(np.float32)
+    fr = Frame.from_dict({"x": x})
+    from h2o_tpu.frame.vec import T_CAT, Vec
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["a", "b"]))
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="binomial", lambda_=0.0,
+                          compute_p_values=True)).train_model()
+    assert m.p_values["x"] < 1e-6
+    assert 0 < m.std_errs["x"] < 1
+
+
+def test_glm_p_values_rejects_regularized():
+    fr = Frame.from_dict({"x": np.arange(50, dtype=np.float32),
+                          "y": np.arange(50, dtype=np.float32)})
+    import pytest
+    with pytest.raises(ValueError, match="lambda"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.5,
+                          compute_p_values=True)).train_model()

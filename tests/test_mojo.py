@@ -196,3 +196,21 @@ def test_isolation_forest_mojo_roundtrip(tmp_path):
     assert np.allclose(engine_s, mojo_s, atol=1e-3), \
         np.abs(engine_s - mojo_s).max()
     assert mojo_s[:5].mean() > mojo_s[5:].mean()
+
+
+def test_pca_mojo_roundtrip(tmp_path):
+    from h2o_tpu.models.pca import PCA, PCAParameters
+    from h2o_tpu.mojo.reader import MojoModel
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(200, 5)).astype(np.float32)
+    X[:, 1] = X[:, 0] * 2 + 0.1 * X[:, 1]
+    fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(5)})
+    m = PCA(PCAParameters(training_frame=fr, k=3, seed=1)).train_model()
+    path = m.save_mojo(str(tmp_path / "pca.zip"))
+    mojo = MojoModel.load(path)
+    engine = np.stack([m.predict(fr).vec(i).to_numpy() for i in range(3)],
+                      axis=1)
+    standalone = mojo.predict(fr)
+    assert np.allclose(engine, standalone, atol=1e-4), \
+        np.abs(engine - standalone).max()
