@@ -59,6 +59,9 @@ class GBMParameters(Parameters):
     monotone_constraints: dict = None        # {col: +1|-1} — `hex/tree/
                                              # Constraints.java` (h2o-py dict
                                              # format); regression/binomial only
+    interaction_constraints: list = None     # [[cols...], ...] allowed
+                                             # interaction groups (`hex/tree/
+                                             # GlobalInteractionConstraints`)
 
 
 class GBMModel(Model):
@@ -119,6 +122,7 @@ class GBM(ModelBuilder):
                              "multinomial models (reference restriction)")
         return TreeConfig(
             use_monotone=bool(getattr(p, "monotone_constraints", None)),
+            use_interaction=bool(getattr(p, "interaction_constraints", None)),
             ntrees=p.ntrees, max_depth=p.max_depth, nbins=p.nbins,
             min_rows=p.min_rows, learn_rate=p.learn_rate,
             reg_lambda=getattr(p, "reg_lambda", 0.0),
@@ -178,6 +182,10 @@ class GBM(ModelBuilder):
                                  f"'{col}' (numeric only, as in the reference)")
             mono_np[names.index(col)] = float(np.sign(d))
         mono = jax.device_put(mono_np, replicated(mesh))
+        imat_np = _interaction_matrix(names,
+                                      getattr(p, "interaction_constraints",
+                                              None))
+        imat = jax.device_put(imat_np, replicated(mesh))
         edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))
         Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
 
@@ -270,7 +278,8 @@ class GBM(ModelBuilder):
         stop_metric_series = []
         for ci, keys in enumerate(chunks):
             job.check_cancelled()
-            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, mono)
+            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, mono,
+                                imat)
             parts.append(trees)
             ntrees_done = sum(t[0].shape[0] for t in parts)
             m = make_metrics(category, jnp.where(ymask, y, jnp.nan),
@@ -392,6 +401,32 @@ def _assemble_forest(parts) -> dict:
     """Stack per-chunk tree arrays into the model's forest dict."""
     return {k: jnp.concatenate([t[i] for t in parts], axis=0)
             for i, k in enumerate(("feat", "thr", "nanL", "val", "gain"))}
+
+
+def _interaction_matrix(names, groups) -> np.ndarray:
+    """(F, F) may-interact matrix from interaction_constraints groups.
+    Features in no group form implicit singletons (may only split alone) —
+    `hex/tree/GlobalInteractionConstraints.java` semantics."""
+    F = len(names)
+    M = np.eye(F, dtype=bool)
+    if not groups:
+        return np.ones((F, F), dtype=bool)
+    idx = {n: i for i, n in enumerate(names)}
+    for grp in groups:
+        if isinstance(grp, str) or not isinstance(grp, (list, tuple)):
+            raise ValueError(
+                "interaction_constraints must be a list of column-name "
+                f"LISTS (e.g. [['a','b'],['c']]), got group {grp!r}")
+        ids = []
+        for col in grp:
+            if col not in idx:
+                raise ValueError(f"interaction_constraints column '{col}' is "
+                                 f"not a feature")
+            ids.append(idx[col])
+        for a in ids:
+            for b in ids:
+                M[a, b] = True
+    return M
 
 
 def _metrics_raw(category, dist, f, drf_mode, ntrees):

@@ -64,6 +64,9 @@ class TreeConfig:
                                     # (on for TPU backend, XLA path elsewhere)
     use_monotone: bool = False   # monotone_constraints active (static flag;
                                  # the per-feature directions ride as an array)
+    use_interaction: bool = False  # interaction_constraints active (the
+                                   # (F,F) may-interact matrix rides as an
+                                   # array)
 
     @property
     def n_nodes(self) -> int:
@@ -255,14 +258,20 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
 # Grow one tree fully on device (shard-local function; psums inside).
 # ---------------------------------------------------------------------------
 def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
-               mono=None):
+               mono=None, imat=None):
     """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,)).
 
     ``mono`` (F,) f32 in {-1,0,1}: monotone constraints. Split candidates
     violating a direction are masked in _find_splits; per-node [lo, hi] value
     bounds propagate to children through the split midpoint and clip leaf
     values — together these make every tree (hence the additive model)
-    monotone in each constrained feature (`hex/tree/Constraints.java`)."""
+    monotone in each constrained feature (`hex/tree/Constraints.java`).
+
+    ``imat`` (F, F) bool: may-interact matrix from interaction_constraints
+    (`hex/tree/GlobalInteractionConstraints.java`). Each node carries an
+    allowed-feature mask; a child's mask is the parent's intersected with the
+    split feature's interaction row, so a branch only ever combines features
+    from one constraint group."""
     Rl, F = Xb.shape
     N = cfg.n_nodes
     B = cfg.nbins + 1
@@ -274,8 +283,10 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     node = jnp.zeros((Rl,), dtype=jnp.int32)
     vals3 = jnp.stack([w, g, h], axis=1)
     constrained = mono is not None
+    interacting = imat is not None
     lo = jnp.full((N,), -jnp.inf, dtype=jnp.float32)
     hi = jnp.full((N,), jnp.inf, dtype=jnp.float32)
+    allowed = jnp.ones((N, F), dtype=jnp.bool_)  # per-node usable features
 
     # per-tree column subsample (same on all shards: colkey is not axis-folded)
     tree_cols = (jax.random.uniform(jax.random.fold_in(colkey, 997), (F,))
@@ -295,6 +306,9 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
 
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
                                 cfg, tree_cols)
+        if interacting:
+            allowed_n = jax.lax.dynamic_slice(allowed, (offset, 0), (n_lv, F))
+            cmask = cmask & allowed_n.T  # (F, n_lv)
 
         gain, bf, bb, bnal, Wt, vLs, vRs = _find_splits(
             hist, cmask, edge_ok, cfg, mono if constrained else None)
@@ -316,6 +330,15 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
             child_hi = jnp.stack([left_hi, right_hi], axis=1).reshape(-1)
             lo = jax.lax.dynamic_update_slice(lo, child_lo, (2 * offset + 1,))
             hi = jax.lax.dynamic_update_slice(hi, child_hi, (2 * offset + 1,))
+
+        if interacting:
+            # children inherit allowed ∩ interact-row(split feature)
+            row = imat[bf]  # (n_lv, F) tiny gather
+            child_allowed = jnp.where(do_split[:, None],
+                                      allowed_n & row, allowed_n)
+            both = jnp.repeat(child_allowed, 2, axis=0)  # (2*n_lv, F)
+            allowed = jax.lax.dynamic_update_slice(
+                allowed, both, (2 * offset + 1, 0))
 
         feat = jax.lax.dynamic_update_slice(
             feat, jnp.where(do_split, bf, -1), (offset,))
@@ -394,8 +417,9 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             return hit
     K = cfg.nclass
 
-    def spmd(Xb, y, w, f, edges, edge_ok, keys, mono):
+    def spmd(Xb, y, w, f, edges, edge_ok, keys, mono, imat):
         mono_arg = mono if cfg.use_monotone else None
+        imat_arg = imat if cfg.use_interaction else None
 
         def tree_step(f, key):
             rowkey = jax.random.fold_in(key, jax.lax.axis_index(ROWS))
@@ -416,13 +440,13 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             if K == 1:
                 ft, th, nl, vl, ga, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
-                    mono_arg)
+                    mono_arg, imat_arg)
                 delta = leaf_delta(vl, node)
             else:
                 grow = jax.vmap(
                     lambda gk, hk, ck: _grow_tree(Xb, gk * s, hk * s, w * s,
                                                   edges, edge_ok, ck, cfg,
-                                                  mono_arg))
+                                                  mono_arg, imat_arg))
                 ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
                 ft, th, nl, vl, ga, node = grow(g, h, ckeys)
                 delta = jax.vmap(leaf_delta)(vl, node)
@@ -435,7 +459,8 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     fspec = P(ROWS) if K == 1 else P(None, ROWS)
     fn = shard_map(
         spmd, mesh=mesh,
-        in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P()),
+        in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P(),
+                  P()),
         out_specs=(fspec, (P(), P(), P(), P(), P())),
         check_vma=False,
     )

@@ -80,3 +80,75 @@ def test_validation_errors():
     with pytest.raises(ValueError, match="categorical"):
         GBM(GBMParameters(training_frame=fr2, response_column="y", ntrees=2,
                           monotone_constraints={"c": 1})).train_model()
+
+
+class TestInteractionConstraints:
+    def test_branches_stay_within_groups(self):
+        rng = np.random.default_rng(0)
+        n = 2000
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        # response mixes all features so the unconstrained tree WOULD interact
+        y = (X[:, 0] * X[:, 2] + X[:, 1] * X[:, 3]
+             + 0.1 * rng.normal(size=n)).astype(np.float32)
+        fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+        fr.add("y", Vec.from_numpy(y))
+        groups = [["x0", "x1"], ["x2", "x3"]]
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=10, max_depth=4, seed=1,
+                              interaction_constraints=groups)).train_model()
+        allowed = np.zeros((4, 4), dtype=bool)
+        for grp in ([0, 1], [2, 3]):
+            for a in grp:
+                for b in grp:
+                    allowed[a, b] = True
+        feat = np.asarray(m.forest["feat"])  # (T, N)
+        N = feat.shape[1]
+        for t in range(feat.shape[0]):
+            for node in range(N):
+                f = feat[t, node]
+                if f < 0:
+                    continue
+                # collect ancestor split features
+                anc = []
+                p = node
+                while p > 0:
+                    p = (p - 1) // 2
+                    if feat[t, p] >= 0:
+                        anc.append(feat[t, p])
+                for a in anc:
+                    assert allowed[a, f], \
+                        f"tree {t}: {f} under ancestor {a} violates groups"
+
+    def test_unconstrained_does_interact(self):
+        # sanity: without constraints the same data produces mixed branches
+        rng = np.random.default_rng(0)
+        n = 2000
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] * X[:, 2] + X[:, 1] * X[:, 3]
+             + 0.1 * rng.normal(size=n)).astype(np.float32)
+        fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+        fr.add("y", Vec.from_numpy(y))
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=10, max_depth=4, seed=1)).train_model()
+        feat = np.asarray(m.forest["feat"])
+        mixed = False
+        for t in range(feat.shape[0]):
+            for node in range(feat.shape[1]):
+                f = feat[t, node]
+                if f < 0:
+                    continue
+                p = node
+                while p > 0:
+                    p = (p - 1) // 2
+                    a = feat[t, p]
+                    if a >= 0 and {int(a), int(f)} in ({0, 2}, {0, 3},
+                                                       {1, 2}, {1, 3}):
+                        mixed = True
+        assert mixed
+
+    def test_unknown_column_rejected(self):
+        fr = Frame.from_dict({"x": np.arange(100, dtype=np.float32),
+                              "y": np.arange(100, dtype=np.float32)})
+        with pytest.raises(ValueError, match="not a feature"):
+            GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=2,
+                              interaction_constraints=[["zzz"]])).train_model()
