@@ -266,6 +266,10 @@ class GLMParameters(Parameters):
     theta: float = 1.0
     missing_values_handling: str = "MeanImputation"
     compute_p_values: bool = False
+    feature_parallelism: int = 1   # >1: shard the expanded design over a 2-D
+                                   # rows×cols mesh — the wide/one-hot Gram
+                                   # sharding axis (SURVEY.md §5.7); GSPMD
+                                   # inserts the cross-axis collectives
 
 
 def _destandardize(beta: np.ndarray, di) -> np.ndarray:
@@ -341,6 +345,10 @@ class GLM(ModelBuilder):
             if (p.family or "").lower() == "multinomial":
                 raise ValueError("compute_p_values is not supported for "
                                  "multinomial family")
+            if p.feature_parallelism > 1:
+                raise NotImplementedError(
+                    "compute_p_values with feature_parallelism: follow-up "
+                    "(the Fisher information needs the unpadded design)")
 
     def _family(self, category) -> Family:
         p = self.params
@@ -366,12 +374,40 @@ class GLM(ModelBuilder):
             if p.compute_p_values:  # AUTO family resolving to multinomial
                 raise ValueError("compute_p_values is not supported for "
                                  "multinomial family")
+            if p.feature_parallelism > 1:
+                raise NotImplementedError(
+                    "feature_parallelism for multinomial GLM is a planned "
+                    "follow-up (per-class block IRLS needs per-block "
+                    "resharding)")
             return self._build_multinomial(job, names, y_dev, resp_domain)
         family = self._family(category)
 
         dinfo = DataInfo.make(fr, names, standardize=p.standardize,
                               missing_values_handling=p.missing_values_handling)
         X, okrow = dinfo.expand(fr)
+        pad_cols = 0
+        if p.feature_parallelism > 1:
+            # re-lay the design over a rows×cols mesh: wide one-hot designs
+            # shard the Gram accumulation over the feature axis too
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            from ..parallel.mesh import COLS, ROWS as _R, make_mesh
+
+            ndev = len(jax.devices())
+            if ndev % p.feature_parallelism:
+                raise ValueError(f"feature_parallelism="
+                                 f"{p.feature_parallelism} must divide the "
+                                 f"device count {ndev}")
+            fp = p.feature_parallelism
+            # zero-pad the feature axis to the shard count (the cols-axis
+            # ESPC analog); padded columns solve to beta=0 and are stripped
+            pad_cols = (-X.shape[1]) % fp
+            if pad_cols:
+                X = jnp.concatenate(
+                    [X, jnp.zeros((X.shape[0], pad_cols), X.dtype)], axis=1)
+            mesh2 = make_mesh(row_parallel=ndev // fp)
+            X = jax.device_put(X, NamedSharding(mesh2, _P(_R, COLS)))
+            y_dev = jax.device_put(y_dev, NamedSharding(mesh2, _P(_R)))
         y = jnp.nan_to_num(y_dev)
         w = (~jnp.isnan(y_dev)).astype(jnp.float32) * okrow.astype(jnp.float32)
         if p.weights_column:
@@ -381,6 +417,9 @@ class GLM(ModelBuilder):
 
         beta, lambda_used, dev, nulldev, neff, iters = self._fit(
             X, y, w, offset, family, job)
+        if pad_cols:  # strip padding: coefficients (all ~0) and design cols
+            beta = np.concatenate([beta[:dinfo.ncols_expanded], beta[-1:]])
+            X = X[:, :dinfo.ncols_expanded]
 
         output = ModelOutput()
         output.names = names

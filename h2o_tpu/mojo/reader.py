@@ -173,9 +173,32 @@ class _GlmMojo(MojoModel):
         self.num_means = np.asarray(g("num_means", []), dtype=np.float64)
         self.mean_imputation = g("mean_imputation", False)
         self.beta = np.asarray(g("beta"), dtype=np.float64)
+        if self.category == "Multinomial":  # flattened (K, P+1) class-major
+            self.beta = self.beta.reshape(self.n_classes, -1)
         self.family = self.info.get("family", "gaussian")
         self.link = self.info.get("link", "identity")
         self.tweedie_link_power = g("tweedie_link_power", 0.0)
+
+    def _cat_terms(self, X):
+        """Per-categorical (index, valid) arrays — independent of beta, so
+        multinomial scoring computes them once and reuses across classes."""
+        skip = 0 if self.use_all else 1
+        terms = []
+        for i in range(self.cats):
+            ival = X[:, i].astype(np.int64) - skip + self.cat_offsets[i]
+            ok = ((ival >= self.cat_offsets[i])
+                  & (ival < self.cat_offsets[i + 1]))
+            terms.append((np.clip(ival, 0, None), ok))
+        return terms
+
+    def _eta(self, X, beta, cat_terms=None):
+        eta = np.zeros(X.shape[0])
+        for ival, ok in (cat_terms if cat_terms is not None
+                         else self._cat_terms(X)):
+            eta += np.where(ok, beta[np.clip(ival, 0, len(beta) - 1)], 0.0)
+        ncat = self.cat_offsets[self.cats]
+        eta += X[:, self.cats:self.cats + self.nums] @ beta[ncat:-1]
+        return eta + beta[-1]
 
     def score(self, X):
         X = np.asarray(X, dtype=np.float64).copy()
@@ -185,17 +208,15 @@ class _GlmMojo(MojoModel):
             for i in range(self.nums):
                 c = self.cats + i
                 X[np.isnan(X[:, c]), c] = self.num_means[i]
-        eta = np.zeros(X.shape[0])
-        skip = 0 if self.use_all else 1
-        for i in range(self.cats):
-            ival = X[:, i].astype(np.int64) - skip + self.cat_offsets[i]
-            ok = (ival >= self.cat_offsets[i]) & (ival < self.cat_offsets[i + 1])
-            eta += np.where(ok, self.beta[np.clip(ival, 0, len(self.beta) - 1)],
-                            0.0)
-        ncat = self.cat_offsets[self.cats]
-        num_beta = self.beta[ncat:-1]
-        eta += X[:, self.cats:self.cats + self.nums] @ num_beta
-        eta += self.beta[-1]
+        if self.category == "Multinomial":  # softmax over per-class etas
+            terms = self._cat_terms(X)
+            etas = np.stack([self._eta(X, self.beta[k], terms)
+                             for k in range(self.beta.shape[0])], axis=1)
+            e = np.exp(etas - etas.max(axis=1, keepdims=True))
+            p = e / e.sum(axis=1, keepdims=True)
+            return np.concatenate(
+                [p.argmax(axis=1)[:, None].astype(np.float64), p], axis=1)
+        eta = self._eta(X, self.beta)
         mu = self._linkinv(eta)
         if self.category == "Binomial":
             return np.stack([(mu > 0.5).astype(np.float64), 1 - mu, mu],

@@ -197,8 +197,6 @@ def _write_tree_mojo(model, path: str):
 def _write_glm_mojo(model, path: str):
     out = model.output
     category = out.model_category
-    if category == "Multinomial":
-        raise NotImplementedError("multinomial GLM MOJO export: follow-up")
     di = model.dinfo
     cats = [n for n, c in zip(di.names, di.is_cat) if c]
     nums = [n for n, c in zip(di.names, di.is_cat) if not c]
@@ -217,9 +215,10 @@ def _write_glm_mojo(model, path: str):
     beta_out = _destandardize(np.asarray(model.beta, dtype=np.float64), di)
     means = np.array([di.num_means[n] for n in nums])
 
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
     info = _common_info(model, "glm", "Generalized Linear Modeling", category,
-                        2 if category == "Binomial" else 1, columns, domains,
-                        mojo_version=1.00)
+                        n_classes, columns, domains, mojo_version=1.00)
     info.update({
         "use_all_factor_levels": di.use_all_factor_levels,
         "cats": len(cats),
@@ -231,7 +230,9 @@ def _write_glm_mojo(model, path: str):
         # in both MeanImputation and Skip modes; Skip only downweights
         # training rows) — so the standalone scorer must impute too.
         "mean_imputation": True,
-        "beta": list(beta_out),
+        # multinomial: beta is the flattened (K, P+1) class-major matrix
+        # (`GlmMultinomialMojoReader` layout role)
+        "beta": list(beta_out.ravel()),
         "family": model.family.name,
         "link": _GLM_LINKS.get(model.family.link_name, "identity"),
         "tweedie_link_power": getattr(model.family, "tweedie_link_power", 0.0),

@@ -173,3 +173,50 @@ def test_glm_p_values_rejects_regularized():
         GLM(GLMParameters(training_frame=fr, response_column="y",
                           family="gaussian", lambda_=0.5,
                           compute_p_values=True)).train_model()
+
+
+def test_glm_feature_parallel_matches_default():
+    """feature_parallelism=2: 2-D rows x cols mesh sharding of the Gram
+    produces the same coefficients as the row-only default."""
+    rng = np.random.default_rng(3)
+    n, f = 1024, 8
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    beta = rng.normal(size=f).astype(np.float32)
+    y = X @ beta + 0.01 * rng.normal(size=n).astype(np.float32)
+    cols = {f"x{j}": X[:, j] for j in range(f)}
+    cols["y"] = y.astype(np.float32)
+    fr = Frame.from_dict(cols)
+    base = dict(training_frame=fr, response_column="y", family="gaussian",
+                lambda_=0.0)
+    c1 = GLM(GLMParameters(**base)).train_model().coef()
+    c2 = GLM(GLMParameters(**base, feature_parallelism=2)).train_model().coef()
+    for k in c1:
+        assert abs(c1[k] - c2[k]) < 1e-3, (k, c1[k], c2[k])
+
+
+def test_glm_feature_parallel_bad_count():
+    fr = Frame.from_dict({"x": np.arange(64, dtype=np.float32),
+                          "y": np.arange(64, dtype=np.float32)})
+    import pytest
+    with pytest.raises(ValueError, match="divide"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian",
+                          feature_parallelism=3)).train_model()
+
+
+def test_glm_feature_parallel_odd_columns():
+    """P not divisible by the factor: cols are zero-padded and stripped."""
+    rng = np.random.default_rng(4)
+    n, f = 512, 9  # odd feature count
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = X[:, 0] * 2 - X[:, 8]
+    cols = {f"x{j}": X[:, j] for j in range(f)}
+    cols["y"] = y.astype(np.float32)
+    fr = Frame.from_dict(cols)
+    base = dict(training_frame=fr, response_column="y", family="gaussian",
+                lambda_=0.0)
+    c1 = GLM(GLMParameters(**base)).train_model().coef()
+    c2 = GLM(GLMParameters(**base, feature_parallelism=2)).train_model().coef()
+    assert set(c1) == set(c2)  # no padded-column ghosts in the coef map
+    for k in c1:
+        assert abs(c1[k] - c2[k]) < 1e-3

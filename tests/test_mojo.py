@@ -214,3 +214,27 @@ def test_pca_mojo_roundtrip(tmp_path):
     standalone = mojo.predict(fr)
     assert np.allclose(engine, standalone, atol=1e-4), \
         np.abs(engine - standalone).max()
+
+
+def test_glm_multinomial_mojo_roundtrip(tmp_path):
+    from h2o_tpu.models.glm import GLM, GLMParameters
+    from h2o_tpu.mojo.reader import MojoModel
+
+    rng = np.random.default_rng(6)
+    n = 400
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = np.argmax(np.stack([x1, x2, -x1 - x2], axis=1)
+                  + 0.3 * rng.normal(size=(n, 3)), axis=1)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y.astype(np.float32), type=T_CAT,
+                               domain=["r", "g", "b"]))
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="multinomial")).train_model()
+    path = m.save_mojo(str(tmp_path / "glm_multi.zip"))
+    mojo = MojoModel.load(path)
+    engine = np.stack([m.predict(fr).vec(i).to_numpy() for i in (1, 2, 3)],
+                      axis=1)
+    standalone = mojo.predict(fr)[:, 1:]
+    assert np.allclose(engine, standalone, atol=2e-4), \
+        np.abs(engine - standalone).max()
