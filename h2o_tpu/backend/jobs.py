@@ -92,6 +92,19 @@ class Job(Keyed):
         """Request cooperative cancellation (`Job.stop_requested` contract)."""
         self._stop_requested = True
 
+    deadline: float | None = None  # wall-clock budget (max_runtime_secs)
+
+    def set_max_runtime(self, secs: float) -> None:
+        """Arm the per-model time budget (`Model.Parameters.max_runtime_secs`
+        — the reference stops training and keeps the partial model)."""
+        if secs and secs > 0:
+            self.deadline = time.time() + secs
+
+    def time_exceeded(self) -> bool:
+        """Iterative builders poll this between iterations and BREAK (keeping
+        the partial model), unlike check_cancelled which unwinds."""
+        return self.deadline is not None and time.time() > self.deadline
+
     @property
     def stop_requested(self) -> bool:
         return self._stop_requested

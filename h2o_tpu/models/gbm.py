@@ -278,6 +278,8 @@ class GBM(ModelBuilder):
         stop_metric_series = []
         for ci, keys in enumerate(chunks):
             job.check_cancelled()
+            if history and job.time_exceeded():  # keep the partial forest
+                break
             f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, mono,
                                 imat)
             parts.append(trees)

@@ -543,10 +543,14 @@ class GLM(ModelBuilder):
         iters_total = 0
         for lam in lambdas:
             job.check_cancelled()
+            if best is not None and job.time_exceeded():
+                break  # keep the best-so-far lambda (partial path)
             l1 = alpha * lam * neff
             l2 = (1 - alpha) * lam * neff
             dev_prev = np.inf
             for it in range(max(p.max_iterations, 1)):
+                if it and job.time_exceeded():
+                    break
                 G, b, dev, _ = step(Xi, y, w, jnp.asarray(beta, jnp.float32), offset)
                 iters_total += 1
                 Gn, bn = np.asarray(G, np.float64), np.asarray(b, np.float64)
@@ -606,6 +610,8 @@ class GLM(ModelBuilder):
         iters = 0
         for i in range(max(p.max_iterations, 1) * 4):  # cheap iterations
             job.check_cancelled()
+            if i and job.time_exceeded():
+                break
             beta, state, value, grad = step(beta, state)
             if p.non_negative:  # projected L-BFGS (IRLSM clips likewise)
                 beta = beta.at[:-1].set(jnp.clip(beta[:-1], 0, None))

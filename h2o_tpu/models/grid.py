@@ -5,10 +5,10 @@ random-discrete strategies with max_models / max_runtime_secs / early-stopping
 search criteria (`hex/grid/HyperSpaceWalker.java:409,511`), and the keyed
 `Grid` container of trained models ranked by a sort metric.
 
-Model builds run sequentially on the controller — the device mesh is the
-bottleneck resource either way (the reference's `ParallelModelBuilder`
-parallelized across idle CPU nodes; the analog here would be mesh slices,
-noted as a follow-up in SURVEY.md §7.6f).
+Model builds run sequentially on the controller by default; ``parallelism>1``
+overlaps host orchestration across a thread pool (the `ParallelModelBuilder`
+role — device work still serializes on the one mesh, so the win is the
+host-side setup/solve overlap).
 """
 
 from __future__ import annotations
@@ -106,12 +106,13 @@ class GridSearch:
 
     def __init__(self, builder_cls, params, hyper_params: dict,
                  search_criteria: SearchCriteria | None = None,
-                 recovery_dir: str | None = None):
+                 recovery_dir: str | None = None, parallelism: int = 1):
         self.builder_cls = builder_cls
         self.base_params = params
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.criteria = search_criteria or SearchCriteria()
         self.recovery_dir = recovery_dir
+        self.parallelism = max(1, int(parallelism))  # ParallelModelBuilder
         self._recovered_models: list = []
         self._recovered_done: list = []
 
@@ -137,26 +138,65 @@ class GridSearch:
             t0 = time.time()
             c = self.criteria
             scores = []
+            def build_one(overrides):
+                """Shared combo build for both execution modes: returns
+                (model|None, overrides, error|None)."""
+                try:
+                    params = self.base_params.clone(**overrides)
+                    return (self.builder_cls(params).train_model(),
+                            overrides, None)
+                except Exception as e:  # failed combos are data, not fatal
+                    return None, overrides, repr(e)
+
+            def accept(m, overrides, err):
+                if m is not None:
+                    grid.models.append(m)
+                    if rec is not None:
+                        self._record(rec, done, _combo_key(overrides), m,
+                                     len(grid.models) - 1)
+                elif err is not None:
+                    grid.failures.append({"params": overrides, "error": err})
+                job.update(0.0)
+
+            if self.parallelism > 1 and c.stopping_rounds <= 0:
+                # concurrent builds (`hex/ParallelModelBuilder` role): device
+                # work interleaves while host orchestration overlaps. Early
+                # stopping needs sequential scores, so it forces 1-at-a-time.
+                import concurrent.futures as cf
+
+                combos = [o for o in self._walk()
+                          if _combo_key(o) not in self._recovered_done]
+                with cf.ThreadPoolExecutor(max_workers=self.parallelism) as ex:
+                    futs = {ex.submit(build_one, o): o for o in combos}
+                    try:
+                        for fut in cf.as_completed(futs):
+                            if (job.stop_requested
+                                    or (c.max_models
+                                        and grid.model_count >= c.max_models)
+                                    or (c.max_runtime_secs
+                                        and time.time() - t0 > c.max_runtime_secs)):
+                                for f2 in futs:
+                                    f2.cancel()  # pending combos only
+                                break
+                            accept(*fut.result())
+                    finally:
+                        for f2 in futs:
+                            f2.cancel()
+                job.check_cancelled()  # surface stop() as CANCELLED
+                return grid
             for i, overrides in enumerate(self._walk()):
                 job.check_cancelled()
                 if c.max_models and grid.model_count >= c.max_models:
                     break
                 if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
                     break
-                key = _combo_key(overrides)
-                if key in self._recovered_done:
+                if _combo_key(overrides) in self._recovered_done:
                     continue  # already trained before the crash
-                try:
-                    params = self.base_params.clone(**overrides)
-                    m = self.builder_cls(params).train_model()
-                    grid.models.append(m)
-                    if rec is not None:
-                        self._record(rec, done, key, m, len(grid.models) - 1)
-                    if c.stopping_rounds > 0 and self._early_stop(grid, scores, c):
-                        break
-                except Exception as e:  # failed combos are recorded, not fatal
-                    grid.failures.append({"params": overrides, "error": repr(e)})
-                job.update(0.0)
+                m, overrides, err = build_one(overrides)
+                accept(m, overrides, err)
+                if (m is not None and c.stopping_rounds > 0
+                        and self._early_stop(grid, scores, c)):
+                    break
             return grid
 
         job.start(run, background=background)

@@ -315,6 +315,7 @@ class ModelBuilder:
     def train(self, background: bool = True) -> Job:
         """trainModel analog — returns the running Job."""
         self.job = Job(f"{self.algo_name} training", work=1.0)
+        self.job.set_max_runtime(self.params.max_runtime_secs)
 
         def run():
             t0 = time.time()
@@ -397,7 +398,9 @@ class ModelBuilder:
             va = _subset_frame(fr, va_idx)
             sub = type(self)(p.clone(training_frame=tr, validation_frame=None,
                                      nfolds=0, fold_column=None))
-            m = sub.build_impl(Job(f"cv_{f}", work=1.0))
+            fold_job = Job(f"cv_{f}", work=1.0)
+            fold_job.deadline = job.deadline  # folds share the outer budget
+            m = sub.build_impl(fold_job)
             holdout_metrics.append(m.model_performance(va))
             if p.keep_cross_validation_predictions:
                 pf = m.predict(va)

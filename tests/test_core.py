@@ -148,3 +148,40 @@ def test_mesh_shapes():
     assert meshmod.n_row_shards(m) == 8
     assert meshmod.padded_len(1, m) == 64
     assert meshmod.padded_len(1000, m) == 1024
+
+
+class TestMaxRuntime:
+    def test_gbm_time_budget_keeps_partial_forest(self):
+        import time as _time
+
+        import numpy as np
+
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+
+        rng = np.random.default_rng(0)
+        n = 5000
+        fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32),
+                              "y": rng.normal(size=n).astype(np.float32)})
+        # 1-tree chunks; a sub-microsecond budget expires right after the
+        # first chunk (the history guard always trains at least one) —
+        # deterministic regardless of machine speed
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=40, max_depth=3, seed=1,
+                              score_tree_interval=1,
+                              max_runtime_secs=1e-9)).train_model()
+        assert m.ntrees == 1  # partial forest, still a usable model
+        assert m.predict(fr).nrow == n
+
+    def test_glm_budget_returns_model(self):
+        import numpy as np
+
+        from h2o_tpu.models.glm import GLM, GLMParameters
+
+        rng = np.random.default_rng(1)
+        n = 2000
+        fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32),
+                              "y": rng.normal(size=n).astype(np.float32)})
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_search=True,
+                              max_runtime_secs=0.2)).train_model()
+        assert m.output.training_metrics is not None

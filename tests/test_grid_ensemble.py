@@ -121,3 +121,12 @@ def test_stacked_ensemble_requires_cv_preds(binom_frame):
         StackedEnsemble(StackedEnsembleParameters(
             training_frame=binom_frame, response_column="y",
             base_models=[gbm])).train_model()
+
+
+def test_grid_parallelism(binom_frame):
+    g = GridSearch(GLM, GLMParameters(training_frame=binom_frame,
+                                      response_column="y", family="binomial"),
+                   {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.0, 0.01]},
+                   parallelism=3).train()
+    assert g.model_count == 6
+    assert all(m.output.training_metrics.auc > 0.5 for m in g.models)
