@@ -160,9 +160,27 @@ def _default_plan() -> list[Step]:
     plan.append(Step("XRT", "def_1", _model_step("xrt", dict(ntrees=50))))
     # one random grid over GBM (`GBMStepsProvider.java:137` search space)
     plan.append(Step("GBM", "grid_1", _gbm_grid_step(), weight=60))
+    # exploitation: retrain the best GBM with learn-rate annealing
+    # (`GBMStepsProvider.java:170-182` GBMExploitationStep lr_annealing)
+    plan.append(Step("GBM", "lr_annealing", _gbm_exploitation_step(), weight=10))
     plan.append(Step("StackedEnsemble", "best_of_family", _se_step(True), weight=5))
     plan.append(Step("StackedEnsemble", "all", _se_step(False), weight=10))
     return plan
+
+
+def _gbm_exploitation_step():
+    def make(aml: "H2OAutoML"):
+        best_gbm = next((m for m in aml.leaderboard.sorted()
+                         if m.algo_name == "gbm"), None)
+        if best_gbm is None:
+            return None
+        params = best_gbm.params.clone(
+            learn_rate_annealing=0.99, ntrees=max(best_gbm.params.ntrees, 100),
+            training_frame=aml.training_frame)
+        from .gbm import GBM
+
+        return [GBM(params).train_model()]
+    return make
 
 
 def _builder_for(algo: str):

@@ -260,10 +260,20 @@ class GBM(ModelBuilder):
         base_seed = p.seed if p.seed not in (-1, None) else 1234
         all_keys = jax.random.split(jax.random.PRNGKey(base_seed),
                                     p.ntrees)[n_prior:]
+        # learn_rate_annealing: rate_i = annealing^i (GBM.java lr schedule);
+        # indices continue across chunks and checkpoint restarts. DRF has no
+        # learning rate at all — leaves are response means — so annealing is
+        # forced off there like learn_rate itself.
+        anneal = (1.0 if self.drf_mode
+                  else float(getattr(p, "learn_rate_annealing", 1.0) or 1.0))
+        all_rates = (anneal ** np.arange(n_prior, p.ntrees)
+                     ).astype(np.float32)
 
         interval = p.score_tree_interval or n_new
         interval = min(interval, n_new)
-        chunks = [all_keys[i:i + interval] for i in range(0, n_new, interval)]
+        chunks = [(all_keys[i:i + interval],
+                   jnp.asarray(all_rates[i:i + interval]))
+                  for i in range(0, n_new, interval)]
 
         output = ModelOutput()
         output.names = names
@@ -276,12 +286,12 @@ class GBM(ModelBuilder):
         import time as _t
 
         stop_metric_series = []
-        for ci, keys in enumerate(chunks):
+        for ci, (keys, rates) in enumerate(chunks):
             job.check_cancelled()
             if history and job.time_exceeded():  # keep the partial forest
                 break
-            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, mono,
-                                imat)
+            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, rates,
+                                mono, imat)
             parts.append(trees)
             ntrees_done = sum(t[0].shape[0] for t in parts)
             m = make_metrics(category, jnp.where(ymask, y, jnp.nan),

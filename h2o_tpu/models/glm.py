@@ -15,7 +15,8 @@ with soft-thresholding over the factorized Gram (the `L1Solver` design);
 
 Families: gaussian, binomial, quasibinomial, poisson, gamma, tweedie,
 negativebinomial, multinomial (per-class block IRLS, the reference's multiclass
-coordinate approach). Ordinal + HGLM are planned follow-ups.
+coordinate approach), ordinal (proportional odds, device gradient descent —
+the reference's GRADIENT_DESCENT_LH role). HGLM is a planned follow-up.
 """
 
 from __future__ import annotations
@@ -379,6 +380,8 @@ class GLM(ModelBuilder):
                     "feature_parallelism for multinomial GLM is a planned "
                     "follow-up (per-class block IRLS needs per-block "
                     "resharding)")
+            if (p.family or "").lower() == "ordinal":
+                return self._build_ordinal(job, names, y_dev, resp_domain)
             return self._build_multinomial(job, names, y_dev, resp_domain)
         family = self._family(category)
 
@@ -626,6 +629,95 @@ class GLM(ModelBuilder):
         dev = float(jnp.sum(family.deviance(y, mu, w)))
         return (np.asarray(beta, np.float64), lam, dev, nulldev, neff, iters)
 
+    def _build_ordinal(self, job, names, y_dev, resp_domain):
+        """Ordinal (proportional-odds) regression — `hex/glm/GLM.java`'s
+        ordinal family (solved there by GRADIENT_DESCENT_LH/SQERR). Cumulative
+        logits P(y≤k) = σ(θ_k − xβ) with monotone thresholds enforced by a
+        softplus reparameterization; fitted by full-batch Adam on device
+        (autodiff supplies the reference's hand-derived likelihood gradients)."""
+        import optax
+
+        p = self.params
+        fr = p.training_frame
+        K = len(resp_domain)
+        dinfo = DataInfo.make(fr, names, standardize=p.standardize,
+                              missing_values_handling=p.missing_values_handling)
+        X, okrow = dinfo.expand(fr)
+        y = jnp.nan_to_num(y_dev)
+        w = (~jnp.isnan(y_dev)).astype(jnp.float32) * okrow.astype(jnp.float32)
+        if p.weights_column:
+            w = w * jnp.nan_to_num(fr.vec(p.weights_column).data)
+        P = X.shape[1]
+        lam = p.lambda_ or 0.0
+        alpha = p.alpha if p.alpha is not None else 0.5
+        if alpha > 0 and lam > 0:
+            from ..utils.log import warn
+
+            warn("ordinal family ignores the l1 share of the penalty "
+                 "(gradient solver; same restriction as L_BFGS)")
+        l2 = (1 - alpha) * lam * float(jnp.sum(w))
+
+        def thresholds(params):
+            # θ_1 free; θ_k = θ_{k-1} + softplus(d_k) keeps them ordered
+            return params["t0"] + jnp.concatenate(
+                [jnp.zeros(1), jnp.cumsum(jax.nn.softplus(params["d"]))])
+
+        def nll(params):
+            eta = X @ params["beta"]
+            th = thresholds(params)                       # (K-1,)
+            cum = jax.nn.sigmoid(th[None, :] - eta[:, None])  # (R, K-1)
+            cdf = jnp.concatenate([jnp.zeros((X.shape[0], 1)), cum,
+                                   jnp.ones((X.shape[0], 1))], axis=1)
+            yk = y.astype(jnp.int32)
+            pk = (jnp.take_along_axis(cdf, yk[:, None] + 1, axis=1)
+                  - jnp.take_along_axis(cdf, yk[:, None], axis=1))[:, 0]
+            ll = jnp.sum(w * jnp.log(jnp.clip(pk, 1e-12, None)))
+            return -ll + 0.5 * l2 * jnp.sum(params["beta"] ** 2)
+
+        params = {"beta": jnp.zeros(P, jnp.float32),
+                  "t0": jnp.zeros(1, jnp.float32),
+                  "d": jnp.zeros(max(K - 2, 0), jnp.float32)}
+        opt = optax.adam(1e-1)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            v, g = jax.value_and_grad(nll)(params)
+            updates, state = opt.update(g, state, params)
+            return optax.apply_updates(params, updates), state, v
+
+        prev = np.inf
+        for i in range(max(p.max_iterations, 1) * 10):
+            job.check_cancelled()
+            if i and job.time_exceeded():
+                break
+            params, state, v = step(params, state)
+            v = float(v)
+            if i % 20 == 19:
+                if abs(prev - v) < p.objective_epsilon * max(abs(prev), 1.0):
+                    break
+                prev = v
+
+        output = ModelOutput()
+        output.names = names
+        output.domains = {n: fr.vec(n).domain for n in names}
+        output.response_domain = list(resp_domain)
+        output.model_category = "Multinomial"  # ordinal scores like multiclass
+        beta = np.asarray(params["beta"], np.float64)
+        th = np.asarray(thresholds(params), np.float64)
+        model = GLMOrdinalModel(p, output, dinfo, beta, th)
+        raw = model.score0(X)
+        ym = jnp.where(w > 0, y, jnp.nan)
+        m = make_metrics("Multinomial", ym, raw,
+                         w if p.weights_column else None)
+        output.training_metrics = m
+        output.scoring_history = [{"iterations": i + 1,
+                                   "negloglik": float(v)}]
+        if p.validation_frame is not None:
+            output.validation_metrics = model.model_performance(
+                p.validation_frame)
+        return model
+
     def _build_multinomial(self, job, names, y_dev, resp_domain):
         """Per-class block IRLS — `hex/glm/GLM.java` multinomial loop analog."""
         p = self.params
@@ -689,6 +781,42 @@ class GLM(ModelBuilder):
                 "relative_importance": mag[order],
                 "scaled_importance": mag[order] / mag.max(),
                 "percentage": mag[order] / mag.sum()}
+
+
+class GLMOrdinalModel(GLMModel):
+    """Proportional-odds model: β shared across classes + ordered thresholds."""
+
+    def __init__(self, params, output, dinfo, beta, thresholds, key=None):
+        super().__init__(params, output, dinfo, beta, BinomialF(), key=key)
+        self.thresholds = thresholds  # (K-1,) ordered cutpoints
+
+    def coef_norm(self) -> dict:
+        out = dict(zip(self.dinfo.expanded_names,
+                       np.asarray(self.beta, np.float64)))
+        for k, t in enumerate(self.thresholds):
+            out[f"threshold_{k + 1}"] = float(t)
+        return out
+
+    def coef(self) -> dict:
+        base = _destandardize(
+            np.concatenate([np.asarray(self.beta, np.float64), [0.0]]),
+            self.dinfo)
+        out = dict(zip(self.dinfo.expanded_names, base[:-1]))
+        # σ(θ − x_std·β_std) = σ((θ − c) − x_orig·β_orig) with
+        # c = −Σ β_j·m_j/s_j (= base[-1]); original-scale cutpoint is θ − c
+        for k, t in enumerate(self.thresholds):
+            out[f"threshold_{k + 1}"] = float(t) - float(base[-1])
+        return out
+
+    def score0(self, X):
+        eta = X @ jnp.asarray(self.beta, jnp.float32)
+        th = jnp.asarray(self.thresholds, jnp.float32)
+        cum = jax.nn.sigmoid(th[None, :] - eta[:, None])
+        cdf = jnp.concatenate([jnp.zeros((X.shape[0], 1)), cum,
+                               jnp.ones((X.shape[0], 1))], axis=1)
+        probs = jnp.diff(cdf, axis=1)
+        label = jnp.argmax(probs, axis=1).astype(jnp.float32)
+        return jnp.concatenate([label[:, None], probs], axis=1)
 
 
 class GLMMultinomialModel(GLMModel):

@@ -417,11 +417,12 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             return hit
     K = cfg.nclass
 
-    def spmd(Xb, y, w, f, edges, edge_ok, keys, mono, imat):
+    def spmd(Xb, y, w, f, edges, edge_ok, keys, rates, mono, imat):
         mono_arg = mono if cfg.use_monotone else None
         imat_arg = imat if cfg.use_interaction else None
 
-        def tree_step(f, key):
+        def tree_step(f, key_rate):
+            key, rate = key_rate  # rate: learn_rate_annealing^tree_index
             rowkey = jax.random.fold_in(key, jax.lax.axis_index(ROWS))
             if cfg.sample_rate < 1.0:
                 s = (jax.random.uniform(rowkey, w.shape[-1:]) < cfg.sample_rate
@@ -441,6 +442,7 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                 ft, th, nl, vl, ga, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
                     mono_arg, imat_arg)
+                vl = vl * rate
                 delta = leaf_delta(vl, node)
             else:
                 grow = jax.vmap(
@@ -449,18 +451,19 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                                                   mono_arg, imat_arg))
                 ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
                 ft, th, nl, vl, ga, node = grow(g, h, ckeys)
+                vl = vl * rate
                 delta = jax.vmap(leaf_delta)(vl, node)
             f = f + delta
             return f, (ft, th, nl, vl, ga)
 
-        f, trees = jax.lax.scan(tree_step, f, keys)
+        f, trees = jax.lax.scan(tree_step, f, (keys, rates))
         return f, trees
 
     fspec = P(ROWS) if K == 1 else P(None, ROWS)
     fn = shard_map(
         spmd, mesh=mesh,
         in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P(),
-                  P()),
+                  P(), P()),
         out_specs=(fspec, (P(), P(), P(), P(), P())),
         check_vma=False,
     )

@@ -52,7 +52,8 @@ class MojoModel:
                    "kmeans": _KMeansMojo, "deeplearning": _DeepLearningMojo,
                    "isolationforest": _IsoForMojo,
                    "extendedisolationforest": _IsoForMojo,
-                   "pca": _PcaMojo}.get(algo)
+                   "pca": _PcaMojo,
+                   "coxph": _CoxPHMojo}.get(algo)
             if cls is None:
                 raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
             model = cls(info, columns, domains)
@@ -410,3 +411,19 @@ class _PcaMojo(_DeepLearningMojo):
     def score(self, X):
         Z = self._expand(np.asarray(X, dtype=np.float64))
         return (Z - self.mu) @ self.V
+
+
+# ---------------------------------------------------------------------------
+class _CoxPHMojo(_DeepLearningMojo):
+    """`hex/genmodel/algos/coxph/CoxPHMojoModel` role: centered linear
+    predictor over the DataInfo-expanded design."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self._read_datainfo_spec()
+        self.beta = np.asarray(g("beta"), dtype=np.float64)
+        self.mean_x = np.asarray(g("mean_x"), dtype=np.float64)
+
+    def score(self, X):
+        Z = self._expand(np.asarray(X, dtype=np.float64))
+        return (Z - self.mean_x) @ self.beta

@@ -56,6 +56,8 @@ def export_mojo(model, path: str) -> str:
         _write_isofor_mojo(model, path)
     elif algo == "pca":
         _write_pca_mojo(model, path)
+    elif algo == "coxph":
+        _write_coxph_mojo(model, path)
     else:
         raise NotImplementedError(f"MOJO export not implemented for '{algo}'")
     return path
@@ -197,6 +199,10 @@ def _write_tree_mojo(model, path: str):
 def _write_glm_mojo(model, path: str):
     out = model.output
     category = out.model_category
+    if type(model).__name__ == "GLMOrdinalModel":
+        raise NotImplementedError(
+            "ordinal GLM MOJO export: follow-up (needs a threshold spec; the "
+            "reference's GlmOrdinalMojoModel)")
     di = model.dinfo
     cats = [n for n, c in zip(di.names, di.is_cat) if c]
     nums = [n for n, c in zip(di.names, di.is_cat) if not c]
@@ -374,4 +380,26 @@ def _write_pca_mojo(model, path: str):
     _write_common(zw, info, columns, domains)
     zw.write_blob("pca/eigenvectors.bin", V.astype("<f8").tobytes())
     zw.write_blob("pca/mu.bin", mu.astype("<f8").tobytes())
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_coxph_mojo(model, path: str):
+    """CoxPH MOJO — `hex/genmodel/algos/coxph/CoxPHMojoWriter` role: the
+    coefficient vector + the centering means; the standalone scorer emits the
+    centered linear predictor lp = (expand(x) − x̄)·β (hazard ratio =
+    exp(lp)), matching the engine's predict()."""
+    di = model.dinfo
+    columns, domains, di_info = _datainfo_spec(di)
+    columns = columns + [model.params.response_column]
+    domains = domains + [None]
+    info = _common_info(model, "coxph", "Cox Proportional Hazards",
+                        "CoxPH", 1, columns, domains, mojo_version=1.00)
+    info.update(di_info)
+    info.update({
+        "beta": [float(v) for v in np.asarray(model.beta)],
+        "mean_x": [float(v) for v in np.asarray(model.mean_x)],
+    })
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
     zw.finish(path)

@@ -124,3 +124,19 @@ def test_drf_classification():
     assert m.output.training_metrics.auc > 0.8
     p = m.predict(fr).vec("pyes").to_numpy()
     assert (p >= 0).all() and (p <= 1).all()
+
+
+def test_learn_rate_annealing_shrinks_later_trees():
+    rng = np.random.default_rng(0)
+    n = 1000
+    x = rng.normal(size=n).astype(np.float32)
+    y = 3 * x + 0.1 * rng.normal(size=n).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y.astype(np.float32)})
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=30,
+                          max_depth=3, seed=1, learn_rate=0.3,
+                          learn_rate_annealing=0.9)).train_model()
+    val = np.asarray(m.forest["val"])
+    leaf_mag = np.abs(val).max(axis=1)  # per-tree max |leaf|
+    # 0.9^20 ~ 0.12: late trees must be much smaller than early ones
+    assert leaf_mag[20] < leaf_mag[0] * 0.5
+    assert m.output.training_metrics.r2 > 0.8

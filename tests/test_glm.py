@@ -220,3 +220,34 @@ def test_glm_feature_parallel_odd_columns():
     assert set(c1) == set(c2)  # no padded-column ghosts in the coef map
     for k in c1:
         assert abs(c1[k] - c2[k]) < 1e-3
+
+
+def test_glm_ordinal_proportional_odds():
+    """family='ordinal': recovers ordered thresholds and the shared slope."""
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    x = rng.normal(size=n).astype(np.float32)
+    eta = 2.0 * x
+    u = rng.logistic(size=n)
+    latent = eta + u
+    y = np.digitize(latent, [-1.5, 1.5])  # 3 ordered classes, cuts at ±1.5
+    fr = Frame.from_dict({"x": x})
+    fr.add("y", Vec.from_numpy(y.astype(np.float32), type=T_CAT,
+                               domain=["low", "mid", "high"]))
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="ordinal", lambda_=0.0,
+                          max_iterations=60)).train_model()
+    c = m.coef()
+    assert abs(c["x"] - 2.0) < 0.25, c
+    assert c["threshold_1"] < c["threshold_2"]  # ordered cutpoints
+    assert abs(c["threshold_1"] + 1.5) < 0.3 and abs(c["threshold_2"] - 1.5) < 0.3
+    # class probabilities are a valid ordered partition
+    pred = m.predict(fr)
+    probs = np.stack([pred.vec(i).to_numpy() for i in (1, 2, 3)], axis=1)
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    # monotone: higher x -> higher P(high)
+    order = np.argsort(x)
+    p_high = probs[order, 2]
+    assert p_high[-1] > 0.8 and p_high[0] < 0.2

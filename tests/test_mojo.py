@@ -238,3 +238,24 @@ def test_glm_multinomial_mojo_roundtrip(tmp_path):
     standalone = mojo.predict(fr)[:, 1:]
     assert np.allclose(engine, standalone, atol=2e-4), \
         np.abs(engine - standalone).max()
+
+
+def test_coxph_mojo_roundtrip(tmp_path):
+    from h2o_tpu.models.coxph import CoxPH, CoxPHParameters
+    from h2o_tpu.mojo.reader import MojoModel
+
+    rng = np.random.default_rng(7)
+    n = 300
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    t = rng.exponential(scale=np.exp(-(0.8 * x1 - 0.4 * x2))).astype(np.float32)
+    event = (rng.random(n) < 0.8).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "t": t, "event": event})
+    m = CoxPH(CoxPHParameters(training_frame=fr, response_column="event",
+                              stop_column="t")).train_model()
+    path = m.save_mojo(str(tmp_path / "coxph.zip"))
+    mojo = MojoModel.load(path)
+    engine_lp = m.predict(fr).vec(0).to_numpy()
+    mojo_lp = mojo.predict(fr)
+    assert np.allclose(engine_lp, mojo_lp, atol=1e-4), \
+        np.abs(engine_lp - mojo_lp).max()
