@@ -14,6 +14,9 @@ allLeft/allRight semantics).
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -22,22 +25,44 @@ from ..frame.vec import T_STR, Vec
 
 
 def sort(fr: Frame, by: list[str] | None = None, ascending: list[bool] | None = None) -> Frame:
-    """Row-sort the frame by columns (device lexsort + gather)."""
+    """Row-sort the frame by columns.
+
+    TPU-native: ONE `lax.sort` carries every payload column through the sort
+    network alongside the keys, so no post-sort permutation gather is needed
+    (a 100M-row dynamic gather costs more than the sort itself on TPU).
+    String columns still need the permutation host-side; the sort emits it as
+    a carried iota only when one exists."""
     by = by or fr.names
     ascending = ascending or [True] * len(by)
     n = fr.nrow
-    # lexsort: last key is primary -> reverse; NaNs sort last (H2O sorts NAs first
-    # for ascending — match that by mapping NaN to -inf/. +inf for desc)
-    keys = []
-    for b, asc in zip(reversed(by), reversed(ascending)):
+    plen = fr.vec(by[0]).plen
+    # primary key first in lax.sort; NaNs first ascending (reference order),
+    # padding rows always last
+    pad = (jnp.arange(plen) >= n).astype(jnp.float32)
+    keys = [pad]
+    for b, asc in zip(by, ascending):
         k = fr.vec(b).data[:]
-        k = jnp.where(jnp.isnan(k), -jnp.inf, k)  # NAs first (reference order)
+        k = jnp.where(jnp.isnan(k), -jnp.inf, k)
         keys.append(k if asc else -k)
-    # padding rows must sort last regardless; lexsort's LAST key is primary
-    pad = (jnp.arange(fr.vec(by[0]).plen) >= n).astype(jnp.float32)
-    keys.append(pad)
-    order = jnp.lexsort(keys)
-    return _gather(fr, order, n)
+    num_names = [nm for nm in fr.names if not fr.vec(nm).is_string()]
+    str_names = [nm for nm in fr.names if fr.vec(nm).is_string()]
+    payload = [fr.vec(nm).data for nm in num_names]
+    if str_names:
+        payload.append(jnp.arange(plen, dtype=jnp.int32))  # permutation
+    sorted_all = jax.lax.sort(tuple(keys) + tuple(payload),
+                              num_keys=len(keys), is_stable=True)
+    out_cols = sorted_all[len(keys):]
+    names, vecs = [], []
+    perm = (np.asarray(out_cols[-1])[:n] if str_names else None)
+    for nm in fr.names:
+        v = fr.vec(nm)
+        if v.is_string():
+            vecs.append(Vec(None, n, type=T_STR, host_data=v.host_data[perm]))
+        else:
+            vecs.append(Vec.from_device(out_cols[num_names.index(nm)], n,
+                                        type=v.type, domain=v.domain))
+        names.append(nm)
+    return Frame(names, vecs)
 
 
 def _gather(fr: Frame, idx, nrow: int) -> Frame:
@@ -55,13 +80,100 @@ def _gather(fr: Frame, idx, nrow: int) -> Frame:
     return Frame(names, vecs)
 
 
+@functools.partial(jax.jit, static_argnames=("all_x",))
+def _merge_ranges(lk, rk, r_payload, all_x: bool):
+    """Phase 1 (one program): sort-carry the right table + match ranges."""
+    srt = jax.lax.sort((rk,) + tuple(r_payload), num_keys=1, is_stable=True)
+    rk_s, r_cols_s = srt[0], srt[1:]
+    lo = jnp.searchsorted(rk_s, lk, side="left")
+    hi = jnp.searchsorted(rk_s, lk, side="right")
+    counts = hi - lo
+    counts_eff = jnp.maximum(counts, 1) if all_x else counts
+    return r_cols_s, lo, counts, jnp.cumsum(counts_eff)
+
+
+@functools.partial(jax.jit, static_argnames=("total",))
+def _merge_expand(l_cols, r_cols_s, lo, counts, cum, total: int):
+    """Phase 2 (one program, output shape fixed by `total`): duplicate-key
+    expansion via scatter + cumsum of per-segment DELTAS — binary search
+    (searchsorted) over the cumsum is gather-bound on TPU (~27 dependent
+    gathers per row); delta-cumsum replaces it with one scatter pass and
+    bandwidth-bound scans. Segment starts are in left-row order, so every
+    per-row quantity q[l_idx] materializes as cumsum(scatter(Δq at starts))."""
+    starts = jnp.concatenate([jnp.zeros(1, cum.dtype), cum[:-1]])
+
+    def fill(per_row):  # per-left-row values -> per-output-row via Δ-cumsum
+        delta = jnp.diff(per_row, prepend=per_row[:1])
+        buf = jnp.zeros(total, per_row.dtype).at[starts].add(delta, mode='drop')
+        buf = buf.at[0].add(per_row[0])
+        return jnp.cumsum(buf)
+
+    ln = counts.shape[0]
+    l_idx = fill(jnp.arange(ln))
+    row_start = fill(starts)
+    row_lo = fill(lo)
+    row_matched = fill((counts > 0).astype(jnp.int32)) > 0
+    within = jnp.arange(total) - row_start
+    rn = r_cols_s[0].shape[0] if r_cols_s else 1
+    r_srt_pos = jnp.clip(row_lo + within, 0, rn - 1)
+    out_l = tuple(jnp.take(c, l_idx) for c in l_cols)
+    out_r = tuple(jnp.where(row_matched, jnp.take(c, r_srt_pos), jnp.nan)
+                  for c in r_cols_s)
+    return out_l, out_r
+
+
+def _merge_device(left: Frame, right: Frame, key: str, all_x: bool) -> Frame:
+    """Single-key numeric join on device in TWO compiled programs (the host
+    sync between them fixes the data-dependent output size). No per-row host
+    work — the RadixOrder/BinaryMerge role collapsed into XLA
+    sort/searchsorted/gather."""
+    ln, rn = left.nrow, right.nrow
+    # NA keys never match (BinaryMerge semantics): +inf left vs -inf right
+    lk = jnp.where(jnp.isnan(left.vec(key).data), jnp.inf,
+                   left.vec(key).data)[:ln]
+    rk = jnp.where(jnp.isnan(right.vec(key).data), -jnp.inf,
+                   right.vec(key).data)[:rn]
+    r_payload = tuple(right.vec(n).data[:rn] for n in right.names if n != key)
+    r_cols_s, lo, counts, cum = _merge_ranges(lk, rk, r_payload, all_x)
+    total = int(cum[-1])  # the one host sync
+    l_cols = tuple(left.vec(n).data[:ln] for n in left.names)
+    out_l, out_r = _merge_expand(l_cols, r_cols_s, lo, counts, cum, total)
+
+    names, vecs = [], []
+    for n, col in zip(left.names, out_l):
+        v = left.vec(n)
+        names.append(n)
+        vecs.append(Vec.from_device(col, total, type=v.type, domain=v.domain))
+    for n, col in zip((n for n in right.names if n != key), out_r):
+        v = right.vec(n)
+        names.append(n)
+        vecs.append(Vec.from_device(col, total, type=v.type, domain=v.domain))
+    return Frame(names, vecs)
+
+
 def merge(left: Frame, right: Frame, by: list[str] | None = None,
           all_x: bool = False, all_y: bool = False) -> Frame:
-    """Join on shared key columns. Host orchestration of device sorts;
-    duplicate right keys expand cartesian-style like BinaryMerge."""
+    """Join on shared key columns. Single-key numeric joins run fully on
+    device (_merge_device); multi-key / string / right-outer joins take the
+    host radix path. Duplicate right keys expand cartesian-style like
+    BinaryMerge."""
     by = by or [n for n in left.names if n in right.names]
     if not by:
         raise ValueError("no common columns to merge on")
+    if (len(by) == 1 and not all_y
+            and not any(left.vec(n).is_string() for n in left.names)
+            and not any(right.vec(n).is_string() for n in right.names)
+            # exact_data = f32-lossy values (big int64/time keys): the device
+            # columns are projections, so joining on them would collide
+            # distinct keys — those frames take the exact host path
+            and not any(left.vec(n).exact_data is not None
+                        for n in left.names)
+            and not any(right.vec(n).exact_data is not None
+                        for n in right.names)
+            and not left.vec(by[0]).is_categorical()
+            and not right.vec(by[0]).is_categorical()
+            and left.nrow > 0 and right.nrow > 0):
+        return _merge_device(left, right, by[0], all_x)
     ln, rn = left.nrow, right.nrow
     # NA keys never match (BinaryMerge semantics): NaN -> +inf on the left,
     # -inf on the right, so searchsorted ranges for them are always empty.

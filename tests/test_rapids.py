@@ -206,3 +206,49 @@ def test_groupby_negative_keys_and_na_group():
     got = {(-999.0 if np.isnan(k) else float(k)): float(s)
            for k, s in zip(keys, sums)}
     assert got == {-5.0: 3.0, -1.0: 3.0, -999.0: 4.0}
+
+
+def test_merge_device_matches_host_path():
+    """Device (single-key numeric) and host (forced via a string col) merge
+    paths must produce identical joins, incl. duplicates and unmatched keys."""
+    rng = np.random.default_rng(0)
+    ln, rn = 500, 60
+    lk = rng.integers(0, 40, ln).astype(np.float32)  # dups + some keys > rn
+    lv = rng.normal(size=ln).astype(np.float32)
+    rk = rng.integers(0, 30, rn).astype(np.float32)  # dup right keys too
+    rw = rng.normal(size=rn).astype(np.float32)
+    left = Frame.from_dict({"k": lk, "v": lv})
+    right = Frame.from_dict({"k": rk, "w": rw})
+    for all_x in (False, True):
+        dev = merge(left, right, by=["k"], all_x=all_x)
+        # force the host path with a string column, then drop it
+        left_s = Frame.from_dict({"k": lk, "v": lv})
+        left_s.add("s", Vec(None, ln, type="string",
+                            host_data=np.asarray(["x"] * ln, dtype=object)))
+        host = merge(left_s, right, by=["k"], all_x=all_x)
+        assert dev.nrow == host.nrow, (all_x, dev.nrow, host.nrow)
+        # compare whole ROWS (k,v,w) so payload misalignment can't hide
+        def rows(fr):
+            m = np.stack([np.nan_to_num(fr.vec(c).to_numpy(), nan=-9e9)
+                          for c in ("k", "v", "w")], axis=1)
+            return m[np.lexsort(m.T[::-1])]
+        assert np.allclose(rows(dev), rows(host), atol=1e-5), all_x
+
+
+
+def test_merge_exact_int64_keys_fall_back_to_host():
+    """Keys above 2^24 are f32-lossy; the join must use exact values."""
+    left = Frame.from_dict({"k": np.array([16777217, 16777216], np.int64),
+                            "v": np.array([1.0, 2.0], np.float32)})
+    right = Frame.from_dict({"k": np.array([16777217], np.int64),
+                             "w": np.array([9.0], np.float32)})
+    out = merge(left, right, by=["k"])
+    assert out.nrow == 1  # only the exact match, no f32 collision
+
+
+def test_merge_empty_left():
+    left = Frame.from_dict({"k": np.zeros(0, np.float32),
+                            "v": np.zeros(0, np.float32)})
+    right = Frame.from_dict({"k": np.array([1.0], np.float32),
+                             "w": np.array([2.0], np.float32)})
+    assert merge(left, right, by=["k"]).nrow == 0
