@@ -399,12 +399,23 @@ class H2OModelClient:
     def key(self):
         return self.model_id
 
-    def predict(self, frame: H2OFrame) -> H2OFrame:
+    def predict(self, frame: H2OFrame, **params) -> H2OFrame:
         j = connection().request(
             "POST",
             f"/3/Predictions/models/{urllib.parse.quote(self.model_id)}"
-            f"/frames/{urllib.parse.quote(frame.frame_id)}")
+            f"/frames/{urllib.parse.quote(frame.frame_id)}", params=params)
         return H2OFrame._by_id(j["predictions_frame"]["name"])
+
+    def predict_contributions(self, frame: H2OFrame) -> H2OFrame:
+        return self.predict(frame, predict_contributions="true")
+
+    def predict_leaf_node_assignment(self, frame: H2OFrame,
+                                     type="Path") -> H2OFrame:
+        return self.predict(frame, leaf_node_assignment="true",
+                            leaf_node_assignment_type=type)
+
+    def staged_predict_proba(self, frame: H2OFrame) -> H2OFrame:
+        return self.predict(frame, predict_staged_proba="true")
 
     def _metrics(self, kind="training_metrics") -> dict:
         return (self._schema or {}).get("output", {}).get(kind) or {}
@@ -421,6 +432,33 @@ class H2OModelClient:
 
     def logloss(self, **kw):
         return self._metrics().get("logloss")
+
+    def aucpr(self, **kw):
+        return self._metrics().get("pr_auc")
+
+    def kolmogorov_smirnov(self, **kw):
+        return self._metrics().get("ks")
+
+    def gini(self, **kw):
+        return self._metrics().get("gini")
+
+    def confusion_matrix(self, **kw):
+        cm = self._metrics().get("cm")
+        return cm and cm.get("table")
+
+    def gains_lift(self, **kw):
+        return self._metrics().get("gains_lift_table")
+
+    def F1(self, thresholds=None, **kw):
+        ts = self._metrics().get("thresholds_and_metric_scores") or {}
+        return list(zip(ts.get("thresholds", []), ts.get("f1", [])))
+
+    def find_threshold_by_max_metric(self, metric: str, **kw):
+        t = self._metrics().get("max_criteria_and_metric_scores")
+        if not t:
+            return None
+        names = t["data"][0]
+        return t["data"][1][names.index(f"max {metric}")]
 
     def varimp(self, use_pandas=False):
         vi = (self._schema or {}).get("output", {}).get("variable_importances")

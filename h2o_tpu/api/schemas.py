@@ -93,16 +93,41 @@ def job_schema(job: Job) -> dict:
     }
 
 
+def table_schema(t) -> dict | None:
+    """`water/api/schemas3/TwoDimTableV3` (compact form)."""
+    if t is None:
+        return None
+    return {"name": t.table_header, "description": t.description,
+            "columns": [{"name": h, "type": ty}
+                        for h, ty in zip(t.col_header, t.col_types)],
+            "data": _clean([[r[i] for r in t.cell_values]
+                            for i in range(len(t.col_header))])}
+
+
 def metrics_schema(m) -> dict | None:
     if m is None:
         return None
     out = {}
-    for f in ("mse", "rmse", "mae", "r2", "auc", "aucpr", "logloss",
-              "mean_per_class_error", "null_deviance", "residual_deviance",
-              "aic"):
+    for f in ("mse", "rmse", "mae", "r2", "auc", "pr_auc", "logloss",
+              "mean_per_class_error", "ks", "null_deviance",
+              "residual_deviance", "aic", "gini"):
         v = getattr(m, f, None)
         if v is not None:
-            out[f.upper() if f in ("auc", "aucpr", "aic") else f] = _clean(v)
+            out[{"auc": "AUC", "pr_auc": "pr_auc", "aic": "AIC"}.get(f, f)] = _clean(v)
+    cm = getattr(m, "confusion_matrix", None)
+    if cm is not None:
+        out["cm"] = {"table": _clean(cm)}
+    for f in ("gains_lift_table", "max_criteria_and_metric_scores"):
+        t = getattr(m, f, None)
+        if t is not None:
+            out[f] = table_schema(t)
+    ts = getattr(m, "thresholds_and_metric_scores", None)
+    if ts is not None:
+        # downsample the 1024-bin per-threshold arrays (stride 8 → 128 rows):
+        # the full resolution lives on the model; the wire payload only feeds
+        # client-side threshold lookups, where 1/128 granularity suffices
+        out["thresholds_and_metric_scores"] = {
+            k: _clean(np.asarray(v)[::8]) for k, v in ts.items()}
     return out
 
 

@@ -74,6 +74,10 @@ class H2OServer:
         return f"http://127.0.0.1:{self.port}"
 
 
+def _truthy(v) -> bool:
+    return str(v).lower() in ("true", "1", "yes")
+
+
 def _err(status: int, msg: str, **extra) -> tuple[int, dict]:
     return status, {"__meta": {"schema_type": "H2OError"},
                     "error_url": "", "msg": msg, "dev_msg": msg,
@@ -363,7 +367,15 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             return _err(404, f"model {mid} not found")
         if fr is None:
             return _err(404, f"frame {fid} not found")
-        pred = model.predict(fr)
+        if _truthy(p.get("predict_contributions")):
+            pred = model.predict_contributions(fr)
+        elif _truthy(p.get("leaf_node_assignment")):
+            pred = model.predict_leaf_node_assignment(
+                fr, type=p.get("leaf_node_assignment_type") or "Path")
+        elif _truthy(p.get("predict_staged_proba")):
+            pred = model.staged_predict_proba(fr)
+        else:
+            pred = model.predict(fr)
         dest = p.get("predictions_frame") or f"predictions_{mid}_{fid}"
         pred.key = dest
         STORE.put(dest, pred)

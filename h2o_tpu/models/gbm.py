@@ -24,7 +24,7 @@ import numpy as np
 
 from ..backend.jobs import Job
 from ..frame.frame import Frame
-from ..frame.vec import Vec
+from ..frame.vec import T_CAT, Vec
 from ..parallel.mesh import default_mesh, replicated
 from .distributions import Bernoulli, Gaussian, get_distribution
 from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
@@ -90,6 +90,104 @@ class GBMModel(Model):
             n = self.ntrees
             return self.f0 + s / jnp.maximum(n, 1)
         return self.f0 + s
+
+    # -- TreeSHAP contributions (`Model.scoreContributions`,
+    #    `hex/genmodel/algos/tree/TreeSHAP.java`) ---------------------------
+    def predict_contributions(self, fr: Frame) -> Frame:
+        """Per-feature SHAP contributions + BiasTerm, in margin space.
+        Rows sum to the raw (link-scale) prediction — same contract as the
+        reference (binomial/regression tree models only)."""
+        if self.output.model_category not in ("Regression", "Binomial"):
+            raise ValueError("predict_contributions supports regression and "
+                             "binomial tree models only (as in the reference)")
+        if "cover" not in self.forest:
+            raise ValueError("model has no stored node covers (trained before "
+                             "SHAP support, or imported from a MOJO without "
+                             "node weights)")
+        from .tree.shap import tree_shap
+
+        X = np.asarray(self.adapt_frame(fr))[:fr.nrow]
+        scale = 1.0 / max(self.ntrees, 1) if self.cfg.drf_mode else 1.0
+        phi = tree_shap(
+            X, np.asarray(self.forest["feat"]), np.asarray(self.forest["thr"]),
+            np.asarray(self.forest["nanL"]), np.asarray(self.forest["val"]),
+            np.asarray(self.forest["cover"]), bias0=float(self.f0),
+            scale=scale)
+        names = list(self.output.names) + ["BiasTerm"]
+        return Frame.from_dict(
+            {n: phi[:, i].astype(np.float32) for i, n in enumerate(names)})
+
+    def _leaf_nodes(self, X: np.ndarray) -> np.ndarray:
+        """(R, T*[K]) final heap node index per row per tree via host routing."""
+        feat = np.asarray(self.forest["feat"])
+        thr = np.asarray(self.forest["thr"])
+        nanL = np.asarray(self.forest["nanL"]).astype(bool)
+        multi = feat.ndim == 3
+        trees = [(feat[t], thr[t], nanL[t]) for t in range(feat.shape[0])] \
+            if not multi else \
+            [(feat[t, k], thr[t, k], nanL[t, k])
+             for t in range(feat.shape[0]) for k in range(feat.shape[1])]
+        R = X.shape[0]
+        out = np.zeros((R, len(trees)), dtype=np.int64)
+        rows = np.arange(R)
+        for ti, (f, th, nl) in enumerate(trees):
+            node = np.zeros(R, dtype=np.int64)
+            for _ in range(self.cfg.max_depth):
+                fs = f[node]
+                leaf = fs < 0
+                x = X[rows, np.clip(fs, 0, None)]
+                right = np.where(np.isnan(x), ~nl[node], x > th[node])
+                node = np.where(leaf, node, 2 * node + 1 + right)
+            out[:, ti] = node
+        return out
+
+    def predict_leaf_node_assignment(self, fr: Frame,
+                                     type: str = "Path") -> Frame:
+        """`Model.scoreLeafNodeAssignment` analog: per-tree terminal leaf as a
+        root-to-leaf L/R path string (default) or the heap node id."""
+        X = np.asarray(self.adapt_frame(fr))[:fr.nrow]
+        nodes = self._leaf_nodes(X)
+        feat = np.asarray(self.forest["feat"])
+        multi = feat.ndim == 3
+        K = feat.shape[1] if multi else 1
+        dom = self.output.response_domain or [str(i) for i in range(K)]
+        names = [f"T{t + 1}" if not multi else f"T{t + 1}.C{dom[k]}"
+                 for t in range(feat.shape[0]) for k in range(K)][:nodes.shape[1]]
+        if type == "Node_ID":
+            return Frame.from_dict({nm: nodes[:, i].astype(np.float32)
+                                    for i, nm in enumerate(names)})
+        out = Frame([], [])
+        for i, nm in enumerate(names):
+            uniq = np.unique(nodes[:, i])
+            lut = {int(n): _heap_path(int(n)) for n in uniq}
+            domain = sorted(set(lut.values()))
+            code = {s: j for j, s in enumerate(domain)}
+            codes = np.array([code[lut[int(n)]] for n in nodes[:, i]],
+                             dtype=np.float32)
+            out.add(nm, Vec.from_numpy(codes, type=T_CAT, domain=domain))
+        return out
+
+    def staged_predict_proba(self, fr: Frame) -> Frame:
+        """Cumulative class-1 probability (binomial) or prediction
+        (regression) after each successive tree (`Model.scoreStagedPredictions`)."""
+        if self.output.model_category not in ("Regression", "Binomial"):
+            raise ValueError("staged predictions support regression and "
+                             "binomial models only")
+        X = np.asarray(self.adapt_frame(fr))[:fr.nrow]
+        nodes = self._leaf_nodes(X)
+        val = np.asarray(self.forest["val"])
+        per_tree = np.stack([val[t][nodes[:, t]]
+                             for t in range(val.shape[0])], axis=1)
+        cum = np.cumsum(per_tree, axis=1)
+        if self.cfg.drf_mode:
+            cum = cum / np.arange(1, val.shape[0] + 1)[None, :]
+        f = float(self.f0) + cum
+        if self.cfg.drf_mode and self.output.model_category == "Binomial":
+            out = np.clip(f, 0.0, 1.0)
+        else:
+            out = np.asarray(self.dist.linkinv(jnp.asarray(f)))
+        return Frame.from_dict({f"T{t + 1}": out[:, t].astype(np.float32)
+                                for t in range(out.shape[1])})
 
 
 def _score_fn(model: GBMModel, X):
@@ -310,6 +408,12 @@ class GBM(ModelBuilder):
         output.training_metrics = history[-1]["training_metrics"]
 
         forest = _assemble_forest(parts)
+        # node covers for TreeSHAP (`forest_covers` docstring): one routing
+        # pass over the training rows, stored with the forest
+        from .tree.engine import forest_covers
+
+        forest["cover"] = forest_covers(X, w, forest["feat"], forest["thr"],
+                                        forest["nanL"], cfg.max_depth)
         output.variable_importances = self._varimp(forest, names)
         model = GBMModel(p, output, forest, f0, dist, cfg, is_cat)
         if p.validation_frame is not None:
@@ -407,6 +511,11 @@ class GBM(ModelBuilder):
             "scaled_importance": rel[order],
             "percentage": (imp / imp.sum())[order],
         }
+
+
+def _heap_path(node: int) -> str:
+    """Heap index → root-to-leaf L/R path string ('' for the root)."""
+    return "".join("R" if b == "1" else "L" for b in bin(node + 1)[3:])
 
 
 def _assemble_forest(parts) -> dict:

@@ -113,14 +113,34 @@ class ModelMetricsBinomial(ModelMetrics):
     gini: float = np.nan
     logloss: float = np.nan
     mean_per_class_error: float = np.nan
+    ks: float = np.nan
     max_f1: float = np.nan
     max_f1_threshold: float = np.nan
     confusion_matrix: Any = None  # 2x2 [[tn, fp], [fn, tp]] at max-F1 threshold
     thresholds_and_metric_scores: Any = None
+    max_criteria_and_metric_scores: Any = None   # TwoDimTable
+    gains_lift_table: Any = None                 # TwoDimTable
+
+    # `hex/AUC2.java` ThresholdCriterion surface
+    def find_threshold_by_max_metric(self, metric: str) -> float:
+        t = self.thresholds_and_metric_scores
+        i = int(np.nanargmax(t[metric]))
+        return float(t["thresholds"][i])
+
+    def metric_at_threshold(self, metric: str, threshold: float) -> float:
+        t = self.thresholds_and_metric_scores
+        i = int(np.argmin(np.abs(t["thresholds"] - threshold)))
+        return float(t[metric][i])
+
+    def confusion_matrix_at(self, threshold: float):
+        t = self.thresholds_and_metric_scores
+        i = int(np.argmin(np.abs(t["thresholds"] - threshold)))
+        return np.array([[t["tns"][i], t["fps"][i]], [t["fns"][i], t["tps"][i]]])
 
     def __repr__(self):
         return self._fmt([("AUC", self.auc), ("pr_auc", self.pr_auc),
                           ("LogLoss", self.logloss), ("Gini", self.gini),
+                          ("KS", self.ks),
                           ("MSE", self.mse), ("RMSE", self.rmse),
                           ("mean_per_class_error", self.mean_per_class_error),
                           ("max F1", f"{self.max_f1} @ {self.max_f1_threshold}")])
@@ -165,6 +185,8 @@ def make_binomial_metrics(y, p, weights=None) -> ModelMetricsBinomial:
     # Cumulative from the top bin down: predictions >= threshold are "positive".
     tp = np.cumsum(pos[::-1])[::-1]
     fp = np.cumsum(neg[::-1])[::-1]
+    tn = nneg - fp
+    fn = npos - tp
     tpr = tp / max(npos, 1e-10)
     fpr = fp / max(nneg, 1e-10)
     # append the (0,0) endpoint; prepend (1,1) is bin 0 cumulative
@@ -173,27 +195,103 @@ def make_binomial_metrics(y, p, weights=None) -> ModelMetricsBinomial:
     auc = float(-np.trapezoid(tpr_full, fpr_full))
     precision = tp / np.maximum(tp + fp, 1e-10)
     recall = tpr
+    specificity = tn / max(nneg, 1e-10)
     order = np.argsort(recall)
     pr_auc = float(np.trapezoid(precision[order], recall[order]))
+    # `hex/AUC2.java` ThresholdCriterion family over every threshold bin.
     f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-10)
+    f2 = 5 * precision * recall / np.maximum(4 * precision + recall, 1e-10)
+    f0point5 = 1.25 * precision * recall / np.maximum(0.25 * precision + recall, 1e-10)
+    accuracy = (tp + tn) / max(n, 1e-10)
+    mcc_den = np.sqrt(np.maximum((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn), 1e-10))
+    absolute_mcc = np.abs((tp * tn - fp * fn) / mcc_den)
+    min_per_class_accuracy = np.minimum(tpr, specificity)
+    mean_per_class_accuracy = 0.5 * (tpr + specificity)
     best = int(np.argmax(f1))
     thr = best / NBINS
-    tn = nneg - fp[best]
-    fn = npos - tp[best]
-    cm = np.array([[tn, fp[best]], [fn, tp[best]]])
-    mpce = 0.5 * (fp[best] / max(nneg, 1e-10) + fn / max(npos, 1e-10))
+    cm = np.array([[tn[best], fp[best]], [fn[best], tp[best]]])
+    mpce = 0.5 * (fp[best] / max(nneg, 1e-10) + fn[best] / max(npos, 1e-10))
     mse = float(r["mse"]) / max(n, 1e-10)
+    thresholds = np.arange(NBINS) / NBINS
+    scores = dict(
+        thresholds=thresholds, f1=f1, f2=f2, f0point5=f0point5,
+        accuracy=accuracy, precision=precision, recall=recall, tpr=tpr,
+        fpr=fpr, specificity=specificity, absolute_mcc=absolute_mcc,
+        min_per_class_accuracy=min_per_class_accuracy,
+        mean_per_class_accuracy=mean_per_class_accuracy,
+        tps=tp, fps=fp, tns=tn, fns=fn)
     return ModelMetricsBinomial(
         mse=mse, rmse=float(np.sqrt(mse)), nobs=int(n),
         auc=auc, pr_auc=pr_auc, gini=2 * auc - 1,
         logloss=float(r["logloss"]) / max(n, 1e-10),
         mean_per_class_error=float(mpce),
+        ks=float(np.max(tpr - fpr)),
         max_f1=float(f1[best]), max_f1_threshold=thr,
         confusion_matrix=cm,
-        thresholds_and_metric_scores=dict(
-            thresholds=np.arange(NBINS) / NBINS, f1=f1, precision=precision,
-            recall=recall, tpr=tpr, fpr=fpr),
+        thresholds_and_metric_scores=scores,
+        max_criteria_and_metric_scores=_max_criteria_table(scores),
+        gains_lift_table=_gains_lift(pos, neg, npos, n),
     )
+
+
+_MAX_CRITERIA = ("f1", "f2", "f0point5", "accuracy", "precision", "recall",
+                 "specificity", "absolute_mcc", "min_per_class_accuracy",
+                 "mean_per_class_accuracy")
+
+
+def _max_criteria_table(scores):
+    """`hex/AUC2.java` maxCriteria table: best value + threshold per criterion."""
+    from ..utils.twodimtable import TwoDimTable
+    rows = []
+    for crit in _MAX_CRITERIA:
+        v = scores[crit]
+        i = int(np.nanargmax(v))
+        rows.append([f"max {crit}", float(scores["thresholds"][i]),
+                     float(v[i]), i])
+    return TwoDimTable(
+        table_header="Maximum Metrics", description="Maximum metrics at their respective thresholds",
+        col_header=["metric", "threshold", "value", "idx"],
+        col_types=["string", "double", "double", "long"], cell_values=rows)
+
+
+def _gains_lift(pos, neg, npos, n, groups: int = 16):
+    """`hex/GainsLift.java`: quantile groups of predicted probability (top
+    first), capture/response rates and lift, from the same threshold histogram
+    the AUC uses (reference uses exact quantiles of the prediction column)."""
+    from ..utils.twodimtable import TwoDimTable
+    if npos <= 0 or n <= 0:
+        return None
+    tot = pos + neg                      # per-bin weighted counts
+    # walk bins from the top prob down, cutting a group at each n/groups
+    cum = np.cumsum(tot[::-1])           # cumulative rows from top
+    cum_pos = np.cumsum(pos[::-1])
+    targets = n * (np.arange(1, groups + 1) / groups)
+    idx = np.searchsorted(cum, targets - 1e-9)
+    idx = np.minimum(idx, len(cum) - 1)
+    rows, prev_rows, prev_pos = [], 0.0, 0.0
+    overall_rate = npos / n
+    for g in range(groups):
+        c_rows, c_pos = float(cum[idx[g]]), float(cum_pos[idx[g]])
+        g_rows, g_pos = c_rows - prev_rows, c_pos - prev_pos
+        if g_rows <= 0:
+            prev_rows, prev_pos = c_rows, c_pos
+            continue
+        lower_thr = 1.0 - (idx[g] + 1) / NBINS
+        resp_rate = g_pos / g_rows
+        cum_resp_rate = c_pos / c_rows
+        lift = resp_rate / overall_rate
+        cum_lift = cum_resp_rate / overall_rate
+        rows.append([g + 1, c_rows / n, lower_thr, resp_rate, cum_resp_rate,
+                     g_pos / npos, c_pos / npos, lift, cum_lift,
+                     100.0 * (lift - 1), 100.0 * (cum_lift - 1)])
+        prev_rows, prev_pos = c_rows, c_pos
+    return TwoDimTable(
+        table_header="Gains/Lift Table", description="Avg response rate: %5.2f %%" % (100 * overall_rate),
+        col_header=["group", "cumulative_data_fraction", "lower_threshold",
+                    "response_rate", "cumulative_response_rate",
+                    "capture_rate", "cumulative_capture_rate", "lift",
+                    "cumulative_lift", "gain", "cumulative_gain"],
+        col_types=["long"] + ["double"] * 10, cell_values=rows)
 
 
 def make_multinomial_metrics(y, probs, weights=None) -> ModelMetricsMultinomial:

@@ -477,6 +477,49 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
 # Forest prediction (vectorized CompressedTree traversal; `hex/tree/
 # CompressedTree.java` score0 analog).
 # ---------------------------------------------------------------------------
+def forest_covers(X, w, feat, thr, nanL, max_depth: int):
+    """Per-node weighted training-row counts ("cover"), shape (T, [K,] N).
+
+    The reference stores these node weights in the tree format for TreeSHAP
+    (`hex/genmodel/algos/tree/TreeSHAP.java` consumes per-node weights written
+    at training time). Here one routing pass over the training rows after the
+    forest is built: the same one-hot-matmul traversal as `predict_forest`,
+    accumulating the weighted occupancy of every node a row visits."""
+    multi = feat.ndim == 3
+    N = feat.shape[-1]
+    Xz = jnp.nan_to_num(X)
+    isnan_f = jnp.isnan(X).astype(jnp.float32)
+
+    def traverse(ftk, thk, nlk):
+        node = jnp.zeros(X.shape[0], dtype=jnp.int32)
+        S = jax.nn.one_hot(jnp.clip(ftk, 0), X.shape[1], dtype=jnp.float32)
+        counts = jnp.zeros(N, jnp.float32).at[0].set(jnp.sum(w))
+        for _ in range(max_depth):
+            n_oh = jax.nn.one_hot(node, N, dtype=jnp.float32)
+            P_feat = jnp.dot(n_oh, S, preferred_element_type=jnp.float32)
+            x = jnp.sum(P_feat * Xz, axis=1)
+            x_nan = jnp.sum(P_feat * isnan_f, axis=1) > 0.5
+            is_leaf = jnp.dot(n_oh, (ftk < 0).astype(jnp.float32)) > 0.5
+            row_thr = _onehot_pick(n_oh, thk)
+            row_nal = jnp.dot(n_oh, nlk.astype(jnp.float32)) > 0.5
+            go_right = jnp.where(x_nan, ~row_nal, x > row_thr)
+            node = jnp.where(is_leaf, node,
+                             2 * node + 1 + go_right.astype(jnp.int32))
+            moved = w * (~is_leaf).astype(jnp.float32)
+            counts = counts + jnp.dot(
+                jax.nn.one_hot(node, N, dtype=jnp.float32).T, moved,
+                preferred_element_type=jnp.float32)
+        return counts
+
+    def one_tree(carry, tree):
+        ft, th, nl = tree
+        out = jax.vmap(traverse)(ft, th, nl) if multi else traverse(ft, th, nl)
+        return carry, out
+
+    _, covers = jax.lax.scan(one_tree, 0, (feat, thr, nanL))
+    return covers
+
+
 def predict_forest(X, feat, thr, nanL, val, max_depth: int):
     """X: (R, F) raw values. feat/thr/nanL/val: (T, [K,] N). Returns summed
     tree outputs (R,) or (R, K).

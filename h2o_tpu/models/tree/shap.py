@@ -1,0 +1,137 @@
+"""Path-dependent TreeSHAP for heap-layout forests.
+
+Analog of `hex/genmodel/algos/tree/TreeSHAP.java` (the Lundberg & Lee exact
+tree SHAP, consumed by `Model.scoreContributions` /
+`predict_contributions` in the reference). The reference walks one row at a
+time through a recursive EXTEND/UNWIND over the decision path; here the same
+recursion runs once per *node* with every per-row quantity carried as a numpy
+vector over the whole row block — the hot/cold direction and the path weights
+are the only row-dependent state, so each tree costs O(nodes × depth) vector
+ops instead of O(rows × nodes × depth) scalar ops.
+
+Trees are the engine's complete-heap arrays (children of i at 2i+1 / 2i+2,
+`feat < 0` marks leaves); `cover` is the per-node weighted training-row count
+computed by `engine.forest_covers` at train time (the reference writes the
+equivalent node weights into the MOJO for SHAP)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EPS = 1e-12
+
+
+def _extend(pw, zf, of, pz, po):
+    """EXTEND: append (pz, po) to the path; updates pweights in place on
+    copies. pw: list of (R,) arrays; zf: list of floats; of: list of (R,)."""
+    l = len(zf)
+    pw = [a.copy() for a in pw]
+    pw.append(np.ones_like(po) if l == 0 else np.zeros_like(po))
+    for i in range(l - 1, -1, -1):
+        pw[i + 1] = pw[i + 1] + po * pw[i] * ((i + 1) / (l + 1))
+        pw[i] = pz * pw[i] * ((l - i) / (l + 1))
+    return pw, zf + [pz], of + [po]
+
+
+def _unwind(pw, zf, of, i):
+    """UNWIND: remove path entry i (previous occurrence of a feature)."""
+    l = len(zf) - 1
+    o, z = of[i], zf[i]
+    pw = [a.copy() for a in pw]
+    n = pw[l]
+    hot = o > 0
+    o_safe = np.where(hot, o, 1.0)
+    for j in range(l - 1, -1, -1):
+        t = pw[j]
+        pw_hot = n * (l + 1) / ((j + 1) * o_safe)
+        pw_cold = t * (l + 1) / max(z * (l - j), _EPS)
+        pw[j] = np.where(hot, pw_hot, pw_cold)
+        n = np.where(hot, t - pw[j] * z * ((l - j) / (l + 1)), n)
+    # entries i..l-1 of the fractions shift left by one; pweights lose the last
+    pw2 = pw[:l]
+    zf2 = zf[:i] + zf[i + 1:]
+    of2 = of[:i] + of[i + 1:]
+    return pw2, zf2, of2
+
+
+def _unwound_sum(pw, zf, of, i):
+    """Sum of pweights after notionally unwinding entry i (leaf step)."""
+    l = len(zf) - 1
+    o, z = of[i], zf[i]
+    hot = o > 0
+    o_safe = np.where(hot, o, 1.0)
+    n = pw[l]
+    total = np.zeros_like(pw[l])
+    for j in range(l - 1, -1, -1):
+        tmp = n * (l + 1) / ((j + 1) * o_safe)
+        cold = pw[j] * (l + 1) / max(z * (l - j), _EPS)
+        total = total + np.where(hot, tmp, cold)
+        n = np.where(hot, pw[j] - tmp * z * ((l - j) / (l + 1)), n)
+    return total
+
+
+def _tree_shap_one(X, feat, thr, nanL, val, cover, phi, scale):
+    """Accumulate one tree's SHAP values into phi (R, F+1)."""
+    R = X.shape[0]
+    f = feat.astype(np.int64)
+    idx = np.clip(f, 0, None)
+    xv = X[:, idx] if X.shape[1] else np.zeros((R, len(f)))
+    nan_x = np.isnan(xv)
+    right = np.where(nan_x, ~nanL.astype(bool)[None, :], xv > thr[None, :])
+
+    root_cover = max(cover[0], _EPS)
+    leaves = (f < 0) & (cover > 0)
+    # bias: expected leaf value under the training distribution
+    phi[:, -1] += scale * float(np.sum(cover[leaves] * val[leaves]) / root_cover)
+    if f[0] < 0:   # single-leaf tree: all bias, no attribution
+        return
+
+    def recurse(j, pw, zf, of, feats_path):
+        if f[j] < 0:
+            v = scale * val[j]
+            for i in range(1, len(feats_path)):
+                s = _unwound_sum(pw, zf, of, i)
+                phi[:, feats_path[i]] += s * (of[i] - zf[i]) * v
+            return
+        d = int(f[j])
+        cl, cr = 2 * j + 1, 2 * j + 2
+        rj = max(cover[j], _EPS)
+        hot_r = right[:, j]
+        try:
+            k = feats_path.index(d)
+        except ValueError:
+            k = -1
+        if k >= 0:
+            iz, io = zf[k], of[k]
+            pw, zf, of = _unwind(pw, zf, of, k)
+            feats_path = feats_path[:k] + feats_path[k + 1:]
+        else:
+            iz, io = 1.0, np.ones(R)
+        for child, is_right in ((cl, False), (cr, True)):
+            pz = iz * cover[child] / rj
+            po = io * (hot_r == is_right).astype(np.float64)
+            pw2, zf2, of2 = _extend(pw, zf, of, pz, po)
+            recurse(child, pw2, zf2, of2, feats_path + [d])
+
+    pw0, zf0, of0 = _extend([], [], [], 1.0, np.ones(R))
+    recurse(0, pw0, zf0, of0, [-1])
+
+
+def tree_shap(X, feat, thr, nanL, val, cover, bias0: float = 0.0,
+              scale: float = 1.0, block: int = 8192) -> np.ndarray:
+    """SHAP contributions for a forest.
+
+    X: (R, F) raw feature matrix (NaN = missing). feat/thr/nanL/val/cover:
+    (T, N) numpy arrays. Returns (R, F+1): per-feature phi + BiasTerm last,
+    in margin/link space; rows sum to the raw forest prediction + bias0."""
+    R, F = X.shape
+    out = np.zeros((R, F + 1), dtype=np.float64)
+    X64 = np.asarray(X, dtype=np.float64)
+    for s in range(0, R, block):
+        blk = slice(s, min(s + block, R))
+        phi = out[blk]
+        for t in range(feat.shape[0]):
+            _tree_shap_one(X64[blk], feat[t], thr[t], nanL[t], val[t],
+                           np.asarray(cover[t], dtype=np.float64), phi, scale)
+    out[:, -1] += bias0
+    return out

@@ -1,0 +1,81 @@
+"""Binomial metric parity pieces — gains/lift (`hex/GainsLift.java`),
+threshold criteria (`hex/AUC2.java` maxCriteria), KS statistic."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from h2o_tpu.models.metrics import make_binomial_metrics
+
+
+def _metrics(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    p = rng.random(n).astype(np.float32)
+    y = (rng.random(n) < p).astype(np.float32)   # well-calibrated, informative
+    return make_binomial_metrics(jnp.asarray(y), jnp.asarray(p))
+
+
+def test_threshold_scores_shape_and_bounds():
+    m = _metrics()
+    t = m.thresholds_and_metric_scores
+    for k in ("f1", "f2", "f0point5", "accuracy", "precision", "recall",
+              "specificity", "absolute_mcc", "min_per_class_accuracy",
+              "mean_per_class_accuracy", "tps", "fps", "tns", "fns"):
+        assert k in t and len(t[k]) == len(t["thresholds"])
+    assert 0.0 <= m.ks <= 1.0
+    assert m.ks > 0.3                         # informative predictor
+    assert np.all(t["accuracy"] <= 1.0 + 1e-6)
+    # counts are consistent: tp+fn = npos at every threshold
+    npos = t["tps"] + t["fns"]
+    assert np.allclose(npos, npos[0])
+
+
+def test_max_criteria_table():
+    m = _metrics()
+    t = m.max_criteria_and_metric_scores
+    assert t.col_header == ["metric", "threshold", "value", "idx"]
+    names = [r[0] for r in t.cell_values]
+    assert "max f1" in names and "max absolute_mcc" in names
+    # max f1 in the table equals the reported max_f1
+    i = names.index("max f1")
+    assert abs(t.cell_values[i][2] - m.max_f1) < 1e-9
+    # max accuracy >= accuracy at the F1-optimal threshold
+    acc_at_f1 = m.metric_at_threshold("accuracy", m.max_f1_threshold)
+    j = names.index("max accuracy")
+    assert t.cell_values[j][2] >= acc_at_f1 - 1e-9
+
+
+def test_find_threshold_and_cm_at():
+    m = _metrics()
+    thr = m.find_threshold_by_max_metric("f1")
+    assert abs(thr - m.max_f1_threshold) < 1e-9
+    cm = m.confusion_matrix_at(thr)
+    assert cm.shape == (2, 2)
+    assert np.allclose(cm, m.confusion_matrix)
+
+
+def test_gains_lift():
+    m = _metrics()
+    t = m.gains_lift_table
+    assert t is not None
+    rows = t.cell_values
+    cols = {h: i for i, h in enumerate(t.col_header)}
+    # final cumulative capture rate is 1, final cumulative lift is 1
+    assert abs(rows[-1][cols["cumulative_capture_rate"]] - 1.0) < 1e-6
+    assert abs(rows[-1][cols["cumulative_lift"]] - 1.0) < 1e-6
+    # top group captures far more than its data share (informative preds)
+    assert rows[0][cols["lift"]] > 1.3
+    # cumulative data fraction is increasing and ends at 1
+    cdf = [r[cols["cumulative_data_fraction"]] for r in rows]
+    assert all(b > a for a, b in zip(cdf, cdf[1:]))
+    assert abs(cdf[-1] - 1.0) < 1e-6
+    # capture rates sum to 1
+    assert abs(sum(r[cols["capture_rate"]] for r in rows) - 1.0) < 1e-6
+
+
+def test_perfect_separation():
+    y = np.concatenate([np.zeros(100), np.ones(100)]).astype(np.float32)
+    p = np.concatenate([np.full(100, 0.1), np.full(100, 0.9)]).astype(np.float32)
+    m = make_binomial_metrics(jnp.asarray(y), jnp.asarray(p))
+    assert m.auc > 0.99
+    assert m.ks > 0.99
+    assert m.max_f1 > 0.99

@@ -69,6 +69,26 @@ class TestRestApi:
         vi = m.varimp()
         assert vi["variable"][0] == "x1"
 
+    def test_contributions_and_metric_tables_via_rest(self, csv_frame):
+        fr, df = csv_frame
+        m = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+        m.train(y="y", training_frame=fr)
+        contrib = m.predict_contributions(fr).as_data_frame()
+        assert list(contrib.columns) == ["x1", "x2", "BiasTerm"]
+        assert len(contrib) == fr.nrow
+        leaves = m.predict_leaf_node_assignment(fr).as_data_frame()
+        assert len(leaves.columns) == 5
+        staged = m.staged_predict_proba(fr).as_data_frame()
+        assert len(staged.columns) == 5
+        # new binomial metric surface
+        assert 0 < m.kolmogorov_smirnov() <= 1
+        gl = m.gains_lift()
+        assert gl and "columns" in gl
+        cm = m.confusion_matrix()
+        assert np.asarray(cm).shape == (2, 2)
+        thr = m.find_threshold_by_max_metric("f1")
+        assert 0 <= thr <= 1
+
     def test_train_with_x_subset(self, csv_frame):
         fr, _ = csv_frame
         m = h2o.H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)

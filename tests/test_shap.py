@@ -1,0 +1,101 @@
+"""TreeSHAP contributions, leaf assignment, staged predictions
+(`Model.scoreContributions` / `hex/genmodel/algos/tree/TreeSHAP.java`)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.models.drf import DRF, DRFParameters
+
+
+def _reg_frame(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    x3 = rng.normal(size=n).astype(np.float32)   # pure noise vs response
+    y = (2 * x1 - x2 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return Frame.from_dict({"x1": x1, "x2": x2, "x3": x3, "y": y})
+
+
+def _bin_frame(n=600, seed=1):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = ((x1 + 0.5 * x2 + 0.3 * rng.normal(size=n)) > 0).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    return fr
+
+
+def test_contributions_additivity_regression():
+    fr = _reg_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=20, max_depth=4, seed=42)).train_model()
+    contrib = m.predict_contributions(fr)
+    assert contrib.names == ["x1", "x2", "x3", "BiasTerm"]
+    phi = np.stack([contrib.vec(n).to_numpy() for n in contrib.names], axis=1)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    # gaussian: margin == prediction; rows must sum to the prediction
+    assert np.allclose(phi.sum(axis=1), pred, atol=1e-3)
+    # the informative features dominate the noise feature
+    mean_abs = np.abs(phi).mean(axis=0)
+    assert mean_abs[0] > mean_abs[2] and mean_abs[1] > mean_abs[2]
+
+
+def test_contributions_additivity_binomial():
+    fr = _bin_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=15, max_depth=3, seed=7)).train_model()
+    contrib = m.predict_contributions(fr)
+    phi = np.stack([contrib.vec(n).to_numpy() for n in contrib.names], axis=1)
+    p1 = m.predict(fr).vec("pp").to_numpy()
+    margin = np.log(np.clip(p1, 1e-12, 1) / np.clip(1 - p1, 1e-12, 1))
+    assert np.allclose(phi.sum(axis=1), margin, atol=1e-3)
+
+
+def test_contributions_drf():
+    fr = _reg_frame()
+    m = DRF(DRFParameters(training_frame=fr, response_column="y",
+                          ntrees=10, max_depth=4, seed=3)).train_model()
+    contrib = m.predict_contributions(fr)
+    phi = np.stack([contrib.vec(n).to_numpy() for n in contrib.names], axis=1)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.allclose(phi.sum(axis=1), pred, atol=1e-3)
+
+
+def test_contributions_multinomial_rejected():
+    rng = np.random.default_rng(0)
+    fr = Frame.from_dict({"x": rng.normal(size=300).astype(np.float32)})
+    fr.add("y", Vec.from_numpy(rng.integers(0, 3, 300).astype(np.float32),
+                               type=T_CAT, domain=["a", "b", "c"]))
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=3, max_depth=2)).train_model()
+    with pytest.raises(ValueError):
+        m.predict_contributions(fr)
+
+
+def test_leaf_node_assignment():
+    fr = _reg_frame(n=300)
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=5, max_depth=3, seed=1)).train_model()
+    paths = m.predict_leaf_node_assignment(fr)
+    assert paths.ncol == 5 and paths.nrow == 300
+    col = paths.vec("T1")
+    assert col.is_categorical()
+    assert all(set(p) <= {"L", "R"} for p in col.domain)
+    ids = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    v = ids.vec("T1").to_numpy()
+    assert np.all(v >= 0) and np.all(v < 2 ** 4 - 1)
+
+
+def test_staged_predictions():
+    fr = _bin_frame(n=400)
+    m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                          ntrees=8, max_depth=3, seed=5)).train_model()
+    staged = m.staged_predict_proba(fr)
+    assert staged.ncol == 8
+    final = staged.vec("T8").to_numpy()
+    p1 = m.predict(fr).vec("pp").to_numpy()
+    assert np.allclose(final, p1, atol=1e-5)
