@@ -333,3 +333,30 @@ class MojoZipReader:
 
     def close(self):
         self._zip.close()
+
+
+# ---------------------------------------------------------------------------
+def bspline_basis(x: np.ndarray, lo: float, hi: float, interior: np.ndarray,
+                  degree: int = 3) -> np.ndarray:
+    """(R,) values -> (R, n_basis) cubic B-spline design. NAs/out-of-range are
+    clamped to the boundary (constant extrapolation)."""
+    x = np.clip(np.nan_to_num(x, nan=(lo + hi) / 2), lo, hi)
+    t = np.concatenate([[lo] * (degree + 1), interior, [hi] * (degree + 1)])
+    n_basis = len(interior) + degree + 1
+    # degree-0: indicator of knot span (right-open; last span right-closed)
+    B = np.zeros((len(x), len(t) - 1))
+    for i in range(len(t) - 1):
+        if t[i + 1] > t[i]:
+            B[:, i] = (x >= t[i]) & ((x < t[i + 1]) | (t[i + 1] == hi))
+    for d in range(1, degree + 1):
+        Bn = np.zeros((len(x), len(t) - 1 - d))
+        for i in range(len(t) - 1 - d):
+            left = 0.0
+            if t[i + d] > t[i]:
+                left = (x - t[i]) / (t[i + d] - t[i]) * B[:, i]
+            right = 0.0
+            if t[i + d + 1] > t[i + 1]:
+                right = (t[i + d + 1] - x) / (t[i + d + 1] - t[i + 1]) * B[:, i + 1]
+            Bn[:, i] = left + right
+        B = Bn
+    return B[:, :n_basis]

@@ -53,7 +53,16 @@ class MojoModel:
                    "isolationforest": _IsoForMojo,
                    "extendedisolationforest": _IsoForMojo,
                    "pca": _PcaMojo,
-                   "coxph": _CoxPHMojo}.get(algo)
+                   "coxph": _CoxPHMojo,
+                   "isotonic": _IsotonicMojo,
+                   "word2vec": _Word2VecMojo,
+                   "glrm": _GlrmMojo,
+                   "targetencoder": _TargetEncoderMojo,
+                   "upliftdrf": _UpliftMojo,
+                   "gam": _GamMojo,
+                   "rulefit": _RuleFitMojo,
+                   "psvm": _PsvmMojo,
+                   "stackedensemble": _EnsembleMojo}.get(algo)
             if cls is None:
                 raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
             model = cls(info, columns, domains)
@@ -427,3 +436,350 @@ class _CoxPHMojo(_DeepLearningMojo):
     def score(self, X):
         Z = self._expand(np.asarray(X, dtype=np.float64))
         return (Z - self.mean_x) @ self.beta
+
+
+# ---------------------------------------------------------------------------
+class _IsotonicMojo(MojoModel):
+    """`hex/genmodel/algos/isotonic/IsotonicRegressionMojoModel` role:
+    piecewise-linear interpolation over the fitted thresholds, clamped."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.xs = np.asarray(g("thresholds_x"), dtype=np.float64)
+        self.ys = np.asarray(g("thresholds_y"), dtype=np.float64)
+        self.out_of_bounds = self.info.get("out_of_bounds", "clip")
+
+    def score(self, X):
+        x = np.asarray(X, dtype=np.float64)[:, 0]
+        out = np.interp(x, self.xs, self.ys)
+        if self.out_of_bounds == "NA":
+            out = np.where((x < self.xs[0]) | (x > self.xs[-1]), np.nan, out)
+        return np.where(np.isnan(x), np.nan, out)
+
+
+# ---------------------------------------------------------------------------
+class _Word2VecMojo(MojoModel):
+    """`hex/genmodel/algos/word2vec/Word2VecMojoModel` role: word → embedding
+    lookup (plus cosine synonyms, the `h2o.find_synonyms` surface)."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.vec_size = g("vec_size")
+        words = [unescape_line(w)
+                 for w in zr.text("word2vec/words.txt").splitlines()]
+        self.vocab = {w: i for i, w in enumerate(words)}
+        self.vectors = np.frombuffer(
+            zr.blob("word2vec/vectors.bin"),
+            dtype="<f4").reshape(len(words), self.vec_size).astype(np.float64)
+        self._norm = self.vectors / np.maximum(
+            np.linalg.norm(self.vectors, axis=1, keepdims=True), 1e-12)
+
+    def transform(self, words) -> np.ndarray:
+        """(len(words), vec_size); unknown words → NaN rows."""
+        out = np.full((len(words), self.vec_size), np.nan)
+        for i, w in enumerate(words):
+            j = self.vocab.get(w)
+            if j is not None:
+                out[i] = self.vectors[j]
+        return out
+
+    def find_synonyms(self, word: str, count: int = 20):
+        j = self.vocab.get(word)
+        if j is None:
+            return {}
+        sims = self._norm @ self._norm[j]
+        order = np.argsort(-sims)
+        inv = {i: w for w, i in self.vocab.items()}
+        out = {}
+        for i in order:
+            if i != j:
+                out[inv[int(i)]] = float(sims[i])
+                if len(out) >= count:
+                    break
+        return out
+
+    def score(self, X):
+        raise NotImplementedError("word2vec MOJOs score words, not rows — "
+                                  "use transform()/find_synonyms()")
+
+
+# ---------------------------------------------------------------------------
+class _GlrmMojo(_DeepLearningMojo):
+    """`hex/genmodel/algos/glrm/GlrmMojoModel` role: project a row onto the
+    archetypes (masked least squares, the X-update the reference iterates at
+    scoring time) and emit the reconstruction in expanded space."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self._read_datainfo_spec()
+        k = g("k")
+        self.Y = np.frombuffer(zr.blob("glrm/archetypes.bin"),
+                               dtype="<f8").reshape(k, -1)
+
+    def _mask(self, X):
+        """Expanded-space validity mask from raw-column NAs."""
+        blocks = []
+        for i in range(self.cats):
+            card = int(self.cat_offsets[i + 1] - self.cat_offsets[i])
+            blocks.append(np.repeat(~np.isnan(X[:, i])[:, None], card, axis=1))
+        for i in range(self.nums):
+            blocks.append(~np.isnan(X[:, self.cats + i])[:, None])
+        return np.concatenate(blocks, axis=1).astype(np.float64)
+
+    def project(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        A = self._expand(X)
+        M = self._mask(X)
+        Y = self.Y
+        k = Y.shape[0]
+        G = np.einsum("km,rm,lm->rkl", Y, M, Y) + 1e-6 * np.eye(k)
+        b = np.einsum("km,rm,rm->rk", Y, M, np.where(M > 0, A, 0.0))
+        return np.linalg.solve(G, b[..., None])[..., 0]
+
+    def score(self, X):
+        return self.project(X) @ self.Y
+
+
+# ---------------------------------------------------------------------------
+class _TargetEncoderMojo(MojoModel):
+    """`hex/genmodel/algos/targetencoder/TargetEncoderMojoModel` role: the
+    no-leakage encoding path (posterior mean, optional blending)."""
+
+    def _read(self, zr):
+        import json
+
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.blending = g("blending", False)
+        self.inflection_point = g("inflection_point", 10.0)
+        self.smoothing = g("smoothing", 20.0)
+        self.prior = np.asarray(g("prior"), dtype=np.float64)
+        tables = json.loads(zr.text("targetencoder/tables.json"))
+        self.tables = {c: (np.asarray(t["num"], dtype=np.float64),
+                           np.asarray(t["den"], dtype=np.float64))
+                       for c, t in tables.items()}
+        self.encoded_columns = list(self.tables)
+
+    def score(self, X):
+        """X columns ordered as self.columns[:-1]; returns the te columns
+        stacked (R, sum of per-column target dims)."""
+        X = np.asarray(X, dtype=np.float64)
+        outs = []
+        for ci, col in enumerate(self.encoded_columns):
+            num, den = self.tables[col]
+            card = num.shape[0] - 1          # last slot = NA bucket
+            codes = X[:, ci]
+            ok = ~np.isnan(codes) & (codes < card)
+            idx = np.where(ok, codes, card).astype(np.int64)
+            row_num, row_den = num[idx], den[idx][:, None]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                post = row_num / np.maximum(row_den, 1e-300)
+            if self.blending:
+                lam = 1.0 / (1.0 + np.exp(np.clip(
+                    (self.inflection_point - row_den) /
+                    max(self.smoothing, 1e-12), -60, 60)))
+                val = lam * post + (1.0 - lam) * self.prior[None, :]
+            else:
+                val = post
+            # unseen/NA levels (den=0) fall back to the prior, exactly as the
+            # engine does after blending (target_encoder.py transform)
+            val = np.where(row_den > 0, val, self.prior[None, :])
+            outs.append(val)
+        return np.concatenate(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+class _UpliftMojo(MojoModel):
+    """`hex/genmodel/algos/upliftdrf` role: paired treatment/control tree
+    groups; emits [uplift, p_y1_ct1, p_y1_ct0]."""
+
+    def _read(self, zr):
+        self.n_trees = parse_kv(self.info.get("n_trees"))
+        self.trees_t, self.trees_c = [], []
+        for j in range(self.n_trees):
+            self.trees_t.append(decode_tree(zr.blob(f"trees/t00_{j:03d}.bin")))
+            self.trees_c.append(decode_tree(zr.blob(f"trees/t01_{j:03d}.bin")))
+
+    def score(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        pt = np.zeros(X.shape[0])
+        pc = np.zeros(X.shape[0])
+        for rt, rc in zip(self.trees_t, self.trees_c):
+            pt += score_tree(rt, X)
+            pc += score_tree(rc, X)
+        pt /= self.n_trees
+        pc /= self.n_trees
+        return np.stack([pt - pc, pt, pc], axis=1)
+
+
+# ---------------------------------------------------------------------------
+class _GamMojo(_DeepLearningMojo):
+    """`hex/genmodel/algos/gam/GamMojoModel` role: [linear-expanded | spline
+    bases] design, eta → linkinv."""
+
+    def _read(self, zr):
+        import json
+
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self._read_datainfo_spec()
+        self.beta = np.asarray(g("beta"), dtype=np.float64)
+        self.link = self.info.get("link", "identity")
+        self.n_lin = g("n_lin", 0)
+        self.gam_specs = json.loads(zr.text("gam/specs.json"))
+
+    _linkinv = _GlmMojo._linkinv
+    tweedie_link_power = 0.0
+
+    def score(self, X):
+        from .format import bspline_basis
+
+        X = np.asarray(X, dtype=np.float64)
+        blocks = []
+        if self.n_lin:
+            blocks.append(self._expand(X[:, :self.n_lin]))
+        for gi, spec in enumerate(self.gam_specs):
+            x = X[:, self.n_lin + gi]
+            B = bspline_basis(x, spec["lo"], spec["hi"],
+                              np.asarray(spec["interior"]), spec["degree"])
+            blocks.append(B - np.asarray(spec["col_means"])[None, :])
+        D = np.concatenate(blocks, axis=1)
+        eta = D @ self.beta[:-1] + self.beta[-1]
+        mu = self._linkinv(eta)
+        if self.category == "Binomial":
+            return np.stack([(mu > 0.5).astype(np.float64), 1 - mu, mu],
+                            axis=1)
+        return mu
+
+
+# ---------------------------------------------------------------------------
+class _RuleFitMojo(MojoModel):
+    """`hex/genmodel/algos/rulefit/RuleFitMojoModel` role: rule-membership
+    design + standardized linear terms, linear model on top."""
+
+    def _read(self, zr):
+        import json
+
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.beta = np.asarray(g("beta"), dtype=np.float64)
+        self.link = self.info.get("link", "identity")
+        spec = json.loads(zr.text("rulefit/spec.json"))
+        self.spec = spec
+        self.n_rules = g("n_rules", 0)
+
+    _linkinv = _GlmMojo._linkinv
+    tweedie_link_power = 0.0
+
+    def score(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        s = self.spec
+        blocks = []
+        if self.n_rules:
+            fidx = np.asarray(s["fidx"], dtype=np.int64)
+            thr = np.asarray(s["thr"], dtype=np.float64)
+            is_gt = np.asarray(s["is_gt"], dtype=bool)
+            na_left = np.asarray(s["na_left"], dtype=bool)
+            act = np.asarray(s["act"], dtype=bool)
+            xv = X[:, fidx]                       # (R, rules, L)
+            isna = np.isnan(xv)
+            le = np.where(isna, na_left, xv <= thr)
+            cond = np.where(is_gt, ~le, le)
+            cond = np.where(act, cond, True)
+            blocks.append(np.all(cond, axis=2).astype(np.float64))
+        if s["lin_names"]:
+            feats = self.columns[:-1] if self.supervised else self.columns
+            mus = np.asarray(s["lin_means"])
+            sgs = np.asarray(s["lin_sigmas"])
+            cols = []
+            for n, mu, sg in zip(s["lin_names"], mus, sgs):
+                col = X[:, feats.index(n)]
+                col = np.where(np.isnan(col), mu, col)
+                cols.append((col - mu) / sg)
+            blocks.append(np.stack(cols, axis=1))
+        D = np.concatenate(blocks, axis=1)
+        eta = D @ self.beta[:-1] + self.beta[-1]
+        mu = self._linkinv(eta)
+        if self.category == "Binomial":
+            return np.stack([(mu > 0.5).astype(np.float64), 1 - mu, mu],
+                            axis=1)
+        return mu
+
+
+# ---------------------------------------------------------------------------
+class _PsvmMojo(_DeepLearningMojo):
+    """`hex/genmodel/algos/psvm/SvmMojoModel` role: Nystrom (or linear)
+    decision function over the DataInfo-expanded features."""
+
+    def _read(self, zr):
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self._read_datainfo_spec()
+        self.gamma = g("gamma", 0.0)
+        self.bias = g("bias", 0.0)
+        self.kernel = self.info.get("kernel", "gaussian")
+        self.beta = np.frombuffer(zr.blob("psvm/beta.bin"), dtype="<f8")
+        if self.kernel == "gaussian":
+            lm = np.frombuffer(zr.blob("psvm/landmarks.bin"), dtype="<f8")
+            wh = np.frombuffer(zr.blob("psvm/whiten.bin"), dtype="<f8")
+            m = int(round(np.sqrt(wh.shape[0])))
+            self.whiten = wh.reshape(m, m)
+            self.landmarks = lm.reshape(m, -1)
+        else:
+            self.landmarks = self.whiten = None
+
+    def score(self, X):
+        Z = self._expand(np.asarray(X, dtype=np.float64))
+        if self.landmarks is not None:
+            d2 = (np.sum(Z * Z, axis=1, keepdims=True)
+                  - 2.0 * Z @ self.landmarks.T
+                  + np.sum(self.landmarks ** 2, axis=1)[None, :])
+            Z = np.exp(-self.gamma * np.maximum(d2, 0.0)) @ self.whiten
+        f = Z @ self.beta + self.bias
+        p1 = 1.0 / (1.0 + np.exp(-2.0 * f))
+        return np.stack([(f > 0).astype(np.float64), 1 - p1, p1], axis=1)
+
+
+# ---------------------------------------------------------------------------
+class _EnsembleMojo(MojoModel):
+    """`hex/genmodel/algos/ensemble/StackedEnsembleMojoModel` role: nested
+    base-model MOJOs feed a level-one row, scored by the metalearner MOJO."""
+
+    def _read(self, zr):
+        import json
+        import os
+        import tempfile
+
+        spec = json.loads(zr.text("ensemble/mapping.json"))
+        self.mapping = spec["bases"]
+        self.meta_features = spec["metalearner_features"]
+        self.base = []
+        tmpdir = tempfile.mkdtemp()
+        try:
+            n = parse_kv(self.info.get("n_base_models"))
+            for i in range(n):
+                pth = os.path.join(tmpdir, f"b{i}.zip")
+                with open(pth, "wb") as fh:
+                    fh.write(zr.blob(f"models/base_{i}.zip"))
+                self.base.append(MojoModel.load(pth))
+            pth = os.path.join(tmpdir, "meta.zip")
+            with open(pth, "wb") as fh:
+                fh.write(zr.blob("models/metalearner.zip"))
+            self.meta = MojoModel.load(pth)
+        finally:
+            import shutil
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    def score(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        feats = self.columns[:-1]
+        level_one = {}
+        for bm, mp in zip(self.base, self.mapping):
+            bfeats = bm.columns[:-1] if bm.supervised else bm.columns
+            Xb = X[:, [feats.index(f) for f in bfeats]]
+            pred = bm.score(Xb)
+            if mp["category"] == "Binomial":
+                level_one[mp["key"]] = pred[:, 2]
+            elif mp["category"] == "Multinomial":
+                for ki, cls in enumerate(mp["response_domain"]):
+                    level_one[f'{mp["key"]}/p{cls}'] = pred[:, 1 + ki]
+            else:
+                level_one[mp["key"]] = pred if pred.ndim == 1 else pred[:, 0]
+        D = np.stack([level_one[n] for n in self.meta_features], axis=1)
+        return self.meta.score(D)

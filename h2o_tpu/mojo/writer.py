@@ -58,6 +58,24 @@ def export_mojo(model, path: str) -> str:
         _write_pca_mojo(model, path)
     elif algo == "coxph":
         _write_coxph_mojo(model, path)
+    elif algo in ("isotonic", "isotonicregression"):
+        _write_isotonic_mojo(model, path)
+    elif algo == "word2vec":
+        _write_word2vec_mojo(model, path)
+    elif algo == "glrm":
+        _write_glrm_mojo(model, path)
+    elif algo == "targetencoder":
+        _write_targetencoder_mojo(model, path)
+    elif algo == "upliftdrf":
+        _write_uplift_mojo(model, path)
+    elif algo == "gam":
+        _write_gam_mojo(model, path)
+    elif algo == "rulefit":
+        _write_rulefit_mojo(model, path)
+    elif algo == "psvm":
+        _write_psvm_mojo(model, path)
+    elif algo == "stackedensemble":
+        _write_ensemble_mojo(model, path)
     else:
         raise NotImplementedError(f"MOJO export not implemented for '{algo}'")
     return path
@@ -401,5 +419,291 @@ def _write_coxph_mojo(model, path: str):
         "mean_x": [float(v) for v in np.asarray(model.mean_x)],
     })
     zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_isotonic_mojo(model, path: str):
+    """Isotonic MOJO — `hex/genmodel/algos/isotonic/IsotonicRegressionMojoWriter`
+    role: the fitted step thresholds; scoring is piecewise-linear
+    interpolation clamped to the fitted range."""
+    columns = list(model.output.names) + [model.params.response_column]
+    domains = [None] * len(columns)
+    info = _common_info(model, "isotonic", "Isotonic Regression", "Regression",
+                        1, columns, domains, mojo_version=1.00)
+    xs = np.asarray(model.xs, dtype=np.float64)
+    ys = np.asarray(model.ys, dtype=np.float64)
+    info.update({"n_thresholds": len(xs),
+                 "thresholds_x": list(xs), "thresholds_y": list(ys),
+                 "out_of_bounds": getattr(model.params, "out_of_bounds",
+                                          "clip")})
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_word2vec_mojo(model, path: str):
+    """Word2Vec MOJO — `hex/genmodel/algos/word2vec/Word2VecMojoWriter` role:
+    the embedding matrix as one float blob + the vocabulary, word-aligned."""
+    words = sorted(model.vocab, key=model.vocab.get)
+    vectors = np.asarray(model.vectors, dtype="<f4")
+    info = _common_info(model, "word2vec", "Word2Vec", "WordEmbedding", 1,
+                        [], [], mojo_version=1.00)
+    info.update({"supervised": False, "n_features": 0,
+                 "vec_size": int(vectors.shape[1]),
+                 "vocab_size": int(vectors.shape[0])})
+    zw = MojoZipWriter()
+    _write_common(zw, info, [], [])
+    zw.write_text("word2vec/words.txt",
+                  "\n".join(escape_line(w) for w in words) + "\n")
+    zw.write_blob("word2vec/vectors.bin", vectors.tobytes())
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_glrm_mojo(model, path: str):
+    """GLRM MOJO — `hex/genmodel/algos/glrm/GlrmMojoWriter` role: the
+    archetype matrix Y + the DataInfo spec; the scorer projects rows onto the
+    archetypes by masked least squares (the reference runs the same X-update
+    iteration at scoring time)."""
+    di = model.dinfo
+    columns, domains, di_info = _datainfo_spec(di)
+    Y = np.asarray(model.Y, dtype=np.float64)
+    info = _common_info(model, "glrm", "Generalized Low Rank Modeling",
+                        "DimReduction", 1, columns, domains, mojo_version=1.00)
+    info.update(di_info)
+    info.update({"supervised": False, "n_features": len(columns),
+                 "k": int(Y.shape[0]), "expanded": int(Y.shape[1])})
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_blob("glrm/archetypes.bin", Y.astype("<f8").tobytes())
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_targetencoder_mojo(model, path: str):
+    """TargetEncoder MOJO — `hex/genmodel/algos/targetencoder/
+    TargetEncoderMojoWriter` role: per-column numerator/denominator tables +
+    prior + blending hyperparameters. Scoring applies the no-leakage path
+    (strategy None) exactly as `TargetEncoderMojoModel` does."""
+    import json
+
+    out = model.output
+    cols = list(model.encodings)
+    columns = cols + [model.params.response_column]
+    domains = [out.domains[c] for c in cols] + [out.response_domain]
+    info = _common_info(model, "targetencoder", "TargetEncoder", "TargetEncoder",
+                        1, columns, domains, mojo_version=1.00)
+    p = model.params
+    info.update({
+        "blending": bool(p.blending),
+        "inflection_point": float(p.inflection_point),
+        "smoothing": float(p.smoothing),
+        "prior": [float(v) for v in np.asarray(model.prior)],
+        "keep_original": bool(getattr(p, "keep_original_categorical_columns",
+                                      True)),
+    })
+    tables = {c: {"num": np.asarray(model.encodings[c]["num"],
+                                    dtype=np.float64).tolist(),
+                  "den": np.asarray(model.encodings[c]["den"],
+                                    dtype=np.float64).tolist()}
+              for c in cols}
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_text("targetencoder/tables.json", json.dumps(tables))
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_uplift_mojo(model, path: str):
+    """Uplift DRF MOJO — `hex/genmodel/algos/upliftdrf` role: paired
+    treatment/control leaf values per tree. Trees are written as two tree
+    groups (group 0 = treatment, group 1 = control) in the standard tree
+    bytecode; the scorer averages each group and emits
+    [uplift, p_y1_ct1, p_y1_ct0]."""
+    out = model.output
+    columns = list(out.names) + [model.params.response_column]
+    domains = [out.domains.get(n) for n in out.names] + [out.response_domain]
+    feat = np.asarray(model.forest["feat"])
+    thr = np.asarray(model.forest["thr"])
+    val_t = np.asarray(model.forest["val_t"]).astype(np.float64)
+    val_c = np.asarray(model.forest["val_c"]).astype(np.float64)
+    nanL = np.zeros_like(feat, dtype=bool)           # engine sends NA right
+    T = feat.shape[0]
+    info = _common_info(model, "upliftdrf", "Uplift Distributed Random Forest",
+                        "BinomialUplift", 2, columns, domains,
+                        mojo_version=1.30)
+    info.update({"n_trees": T, "n_trees_per_class": 2,
+                 "max_depth": int(model.cfg.max_depth),
+                 "treatment_column": model.params.treatment_column})
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    for j in range(T):
+        for gi, val in ((0, val_t), (1, val_c)):
+            blob, aux = encode_tree(feat[j], thr[j], nanL[j], val[j])
+            zw.write_blob(f"trees/t{gi:02d}_{j:03d}.bin", blob)
+            zw.write_blob(f"trees/t{gi:02d}_{j:03d}_aux.bin", aux)
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_gam_mojo(model, path: str):
+    """GAM MOJO — `hex/genmodel/algos/gam/GamMojoWriter` role: the linear
+    DataInfo spec + per-gam-column spline specs (knots, degree, centering
+    means) + the coefficient vector over [linear | spline bases]."""
+    import json
+
+    out = model.output
+    category = out.model_category
+    di = model.dinfo
+    if di is not None and di.names:
+        lin_cols, lin_doms, di_info = _datainfo_spec(di)
+    else:
+        lin_cols, lin_doms, di_info = [], [], {"cats": 0, "nums": 0}
+    gam_cols = [s["column"] for s in model.gam_specs]
+    columns = lin_cols + gam_cols + [model.params.response_column]
+    domains = lin_doms + [None] * len(gam_cols) + [out.response_domain]
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
+    info = _common_info(model, "gam", "Generalized Additive Model", category,
+                        n_classes, columns, domains, mojo_version=1.00)
+    info.update(di_info)
+    info.update({
+        "beta": [float(v) for v in np.asarray(model.beta)],
+        "family": model.family.name,
+        "link": model.family.link_name,
+        "n_lin": len(lin_cols),
+    })
+    specs = [{k: (v.tolist() if isinstance(v, np.ndarray) else v)
+              for k, v in s.items()} for s in model.gam_specs]
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_text("gam/specs.json", json.dumps(specs))
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_rulefit_mojo(model, path: str):
+    """RuleFit MOJO — `hex/genmodel/algos/rulefit/RuleFitMojoWriter` role:
+    the packed rule tensors + linear-term standardization + the (raw-scale)
+    GLM coefficients over the [rules | linear] design."""
+    import json
+
+    from ..models.glm import _destandardize
+
+    out = model.output
+    category = out.model_category
+    columns = list(out.names) + [model.params.response_column]
+    domains = [out.domains.get(n) for n in out.names] + [out.response_domain]
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
+    info = _common_info(model, "rulefit", "RuleFit", category, n_classes,
+                        columns, domains, mojo_version=1.00)
+    g = model.glm_model
+    beta = _destandardize(np.asarray(g.beta, dtype=np.float64), g.dinfo)
+    info.update({
+        "beta": list(beta.ravel()),
+        "family": g.family.name,
+        "link": g.family.link_name,
+        "n_rules": 0 if model.rule_arrays is None
+        else int(np.asarray(model.rule_arrays[0]).shape[0]),
+    })
+    spec = {
+        "lin_names": list(model.lin_names),
+        "lin_means": [float(v) for v in model.lin_stats[0]] if model.lin_names else [],
+        "lin_sigmas": [float(v) for v in model.lin_stats[1]] if model.lin_names else [],
+    }
+    if model.rule_arrays is not None:
+        fidx, thr, is_gt, na_left, act = (np.asarray(a)
+                                          for a in model.rule_arrays)
+        spec.update({"fidx": fidx.astype(int).tolist(), "thr": thr.tolist(),
+                     "is_gt": is_gt.astype(int).tolist(),
+                     "na_left": na_left.astype(int).tolist(),
+                     "act": act.astype(int).tolist()})
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_text("rulefit/spec.json", json.dumps(spec))
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_psvm_mojo(model, path: str):
+    """PSVM MOJO — `hex/genmodel/algos/psvm/SvmMojoWriter` role: the
+    decision-function state (Nystrom landmarks + whitening + weights, or the
+    plain linear weights) over the DataInfo-expanded features."""
+    di = model.dinfo
+    out = model.output
+    feat_cols, feat_doms, di_info = _datainfo_spec(di)
+    columns = feat_cols + [model.params.response_column]
+    domains = feat_doms + [out.response_domain]
+    info = _common_info(model, "psvm", "PSVM", "Binomial", 2, columns,
+                        domains, mojo_version=1.00)
+    info.update(di_info)
+    info.update({"gamma": float(model.gamma), "bias": float(model.bias),
+                 "kernel": "gaussian" if model.landmarks is not None
+                 else "linear",
+                 "sv_count": int(model.sv_count)})
+    zw = MojoZipWriter()
+    _write_common(zw, info, columns, domains)
+    zw.write_blob("psvm/beta.bin",
+                  np.asarray(model.beta, dtype="<f8").tobytes())
+    if model.landmarks is not None:
+        zw.write_blob("psvm/landmarks.bin",
+                      np.asarray(model.landmarks, dtype="<f8").tobytes())
+        zw.write_blob("psvm/whiten.bin",
+                      np.asarray(model.whiten, dtype="<f8").tobytes())
+    zw.finish(path)
+
+
+# ---------------------------------------------------------------------------
+def _write_ensemble_mojo(model, path: str):
+    """Stacked Ensemble MOJO — `hex/genmodel/algos/ensemble/
+    StackedEnsembleMojoWriter` role: the base models and the metalearner as
+    nested MOJO zips, plus the level-one column mapping."""
+    import json
+    import os
+    import shutil
+    import tempfile
+
+    out = model.output
+    category = out.model_category
+    # the ensemble's own output.names is empty (it consumes base predictions);
+    # the MOJO's feature columns are the union of the base models' features
+    feats, doms = [], []
+    for bm in model.base_models:
+        for n in bm.output.names:
+            if n not in feats:
+                feats.append(n)
+                doms.append(bm.output.domains.get(n))
+    columns = feats + [model.params.response_column]
+    domains = doms + [out.response_domain]
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
+    info = _common_info(model, "stackedensemble", "Stacked Ensemble", category,
+                        n_classes, columns, domains, mojo_version=1.00)
+    info["n_base_models"] = len(model.base_models)
+    mapping = []
+    zw = MojoZipWriter()
+    tmpdir = tempfile.mkdtemp()
+    try:
+        for i, bm in enumerate(model.base_models):
+            sub = os.path.join(tmpdir, f"base_{i}.zip")
+            export_mojo(bm, sub)
+            with open(sub, "rb") as fh:
+                zw.write_blob(f"models/base_{i}.zip", fh.read())
+            mapping.append({"key": str(bm.key),
+                            "category": bm.output.model_category,
+                            "response_domain": bm.output.response_domain})
+        sub = os.path.join(tmpdir, "meta.zip")
+        export_mojo(model.metalearner, sub)
+        with open(sub, "rb") as fh:
+            zw.write_blob("models/metalearner.zip", fh.read())
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    zw.write_text("ensemble/mapping.json", json.dumps(
+        {"bases": mapping,
+         "metalearner_features": list(model.metalearner.output.names)}))
     _write_common(zw, info, columns, domains)
     zw.finish(path)

@@ -1,0 +1,206 @@
+"""MOJO roundtrips for the second wave of algos — isotonic, word2vec, GLRM,
+TargetEncoder, UpliftDRF, GAM, RuleFit, PSVM, StackedEnsemble
+(reference readers under `hex/genmodel/algos/**`)."""
+
+import numpy as np
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, T_STR, Vec
+from h2o_tpu.mojo import MojoModel
+
+
+def _save_load(model, tmp_path):
+    path = str(tmp_path / f"{model.algo_name}.zip")
+    model.save_mojo(path)
+    return MojoModel.load(path)
+
+
+def test_isotonic_mojo(tmp_path):
+    from h2o_tpu.models.isotonic import IsotonicParameters, IsotonicRegression
+
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 10, 400).astype(np.float32)
+    y = (np.sqrt(x) + 0.1 * rng.normal(size=400)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = IsotonicRegression(IsotonicParameters(
+        training_frame=fr, response_column="y")).train_model()
+    scorer = _save_load(m, tmp_path)
+    np.testing.assert_allclose(scorer.predict(fr),
+                               m.predict(fr).vec("predict").to_numpy(),
+                               atol=1e-5)
+
+
+def test_word2vec_mojo(tmp_path):
+    from h2o_tpu.models.word2vec import Word2Vec, Word2VecParameters
+
+    rng = np.random.default_rng(6)
+    topics = {"fruit": ["apple", "pear", "plum", "grape"],
+              "tech": ["cpu", "gpu", "ram", "disk"]}
+    words = []
+    for _ in range(400):
+        t = "fruit" if rng.random() < 0.5 else "tech"
+        words.extend(rng.choice(topics[t], size=5).tolist())
+        words.append(None)
+    v = Vec(None, len(words), type=T_STR,
+            host_data=np.array(words, dtype=object))
+    fr = Frame(["words"], [v])
+    m = Word2Vec(Word2VecParameters(training_frame=fr, vec_size=8, epochs=5,
+                                    min_word_freq=5, window_size=3,
+                                    seed=6)).train_model()
+    scorer = _save_load(m, tmp_path)
+    got = scorer.transform(["apple", "zzz"])
+    np.testing.assert_allclose(got[0], np.asarray(m.vectors)[m.vocab["apple"]],
+                               atol=1e-6)
+    assert np.isnan(got[1]).all()
+    syn = scorer.find_synonyms("apple", 3)
+    assert len(syn) == 3
+
+
+def test_glrm_mojo(tmp_path):
+    from h2o_tpu.models.glrm import GLRM, GLRMParameters
+
+    rng = np.random.default_rng(0)
+    A = (rng.normal(size=(150, 3)) @ rng.normal(size=(3, 6))).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(6)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=3, max_iterations=150,
+                            init="SVD", seed=1)).train_model()
+    scorer = _save_load(m, tmp_path)
+    rec_engine = np.stack([m.predict(fr).vec(i).to_numpy()
+                           for i in range(6)], axis=1)
+    rec_mojo = scorer.predict(fr)
+    np.testing.assert_allclose(rec_mojo, rec_engine, atol=1e-3, rtol=1e-3)
+
+
+def test_targetencoder_mojo(tmp_path):
+    from h2o_tpu.models.target_encoder import (TargetEncoder,
+                                               TargetEncoderParameters)
+
+    rng = np.random.default_rng(4)
+    n = 500
+    cat = rng.integers(0, 4, n)
+    y = ((cat == 2) | (rng.random(n) < 0.3)).astype(np.float32)
+    fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32)})
+    fr.add("c", Vec.from_numpy(cat.astype(np.float32), type=T_CAT,
+                               domain=["a", "b", "c", "d"]))
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["no", "yes"]))
+    m = TargetEncoder(TargetEncoderParameters(
+        training_frame=fr, response_column="y", columns_to_encode=["c"],
+        noise=0.0, blending=True)).train_model()
+    scorer = _save_load(m, tmp_path)
+    te_engine = m.transform(fr).vec("c_te").to_numpy()
+    te_mojo = scorer.predict(fr)[:, 0]
+    np.testing.assert_allclose(te_mojo, te_engine, atol=1e-6)
+    # unseen/NA category falls back to the prior, matching the engine
+    na = scorer.score(np.array([[np.nan]]))
+    np.testing.assert_allclose(na[0, 0], np.asarray(m.prior)[0], atol=1e-9)
+
+
+def test_uplift_mojo(tmp_path):
+    from h2o_tpu.models.uplift import UpliftDRF, UpliftDRFParameters
+
+    rng = np.random.default_rng(42)
+    n = 800
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    treat = rng.integers(0, 2, n).astype(np.float32)
+    p = 0.3 + 0.3 * (x1 > 0) * treat
+    y = (rng.random(n) < p).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("treatment", Vec.from_numpy(treat, type=T_CAT, domain=["0", "1"]))
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["0", "1"]))
+    m = UpliftDRF(UpliftDRFParameters(
+        training_frame=fr, response_column="y", treatment_column="treatment",
+        ntrees=10, max_depth=3, seed=1, uplift_metric="KL")).train_model()
+    scorer = _save_load(m, tmp_path)
+    eng = m.predict(fr)
+    got = scorer.predict(fr)
+    for j, nm in enumerate(["uplift_predict", "p_y1_ct1", "p_y1_ct0"]):
+        np.testing.assert_allclose(got[:, j], eng.vec(nm).to_numpy(),
+                                   atol=1e-5)
+
+
+def test_gam_mojo(tmp_path):
+    from h2o_tpu.models.gam import GAM, GAMParameters
+
+    rng = np.random.default_rng(0)
+    n = 1500
+    x = rng.uniform(-3, 3, n).astype(np.float32)
+    z = rng.normal(size=n).astype(np.float32)
+    y = (np.sin(x) * 2 + 0.5 * z + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "z": z, "y": y})
+    m = GAM(GAMParameters(training_frame=fr, response_column="y",
+                          gam_columns=["x"], num_knots=8, scale=0.1,
+                          family="gaussian", lambda_=0.0,
+                          alpha=0.0)).train_model()
+    scorer = _save_load(m, tmp_path)
+    np.testing.assert_allclose(scorer.predict(fr),
+                               m.predict(fr).vec("predict").to_numpy(),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_rulefit_mojo(tmp_path):
+    from h2o_tpu.models.rulefit import RuleFit, RuleFitParameters
+
+    rng = np.random.default_rng(5)
+    n = 800
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    y = ((a > 0.5) & (b < 0.0)).astype(np.float32)
+    fr = Frame.from_dict({"a": a, "b": b, "y": y})
+    fr.replace("y", fr.vec("y").astype_cat(["0", "1"]))
+    m = RuleFit(RuleFitParameters(
+        training_frame=fr, response_column="y", min_rule_length=2,
+        max_rule_length=3, rule_generation_ntrees=10, seed=5,
+        family="binomial", model_type="rules_and_linear")).train_model()
+    scorer = _save_load(m, tmp_path)
+    eng_p1 = m.predict(fr).vec(2).to_numpy()
+    got = scorer.predict(fr)
+    np.testing.assert_allclose(got[:, 2], eng_p1, atol=1e-4, rtol=1e-3)
+
+
+def test_psvm_mojo(tmp_path):
+    from h2o_tpu.models.psvm import PSVM, SVMParameters
+
+    rng = np.random.default_rng(3)
+    n = 600
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (np.sqrt((x ** 2).sum(1)) < 1.1).astype(np.float32)
+    fr = Frame.from_dict({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    fr.replace("y", fr.vec("y").astype_cat(["0", "1"]))
+    m = PSVM(SVMParameters(training_frame=fr, response_column="y",
+                           kernel_type="gaussian", hyper_param=1.0,
+                           seed=4)).train_model()
+    scorer = _save_load(m, tmp_path)
+    eng = m.predict(fr)
+    got = scorer.predict(fr)
+    np.testing.assert_allclose(got[:, 2], eng.vec(2).to_numpy(), atol=1e-4,
+                               rtol=1e-3)
+    assert (got[:, 0] == eng.vec(0).to_numpy()).mean() > 0.99
+
+
+def test_stackedensemble_mojo(tmp_path):
+    from h2o_tpu.models.drf import DRF, DRFParameters
+    from h2o_tpu.models.ensemble import (StackedEnsemble,
+                                         StackedEnsembleParameters)
+    from h2o_tpu.models.gbm import GBM, GBMParameters
+    from h2o_tpu.models.glm import GLM, GLMParameters
+
+    rng = np.random.default_rng(11)
+    n = 500
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = ((x1 + 0.5 * x2 + 0.3 * rng.normal(size=n)) > 0).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    common = dict(training_frame=fr, response_column="y", nfolds=3, seed=11,
+                  keep_cross_validation_predictions=True)
+    gbm = GBM(GBMParameters(ntrees=5, max_depth=3, **common)).train_model()
+    drf = DRF(DRFParameters(ntrees=5, max_depth=3, **common)).train_model()
+    glm = GLM(GLMParameters(family="binomial", **common)).train_model()
+    se = StackedEnsemble(StackedEnsembleParameters(
+        training_frame=fr, response_column="y",
+        base_models=[gbm, drf, glm], seed=11)).train_model()
+    scorer = _save_load(se, tmp_path)
+    eng_p1 = se.predict(fr).vec(2).to_numpy()
+    got = scorer.predict(fr)
+    np.testing.assert_allclose(got[:, 2], eng_p1, atol=1e-4, rtol=1e-3)
