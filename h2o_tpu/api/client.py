@@ -345,6 +345,112 @@ class H2OFrame:
     def rbind(self, other: "H2OFrame") -> "H2OFrame":
         return self._exec(f"(rbind {self.frame_id} {other.frame_id})")
 
+    def skewness(self, na_rm=True):
+        return self._exec(f"(skewness {self.frame_id} true)")
+
+    def kurtosis(self, na_rm=True):
+        return self._exec(f"(kurtosis {self.frame_id} true)")
+
+    def cor(self, other: "H2OFrame" = None):
+        o = other.frame_id if other is not None else self.frame_id
+        return self._exec(f"(cor {self.frame_id} {o} 'everything' 'Pearson')")
+
+    def quantile(self, prob=(0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9,
+                             0.99)) -> "H2OFrame":
+        ps = " ".join(str(p) for p in prob)
+        return self._exec(f"(quantile {self.frame_id} [{ps}] 'interpolate' _)")
+
+    def impute(self, column=-1, method="mean"):
+        return self._exec(f"(h2o.impute {self.frame_id} {column} '{method}' "
+                          f"'interpolate' [] _ _)")
+
+    def scale(self, center=True, scale=True) -> "H2OFrame":
+        c = "true" if center else "false"
+        s = "true" if scale else "false"
+        return self._exec(f"(scale {self.frame_id} {c} {s})")
+
+    def na_omit(self) -> "H2OFrame":
+        return self._exec(f"(na.omit {self.frame_id})")
+
+    def fillna(self, method="forward", axis=0, maxlen=1) -> "H2OFrame":
+        return self._exec(f"(h2o.fillna {self.frame_id} '{method}' {axis} "
+                          f"{maxlen})")
+
+    def match(self, table, nomatch=None) -> "H2OFrame":
+        items = " ".join(f"'{t}'" if isinstance(t, str) else str(t)
+                         for t in table)
+        nm = "_" if nomatch is None else str(nomatch)
+        return self._exec(f"(match {self.frame_id} [{items}] {nm} 1)")
+
+    def cut(self, breaks, labels=None, include_lowest=False,
+            right=True) -> "H2OFrame":
+        bs = " ".join(str(b) for b in breaks)
+        lb = "_" if not labels else \
+            "[" + " ".join(f"'{l}'" for l in labels) + "]"
+        il = "true" if include_lowest else "false"
+        r = "true" if right else "false"
+        return self._exec(f"(cut {self.frame_id} [{bs}] {lb} {il} {r} 3)")
+
+    def difflag1(self) -> "H2OFrame":
+        return self._exec(f"(difflag1 {self.frame_id})")
+
+    def kfold_column(self, n_folds=3, seed=-1) -> "H2OFrame":
+        return self._exec(f"(kfold_column {self.frame_id} {n_folds} {seed})")
+
+    def stratified_kfold_column(self, n_folds=3, seed=-1) -> "H2OFrame":
+        return self._exec(
+            f"(stratified_kfold_column {self.frame_id} {n_folds} {seed})")
+
+    def stratified_split(self, test_frac=0.2, seed=-1) -> "H2OFrame":
+        return self._exec(f"(h2o.random_stratified_split {self.frame_id} "
+                          f"{test_frac} {seed})")
+
+    def levels(self):
+        return self._exec(f"(levels {self.frame_id})")
+
+    def relevel(self, y: str) -> "H2OFrame":
+        return self._exec(f"(relevel {self.frame_id} '{y}')")
+
+    def pivot(self, index: str, column: str, value: str) -> "H2OFrame":
+        return self._exec(f"(pivot {self.frame_id} '{index}' '{column}' "
+                          f"'{value}')")
+
+    def melt(self, id_vars, value_vars=None, var_name="variable",
+             value_name="value", skipna=False) -> "H2OFrame":
+        ids = " ".join(f"'{c}'" for c in id_vars)
+        vv = "_" if not value_vars else \
+            "[" + " ".join(f"'{c}'" for c in value_vars) + "]"
+        sk = "true" if skipna else "false"
+        return self._exec(f"(melt {self.frame_id} [{ids}] {vv} '{var_name}' "
+                          f"'{value_name}' {sk})")
+
+    def transpose(self) -> "H2OFrame":
+        return self._exec(f"(t {self.frame_id})")
+
+    def mult(self, other: "H2OFrame") -> "H2OFrame":
+        return self._exec(f"(x*y {self.frame_id} {other.frame_id})")
+
+    def topn(self, column=0, nPercent=10, grabTopN=-1) -> "H2OFrame":
+        """grabTopN=-1 → top values; any other value → bottom (h2o-py
+        `topNBottomN` convention routes both through this prim)."""
+        bottom = "0" if grabTopN == -1 else "1"
+        return self._exec(f"(topn {self.frame_id} {column} {nPercent} "
+                          f"{bottom})")
+
+    def entropy(self) -> "H2OFrame":
+        return self._exec(f"(entropy {self.frame_id})")
+
+    def strsplit(self, pattern: str) -> "H2OFrame":
+        return self._exec(f"(strsplit {self.frame_id} '{pattern}')")
+
+    def countmatches(self, pattern) -> "H2OFrame":
+        pats = pattern if isinstance(pattern, list) else [pattern]
+        items = " ".join(f"'{p}'" for p in pats)
+        return self._exec(f"(countmatches {self.frame_id} [{items}])")
+
+    def tokenize(self, split=" ") -> "H2OFrame":
+        return self._exec(f"(tokenize {self.frame_id} '{split}')")
+
     def set_names(self, names: list[str]) -> "H2OFrame":
         """Rename columns in place (h2o-py semantics: the handle keeps
         pointing at the renamed frame)."""

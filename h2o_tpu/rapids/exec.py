@@ -25,6 +25,7 @@ import numpy as np
 from ..backend.kvstore import STORE
 from ..frame.frame import Frame
 from ..frame.vec import T_CAT, Vec
+from . import advmath
 from . import strings as strmod
 from .groupby import group_by
 from .merge import merge as merge_fn, sort as sort_fn
@@ -213,7 +214,8 @@ class Rapids:
             lit = {"true": 1.0, "TRUE": 1.0, "True": 1.0,
                    "false": 0.0, "FALSE": 0.0, "False": 0.0,
                    "NA": float("nan"), "NaN": float("nan"),
-                   "null": None, "None": None}
+                   "null": None, "None": None,
+                   "_": None}  # h2o-py placeholder for defaulted args
             if val in lit:
                 return lit[val]
             obj = self.session.lookup(val)
@@ -369,11 +371,85 @@ _PRIMS = {
     "grep": lambda R, v, pat, ic=False, inv=False, ol=True: strmod.grep(
         _as_vec(v), pat, ignore_case=bool(ic), invert=bool(inv),
         output_logical=bool(ol)),
+    "lstrip": lambda R, v, set=None: strmod.lstrip(_as_vec(v), set),
+    "rstrip": lambda R, v, set=None: strmod.rstrip(_as_vec(v), set),
+    "substring": lambda R, v, s, e=None: strmod.substring(
+        _as_vec(v), int(s), None if e is None else int(e)),
+    "replacefirst": lambda R, v, pat, rep, ic=False: strmod.sub(
+        _as_vec(v), pat, rep, ignore_case=bool(ic)),
+    "replaceall": lambda R, v, pat, rep, ic=False: strmod.gsub(
+        _as_vec(v), pat, rep, ignore_case=bool(ic)),
+    "countmatches": lambda R, v, pats: strmod.countmatches(_as_vec(v), pats),
+    "strsplit": lambda R, v, pat: (lambda vs: Frame(
+        [f"C{i + 1}" for i in range(len(vs))], vs))(
+            strmod.strsplit(_as_vec(v), pat)),
+    "entropy": lambda R, v: strmod.entropy(_as_vec(v)),
+    "strDistance": lambda R, a, b, measure="lv", ce=True: strmod.strdistance(
+        _as_vec(a), _as_vec(b), measure, bool(ce)),
+    "tokenize": lambda R, v, split=" ": strmod.tokenize(_as_vec(v), split),
+    "ascharacter": lambda R, v: strmod.ascharacter(_as_vec(v)),
     # time
     **{part: (lambda p: (lambda R, v: time_part(_as_vec(v), p)))(part)
        for part in ("year", "month", "day", "dayOfWeek", "hour", "minute",
                     "second", "millis")},
+    "moment": lambda R, *a: advmath.moment(*a),
+    "mktime": lambda R, *a: advmath.moment(*a),
+    # advmath / munging (second wave, `prims/{advmath,mungers,matrix}`)
+    "skewness": lambda R, v, na_rm=True: advmath.skewness(_as_vec(v)),
+    "kurtosis": lambda R, v, na_rm=True: advmath.kurtosis(_as_vec(v)),
+    "cor": lambda R, x, y, use="everything", method="Pearson":
+        advmath.cor(_as_frame(x), _as_frame(y), use, method),
+    "quantile": lambda R, fr, probs, interp="interpolate", w="_":
+        advmath.quantile_frame(_as_frame(fr), probs, interp),
+    "h2o.impute": lambda R, fr, col=-1, method="mean", combine="interpolate",
+        by=None, gbframe=None, values=None:
+        advmath.impute(_as_frame(fr), None if col is None else int(col),
+                       method, combine, by, values),
+    "scale": lambda R, fr, center=True, scale=True:
+        advmath.scale_frame(_as_frame(fr), _maybe_list(center),
+                            _maybe_list(scale)),
+    "na.omit": lambda R, fr: advmath.na_omit(_as_frame(fr)),
+    "h2o.fillna": lambda R, fr, method="forward", axis=0, maxlen=1:
+        advmath.fillna(_as_frame(fr), method, int(axis), int(maxlen)),
+    "which": lambda R, v: advmath.which(_as_vec(v)),
+    "which.max": lambda R, fr, na_rm=True, axis=0:
+        advmath.which_extreme(_as_frame(fr), bool(na_rm), int(axis), mx=True),
+    "which.min": lambda R, fr, na_rm=True, axis=0:
+        advmath.which_extreme(_as_frame(fr), bool(na_rm), int(axis), mx=False),
+    "match": lambda R, v, table, nomatch=None, start=1.0:
+        advmath.match(_as_vec(v), table, nomatch, float(start)),
+    "cut": lambda R, v, breaks, labels=None, il=False, right=True, dig=3:
+        advmath.cut(_as_vec(v), breaks, labels, bool(il), bool(right),
+                    int(dig)),
+    "difflag1": lambda R, v: advmath.difflag1(_as_vec(v)),
+    "kfold_column": lambda R, v, n, seed=-1:
+        advmath.kfold_column(_as_vec(v), int(n), seed),
+    "stratified_kfold_column": lambda R, v, n, seed=-1:
+        advmath.stratified_kfold_column(_as_vec(v), int(n), seed),
+    "h2o.random_stratified_split": lambda R, v, frac=0.2, seed=-1:
+        advmath.stratified_split(_as_vec(v), float(frac), seed),
+    "levels": lambda R, fr: advmath.levels(_as_frame(fr)),
+    "relevel": lambda R, v, lvl: advmath.relevel(_as_vec(v), str(lvl)),
+    "setDomain": lambda R, v, *a: advmath.set_domain(_as_vec(v), a[-1]),
+    "pivot": lambda R, fr, index, column, value:
+        advmath.pivot(_as_frame(fr), index, column, value),
+    "melt": lambda R, fr, ids, vals=None, var="variable", val="value",
+        skipna=False: advmath.melt(_as_frame(fr), ids, vals, var, val,
+                                   bool(skipna)),
+    "t": lambda R, fr: advmath.transpose(_as_frame(fr)),
+    "x*y": lambda R, x, y: advmath.mmult(_as_frame(x), _as_frame(y)),
+    "rank_within_groupby": lambda R, fr, g, s, asc=None,
+        name="New_Rank_column", *rest: advmath.rank_within_group_by(
+            _as_frame(fr), g, s, asc, str(name)),
+    "topn": lambda R, fr, col, pct, bottom=0.0:
+        advmath.topn(_as_frame(fr), int(col), float(pct), bool(bottom)),
 }
+
+
+def _maybe_list(x):
+    if isinstance(x, list):
+        return [float(v) for v in x]
+    return bool(x)
 
 
 def _asfactor(v: Vec) -> Vec:

@@ -17,6 +17,16 @@ import numpy as np
 from ..frame.vec import T_CAT, T_INT, T_STR, Vec
 
 
+def _host_strings(v: Vec) -> list:
+    """Row-wise python strings (None = NA) from a string or categorical Vec."""
+    if v.is_string():
+        return list(v.host_data)
+    if v.is_categorical():
+        x = v.to_numpy()
+        return [None if np.isnan(c) else v.domain[int(c)] for c in x]
+    raise TypeError(f"string op on {v.type} Vec")
+
+
 def _apply(v: Vec, fn) -> Vec:
     if v.is_categorical():
         return Vec(v.data, v.nrow, type=T_CAT,
@@ -154,3 +164,70 @@ def asfactor(v: Vec) -> Vec:
     codes = np.full(host.shape, np.nan, dtype=np.float32)
     codes[ok] = [lookup[int(x)] for x in host[ok]]
     return Vec.from_numpy(codes, type=T_CAT, domain=[str(x) for x in lv])
+
+
+def entropy(v: Vec) -> Vec:
+    """Per-string Shannon character entropy (`AstEntropy`)."""
+    import math
+
+    def ent(s):
+        if not s:
+            return 0.0
+        counts = {}
+        for ch in s:
+            counts[ch] = counts.get(ch, 0) + 1
+        n = len(s)
+        return -sum(c / n * math.log2(c / n) for c in counts.values())
+
+    host = _host_strings(v)
+    out = np.array([np.nan if s is None else ent(s) for s in host],
+                   dtype=np.float32)
+    return Vec.from_numpy(out)
+
+
+def strdistance(v1: Vec, v2: Vec, measure: str = "lv",
+                compare_empty: bool = True) -> Vec:
+    """Pairwise string distance (`AstStrDistance`); Levenshtein ('lv') and
+    Jaccard ('jaccard') measures."""
+
+    def lev(a, b):
+        if a == b:
+            return 0
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[-1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    def jac(a, b):
+        sa, sb = set(a), set(b)
+        return 1.0 - len(sa & sb) / max(len(sa | sb), 1)
+
+    fn = jac if measure == "jaccard" else lev
+    h1, h2 = _host_strings(v1), _host_strings(v2)
+    out = np.full(len(h1), np.nan, dtype=np.float32)
+    for i, (a, b) in enumerate(zip(h1, h2)):
+        if a is None or b is None:
+            continue
+        if (a == "" or b == "") and not compare_empty:
+            continue
+        out[i] = fn(a, b)
+    return Vec.from_numpy(out)
+
+
+def tokenize(v: Vec, split: str = " ") -> Vec:
+    """Flatten each string into one token per output row, NA row between
+    originals (`AstTokenize` — the word2vec ingest shape)."""
+    import re as _re
+
+    host = _host_strings(v)
+    out = []
+    for s in host:
+        if s is not None:
+            out.extend(t for t in _re.split(split, s) if t)
+        out.append(None)
+    return Vec(None, len(out), type=T_STR,
+               host_data=np.array(out, dtype=object))

@@ -1,0 +1,208 @@
+"""Second-wave rapids prims — advmath/mungers/matrix/string ops
+(`water/rapids/ast/prims/**`), driven through the Lisp evaluator."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.backend.kvstore import STORE
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, T_STR, Vec
+from h2o_tpu.rapids.exec import Rapids, Session
+
+
+@pytest.fixture
+def rap():
+    r = Rapids(Session())
+    yield r
+    r.session.end()
+
+
+def _put(name, fr):
+    fr.key = name
+    STORE.put(name, fr)
+    return fr
+
+
+def test_skewness_kurtosis_cor(rap):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=2000).astype(np.float32)
+    y = (2 * x + 0.1 * rng.normal(size=2000)).astype(np.float32)
+    _put("fx", Frame.from_dict({"x": x}))
+    _put("fy", Frame.from_dict({"y": y}))
+    assert abs(rap.exec("(skewness fx true)")) < 0.2
+    assert abs(rap.exec("(kurtosis fx true)") - 3.0) < 0.4
+    c = rap.exec("(cor fx fy 'everything' 'Pearson')")
+    assert c > 0.99
+
+
+def test_quantile_and_impute(rap):
+    x = np.arange(101, dtype=np.float32)
+    _put("q", Frame.from_dict({"x": x}))
+    out = rap.exec("(quantile q [0.1 0.5 0.9] 'interpolate' _)")
+    got = out.vec("xQuantiles").to_numpy()
+    np.testing.assert_allclose(got, [10, 50, 90], atol=1e-4)
+    xx = x.copy()
+    xx[::10] = np.nan
+    _put("imp", Frame.from_dict({"x": xx}))
+    fills = rap.exec("(h2o.impute imp 0 'mean' 'interpolate' [] _ _)")
+    v = STORE.get("imp").vec("x").to_numpy()
+    assert not np.isnan(v).any()
+    assert abs(fills[0] - np.nanmean(xx)) < 1e-3
+
+
+def test_scale_naomit_fillna(rap):
+    x = np.array([1.0, 2, np.nan, 4, 5], np.float32)
+    _put("s", Frame.from_dict({"x": x}))
+    sc = rap.exec("(scale s true true)")
+    got = sc.vec("x").to_numpy()
+    assert abs(np.nanmean(got)) < 1e-6
+    om = rap.exec("(na.omit s)")
+    assert om.nrow == 4
+    fl = rap.exec("(h2o.fillna s 'forward' 0 1)")
+    assert fl.vec("x").to_numpy()[2] == 2.0
+
+
+def test_which_match_cut_diff(rap):
+    x = np.array([0.0, 1, 0, 1, 1], np.float32)
+    _put("w", Frame.from_dict({"x": x}))
+    idx = rap.exec("(which w)").to_numpy()
+    np.testing.assert_array_equal(idx, [1, 3, 4])
+    cat = Vec.from_numpy(np.array([0, 1, 2, 1], np.float32), type=T_CAT,
+                         domain=["a", "b", "c"])
+    _put("m", Frame(["c"], [cat]))
+    got = rap.exec("(match m ['b' 'c'] _ 1)").to_numpy()
+    np.testing.assert_allclose(got, [np.nan, 1, 2, 1], equal_nan=True)
+    _put("cu", Frame.from_dict({"x": np.array([0.5, 1.5, 2.5], np.float32)}))
+    cv = rap.exec("(cut cu [0 1 2 3] _ false true 3)")
+    assert cv.is_categorical() and len(cv.domain) == 3
+    np.testing.assert_allclose(cv.to_numpy(), [0, 1, 2])
+    dv = rap.exec("(difflag1 cu)").to_numpy()
+    assert np.isnan(dv[0]) and dv[1] == 1.0
+
+
+def test_fold_and_split_columns(rap):
+    y = Vec.from_numpy((np.arange(100) % 2).astype(np.float32), type=T_CAT,
+                       domain=["a", "b"])
+    _put("y", Frame(["y"], [y]))
+    f = rap.exec("(kfold_column y 5 42)").to_numpy()
+    assert set(np.unique(f)) == {0, 1, 2, 3, 4}
+    sf = rap.exec("(stratified_kfold_column y 5 42)").to_numpy()
+    for lvl in (0, 1):
+        counts = np.bincount(sf[np.arange(100) % 2 == lvl].astype(int))
+        assert counts.max() - counts.min() <= 1
+    sp = rap.exec("(h2o.random_stratified_split y 0.3 42)")
+    assert sp.domain == ["train", "test"]
+    assert abs((sp.to_numpy() == 1).mean() - 0.3) < 0.05
+
+
+def test_levels_relevel_setdomain(rap):
+    cat = Vec.from_numpy(np.array([0, 1, 2], np.float32), type=T_CAT,
+                         domain=["a", "b", "c"])
+    _put("lv", Frame(["c"], [cat]))
+    assert rap.exec("(levels lv)") == [["a", "b", "c"]]
+    rl = rap.exec("(relevel lv 'c')")
+    assert rl.domain == ["c", "a", "b"]
+    np.testing.assert_allclose(rl.to_numpy(), [1, 2, 0])
+    sd = rap.exec("(setDomain lv ['x' 'y' 'z'])")
+    assert sd.domain == ["x", "y", "z"]
+
+
+def test_pivot_melt_transpose_mmult(rap):
+    fr = _put("pv", Frame.from_dict({
+        "id": np.array([1, 1, 2, 2], np.float32),
+        "val": np.array([10, 20, 30, 40], np.float32)}))
+    fr.add("kind", Vec.from_numpy(np.array([0, 1, 0, 1], np.float32),
+                                  type=T_CAT, domain=["u", "v"]))
+    wide = rap.exec("(pivot pv 'id' 'kind' 'val')")
+    assert wide.names == ["id", "u", "v"] and wide.nrow == 2
+    np.testing.assert_allclose(wide.vec("v").to_numpy(), [20, 40])
+    _put("wd", wide)
+    long = rap.exec("(melt wd ['id'] ['u' 'v'] 'variable' 'value' false)")
+    assert long.nrow == 4
+    _put("mt", Frame.from_dict({"a": np.array([1, 2], np.float32),
+                                "b": np.array([3, 4], np.float32)}))
+    tr = rap.exec("(t mt)")
+    assert tr.nrow == 2 and tr.ncol == 2
+    np.testing.assert_allclose(tr.vec(0).to_numpy(), [1, 3])
+    mm = rap.exec("(x*y mt (t mt))")
+    # [[1,3],[2,4]] @ [[1,2],[3,4]] = [[10,14],[14,20]]
+    np.testing.assert_allclose(mm.vec(0).to_numpy(), [10, 14])
+
+
+def test_rank_topn(rap):
+    fr = _put("rk", Frame.from_dict({
+        "g": np.array([0, 0, 1, 1, 1], np.float32),
+        "v": np.array([5.0, 3, 9, 1, 4], np.float32)}))
+    out = rap.exec("(rank_within_groupby rk ['g'] ['v'] [1] 'rank' false)")
+    np.testing.assert_allclose(out.vec("rank").to_numpy(), [2, 1, 3, 1, 2])
+    top = rap.exec("(topn rk 1 40 0)")
+    assert top.nrow == 2
+    np.testing.assert_allclose(np.sort(top.vec(1).to_numpy()), [5, 9])
+
+
+def test_string_second_wave(rap):
+    s = Vec(None, 4, type=T_STR,
+            host_data=np.array(["ab-cd", "x-y", None, "zz"], dtype=object))
+    _put("st", Frame(["s"], [s]))
+    sp = rap.exec("(strsplit st '-')")
+    assert sp.ncol == 2
+    ent = rap.exec("(entropy st)").to_numpy()
+    assert ent[3] == 0.0 and ent[0] > 1.0
+    sub = rap.exec("(substring st 0 2)")
+    assert sub.host_data[0] == "ab"
+    cm = rap.exec("(countmatches st ['-'])").to_numpy()
+    assert cm[0] == 1 and cm[3] == 0
+    tk = rap.exec("(tokenize st '-')")
+    toks = [t for t in tk.host_data if t is not None]
+    assert toks == ["ab", "cd", "x", "y", "zz"]
+    s2 = Vec(None, 4, type=T_STR,
+             host_data=np.array(["ab-cd", "x-z", "q", "zz"], dtype=object))
+    _put("st2", Frame(["s"], [s2]))
+    d = rap.exec("(strDistance st st2 'lv' true)").to_numpy()
+    assert d[0] == 0 and d[1] == 1 and np.isnan(d[2])
+
+
+def test_impute_by_group(rap):
+    fr = _put("gimp", Frame.from_dict({
+        "g": np.array([0, 0, 1, 1], np.float32),
+        "x": np.array([1.0, np.nan, 10.0, np.nan], np.float32)}))
+    rap.exec("(h2o.impute gimp 1 'mean' 'interpolate' [0] _ _)")
+    got = STORE.get("gimp").vec("x").to_numpy()
+    np.testing.assert_allclose(got, [1, 1, 10, 10])
+
+
+def test_fillna_axis1_and_whichmax_axis1(rap):
+    fr = _put("ax", Frame.from_dict({
+        "a": np.array([1.0, np.nan], np.float32),
+        "b": np.array([np.nan, 5.0], np.float32),
+        "c": np.array([np.nan, 2.0], np.float32)}))
+    fl = rap.exec("(h2o.fillna ax 'forward' 1 1)")
+    np.testing.assert_allclose(fl.vec("b").to_numpy(), [1.0, 5.0])
+    assert np.isnan(fl.vec("c").to_numpy()[0])  # maxlen=1: too far from 'a'
+    wm = rap.exec("(which.max ax true 1)")
+    np.testing.assert_allclose(wm.vec(0).to_numpy(), [0, 1])
+
+
+def test_topn_exact_big_ints(rap):
+    big = np.array([2 ** 33 + 1, 2 ** 33 + 9, 2 ** 33 + 5], dtype=np.int64)
+    _put("big", Frame.from_dict({"x": big}))
+    top = rap.exec("(topn big 0 100 0)")
+    vals = np.sort(top.vec(1).to_numpy().astype(np.int64))
+    np.testing.assert_array_equal(vals, np.sort(big))
+
+
+def test_cut_labels_and_match_nomatch(rap):
+    _put("cl", Frame.from_dict({"x": np.array([0.5, 1.5], np.float32)}))
+    cv = rap.exec("(cut cl [0 1 2] ['lo' 'hi'] false true 3)")
+    assert cv.domain == ["lo", "hi"]
+    cat = Vec.from_numpy(np.array([0, 1], np.float32), type=T_CAT,
+                         domain=["a", "b"])
+    _put("mn", Frame(["c"], [cat]))
+    got = rap.exec("(match mn ['b'] 0 1)").to_numpy()
+    np.testing.assert_allclose(got, [0, 1])
+
+
+def test_moment(rap):
+    v = rap.exec("(moment 2020 1 2 0 0 0 0)")
+    ms = v.to_numpy()[0]
+    assert ms == np.datetime64("2020-01-02T00:00:00", "ms").astype("int64")

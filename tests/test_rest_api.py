@@ -89,6 +89,22 @@ class TestRestApi:
         thr = m.find_threshold_by_max_metric("f1")
         assert 0 <= thr <= 1
 
+    def test_advmath_prims_via_client(self, csv_frame):
+        fr, df = csv_frame
+        x = fr["x1"]
+        assert abs(x.skewness() - df.x1.skew()) < 0.1
+        q = x.quantile([0.5]).as_data_frame()
+        assert abs(q.iloc[0, 1] - df.x1.median()) < 0.05
+        assert abs(x.cor(fr["x2"]) - df.x1.corr(df.x2)) < 0.05
+        folds = x.kfold_column(n_folds=4, seed=1).as_data_frame()
+        assert set(folds.iloc[:, 0].unique()) == {0, 1, 2, 3}
+        assert fr["y"].levels() == [["no", "yes"]]
+        cut = x.cut([-10, 0, 10]).as_data_frame()
+        assert cut.iloc[:, 0].nunique() == 2
+        sc = x.scale().as_data_frame()
+        assert abs(sc.iloc[:, 0].mean()) < 1e-5
+        assert fr.na_omit().nrow == fr.nrow  # no NAs in fixture
+
     def test_train_with_x_subset(self, csv_frame):
         fr, _ = csv_frame
         m = h2o.H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
