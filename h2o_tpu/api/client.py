@@ -574,6 +574,24 @@ class H2OModelClient:
             return pd.DataFrame(vi)
         return vi
 
+    def partial_plot(self, frame: H2OFrame, cols=None, nbins: int = 20,
+                     plot: bool = False):
+        """Partial dependence tables (h2o-py `partial_plot` data surface)."""
+        params = {"model_id": self.model_id, "frame_id": frame.frame_id,
+                  "nbins": nbins}
+        if cols:
+            params["cols"] = ",".join(cols)
+        j = connection().request("POST", "/3/PartialDependence", params=params)
+        return j["partial_dependence_data"]
+
+    def permutation_importance(self, frame: H2OFrame, metric: str = "AUTO",
+                               n_repeats: int = 1, seed: int = -1):
+        j = connection().request(
+            "POST", "/3/PermutationVarImp",
+            params={"model_id": self.model_id, "frame_id": frame.frame_id,
+                    "metric": metric, "n_repeats": n_repeats, "seed": seed})
+        return j["permutation_varimp"]
+
     def download_mojo(self, path: str = ".") -> str:
         j = connection().request(
             "GET", f"/3/Models/{urllib.parse.quote(self.model_id)}/mojo",

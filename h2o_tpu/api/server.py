@@ -382,6 +382,35 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, {"predictions_frame": schemas.key_schema(dest),
                      "model_metrics": [{}]}
 
+    if head == "PartialDependence" and method == "POST":
+        # `water/api/ModelMetricsHandler` PDP route (synchronous here: the
+        # reference runs it as a job; ours is one batched rescore per bin)
+        model = STORE.get(p.get("model_id", ""))
+        fr = STORE.get(p.get("frame_id", ""))
+        if model is None or fr is None:
+            return _err(404, "model or frame not found")
+        cols = p.get("cols")
+        cols = cols.split(",") if isinstance(cols, str) and cols else None
+        targets = p.get("targets")
+        targets = targets.split(",") if isinstance(targets, str) and targets \
+            else None
+        tables = model.partial_dependence(
+            fr, cols, nbins=int(p.get("nbins", 20) or 20),
+            weight_column=p.get("weight_column") or None, targets=targets)
+        return 200, {"partial_dependence_data":
+                     [schemas.table_schema(t) for t in tables]}
+
+    if head == "PermutationVarImp" and method == "POST":
+        model = STORE.get(p.get("model_id", ""))
+        fr = STORE.get(p.get("frame_id", ""))
+        if model is None or fr is None:
+            return _err(404, "model or frame not found")
+        t = model.permutation_importance(
+            fr, metric=p.get("metric", "AUTO") or "AUTO",
+            n_repeats=int(p.get("n_repeats", 1) or 1),
+            seed=int(p.get("seed", -1) or -1))
+        return 200, {"permutation_varimp": schemas.table_schema(t)}
+
     # -- jobs ----------------------------------------------------------------
     if head == "Jobs":
         if rest[1:]:

@@ -119,6 +119,8 @@ def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
                                   "convert to parquet/csv")
     elif ext in (".svm", ".svmlight"):
         return _parse_svmlight(path, mesh=mesh, dest_key=dest_key)
+    elif ext == ".arff":
+        return _parse_arff(path, mesh=mesh, dest_key=dest_key)
     else:
         table = _read_csv(path, guess_setup(path, setup))
     return _table_to_frame(table, setup or ParseSetup(), mesh=mesh, dest_key=dest_key)
@@ -240,6 +242,78 @@ def _parse_svmlight(path: str, mesh=None, dest_key=None) -> Frame:
     for j in range(max_idx + 1):
         cols[f"C{j}"] = mat[:, j]
     return Frame.from_dict(cols, mesh=mesh, key=dest_key)
+
+
+def _parse_arff(path: str, mesh=None, dest_key: str | None = None) -> Frame:
+    """ARFF ingest (`water/parser/ARFFParser.java` role): @attribute header
+    drives column typing (numeric / nominal / string / date-as-string), then
+    the @data section parses as CSV."""
+    import csv as _csv
+
+    from ..frame.vec import T_CAT, T_STR, Vec
+
+    names, kinds, domains = [], [], []
+    data_rows = []
+    in_data = False
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("%"):
+                continue
+            low = line.lower()
+            if not in_data and low.startswith("@attribute"):
+                rest = line.split(None, 1)[1]
+                if rest.startswith(("'", '"')):
+                    q = rest[0]
+                    end = rest.index(q, 1)
+                    name, spec = rest[1:end], rest[end + 1:].strip()
+                else:
+                    name, _, spec = rest.partition(" ")
+                    spec = spec.strip()
+                names.append(name)
+                if spec.startswith("{"):
+                    kinds.append("enum")
+                    # domain values may be quoted and contain commas
+                    toks = next(_csv.reader([spec.strip("{}")],
+                                            quotechar="'",
+                                            skipinitialspace=True))
+                    domains.append([t.strip().strip("'\"") for t in toks])
+                elif spec.lower() in ("numeric", "integer", "real"):
+                    kinds.append("numeric")
+                    domains.append(None)
+                else:  # string / date / relational — host-side strings
+                    kinds.append("string")
+                    domains.append(None)
+            elif low.startswith("@data"):
+                in_data = True
+            elif in_data:
+                if line.startswith("{"):
+                    raise NotImplementedError(
+                        "sparse-format ARFF ({index value, ...} rows) is not "
+                        "supported — densify or convert to CSV")
+                # ARFF quotes with single quotes; csv defaults to double
+                data_rows.append(next(_csv.reader([line], quotechar="'")))
+    n = len(data_rows)
+    cols = {}
+    for j, (name, kind, dom) in enumerate(zip(names, kinds, domains)):
+        raw = [r[j].strip() if j < len(r) else "?" for r in data_rows]
+        if kind == "numeric":
+            vals = np.array([np.nan if t in ("?", "") else float(t)
+                             for t in raw], dtype=np.float64)
+            cols[name] = Vec.from_numpy(vals, mesh=mesh)
+        elif kind == "enum":
+            lut = {lvl: i for i, lvl in enumerate(dom)}
+            vals = np.array([np.nan if t in ("?", "") else
+                             lut.get(t.strip("'\""), np.nan) for t in raw],
+                            dtype=np.float32)
+            cols[name] = Vec.from_numpy(vals, type=T_CAT, domain=dom,
+                                        mesh=mesh)
+        else:
+            vals = np.array([None if t in ("?", "") else t.strip("'\"")
+                             for t in raw], dtype=object)
+            cols[name] = Vec(None, n, type=T_STR, host_data=vals)
+    _check_frame_size(n, len(names))
+    return Frame(list(cols), list(cols.values()), key=dest_key)
 
 
 def import_file(path: str, destination_frame: str | None = None,

@@ -1,0 +1,149 @@
+"""Model explanation tools — partial dependence + permutation importance.
+
+Analog of `h2o-core/src/main/java/hex/PartialDependence.java` (the
+`/3/PartialDependence` handler's worker) and `hex/PermutationVarImp.java`.
+The reference runs one scoring MRTask per grid point / per shuffled column;
+here each grid point is one batched `model.predict` over the sharded frame —
+the mutate-column-and-rescore loop stays on host, the scoring stays on
+device."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+from ..utils.twodimtable import TwoDimTable
+
+
+def _response_col(model, pred: Frame, target: str | None = None) -> np.ndarray:
+    """The PDP target: p1 for binomial, p(target) for multinomial,
+    prediction for regression."""
+    cat = model.output.model_category
+    if cat == "Binomial":
+        return pred.vec(2).to_numpy()
+    if cat == "Multinomial":
+        return pred.vec(f"p{target}").to_numpy()
+    return pred.vec(0).to_numpy()
+
+
+def partial_dependence(model, fr: Frame, cols=None, nbins: int = 20,
+                       weight_column: str | None = None,
+                       targets=None) -> list[TwoDimTable]:
+    """One table per column (per target class for multinomial): grid value,
+    weighted mean response, stddev, stderr of the per-row responses with the
+    column pinned to the value."""
+    cat = model.output.model_category
+    if cat == "Multinomial" and not targets:
+        raise ValueError("multinomial PDP requires `targets` (class labels), "
+                         "as in the reference's PartialDependence.targets")
+    targets = [None] if cat != "Multinomial" else (
+        [targets] if isinstance(targets, str) else list(targets))
+    cols = cols or [n for n in model.output.names][:2]
+    cols = [cols] if isinstance(cols, str) else list(cols)
+    w = None
+    if weight_column is not None:
+        w = np.nan_to_num(fr.vec(weight_column).to_numpy())
+    out = []
+    for col, target in [(c, t) for c in cols for t in targets]:
+        v = fr.vec(col)
+        if v.is_categorical():
+            grid = np.arange(len(v.domain), dtype=np.float64)
+            labels = list(v.domain)
+        else:
+            x = v.to_numpy()
+            ok = ~np.isnan(x)
+            lo, hi = float(np.min(x[ok])), float(np.max(x[ok]))
+            grid = np.linspace(lo, hi, nbins)
+            labels = None
+        rows = []
+        for gi, val in enumerate(grid):
+            pinned = Frame(list(fr.names),
+                           [Vec.from_numpy(
+                               np.full(fr.nrow, val, dtype=np.float32),
+                               type=v.type, domain=v.domain)
+                            if n == col else fr.vec(n) for n in fr.names])
+            resp = _response_col(model, model.predict(pinned), target)
+            ok = ~np.isnan(resp)
+            ww = (w[ok] if w is not None else np.ones(ok.sum()))
+            n = max(ww.sum(), 1e-12)
+            mean = float(np.sum(ww * resp[ok]) / n)
+            var = float(np.sum(ww * (resp[ok] - mean) ** 2) / n)
+            std = np.sqrt(var)
+            rows.append([labels[gi] if labels else float(val), mean, std,
+                         std / np.sqrt(max(ok.sum(), 1))])
+        hdr = f"PartialDependence: {col}" + \
+            (f" (target {target})" if target is not None else "")
+        out.append(TwoDimTable(
+            table_header=hdr,
+            col_header=[col, "mean_response", "stddev_response",
+                        "std_error_mean_response"],
+            col_types=["string" if labels else "double"] + ["double"] * 3,
+            cell_values=rows))
+    return out
+
+
+def permutation_varimp(model, fr: Frame, metric: str = "AUTO",
+                       n_repeats: int = 1, seed: int = -1) -> TwoDimTable:
+    """Permutation feature importance (`hex/PermutationVarImp.java`): metric
+    degradation when one feature column is shuffled, per feature."""
+    from .metrics import (make_binomial_metrics, make_multinomial_metrics,
+                          make_regression_metrics)
+    import jax.numpy as jnp
+
+    cat = model.output.model_category
+    mname = metric.upper()
+    allowed = {"Binomial": ("AUTO", "AUC", "LOGLOSS"),
+               "Multinomial": ("AUTO", "LOGLOSS"),
+               "Regression": ("AUTO", "RMSE", "MSE")}.get(cat)
+    if allowed is None:
+        raise ValueError(f"permutation importance is not supported for "
+                         f"{cat} models")
+    if mname not in allowed:
+        raise ValueError(f"metric '{metric}' is not supported for {cat} "
+                         f"models (one of {allowed})")
+    y_name = model.params.response_column
+    y = fr.vec(y_name).to_numpy()
+
+    def score_metric(frame) -> float:
+        pred = model.predict(frame)
+        if cat == "Binomial":
+            p1 = pred.vec(2).to_numpy()
+            m = make_binomial_metrics(jnp.asarray(y), jnp.asarray(p1))
+            return m.auc if mname in ("AUTO", "AUC") else -m.logloss
+        if cat == "Multinomial":
+            P = np.stack([pred.vec(i).to_numpy()
+                          for i in range(1, pred.ncol)], axis=1)
+            m = make_multinomial_metrics(jnp.asarray(y), jnp.asarray(P))
+            return -m.logloss
+        p = pred.vec(0).to_numpy()
+        m = make_regression_metrics(jnp.asarray(y), jnp.asarray(p))
+        return -m.rmse if mname in ("AUTO", "RMSE") else -m.mse
+
+    base = score_metric(fr)
+    rng = np.random.default_rng(None if seed in (-1, None) else int(seed))
+    names = list(model.output.names)
+    rows = []
+    for col in names:
+        v = fr.vec(col)
+        x = v.to_numpy().copy()
+        deltas = []
+        for _ in range(max(1, n_repeats)):
+            perm = rng.permutation(fr.nrow)
+            shuffled = Frame(list(fr.names),
+                             [Vec.from_numpy(x[perm], type=v.type,
+                                             domain=v.domain)
+                              if n == col else fr.vec(n) for n in fr.names])
+            deltas.append(base - score_metric(shuffled))
+        rows.append([col, float(np.mean(deltas))])
+    imp = np.array([r[1] for r in rows])
+    mx = imp.max() if imp.max() > 0 else 1.0
+    tot = imp.sum() if imp.sum() > 0 else 1.0
+    table_rows = [[r[0], r[1], r[1] / mx, r[1] / tot]
+                  for r in sorted(rows, key=lambda r: -r[1])]
+    return TwoDimTable(
+        table_header="Permutation Variable Importance",
+        col_header=["Variable", "Relative Importance", "Scaled Importance",
+                    "Percentage"],
+        col_types=["string", "double", "double", "double"],
+        cell_values=table_rows)

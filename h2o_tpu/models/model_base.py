@@ -215,6 +215,28 @@ class Model(Keyed):
 
     download_mojo = save_mojo  # h2o-py surface alias
 
+    def save_pojo(self, path: str, class_name: str | None = None) -> str:
+        """Java source scorer (`hex/tree/TreeJCodeGen` / `toJavaPredict`)."""
+        from ..mojo.pojo import export_pojo
+
+        return export_pojo(self, path, class_name)
+
+    download_pojo = save_pojo
+
+    # -- explanation surface (`hex/PartialDependence`, `hex/PermutationVarImp`)
+    def partial_dependence(self, fr, cols=None, nbins: int = 20,
+                           weight_column=None, targets=None):
+        from .explain import partial_dependence
+
+        return partial_dependence(self, fr, cols, nbins, weight_column,
+                                  targets)
+
+    def permutation_importance(self, fr, metric: str = "AUTO",
+                               n_repeats: int = 1, seed: int = -1):
+        from .explain import permutation_varimp
+
+        return permutation_varimp(self, fr, metric, n_repeats, seed)
+
     def remove_impl(self, store):
         for m in self.output.cv_models:
             store.remove(m.key)

@@ -105,6 +105,16 @@ class TestRestApi:
         assert abs(sc.iloc[:, 0].mean()) < 1e-5
         assert fr.na_omit().nrow == fr.nrow  # no NAs in fixture
 
+    def test_pdp_and_permutation_via_rest(self, csv_frame):
+        fr, df = csv_frame
+        m = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+        m.train(y="y", training_frame=fr)
+        pdp = m.partial_plot(fr, cols=["x1"], nbins=5)
+        assert len(pdp) == 1 and len(pdp[0]["data"][0]) == 5
+        pvi = m.permutation_importance(fr, seed=3)
+        names = pvi["data"][0]
+        assert names[0] == "x1"   # the signal feature ranks first
+
     def test_train_with_x_subset(self, csv_frame):
         fr, _ = csv_frame
         m = h2o.H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
