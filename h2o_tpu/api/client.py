@@ -312,6 +312,12 @@ class H2OFrame:
 
     __hash__ = None
 
+    def __and__(self, o):
+        return self._binop("&", o)
+
+    def __or__(self, o):
+        return self._binop("|", o)
+
     def mean(self, na_rm=True):
         return self._exec(f"(mean {self.frame_id} {'true' if na_rm else 'false'})")
 
@@ -451,6 +457,41 @@ class H2OFrame:
     def tokenize(self, split=" ") -> "H2OFrame":
         return self._exec(f"(tokenize {self.frame_id} '{split}')")
 
+    def runif(self, seed=-1) -> "H2OFrame":
+        return self._exec(f"(h2o.runif {self.frame_id} {seed})")
+
+    def split_frame(self, ratios=(0.75,), seed=-1) -> list["H2OFrame"]:
+        """Random frame split (h2o-py `split_frame`): cumulative ratio
+        buckets over one uniform column."""
+        r = self.runif(seed=seed)
+        out = []
+        lo = 0.0
+        bounds = list(ratios) + [None]
+        for frac in bounds:
+            hi = lo + frac if frac is not None else 1.0
+            mask = (r >= lo) & (r < hi) if frac is not None else (r >= lo)
+            out.append(self[mask])
+            lo = hi
+        return out
+
+    def drop(self, col) -> "H2OFrame":
+        """Remove column(s) by name/index (h2o-py `drop`)."""
+        cols = [col] if isinstance(col, (str, int)) else list(col)
+        have = self.columns
+        names = [c if isinstance(c, str) else have[c] for c in cols]
+        missing = [n for n in names if n not in have]
+        if missing:
+            raise ValueError(f"drop: column(s) {missing} not in frame")
+        keep = [n for n in have if n not in names]
+        return self[keep]
+
+    def ascharacter(self) -> "H2OFrame":
+        return self._exec(f"(ascharacter {self.frame_id})")
+
+    def group_by(self, by) -> "H2OGroupBy":
+        """h2o-py GroupBy builder: chain aggregates, then `.get_frame()`."""
+        return H2OGroupBy(self, [by] if isinstance(by, str) else list(by))
+
     def set_names(self, names: list[str]) -> "H2OFrame":
         """Rename columns in place (h2o-py semantics: the handle keeps
         pointing at the renamed frame)."""
@@ -494,6 +535,55 @@ class H2OFrame:
 # ---------------------------------------------------------------------------
 # estimators (`h2o-py/h2o/estimators/*` — thin generated layer)
 # ---------------------------------------------------------------------------
+class H2OGroupBy:
+    """`h2o-py/h2o/group_by.py` surface over the rapids GB prim."""
+
+    def __init__(self, fr: H2OFrame, by: list[str]):
+        self._fr = fr
+        self._by = by
+        self._aggs: list[tuple[str, str, str]] = []
+
+    def _add(self, agg, col, na):
+        cols = [col] if isinstance(col, str) else list(col)
+        for c in cols:
+            self._aggs.append((agg, c, na))
+        return self
+
+    def sum(self, col, na="all"):
+        return self._add("sum", col, na)
+
+    def mean(self, col, na="all"):
+        return self._add("mean", col, na)
+
+    def min(self, col, na="all"):
+        return self._add("min", col, na)
+
+    def max(self, col, na="all"):
+        return self._add("max", col, na)
+
+    def sd(self, col, na="all"):
+        return self._add("sd", col, na)
+
+    def var(self, col, na="all"):
+        return self._add("var", col, na)
+
+    def count(self, na="all"):
+        self._aggs.append(("nrow", self._by[0], na))
+        return self
+
+    def get_frame(self) -> H2OFrame:
+        by = " ".join(f"'{c}'" for c in self._by)
+        aggs = " ".join(f"'{a}' '{c}' '{na}'" for a, c, na in self._aggs)
+        return self._fr._exec(f"(GB {self._fr.frame_id} [{by}] {aggs})")
+
+
+def export_file(frame: H2OFrame, path: str, force: bool = False) -> None:
+    """`h2o.export_file`: write a frame to CSV/parquet server-side."""
+    connection().request(
+        "POST", f"/3/Frames/{urllib.parse.quote(frame.frame_id)}/export",
+        params={"path": path, "force": "true" if force else "false"})
+
+
 class H2OModelClient:
     """Client handle on a trained server-side model."""
 

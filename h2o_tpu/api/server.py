@@ -17,6 +17,7 @@ return the reference's H2OErrorV3 shape with http status codes
 from __future__ import annotations
 
 import json
+import os
 import threading
 import traceback
 import urllib.parse
@@ -242,7 +243,6 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
     if head == "ImportFiles":
         path = p.get("path", "")
         import glob as _glob
-        import os
 
         if "://" in path:  # URI schemes resolve through the Persist SPI
             from ..io.persist import localize
@@ -313,6 +313,19 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if method == "DELETE":
             STORE.remove(fid)
             return 200, {}
+        if rest[2:] and rest[2] == "export" and method == "POST":
+            # `water/api/FramesHandler.export` — CSV/parquet by extension
+            path = p.get("path", "")
+            if not path:
+                return _err(400, "export: path is required")
+            if not _truthy(p.get("force")) and os.path.exists(path):
+                return _err(400, f"export: {path} exists (use force)")
+            df = fr.to_pandas()
+            if path.endswith((".parquet", ".pq")):
+                df.to_parquet(path)
+            else:
+                df.to_csv(path, index=False)
+            return 200, {"job": {"status": "DONE", "dest": path}}
         if rest[2:] and rest[2] == "summary":
             return 200, {"frames": [schemas.frame_schema(fr, npreview=0)]}
         n = int(p.get("row_count", 10) or 10)
@@ -348,7 +361,6 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             STORE.remove(mid)
             return 200, {}
         if rest[2:] and rest[2] == "mojo":
-            import os
 
             path = p.get("dir") or "."
             if os.path.isdir(path) or path.endswith(os.sep):
@@ -469,7 +481,6 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
 
 
 def _dest_name(path: str) -> str:
-    import os
 
     base = os.path.basename(path)
     for ext in (".csv", ".gz", ".zip", ".parquet"):

@@ -318,7 +318,7 @@ def _group_by(R, fr, by, *aggspec):
     for i in range(0, len(aggspec), 3):
         agg, col, na = aggspec[i], aggspec[i + 1], aggspec[i + 2]
         col_name = fr.names[_col_indices(fr, col)[0]]
-        aggs.append((agg, col_name))
+        aggs.append((agg, col_name, na))
     return group_by(fr, by_names, aggs)
 
 
@@ -443,6 +443,12 @@ _PRIMS = {
             _as_frame(fr), g, s, asc, str(name)),
     "topn": lambda R, fr, col, pct, bottom=0.0:
         advmath.topn(_as_frame(fr), int(col), float(pct), bool(bottom)),
+    # uniform random column keyed to the frame's rows (`AstRunif`) — the
+    # h2o-py split_frame building block
+    "h2o.runif": lambda R, fr, seed=-1: (lambda f: Vec.from_numpy(
+        np.random.default_rng(
+            None if seed in (-1, None) else int(seed)).random(
+                f.nrow).astype(np.float32)))(_as_frame(fr)),
 }
 
 

@@ -105,6 +105,43 @@ class TestRestApi:
         assert abs(sc.iloc[:, 0].mean()) < 1e-5
         assert fr.na_omit().nrow == fr.nrow  # no NAs in fixture
 
+    def test_group_by_and_export(self, csv_frame, tmp_path):
+        fr, df = csv_frame
+        g = fr.group_by("y").mean("x1").count().get_frame()
+        got = g.as_data_frame().set_index("y")
+        want = df.groupby("y").x1.mean()
+        for lvl in ("no", "yes"):
+            assert abs(got.loc[lvl, "mean_x1"] - want[lvl]) < 1e-5
+        # na='all' (h2o-py default) poisons NA-bearing groups; na='rm' drops
+        na_fr = h2o.upload_frame(pd.DataFrame(
+            {"k": ["a", "a", "b"], "v": [1.0, np.nan, 3.0]}))
+        g_all = na_fr.group_by("k").mean("v", na="all").get_frame() \
+            .as_data_frame().set_index("k")
+        assert np.isnan(g_all.loc["a", "mean_v"])
+        assert g_all.loc["b", "mean_v"] == 3.0
+        g_rm = na_fr.group_by("k").mean("v", na="rm").get_frame() \
+            .as_data_frame().set_index("k")
+        assert g_rm.loc["a", "mean_v"] == 1.0
+        with pytest.raises(ValueError):
+            fr.drop("no_such_column")
+        out = str(tmp_path / "exp.csv")
+        h2o.export_file(fr, out)
+        back = pd.read_csv(out)
+        assert len(back) == fr.nrow and list(back.columns) == fr.columns
+        with pytest.raises(Exception):
+            h2o.export_file(fr, out)          # exists, no force
+        h2o.export_file(fr, out, force=True)  # overwrite allowed
+
+    def test_split_drop_runif(self, csv_frame):
+        fr, df = csv_frame
+        tr, te = fr.split_frame(ratios=[0.7], seed=1)
+        assert tr.nrow + te.nrow == fr.nrow
+        assert abs(tr.nrow / fr.nrow - 0.7) < 0.1
+        d = fr.drop("x2")
+        assert d.columns == ["x1", "y"]
+        r = fr.runif(seed=2).as_data_frame()
+        assert (r.iloc[:, 0] >= 0).all() and (r.iloc[:, 0] <= 1).all()
+
     def test_pdp_and_permutation_via_rest(self, csv_frame):
         fr, df = csv_frame
         m = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
