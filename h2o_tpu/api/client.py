@@ -32,9 +32,16 @@ class H2OConnectionError(Exception):
 class H2OConnection:
     """REST transport — `h2o-py/h2o/backend/connection.py` analog."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, username: str | None = None,
+                 password: str | None = None):
         self.url = url.rstrip("/")
         self.session_id: str | None = None
+        self._auth = None
+        if username is not None:
+            import base64
+
+            self._auth = "Basic " + base64.b64encode(
+                f"{username}:{password or ''}".encode()).decode()
 
     def request(self, method: str, path: str, data: dict | None = None,
                 params: dict | None = None) -> dict:
@@ -43,6 +50,8 @@ class H2OConnection:
             url += "?" + urllib.parse.urlencode(params)
         body = None
         headers = {}
+        if self._auth:
+            headers["Authorization"] = self._auth
         if data is not None:
             body = json.dumps(data).encode()
             headers["Content-Type"] = "application/json"
@@ -58,7 +67,9 @@ class H2OConnection:
             except (ValueError, KeyError):
                 raise H2OConnectionError(str(e))
         except urllib.error.URLError as e:
-            raise H2OConnectionError(f"no H2O server at {self.url}: {e}")
+            err = H2OConnectionError(f"no H2O server at {self.url}: {e}")
+            err.no_server = True  # distinguishes "nothing listening" from
+            raise err             # HTTP-level failures like 401
 
     # session for rapids temp management
     def session(self) -> str:
@@ -77,30 +88,37 @@ def connection() -> H2OConnection:
 # module surface (`h2o-py/h2o/h2o.py`)
 # ---------------------------------------------------------------------------
 def init(url: str | None = None, port: int = 54321, name: str = "h2o_tpu",
-         strict_version_check: bool = False, **kw):
+         strict_version_check: bool = False, username: str | None = None,
+         password: str | None = None, hash_login: dict | str | None = None,
+         **kw):
     """Connect to a running server, else boot one in-process
-    (`h2o-py/h2o/h2o.py:137` connect-or-spawn)."""
+    (`h2o-py/h2o/h2o.py:137` connect-or-spawn). `username`/`password` send
+    basic auth; `hash_login` configures it on a freshly booted server."""
     global _conn
     if url is None:
         url = f"http://127.0.0.1:{port}"
     try:
-        _conn = H2OConnection(url)
+        _conn = H2OConnection(url, username, password)
         _conn.request("GET", "/3/Cloud")
         return _conn
-    except H2OConnectionError:
-        pass
+    except H2OConnectionError as e:
+        if not getattr(e, "no_server", False):
+            # a server IS listening but refused us (401, 5xx…) — surface it
+            # rather than silently booting a fresh empty cluster beside it
+            raise
     from .server import H2OServer
 
-    server = H2OServer(port=port, name=name).start()
-    _conn = H2OConnection(server.url)
+    server = H2OServer(port=port, name=name, hash_login=hash_login).start()
+    _conn = H2OConnection(server.url, username, password)
     _conn._server = server  # keep alive / allow shutdown
     cluster_status()
     return _conn
 
 
-def connect(url: str, **kw):
+def connect(url: str, username: str | None = None,
+            password: str | None = None, **kw):
     global _conn
-    _conn = H2OConnection(url)
+    _conn = H2OConnection(url, username, password)
     _conn.request("GET", "/3/Cloud")
     return _conn
 
@@ -269,6 +287,40 @@ class H2OFrame:
         if isinstance(sel, H2OFrame):  # boolean mask frame
             return self._exec(f"(rows {self.frame_id} (cols {sel.frame_id} 0))")
         raise TypeError(f"bad selector {sel!r}")
+
+    @staticmethod
+    def _src_expr(value) -> str:
+        if isinstance(value, H2OFrame):
+            return value.frame_id
+        if isinstance(value, str):
+            return f"'{value}'"
+        if value is None:
+            return "NA"
+        return repr(float(value))
+
+    def __setitem__(self, sel, value):
+        """In-place column/slice update: `(append ...)` for a new column,
+        `(:= ...)` rectangle assign otherwise (h2o-py `H2OFrame.__setitem__`
+        → `AstAppend`/`AstRectangleAssign`)."""
+        src = self._src_expr(value)
+        if isinstance(sel, tuple) and len(sel) == 2:
+            rowsel, colsel = sel
+            rows = (f"(cols {rowsel.frame_id} 0)"
+                    if isinstance(rowsel, H2OFrame) else
+                    "[]" if rowsel is None else
+                    f"[{' '.join(str(int(r)) for r in rowsel)}]"
+                    if isinstance(rowsel, (list, tuple)) else
+                    str(int(rowsel)))
+            cols = (f"'{colsel}'" if isinstance(colsel, str)
+                    else str(int(colsel)))
+            expr = f"(:= {self.frame_id} {src} {cols} {rows})"
+        elif isinstance(sel, str) and sel not in self.columns:
+            expr = f"(append {self.frame_id} {src} '{sel}')"
+        else:
+            col = sel if not isinstance(sel, str) else f"'{sel}'"
+            expr = f"(:= {self.frame_id} {src} {col} [])"
+        self._exec(f"(assign {self.frame_id} {expr})")
+        self.refresh()
 
     def _binop(self, op, other, reverse=False):
         rhs = other.frame_id if isinstance(other, H2OFrame) else repr(float(other))
@@ -443,6 +495,68 @@ class H2OFrame:
         return self._exec(f"(topn {self.frame_id} {column} {nPercent} "
                           f"{bottom})")
 
+    def mode(self):
+        return self._exec(f"(mode {self.frame_id})")
+
+    def hist(self, breaks="sturges") -> "H2OFrame":
+        b = (f"'{breaks}'" if isinstance(breaks, str) else
+             "[" + " ".join(map(str, breaks)) + "]"
+             if isinstance(breaks, (list, tuple)) else str(breaks))
+        return self._exec(f"(hist {self.frame_id} {b})")
+
+    def distance(self, y: "H2OFrame", measure="l2") -> "H2OFrame":
+        return self._exec(f"(distance {self.frame_id} {y.frame_id} "
+                          f"'{measure}')")
+
+    def drop_duplicates(self, columns, keep="first") -> "H2OFrame":
+        cols = " ".join(f"'{c}'" if isinstance(c, str) else str(c)
+                        for c in columns)
+        return self._exec(f"(dropdup {self.frame_id} [{cols}] '{keep}')")
+
+    def mad(self, combine_method="interpolate", constant=1.4826):
+        return self._exec(f"(h2o.mad {self.frame_id} '{combine_method}' "
+                          f"{constant})")
+
+    def nlevels(self):
+        return self._exec(f"(nlevels {self.frame_id})")
+
+    def anyfactor(self):
+        return bool(self._exec(f"(any.factor {self.frame_id})"))
+
+    def isna(self) -> "H2OFrame":
+        return self._exec(f"(is.na {self.frame_id})")
+
+    def columns_by_type(self, coltype="numeric"):
+        return self._exec(f"(columnsByType {self.frame_id} '{coltype}')")
+
+    def set_level(self, level: str) -> "H2OFrame":
+        return self._exec(f"(setLevel {self.frame_id} '{level}')")
+
+    def append_levels(self, levels) -> "H2OFrame":
+        lv = " ".join(f"'{l}'" for l in levels)
+        return self._exec(f"(appendLevels {self.frame_id} [{lv}])")
+
+    def relevel_by_frequency(self, top_n=-1) -> "H2OFrame":
+        return self._exec(f"(relevel.by.freq {self.frame_id} {top_n})")
+
+    def as_date(self, format: str) -> "H2OFrame":
+        return self._exec(f"(as.Date {self.frame_id} '{format}')")
+
+    def week(self) -> "H2OFrame":
+        return self._exec(f"(week {self.frame_id})")
+
+    def isax(self, num_words, max_cardinality, optimize_card=False):
+        oc = "1" if optimize_card else "0"
+        return self._exec(f"(isax {self.frame_id} {num_words} "
+                          f"{max_cardinality} {oc})")
+
+    def apply(self, fun: str, axis=0) -> "H2OFrame":
+        """Apply a reducer over rows (axis=1) or columns (axis=0). `fun` is
+        a reducer name ('mean', 'sum', …) or a raw rapids lambda string."""
+        lam = fun if fun.lstrip().startswith("{") else f"{{x . ({fun} x)}}"
+        margin = 1 if axis == 1 else 2
+        return self._exec(f"(apply {self.frame_id} {margin} {lam})")
+
     def entropy(self) -> "H2OFrame":
         return self._exec(f"(entropy {self.frame_id})")
 
@@ -575,6 +689,16 @@ class H2OGroupBy:
         by = " ".join(f"'{c}'" for c in self._by)
         aggs = " ".join(f"'{a}' '{c}' '{na}'" for a, c, na in self._aggs)
         return self._fr._exec(f"(GB {self._fr.frame_id} [{by}] {aggs})")
+
+
+def interaction(frame: H2OFrame, factors, pairwise=False, max_factors=100,
+                min_occurrence=1, destination_frame=None) -> H2OFrame:
+    """`h2o.interaction`: combined categorical columns from factor tuples."""
+    items = " ".join(f"'{f}'" if isinstance(f, str) else str(f)
+                     for f in factors)
+    pw = "true" if pairwise else "false"
+    return frame._exec(f"(interaction {frame.frame_id} [{items}] {pw} "
+                       f"{max_factors} {min_occurrence})")
 
 
 def export_file(frame: H2OFrame, path: str, force: bool = False) -> None:

@@ -40,11 +40,49 @@ _SESSIONS: dict[str, Session] = {}
 class H2OServer:
     """Server lifecycle — `water/H2O.main` + Jetty boot analog."""
 
-    def __init__(self, port: int = 54321, name: str = "h2o_tpu"):
+    def __init__(self, port: int = 54321, name: str = "h2o_tpu",
+                 hash_login: dict | str | None = None):
+        """`hash_login`: {user: sha256-hex-or-plain} dict or a realm file of
+        `user:sha256hex` lines — the `-hash_login` basic-auth analog
+        (`h2o-security`, `water/webserver/H2OHttpViewImpl` auth hook)."""
         self.port = port
         self.name = name
         self.httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        if isinstance(hash_login, str):
+            creds = {}
+            with open(hash_login) as f:
+                for line in f:
+                    if ":" in line:
+                        u, _, h = line.strip().partition(":")
+                        creds[u] = h
+            hash_login = creds
+        self.hash_login = hash_login
+
+    def check_auth(self, header: str | None) -> bool:
+        if not self.hash_login:
+            return True
+        if not header or not header.startswith("Basic "):
+            return False
+        import base64
+        import hashlib
+        import hmac
+        import re
+
+        try:
+            user, _, pw = base64.b64decode(
+                header[6:]).decode().partition(":")
+        except Exception:
+            return False
+        expect = self.hash_login.get(user)
+        if expect is None:
+            return False
+        # a 64-hex entry is a stored sha256 — compare digests only, so the
+        # realm file never doubles as a usable credential (no pass-the-hash)
+        if re.fullmatch(r"[0-9a-f]{64}", expect):
+            digest = hashlib.sha256(pw.encode()).hexdigest()
+            return hmac.compare_digest(digest, expect)
+        return hmac.compare_digest(pw, expect)
 
     def start(self) -> "H2OServer":
         handler = _make_handler(self)
@@ -94,7 +132,8 @@ def _jobs_of(algo_cls, params_cls, body: dict) -> tuple[int, dict]:
         raise ValueError(f"unknown parameter(s) {unknown} for this algorithm")
     kwargs = {}
     for k, v in body.items():
-        if k in ("training_frame", "validation_frame", "blending_frame"):
+        if k in ("training_frame", "validation_frame", "blending_frame",
+                 "calibration_frame"):
             v = STORE.get(v)
         kwargs[k] = v
     builder = algo_cls(params_cls(**kwargs))
@@ -138,6 +177,13 @@ def _make_handler(server: H2OServer):
                     for k, v in urllib.parse.parse_qs(raw).items()}
 
         def _route(self, method: str):
+            if not server.check_auth(self.headers.get("Authorization")):
+                self.send_response(401)
+                self.send_header("WWW-Authenticate",
+                                 'Basic realm="h2o_tpu"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
             parsed = urllib.parse.urlparse(self.path)
             parts = [p for p in parsed.path.split("/") if p]
             query = {k: v[0] if len(v) == 1 else v
@@ -476,6 +522,59 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         from ..utils.timeline import snapshot
 
         return 200, {"events": snapshot()}
+    if head == "Profiler":
+        # `water/api/ProfilerHandler`: cluster stack-sample aggregation; here
+        # the controller process is sampled for `depth` rounds
+        import sys
+        import time as _time
+        from collections import Counter
+
+        depth = int(p.get("depth", 10))
+        counts: Counter = Counter()
+        for _ in range(max(depth, 1)):
+            for frame in sys._current_frames().values():
+                stack = []
+                f = frame
+                while f is not None and len(stack) < 20:
+                    stack.append(f"{f.f_code.co_filename}:{f.f_lineno} "
+                                 f"{f.f_code.co_name}")
+                    f = f.f_back
+                counts["\n".join(stack)] += 1
+            _time.sleep(0.005)
+        nodes = [{"node_name": server.name,
+                  "entries": [{"stacktrace": s, "count": c}
+                              for s, c in counts.most_common(50)]}]
+        return 200, {"nodes": nodes}
+    if head == "WaterMeterCpuTicks":
+        # `water/api/WaterMeterCpuTicksHandler` — /proc/stat per-core ticks
+        ticks = []
+        try:
+            with open("/proc/stat") as f:
+                for line in f:
+                    if line.startswith("cpu") and line[3:4].isdigit():
+                        vals = [int(x) for x in line.split()[1:5]]
+                        ticks.append(vals)  # user, nice, sys, idle
+        except OSError:
+            pass
+        return 200, {"cpu_ticks": ticks}
+    if head == "WaterMeterIo":
+        # `water/api/WaterMeterIoHandler` — process I/O counters
+        io = {}
+        try:
+            with open("/proc/self/io") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    io[k.strip()] = int(v)
+        except OSError:
+            pass
+        return 200, {"persist_stats": [{
+            "backend": "ice", "store_count": 0,
+            "load_bytes": io.get("read_bytes", 0),
+            "store_bytes": io.get("write_bytes", 0)}]}
+    if head == "NetworkTest":
+        from ..utils.devicebench import network_test
+
+        return 200, network_test()
 
     return _err(404, f"no route for {method} /{'/'.join(parts)}")
 

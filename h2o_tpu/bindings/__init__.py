@@ -1,0 +1,2 @@
+"""Client-bindings codegen — `h2o-bindings/` analog (generator producing
+estimator classes from live schema metadata, `h2o-bindings/bin/gen_python.py`)."""

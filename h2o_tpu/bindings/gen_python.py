@@ -1,0 +1,144 @@
+"""Generate Python estimator classes from live schema metadata.
+
+Reference: `h2o-bindings/bin/gen_python.py` — the reference introspects a
+running server's `/3/Metadata/schemas` and emits `h2o-py/h2o/estimators/*`;
+here the same loop reads `/3/ModelBuilders/{algo}` parameter metadata
+(served by `models/registry.param_metadata`) and emits one
+`H2O<Algo>Estimator` subclass per registered algorithm, with typed keyword
+arguments, defaults, and docstrings. Run either against a live server
+(`--url`) or in-process off the registry (no server needed):
+
+    python -m h2o_tpu.bindings.gen_python --dest /tmp/estimators
+"""
+
+from __future__ import annotations
+
+import keyword
+import os
+
+
+_HEADER = '''"""Generated estimator bindings — do not edit by hand.
+
+Produced by h2o_tpu.bindings.gen_python (the `h2o-bindings/bin/gen_python.py`
+analog) from live /3/ModelBuilders parameter metadata.
+"""
+
+from h2o_tpu.api.client import H2OEstimator
+
+
+'''
+
+_CLASS_TMPL = '''class H2O{cls}Estimator(H2OEstimator):
+    """{doc}
+
+    Parameters (from /3/ModelBuilders/{algo} schema metadata):
+{params_doc}
+    """
+
+    algo = "{algo}"
+
+    def __init__(self{sig}):
+        kwargs = {{k: v for k, v in locals().items()
+                  if k not in ("self", "__class__") and v is not None}}
+        H2OEstimator.__init__(self, **kwargs)
+
+
+'''
+
+
+def _camel(algo: str) -> str:
+    special = {"gbm": "GradientBoosting", "drf": "RandomForest",
+               "glm": "GeneralizedLinear", "xgboost": "XGBoost",
+               "kmeans": "KMeans", "pca": "PrincipalComponentAnalysis",
+               "svd": "SingularValueDecomposition",
+               "glrm": "GeneralizedLowRank", "coxph": "CoxProportionalHazards",
+               "naivebayes": "NaiveBayes", "deeplearning": "DeepLearning",
+               "isolationforest": "IsolationForest",
+               "extendedisolationforest": "ExtendedIsolationForest",
+               "upliftdrf": "UpliftRandomForest",
+               "targetencoder": "TargetEncoder",
+               "stackedensemble": "StackedEnsemble",
+               "rulefit": "RuleFit", "psvm": "SupportVectorMachine",
+               "gam": "GeneralizedAdditive", "anovaglm": "ANOVAGLM",
+               "modelselection": "ModelSelection", "isotonicregression":
+               "IsotonicRegression", "decisiontree": "DecisionTree",
+               "adaboost": "AdaBoost", "word2vec": "Word2vec",
+               "aggregator": "Aggregator", "infogram": "Infogram",
+               "generic": "Generic"}
+    return special.get(algo, algo.capitalize())
+
+
+def _pydefault(v):
+    return repr(v)
+
+
+def _fetch_metadata(url: str | None):
+    """[(algo, [ {name,type,default_value}, ... ]), ...]"""
+    if url:
+        import json
+        import urllib.request
+
+        def get(path):
+            with urllib.request.urlopen(url.rstrip("/") + path,
+                                        timeout=60) as r:
+                return json.loads(r.read().decode())
+
+        algos = sorted(get("/3/ModelBuilders")["model_builders"])
+        return [(a, get(f"/3/ModelBuilders/{a}")["parameters"])
+                for a in algos]
+    from ..models import registry
+
+    return [(a, registry.param_metadata(a))
+            for a in sorted(registry.algo_names())]
+
+
+def generate_source(url: str | None = None) -> str:
+    """The generated module text (one estimator class per algo)."""
+    out = [_HEADER]
+    for algo, params in _fetch_metadata(url):
+        names, sig_parts, doc_lines = set(), [], []
+        for prm in params:
+            name = prm["name"]
+            if name in ("training_frame", "validation_frame") \
+                    or keyword.iskeyword(name) or not name.isidentifier():
+                continue
+            if name in names:
+                continue
+            names.add(name)
+            default = prm.get("default_value")
+            if isinstance(default, str) and default.startswith("<"):
+                default = None
+            sig_parts.append(f"{name}={_pydefault(default)}")
+            doc_lines.append(f"        {name}: {prm.get('type', 'Any')} "
+                             f"(default {default!r})")
+        sig = ""
+        if sig_parts:
+            # one kwarg per line keeps the generated file reviewable
+            joined = ",\n                 ".join(sig_parts)
+            sig = f", *,\n                 {joined}"
+        out.append(_CLASS_TMPL.format(
+            cls=_camel(algo), algo=algo,
+            doc=f"Builder for the '{algo}' algorithm.",
+            params_doc="\n".join(doc_lines) or "        (none)",
+            sig=sig))
+    return "".join(out)
+
+
+def generate(dest_dir: str, url: str | None = None) -> str:
+    """Write `estimators_gen.py` under dest_dir; returns the file path."""
+    os.makedirs(dest_dir, exist_ok=True)
+    path = os.path.join(dest_dir, "estimators_gen.py")
+    with open(path, "w") as f:
+        f.write(generate_source(url))
+    return path
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dest", default="./bindings_out")
+    ap.add_argument("--url", default=None,
+                    help="live server to introspect (default: in-process)")
+    a = ap.parse_args()
+    print(generate(a.dest, a.url))

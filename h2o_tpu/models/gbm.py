@@ -62,6 +62,8 @@ class GBMParameters(Parameters):
     interaction_constraints: list = None     # [[cols...], ...] allowed
                                              # interaction groups (`hex/tree/
                                              # GlobalInteractionConstraints`)
+    calibrate_model: bool = False            # Platt-scale p1 on a holdout
+    calibration_frame: object = None         # (`hex/tree/CalibrationHelper`)
 
 
 class GBMModel(Model):
@@ -79,8 +81,23 @@ class GBMModel(Model):
     def ntrees(self) -> int:
         return int(self.forest["feat"].shape[0])
 
+    calib = None   # (a, b) Platt coefficients when calibrate_model was set
+
     def score0(self, X: jax.Array) -> jax.Array:
         return _score_fn(self, X)
+
+    def predict(self, fr: Frame) -> Frame:
+        out = super().predict(fr)
+        if self.calib is not None:
+            # `CalibrationHelper.postProcessPredictions`: cal_p columns appended
+            a, b = self.calib
+            p1 = out.vec(2).data
+            pc = jnp.clip(p1, 1e-6, 1 - 1e-6)
+            margin = jnp.log(pc / (1 - pc))
+            cal = jax.nn.sigmoid(a * margin + b)
+            out.add("cal_p0", Vec.from_device(1.0 - cal, fr.nrow))
+            out.add("cal_p1", Vec.from_device(cal, fr.nrow))
+        return out
 
     def _raw_f(self, X):
         s = predict_forest(X, self.forest["feat"], self.forest["thr"],
@@ -416,9 +433,41 @@ class GBM(ModelBuilder):
                                         forest["nanL"], cfg.max_depth)
         output.variable_importances = self._varimp(forest, names)
         model = GBMModel(p, output, forest, f0, dist, cfg, is_cat)
+        if getattr(p, "calibrate_model", False):
+            model.calib = self._fit_calibration(model, category)
         if p.validation_frame is not None:
             output.validation_metrics = model.model_performance(p.validation_frame)
         return model
+
+    def _fit_calibration(self, model, category):
+        """Platt scaling on a holdout (`hex/tree/CalibrationHelper`): a 1-D
+        logistic fit of the actuals against the model's margin."""
+        p = self.params
+        if category != "Binomial":
+            raise ValueError("calibrate_model requires a binomial model")
+        if p.calibration_frame is None:
+            raise ValueError("calibrate_model requires calibration_frame")
+        cf = p.calibration_frame
+        X = model.adapt_frame(cf)
+        f = model._raw_f(X)  # margin (or probability for DRF)
+        if model.cfg.drf_mode:
+            pc = jnp.clip(f, 1e-6, 1 - 1e-6)
+            f = jnp.log(pc / (1 - pc))
+        y = jnp.nan_to_num(cf.vec(p.response_column).data)
+        wm = (~jnp.isnan(cf.vec(p.response_column).data)).astype(jnp.float32)
+
+        # 2-parameter Newton iterations for sigmoid(a*f + b), on device
+        ab = jnp.array([1.0, 0.0])
+        for _ in range(25):
+            eta = ab[0] * f + ab[1]
+            mu = jax.nn.sigmoid(eta)
+            g_eta = wm * (mu - y)
+            h_eta = jnp.maximum(wm * mu * (1 - mu), 1e-10)
+            g = jnp.array([jnp.sum(g_eta * f), jnp.sum(g_eta)])
+            H = jnp.array([[jnp.sum(h_eta * f * f), jnp.sum(h_eta * f)],
+                           [jnp.sum(h_eta * f), jnp.sum(h_eta)]])
+            ab = ab - jnp.linalg.solve(H + 1e-8 * jnp.eye(2), g)
+        return (float(ab[0]), float(ab[1]))
 
     @staticmethod
     def _resolve_checkpoint(cp) -> "GBMModel":

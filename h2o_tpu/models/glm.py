@@ -260,6 +260,18 @@ class GLMParameters(Parameters):
     standardize: bool = True
     intercept: bool = True
     non_negative: bool = False
+    dispersion_parameter_method: str = "pearson"  # pearson | deviance | ml
+                                     # (`hex/glm/GLMModel.DispersionMethod`);
+                                     # ml: exact for gamma (digamma Newton),
+                                     # Dunn-Smyth series likelihood for tweedie
+    fix_dispersion_parameter: bool = False
+    init_dispersion_parameter: float = 1.0
+    beta_constraints: object = None  # Frame or {names, lower_bounds,
+                                     # upper_bounds} — box constraints per
+                                     # coefficient on the natural scale
+                                     # (`hex/glm/GLM.BetaConstraint`); applied
+                                     # by projection in IRLSM/COD; rejected
+                                     # with L_BFGS like the reference
     max_iterations: int = 50
     beta_epsilon: float = 1e-5
     objective_epsilon: float = 1e-6
@@ -271,6 +283,152 @@ class GLMParameters(Parameters):
                                    # rows×cols mesh — the wide/one-hot Gram
                                    # sharding axis (SURVEY.md §5.7); GSPMD
                                    # inserts the cross-axis collectives
+
+
+def _beta_bounds(spec, di, pad_cols: int = 0):
+    """(lo, hi) arrays over [expanded coefs..., intercept] on the TRAINING
+    (standardized) scale, from a natural-scale constraint spec — a Frame or
+    dict with names/lower_bounds/upper_bounds (`hex/glm/GLM.BetaConstraint`).
+    Natural bound b on a standardized numeric coef becomes b·σ (β_std = β·σ);
+    one-hot and unstandardized coefs carry bounds unchanged."""
+    if spec is None:
+        return None
+    if hasattr(spec, "vec"):  # Frame
+        names = [str(x) for x in
+                 (spec.vec("names").host_data
+                  if spec.vec("names").host_data is not None else
+                  [spec.vec("names").domain[int(c)]
+                   for c in spec.vec("names").to_numpy()])]
+        lob = spec.vec("lower_bounds").to_numpy()
+        upb = spec.vec("upper_bounds").to_numpy()
+    else:
+        names = list(spec["names"])
+        lob = np.asarray(spec.get("lower_bounds",
+                                  [-np.inf] * len(names)), dtype=np.float64)
+        upb = np.asarray(spec.get("upper_bounds",
+                                  [np.inf] * len(names)), dtype=np.float64)
+    P = di.ncols_expanded
+    lo = np.full(P + 1 + pad_cols, -np.inf)
+    hi = np.full(P + 1 + pad_cols, np.inf)
+    idx = {n: j for j, n in enumerate(di.expanded_names)}
+    for n, l, u in zip(names, lob, upb):
+        if n not in idx:
+            raise ValueError(f"beta_constraints: unknown coefficient '{n}' "
+                             f"(expanded names: numeric column or "
+                             f"'col.level')")
+        j = idx[n]
+        s = di.num_sigmas.get(n, 1.0) if di.standardize else 1.0
+        if not np.isnan(l):
+            lo[j] = l * s
+        if not np.isnan(u):
+            hi[j] = u * s
+    if pad_cols:
+        # padded design columns sit between the real coefs and the intercept
+        lo[P:P + pad_cols], hi[P:P + pad_cols] = -np.inf, np.inf
+        lo[-1], hi[-1] = -np.inf, np.inf
+    return lo, hi
+
+
+def _tweedie_loglik(y, mu, phi, p):
+    """Σ log f(y; μ, φ) for Tweedie 1<p<2, by the Dunn & Smyth (2005) series
+    (`hex/glm/TweedieMLDispersionOnly` analog). Host-side f64; the series
+    index window is centered on j_max = y^{2−p}/(φ(2−p))."""
+    from scipy.special import gammaln
+
+    y = np.asarray(y, np.float64)
+    mu = np.maximum(np.asarray(mu, np.float64), 1e-10)
+    alpha = (2.0 - p) / (p - 1.0)
+    ll = (y * mu ** (1 - p) / (1 - p) - mu ** (2 - p) / (2 - p)) / phi
+    pos = y > 0
+    yp = y[pos]
+    if yp.size:
+        jmax = np.max(np.maximum(yp ** (2 - p) / (phi * (2 - p)), 1.0))
+        J = int(min(max(3 * jmax + 20, 40), 4000))
+        j = np.arange(1, J + 1, dtype=np.float64)[None, :]
+        logz = (alpha * np.log(yp) - alpha * np.log(p - 1)
+                - (1 + alpha) * np.log(phi) - np.log(2 - p))[:, None]
+        logWj = j * logz - gammaln(j + 1) - gammaln(alpha * j)
+        m = logWj.max(axis=1, keepdims=True)
+        logW = m[:, 0] + np.log(np.exp(logWj - m).sum(axis=1))
+        ll[pos] += logW - np.log(yp)
+    return float(ll.sum())
+
+
+def _gamma_ml_dispersion(dev: float, neff: float) -> float:
+    """Exact gamma ML: solve log α − ψ(α) = D/(2n) for the shape α = 1/φ
+    by Newton with digamma/trigamma (`hex/glm/DispersionTask` ml branch)."""
+    from scipy.special import digamma, polygamma
+
+    c = max(dev / (2.0 * max(neff, 1.0)), 1e-12)
+    # Minka's initializer, then Newton on f(α) = log α − ψ(α) − c
+    a = (3.0 - c + np.sqrt((c - 3.0) ** 2 + 24.0 * c)) / (12.0 * c)
+    for _ in range(30):
+        f = np.log(a) - float(digamma(a)) - c
+        fp = 1.0 / a - float(polygamma(1, a))
+        step = f / fp
+        a_new = a - step
+        if a_new <= 0:
+            a_new = a / 2.0
+        if abs(a_new - a) < 1e-12 * max(a, 1.0):
+            a = a_new
+            break
+        a = a_new
+    return 1.0 / max(a, 1e-12)
+
+
+def _estimate_dispersion(p, family, y, mu, w, dev, neff, rank) -> float:
+    """Dispersion φ per `dispersion_parameter_method`
+    (`hex/glm/GLMModel.java:528`, `hex/glm/DispersionTask.java`)."""
+    if p.fix_dispersion_parameter:
+        return float(p.init_dispersion_parameter)
+    method = (p.dispersion_parameter_method or "pearson").lower()
+    df = max(neff - rank, 1.0)
+    if method == "deviance":
+        return float(dev) / df
+    if method == "ml":
+        if family.name == "gamma":
+            return _gamma_ml_dispersion(float(dev), float(neff))
+        if family.name == "tweedie":
+            if not (1.0 < family.p < 2.0):
+                raise ValueError("ml dispersion for tweedie requires "
+                                 "1 < tweedie_variance_power < 2")
+            yh = np.asarray(y)
+            muh = np.asarray(mu)
+            wh = np.asarray(w)
+            keep = wh > 0
+            yh, muh = yh[keep], muh[keep]
+            # golden-section over log φ around the Pearson start
+            pearson = _estimate_dispersion_pearson(family, yh, muh,
+                                                   np.ones_like(yh), df)
+            lo, hi = np.log(pearson) - 4.0, np.log(pearson) + 4.0
+            gr = (np.sqrt(5.0) - 1) / 2
+            f = lambda lp: _tweedie_loglik(yh, muh, np.exp(lp), family.p)
+            a, b = lo, hi
+            c1, c2 = b - gr * (b - a), a + gr * (b - a)
+            f1, f2 = f(c1), f(c2)
+            for _ in range(40):
+                if f1 < f2:
+                    a, c1, f1 = c1, c2, f2
+                    c2 = a + gr * (b - a)
+                    f2 = f(c2)
+                else:
+                    b, c2, f2 = c2, c1, f1
+                    c1 = b - gr * (b - a)
+                    f1 = f(c1)
+                if b - a < 1e-8:
+                    break
+            return float(np.exp(0.5 * (a + b)))
+        raise ValueError(f"ml dispersion is supported for gamma and tweedie "
+                         f"(got family={family.name}) — use pearson/deviance")
+    # pearson (default)
+    return _estimate_dispersion_pearson(family, np.asarray(y),
+                                        np.asarray(mu), np.asarray(w), df)
+
+
+def _estimate_dispersion_pearson(family, y, mu, w, df) -> float:
+    V = np.asarray(family.variance(jnp.asarray(mu)))
+    resid2 = w * (y - mu) ** 2 / np.maximum(V, 1e-12)
+    return float(np.nansum(resid2) / df)
 
 
 def _destandardize(beta: np.ndarray, di) -> np.ndarray:
@@ -294,6 +452,7 @@ def _destandardize(beta: np.ndarray, di) -> np.ndarray:
 
 class GLMModel(Model):
     algo_name = "glm"
+    dispersion_estimated = None  # φ per dispersion_parameter_method
 
     def __init__(self, params, output, dinfo: DataInfo, beta, family, key=None):
         self.dinfo = dinfo
@@ -375,6 +534,9 @@ class GLM(ModelBuilder):
             if p.compute_p_values:  # AUTO family resolving to multinomial
                 raise ValueError("compute_p_values is not supported for "
                                  "multinomial family")
+            if p.beta_constraints is not None:
+                raise NotImplementedError("beta_constraints for multinomial "
+                                          "GLM: follow-up")
             if p.feature_parallelism > 1:
                 raise NotImplementedError(
                     "feature_parallelism for multinomial GLM is a planned "
@@ -418,6 +580,8 @@ class GLM(ModelBuilder):
         offset = (jnp.nan_to_num(fr.vec(p.offset_column).data)
                   if p.offset_column else jnp.zeros_like(y))
 
+        self._bounds = _beta_bounds(p.beta_constraints, dinfo,
+                                    pad_cols=pad_cols)
         beta, lambda_used, dev, nulldev, neff, iters = self._fit(
             X, y, w, offset, family, job)
         if pad_cols:  # strip padding: coefficients (all ~0) and design cols
@@ -443,6 +607,12 @@ class GLM(ModelBuilder):
         output.scoring_history = [{"iterations": iters, "lambda": lambda_used,
                                    "deviance": float(dev)}]
         output.variable_importances = self._varimp_from_beta(dinfo, beta)
+        if family.name in ("gaussian", "gamma", "tweedie", "negativebinomial",
+                           "quasibinomial"):
+            mu = raw if raw.ndim == 1 else raw[:, -1]
+            model.dispersion_estimated = _estimate_dispersion(
+                p, family, ym, mu, np.asarray(w), float(dev), float(neff),
+                len(beta))
         if p.compute_p_values:
             self._compute_p_values(model, X, y, w, offset, family, beta,
                                    float(dev), float(neff))
@@ -462,7 +632,11 @@ class GLM(ModelBuilder):
         Gn = np.asarray(G, np.float64)
         rank = len(beta)
         gaussian = family.name == "gaussian"
-        dispersion = dev / max(neff - rank, 1.0) if gaussian else 1.0
+        # families with a free dispersion parameter scale the covariance by
+        # the estimate (`hex/glm/GLM.java` computeSubmodel p-values path)
+        est = getattr(model, "dispersion_estimated", None)
+        dispersion = (est if est is not None
+                      else dev / max(neff - rank, 1.0) if gaussian else 1.0)
         try:
             cov = np.linalg.inv(Gn + 1e-10 * np.eye(Gn.shape[0])) * dispersion
         except np.linalg.LinAlgError:
@@ -531,6 +705,12 @@ class GLM(ModelBuilder):
             lambdas = [p.lambda_ if p.lambda_ is not None else 0.0]
 
         if p.solver and p.solver.upper() in ("L_BFGS", "LBFGS"):
+            if getattr(self, "_bounds", None) is not None:
+                # reference restriction: L-BFGS has no projection step
+                # (`hex/glm/GLM.java` beta constraints require IRLSM/COD)
+                raise ValueError("beta_constraints are not supported with "
+                                 "solver=L_BFGS — use IRLSM or "
+                                 "COORDINATE_DESCENT")
             # walk the full lambda path warm-started, like the IRLSM branch
             iters_total = 0
             result = None
@@ -561,6 +741,9 @@ class GLM(ModelBuilder):
                 if p.non_negative:
                     nb = beta_new[:-1]
                     beta_new[:-1] = np.clip(nb, 0, None)
+                if getattr(self, "_bounds", None) is not None:
+                    lo, hi = self._bounds
+                    beta_new = np.clip(beta_new, lo, hi)
                 diff = np.max(np.abs(beta_new - beta)) if it else np.inf
                 beta = beta_new
                 if diff < p.beta_epsilon:

@@ -1,0 +1,153 @@
+"""EasyPredictModelWrapper — the row-at-a-time production scoring façade.
+
+Reference: `h2o-genmodel/src/main/java/hex/genmodel/easy/
+EasyPredictModelWrapper.java` + the typed prediction classes under
+`hex/genmodel/easy/prediction/*`. A loaded MOJO scores batched matrices
+(`reader.MojoModel.score`); this wrapper adds the deployment-side surface:
+RowData dicts with string categorical levels, per-category typed results,
+and unknown-level handling (`convertUnknownCategoricalLevelsToNa`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .reader import MojoModel
+
+
+@dataclass
+class RegressionModelPrediction:
+    value: float = 0.0
+
+
+@dataclass
+class BinomialModelPrediction:
+    label: str = ""
+    labelIndex: int = 0
+    classProbabilities: list = field(default_factory=list)
+
+
+@dataclass
+class MultinomialModelPrediction:
+    label: str = ""
+    labelIndex: int = 0
+    classProbabilities: list = field(default_factory=list)
+
+
+@dataclass
+class ClusteringModelPrediction:
+    cluster: int = 0
+
+
+@dataclass
+class AnomalyDetectionPrediction:
+    score: float = 0.0
+    normalizedScore: float = 0.0
+
+
+@dataclass
+class DimReductionModelPrediction:
+    dimensions: list = field(default_factory=list)
+
+
+class PredictUnknownCategoricalLevelException(ValueError):
+    def __init__(self, message, column, level):
+        super().__init__(message)
+        self.column = column
+        self.level = level
+
+
+class EasyPredictModelWrapper:
+    """Row-dict scoring over a loaded MOJO (`EasyPredictModelWrapper.java`)."""
+
+    def __init__(self, model: MojoModel | str,
+                 convert_unknown_categorical_levels_to_na: bool = False):
+        if isinstance(model, str):
+            model = MojoModel.load(model)
+        self.model = model
+        self.convert_unknown = convert_unknown_categorical_levels_to_na
+        self._features = (model.columns[:-1] if model.supervised
+                          else model.columns)
+        self._feat_domains = model.domains[:len(self._features)]
+        self._resp_domain = (model.domains[-1]
+                             if model.supervised else None)
+        self.unknown_categorical_levels_seen: dict[str, int] = {}
+
+    # -- row encoding (`easy/RowToRawDataConverter.java`) --------------------
+    def _encode_row(self, row: dict) -> np.ndarray:
+        x = np.full(len(self._features), np.nan)
+        for i, (name, dom) in enumerate(zip(self._features,
+                                            self._feat_domains)):
+            if name not in row or row[name] is None:
+                continue
+            v = row[name]
+            if dom is not None:
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    x[i] = float(v)  # pre-encoded level index
+                    continue
+                v = str(v)
+                try:
+                    x[i] = dom.index(v)
+                except ValueError:
+                    if not self.convert_unknown:
+                        raise PredictUnknownCategoricalLevelException(
+                            f"Unknown categorical level ({name},{v})",
+                            name, v)
+                    self.unknown_categorical_levels_seen[name] = (
+                        self.unknown_categorical_levels_seen.get(name, 0) + 1)
+            else:
+                x[i] = float(v)
+        return x
+
+    def _score_row(self, row: dict) -> np.ndarray:
+        out = self.model.score(self._encode_row(row)[None, :])
+        return np.atleast_1d(np.asarray(out)[0])
+
+    # -- typed per-category entry points -------------------------------------
+    def predict_regression(self, row: dict) -> RegressionModelPrediction:
+        out = self._score_row(row)
+        return RegressionModelPrediction(value=float(out[-1] if out.ndim
+                                                     else out))
+
+    def predict_binomial(self, row: dict) -> BinomialModelPrediction:
+        out = self._score_row(row)
+        probs = [float(p) for p in out[1:]]
+        idx = int(out[0])
+        dom = self._resp_domain or [str(i) for i in range(len(probs))]
+        return BinomialModelPrediction(label=dom[idx], labelIndex=idx,
+                                       classProbabilities=probs)
+
+    def predict_multinomial(self, row: dict) -> MultinomialModelPrediction:
+        b = self.predict_binomial(row)
+        return MultinomialModelPrediction(label=b.label,
+                                          labelIndex=b.labelIndex,
+                                          classProbabilities=b.classProbabilities)
+
+    def predict_clustering(self, row: dict) -> ClusteringModelPrediction:
+        out = self._score_row(row)
+        return ClusteringModelPrediction(cluster=int(out[0]))
+
+    def predict_anomaly_detection(self, row: dict) -> AnomalyDetectionPrediction:
+        out = self._score_row(row)
+        score = float(out[0])
+        norm = float(out[1]) if out.shape[0] > 1 else score
+        return AnomalyDetectionPrediction(score=score, normalizedScore=norm)
+
+    def predict_dim_reduction(self, row: dict) -> DimReductionModelPrediction:
+        out = self._score_row(row)
+        return DimReductionModelPrediction(
+            dimensions=[float(v) for v in out])
+
+    def predict(self, row: dict):
+        """Category-dispatched prediction (`EasyPredictModelWrapper.predict`)."""
+        cat = (self.model.category or "").lower()
+        return {
+            "regression": self.predict_regression,
+            "binomial": self.predict_binomial,
+            "multinomial": self.predict_multinomial,
+            "clustering": self.predict_clustering,
+            "anomalydetection": self.predict_anomaly_detection,
+            "dimreduction": self.predict_dim_reduction,
+        }.get(cat, self.predict_regression)(row)

@@ -47,10 +47,36 @@ def kurtosis(v: Vec, na_rm: bool = True) -> float:
     return float(m4 / (m2 * m2))
 
 
+def _rank_frame(fr: Frame) -> Frame:
+    """Average-rank transform per column (ties → midranks), the Spearman
+    front-end (`advmath/SpearmanCorrelation.java` rank MRTask)."""
+    from scipy.stats import rankdata
+
+    cols = {}
+    for n in fr.names:
+        x = fr.vec(n).to_numpy()
+        r = np.full_like(x, np.nan, dtype=np.float64)
+        ok = ~np.isnan(x)
+        r[ok] = rankdata(x[ok])
+        cols[n] = r.astype(np.float32)
+    return Frame(list(fr.names), [Vec.from_numpy(v) for v in cols.values()])
+
+
 def cor(fx: Frame, fy: Frame, use: str = "everything",
         method: str = "Pearson"):
-    """Pairwise Pearson correlation; complete-rows handling like the
+    """Pairwise Pearson/Spearman correlation; complete-rows handling like the
     reference's 'complete.obs'. Returns a float for 1x1, else a Frame."""
+    if str(method).lower().startswith("spearman"):
+        # Spearman = Pearson over midrank-transformed columns; ranks are
+        # computed AFTER dropping incomplete rows so they stay contiguous
+        # (matches R's complete.obs and `SpearmanCorrelation.java`)
+        ok = np.ones(fx.vec(0).nrow, dtype=bool)
+        for f in (fx, fy):
+            for i in range(f.ncol):
+                ok &= ~np.isnan(f.vec(i).to_numpy())
+        idx = np.where(ok)[0]
+        return cor(_rank_frame(fx.take(idx)), _rank_frame(fy.take(idx)),
+                   use, "Pearson")
     Xc = [fx.vec(i) for i in range(fx.ncol)]
     Yc = [fy.vec(i) for i in range(fy.ncol)]
     X = jnp.stack([c.data for c in Xc], axis=1)
@@ -502,6 +528,49 @@ def topn(fr: Frame, col: int, npercent: float, bottom: bool = False) -> Frame:
     return Frame(["Row Indices", f"{name} {fr.names[int(col)]} values"],
                  [Vec.from_numpy(pick, type=T_INT),
                   Vec.from_numpy(x[pick])])
+
+
+# ---------------------------------------------------------------------------
+# factor interactions (`hex/Interaction` / `h2o.interaction`)
+# ---------------------------------------------------------------------------
+def interaction(fr: Frame, factors, pairwise: bool = False,
+                max_factors: int = 100, min_occurrence: int = 1) -> Frame:
+    """Combined categorical columns from factor tuples: top `max_factors`
+    observed combos (≥ min_occurrence) become levels, the tail becomes
+    'other'."""
+    factors = [factors] if isinstance(factors, str) else list(factors)
+    names = [fr.names[int(f)] if isinstance(f, float) else f for f in factors]
+    groups = ([[a, b] for i, a in enumerate(names) for b in names[i + 1:]]
+              if pairwise and len(names) > 2 else [names])
+    out = Frame([], [])
+    for grp in groups:
+        vs = [fr.vec(n) for n in grp]
+        for v, n in zip(vs, grp):
+            if not v.is_categorical():
+                raise ValueError(f"interaction: column '{n}' is not "
+                                 f"categorical")
+        # vectorized combo coding: stack code columns, NA row-mask, then one
+        # np.unique over complete rows builds the observed-combo table
+        codes = np.stack([v.to_numpy() for v in vs], axis=1)
+        ok = ~np.isnan(codes).any(axis=1)
+        combos = codes[ok].astype(np.int64)
+        uniq, inverse, counts = np.unique(
+            combos, axis=0, return_inverse=True, return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        keep_n = int(min(max_factors,
+                         int(np.sum(counts >= min_occurrence))))
+        kept_ids = order[:keep_n][counts[order[:keep_n]] >= min_occurrence]
+        dom = ["_".join(v.domain[c] for v, c in zip(vs, uniq[u]))
+               for u in kept_ids]
+        has_other = len(uniq) > len(kept_ids)
+        if has_other:
+            dom.append("other")
+        remap = np.full(len(uniq), float(len(kept_ids)))  # default → other
+        remap[kept_ids] = np.arange(len(kept_ids), dtype=np.float64)
+        col = np.full(fr.nrow, np.nan, dtype=np.float32)
+        col[ok] = remap[inverse]
+        out.add("_".join(grp), Vec.from_numpy(col, type=T_CAT, domain=dom))
+    return out
 
 
 # ---------------------------------------------------------------------------

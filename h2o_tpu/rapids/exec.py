@@ -26,6 +26,7 @@ from ..backend.kvstore import STORE
 from ..frame.frame import Frame
 from ..frame.vec import T_CAT, Vec
 from . import advmath
+from . import mungers
 from . import strings as strmod
 from .groupby import group_by
 from .merge import merge as merge_fn, sort as sort_fn
@@ -193,6 +194,7 @@ class Rapids:
 
     def __init__(self, session: Session | None = None):
         self.session = session or Session()
+        self._scopes: list[dict] = []  # lambda parameter bindings
 
     # -- public entry (`Rapids.exec`) ----------------------------------------
     def exec(self, expr: str):
@@ -210,6 +212,19 @@ class Rapids:
             return val
         if kind == "list":
             return [self._eval(x) for x in val]
+        if kind == "fun":
+            # { id1 id2 . body } — `water/rapids/ast/AstFunction.java`
+            params, body, seen_dot = [], None, False
+            for item in val:
+                if item == ("id", "."):
+                    seen_dot = True
+                elif not seen_dot:
+                    params.append(item[1])
+                else:
+                    body = item
+            if body is None:
+                raise ValueError("lambda without body: { ids . expr }")
+            return RLambda(self, params, body)
         if kind == "id":
             lit = {"true": 1.0, "TRUE": 1.0, "True": 1.0,
                    "false": 0.0, "FALSE": 0.0, "False": 0.0,
@@ -218,6 +233,9 @@ class Rapids:
                    "_": None}  # h2o-py placeholder for defaulted args
             if val in lit:
                 return lit[val]
+            for scope in reversed(self._scopes):
+                if val in scope:
+                    return scope[val]
             obj = self.session.lookup(val)
             if obj is None:
                 raise KeyError(f"rapids: unknown id '{val}'")
@@ -249,11 +267,203 @@ class Rapids:
         return fn(self, *args)
 
 
+class RLambda:
+    """A parsed `{ ids . body }` function value (`AstFunction.java`)."""
+
+    def __init__(self, rapids: "Rapids", params: list[str], body):
+        self.rapids = rapids
+        self.params = params
+        self.body = body
+
+    def __call__(self, *vals):
+        self.rapids._scopes.append(dict(zip(self.params, vals)))
+        try:
+            return self.rapids._eval(self.body)
+        finally:
+            self.rapids._scopes.pop()
+
+
+# row-wise vectorized fast path for `apply` margin=1 lambdas of the form
+# { x . (op x [na_rm]) } — one fused reduction instead of a per-row loop.
+# Keyed (op, na_rm) so NA semantics match _prim_reduce exactly: na_rm=False
+# (the reducer default) propagates NaN through the row.
+_ROW_REDUCERS = {
+    ("mean", True): lambda M: np.nanmean(M, axis=1),
+    ("mean", False): lambda M: np.mean(M, axis=1),
+    ("sum", True): lambda M: np.nansum(M, axis=1),
+    ("sum", False): lambda M: np.sum(M, axis=1),
+    ("min", True): lambda M: np.nanmin(M, axis=1),
+    ("min", False): lambda M: np.min(M, axis=1),
+    ("max", True): lambda M: np.nanmax(M, axis=1),
+    ("max", False): lambda M: np.max(M, axis=1),
+    ("median", True): lambda M: np.nanmedian(M, axis=1),
+    ("median", False): lambda M: np.median(M, axis=1),
+    ("sd", True): lambda M: np.nanstd(M, axis=1, ddof=1),
+    ("sd", False): lambda M: np.std(M, axis=1, ddof=1),
+    ("var", True): lambda M: np.nanvar(M, axis=1, ddof=1),
+    ("var", False): lambda M: np.var(M, axis=1, ddof=1),
+}
+
+_NA_RM_LITERALS = {("id", "true"): True, ("id", "TRUE"): True,
+                   ("id", "True"): True, ("num", 1.0): True,
+                   ("id", "false"): False, ("id", "FALSE"): False,
+                   ("id", "False"): False, ("num", 0.0): False}
+
+
+def _apply(R, fr, margin, fun):
+    """(apply fr margin fun) — `AstApply.java`: 1 = rows, 2 = columns."""
+    fr = _as_frame(fr)
+    margin = int(margin)
+    if not isinstance(fun, RLambda):
+        raise ValueError("apply expects a function {x . body}")
+    if margin == 2:
+        results = [fun(Frame([n], [fr.vec(n)])) for n in fr.names]
+        if all(isinstance(r, (int, float)) for r in results):
+            return Frame(fr.names, [Vec.from_numpy(np.asarray([r]))
+                                    for r in results])
+        cols = []
+        for n, r in zip(fr.names, results):
+            v = _as_vec(r) if isinstance(r, (Frame, Vec)) else Vec.from_numpy(
+                np.asarray([float(r)]))
+            cols.append(v)
+        nr = max(v.nrow for v in cols)
+        cols = [v if v.nrow == nr else Vec.from_numpy(
+            np.resize(v.to_numpy(), nr)) for v in cols]
+        return Frame(list(fr.names), cols)
+    if margin != 1:
+        raise ValueError("apply margin must be 1 (rows) or 2 (cols)")
+    body = fun.body
+    if (body[0] == "exec" and len(body[1]) in (2, 3)
+            and body[1][1] == ("id", fun.params[0])
+            and (len(body[1]) == 2 or body[1][2] in _NA_RM_LITERALS)):
+        na_rm = (_NA_RM_LITERALS[body[1][2]] if len(body[1]) == 3
+                 else False)  # _prim_reduce's na_rm default
+        red = _ROW_REDUCERS.get((body[1][0][1], na_rm))
+        if red is not None:
+            M = np.asarray(fr.as_matrix())[: fr.nrow]
+            return Frame(["apply"], [Vec.from_numpy(red(M))])
+    # general path: per-row evaluation (host loop; reference runs an MRTask).
+    # The row binds as a single column of its values (ValRow semantics: row
+    # reducers fold across the row's cells).
+    M = np.asarray(fr.as_matrix())[: fr.nrow]
+    rows = []
+    for i in range(fr.nrow):
+        r = fun(Frame(["row"], [Vec.from_numpy(M[i, :])]))
+        if isinstance(r, (Frame, Vec)):
+            r = [float(x) for x in _as_vec(r).to_numpy()]
+        rows.append(r if isinstance(r, list) else [float(r)])
+    width = max(len(r) for r in rows)
+    out = np.full((fr.nrow, width), np.nan)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return Frame([f"C{j + 1}" for j in range(width)] if width > 1
+                 else ["apply"],
+                 [Vec.from_numpy(out[:, j]) for j in range(width)])
+
+
+def _ddply(R, fr, group_cols, fun):
+    """(ddply fr [cols] fun) — per-group lambda results (`AstDdply.java`)."""
+    fr = _as_frame(fr)
+    if not isinstance(fun, RLambda):
+        raise ValueError("ddply expects a function {x . body}")
+    gidx = _col_indices(fr, group_cols)
+    keys = [fr.vec(i).to_numpy() for i in gidx]
+    tags = {}
+    for r in range(fr.nrow):
+        t = tuple(np.nan if np.isnan(k[r]) else float(k[r]) for k in keys)
+        tags.setdefault(t, []).append(r)
+    grows, rrows = [], []
+    for t, idx in sorted(tags.items(),
+                         key=lambda kv: tuple(
+                             (np.inf if x != x else x) for x in kv[0])):
+        sub = fr.take(np.asarray(idx))
+        r = fun(sub)
+        if isinstance(r, (Frame, Vec)):
+            r = [float(x) for x in _as_vec(r).to_numpy()]
+        grows.append(list(t))
+        rrows.append(r if isinstance(r, list) else [float(r)])
+    width = max(len(r) for r in rrows) if rrows else 1
+    names = [fr.names[i] for i in gidx] + [f"ddply_C{j + 1}"
+                                           for j in range(width)]
+    cols = []
+    for j in range(len(gidx)):
+        src = fr.vec(gidx[j])
+        cols.append(Vec.from_numpy(
+            np.asarray([g[j] for g in grows], dtype=np.float32),
+            type=src.type, domain=src.domain))
+    for j in range(width):
+        cols.append(Vec.from_numpy(np.asarray(
+            [r[j] if j < len(r) else np.nan for r in rrows])))
+    return Frame(names, cols)
+
+
+def _append_prim(R, dst, *rest):
+    """(append dst (src name)+ ) — `AstAppend.java`."""
+    out = _as_frame(dst)
+    if len(rest) % 2:
+        raise ValueError("append needs (src, name) pairs")
+    for i in range(0, len(rest), 2):
+        out = mungers.append(out, rest[i], str(rest[i + 1]))
+    return out
+
+
+def _rect_assign_prim(R, dst, src, cols, rows=None):
+    """(:= dst src col_expr row_expr) — `AstRectangleAssign.java`."""
+    fr = _as_frame(dst)
+    cidx = _col_indices(fr, cols) if cols not in ([],) else []
+    if not cidx:  # "empty really means all"
+        cidx = list(range(fr.ncol))
+    return mungers.rectangle_assign(fr, src, cidx, _row_mask(fr, rows))
+
+
+def _rename_key(R, old: str, new: str):
+    """(rename "old" "new") — rename a DKV key (`AstRename.java`)."""
+    obj = R.session.lookup(old)
+    if obj is None:
+        raise KeyError(f"rename: no such key '{old}'")
+    R.session.temps.pop(old, None)
+    STORE.remove(old, cascade=False)
+    obj.key = new
+    STORE.put(new, obj)
+    return float("nan")
+
+
+def _sumaxis(fr: Frame, na_rm: bool, axis: int):
+    """(sumaxis fr na_rm axis) — per-column (0) or per-row (1) sums."""
+    M = np.asarray(fr.as_matrix())[: fr.nrow]
+    red = np.nansum if na_rm else np.sum
+    if axis == 1:
+        return Frame(["sum"], [Vec.from_numpy(red(M, axis=1))])
+    return Frame(list(fr.names),
+                 [Vec.from_numpy(np.asarray([red(M[:, j])]))
+                  for j in range(fr.ncol)])
+
+
 # ---------------------------------------------------------------------------
 # primitive table (`water/rapids/ast/prims/**` subset)
 # ---------------------------------------------------------------------------
+# numpy ufuncs so scalar edge cases match the vector path: (/ 1 0) → inf,
+# (%% x 0) → nan, (^ -1 0.5) → nan — never a Python ZeroDivisionError
+_SCALAR_BINOPS = {
+    "+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide,
+    "^": np.float_power, "%%": np.mod,
+    "intDiv": lambda a, b: np.floor(np.divide(a, b)),
+    "==": np.equal, "!=": np.not_equal,
+    "<": np.less, "<=": np.less_equal,
+    ">": np.greater, ">=": np.greater_equal,
+    "&": lambda a, b: (a != 0) & (b != 0),
+    "|": lambda a, b: (a != 0) | (b != 0),
+    "&&": lambda a, b: (a != 0) & (b != 0),
+    "||": lambda a, b: (a != 0) | (b != 0),
+}
+
+
 def _prim_binop(op):
     def fn(R, l, r):
+        if isinstance(l, (int, float)) and isinstance(r, (int, float)):
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return float(_SCALAR_BINOPS[op](np.float64(l),
+                                                np.float64(r)))
         return binop(op, _as_vec(l), _as_vec(r))
     return fn
 
@@ -328,9 +538,10 @@ _PRIMS = {
        ("+", "-", "*", "/", "^", "%%", "intDiv", "==", "!=", "<", "<=", ">",
         ">=", "&", "|", "&&", "||")},
     **{op: _prim_unop(op) for op in
-       ("abs", "ceiling", "floor", "trunc", "exp", "log", "log10", "log2",
-        "sqrt", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
-        "tanh", "sign", "not")},
+       ("abs", "ceiling", "floor", "trunc", "exp", "expm1", "log", "log10",
+        "log2", "log1p", "sqrt", "sin", "cos", "tan", "asin", "acos", "atan",
+        "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "sign", "not",
+        "gamma", "lgamma", "digamma", "trigamma", "cospi", "sinpi", "tanpi")},
     "is.na": lambda R, v: unop("isna", _as_vec(v)),
     **{op: _prim_reduce(op) for op in
        ("min", "max", "sum", "mean", "median", "sd", "var", "prod", "all",
@@ -443,6 +654,66 @@ _PRIMS = {
             _as_frame(fr), g, s, asc, str(name)),
     "topn": lambda R, fr, col, pct, bottom=0.0:
         advmath.topn(_as_frame(fr), int(col), float(pct), bool(bottom)),
+    "interaction": lambda R, fr, factors, pairwise=False, mf=100, mo=1:
+        advmath.interaction(_as_frame(fr), factors, bool(pairwise), int(mf),
+                            int(mo)),
+    # third wave: mutation / repeaters / mungers (`prims/{assign,repeaters,
+    # mungers,filters,timeseries}`)
+    "append": _append_prim,
+    ":=": _rect_assign_prim,
+    "seq": lambda R, frm, to, by=1.0: mungers.seq(float(frm), float(to),
+                                                  float(by)),
+    "seq_len": lambda R, n: mungers.seq_len(n),
+    "rep_len": lambda R, x, n: mungers.rep_len(x, n),
+    "mode": lambda R, v: mungers.mode(_as_vec(v)),
+    "distance": lambda R, x, y, measure="l2": mungers.distance(
+        _as_frame(x), _as_frame(y), str(measure)),
+    "hist": lambda R, v, breaks="sturges": mungers.hist(_as_vec(v), breaks),
+    "modulo_kfold_column": lambda R, v, n: mungers.modulo_kfold_column(
+        _as_vec(v), int(n)),
+    "dropdup": lambda R, fr, cols, keep="first": mungers.dropdup(
+        _as_frame(fr), cols, str(keep)),
+    "h2o.mad": lambda R, fr, combine="interpolate", const=1.4826:
+        mungers.mad(_as_frame(fr), str(combine), float(const)),
+    "perfectAUC": lambda R, p, y: mungers.perfect_auc(_as_vec(p), _as_vec(y)),
+    "nlevels": lambda R, v: mungers.nlevels(_as_vec(v)),
+    "any.factor": lambda R, fr: mungers.any_factor(_as_frame(fr)),
+    "is.character": lambda R, v: float(_as_vec(v).is_string()),
+    "is.numeric": lambda R, v: float(_as_vec(v).is_numeric()
+                                     and not _as_vec(v).is_categorical()),
+    "columnsByType": lambda R, fr, t="numeric": mungers.columns_by_type(
+        _as_frame(fr), str(t)),
+    "rename": lambda R, old, new: _rename_key(R, str(old), str(new)),
+    "setLevel": lambda R, v, lvl: mungers.set_level(_as_vec(v), str(lvl)),
+    "appendLevels": lambda R, v, lvls: mungers.append_levels(_as_vec(v), lvls),
+    "relevel.by.freq": lambda R, v, topn=-1.0: mungers.relevel_by_freq(
+        _as_vec(v), int(topn)),
+    "getrow": lambda R, fr: mungers.getrow(_as_frame(fr)),
+    "flatten": lambda R, fr: mungers.flatten(_as_frame(fr)),
+    "as.Date": lambda R, v, fmt: mungers.as_date(_as_vec(v), str(fmt)),
+    "week": lambda R, v: mungers.week(_as_vec(v)),
+    "listTimeZones": lambda R: mungers.list_timezones(),
+    "getTimeZone": lambda R: mungers.get_timezone(),
+    "setTimeZone": lambda R, tz: mungers.set_timezone(str(tz)),
+    "isax": lambda R, fr, nw, mc, oc=0.0: mungers.isax(
+        _as_frame(fr), int(nw), int(mc), bool(oc)),
+    "num_valid_substrings": lambda R, v, path: mungers.num_valid_substrings(
+        _as_vec(v), str(path)),
+    "apply": _apply,
+    "ddply": _ddply,
+    "tf-idf": lambda R, fr, did, tid, pre=True, cs=True: mungers.tf_idf(
+        _as_frame(fr), int(did), int(tid), bool(pre), bool(cs)),
+    # NA-tolerant reducer aliases + axis/count reducers (`prims/reducers`)
+    **{alias: _prim_reduce(base) for alias, base in
+       (("sumNA", "sum"), ("maxNA", "max"), ("minNA", "min"),
+        ("prod.na", "prod"))},
+    "sumaxis": lambda R, fr, na_rm=False, axis=0.0: _sumaxis(
+        _as_frame(fr), bool(na_rm), int(axis)),
+    "naCnt": lambda R, fr: [float(v.nacnt())
+                            for v in _as_frame(fr).vecs],
+    "any.na": lambda R, fr: float(any(v.nacnt() > 0
+                                      for v in _as_frame(fr).vecs)),
+    "%/%": _prim_binop("intDiv"),
     # uniform random column keyed to the frame's rows (`AstRunif`) — the
     # h2o-py split_frame building block
     "h2o.runif": lambda R, fr, seed=-1: (lambda f: Vec.from_numpy(

@@ -114,6 +114,10 @@ _UNARY = {
         jnp.where(x > 0, 1.0, jnp.cos(jnp.pi * jnp.floor(x)))),
     "lgamma": jax.scipy.special.gammaln,
     "digamma": jax.scipy.special.digamma,
+    "trigamma": lambda x: jax.scipy.special.polygamma(1, x),
+    "cospi": lambda x: jnp.cos(jnp.pi * x),
+    "sinpi": lambda x: jnp.sin(jnp.pi * x),
+    "tanpi": lambda x: jnp.tan(jnp.pi * x),
     "not": lambda x: jnp.where(jnp.isnan(x), jnp.nan, (x == 0).astype(jnp.float32)),
     "isna": None,  # special-cased (NA -> 1, never NA)
 }

@@ -1,0 +1,81 @@
+"""Device microbenchmarks — `water/init/{Linpack,MemoryBandwidth,NetworkBench}`
+analogs, re-targeted at what actually bounds a TPU node: MXU matmul throughput
+(Linpack), HBM streaming bandwidth (MemoryBandwidth), and all-reduce bandwidth
+over the mesh axis (NetworkBench rode the node-to-node sockets; here the
+collective rides ICI — SURVEY.md §2.5 mapping). Served at `/3/NetworkTest`
+like the reference's `water/api/NetworkTestHandler`.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timeit(fn, warmup: int = 1, iters: int = 3) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def linpack_gflops(n: int = 2048) -> float:
+    """MXU matmul throughput in GFLOP/s (`water/init/Linpack.java` solved an
+    LU system; on TPU the representative FLOP engine is the matmul)."""
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+    mm = jax.jit(lambda x, y: (x @ y).astype(jnp.float32).sum())
+    sec = _timeit(lambda: mm(a, b))
+    return 2.0 * n * n * n / sec / 1e9
+
+
+def memory_bandwidth_gbs(mb: int = 256) -> float:
+    """HBM streaming bandwidth in GB/s (`water/init/MemoryBandwidth.java`):
+    one read + one write stream via an on-device copy-scale kernel."""
+    n = mb * 1024 * 1024 // 4
+    x = jnp.ones((n,), jnp.float32)
+    k = jax.jit(lambda v: v * 1.0000001)
+    sec = _timeit(lambda: k(x))
+    return 2.0 * n * 4 / sec / 1e9
+
+
+def collective_bandwidth_gbs(mb: int = 64) -> dict:
+    """All-reduce bandwidth across the device mesh (`water/init/NetworkBench`
+    measured point-to-point sockets; the TPU data plane is the psum over
+    ICI). Returns bytes/s per message size; single-device meshes report the
+    degenerate (no-transfer) case."""
+    from ..parallel import mesh as meshmod
+    from jax.sharding import PartitionSpec as P
+
+    mesh = meshmod.default_mesh()
+    ndev = int(np.prod(list(mesh.shape.values())))
+    axis = list(mesh.shape.keys())[0]
+    n = mb * 1024 * 1024 // 4
+
+    def allreduce(x):
+        return jax.lax.psum(x, axis)
+
+    fn = jax.jit(
+        jax.shard_map(allreduce, mesh=mesh, in_specs=P(axis), out_specs=P()))
+    x = jnp.ones((max(n // max(ndev, 1), 1) * ndev,), jnp.float32)
+    sec = _timeit(lambda: fn(x))
+    # ring all-reduce moves 2(k-1)/k of the payload per device
+    payload = x.nbytes * (2 * (ndev - 1) / max(ndev, 1) if ndev > 1 else 1.0)
+    return {"devices": ndev, "message_mb": mb,
+            "gbytes_per_sec": payload / sec / 1e9,
+            "microseconds": sec * 1e6}
+
+
+def network_test() -> dict:
+    """`/3/NetworkTest` payload: the three microbenchmarks in one sweep."""
+    return {
+        "linpack_gflops": linpack_gflops(1024),
+        "memory_bandwidth_gbs": memory_bandwidth_gbs(64),
+        "collective": collective_bandwidth_gbs(16),
+    }

@@ -1,0 +1,28 @@
+"""Bindings codegen (`h2o-bindings/bin/gen_python.py` analog)."""
+
+import sys
+
+from h2o_tpu.bindings.gen_python import generate, generate_source
+
+
+def test_generate_source_covers_registry():
+    from h2o_tpu.models import registry
+
+    src = generate_source()
+    for algo in registry.algo_names():
+        assert f'algo = "{algo}"' in src
+
+
+def test_generated_module_importable(tmp_path):
+    path = generate(str(tmp_path))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import estimators_gen as eg
+        e = eg.H2OGradientBoostingEstimator(ntrees=3, max_depth=2)
+        assert e.algo == "gbm"
+        assert e._params["ntrees"] == 3
+        assert "__class__" not in e._params
+        assert hasattr(eg, "H2OKMeansEstimator")
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("estimators_gen", None)

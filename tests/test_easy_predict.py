@@ -1,0 +1,112 @@
+"""EasyPredictModelWrapper row-API (`hex/genmodel/easy/
+EasyPredictModelWrapper.java` + typed prediction classes)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.models.kmeans import KMeans, KMeansParameters
+from h2o_tpu.mojo.easy import (BinomialModelPrediction,
+                               EasyPredictModelWrapper,
+                               PredictUnknownCategoricalLevelException,
+                               RegressionModelPrediction)
+
+
+def _frame(n=300, seed=1, binomial=True):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n).astype(np.float32)
+    cat = rng.integers(0, 3, size=n).astype(np.float32)
+    logits = x1 + 0.8 * (cat - 1)
+    if binomial:
+        lab = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+        yvec = Vec.from_numpy(lab, type=T_CAT, domain=["no", "yes"])
+    else:
+        yvec = Vec.from_numpy(logits + rng.normal(
+            scale=0.1, size=n).astype(np.float32))
+    return Frame(["x1", "cat", "y"],
+                 [Vec.from_numpy(x1),
+                  Vec.from_numpy(cat, type=T_CAT, domain=["a", "b", "c"]),
+                  yvec])
+
+
+@pytest.fixture(scope="module")
+def binomial_mojo(tmp_path_factory):
+    fr = _frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=10,
+                          max_depth=3, seed=1)).train_model()
+    path = str(tmp_path_factory.mktemp("mojo") / "gbm.zip")
+    m.save_mojo(path)
+    return m, fr, path
+
+
+def test_binomial_row_prediction(binomial_mojo):
+    m, fr, path = binomial_mojo
+    wrapper = EasyPredictModelWrapper(path)
+    pred = wrapper.predict_binomial({"x1": 1.5, "cat": "b"})
+    assert isinstance(pred, BinomialModelPrediction)
+    assert pred.label in ("no", "yes")
+    assert len(pred.classProbabilities) == 2
+    assert abs(sum(pred.classProbabilities) - 1.0) < 1e-6
+    # matches the engine's batch prediction for the same row
+    one = Frame(["x1", "cat"],
+                [Vec.from_numpy(np.array([1.5], np.float32)),
+                 Vec.from_numpy(np.array([1.0], np.float32), type=T_CAT,
+                                domain=["a", "b", "c"])])
+    p1 = m.predict(one).vec(2).to_numpy()[0]
+    assert abs(pred.classProbabilities[1] - p1) < 1e-5
+    # category-dispatched generic predict
+    auto = wrapper.predict({"x1": 1.5, "cat": "b"})
+    assert auto.classProbabilities == pred.classProbabilities
+
+
+def test_unknown_level_handling(binomial_mojo):
+    _, _, path = binomial_mojo
+    strict = EasyPredictModelWrapper(path)
+    with pytest.raises(PredictUnknownCategoricalLevelException):
+        strict.predict_binomial({"x1": 0.0, "cat": "zebra"})
+    lenient = EasyPredictModelWrapper(
+        path, convert_unknown_categorical_levels_to_na=True)
+    pred = lenient.predict_binomial({"x1": 0.0, "cat": "zebra"})
+    assert len(pred.classProbabilities) == 2
+    assert lenient.unknown_categorical_levels_seen == {"cat": 1}
+
+
+def test_missing_value_row(binomial_mojo):
+    _, _, path = binomial_mojo
+    wrapper = EasyPredictModelWrapper(path)
+    pred = wrapper.predict_binomial({"x1": None})  # cat absent, x1 None
+    assert len(pred.classProbabilities) == 2
+
+
+def test_regression_row_prediction(tmp_path):
+    fr = _frame(binomial=False)
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=5,
+                          max_depth=3, seed=2)).train_model()
+    path = str(tmp_path / "reg.zip")
+    m.save_mojo(path)
+    wrapper = EasyPredictModelWrapper(path)
+    pred = wrapper.predict_regression({"x1": 0.3, "cat": "a"})
+    assert isinstance(pred, RegressionModelPrediction)
+    one = Frame(["x1", "cat"],
+                [Vec.from_numpy(np.array([0.3], np.float32)),
+                 Vec.from_numpy(np.array([0.0], np.float32), type=T_CAT,
+                                domain=["a", "b", "c"])])
+    assert abs(pred.value - m.predict(one).vec(0).to_numpy()[0]) < 1e-5
+
+
+def test_clustering_row_prediction(tmp_path):
+    fr = Frame.from_dict({
+        "x": np.concatenate([np.zeros(50), np.ones(50) * 10]).astype(
+            np.float32),
+        "z": np.concatenate([np.zeros(50), np.ones(50) * 10]).astype(
+            np.float32)})
+    m = KMeans(KMeansParameters(training_frame=fr, k=2,
+                                seed=1)).train_model()
+    path = str(tmp_path / "km.zip")
+    m.save_mojo(path)
+    wrapper = EasyPredictModelWrapper(path)
+    a = wrapper.predict_clustering({"x": 0.0, "z": 0.0}).cluster
+    b = wrapper.predict_clustering({"x": 10.0, "z": 10.0}).cluster
+    assert {a, b} == {0, 1}

@@ -251,3 +251,97 @@ def test_glm_ordinal_proportional_odds():
     order = np.argsort(x)
     p_high = probs[order, 2]
     assert p_high[-1] > 0.8 and p_high[0] < 0.2
+
+
+def test_beta_constraints_box():
+    """`hex/glm/GLM.BetaConstraint`: box constraints honored on the natural
+    scale, for both IRLSM and L-BFGS."""
+    rng = np.random.default_rng(0)
+    n = 2000
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (3.0 * x1 - 2.0 * x2 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y})
+    bc = {"names": ["x1", "x2"], "lower_bounds": [0.0, -1.0],
+          "upper_bounds": [1.5, 1.0]}
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0, solver="IRLSM",
+                          beta_constraints=bc)).train_model()
+    coef = m.coef()
+    assert 0.0 - 1e-6 <= coef["x1"] <= 1.5 + 1e-3, coef
+    assert -1.0 - 1e-3 <= coef["x2"] <= 1.0 + 1e-6, coef
+    # bounds bind: the unconstrained optimum (3, -2) is outside the box
+    assert coef["x1"] > 1.3 and coef["x2"] < -0.8
+    # L-BFGS has no projection step: reference restriction surfaces as error
+    with pytest.raises(ValueError):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0, solver="L_BFGS",
+                          beta_constraints=bc)).train_model()
+
+
+def test_beta_constraints_unknown_name():
+    fr = Frame.from_dict({"x": np.arange(10, dtype=np.float32),
+                          "y": np.arange(10, dtype=np.float32)})
+    with pytest.raises(ValueError):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian",
+                          beta_constraints={"names": ["zzz"]})).train_model()
+
+
+def test_dispersion_pearson_gaussian_matches_mse():
+    rng = np.random.default_rng(1)
+    n = 1000
+    x = rng.normal(size=n).astype(np.float32)
+    y = (2 * x + 0.5 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0)).train_model()
+    # gaussian pearson dispersion == residual variance estimate ~ 0.25
+    assert abs(m.dispersion_estimated - 0.25) < 0.05
+
+
+def test_dispersion_gamma_ml_and_pearson():
+    rng = np.random.default_rng(2)
+    n = 4000
+    x = rng.normal(size=n).astype(np.float32)
+    shape = 4.0  # phi = 1/shape = 0.25
+    mu = np.exp(0.5 + 0.3 * x)
+    y = rng.gamma(shape, mu / shape).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    mp = GLM(GLMParameters(training_frame=fr, response_column="y",
+                           family="gamma", lambda_=0.0,
+                           dispersion_parameter_method="pearson")
+             ).train_model()
+    ml = GLM(GLMParameters(training_frame=fr, response_column="y",
+                           family="gamma", lambda_=0.0,
+                           dispersion_parameter_method="ml")).train_model()
+    assert abs(mp.dispersion_estimated - 0.25) < 0.06
+    assert abs(ml.dispersion_estimated - 0.25) < 0.04
+    fx = GLM(GLMParameters(training_frame=fr, response_column="y",
+                           family="gamma", lambda_=0.0,
+                           fix_dispersion_parameter=True,
+                           init_dispersion_parameter=0.7)).train_model()
+    assert fx.dispersion_estimated == 0.7
+
+
+def test_dispersion_tweedie_ml():
+    """Dunn-Smyth series ML recovers the simulated tweedie dispersion:
+    compound-poisson-gamma draw with p=1.5, phi=1."""
+    rng = np.random.default_rng(3)
+    n = 3000
+    mu = np.full(n, 2.0)
+    p_var, phi = 1.5, 1.0
+    # compound poisson-gamma simulation for Tw(p) — Dunn & Smyth param map
+    lam = mu ** (2 - p_var) / (phi * (2 - p_var))
+    alpha = (2 - p_var) / (p_var - 1)
+    gam_scale = phi * (p_var - 1) * mu ** (p_var - 1)
+    N = rng.poisson(lam)
+    y = np.array([rng.gamma(alpha * k, gam_scale[i]) if k > 0 else 0.0
+                  for i, k in enumerate(N)], dtype=np.float32)
+    fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32) * 1e-3,
+                          "y": y})
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="tweedie", tweedie_variance_power=p_var,
+                          lambda_=0.0,
+                          dispersion_parameter_method="ml")).train_model()
+    assert abs(m.dispersion_estimated - phi) < 0.25

@@ -206,3 +206,20 @@ def test_moment(rap):
     v = rap.exec("(moment 2020 1 2 0 0 0 0)")
     ms = v.to_numpy()[0]
     assert ms == np.datetime64("2020-01-02T00:00:00", "ms").astype("int64")
+
+
+def test_interaction(rap):
+    a = Vec.from_numpy(np.array([0, 0, 1, 1, 0], np.float32), type=T_CAT,
+                       domain=["x", "y"])
+    b = Vec.from_numpy(np.array([0, 1, 0, 1, np.nan], np.float32), type=T_CAT,
+                       domain=["u", "v"])
+    _put("ia", Frame(["a", "b"], [a, b]))
+    out = rap.exec("(interaction ia ['a' 'b'] false 100 1)")
+    v = out.vec("a_b")
+    assert v.is_categorical()
+    assert set(v.domain) == {"x_u", "x_v", "y_u", "y_v"}
+    assert np.isnan(v.to_numpy()[4])
+    # max_factors cap introduces 'other'
+    capped = rap.exec("(interaction ia ['a' 'b'] false 2 1)")
+    assert "other" in capped.vec("a_b").domain
+    assert len(capped.vec("a_b").domain) == 3

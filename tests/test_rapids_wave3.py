@@ -1,0 +1,241 @@
+"""Third-wave rapids prims — assign/repeaters/mungers/filters/timeseries
+(`water/rapids/ast/prims/{assign,repeaters,mungers,filters,timeseries,
+reducers,models}`), driven through the Lisp evaluator."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.backend.kvstore import STORE
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.rapids.exec import Rapids, Session
+
+
+@pytest.fixture
+def rap():
+    r = Rapids(Session())
+    yield r
+    r.session.end()
+
+
+def _put(name, fr):
+    fr.key = name
+    STORE.put(name, fr)
+    return fr
+
+
+def _num(rap, name, **cols):
+    return _put(name, Frame.from_dict(
+        {k: np.asarray(v, dtype=np.float32) for k, v in cols.items()}))
+
+
+def test_append_and_rect_assign(rap):
+    _num(rap, "fa", x=[1, 2, 3, 4], y=[10, 20, 30, 40])
+    out = rap.exec("(append fa 7 'z')")
+    assert out.names == ["x", "y", "z"]
+    np.testing.assert_allclose(out.vec("z").to_numpy(), 7.0)
+    # frame-valued source
+    out2 = rap.exec("(append fa (cols fa 'x') 'x2')")
+    np.testing.assert_allclose(out2.vec("x2").to_numpy(),
+                               out2.vec("x").to_numpy())
+    # rectangle assign: rows [1 2] of col 0 <- 99
+    out3 = rap.exec("(:= fa 99 [0] [1 2])")
+    np.testing.assert_allclose(out3.vec("x").to_numpy(), [1, 99, 99, 4])
+    # empty col list means all; empty row list means all rows
+    out4 = rap.exec("(:= fa NA [] [0])")
+    assert np.isnan(out4.vec("x").to_numpy()[0])
+    assert np.isnan(out4.vec("y").to_numpy()[0])
+
+
+def test_rect_assign_categorical_level(rap):
+    v = Vec.from_numpy(np.array([0, 1, 0], np.float32), type=T_CAT,
+                       domain=["a", "b"])
+    _put("fc", Frame(["c"], [v]))
+    out = rap.exec("(:= fc 'c' [0] [2])")
+    vv = out.vec("c")
+    assert vv.domain == ["a", "b", "c"]  # new level appended
+    assert vv.to_numpy()[2] == 2.0
+
+
+def test_seq_replen_mode(rap):
+    np.testing.assert_allclose(rap.exec("(seq 2 10 2)").to_numpy(),
+                               [2, 4, 6, 8, 10])
+    np.testing.assert_allclose(rap.exec("(seq_len 4)").to_numpy(),
+                               [1, 2, 3, 4])
+    np.testing.assert_allclose(rap.exec("(rep_len 1.5 3)").to_numpy(),
+                               [1.5, 1.5, 1.5])
+    v = Vec.from_numpy(np.array([0, 1, 1, 1, 2], np.float32), type=T_CAT,
+                       domain=["a", "b", "c"])
+    _put("fm", Frame(["c"], [v]))
+    assert rap.exec("(mode fm)") == 1.0
+
+
+def test_distance_and_hist(rap):
+    _num(rap, "dx", a=[0, 3], b=[0, 4])
+    _num(rap, "dy", a=[0.0], b=[0.0])
+    d = rap.exec("(distance dx dy 'l2')")
+    np.testing.assert_allclose(d.vec(0).to_numpy(), [0.0, 5.0], atol=1e-5)
+    _num(rap, "hx", x=list(range(100)))
+    h = rap.exec("(hist hx 'sturges')")
+    assert set(h.names) == {"breaks", "counts", "mids_true", "mids"}
+    assert h.vec("counts").to_numpy().sum() == 100
+    h2 = rap.exec("(hist hx 5)")
+    assert len(h2.vec("counts").to_numpy()) == 5
+
+
+def test_dropdup_and_modulo_kfold(rap):
+    _num(rap, "dd", k=[1, 1, 2, 2, 3], v=[9, 8, 7, 6, 5])
+    first = rap.exec("(dropdup dd [0] 'first')")
+    np.testing.assert_allclose(first.vec("v").to_numpy(), [9, 7, 5])
+    last = rap.exec("(dropdup dd [0] 'last')")
+    np.testing.assert_allclose(last.vec("v").to_numpy(), [8, 6, 5])
+    _num(rap, "mk", x=list(range(7)))
+    f = rap.exec("(modulo_kfold_column (cols mk 0) 3)")
+    np.testing.assert_allclose(f.to_numpy(), [0, 1, 2, 0, 1, 2, 0])
+
+
+def test_mad_perfect_auc(rap):
+    _num(rap, "md", x=[1, 2, 3, 4, 100])
+    # median=3, |x-3| = [2,1,0,1,97], median=1 → 1.4826
+    assert abs(rap.exec("(h2o.mad md 'interpolate' 1.4826)") - 1.4826) < 1e-5
+    _num(rap, "pa", p=[0.1, 0.4, 0.35, 0.8], y=[0, 0, 1, 1])
+    auc = rap.exec("(perfectAUC (cols pa 'p') (cols pa 'y'))")
+    assert abs(auc - 0.75) < 1e-9
+
+
+def test_domain_surgery(rap):
+    v = Vec.from_numpy(np.array([0, 1, 1, 2, 2, 2], np.float32), type=T_CAT,
+                       domain=["a", "b", "c"])
+    _put("ds", Frame(["c"], [v]))
+    assert rap.exec("(nlevels ds)") == 3.0
+    assert rap.exec("(any.factor ds)") == 1.0
+    lv = rap.exec("(setLevel ds 'b')")
+    assert set(lv.to_numpy()) == {1.0}
+    ap = rap.exec("(appendLevels ds ['z'])")
+    assert ap.domain == ["a", "b", "c", "z"]
+    rl = rap.exec("(relevel.by.freq ds -1)")
+    assert rl.domain == ["c", "b", "a"]  # by descending frequency
+    np.testing.assert_allclose(rl.to_numpy(), [2, 1, 1, 0, 0, 0])
+
+
+def test_getrow_flatten_columns_by_type(rap):
+    v = Vec.from_numpy(np.array([1], np.float32), type=T_CAT, domain=["lv"])
+    fr = Frame.from_dict({"n": np.array([3.5], np.float32)})
+    fr.add("c", Vec.from_numpy(np.array([0], np.float32), type=T_CAT,
+                               domain=["lv"]))
+    _put("g1", fr)
+    row = rap.exec("(getrow g1)")
+    assert row == [3.5, "lv"]
+    _num(rap, "g2", x=[42.0])
+    assert rap.exec("(flatten g2)") == 42.0
+    assert rap.exec("(columnsByType g1 'numeric')") == [0.0]
+    assert rap.exec("(columnsByType g1 'categorical')") == [1.0]
+    assert rap.exec("(is.numeric (cols g1 'n'))") == 1.0
+
+
+def test_as_date_week(rap):
+    sv = Vec.from_numpy(np.array(["2020-01-02", "2020-12-31"], dtype=object))
+    _put("ad", Frame(["d"], [sv]))
+    t = rap.exec("(as.Date ad 'yyyy-MM-dd')")
+    ms = t.to_numpy()
+    assert ms[0] == np.datetime64("2020-01-02", "ms").astype("int64")
+    wk = rap.exec("(week (as.Date ad 'yyyy-MM-dd'))")
+    assert wk.to_numpy()[0] == 1.0
+
+
+def test_timezone_prims(rap):
+    z = rap.exec("(listTimeZones)")
+    assert z.nrow >= 1
+    rap.exec("(setTimeZone 'UTC')")
+    tz = rap.exec("(getTimeZone)")
+    assert tz.vec(0).host_data[0] == "UTC"
+
+
+def test_isax(rap):
+    rng = np.random.default_rng(0)
+    X = {f"t{i}": rng.normal(size=8).astype(np.float32) for i in range(16)}
+    _put("ts", Frame.from_dict(X))
+    out = rap.exec("(isax ts 4 8 0)")
+    assert "iSax_index" in out.names
+    assert out.names == ["iSax_index", "c0", "c1", "c2", "c3"]
+    syms = np.stack([out.vec(f"c{i}").to_numpy() for i in range(4)])
+    assert syms.min() >= 0 and syms.max() <= 7
+
+
+def test_lambda_apply(rap):
+    _num(rap, "ap", a=[1, 2, 3], b=[4, 5, 6])
+    colmeans = rap.exec("(apply ap 2 {x . (mean x)})")
+    np.testing.assert_allclose(
+        [colmeans.vec("a").to_numpy()[0], colmeans.vec("b").to_numpy()[0]],
+        [2.0, 5.0])
+    rowsums = rap.exec("(apply ap 1 {x . (sum x)})")
+    np.testing.assert_allclose(rowsums.vec(0).to_numpy(), [5, 7, 9])
+    # general (non-fast-path) row lambda
+    expr = rap.exec("(apply ap 1 {x . (+ (sum x) 1)})")
+    np.testing.assert_allclose(expr.vec(0).to_numpy(), [6, 8, 10])
+
+
+def test_ddply(rap):
+    _num(rap, "dp", g=[0, 0, 1, 1, 1], v=[1, 2, 3, 4, 5])
+    out = rap.exec("(ddply dp [0] {x . (mean (cols x 'v'))})")
+    assert out.nrow == 2
+    np.testing.assert_allclose(out.vec(1).to_numpy(), [1.5, 4.0])
+
+
+def test_na_reducers_sumaxis(rap):
+    _num(rap, "nr", x=[1, 2, np.nan], y=[1, 1, 1])
+    assert rap.exec("(sumNA nr true)") == [3.0, 3.0]
+    assert rap.exec("(naCnt nr)") == [1.0, 0.0]
+    assert rap.exec("(any.na nr)") == 1.0
+    colsums = rap.exec("(sumaxis nr true 0)")
+    np.testing.assert_allclose(
+        [colsums.vec("x").to_numpy()[0], colsums.vec("y").to_numpy()[0]],
+        [3.0, 3.0])
+    rowsums = rap.exec("(sumaxis nr true 1)")
+    np.testing.assert_allclose(rowsums.vec(0).to_numpy(), [2, 3, 1])
+
+
+def test_extra_math_unops(rap):
+    _num(rap, "mu", x=[0.5])
+    assert abs(rap.exec("(expm1 mu)").to_numpy()[0]
+               - (np.expm1(0.5))) < 1e-6
+    assert abs(rap.exec("(cospi mu)").to_numpy()[0]) < 1e-6
+    assert abs(rap.exec("(lgamma mu)").to_numpy()[0]
+               - 0.5723649) < 1e-4
+    assert rap.exec("(%/% mu 0.5)") is not None
+
+
+def test_rename_key(rap):
+    _num(rap, "old_key", x=[1.0])
+    rap.exec("(rename 'old_key' 'new_key')")
+    out = rap.exec("(flatten (cols new_key 0))")
+    assert out == 1.0
+    with pytest.raises(KeyError):
+        rap.exec("(nrow old_key)")
+
+
+def test_tf_idf(rap):
+    docs = Vec.from_numpy(np.array([0, 0, 1], np.float32))
+    txt = Vec.from_numpy(np.array(["a b a", "c", "a c"], dtype=object))
+    _put("tfi", Frame(["doc", "text"], [docs, txt]))
+    out = rap.exec("(tf-idf tfi 0 1 true true)")
+    assert out.names == ["DocID", "Word", "TF", "IDF", "TF-IDF"]
+    rows = {(d, w): (t, i) for d, w, t, i in zip(
+        out.vec("DocID").to_numpy(), out.vec("Word").host_data,
+        out.vec("TF").to_numpy(), out.vec("IDF").to_numpy())}
+    assert rows[(0.0, "a")][0] == 2          # 'a' twice in doc 0
+    # 'a' in both docs: idf = log(3/3) = 0; 'b' in one: log(3/2)
+    assert abs(rows[(0.0, "a")][1]) < 1e-9
+    assert abs(rows[(0.0, "b")][1] - np.log(1.5)) < 1e-6
+
+
+def test_spearman_cor(rap):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=500).astype(np.float32)
+    y = np.exp(x).astype(np.float32)  # monotone → spearman rho == 1
+    _put("sx", Frame.from_dict({"x": x}))
+    _put("sy", Frame.from_dict({"y": y}))
+    rho = rap.exec("(cor sx sy 'everything' 'Spearman')")
+    assert abs(rho - 1.0) < 1e-6
+    pear = rap.exec("(cor sx sy 'everything' 'Pearson')")
+    assert pear < 0.999  # nonlinear, pearson strictly below spearman

@@ -216,6 +216,59 @@ class TestRestApi:
         with pytest.raises(h2o.H2OConnectionError, match="unknown parameter"):
             bad.train(y="y", training_frame=fr)
 
+    def test_setitem_new_and_overwrite(self, cloud):
+        fr = h2o.H2OFrame({"a": [1.0, 2.0, 3.0], "b": [4.0, 5.0, 6.0]})
+        fr["c"] = fr["a"] + fr["b"]          # append via (append ...)
+        assert fr.columns == ["a", "b", "c"]
+        assert fr["c"].sum() == 21.0
+        fr["a"] = 0                          # overwrite via (:= ...)
+        assert fr["a"].sum() == 0.0
+        fr[1, "b"] = 99                      # single-cell rectangle assign
+        assert fr["b"].sum() == 4.0 + 99.0 + 6.0
+
+    def test_frame_apply_and_new_methods(self, cloud):
+        fr = h2o.H2OFrame({"a": [1.0, 2.0, 3.0], "b": [4.0, 5.0, 6.0]})
+        rs = fr.apply("sum", axis=1)
+        df = rs.as_data_frame()
+        assert list(df.iloc[:, 0]) == [5.0, 7.0, 9.0]
+        assert fr.anyfactor() is False
+        dup = h2o.H2OFrame({"k": [1.0, 1.0, 2.0]})
+        assert dup.drop_duplicates(["k"]).nrow == 2
+
+    def test_profiler_watermeter_endpoints(self, cloud):
+        prof = h2o.connection().request("GET", "/3/Profiler",
+                                        params={"depth": 2})
+        assert prof["nodes"] and prof["nodes"][0]["entries"]
+        ticks = h2o.connection().request("GET", "/3/WaterMeterCpuTicks/0")
+        assert isinstance(ticks["cpu_ticks"], list)
+        io = h2o.connection().request("GET", "/3/WaterMeterIo")
+        assert "persist_stats" in io
+
+    def test_network_test_microbench(self, cloud):
+        nt = h2o.connection().request("GET", "/3/NetworkTest")
+        assert nt["linpack_gflops"] > 0
+        assert nt["memory_bandwidth_gbs"] > 0
+        assert nt["collective"]["devices"] >= 1
+
+    def test_hash_login_auth(self):
+        import hashlib
+        from h2o_tpu.api.server import H2OServer
+
+        creds = {"bob": hashlib.sha256(b"pw123").hexdigest()}
+        srv = H2OServer(port=54880, name="authed", hash_login=creds).start()
+        try:
+            import urllib.request
+
+            with pytest.raises(Exception):
+                urllib.request.urlopen(f"{srv.url}/3/Cloud", timeout=10)
+            conn = h2o.H2OConnection(srv.url, "bob", "pw123")
+            assert conn.request("GET", "/3/Cloud")["cloud_healthy"]
+            bad = h2o.H2OConnection(srv.url, "bob", "wrong")
+            with pytest.raises(h2o.H2OConnectionError):
+                bad.request("GET", "/3/Cloud")
+        finally:
+            srv.stop()
+
     def test_model_builders_metadata(self, cloud):
         mb = h2o.connection().request("GET", "/3/ModelBuilders")
         assert "gbm" in mb["model_builders"]

@@ -99,3 +99,37 @@ def test_staged_predictions():
     final = staged.vec("T8").to_numpy()
     p1 = m.predict(fr).vec("pp").to_numpy()
     assert np.allclose(final, p1, atol=1e-5)
+
+
+def test_platt_calibration():
+    """`hex/tree/CalibrationHelper`: cal_p1 columns appended, calibrated
+    probabilities closer to empirical rates than the raw model output."""
+    rng = np.random.default_rng(3)
+    n = 2000
+    x = rng.normal(size=n).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(np.float32)
+    fr = Frame.from_dict({"x": x})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    calib = Frame.from_dict({"x": x[:500]})
+    calib.add("y", Vec.from_numpy(y[:500], type=T_CAT, domain=["n", "p"]))
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=30,
+                          max_depth=5, seed=1, calibrate_model=True,
+                          calibration_frame=calib)).train_model()
+    pred = m.predict(fr)
+    assert "cal_p1" in pred.names and "cal_p0" in pred.names
+    cal = pred.vec("cal_p1").to_numpy()
+    p0 = pred.vec("cal_p0").to_numpy()
+    np.testing.assert_allclose(cal + p0, 1.0, atol=1e-6)
+    assert 0 <= cal.min() and cal.max() <= 1
+    # calibrated logloss on fresh-ish data should not be much worse than raw
+    raw = pred.vec("pp").to_numpy()
+    ll = lambda p: -np.mean(y * np.log(np.clip(p, 1e-12, 1))
+                            + (1 - y) * np.log(np.clip(1 - p, 1e-12, 1)))
+    assert ll(cal) < ll(raw) + 0.05
+
+
+def test_calibration_requires_frame():
+    fr = _bin_frame()
+    with pytest.raises(ValueError):
+        GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=3,
+                          max_depth=2, calibrate_model=True)).train_model()
