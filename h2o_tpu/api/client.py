@@ -737,6 +737,40 @@ class H2OModelClient:
     def staged_predict_proba(self, frame: H2OFrame) -> H2OFrame:
         return self.predict(frame, predict_staged_proba="true")
 
+    # -- GLM-family coefficient surface (`h2o-py` model.coef()) --------------
+    def _coef_table(self) -> dict:
+        tbl = ((self._schema or {}).get("output") or {}).get(
+            "coefficients_table")
+        if tbl is None:
+            raise ValueError(f"model {self.model_id} has no coefficients")
+        return tbl
+
+    def coef(self) -> dict:
+        t = self._coef_table()
+        return dict(zip(t["names"], t["coefficients"]))
+
+    def coef_norm(self) -> dict:
+        t = self._coef_table()
+        return dict(zip(t["names"], t["standardized_coefficients"]))
+
+    def _coef_stat(self, col) -> dict:
+        t = self._coef_table()
+        if col not in t:
+            raise ValueError(f"train with compute_p_values=True for {col}")
+        return dict(zip(t["names"], t[col]))
+
+    def std_errs(self) -> dict:
+        return self._coef_stat("std_errs")
+
+    def z_values(self) -> dict:
+        return self._coef_stat("z_values")
+
+    def p_values(self) -> dict:
+        return self._coef_stat("p_values")
+
+    def dispersion(self):
+        return ((self._schema or {}).get("output") or {}).get("dispersion")
+
     def _metrics(self, kind="training_metrics") -> dict:
         return (self._schema or {}).get("output", {}).get(kind) or {}
 

@@ -134,7 +134,7 @@ def metrics_schema(m) -> dict | None:
 def model_schema(model) -> dict:
     """`water/api/schemas3/ModelSchemaV3` (summary form)."""
     o = model.output
-    return {
+    out = {
         "model_id": key_schema(model.key, "Key<Model>"),
         "algo": model.algo_name,
         "algo_full_name": model.algo_name,
@@ -152,3 +152,39 @@ def model_schema(model) -> dict:
             "run_time_ms": o.run_time_ms,
         },
     }
+    if hasattr(model, "coef"):  # GLM-family: `hex/schemas/GLMModelV3`
+        try:
+            coefs = model.coef()
+        except Exception as e:  # keep /3/Models listing alive, but visibly
+            from ..utils.log import warn
+
+            warn(f"coefficients_table for {model.key}: {e!r}")
+            coefs = None
+        flat = coefs and not any(isinstance(v, dict) for v in coefs.values())
+        if flat:  # multinomial's {class: {coef: v}} ships per-class instead
+            tbl = {"names": list(coefs), "coefficients": _clean(
+                list(coefs.values()))}
+            if hasattr(model, "coef_norm"):
+                tbl["standardized_coefficients"] = _clean(
+                    list(model.coef_norm().values()))
+            for attr, col in (("std_errs", "std_errs"),
+                              ("z_values", "z_values"),
+                              ("p_values", "p_values")):
+                d = getattr(model, attr, None)
+                if d:
+                    tbl[col] = _clean([d.get(n) for n in coefs])
+            out["output"]["coefficients_table"] = tbl
+        elif coefs:
+            # `coefficients_table_multinomials_with_class_names` role: one
+            # coefficient list per response class (`GLMModelV3.java:33`)
+            any_class = next(iter(coefs.values()))
+            out["output"]["coefficients_table_multinomial"] = {
+                "names": list(any_class),
+                "classes": list(coefs),
+                "coefficients": [
+                    _clean([coefs[k].get(n) for n in any_class])
+                    for k in coefs]}
+        disp = getattr(model, "dispersion_estimated", None)
+        if disp is not None:
+            out["output"]["dispersion"] = _clean(disp)
+    return out

@@ -115,8 +115,7 @@ def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
 
         table = orc.ORCFile(path).read()
     elif ext == ".avro":
-        raise NotImplementedError("avro ingest requires fastavro (not in image); "
-                                  "convert to parquet/csv")
+        return _parse_avro(path, mesh=mesh, dest_key=dest_key)
     elif ext in (".svm", ".svmlight"):
         return _parse_svmlight(path, mesh=mesh, dest_key=dest_key)
     elif ext == ".arff":
@@ -214,6 +213,39 @@ def _intern_categorical(col, mesh) -> Vec:
     out = remap[codes.astype(np.int64)] if len(dic) else codes
     out[null_mask] = np.nan
     return Vec.from_numpy(out, type=T_CAT, domain=[dic[i] for i in order], mesh=mesh)
+
+
+def _parse_avro(path: str, mesh=None, dest_key: str | None = None) -> Frame:
+    """Avro container ingest via the pure-Python reader (`io/avro.py`,
+    `h2o-parsers/h2o-avro-parser` analog: flat records → columns)."""
+    from .avro import read_avro
+
+    names, cols, domains, types = read_avro(path)
+    out = {}
+    for name, vals, dom, prim in zip(names, cols, domains, types):
+        if dom is not None:  # enum → categorical codes over the schema domain
+            lut = {s: i for i, s in enumerate(dom)}
+            arr = np.array([np.nan if v is None else lut[v] for v in vals],
+                           dtype=np.float32)
+            out[name] = Vec.from_numpy(arr, type=T_CAT, domain=list(dom),
+                                       mesh=mesh)
+        elif prim in ("string", "bytes", "fixed"):
+            out[name] = Vec.from_numpy(np.array(
+                [None if v is None else
+                 (v.decode("utf-8", "replace") if isinstance(v, bytes)
+                  else str(v)) for v in vals], dtype=object))
+        elif prim in ("int", "long") and not any(v is None for v in vals):
+            # exact-int64 path: Vec retains the lossless copy when the f32
+            # HBM projection would round (vec.py exact_data)
+            out[name] = Vec.from_numpy(np.array(vals, dtype=np.int64),
+                                       mesh=mesh)
+        else:
+            arr = np.array([np.nan if v is None else float(v) for v in vals],
+                           dtype=np.float64)
+            out[name] = Vec.from_numpy(arr, mesh=mesh)
+    fr = Frame(list(out), list(out.values()), key=dest_key)
+    STORE.put_keyed(fr)
+    return fr
 
 
 def _parse_svmlight(path: str, mesh=None, dest_key=None) -> Frame:

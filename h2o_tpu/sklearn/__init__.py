@@ -1,0 +1,18 @@
+"""scikit-learn adapters — `h2o-py/h2o/sklearn/` analog.
+
+The reference generates `H2O<Algo>Classifier` / `H2O<Algo>Regressor` classes
+wrapping each estimator behind sklearn's fit/predict/get_params contract
+(`h2o-py/h2o/sklearn/__init__.py` `_algo_to_classes`, `wrapper.py`
+`H2OtoSklearnEstimator`). Same shape here: thin BaseEstimator wrappers that
+convert numpy/pandas X, y into engine Frames and train in-process on the
+device mesh (no REST hop — the adapter is a library-boundary surface).
+"""
+
+from .wrapper import (H2OClassifierMixin, H2ORegressorMixin,
+                      make_sklearn_classes)
+
+_GENERATED = make_sklearn_classes()
+globals().update(_GENERATED)
+
+__all__ = sorted(_GENERATED) + ["H2OClassifierMixin", "H2ORegressorMixin",
+                                "make_sklearn_classes"]

@@ -1,0 +1,209 @@
+"""sklearn BaseEstimator bridge for h2o_tpu model builders.
+
+Reference: `h2o-py/h2o/sklearn/wrapper.py` (`H2OtoSklearnEstimator`,
+`BaseSklearnEstimator`) — params round-trip through get_params/set_params so
+`sklearn.base.clone`, pipelines, and grid search work; fit/predict convert
+between numpy/pandas and engine Frames.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import T_CAT, Vec
+
+
+try:  # sklearn's bases provide __sklearn_tags__ for pipeline/CV integration
+    from sklearn.base import BaseEstimator as _SkBase
+    from sklearn.base import ClassifierMixin as _SkClassifier
+    from sklearn.base import RegressorMixin as _SkRegressor
+except ImportError:  # pragma: no cover — adapters still importable bare
+    _SkBase = object
+
+    class _SkClassifier:  # noqa: N801
+        pass
+
+    class _SkRegressor:  # noqa: N801
+        pass
+
+
+class _BaseAdapter(_SkBase):
+    """Minimal BaseEstimator contract (get_params/set_params via a params
+    dict, so **kwargs __init__ stays clone-compatible)."""
+
+    _algo = None  # registry name
+
+    def __init__(self, **params):
+        self._params = dict(params)
+        self._model = None
+        self._classes = None
+
+    # sklearn plumbing --------------------------------------------------------
+    def get_params(self, deep=True):
+        return dict(self._params)
+
+    def set_params(self, **params):
+        self._params.update(params)
+        return self
+
+    @classmethod
+    def _get_param_names(cls):
+        return []
+
+    def __sklearn_clone__(self):
+        return type(self)(**self.get_params())
+
+    def _repr_mimebundle_(self, **kw):  # keep sklearn's html repr quiet
+        return {"text/plain": repr(self)}
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._params})"
+
+    # data conversion ---------------------------------------------------------
+    @staticmethod
+    def _to_frame(X, y=None, classification=False):
+        cols = {}
+        if hasattr(X, "columns"):  # pandas
+            names = [str(c) for c in X.columns]
+            X = np.asarray(X, dtype=np.float32)
+        else:
+            X = np.asarray(X, dtype=np.float32)
+            names = [f"x{j}" for j in range(X.shape[1])]
+        for j, n in enumerate(names):
+            cols[n] = X[:, j]
+        fr = Frame.from_dict(cols)
+        classes = None
+        if y is not None:
+            y = np.asarray(y)
+            if classification:
+                classes, codes = np.unique(y, return_inverse=True)
+                fr.add("__response__", Vec.from_numpy(
+                    codes.astype(np.float32), type=T_CAT,
+                    domain=[str(c) for c in classes]))
+            else:
+                fr.add("__response__",
+                       Vec.from_numpy(np.asarray(y, dtype=np.float32)))
+        return fr, names, classes
+
+    def _train(self, fr, extra=None):
+        from ..models import registry
+
+        entry = registry.lookup(self._algo)
+        algo_cls, params_cls = entry[0], entry[1]
+        kw = dict(self._params)
+        kw.update(extra or {})
+        kw["training_frame"] = fr
+        self._model = algo_cls(params_cls(**kw)).train_model()
+        # trailing-underscore attrs mark the estimator fitted for sklearn's
+        # check_is_fitted (wrapper.py sets the same convention)
+        self.fitted_ = True
+        self.n_features_in_ = fr.ncol - (1 if "__response__" in fr.names
+                                         else 0)
+        return self
+
+    def _predict_frame(self, X):
+        fr, _, _ = self._to_frame(X)
+        return self._model.predict(fr)
+
+
+class H2ORegressorMixin(_SkRegressor):
+    _estimator_type = "regressor"
+
+    def fit(self, X, y):
+        fr, self._names, _ = self._to_frame(X, y, classification=False)
+        return self._train(fr, {"response_column": "__response__"})
+
+    def predict(self, X):
+        return self._predict_frame(X).vec(0).to_numpy().astype(np.float64)
+
+    def score(self, X, y):
+        pred = self.predict(X)
+        y = np.asarray(y, dtype=np.float64)
+        ss_res = float(((y - pred) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return 1.0 - ss_res / max(ss_tot, 1e-300)
+
+
+class H2OClassifierMixin(_SkClassifier):
+    _estimator_type = "classifier"
+
+    def fit(self, X, y):
+        fr, self._names, self._classes = self._to_frame(
+            X, y, classification=True)
+        return self._train(fr, {"response_column": "__response__"})
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    def predict(self, X):
+        out = self._predict_frame(X)
+        labels = out.vec(0).to_numpy().astype(np.int64)
+        return self._classes[labels]
+
+    def predict_proba(self, X):
+        out = self._predict_frame(X)
+        K = len(self._classes)
+        return np.stack([out.vec(1 + k).to_numpy() for k in range(K)],
+                        axis=1).astype(np.float64)
+
+    def score(self, X, y):
+        return float(np.mean(self.predict(X) == np.asarray(y)))
+
+
+class _UnsupervisedMixin:
+    """KMeans / IsolationForest style: fit(X) with no response."""
+
+    def fit(self, X, y=None):
+        fr, self._names, _ = self._to_frame(X)
+        return self._train(fr)
+
+    def predict(self, X):
+        out = self._predict_frame(X)
+        return out.vec(0).to_numpy().astype(np.float64)
+
+    def transform(self, X):
+        out = self._predict_frame(X)
+        return np.stack([out.vec(j).to_numpy() for j in range(out.ncol)],
+                        axis=1).astype(np.float64)
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X).transform(X)
+
+
+# algo → (ClassifierName, RegressorName) or (single wrapper name, mixin)
+_SUPERVISED = {
+    "gbm": "H2OGradientBoosting",
+    "drf": "H2ORandomForest",
+    "glm": "H2OGeneralizedLinear",
+    "deeplearning": "H2ODeepLearning",
+    "xgboost": "H2OXGBoost",
+    "naivebayes": "H2ONaiveBayes",  # classifier only
+    "adaboost": "H2OAdaBoost",      # classifier only
+}
+_CLASSIFIER_ONLY = {"naivebayes", "adaboost"}
+_UNSUPERVISED = {
+    "kmeans": "H2OKMeansEstimator",
+    "isolationforest": "H2OIsolationForestEstimator",
+    "extendedisolationforest": "H2OExtendedIsolationForestEstimator",
+    "pca": "H2OPCAEstimator",
+}
+
+
+def make_sklearn_classes() -> dict:
+    """Generate the adapter classes (the reference's module-import-time
+    `_algo_to_classes` generation loop)."""
+    out = {}
+    for algo, stem in _SUPERVISED.items():
+        out[f"{stem}Classifier"] = type(
+            f"{stem}Classifier", (H2OClassifierMixin, _BaseAdapter),
+            {"_algo": algo})
+        if algo not in _CLASSIFIER_ONLY:
+            out[f"{stem}Regressor"] = type(
+                f"{stem}Regressor", (H2ORegressorMixin, _BaseAdapter),
+                {"_algo": algo})
+    for algo, name in _UNSUPERVISED.items():
+        out[name] = type(name, (_UnsupervisedMixin, _BaseAdapter),
+                         {"_algo": algo})
+    return out

@@ -345,3 +345,59 @@ def test_dispersion_tweedie_ml():
                           lambda_=0.0,
                           dispersion_parameter_method="ml")).train_model()
     assert abs(m.dispersion_estimated - phi) < 0.25
+
+
+def test_tweedie_variance_power_estimation():
+    """fix_tweedie_variance_power=False: joint (p, phi) profile ML recovers
+    the simulated variance power (`hex/glm/TweedieEstimator` analog)."""
+    rng = np.random.default_rng(7)
+    n = 3000
+    mu = np.full(n, 2.0)
+    p_true, phi_true = 1.5, 0.8
+    lam = mu ** (2 - p_true) / (phi_true * (2 - p_true))
+    alpha = (2 - p_true) / (p_true - 1)
+    gam_scale = phi_true * (p_true - 1) * mu ** (p_true - 1)
+    N = rng.poisson(lam)
+    y = np.array([rng.gamma(alpha * k, gam_scale[i]) if k > 0 else 0.0
+                  for i, k in enumerate(N)], dtype=np.float32)
+    fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32) * 1e-3,
+                          "y": y})
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="tweedie", tweedie_variance_power=1.3,
+                          lambda_=0.0, dispersion_parameter_method="ml",
+                          fix_tweedie_variance_power=False)).train_model()
+    assert abs(m.tweedie_variance_power_estimated - p_true) < 0.15
+    assert abs(m.dispersion_estimated - phi_true) < 0.3
+
+
+def test_beta_constraints_multinomial():
+    """Box constraints project every class block of the multinomial fit."""
+    rng = np.random.default_rng(9)
+    n = 2000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    scores = np.stack([2.0 * x1, -2.0 * x1 + x2, -x2], axis=1)
+    cls = np.argmax(scores + rng.gumbel(size=(n, 3)) * 0.3, axis=1)
+    import pandas as pd
+    fr = Frame.from_pandas(pd.DataFrame(
+        {"x1": x1, "x2": x2,
+         "y": pd.Categorical.from_codes(cls, ["a", "b", "c"])}))
+    bc = {"names": ["x1"], "lower_bounds": [-0.5], "upper_bounds": [0.5]}
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="multinomial", lambda_=0.0,
+                          standardize=False,
+                          beta_constraints=bc)).train_model()
+    for klass, coefs in m.coef().items():
+        assert -0.5 - 1e-6 <= coefs["x1"] <= 0.5 + 1e-6, (klass, coefs)
+
+
+def test_beta_constraints_ordinal_rejected():
+    from h2o_tpu.frame.vec import T_CAT, Vec
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=100).astype(np.float32)
+    fr = Frame.from_dict({"x": x})
+    lev = np.clip((x + 1).astype(int), 0, 2).astype(np.float32)
+    fr.add("y", Vec.from_numpy(lev, type=T_CAT, domain=["lo", "mid", "hi"]))
+    with pytest.raises(NotImplementedError, match="ordinal"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="ordinal",
+                          beta_constraints={"names": ["x"]})).train_model()

@@ -86,3 +86,70 @@ def test_import_svmlight(tmp_path):
 def test_col_types_override(csv_path):
     fr = import_file(csv_path, col_types={"species": "string"})
     assert fr.vec("species").is_string()
+
+
+class TestAvro:
+    """Pure-python Avro container ingest (`h2o-parsers/h2o-avro-parser`)."""
+
+    def _write_sample(self, path, codec="null"):
+        from h2o_tpu.io.avro import write_avro
+
+        write_avro(path,
+                   ["num", "name"],
+                   [[1.5, None, 3.25], ["a", "b", None]],
+                   schema_types=["double", "string"], codec=codec)
+
+    def test_roundtrip_null_codec(self, tmp_path):
+        from h2o_tpu.io.parser import parse_file
+
+        p = str(tmp_path / "t.avro")
+        self._write_sample(p)
+        fr = parse_file(p)
+        assert fr.names == ["num", "name"]
+        x = fr.vec("num").to_numpy()
+        assert x[0] == 1.5 and np.isnan(x[1]) and x[2] == 3.25
+        assert fr.vec("name").host_data[0] == "a"
+        assert fr.vec("name").host_data[2] is None
+
+    def test_roundtrip_deflate(self, tmp_path):
+        from h2o_tpu.io.parser import parse_file
+
+        p = str(tmp_path / "d.avro")
+        self._write_sample(p, codec="deflate")
+        fr = parse_file(p)
+        assert fr.nrow == 3 and fr.vec("num").to_numpy()[2] == 3.25
+
+    def test_enum_and_int_fields(self, tmp_path):
+        import json
+        import struct
+        from h2o_tpu.io.parser import parse_file
+
+        # hand-rolled container with int + enum fields
+        def zz(v):
+            v = (v << 1) ^ (v >> 63)
+            out = bytearray()
+            while True:
+                b = v & 0x7F
+                v >>= 7
+                if v:
+                    out.append(b | 0x80)
+                else:
+                    out.append(b)
+                    return bytes(out)
+
+        schema = {"type": "record", "name": "r", "fields": [
+            {"name": "i", "type": "long"},
+            {"name": "col", "type": {"type": "enum", "name": "e",
+                                     "symbols": ["red", "green"]}}]}
+        sj = json.dumps(schema).encode()
+        body = zz(7) + zz(0) + zz(-2) + zz(1) + zz(41) + zz(0)
+        buf = (b"Obj\x01" + zz(1) + zz(len(b"avro.schema")) + b"avro.schema"
+               + zz(len(sj)) + sj + zz(0) + b"S" * 16
+               + zz(3) + zz(len(body)) + body + b"S" * 16)
+        p = str(tmp_path / "e.avro")
+        open(p, "wb").write(buf)
+        fr = parse_file(p)
+        np.testing.assert_allclose(fr.vec("i").to_numpy(), [7, -2, 41])
+        v = fr.vec("col")
+        assert v.domain == ["red", "green"]
+        np.testing.assert_allclose(v.to_numpy(), [0, 1, 0])

@@ -1,0 +1,73 @@
+"""sklearn adapter layer (`h2o-py/h2o/sklearn/` analog)."""
+
+import numpy as np
+import pytest
+
+
+def _data(n=400, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    if classes:
+        logits = X[:, 0] * 2 - X[:, 1]
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-logits)), "pos", "neg")
+        if classes > 2:
+            y = np.array([f"c{i}" for i in
+                          rng.integers(0, classes, n)])
+    else:
+        y = (X[:, 0] * 2 - X[:, 1] + 0.1 * rng.normal(size=n)).astype(
+            np.float64)
+    return X, y
+
+
+def test_classifier_fit_predict_proba():
+    from h2o_tpu.sklearn import H2OGradientBoostingClassifier
+
+    X, y = _data()
+    clf = H2OGradientBoostingClassifier(ntrees=10, max_depth=3, seed=1)
+    clf.fit(X, y)
+    assert set(clf.classes_) == {"neg", "pos"}
+    pred = clf.predict(X)
+    assert set(pred) <= {"neg", "pos"}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert clf.score(X, y) > 0.8
+
+
+def test_regressor_and_clone():
+    from sklearn.base import clone
+
+    from h2o_tpu.sklearn import H2OGeneralizedLinearRegressor
+
+    X, y = _data(classes=0)
+    reg = H2OGeneralizedLinearRegressor(family="gaussian", lambda_=0.0)
+    assert clone(reg).get_params() == reg.get_params()
+    reg.fit(X, y)
+    assert reg.score(X, y) > 0.9
+
+
+def test_pipeline_compatibility():
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    from h2o_tpu.sklearn import H2ORandomForestClassifier
+
+    X, y = _data(n=300)
+    pipe = Pipeline([("sc", StandardScaler()),
+                     ("rf", H2ORandomForestClassifier(ntrees=8, seed=1))])
+    pipe.fit(X, y)
+    assert pipe.score(X, y) > 0.7
+
+
+def test_kmeans_and_pca_adapters():
+    from h2o_tpu.sklearn import H2OKMeansEstimator, H2OPCAEstimator
+
+    rng = np.random.default_rng(1)
+    X = np.concatenate([rng.normal(0, 0.3, (50, 2)),
+                        rng.normal(5, 0.3, (50, 2))]).astype(np.float32)
+    km = H2OKMeansEstimator(k=2, seed=1).fit(X)
+    lab = km.predict(X)
+    assert len(set(lab[:50])) == 1 and len(set(lab[50:])) == 1
+    pca = H2OPCAEstimator(k=2)
+    Z = pca.fit_transform(X)
+    assert Z.shape == (100, 2)
