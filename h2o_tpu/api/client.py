@@ -33,10 +33,20 @@ class H2OConnection:
     """REST transport — `h2o-py/h2o/backend/connection.py` analog."""
 
     def __init__(self, url: str, username: str | None = None,
-                 password: str | None = None):
+                 password: str | None = None,
+                 verify_ssl_certificates: bool = True,
+                 cacert: str | None = None):
         self.url = url.rstrip("/")
         self.session_id: str | None = None
         self._auth = None
+        self._ssl_ctx = None
+        if url.startswith("https"):
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context(cafile=cacert)
+            if not verify_ssl_certificates:
+                self._ssl_ctx.check_hostname = False
+                self._ssl_ctx.verify_mode = ssl.CERT_NONE
         if username is not None:
             import base64
 
@@ -58,7 +68,8 @@ class H2OConnection:
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
         try:
-            with urllib.request.urlopen(req, timeout=600) as resp:
+            with urllib.request.urlopen(req, timeout=600,
+                                        context=self._ssl_ctx) as resp:
                 return json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             try:
@@ -90,15 +101,18 @@ def connection() -> H2OConnection:
 def init(url: str | None = None, port: int = 54321, name: str = "h2o_tpu",
          strict_version_check: bool = False, username: str | None = None,
          password: str | None = None, hash_login: dict | str | None = None,
+         verify_ssl_certificates: bool = True, cacert: str | None = None,
          **kw):
     """Connect to a running server, else boot one in-process
     (`h2o-py/h2o/h2o.py:137` connect-or-spawn). `username`/`password` send
-    basic auth; `hash_login` configures it on a freshly booted server."""
+    basic auth; `hash_login` configures it on a freshly booted server;
+    `verify_ssl_certificates`/`cacert` control https trust."""
     global _conn
     if url is None:
         url = f"http://127.0.0.1:{port}"
     try:
-        _conn = H2OConnection(url, username, password)
+        _conn = H2OConnection(url, username, password,
+                              verify_ssl_certificates, cacert)
         _conn.request("GET", "/3/Cloud")
         return _conn
     except H2OConnectionError as e:
@@ -109,16 +123,20 @@ def init(url: str | None = None, port: int = 54321, name: str = "h2o_tpu",
     from .server import H2OServer
 
     server = H2OServer(port=port, name=name, hash_login=hash_login).start()
-    _conn = H2OConnection(server.url, username, password)
+    _conn = H2OConnection(server.url, username, password,
+                          verify_ssl_certificates, cacert)
     _conn._server = server  # keep alive / allow shutdown
     cluster_status()
     return _conn
 
 
 def connect(url: str, username: str | None = None,
-            password: str | None = None, **kw):
+            password: str | None = None,
+            verify_ssl_certificates: bool = True, cacert: str | None = None,
+            **kw):
     global _conn
-    _conn = H2OConnection(url, username, password)
+    _conn = H2OConnection(url, username, password,
+                          verify_ssl_certificates, cacert)
     _conn.request("GET", "/3/Cloud")
     return _conn
 

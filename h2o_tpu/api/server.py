@@ -41,14 +41,20 @@ class H2OServer:
     """Server lifecycle — `water/H2O.main` + Jetty boot analog."""
 
     def __init__(self, port: int = 54321, name: str = "h2o_tpu",
-                 hash_login: dict | str | None = None):
+                 hash_login: dict | str | None = None,
+                 ssl_certfile: str | None = None,
+                 ssl_keyfile: str | None = None):
         """`hash_login`: {user: sha256-hex-or-plain} dict or a realm file of
         `user:sha256hex` lines — the `-hash_login` basic-auth analog
-        (`h2o-security`, `water/webserver/H2OHttpViewImpl` auth hook)."""
+        (`h2o-security`, `water/webserver/H2OHttpViewImpl` auth hook).
+        `ssl_certfile`/`ssl_keyfile` terminate TLS on the REST socket — the
+        `-jks`/https role of `water/network/SSLSocketChannelFactory`."""
         self.port = port
         self.name = name
         self.httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        self.ssl_certfile = ssl_certfile
+        self.ssl_keyfile = ssl_keyfile
         if isinstance(hash_login, str):
             creds = {}
             with open(hash_login) as f:
@@ -97,6 +103,13 @@ class H2OServer:
                 last_err = e
         if self.httpd is None:
             raise last_err
+        if self.ssl_certfile:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="h2o-rest")
         self._thread.start()
@@ -110,7 +123,8 @@ class H2OServer:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.ssl_certfile else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
 
 def _truthy(v) -> bool:

@@ -174,6 +174,28 @@ class DeepLearningModel(Model):
         mse = jnp.mean((out - X) ** 2, axis=1)
         return Frame(["Reconstruction.MSE"], [Vec.from_device(mse, fr.nrow)])
 
+    def deepfeatures(self, fr: Frame, layer: int) -> Frame:
+        """Hidden-layer activations (`Model.scoreDeepFeatures` /
+        h2o-py `model.deepfeatures(frame, layer)`); layer is 0-based."""
+        p: DeepLearningParameters = self.params
+        X = self.adapt_frame(fr)
+        nhidden = len(self.net) - 1
+        if not (0 <= layer < nhidden):
+            raise ValueError(f"layer must be in [0, {nhidden})")
+        act = _act(p.activation)
+        maxout = p.activation.lower().startswith("maxout")
+        h = X
+        for i in range(layer + 1):
+            z = h @ self.net[i]["W"] + self.net[i]["b"]
+            if maxout:
+                z = z.reshape(z.shape[0], -1, 2).max(axis=2)
+            else:
+                z = act(z)
+            h = z
+        names = [f"DF.L{layer + 1}.C{j + 1}" for j in range(h.shape[1])]
+        return Frame(names, [Vec.from_device(h[:, j], fr.nrow)
+                             for j in range(h.shape[1])])
+
 
 class DeepLearning(ModelBuilder):
     algo_name = "deeplearning"

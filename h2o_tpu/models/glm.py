@@ -16,7 +16,8 @@ with soft-thresholding over the factorized Gram (the `L1Solver` design);
 Families: gaussian, binomial, quasibinomial, poisson, gamma, tweedie,
 negativebinomial, multinomial (per-class block IRLS, the reference's multiclass
 coordinate approach), ordinal (proportional odds, device gradient descent —
-the reference's GRADIENT_DESCENT_LH role). HGLM is a planned follow-up.
+the reference's GRADIENT_DESCENT_LH role), HGLM (random-intercept mixed model
+via device one-hot cross-products + host Henderson/EM solve).
 """
 
 from __future__ import annotations
@@ -269,6 +270,13 @@ class GLMParameters(Parameters):
     fix_tweedie_variance_power: bool = True  # False: joint (p, φ) ML over the
                                      # fitted means via the series likelihood
                                      # (`hex/glm/TweedieEstimator` analog)
+    HGLM: bool = False               # hierarchical GLM: y = Xβ + Zu + e with
+                                     # one categorical random-intercept column
+                                     # (`hex/glm/GLMModel.java:499,638-641` —
+                                     # the reference also requires exactly one
+                                     # random column, gaussian rand_family)
+    random_columns: list = None      # [column name or index]
+    rand_family: list = None         # ["gaussian"] (only member supported)
     beta_constraints: object = None  # Frame or {names, lower_bounds,
                                      # upper_bounds} — box constraints per
                                      # coefficient on the natural scale
@@ -553,6 +561,8 @@ class GLM(ModelBuilder):
         fr = p.training_frame
         names = self.feature_names()
         y_dev, category, resp_domain = self.response_info()
+        if getattr(p, "HGLM", False):
+            return self._build_hglm(job, names, y_dev, category)
         if category == "Multinomial":
             if p.compute_p_values:  # AUTO family resolving to multinomial
                 raise ValueError("compute_p_values is not supported for "
@@ -985,6 +995,115 @@ class GLM(ModelBuilder):
         output.training_metrics = make_metrics("Multinomial", ym, raw, None)
         return model
 
+    def _build_hglm(self, job, names, y_dev, category):
+        """Hierarchical GLM — linear mixed model with one categorical random
+        intercept (`hex/glm/GLM.java` HGLM path, Lee & Nelder fitting;
+        `GLMModel.java:638-641` restricts to exactly one random column).
+
+        TPU-native structure: all data-sized cross products (XᵀX, XᵀZ, ZᵀZ,
+        Xᵀy, Zᵀy) are one-hot einsums over the row-sharded design — Z never
+        materializes beyond a one-hot matmul; the (P+q) Henderson solve and
+        EM variance-component updates run on host per iteration, like the
+        reference's home-node solve.
+        """
+        p = self.params
+        fr = p.training_frame
+        fam = (p.family or "AUTO").lower()
+        if category != "Regression" or fam not in ("gaussian", "auto"):
+            raise NotImplementedError("HGLM supports family=gaussian with a "
+                                      "numeric response (the reference's "
+                                      "tested path)")
+        if not p.random_columns or len(p.random_columns) != 1:
+            raise ValueError("HGLM requires exactly one random column "
+                             "(`GLMModel.java:641`)")
+        if p.rand_family and [str(f).lower() for f in p.rand_family] != [
+                "gaussian"]:
+            raise NotImplementedError("rand_family supports [gaussian]")
+        rc = p.random_columns[0]
+        rname = fr.names[int(rc)] if not isinstance(rc, str) else rc
+        rvec = fr.vec(rname)
+        if not rvec.is_categorical():
+            raise ValueError(f"HGLM random column '{rname}' must be "
+                             f"categorical")
+        names = [n for n in names if n != rname]
+        dinfo = DataInfo.make(fr, names, standardize=p.standardize,
+                              missing_values_handling=p.missing_values_handling)
+        X, okrow = dinfo.expand(fr)
+        ones = jnp.ones((X.shape[0], 1), jnp.float32)
+        Xi = jnp.concatenate([X, ones], axis=1)  # intercept last
+        y = jnp.nan_to_num(y_dev)
+        w = (~jnp.isnan(y_dev)).astype(jnp.float32) * okrow.astype(jnp.float32)
+        if p.weights_column:
+            w = w * jnp.nan_to_num(fr.vec(p.weights_column).data)
+        q = len(rvec.domain)
+        zi = jnp.nan_to_num(rvec.data, nan=-1.0).astype(jnp.int32)
+        Zoh = jax.nn.one_hot(zi, q, dtype=jnp.float32)  # (R, q)
+        Zoh = jnp.where((zi >= 0)[:, None], Zoh, 0.0)  # NA level → zero row
+
+        @jax.jit
+        def crossprods(Xi, Zoh, y, w):
+            Xw = Xi * w[:, None]
+            return (jnp.einsum("rp,rq->pq", Xw, Xi),      # XᵀWX
+                    jnp.einsum("rp,rq->pq", Xw, Zoh),     # XᵀWZ
+                    jnp.einsum("rp,rq->pq", Zoh * w[:, None], Zoh),  # ZᵀWZ
+                    Xw.T @ y, (Zoh * w[:, None]).T @ y,
+                    jnp.sum(w * y * y), jnp.sum(w))
+
+        XtX, XtZ, ZtZ, Xty, Zty, yty, neff = (
+            np.asarray(a, np.float64) for a in crossprods(Xi, Zoh, y, w))
+        neff = float(neff)
+        P1 = XtX.shape[0]
+
+        # EM on variance components over Henderson's mixed-model equations
+        sig_e, sig_u = 1.0, 1.0
+        beta = np.zeros(P1)
+        u = np.zeros(q)
+        M = np.block([[XtX, XtZ], [XtZ.T, ZtZ]])  # iteration-invariant block
+        rhs = np.concatenate([Xty, Zty])
+        for it in range(max(p.max_iterations, 10)):
+            job.check_cancelled()
+            lam = sig_e / max(sig_u, 1e-12)
+            A = M.copy()
+            A[P1:, P1:] += lam * np.eye(q)
+            A[np.diag_indices_from(A)] += 1e-8
+            Ainv = np.linalg.inv(A)  # one factorization serves solve + traces
+            sol = Ainv @ rhs
+            beta_new, u_new = sol[:P1], sol[P1:]
+            # E-step traces from the random-effect block of A⁻¹·σe²
+            Tuu = Ainv[P1:, P1:] * sig_e
+            sse = yty - 2 * rhs @ sol + sol @ (M @ sol)
+            # standard LMM EM updates (Laird-Ware / Searle):
+            #   σe² ← (êᵀê + σe²[(p+q) − λ·tr(A⁻¹_uu)])/n
+            #   σu² ← (ûᵀû + tr(Tuu))/q,  Tuu = σe²·A⁻¹_uu
+            sig_e_new = float((sse + sig_e * (P1 + q)
+                               - lam * np.trace(Tuu)) / max(neff, 1.0))
+            sig_u_new = float((u_new @ u_new + np.trace(Tuu)) / q)
+            done = (abs(sig_e_new - sig_e) < 1e-8 * max(sig_e, 1.0)
+                    and abs(sig_u_new - sig_u) < 1e-8 * max(sig_u, 1.0))
+            beta, u = beta_new, u_new
+            sig_e = max(sig_e_new, 1e-10)
+            sig_u = max(sig_u_new, 1e-10)
+            if done:
+                break
+
+        output = ModelOutput()
+        output.names = names + [rname]
+        output.domains = {n: fr.vec(n).domain for n in output.names}
+        output.response_domain = None
+        output.model_category = "Regression"
+        model = HGLMModel(p, output, dinfo, beta, GaussianF(), u,
+                          rname, list(rvec.domain))
+        model.varfix = sig_e       # residual variance (`to2dTableHGLM`)
+        model.varranef = sig_u     # random-effect variance
+        raw = model.score0_with_ranef(X, zi)
+        ym = jnp.where(w > 0, y, jnp.nan)
+        m = make_metrics("Regression", ym, raw,
+                         w if p.weights_column else None)
+        output.training_metrics = m
+        output.scoring_history = [{"iterations": it + 1,
+                                   "varfix": sig_e, "varranef": sig_u}]
+        return model
+
     def _varimp_from_beta(self, dinfo, beta):
         mag = np.abs(np.asarray(beta)[:-1])
         if mag.sum() <= 0:
@@ -994,6 +1113,55 @@ class GLM(ModelBuilder):
                 "relative_importance": mag[order],
                 "scaled_importance": mag[order] / mag.max(),
                 "percentage": mag[order] / mag.sum()}
+
+
+class HGLMModel(GLMModel):
+    """Mixed model y = Xβ + Zu + e. Predictions add the level's BLUP random
+    intercept when the level is known; unseen/NA levels fall back to the
+    fixed-effects mean (the reference scores HGLM the same way)."""
+
+    def __init__(self, params, output, dinfo, beta, family, ubeta,
+                 random_column, random_domain, key=None):
+        super().__init__(params, output, dinfo, beta, family, key=key)
+        self.ubeta = np.asarray(ubeta, np.float64)
+        self.random_column = random_column
+        self.random_domain = list(random_domain)
+
+    def coef_random(self) -> dict:
+        """Per-level random intercepts (the reference's ubeta table)."""
+        return {lvl: float(v) for lvl, v in zip(self.random_domain,
+                                                self.ubeta)}
+
+    def score0_with_ranef(self, X, zi) -> jax.Array:
+        beta = jnp.asarray(self.beta, jnp.float32)
+        eta = X @ beta[:-1] + beta[-1]
+        ub = jnp.asarray(self.ubeta, jnp.float32)
+        ranef = jnp.where((zi >= 0) & (zi < len(self.random_domain)),
+                          ub[jnp.clip(zi, 0, len(self.random_domain) - 1)],
+                          0.0)
+        return eta + ranef
+
+    def predict(self, fr: Frame) -> Frame:
+        from ..frame.vec import Vec
+
+        X = self.adapt_frame(fr)
+        rv = (fr.vec(self.random_column)
+              if self.random_column in fr.names else None)
+        if rv is not None and rv.domain is not None:
+            # remap the scoring frame's levels into the training domain
+            lut = np.full(len(rv.domain), -1, np.int32)
+            for i, lvl in enumerate(rv.domain):
+                if lvl in self.random_domain:
+                    lut[i] = self.random_domain.index(lvl)
+            codes = np.nan_to_num(rv.to_numpy(), nan=-1.0).astype(np.int32)
+            zi_np = np.full(X.shape[0], -1, np.int32)  # X rows are padded
+            zi_np[:len(codes)] = np.where(codes >= 0,
+                                          lut[np.clip(codes, 0, None)], -1)
+            zi = jnp.asarray(zi_np)
+        else:
+            zi = jnp.full((X.shape[0],), -1, jnp.int32)
+        mu = self.score0_with_ranef(X, zi)
+        return Frame(["predict"], [Vec.from_device(mu, fr.nrow)])
 
 
 class GLMOrdinalModel(GLMModel):

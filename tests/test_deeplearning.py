@@ -88,3 +88,23 @@ def test_dl_sgd_and_dropout(xor_frame):
         input_dropout_ratio=0.05, mini_batch_size=64,
     )).train_model()
     assert m.output.training_metrics.auc > 0.8
+
+
+def test_deepfeatures_layer_extraction():
+    rng = np.random.default_rng(0)
+    n = 300
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] ** 2).astype(np.float32)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = y
+    fr = Frame.from_dict(cols)
+    m = DeepLearning(DeepLearningParameters(
+        training_frame=fr, response_column="y", hidden=[16, 8],
+        epochs=3, seed=1)).train_model()
+    df0 = m.deepfeatures(fr, 0)
+    df1 = m.deepfeatures(fr, 1)
+    assert df0.ncol == 16 and df1.ncol == 8 and df0.nrow == n
+    assert df0.names[0] == "DF.L1.C1"
+    import pytest
+    with pytest.raises(ValueError):
+        m.deepfeatures(fr, 2)

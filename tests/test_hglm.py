@@ -1,0 +1,86 @@
+"""HGLM — random-intercept linear mixed model (`hex/glm/GLM.java` HGLM path,
+restricted like the reference to one categorical random column)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.glm import GLM, GLMParameters
+
+
+def _mixed_data(n_groups=30, per_group=60, seed=0,
+                sig_u=1.5, sig_e=0.5):
+    rng = np.random.default_rng(seed)
+    n = n_groups * per_group
+    g = np.repeat(np.arange(n_groups), per_group)
+    u = rng.normal(0, sig_u, n_groups)
+    x = rng.normal(size=n)
+    y = 2.0 * x + 1.0 + u[g] + rng.normal(0, sig_e, n)
+    fr = Frame.from_dict({"x": x.astype(np.float32)})
+    fr.add("grp", Vec.from_numpy(g.astype(np.float32), type=T_CAT,
+                                 domain=[f"g{i}" for i in range(n_groups)]))
+    fr.add("y", Vec.from_numpy(y.astype(np.float32)))
+    return fr, u
+
+
+def test_hglm_recovers_fixed_and_variance_components():
+    fr, u = _mixed_data()
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", HGLM=True,
+                          random_columns=["grp"],
+                          standardize=False)).train_model()
+    coef = m.coef()
+    assert abs(coef["x"] - 2.0) < 0.05, coef
+    assert abs(coef["Intercept"] - 1.0) < 0.5  # absorbed into grand mean
+    # variance components: sig_u^2 = 2.25, sig_e^2 = 0.25
+    assert abs(m.varranef - 2.25) < 0.8, m.varranef
+    assert abs(m.varfix - 0.25) < 0.08, m.varfix
+    # BLUPs shrink toward but track the true random effects
+    ub = m.coef_random()
+    est = np.array([ub[f"g{i}"] for i in range(30)])
+    c = np.corrcoef(est, u - np.mean(u))[0, 1]
+    assert c > 0.97, c
+
+
+def test_hglm_prediction_uses_blups():
+    fr, _ = _mixed_data(seed=1)
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", HGLM=True,
+                          random_columns=["grp"],
+                          standardize=False)).train_model()
+    pred_with = m.predict(fr).vec(0).to_numpy()
+    y = fr.vec("y").to_numpy()
+    # with random effects the fit is much tighter than fixed-only
+    fixed_only = GLM(GLMParameters(training_frame=fr, response_column="y",
+                                   family="gaussian", lambda_=0.0,
+                                   ignored_columns=["grp"],
+                                   standardize=False)).train_model()
+    pred_fixed = fixed_only.predict(fr).vec(0).to_numpy()
+    assert np.mean((y - pred_with) ** 2) < 0.5 * np.mean(
+        (y - pred_fixed) ** 2)
+    # unseen level scores at the fixed-effects mean (no crash)
+    f2 = Frame.from_dict({"x": np.zeros(2, np.float32)})
+    f2.add("grp", Vec.from_numpy(np.zeros(2, np.float32), type=T_CAT,
+                                 domain=["NEW_LEVEL"]))
+    out = m.predict(f2).vec(0).to_numpy()
+    assert np.isfinite(out).all()
+
+
+def test_hglm_validation():
+    fr, _ = _mixed_data(n_groups=3, per_group=5)
+    with pytest.raises(ValueError, match="exactly one random column"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", HGLM=True)).train_model()
+    with pytest.raises(ValueError, match="categorical"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", HGLM=True,
+                          random_columns=["x"])).train_model()
+
+
+def test_hglm_rejects_non_gaussian():
+    fr, _ = _mixed_data(n_groups=3, per_group=5)
+    with pytest.raises(NotImplementedError, match="gaussian"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="poisson", HGLM=True,
+                          random_columns=["grp"])).train_model()

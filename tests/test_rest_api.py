@@ -320,3 +320,28 @@ class TestRapidsExec:
     def test_unbalanced_raises(self):
         with pytest.raises(ValueError):
             self.R.exec("(mean (cols rapids_fr 'a'")
+
+
+class TestTls:
+    def test_https_roundtrip(self, tmp_path):
+        import subprocess
+
+        cert = str(tmp_path / "cert.pem")
+        key = str(tmp_path / "key.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+             key, "-out", cert, "-days", "1", "-nodes", "-subj",
+             "/CN=127.0.0.1", "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+        from h2o_tpu.api.server import H2OServer
+
+        srv = H2OServer(port=54990, name="tls",
+                        ssl_certfile=cert, ssl_keyfile=key).start()
+        try:
+            assert srv.url.startswith("https://")
+            conn = h2o.H2OConnection(srv.url, verify_ssl_certificates=False)
+            assert conn.request("GET", "/3/Cloud")["cloud_healthy"]
+            strict = h2o.H2OConnection(srv.url, cacert=cert)
+            assert strict.request("GET", "/3/Cloud")["cloud_healthy"]
+        finally:
+            srv.stop()
