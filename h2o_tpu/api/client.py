@@ -5,9 +5,9 @@ Mirrors the reference's client architecture: a connection object wrapping the
 versioned JSON endpoints (`h2o-py/h2o/backend/connection.py:249,431`), module
 functions (``init/connect/import_file/get_frame/remove/rapids/shutdown``), an
 ``H2OFrame`` handle whose operations compile to Rapids expressions posted to
-`/99/Rapids` (`h2o-py/h2o/expr.py:27-44` — the reference batches them lazily;
-here each op evaluates eagerly, a deliberate divergence since the server is
-in-process and round-trips are free), and estimator classes over
+`/99/Rapids` lazily (`h2o-py/h2o/expr.py:27-44` ExprNode DAG: frame ops hold
+a pending expression, nested ops fuse, and one `(tmp= ...)` materializes on
+first identity/data access), and estimator classes over
 `/3/ModelBuilders/{algo}` (`h2o-py/h2o/estimators/`).
 
 ``init()`` with no running server boots an in-process `H2OServer` — the analog
@@ -17,6 +17,7 @@ of h2o.init() spawning a local JVM (`h2o-py/h2o/h2o.py:287`).
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
@@ -229,23 +230,73 @@ def rapids(expr: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# H2OFrame handle (`h2o-py/h2o/frame.py`)
+# H2OFrame handle (`h2o-py/h2o/frame.py` + the lazy `h2o-py/h2o/expr.py`
+# ExprNode DAG: frame-producing ops build a pending rapids expression and
+# only materialize — one `(tmp= name expr)` POST — when the frame's identity
+# or data is actually needed. Nested ops fuse into a single server round-trip
+# the way h2o-py's expression DAG does.)
 # ---------------------------------------------------------------------------
+import itertools as _itertools
+
+_TMP_COUNTER = _itertools.count(1)  # atomic under the GIL (worker threads)
+
+
 class H2OFrame:
     def __init__(self, python_obj=None, destination_frame: str | None = None):
+        self._pending: str | None = None  # un-materialized rapids expression
+        self._inlined = False  # pending expr already embedded somewhere once
         if python_obj is not None:
             other = upload_frame(python_obj, destination_frame)
-            self.frame_id = other.frame_id
+            self._id = other.frame_id
             self._schema = other._schema
         else:
-            self.frame_id = None
+            self._id = None
             self._schema = None
 
     @classmethod
     def _by_id(cls, frame_id: str) -> "H2OFrame":
         fr = cls()
-        fr.frame_id = frame_id
+        fr._id = frame_id
         return fr
+
+    @classmethod
+    def _lazy(cls, expr: str) -> "H2OFrame":
+        fr = cls()
+        fr._pending = expr
+        return fr
+
+    @property
+    def frame_id(self) -> str:
+        """Materializes a pending expression on first identity access
+        (h2o-py `ExprNode._eager_frame`)."""
+        if self._id is None and self._pending is not None:
+            name = f"py_{next(_TMP_COUNTER)}_{os.getpid()}"
+            rapids(f"(tmp= {name} {self._pending})")
+            self._id = name
+            self._pending = None
+        return self._id
+
+    @frame_id.setter
+    def frame_id(self, value: str):
+        self._id = value
+        self._pending = None
+
+    def _ref(self) -> str:
+        """Expression fragment for embedding in a larger rapids expression.
+
+        A pending expression inlines ONCE (fusion, no round-trip); any later
+        reference materializes and embeds the key instead — this caps the
+        expression-string growth of reused/self-referencing lazy frames
+        (h2o-py's ExprNode caches evaluated nodes for the same reason) and
+        keeps repeated scalar reductions from re-evaluating the chain."""
+        if self._id is None and self._pending is not None and \
+                not self._inlined:
+            self._inlined = True
+            return self._pending
+        return self.frame_id
+
+    def _fr(self, expr: str) -> "H2OFrame":
+        return H2OFrame._lazy(expr)
 
     # -- metadata ------------------------------------------------------------
     def _summary(self) -> dict:
@@ -295,15 +346,15 @@ class H2OFrame:
 
     def __getitem__(self, sel):
         if isinstance(sel, str):
-            return self._exec(f"(cols {self.frame_id} '{sel}')")
+            return self._fr(f"(cols {self._ref()} '{sel}')")
         if isinstance(sel, int):
-            return self._exec(f"(cols {self.frame_id} {sel})")
+            return self._fr(f"(cols {self._ref()} {sel})")
         if isinstance(sel, list):
             inner = " ".join(f"'{s}'" if isinstance(s, str) else str(s)
                              for s in sel)
-            return self._exec(f"(cols {self.frame_id} [{inner}])")
+            return self._fr(f"(cols {self._ref()} [{inner}])")
         if isinstance(sel, H2OFrame):  # boolean mask frame
-            return self._exec(f"(rows {self.frame_id} (cols {sel.frame_id} 0))")
+            return self._fr(f"(rows {self._ref()} (cols {sel._ref()} 0))")
         raise TypeError(f"bad selector {sel!r}")
 
     @staticmethod
@@ -317,10 +368,14 @@ class H2OFrame:
         return repr(float(value))
 
     def __setitem__(self, sel, value):
-        """In-place column/slice update: `(append ...)` for a new column,
-        `(:= ...)` rectangle assign otherwise (h2o-py `H2OFrame.__setitem__`
-        → `AstAppend`/`AstRectangleAssign`)."""
+        """Column/slice update: `(append ...)` for a new column, `(:= ...)`
+        rectangle assign otherwise (h2o-py `H2OFrame.__setitem__` →
+        `AstAppend`/`AstRectangleAssign`). The result is bound to a FRESH key
+        and this handle rebinds to it — outstanding lazy frames built from
+        the old key keep seeing the pre-mutation data, matching h2o-py's
+        immutable ExprNode-DAG semantics."""
         src = self._src_expr(value)
+        fid = self.frame_id
         if isinstance(sel, tuple) and len(sel) == 2:
             rowsel, colsel = sel
             rows = (f"(cols {rowsel.frame_id} 0)"
@@ -331,21 +386,24 @@ class H2OFrame:
                     str(int(rowsel)))
             cols = (f"'{colsel}'" if isinstance(colsel, str)
                     else str(int(colsel)))
-            expr = f"(:= {self.frame_id} {src} {cols} {rows})"
+            expr = f"(:= {fid} {src} {cols} {rows})"
         elif isinstance(sel, str) and sel not in self.columns:
-            expr = f"(append {self.frame_id} {src} '{sel}')"
+            expr = f"(append {fid} {src} '{sel}')"
         else:
             col = sel if not isinstance(sel, str) else f"'{sel}'"
-            expr = f"(:= {self.frame_id} {src} {col} [])"
-        self._exec(f"(assign {self.frame_id} {expr})")
+            expr = f"(:= {fid} {src} {col} [])"
+        name = f"py_{next(_TMP_COUNTER)}_{os.getpid()}"
+        rapids(f"(tmp= {name} {expr})")
+        self._id = name
+        self._pending = None
         self.refresh()
 
     def _binop(self, op, other, reverse=False):
-        rhs = other.frame_id if isinstance(other, H2OFrame) else repr(float(other))
-        lhs = self.frame_id
+        rhs = other._ref() if isinstance(other, H2OFrame) else repr(float(other))
+        lhs = self._ref()
         if reverse:
             lhs, rhs = rhs, lhs
-        return self._exec(f"({op} {lhs} {rhs})")
+        return self._fr(f"({op} {lhs} {rhs})")
 
     def __add__(self, o):
         return self._binop("+", o)
@@ -389,52 +447,55 @@ class H2OFrame:
         return self._binop("|", o)
 
     def mean(self, na_rm=True):
-        return self._exec(f"(mean {self.frame_id} {'true' if na_rm else 'false'})")
+        return self._exec(f"(mean {self._ref()} {'true' if na_rm else 'false'})")
 
     def sum(self, na_rm=True):
-        return self._exec(f"(sum {self.frame_id} {'true' if na_rm else 'false'})")
+        return self._exec(f"(sum {self._ref()} {'true' if na_rm else 'false'})")
 
     def min(self):
-        return self._exec(f"(min {self.frame_id} true)")
+        return self._exec(f"(min {self._ref()} true)")
 
     def max(self):
-        return self._exec(f"(max {self.frame_id} true)")
+        return self._exec(f"(max {self._ref()} true)")
 
     def sd(self):
-        return self._exec(f"(sd {self.frame_id} true)")
+        return self._exec(f"(sd {self._ref()} true)")
 
     def asfactor(self) -> "H2OFrame":
-        return self._exec(f"(as.factor {self.frame_id})")
+        return self._fr(f"(as.factor {self._ref()})")
 
     def asnumeric(self) -> "H2OFrame":
-        return self._exec(f"(as.numeric {self.frame_id})")
+        return self._fr(f"(as.numeric {self._ref()})")
 
     def unique(self) -> "H2OFrame":
-        return self._exec(f"(unique {self.frame_id})")
+        return self._fr(f"(unique {self._ref()})")
 
     def table(self) -> "H2OFrame":
-        return self._exec(f"(table {self.frame_id})")
+        return self._fr(f"(table {self._ref()})")
 
     def cbind(self, other: "H2OFrame") -> "H2OFrame":
-        return self._exec(f"(cbind {self.frame_id} {other.frame_id})")
+        return self._fr(f"(cbind {self._ref()} {other._ref()})")
 
     def rbind(self, other: "H2OFrame") -> "H2OFrame":
-        return self._exec(f"(rbind {self.frame_id} {other.frame_id})")
+        return self._fr(f"(rbind {self._ref()} {other._ref()})")
 
     def skewness(self, na_rm=True):
-        return self._exec(f"(skewness {self.frame_id} true)")
+        return self._exec(f"(skewness {self._ref()} true)")
 
     def kurtosis(self, na_rm=True):
-        return self._exec(f"(kurtosis {self.frame_id} true)")
+        return self._exec(f"(kurtosis {self._ref()} true)")
 
     def cor(self, other: "H2OFrame" = None):
-        o = other.frame_id if other is not None else self.frame_id
-        return self._exec(f"(cor {self.frame_id} {o} 'everything' 'Pearson')")
+        if other is None:
+            fid = self.frame_id  # one evaluation, embedded twice by key
+            return self._exec(f"(cor {fid} {fid} 'everything' 'Pearson')")
+        return self._exec(f"(cor {self._ref()} {other._ref()} "
+                          f"'everything' 'Pearson')")
 
     def quantile(self, prob=(0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9,
                              0.99)) -> "H2OFrame":
         ps = " ".join(str(p) for p in prob)
-        return self._exec(f"(quantile {self.frame_id} [{ps}] 'interpolate' _)")
+        return self._fr(f"(quantile {self._ref()} [{ps}] 'interpolate' _)")
 
     def impute(self, column=-1, method="mean"):
         return self._exec(f"(h2o.impute {self.frame_id} {column} '{method}' "
@@ -443,20 +504,20 @@ class H2OFrame:
     def scale(self, center=True, scale=True) -> "H2OFrame":
         c = "true" if center else "false"
         s = "true" if scale else "false"
-        return self._exec(f"(scale {self.frame_id} {c} {s})")
+        return self._fr(f"(scale {self._ref()} {c} {s})")
 
     def na_omit(self) -> "H2OFrame":
-        return self._exec(f"(na.omit {self.frame_id})")
+        return self._fr(f"(na.omit {self._ref()})")
 
     def fillna(self, method="forward", axis=0, maxlen=1) -> "H2OFrame":
-        return self._exec(f"(h2o.fillna {self.frame_id} '{method}' {axis} "
-                          f"{maxlen})")
+        return self._fr(f"(h2o.fillna {self._ref()} '{method}' {axis} "
+                        f"{maxlen})")
 
     def match(self, table, nomatch=None) -> "H2OFrame":
         items = " ".join(f"'{t}'" if isinstance(t, str) else str(t)
                          for t in table)
         nm = "_" if nomatch is None else str(nomatch)
-        return self._exec(f"(match {self.frame_id} [{items}] {nm} 1)")
+        return self._fr(f"(match {self._ref()} [{items}] {nm} 1)")
 
     def cut(self, breaks, labels=None, include_lowest=False,
             right=True) -> "H2OFrame":
@@ -465,10 +526,10 @@ class H2OFrame:
             "[" + " ".join(f"'{l}'" for l in labels) + "]"
         il = "true" if include_lowest else "false"
         r = "true" if right else "false"
-        return self._exec(f"(cut {self.frame_id} [{bs}] {lb} {il} {r} 3)")
+        return self._fr(f"(cut {self._ref()} [{bs}] {lb} {il} {r} 3)")
 
     def difflag1(self) -> "H2OFrame":
-        return self._exec(f"(difflag1 {self.frame_id})")
+        return self._fr(f"(difflag1 {self._ref()})")
 
     def kfold_column(self, n_folds=3, seed=-1) -> "H2OFrame":
         return self._exec(f"(kfold_column {self.frame_id} {n_folds} {seed})")

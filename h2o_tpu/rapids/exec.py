@@ -50,8 +50,12 @@ class Session:
         return STORE.get(name)
 
     def assign(self, name: str, value):
+        if isinstance(value, Vec):
+            # a keyed temp is always frame-shaped (the reference's tmp= puts
+            # a Frame in DKV even for single-Vec expression results)
+            value = _as_frame(value)
         self.temps[name] = value
-        if isinstance(value, (Frame, Vec)):
+        if isinstance(value, Frame):
             value.key = name
             STORE.put(name, value)
         return value

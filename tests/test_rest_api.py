@@ -269,6 +269,40 @@ class TestRestApi:
         finally:
             srv.stop()
 
+    def test_lazy_expression_fusion(self, cloud):
+        """Frame ops build a pending rapids DAG (h2o-py expr.py analog):
+        chained arithmetic + reduction runs as ONE /99/Rapids POST."""
+        fr = h2o.H2OFrame({"a": [1.0, 2.0, 3.0], "b": [2.0, 2.0, 2.0]})
+        conn = h2o.connection()
+        calls = []
+        orig = conn.request
+
+        def counting(method, path, *a, **kw):
+            calls.append(path)
+            return orig(method, path, *a, **kw)
+
+        conn.request = counting
+        try:
+            expr = (fr["a"] * 2 + fr["b"]) / 2
+            assert expr._pending is not None  # nothing sent yet
+            assert not calls
+            val = expr.sum()                  # one fused round-trip
+            assert val == 9.0
+            rapids_calls = [c for c in calls if "Rapids" in c]
+            assert len(rapids_calls) == 1, calls
+            # materialization POSTs exactly one more rapids call
+            n = len(calls)
+            fid = expr.frame_id
+            assert expr._pending is None
+            new_rapids = [c for c in calls[n:] if "Rapids" in c]
+            assert len(new_rapids) == 1, calls[n:]
+            assert h2o.get_frame(fid).nrow == 3
+            # reuse after a first inline embeds the key, not the expression
+            twice = expr + expr
+            assert twice.sum() == 2 * val
+        finally:
+            conn.request = orig
+
     def test_model_builders_metadata(self, cloud):
         mb = h2o.connection().request("GET", "/3/ModelBuilders")
         assert "gbm" in mb["model_builders"]
