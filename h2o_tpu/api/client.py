@@ -446,6 +446,38 @@ class H2OFrame:
     def __or__(self, o):
         return self._binop("|", o)
 
+    # unary math surface (h2o-py H2OFrame.cos/log/... — each compiles to
+    # the matching rapids prim lazily)
+    def _unop(self, op) -> "H2OFrame":
+        return self._fr(f"({op} {self._ref()})")
+
+    def cos(self): return self._unop("cos")          # noqa: E704
+    def sin(self): return self._unop("sin")          # noqa: E704
+    def tan(self): return self._unop("tan")          # noqa: E704
+    def acos(self): return self._unop("acos")        # noqa: E704
+    def asin(self): return self._unop("asin")        # noqa: E704
+    def atan(self): return self._unop("atan")        # noqa: E704
+    def cosh(self): return self._unop("cosh")        # noqa: E704
+    def sinh(self): return self._unop("sinh")        # noqa: E704
+    def tanh(self): return self._unop("tanh")        # noqa: E704
+    def exp(self): return self._unop("exp")          # noqa: E704
+    def expm1(self): return self._unop("expm1")      # noqa: E704
+    def log(self): return self._unop("log")          # noqa: E704
+    def log1p(self): return self._unop("log1p")      # noqa: E704
+    def log2(self): return self._unop("log2")        # noqa: E704
+    def log10(self): return self._unop("log10")      # noqa: E704
+    def sqrt(self): return self._unop("sqrt")        # noqa: E704
+    def abs(self): return self._unop("abs")          # noqa: E704
+    def floor(self): return self._unop("floor")      # noqa: E704
+    def ceil(self): return self._unop("ceiling")     # noqa: E704
+    def trunc(self): return self._unop("trunc")      # noqa: E704
+    def sign(self): return self._unop("sign")        # noqa: E704
+    def gamma(self): return self._unop("gamma")      # noqa: E704
+    def lgamma(self): return self._unop("lgamma")    # noqa: E704
+    def digamma(self): return self._unop("digamma")  # noqa: E704
+    def trigamma(self): return self._unop("trigamma")  # noqa: E704
+    def logical_negation(self): return self._unop("not")  # noqa: E704
+
     def mean(self, na_rm=True):
         return self._exec(f"(mean {self._ref()} {'true' if na_rm else 'false'})")
 

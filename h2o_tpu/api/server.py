@@ -388,6 +388,14 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             return 200, {"job": {"status": "DONE", "dest": path}}
         if rest[2:] and rest[2] == "summary":
             return 200, {"frames": [schemas.frame_schema(fr, npreview=0)]}
+        if rest[2:] and rest[2] == "columns":
+            # columns-only payload, no row preview (`FramesHandler.columns`)
+            full = schemas.frame_schema(fr, npreview=0)
+            return 200, {"frames": [{
+                "frame_id": full["frame_id"],
+                "rows": full["rows"],
+                "num_columns": full["num_columns"],
+                "columns": full["columns"]}]}
         n = int(p.get("row_count", 10) or 10)
         return 200, {"frames": [schemas.frame_schema(fr, npreview=n)]}
 
@@ -589,8 +597,90 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         from ..utils.devicebench import network_test
 
         return 200, network_test()
+    if head == "Metadata":
+        # `/3/Metadata/endpoints` + `/3/Metadata/schemas` — the
+        # schema-metadata surface that drives client codegen
+        # (`water/api/MetadataHandler`, consumed by h2o-bindings)
+        sub = rest[1] if rest[1:] else "endpoints"
+        if sub == "endpoints":
+            return 200, {"routes": _ROUTES_DOC}
+        if sub == "schemas":
+            # reference schema-class naming (`hex/schemas/*V3`): acronym
+            # algos keep their acronym, the rest camel-case
+            special = {"gbm": "GBM", "drf": "DRF", "glm": "GLM",
+                       "gam": "GAM", "psvm": "PSVM", "svd": "SVD",
+                       "pca": "PCA", "glrm": "GLRM", "coxph": "CoxPH",
+                       "anovaglm": "ANOVAGLM", "dt": "DT",
+                       "kmeans": "KMeans", "deeplearning": "DeepLearning",
+                       "naivebayes": "NaiveBayes",
+                       "isolationforest": "IsolationForest",
+                       "extendedisolationforest": "ExtendedIsolationForest",
+                       "upliftdrf": "UpliftDRF",
+                       "targetencoder": "TargetEncoder",
+                       "stackedensemble": "StackedEnsemble",
+                       "rulefit": "RuleFit", "isotonic": "IsotonicRegression",
+                       "modelselection": "ModelSelection",
+                       "adaboost": "AdaBoost", "word2vec": "Word2Vec",
+                       "aggregator": "Aggregator", "infogram": "Infogram",
+                       "generic": "Generic", "xgboost": "XGBoost"}
+            names = sorted(
+                {f"{special.get(a, a.capitalize())}ParametersV3"
+                 for a in registry.algo_names()}
+                | {"CloudV3", "FramesV3", "FrameV3", "JobsV3", "JobV3",
+                   "ModelsV3", "ModelSchemaV3", "ModelBuildersV3",
+                   "RapidsSchemaV3", "ImportFilesV3", "ParseV3",
+                   "ParseSetupV3", "InitIDV3", "ShutdownV3", "LogsV3",
+                   "TimelineV3", "ProfilerV3", "NetworkTestV3",
+                   "PartialDependenceV3", "PermutationVarImpV3",
+                   "TwoDimTableV3", "KeyV3", "H2OErrorV3"})
+            return 200, {"schemas": [{"name": n, "version": 3}
+                                     for n in names]}
+        return _err(404, f"unknown metadata view {sub}")
 
     return _err(404, f"no route for {method} /{'/'.join(parts)}")
+
+
+_ROUTES_DOC = [
+    {"http_method": m, "url_pattern": u, "summary": s}
+    for m, u, s in [
+        ("GET", "/3/Cloud", "cluster status"),
+        ("GET", "/3/About", "version info"),
+        ("POST", "/3/Shutdown", "shut the cluster down"),
+        ("GET", "/3/ImportFiles", "import files by path/URI"),
+        ("POST", "/3/ParseSetup", "guess parse setup"),
+        ("POST", "/3/Parse", "parse files into a Frame"),
+        ("GET", "/3/Frames", "list frames"),
+        ("GET", "/3/Frames/{id}/summary", "frame summary with column stats"),
+        ("GET", "/3/Frames/{id}/columns", "frame columns"),
+        ("POST", "/3/Frames/{id}/export", "export a frame to csv/parquet"),
+        ("DELETE", "/3/Frames/{id}", "remove a frame"),
+        ("GET", "/3/ModelBuilders", "list algorithms"),
+        ("GET", "/3/ModelBuilders/{algo}", "algorithm parameter metadata"),
+        ("POST", "/3/ModelBuilders/{algo}", "launch a training job"),
+        ("GET", "/3/Models", "list models"),
+        ("GET", "/3/Models/{id}", "model detail"),
+        ("GET", "/3/Models/{id}/mojo", "export MOJO"),
+        ("DELETE", "/3/Models/{id}", "remove a model"),
+        ("POST", "/3/Predictions/models/{m}/frames/{f}", "score a frame"),
+        ("POST", "/3/PartialDependence", "partial dependence"),
+        ("POST", "/3/PermutationVarImp", "permutation importance"),
+        ("GET", "/3/Jobs", "list jobs"),
+        ("GET", "/3/Jobs/{id}", "poll a job"),
+        ("POST", "/3/Jobs/{id}/cancel", "cancel a job"),
+        ("POST", "/99/Rapids", "execute a rapids expression"),
+        ("POST", "/3/InitID", "open a session"),
+        ("DELETE", "/3/InitID", "end a session"),
+        ("GET", "/3/JStack", "thread stack dump"),
+        ("GET", "/3/Logs", "node log ring"),
+        ("GET", "/3/Timeline", "event timeline ring"),
+        ("GET", "/3/Profiler", "stack-sample profile"),
+        ("GET", "/3/WaterMeterCpuTicks/{node}", "cpu tick counters"),
+        ("GET", "/3/WaterMeterIo", "io counters"),
+        ("GET", "/3/NetworkTest", "device microbenchmarks"),
+        ("GET", "/3/Metadata/endpoints", "this listing"),
+        ("GET", "/3/Metadata/schemas", "schema catalog"),
+    ]
+]
 
 
 def _dest_name(path: str) -> str:

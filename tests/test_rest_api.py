@@ -379,3 +379,24 @@ class TestTls:
             assert strict.request("GET", "/3/Cloud")["cloud_healthy"]
         finally:
             srv.stop()
+
+
+class TestMetadata:
+    def test_endpoints_and_schemas(self, cloud):
+        eps = h2o.connection().request("GET", "/3/Metadata/endpoints")
+        urls = {r["url_pattern"] for r in eps["routes"]}
+        assert "/99/Rapids" in urls and "/3/ModelBuilders/{algo}" in urls
+        sch = h2o.connection().request("GET", "/3/Metadata/schemas")
+        names = {s["name"] for s in sch["schemas"]}
+        assert "GBMParametersV3" in names and "ModelSchemaV3" in names
+
+    def test_schema_names_and_columns_route(self, cloud):
+        sch = h2o.connection().request("GET", "/3/Metadata/schemas")
+        names = {s["name"] for s in sch["schemas"]}
+        assert "DeepLearningParametersV3" in names  # camel-case, not upper
+        assert "KMeansParametersV3" in names
+        fr = h2o.H2OFrame({"a": [1.0, 2.0]})
+        cols = h2o.connection().request(
+            "GET", f"/3/Frames/{fr.frame_id}/columns")["frames"][0]
+        assert cols["num_columns"] == 1 and "columns" in cols
+        assert not cols["columns"][0].get("data")  # no row preview payload
