@@ -252,3 +252,48 @@ def test_merge_empty_left():
     right = Frame.from_dict({"k": np.array([1.0], np.float32),
                              "w": np.array([2.0], np.float32)})
     assert merge(left, right, by=["k"]).nrow == 0
+
+
+def test_merge_duplicate_keys_and_na_vs_pandas():
+    """Randomized check of the combined-sort join against pandas: duplicate
+    right keys (expansion), unmatched rows, NA keys, inner + left joins."""
+    import pandas as pd
+    from h2o_tpu.rapids.merge import merge as h2o_merge
+
+    rng = np.random.default_rng(5)
+    ln, rn = 5000, 300
+    lk = rng.integers(0, 200, ln).astype(np.float32)
+    lk[rng.random(ln) < 0.05] = np.nan
+    rk = rng.integers(0, 250, rn).astype(np.float32)  # dups + unmatched
+    left = Frame.from_dict({"key": lk, "x": np.arange(ln, dtype=np.float32)})
+    right = Frame.from_dict({"key": rk,
+                             "v": rng.normal(size=rn).astype(np.float32)})
+    ldf = pd.DataFrame({"key": lk, "x": np.arange(ln, dtype=np.float32)})
+    rdf = pd.DataFrame({"key": rk, "v": np.asarray(
+        right.vec("v").to_numpy())})
+
+    for all_x, how in ((False, "inner"), (True, "left")):
+        ours = h2o_merge(left, right, all_x=all_x)
+        want = ldf.merge(rdf, on="key", how=how)
+        assert ours.nrow == len(want), (all_x, ours.nrow, len(want))
+        a = (pd.DataFrame({"key": ours.vec("key").to_numpy(),
+                           "x": ours.vec("x").to_numpy(),
+                           "v": ours.vec("v").to_numpy()})
+             .sort_values(["x", "v"]).reset_index(drop=True))
+        b = want[["key", "x", "v"]].sort_values(["x", "v"]) \
+            .reset_index(drop=True)
+        np.testing.assert_allclose(a["x"], b["x"])
+        np.testing.assert_allclose(a["v"], b["v"], equal_nan=True)
+
+
+def test_merge_signed_zero_keys_join():
+    from h2o_tpu.rapids.merge import merge as h2o_merge
+
+    left = Frame.from_dict({"key": np.array([0.0, 1.0], np.float32),
+                            "x": np.array([1.0, 2.0], np.float32)})
+    right = Frame.from_dict({"key": np.array([-0.0, 1.0], np.float32),
+                             "v": np.array([7.0, 8.0], np.float32)})
+    out = h2o_merge(left, right)
+    assert out.nrow == 2
+    v = dict(zip(out.vec("x").to_numpy(), out.vec("v").to_numpy()))
+    assert v[1.0] == 7.0 and v[2.0] == 8.0
