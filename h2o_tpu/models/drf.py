@@ -9,8 +9,9 @@ and prediction averages over trees. XRT = DRF with random split thresholds; we
 approximate via stronger per-split column sampling (histogram splits are
 already coarsely discretized) — documented divergence.
 
-OOB scoring (`DRF.java` OOB handling) is a planned follow-up; training metrics
-are currently in-bag.
+Training metrics are OOB-based like the reference (`DRF.java` OOB scoring):
+the tree scan accumulates each row's out-of-bag tree outputs, and the final
+reported metrics average only trees whose bag excluded the row.
 """
 
 from __future__ import annotations

@@ -401,18 +401,31 @@ class GBM(ModelBuilder):
         import time as _t
 
         stop_metric_series = []
+        oob_sum = oob_cnt = None
         for ci, (keys, rates) in enumerate(chunks):
             job.check_cancelled()
             if history and job.time_exceeded():  # keep the partial forest
                 break
-            f, trees = train_fn(Xb, y_k, w, f, edges, edge_ok, keys, rates,
-                                mono, imat)
+            f, osum, ocnt, trees = train_fn(Xb, y_k, w, f, edges, edge_ok,
+                                            keys, rates, mono, imat)
+            oob_sum = osum if oob_sum is None else oob_sum + osum
+            oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
             parts.append(trees)
             ntrees_done = sum(t[0].shape[0] for t in parts)
-            m = make_metrics(category, jnp.where(ymask, y, jnp.nan),
-                             _metrics_raw(category, dist, f, self.drf_mode,
-                                          ntrees_done),
-                             None if p.weights_column is None else w)
+            # DRF scores OOB throughout (history + early stopping), so the
+            # stopping signal is honest, not in-bag memorization; OOB spans
+            # only this build's trees, hence the checkpoint gate below
+            m = None
+            if self.drf_mode and p.sample_rate < 1.0 and prior is None:
+                m = self._oob_metrics(category, oob_sum, oob_cnt, y, ymask,
+                                      w if p.weights_column else None)
+                if m is not None:
+                    m.description = "Reported on OOB data"
+            if m is None:
+                m = make_metrics(category, jnp.where(ymask, y, jnp.nan),
+                                 _metrics_raw(category, dist, f,
+                                              self.drf_mode, ntrees_done),
+                                 None if p.weights_column is None else w)
             history.append({"timestamp": _t.time(), "number_of_trees": ntrees_done,
                             "training_metrics": m})
             job.update(len(keys) / max(n_new, 1))
@@ -422,6 +435,10 @@ class GBM(ModelBuilder):
             if self._should_stop(m, stop_metric_series):
                 break
         output.scoring_history = history
+        # DRF training metrics are the OOB metrics from the chunk loop above;
+        # checkpoint continuations fall back to in-bag (prior trees' bags are
+        # not recoverable, and one new tree's OOB would misrepresent the
+        # whole forest)
         output.training_metrics = history[-1]["training_metrics"]
 
         forest = _assemble_forest(parts)
@@ -438,6 +455,27 @@ class GBM(ModelBuilder):
         if p.validation_frame is not None:
             output.validation_metrics = model.model_performance(p.validation_frame)
         return model
+
+    def _oob_metrics(self, category, osum, ocnt, y, ymask, w):
+        """Metrics over out-of-bag predictions: rows never out of bag (tiny
+        forests) are excluded like the reference's OOB scorer."""
+        seen = ocnt > 0
+        if not bool(jnp.any(seen & ymask)):
+            return None
+        cnt = jnp.maximum(ocnt, 1.0)
+        ym = jnp.where(ymask & seen, y, jnp.nan)
+        if category == "Regression":
+            raw = osum / cnt
+        elif category == "Binomial":
+            p1 = jnp.clip(osum / cnt, 0.0, 1.0)
+            raw = jnp.stack([(p1 > 0.5).astype(jnp.float32), 1 - p1, p1],
+                            axis=1)
+        else:  # Multinomial: per-class sums (K, R)
+            p = jnp.clip(osum / cnt[None, :], 1e-9, 1.0).T
+            p = p / jnp.sum(p, axis=1, keepdims=True)
+            label = jnp.argmax(p, axis=1).astype(jnp.float32)
+            raw = jnp.concatenate([label[:, None], p], axis=1)
+        return make_metrics(category, ym, raw, w)
 
     def _fit_calibration(self, model, category):
         """Platt scaling on a holdout (`hex/tree/CalibrationHelper`): a 1-D
@@ -602,7 +640,9 @@ def _interaction_matrix(names, groups) -> np.ndarray:
 def _metrics_raw(category, dist, f, drf_mode, ntrees):
     """Convert carried link predictions to the score0 output layout."""
     if category == "Regression":
-        return dist.linkinv(f)
+        # DRF carries the SUM of per-tree leaf means; the prediction is the
+        # average (prediction path divides in _raw_f — metrics must too)
+        return f / max(ntrees, 1) if drf_mode else dist.linkinv(f)
     if category == "Binomial":
         p1 = dist.linkinv(f) if not drf_mode else jnp.clip(f / max(ntrees, 1), 0, 1)
         return jnp.stack([(p1 > 0.5).astype(jnp.float32), 1 - p1, p1], axis=1)

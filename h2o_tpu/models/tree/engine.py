@@ -406,8 +406,10 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     a fresh closure and jax's compile cache misses (AdaBoost re-trains a
     learner per round; a per-learner recompile turned 30 stumps into minutes).
 
-    Returns train(Xb, y, w, f0, edges, edge_ok, key, ntrees_chunk) ->
-    (f, (feat, thr, nanL, val) stacked over trees).
+    Returns train(Xb, y, w, f0, edges, edge_ok, keys, rates, mono, imat) ->
+    (f, oob_sum, oob_cnt, (feat, thr, nanL, val, gain) stacked over trees);
+    oob_sum/oob_cnt accumulate each row's out-of-bag tree outputs for DRF's
+    OOB scoring (zeros when sample_rate == 1).
     """
     mesh = mesh or default_mesh()
     if cache_key is not None:
@@ -421,7 +423,8 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
         mono_arg = mono if cfg.use_monotone else None
         imat_arg = imat if cfg.use_interaction else None
 
-        def tree_step(f, key_rate):
+        def tree_step(carry, key_rate):
+            f, osum, ocnt = carry
             key, rate = key_rate  # rate: learn_rate_annealing^tree_index
             rowkey = jax.random.fold_in(key, jax.lax.axis_index(ROWS))
             if cfg.sample_rate < 1.0:
@@ -454,17 +457,23 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                 vl = vl * rate
                 delta = jax.vmap(leaf_delta)(vl, node)
             f = f + delta
-            return f, (ft, th, nl, vl, ga)
+            # OOB accumulation (`DRF.java` OOB scoring): rows outside this
+            # tree's bag collect its raw output; two (R,)-adds per tree
+            oob = 1.0 - s
+            osum = osum + delta * (oob if K == 1 else oob[None, :])
+            ocnt = ocnt + oob
+            return (f, osum, ocnt), (ft, th, nl, vl, ga)
 
-        f, trees = jax.lax.scan(tree_step, f, (keys, rates))
-        return f, trees
+        init = (f, jnp.zeros_like(f), jnp.zeros(w.shape[-1:], jnp.float32))
+        (f, osum, ocnt), trees = jax.lax.scan(tree_step, init, (keys, rates))
+        return f, osum, ocnt, trees
 
     fspec = P(ROWS) if K == 1 else P(None, ROWS)
     fn = shard_map(
         spmd, mesh=mesh,
         in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P(),
                   P(), P()),
-        out_specs=(fspec, (P(), P(), P(), P(), P())),
+        out_specs=(fspec, fspec, P(ROWS), (P(), P(), P(), P(), P())),
         check_vma=False,
     )
     jitted = jax.jit(fn)

@@ -140,3 +140,70 @@ def test_learn_rate_annealing_shrinks_later_trees():
     # 0.9^20 ~ 0.12: late trees must be much smaller than early ones
     assert leaf_mag[20] < leaf_mag[0] * 0.5
     assert m.output.training_metrics.r2 > 0.8
+
+
+def test_drf_oob_training_metrics():
+    """DRF training metrics are OOB-based (`DRF.java` OOB scoring): on noisy
+    data, in-bag AUC is optimistically high while OOB stays honest."""
+    from h2o_tpu.models.drf import DRF, DRFParameters
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    # weak signal + heavy noise: in-bag trees can memorize, OOB cannot
+    logits = 0.5 * x[:, 0]
+    yb = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    cols = {f"x{j}": x[:, j] for j in range(4)}
+    fr = Frame.from_dict(cols)
+    fr.add("y", Vec.from_numpy(yb, type=T_CAT, domain=["n", "p"]))
+    m = DRF(DRFParameters(training_frame=fr, response_column="y", ntrees=30,
+                          max_depth=10, seed=1)).train_model()
+    tm = m.output.training_metrics
+    assert getattr(tm, "description", "") == "Reported on OOB data"
+    # in-bag AUC of the same forest (direct rescoring) is higher than OOB
+    inbag = m.model_performance(fr)
+    assert inbag.auc > tm.auc > 0.5, (inbag.auc, tm.auc)
+
+
+def test_drf_regression_metrics_are_averaged():
+    """Carried-sum vs averaged-prediction bug guard: DRF regression training
+    RMSE must match the forest's actual predictions, not the tree sum."""
+    from h2o_tpu.models.drf import DRF, DRFParameters
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    x = rng.normal(size=n).astype(np.float32)
+    y = (2 * x + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = DRF(DRFParameters(training_frame=fr, response_column="y", ntrees=20,
+                          max_depth=6, seed=1)).train_model()
+    tm = m.output.training_metrics
+    assert tm.r2 > 0.9, tm.r2   # was -354 with the sum bug
+    pred = m.predict(fr).vec(0).to_numpy()
+    direct_rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    # OOB rmse is a bit above in-bag rescoring but the same order
+    assert tm.rmse < 4 * direct_rmse + 0.2
+
+
+def test_drf_checkpoint_falls_back_to_inbag_metrics():
+    """Checkpoint continuation can't reconstruct prior trees' bags, so the
+    continued model reports in-bag metrics (no OOB tag)."""
+    from h2o_tpu.models.drf import DRF, DRFParameters
+
+    rng = np.random.default_rng(6)
+    n = 800
+    x = rng.normal(size=n).astype(np.float32)
+    y = (2 * x + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    base = DRF(DRFParameters(training_frame=fr, response_column="y",
+                             ntrees=10, max_depth=5, seed=1)).train_model()
+    assert getattr(base.output.training_metrics, "description", "") \
+        == "Reported on OOB data"
+    cont = DRF(DRFParameters(training_frame=fr, response_column="y",
+                             ntrees=15, max_depth=5, seed=1,
+                             checkpoint=base)).train_model()
+    assert getattr(cont.output.training_metrics, "description", "") \
+        != "Reported on OOB data"
+    assert cont.ntrees == 15
