@@ -30,6 +30,7 @@ import numpy as np
 
 from ..backend.jobs import Job
 from ..frame.frame import Frame
+from ..frame.vec import Vec
 from .datainfo import DataInfo
 from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
 
@@ -277,6 +278,10 @@ class GLMParameters(Parameters):
                                      # random column, gaussian rand_family)
     random_columns: list = None      # [column name or index]
     rand_family: list = None         # ["gaussian"] (only member supported)
+    interactions: list = None        # columns whose pairwise products enter
+                                     # the design (`GLMModel.java:515`);
+                                     # numeric×numeric pairs (cat interactions
+                                     # via `h2o.interaction` + train)
     beta_constraints: object = None  # Frame or {names, lower_bounds,
                                      # upper_bounds} — box constraints per
                                      # coefficient on the natural scale
@@ -462,6 +467,44 @@ def _estimate_dispersion_pearson(family, y, mu, w, df) -> float:
     return float(np.nansum(resid2) / df)
 
 
+def _resolve_interaction_cols(fr: Frame, interactions: list,
+                              reserved: set) -> list:
+    """Interaction spec (names or train-frame indices) → frozen column names,
+    validated: numeric only, and never the response/weights/offset columns
+    (the reference rejects special columns in `_interactions`)."""
+    cols = [fr.names[int(c)] if not isinstance(c, str) else c
+            for c in interactions]
+    for c in cols:
+        if c in reserved:
+            raise ValueError(f"interactions may not include the special "
+                             f"column '{c}' (response/weights/offset)")
+        if fr.vec(c).is_categorical() or fr.vec(c).is_string():
+            raise NotImplementedError(
+                f"interactions: column '{c}' is not numeric — expand "
+                f"categorical interactions with h2o.interaction first")
+    return cols
+
+
+def _expand_interactions(fr: Frame, names: list, cols: list):
+    """Append pairwise product columns for the resolved numeric features
+    (`hex/DataInfo` interactions; `GLMModel.java:515` _interactions). The
+    same expansion replays at score time via GLMModel.adapt_frame, AFTER
+    categorical-encoding replay so train and score see the same values."""
+    out = Frame(list(fr.names), list(fr.vecs))
+    new_names = list(names)
+    for i, a in enumerate(cols):
+        for b in cols[i + 1:]:
+            nm = f"{a}_{b}"
+            if nm in out.names:
+                raise ValueError(
+                    f"interactions: generated column name '{nm}' collides "
+                    f"with an existing column — rename it")
+            out.add(nm, Vec.from_device(fr.vec(a).data * fr.vec(b).data,
+                                        fr.nrow))
+            new_names.append(nm)
+    return out, new_names
+
+
 def _destandardize(beta: np.ndarray, di) -> np.ndarray:
     """Map coefficients from the standardized training scale back to the
     original feature scale: b → b/s, intercept → intercept − Σ b·m/s.
@@ -507,8 +550,14 @@ class GLMModel(Model):
         names = self.dinfo.expanded_names + ["Intercept"]
         return dict(zip(names, np.asarray(self.beta)))
 
+    interaction_cols = None  # frozen at train time (names, never indices)
+
     def adapt_frame(self, fr: Frame):
-        X, ok = self.dinfo.expand(self.pre_adapt(fr))
+        fr = self.pre_adapt(fr)  # categorical-encoding replay FIRST, so the
+        if self.interaction_cols:  # products see the same values as training
+            fr, _ = _expand_interactions(fr, list(fr.names),
+                                         self.interaction_cols)
+        X, ok = self.dinfo.expand(fr)
         return X
 
     def score0(self, X: jax.Array) -> jax.Array:
@@ -561,6 +610,17 @@ class GLM(ModelBuilder):
         fr = p.training_frame
         names = self.feature_names()
         y_dev, category, resp_domain = self.response_info()
+        self._interaction_cols = None
+        if getattr(p, "interactions", None):
+            if category == "Multinomial" or getattr(p, "HGLM", False):
+                raise NotImplementedError(
+                    "interactions are supported for single-block GLM "
+                    "families (not multinomial/ordinal/HGLM)")
+            reserved = {p.response_column, p.weights_column, p.offset_column}
+            self._interaction_cols = _resolve_interaction_cols(
+                fr, p.interactions, reserved)
+            fr, names = _expand_interactions(fr, names,
+                                             self._interaction_cols)
         if getattr(p, "HGLM", False):
             return self._build_hglm(job, names, y_dev, category)
         if category == "Multinomial":
@@ -627,6 +687,7 @@ class GLM(ModelBuilder):
         output.response_domain = list(resp_domain) if resp_domain else None
         output.model_category = category
         model = GLMModel(p, output, dinfo, beta, family)
+        model.interaction_cols = self._interaction_cols
         raw = model.score0(X)
         ym = jnp.where(w > 0, y, jnp.nan)
         m = make_metrics(category, ym, raw, w if p.weights_column else None)

@@ -401,3 +401,75 @@ def test_beta_constraints_ordinal_rejected():
         GLM(GLMParameters(training_frame=fr, response_column="y",
                           family="ordinal",
                           beta_constraints={"names": ["x"]})).train_model()
+
+
+def test_glm_interactions_pairwise():
+    """`interactions`: pairwise numeric products enter the design and replay
+    at score time (`GLMModel.java:515`)."""
+    rng = np.random.default_rng(8)
+    n = 3000
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (1.0 * x1 + 2.0 * x1 * x2 + 0.05 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y})
+    plain = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              standardize=False)).train_model()
+    inter = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              standardize=False,
+                              interactions=["x1", "x2"])).train_model()
+    assert inter.coef()["x1_x2"] == pytest.approx(2.0, abs=0.05)
+    assert (inter.output.training_metrics.r2
+            > plain.output.training_metrics.r2 + 0.2)
+    # scoring replays the expansion on a fresh frame
+    f2 = Frame.from_dict({"x1": np.array([1.0], np.float32),
+                          "x2": np.array([2.0], np.float32)})
+    pred = inter.predict(f2).vec(0).to_numpy()[0]
+    assert abs(pred - (1.0 * 1 + 2.0 * 1 * 2)) < 0.2
+    with pytest.raises(NotImplementedError, match="numeric"):
+        import pandas as pd
+        frc = Frame.from_pandas(pd.DataFrame(
+            {"g": pd.Categorical(["a", "b"] * 50),
+             "x": np.arange(100, dtype=np.float32),
+             "y": np.arange(100, dtype=np.float32)}))
+        GLM(GLMParameters(training_frame=frc, response_column="y",
+                          family="gaussian",
+                          interactions=["g", "x"])).train_model()
+
+
+def test_glm_interactions_guards():
+    rng = np.random.default_rng(0)
+    n = 200
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (x1 + x2).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y})
+    with pytest.raises(ValueError, match="special column"):
+        GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian",
+                          interactions=["x1", "y"])).train_model()
+    clash = Frame.from_dict({"x1": x1, "x2": x2,
+                             "x1_x2": x1 * 0, "y": y})
+    with pytest.raises(ValueError, match="collides"):
+        GLM(GLMParameters(training_frame=clash, response_column="y",
+                          family="gaussian",
+                          interactions=["x1", "x2"])).train_model()
+    # indices freeze to names at train; scoring frame lacks the response
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0, standardize=False,
+                          interactions=[0, 1])).train_model()
+    sf = Frame.from_dict({"x2": np.array([2.0], np.float32),
+                          "x1": np.array([1.0], np.float32)})  # reordered
+    pred = m.predict(sf).vec(0).to_numpy()[0]
+    assert abs(pred - 3.0) < 0.1
+    import pandas as pd
+    mfr = Frame.from_pandas(pd.DataFrame(
+        {"x1": x1, "x2": x2,
+         "y": pd.Categorical.from_codes((y > 0).astype(int) + (x1 > 1),
+                                        ["a", "b", "c"])}))
+    with pytest.raises(NotImplementedError, match="single-block"):
+        GLM(GLMParameters(training_frame=mfr, response_column="y",
+                          family="multinomial",
+                          interactions=["x1", "x2"])).train_model()
