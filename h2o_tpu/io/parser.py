@@ -57,7 +57,8 @@ class ParseSetup:
 def guess_setup(path: str, setup: ParseSetup | None = None) -> ParseSetup:
     """Sample the file head and guess separator/header (ParseSetup pass 1)."""
     setup = setup or ParseSetup()
-    if path.endswith((".parquet", ".pq", ".orc", ".avro", ".svm", ".svmlight")):
+    if path.endswith((".parquet", ".pq", ".orc", ".avro", ".svm", ".svmlight",
+                      ".xlsx")):
         return setup
     with open(path, "rb") as f:
         head = f.read(1 << 16).decode("utf-8", errors="replace")
@@ -116,6 +117,8 @@ def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
         table = orc.ORCFile(path).read()
     elif ext == ".avro":
         return _parse_avro(path, mesh=mesh, dest_key=dest_key)
+    elif ext == ".xlsx":
+        return _parse_xlsx(path, mesh=mesh, dest_key=dest_key)
     elif ext in (".svm", ".svmlight"):
         return _parse_svmlight(path, mesh=mesh, dest_key=dest_key)
     elif ext == ".arff":
@@ -210,7 +213,10 @@ def _intern_categorical(col, mesh) -> Vec:
     order = np.argsort(np.asarray(dic, dtype=object), kind="stable")
     remap = np.empty(len(dic), dtype=np.float32)
     remap[order] = np.arange(len(dic), dtype=np.float32)
-    out = remap[codes.astype(np.int64)] if len(dic) else codes
+    # null entries surface as NaN indices — clamp before the remap gather,
+    # the null mask restores them after
+    safe = np.nan_to_num(codes, nan=0.0).astype(np.int64)
+    out = remap[safe] if len(dic) else codes
     out[null_mask] = np.nan
     return Vec.from_numpy(out, type=T_CAT, domain=[dic[i] for i in order], mesh=mesh)
 
@@ -242,6 +248,42 @@ def _parse_avro(path: str, mesh=None, dest_key: str | None = None) -> Frame:
         else:
             arr = np.array([np.nan if v is None else float(v) for v in vals],
                            dtype=np.float64)
+            out[name] = Vec.from_numpy(arr, mesh=mesh)
+    fr = Frame(list(out), list(out.values()), key=dest_key)
+    STORE.put_keyed(fr)
+    return fr
+
+
+def _parse_xlsx(path: str, mesh=None, dest_key: str | None = None) -> Frame:
+    """XLSX ingest (`water/parser/XlsParser.java` role, `io/xlsx.py`
+    stdlib-zip reader): header row + typed columns, string columns interned
+    to categoricals like the CSV path."""
+    from .xlsx import read_xlsx
+
+    header, rows = read_xlsx(path)
+    # dedupe duplicate header names (cbind-style suffixing) — a dict would
+    # silently drop all but the last same-named column
+    seen: dict[str, int] = {}
+    uniq = []
+    for name in header:
+        if name in seen:
+            seen[name] += 1
+            uniq.append(f"{name}{seen[name]}")
+        else:
+            seen[name] = 0
+            uniq.append(name)
+    header = uniq
+    out = {}
+    for j, name in enumerate(header):
+        vals = [r[j] for r in rows]
+        if any(isinstance(v, str) for v in vals):
+            import pyarrow as pa
+
+            arr = pa.array([None if v is None else str(v) for v in vals])
+            out[name] = _intern_categorical(arr, mesh)
+        else:
+            arr = np.array([np.nan if v is None else float(v)
+                            for v in vals], dtype=np.float64)
             out[name] = Vec.from_numpy(arr, mesh=mesh)
     fr = Frame(list(out), list(out.values()), key=dest_key)
     STORE.put_keyed(fr)

@@ -120,10 +120,68 @@ class CoxPHModel(Model):
         self.mean_x = mean_x    # centering vector (R convention: lp centered)
         super().__init__(params, output, key=key)
 
+    baseline = None  # {stratum_code: (event_times, cumulative_hazard)}
+    strata_cols = None
+
     def predict(self, fr: Frame) -> Frame:
         X, _ = self.dinfo.expand(fr)
         lp = (X - self.mean_x) @ self.beta
         return Frame(["lp"], [Vec.from_device(lp, fr.nrow)])
+
+    def baseline_hazard_frame(self) -> Frame:
+        """Breslow cumulative baseline hazard per stratum (`hex/coxph`'s
+        baseline hazard output; R `basehaz`)."""
+        import numpy as _np
+
+        if not self.baseline:
+            raise ValueError("no baseline hazard stored")
+        ts, hs, ks = [], [], []
+        for k, (t, h) in sorted(self.baseline.items()):
+            ts.append(t)
+            hs.append(h)
+            ks.append(_np.full(len(t), float(k)))
+        out = Frame(["t", "cumhaz"],
+                    [Vec.from_numpy(_np.concatenate(ts)),
+                     Vec.from_numpy(_np.concatenate(hs))])
+        if len(self.baseline) > 1:
+            out.add("stratum", Vec.from_numpy(_np.concatenate(ks)))
+        return out
+
+    def survfit(self, fr: Frame, max_rows: int = 1000) -> Frame:
+        """Per-row survival curves S(t|x) = exp(−H0(t)·exp(lp)) over the
+        training event times (R `survfit.coxph` role). Columns: t then one
+        survival column per scoring row."""
+        import numpy as _np
+
+        if fr.nrow > max_rows:
+            raise ValueError(f"survfit: frame has {fr.nrow} rows; cap is "
+                             f"{max_rows} (curves are per-row columns)")
+        lp = _np.asarray(self.predict(fr).vec(0).to_numpy(), _np.float64)
+        if not self.strata_cols:
+            (only_key,) = self.baseline.keys()
+            strat = _np.full(fr.nrow, only_key, _np.int64)
+        else:  # replay the training stratum encoding (even if only one
+            strat = _np.zeros(fr.nrow, dtype=_np.int64)  # stratum was seen)
+            for s in self.strata_cols:
+                sv = fr.vec(s).to_numpy()
+                strat = strat * (self._strat_base[s]) + _np.where(
+                    _np.isnan(sv), 0, sv + 1).astype(_np.int64)
+        tgrid = _np.unique(_np.concatenate(
+            [t for t, _ in self.baseline.values()]))
+        cols = [Vec.from_numpy(tgrid.astype(_np.float64))]
+        names = ["t"]
+        for i in range(fr.nrow):
+            k = int(strat[i])
+            if k not in self.baseline:
+                raise ValueError(f"survfit: unseen stratum for row {i}")
+            t, h = self.baseline[k]
+            # the Breslow estimator is a right-continuous STEP function —
+            # H(τ) = h at the last event time ≤ τ, never interpolated
+            idx = _np.searchsorted(t, tgrid, side="right") - 1
+            H = _np.where(idx >= 0, h[_np.clip(idx, 0, None)], 0.0)
+            cols.append(Vec.from_numpy(_np.exp(-H * _np.exp(lp[i]))))
+            names.append(f"surv_{i}")
+        return Frame(names, cols)
 
 
 class CoxPH(ModelBuilder):
@@ -148,9 +206,11 @@ class CoxPH(ModelBuilder):
         w = (np.nan_to_num(fr.vec(p.weights_column).to_numpy())
              if p.weights_column else np.ones(nrow))
         strata = np.zeros(nrow, dtype=np.int64)
+        strat_bases = {}
         for s in (p.stratify_by or []):
             sv = fr.vec(s).to_numpy()
-            strata = strata * (int(np.nanmax(sv)) + 2) + \
+            strat_bases[s] = int(np.nanmax(sv)) + 2
+            strata = strata * strat_bases[s] + \
                 np.where(np.isnan(sv), 0, sv + 1).astype(np.int64)
 
         ok = ~(np.isnan(t_stop) | np.isnan(event)) & (w > 0)
@@ -236,6 +296,28 @@ class CoxPH(ModelBuilder):
         model = CoxPHModel(p, output, jnp.asarray(beta_np.astype(np.float32)),
                            dinfo, jnp.asarray(mu.astype(np.float32)))
         model.coefficients = dict(zip(dinfo.expanded_names, beta_np))
+
+        # Breslow cumulative baseline hazard per stratum (basehaz role):
+        # dH0(t) = Σ w·event at t / Σ_{risk set} w·exp(lp), risk sets via
+        # within-stratum suffix sums over the already time-sorted rows
+        risk = ww * np.exp((X - mu) @ beta_np)
+        rev = np.cumsum(risk[::-1])[::-1]
+        ends_pad = np.append(rev, 0.0)
+        sfx = rev - ends_pad[strat_end]
+        gstart = np.where(new_group)[0]
+        denom = sfx[gstart]
+        dsum = np.bincount(gid, weights=ww * (ev > 0))
+        dh = np.where(denom > 0, dsum / np.maximum(denom, 1e-300), 0.0)
+        g_times = tt[gstart]
+        g_strat = ss[gstart]
+        baseline = {}
+        for k in np.unique(g_strat):
+            sel = g_strat == k
+            baseline[int(k)] = (g_times[sel].astype(np.float64),
+                                np.cumsum(dh[sel]))
+        model.baseline = baseline
+        model.strata_cols = list(p.stratify_by or [])
+        model._strat_base = strat_bases
         return model
 
 

@@ -113,3 +113,75 @@ def test_coxph_stratified():
     # predictions: linear predictor frame
     lp = m.predict(fr)
     assert lp.names == ["lp"] and lp.nrow == n
+
+
+def test_baseline_hazard_and_survfit():
+    """Breslow baseline hazard: on exponential data with hazard h0*exp(b*x),
+    the cumulative baseline is ~linear with slope h0, and survival curves
+    order by linear predictor."""
+    rng = np.random.default_rng(2)
+    n = 4000
+    x = rng.normal(size=n).astype(np.float32)
+    h0, b = 0.5, 0.7
+    t = rng.exponential(1.0 / (h0 * np.exp(b * x))).astype(np.float32)
+    cens = rng.exponential(4.0, n).astype(np.float32)
+    stop = np.minimum(t, cens)
+    event = (t <= cens).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "stop": stop, "event": event})
+    m = CoxPH(CoxPHParameters(training_frame=fr, response_column="event",
+                              stop_column="stop")).train_model()
+    bh = m.baseline_hazard_frame()
+    tcol = bh.vec("t").to_numpy()
+    H = bh.vec("cumhaz").to_numpy()
+    assert np.all(np.diff(H) >= -1e-12)  # monotone
+    # slope ~ h0 * exp(-b * mean_x_centering) — lp is centered at mean x,
+    # so H(t) ≈ h0 * exp(b * mu_x) * t; mu_x ~ 0 → slope ~ h0
+    mid = (tcol > 0.2) & (tcol < 2.0)
+    slope = np.polyfit(tcol[mid], H[mid], 1)[0]
+    assert abs(slope - h0) < 0.15, slope
+    # survfit: higher-risk row decays faster, S in [0,1], monotone down
+    sf = m.survfit(Frame.from_dict({"x": np.array([-1.0, 1.0], np.float32)}))
+    s_low = sf.vec("surv_0").to_numpy()
+    s_high = sf.vec("surv_1").to_numpy()
+    assert np.all(s_low <= 1.0 + 1e-9) and np.all(s_high >= -1e-9)
+    assert np.all(np.diff(s_low) <= 1e-12)
+    assert s_high[-1] < s_low[-1]
+
+
+def test_survfit_stratified():
+    rng = np.random.default_rng(3)
+    n = 2000
+    g = rng.integers(0, 2, n).astype(np.float32)
+    x = rng.normal(size=n).astype(np.float32)
+    scale = np.where(g > 0, 0.5, 2.0)
+    t = rng.exponential(scale).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "g": g, "stop": t,
+                          "event": np.ones(n, np.float32)})
+    m = CoxPH(CoxPHParameters(training_frame=fr, response_column="event",
+                              stop_column="stop",
+                              stratify_by=["g"])).train_model()
+    bh = m.baseline_hazard_frame()
+    assert "stratum" in bh.names
+    sf = m.survfit(Frame.from_dict({"x": np.zeros(2, np.float32),
+                                    "g": np.array([0.0, 1.0], np.float32)}))
+    # stratum 1 (scale 0.5) dies faster than stratum 0 (scale 2.0)
+    tmid = np.searchsorted(sf.vec("t").to_numpy(), 1.0)
+    assert sf.vec("surv_1").to_numpy()[tmid] < sf.vec("surv_0").to_numpy()[tmid]
+
+
+def test_survfit_single_observed_stratum():
+    """Stratified model whose training data happens to contain one stratum
+    still encodes/decodes the stratum consistently."""
+    rng = np.random.default_rng(4)
+    n = 500
+    fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32),
+                          "g": np.zeros(n, np.float32),
+                          "stop": rng.exponential(1.0, n).astype(np.float32),
+                          "event": np.ones(n, np.float32)})
+    m = CoxPH(CoxPHParameters(training_frame=fr, response_column="event",
+                              stop_column="stop",
+                              stratify_by=["g"])).train_model()
+    sf = m.survfit(Frame.from_dict({"x": np.zeros(1, np.float32),
+                                    "g": np.zeros(1, np.float32)}))
+    s = sf.vec("surv_0").to_numpy()
+    assert np.all(np.diff(s) <= 1e-12) and 0 <= s[-1] <= 1

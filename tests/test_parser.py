@@ -153,3 +153,59 @@ class TestAvro:
         v = fr.vec("col")
         assert v.domain == ["red", "green"]
         np.testing.assert_allclose(v.to_numpy(), [0, 1, 0])
+
+
+class TestXlsx:
+    """XLSX ingest via the stdlib zip/XML reader (`io/xlsx.py`)."""
+
+    def test_roundtrip(self, tmp_path):
+        from h2o_tpu.io.parser import parse_file
+        from h2o_tpu.io.xlsx import write_xlsx
+
+        p = str(tmp_path / "t.xlsx")
+        write_xlsx(p, ["num", "name"],
+                   [[1.5, "a"], [2.5, "b"], [None, None], [4.0, "a"]])
+        fr = parse_file(p)
+        assert fr.names == ["num", "name"]
+        x = fr.vec("num").to_numpy()
+        assert x[0] == 1.5 and np.isnan(x[2]) and x[3] == 4.0
+        v = fr.vec("name")
+        assert v.is_categorical() and v.domain == ["a", "b"]
+        np.testing.assert_allclose(v.to_numpy(), [0, 1, np.nan, 0],
+                                   equal_nan=True)
+
+    def test_import_file_entrypoint(self, tmp_path):
+        from h2o_tpu.io.xlsx import write_xlsx
+
+        p = str(tmp_path / "e.xlsx")
+        write_xlsx(p, ["a"], [[1.0], [2.0]])
+        fr = import_file(p)
+        assert fr.nrow == 2 and fr.vec("a").to_numpy()[1] == 2.0
+
+    def test_duplicate_headers_and_error_cells(self, tmp_path):
+        import zipfile
+        from h2o_tpu.io.parser import parse_file
+        from h2o_tpu.io.xlsx import write_xlsx
+
+        p = str(tmp_path / "dup.xlsx")
+        write_xlsx(p, ["a", "a"], [[1.0, 2.0], [3.0, 4.0]])
+        fr = parse_file(p)
+        assert fr.names == ["a", "a1"]
+        np.testing.assert_allclose(fr.vec("a").to_numpy(), [1, 3])
+        np.testing.assert_allclose(fr.vec("a1").to_numpy(), [2, 4])
+        # error cells (t="e") become NA instead of crashing the parse
+        p2 = str(tmp_path / "err.xlsx")
+        write_xlsx(p2, ["v"], [[1.0], [2.0]])
+        with zipfile.ZipFile(p2) as z:
+            sheet = z.read("xl/worksheets/sheet1.xml").decode()
+            names = z.namelist()
+            contents = {n: z.read(n) for n in names}
+        sheet = sheet.replace('<c r="A3"><v>2.0</v></c>',
+                              '<c r="A3" t="e"><v>#DIV/0!</v></c>')
+        contents["xl/worksheets/sheet1.xml"] = sheet.encode()
+        with zipfile.ZipFile(p2, "w") as z:
+            for n, data in contents.items():
+                z.writestr(n, data)
+        fr2 = parse_file(p2)
+        x = fr2.vec("v").to_numpy()
+        assert x[0] == 1.0 and np.isnan(x[1])
