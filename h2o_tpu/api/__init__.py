@@ -6,10 +6,11 @@ h2o; h2o.init(); h2o.import_file(...)`` mirrors the h2o-py entry points
 """
 
 from .client import (H2OConnection, H2OConnectionError, H2OEstimator,
-                     H2OFrame, H2OGroupBy, H2OModelClient, cluster_status,
-                     connect, connection, export_file, get_frame, get_model,
-                     import_file, init, interaction, ls, rapids, remove,
-                     shutdown, upload_frame)
+                     H2OFrame, H2OGroupBy, H2OModelClient, assign,
+                     cluster_status, connect, connection, deep_copy,
+                     export_file, get_frame, get_model, get_timezone,
+                     import_file, init, interaction, list_timezones, ls,
+                     rapids, remove, set_timezone, shutdown, upload_frame)
 from .client import (H2OAdaBoostEstimator, H2OANOVAGLMEstimator,
                      H2OAggregatorEstimator,
                      H2OCoxProportionalHazardsEstimator,

@@ -749,6 +749,30 @@ class H2OFrame:
             return pd.DataFrame(cols)
         return cols
 
+    def describe(self, chunk_summary=False):
+        """Print the per-column summary table (`H2OFrame.describe`)."""
+        summ = self._summary()
+        print(f"Rows:{summ['rows']}  Cols:{summ['num_columns']}")
+        def first(v):  # schema emits mins/maxs as 1-element lists
+            return v[0] if isinstance(v, list) and v else (
+                None if isinstance(v, list) else v)
+
+        rows = []
+        for c in summ["columns"]:
+            rows.append([c["label"], c["type"], first(c.get("mins")),
+                         first(c.get("maxs")), c.get("mean"), c.get("sigma"),
+                         c.get("missing_count")])
+        try:
+            import pandas as pd
+
+            df = pd.DataFrame(rows, columns=["column", "type", "min", "max",
+                                             "mean", "sigma", "missing"])
+            print(df.to_string(index=False))
+        except ImportError:
+            for r in rows:
+                print(r)
+        return self
+
     def head(self, rows=10):
         # only the first `rows` rows cross the wire (server-side preview cap)
         return self.as_data_frame(rows=rows)
@@ -800,6 +824,38 @@ class H2OGroupBy:
         by = " ".join(f"'{c}'" for c in self._by)
         aggs = " ".join(f"'{a}' '{c}' '{na}'" for a, c, na in self._aggs)
         return self._fr._exec(f"(GB {self._fr.frame_id} [{by}] {aggs})")
+
+
+def deep_copy(frame: H2OFrame, destination_frame: str) -> H2OFrame:
+    """`h2o.deep_copy`: server-side materialized copy under a new key."""
+    idx = " ".join(str(i) for i in range(frame.ncol))
+    rapids(f"(tmp= {destination_frame} (cols {frame._ref()} [{idx}]))")
+    return H2OFrame._by_id(destination_frame)
+
+
+def assign(frame: H2OFrame, destination_frame: str) -> H2OFrame:
+    """`h2o.assign`: bind the frame's data under a new key and rebind this
+    handle to it. The old key stays alive so other handles and pending lazy
+    expressions that captured it keep working (the client's snapshot
+    contract — see __setitem__)."""
+    idx = " ".join(str(i) for i in range(frame.ncol))
+    rapids(f"(tmp= {destination_frame} (cols {frame._ref()} [{idx}]))")
+    frame.frame_id = destination_frame
+    frame.refresh()
+    return frame
+
+
+def list_timezones() -> "H2OFrame":
+    return H2OFrame._lazy("(listTimeZones)")
+
+
+def get_timezone() -> str:
+    fr = H2OFrame._lazy("(getTimeZone)")
+    return fr.as_data_frame().iloc[0, 0]
+
+
+def set_timezone(tz: str) -> None:
+    rapids(f"(setTimeZone '{tz}')")
 
 
 def interaction(frame: H2OFrame, factors, pairwise=False, max_factors=100,
@@ -975,6 +1031,10 @@ class H2OEstimator:
               validation_frame: H2OFrame | None = None, **kw):
         body = dict(self._params)
         body.update(kw)
+        # frame-valued params (pre_trained, calibration_frame, …) ride the
+        # wire as their keys; the server resolves them back to Frames
+        body = {k: (v.frame_id if isinstance(v, H2OFrame) else v)
+                for k, v in body.items()}
         if training_frame is not None:
             body["training_frame"] = training_frame.frame_id
         if validation_frame is not None:

@@ -147,7 +147,7 @@ def _jobs_of(algo_cls, params_cls, body: dict) -> tuple[int, dict]:
     kwargs = {}
     for k, v in body.items():
         if k in ("training_frame", "validation_frame", "blending_frame",
-                 "calibration_frame"):
+                 "calibration_frame", "pre_trained"):
             v = STORE.get(v)
         kwargs[k] = v
     builder = algo_cls(params_cls(**kwargs))

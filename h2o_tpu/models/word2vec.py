@@ -38,6 +38,9 @@ class Word2VecParameters(Parameters):
     negative_samples: int = 5    # negative-sampling k (divergence from HS)
     init_learning_rate: float = 0.025
     sent_sample_rate: float = 1e-3
+    pre_trained: object = None   # Frame [Word, V1..VD] — import external
+                                 # embeddings instead of training
+                                 # (`hex/word2vec/Word2Vec.java` pre-trained)
 
 
 class Word2VecModel(Model):
@@ -138,8 +141,17 @@ class Word2Vec(ModelBuilder):
     algo_name = "word2vec"
     supervised = False
 
+    def _validate(self):
+        if self.params.pre_trained is not None:
+            if self.params.training_frame is None:
+                self.params.training_frame = self.params.pre_trained
+            return
+        super()._validate()
+
     def build_impl(self, job: Job) -> Word2VecModel:
         p: Word2VecParameters = self.params
+        if p.pre_trained is not None:
+            return self._from_pretrained(p)
         fr = p.training_frame
         wcol = fr.vec(0)
         host = (wcol.host_data if wcol.is_string() else np.array(
@@ -206,3 +218,25 @@ class Word2Vec(ModelBuilder):
         output = ModelOutput()
         output.model_category = "WordEmbedding"
         return Word2VecModel(p, output, vocab, np.asarray(W))
+
+    def _from_pretrained(self, p) -> Word2VecModel:
+        """Import external embeddings: frame of [Word, V1..VD]
+        (`Word2Vec.java` pre-trained model path; h2o-py
+        `H2OWord2vecEstimator(pre_trained=...)`)."""
+        fr = p.pre_trained
+        p.vec_size = fr.ncol - 1  # embedding width comes from the frame
+        wcol = fr.vec(0)
+        words = (wcol.host_data if wcol.is_string() else
+                 [None if np.isnan(c) else wcol.domain[int(c)]
+                  for c in wcol.to_numpy()])
+        W = np.stack([fr.vec(j).to_numpy() for j in range(1, fr.ncol)],
+                     axis=1).astype(np.float32)
+        vocab = {}
+        keep = []
+        for i, w in enumerate(words):
+            if w is not None and w not in vocab:
+                vocab[w] = len(vocab)
+                keep.append(i)
+        output = ModelOutput()
+        output.model_category = "WordEmbedding"
+        return Word2VecModel(p, output, vocab, W[keep])

@@ -400,3 +400,54 @@ class TestMetadata:
             "GET", f"/3/Frames/{fr.frame_id}/columns")["frames"][0]
         assert cols["num_columns"] == 1 and "columns" in cols
         assert not cols["columns"][0].get("data")  # no row preview payload
+
+
+class TestClientUtilities:
+    def test_deep_copy_assign_describe_tz(self, cloud, capsys):
+        fr = h2o.H2OFrame({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+        old_id = fr.frame_id
+        cp = h2o.deep_copy(fr, "my_copy")
+        assert cp.frame_id == "my_copy" and cp.nrow == 2
+        # the copy holds its own data: removing the source key entirely
+        # leaves the copy scoreable (a shallow alias would 404)
+        h2o.remove(old_id)
+        assert cp["a"].sum() == 3.0
+        renamed = h2o.assign(cp, "renamed_copy")
+        assert renamed.frame_id == "renamed_copy"
+        assert h2o.get_frame("renamed_copy").nrow == 2
+        # assign keeps the old key alive (lazy-snapshot contract)
+        assert h2o.get_frame("my_copy").nrow == 2
+        fr.describe()
+        out = capsys.readouterr().out
+        assert "Rows:2" in out and "a" in out
+        assert h2o.list_timezones().nrow >= 1
+        h2o.set_timezone("UTC")
+        assert h2o.get_timezone() == "UTC"
+
+    def test_word2vec_pretrained(self, cloud):
+        import numpy as np
+
+        from h2o_tpu.frame.frame import Frame
+        from h2o_tpu.frame.vec import Vec
+        from h2o_tpu.models.word2vec import Word2Vec, Word2VecParameters
+
+        words = Vec.from_numpy(np.array(["king", "queen", "apple"],
+                                        dtype=object))
+        vecs = np.array([[1.0, 0.0], [0.9, 0.1], [0.0, 1.0]], np.float32)
+        fr = Frame(["Word", "V1", "V2"],
+                   [words, Vec.from_numpy(vecs[:, 0]),
+                    Vec.from_numpy(vecs[:, 1])])
+        m = Word2Vec(Word2VecParameters(pre_trained=fr)).train_model()
+        assert m.params.vec_size == 2  # synced from the embedding width
+        syn = m.find_synonyms("king", 1)
+        assert list(syn)[0] == "queen"
+
+    def test_word2vec_pretrained_over_rest(self, cloud):
+        import pandas as pd
+
+        emb = h2o.upload_frame(pd.DataFrame(
+            {"Word": ["hot", "warm", "cold"],
+             "V1": [1.0, 0.9, -1.0], "V2": [0.0, 0.1, 0.0]}))
+        est = h2o.H2OWord2vecEstimator(pre_trained=emb)
+        est.train(training_frame=emb)
+        assert est.model_id
