@@ -522,6 +522,21 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         _SESSIONS[sid] = Session(sid)
         return 200, {"session_key": sid}
 
+    if head == "Typeahead":
+        # `GET /3/Typeahead/files?src=...&limit=N` — path completion for the
+        # import UI (`water/api/TypeaheadHandler`)
+        src = p.get("src", "") or ""
+        limit = int(p.get("limit", 100) or 100)
+        import glob as _glob
+
+        # escape glob metacharacters: src is a literal path prefix, not a
+        # pattern ('/data/run[1]/' must match itself); non-positive limit
+        # means unlimited (the H2O -1 convention)
+        matches = sorted(_glob.glob(_glob.escape(src) + "*"))
+        if limit > 0:
+            matches = matches[:limit]
+        return 200, {"src": src, "matches": matches}
+
     # -- observability -------------------------------------------------------
     if head == "JStack":
         # thread dumps — `water/api/JStackHandler` analog for the controller
@@ -677,6 +692,7 @@ _ROUTES_DOC = [
         ("GET", "/3/WaterMeterCpuTicks/{node}", "cpu tick counters"),
         ("GET", "/3/WaterMeterIo", "io counters"),
         ("GET", "/3/NetworkTest", "device microbenchmarks"),
+        ("GET", "/3/Typeahead/files", "path completion for import"),
         ("GET", "/3/Metadata/endpoints", "this listing"),
         ("GET", "/3/Metadata/schemas", "schema catalog"),
     ]

@@ -451,3 +451,21 @@ class TestClientUtilities:
         est = h2o.H2OWord2vecEstimator(pre_trained=emb)
         est.train(training_frame=emb)
         assert est.model_id
+
+    def test_typeahead_files(self, cloud, tmp_path):
+        (tmp_path / "data1.csv").write_text("a\n1\n")
+        (tmp_path / "data2.csv").write_text("a\n1\n")
+        r = h2o.connection().request(
+            "GET", "/3/Typeahead/files",
+            params={"src": str(tmp_path / "data"), "limit": 10})
+        assert len(r["matches"]) == 2
+        assert all(m.startswith(str(tmp_path)) for m in r["matches"])
+
+    def test_typeahead_metachars_and_unlimited(self, cloud, tmp_path):
+        d = tmp_path / "run[1]"
+        d.mkdir()
+        (d / "f.csv").write_text("a\n1\n")
+        r = h2o.connection().request(
+            "GET", "/3/Typeahead/files",
+            params={"src": str(tmp_path / "run["), "limit": -1})
+        assert r["matches"] == [str(d)]
