@@ -1,0 +1,78 @@
+"""Worker process for the multi-host cloud test (run by
+test_multihost.py, once per simulated host).
+
+The analog of one JVM in the reference's 4-JVMs-on-one-box `testMultiNode`
+trick (`gradle/multiNodeTesting.gradle:34-53`) — except here the "cluster"
+is `jax.distributed` over localhost (Gloo on CPU; DCN on real pods), and the
+data plane is a GLOBAL row-sharded mesh spanning both processes: each host
+contributes process-local rows and the mr_reduce/Gram collectives cross the
+process boundary.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from h2o_tpu.parallel import cluster, mesh as meshmod
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    mesh = cluster.init_cluster(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc, process_id=pid)
+    cluster.stall_till_cloudsize(nproc)
+    assert cluster.cloud_size() == nproc
+
+    ndev = len(jax.devices())            # global device count
+    local = 2                            # devices per process
+    assert ndev == nproc * local, (ndev, nproc)
+
+    # each host contributes 8 process-local rows of (x, y)
+    rows_per_proc = 8
+    x_local = (np.arange(rows_per_proc, dtype=np.float32)
+               + 100.0 * pid)            # deterministic, distinct per host
+    sh = NamedSharding(mesh, P(meshmod.ROWS))
+    gx = jax.make_array_from_process_local_data(
+        sh, x_local, (rows_per_proc * nproc,))
+
+    # 1) cross-process reduction (the MRTask reduce over "DCN")
+    total = jax.jit(lambda v: jnp.sum(v),
+                    out_shardings=NamedSharding(mesh, P()))(gx)
+    expect = sum(float(np.sum(np.arange(rows_per_proc) + 100.0 * p))
+                 for p in range(nproc))
+    assert abs(float(total) - expect) < 1e-3, (float(total), expect)
+
+    # 2) a GLM-style Gram over the global design (XᵀX crosses processes)
+    X_local = np.stack([x_local, np.ones_like(x_local)], axis=1)
+    gX = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(meshmod.ROWS, None)), X_local,
+        (rows_per_proc * nproc, 2))
+    G = jax.jit(lambda A: jnp.einsum("rp,rq->pq", A, A),
+                out_shardings=NamedSharding(mesh, P()))(gX)
+    allX = np.concatenate([
+        np.stack([np.arange(rows_per_proc, dtype=np.float32) + 100.0 * p,
+                  np.ones(rows_per_proc, np.float32)], axis=1)
+        for p in range(nproc)])
+    np.testing.assert_allclose(np.asarray(G), allX.T @ allX, rtol=1e-5)
+
+    print(f"WORKER_{pid}_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

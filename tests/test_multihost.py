@@ -1,0 +1,67 @@
+"""Multi-host cloud test — the reference's N-JVMs-on-one-box distributed
+test brought to the JAX runtime (SURVEY.md §4 "lesson", §2.5 DCN mapping).
+
+Forks 2 worker PROCESSES that join one `jax.distributed` cloud over
+localhost and run cross-process collectives on a global row mesh: the real
+multi-host code path (process-local data → global array → psum/Gram across
+the process boundary), not the in-process virtual mesh the rest of the
+suite uses.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_cloud(worker, port, env):
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for i in range(2)]
+    outs = [""] * len(procs)
+    timed_out = False
+    try:
+        for i, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=150)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                p.kill()
+                out, _ = p.communicate()  # harvest whatever it printed
+            outs[i] = out.decode()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return procs, outs, timed_out
+
+
+def test_two_process_cloud_collectives():
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # one retry with a fresh port covers the bind/close/reuse race under
+    # parallel CI (another process can grab the port in the window)
+    for attempt in range(2):
+        procs, outs, timed_out = _run_cloud(worker, _free_port(), env)
+        if not timed_out:
+            break
+    if timed_out:
+        # a hung coordinator usually means the OTHER worker died early —
+        # surface every worker's output so the real cause is visible
+        raise AssertionError(
+            "cloud formation timed out; worker outputs:\n" +
+            "\n---\n".join(o[-2000:] for o in outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+        assert f"WORKER_{i}_OK" in out, out[-2000:]
