@@ -5,9 +5,10 @@ an independent fit on a row subsample (default 0.632, `DRFParameters` in the
 reference), per-split column subsampling via ``mtries`` (-1 = sqrt(F) for
 classification, F/3 for regression — `hex/tree/drf/DRF.java` mtry defaults),
 leaves store per-leaf response means (class probability for classification),
-and prediction averages over trees. XRT = DRF with random split thresholds; we
-approximate via stronger per-split column sampling (histogram splits are
-already coarsely discretized) — documented divergence.
+and prediction averages over trees. XRT = DRF with random split thresholds,
+realized exactly via ``histogram_type="Random"`` bin edges (uniform random
+cut points per feature — `binning.py`), the reference's Random histogram
+mechanism.
 
 Training metrics are OOB-based like the reference (`DRF.java` OOB scoring):
 the tree scan accumulates each row's out-of-bag tree outputs, and the final
@@ -61,8 +62,9 @@ class DRF(GBM):
                                    max_depth=depth, learn_rate=1.0)
 
 
+@dataclass
 class XRTParameters(DRFParameters):
-    pass
+    histogram_type: str = "Random"  # random split thresholds ARE the XRT
 
 
 class XRT(DRF):

@@ -42,6 +42,10 @@ class GBMParameters(Parameters):
     learn_rate: float = 0.1
     learn_rate_annealing: float = 1.0
     sample_rate: float = 1.0
+    histogram_type: str = "AUTO"  # AUTO/QuantilesGlobal (global sampled
+                                  # quantiles — this engine's default) |
+                                  # UniformAdaptive | Random
+                                  # (`hex/tree/SharedTreeModel.HistogramType`)
     col_sample_rate: float = 1.0
     col_sample_rate_per_tree: float = 1.0
     nbins: int = 20
@@ -283,8 +287,10 @@ class GBM(ModelBuilder):
         ymask = ~jnp.isnan(y_dev)
         w = w * ymask.astype(jnp.float32)
 
-        edges_np = compute_bin_edges(X, is_cat, p.nbins,
-                                     seed=p.seed if p.seed not in (-1, None) else 1234)
+        edges_np = compute_bin_edges(
+            X, is_cat, p.nbins,
+            seed=p.seed if p.seed not in (-1, None) else 1234,
+            histogram_type=p.histogram_type)
         mesh = default_mesh()
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf), replicated(mesh))
         mono_np = np.zeros(len(names), dtype=np.float32)

@@ -24,14 +24,27 @@ import numpy as np
 
 
 def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
-                      sample: int = 200_000, seed: int = 1234) -> np.ndarray:
-    """Quantile-based global bin edges per feature.
+                      sample: int = 200_000, seed: int = 1234,
+                      histogram_type: str = "QuantilesGlobal") -> np.ndarray:
+    """Global bin edges per feature.
+
+    ``histogram_type`` mirrors `hex/tree/SharedTreeModel.HistogramType`:
+    AUTO/QuantilesGlobal → sampled global quantiles (this engine's default —
+    bins adapt to the data distribution); UniformAdaptive → equal-width
+    between per-feature min/max; Random → uniform random cut points (the
+    extremely-randomized-trees flavor). Categorical features always bin on
+    their category codes.
 
     X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles are taken on a
     host-side row sample (the reference's QuantilesGlobal mode also samples).
     Returns (F, nbins-1) float32 edges, NaN-padded where a feature has fewer
     distinct cut points.
     """
+    ht = (histogram_type or "AUTO").lower()
+    if ht not in ("auto", "quantilesglobal", "uniformadaptive", "random"):
+        raise ValueError(
+            f"unsupported histogram_type '{histogram_type}' — supported: "
+            f"AUTO, QuantilesGlobal, UniformAdaptive, Random")
     R, F = X.shape
     if R > sample:
         rng = np.random.default_rng(seed)
@@ -49,7 +62,18 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
         if is_cat[f]:
             card = int(col.max()) + 1
             cuts = np.arange(min(card - 1, nbins - 1), dtype=np.float32)
-        else:
+        elif ht == "uniformadaptive":
+            lo, hi = float(col.min()), float(col.max())
+            cuts = (np.unique(np.linspace(lo, hi, nbins + 1)[1:-1]
+                              .astype(np.float32)) if hi > lo
+                    else np.zeros(0, np.float32))
+        elif ht == "random":
+            lo, hi = float(col.min()), float(col.max())
+            rrng = np.random.default_rng(seed + 7919 * f)
+            cuts = (np.unique(rrng.uniform(lo, hi, nbins - 1)
+                              .astype(np.float32)) if hi > lo
+                    else np.zeros(0, np.float32))
+        else:  # AUTO / QuantilesGlobal
             cuts = np.unique(np.quantile(col, qs).astype(np.float32))
         edges[f, : len(cuts)] = cuts
     return edges

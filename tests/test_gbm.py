@@ -207,3 +207,34 @@ def test_drf_checkpoint_falls_back_to_inbag_metrics():
     assert getattr(cont.output.training_metrics, "description", "") \
         != "Reported on OOB data"
     assert cont.ntrees == 15
+
+
+def test_histogram_types():
+    """histogram_type parity (`SharedTreeModel.HistogramType`): all three
+    binning modes learn; uniform vs quantile produce different edge sets on
+    skewed data."""
+    from h2o_tpu.models.tree.binning import compute_bin_edges
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    x = rng.lognormal(0, 1, n).astype(np.float32)  # heavily skewed
+    y = (np.log(x) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    # uniform edges waste resolution on a lognormal tail — allowed a lower
+    # bar (that gap is exactly why QuantilesGlobal is the engine default)
+    for ht, bar in (("QuantilesGlobal", 0.8), ("UniformAdaptive", 0.6),
+                    ("Random", 0.6)):
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=15, max_depth=4, seed=1,
+                              histogram_type=ht)).train_model()
+        assert m.output.training_metrics.r2 > bar, (ht,
+                                                    m.output.training_metrics.r2)
+    X = jnp.asarray(x[:, None])
+    is_cat = np.array([False])
+    q = compute_bin_edges(X, is_cat, 10, histogram_type="QuantilesGlobal")
+    u = compute_bin_edges(X, is_cat, 10, histogram_type="UniformAdaptive")
+    qe, ue = q[0][~np.isnan(q[0])], u[0][~np.isnan(u[0])]
+    assert not np.allclose(np.sort(qe)[:len(ue)][:3], np.sort(ue)[:3])
+    # uniform edges are equally spaced
+    assert np.allclose(np.diff(ue), np.diff(ue)[0], rtol=1e-3)
