@@ -7,10 +7,12 @@ quasibinomial, multinomial, poisson, gamma, tweedie, laplace, quantile, huber.
 Per-class trees for multinomial are one fused vmapped pass
 (`SharedTree.java:361-363`).
 
-Divergences (documented): leaf values are Newton steps -G/(H+λ) for every
-family (the reference fits special leaf gammas for laplace/quantile/huber,
-`GBM.java:685,730,814` — exact per-leaf quantile refits are a planned
-follow-up); binning is global-quantile (see tree/binning.py).
+Leaf values: Newton steps -G/(H+λ) for most families; laplace/quantile fit
+QUANTILE gamma leaves like the reference (`GBM.java:730,814`) via a
+distributed 256-bin residual histogram (bin-resolution exactness — the one
+remaining leaf divergence is huber's hybrid gamma, `GBM.java:685`, still a
+Newton step). Binning is global-quantile by default with
+UniformAdaptive/Random selectable (see tree/binning.py).
 """
 
 from __future__ import annotations
@@ -322,6 +324,13 @@ class GBM(ModelBuilder):
 
         grad_fn = self._make_grad_fn(dist, K)
         cfg = self._tree_config(K)
+        if not self.drf_mode and K == 1 and dist.name in ("laplace",
+                                                          "quantile"):
+            # exact gamma leaves: median (laplace) / alpha-quantile of the
+            # in-leaf residuals replaces the Newton step (`GBM.java:730,814`)
+            cfg = dataclasses.replace(
+                cfg, leaf_quantile=(0.5 if dist.name == "laplace"
+                                    else p.quantile_alpha))
         # the cache key must pin everything grad_fn's behavior depends on;
         # custom distribution UDFs bypass the cache entirely (an id()-based
         # key could alias a new UDF at a recycled address after GC)

@@ -67,6 +67,13 @@ class TreeConfig:
     use_interaction: bool = False  # interaction_constraints active (the
                                    # (F,F) may-interact matrix rides as an
                                    # array)
+    leaf_quantile: float | None = None  # laplace/quantile leaf refit: leaf
+                                   # value = this quantile of the residuals
+                                   # in the leaf (`hex/tree/gbm/GBM.java:
+                                   # 730,814` exact gamma leaves), computed
+                                   # distributed via a 256-bin residual
+                                   # histogram (bin-resolution exactness —
+                                   # documented divergence)
 
     @property
     def n_nodes(self) -> int:
@@ -139,6 +146,65 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
     init = jnp.zeros((F, n_lv, nbins_tot, V), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
     return jax.lax.psum(hist, ROWS)
+
+
+def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
+    """Per-node q-quantile of the residuals, distributed: one (node, bin)
+    weight histogram over a linear residual grid (one-hot einsums riding the
+    MXU like every other accumulation here), psum across shards, then the
+    quantile read off the cumulative histogram. Exact to grid resolution."""
+    ok = w > 0
+    wz = jnp.where(ok, w, 0.0)
+    Rl = resid.shape[0]
+    rb = _block_rows(Rl, block)
+    nblk = Rl // rb
+
+    def node_hist(nd_r, bins_r, w_r):
+        def body(acc, blk):
+            nd, bb, ww = blk
+            n_oh = (jax.nn.one_hot(nd, acc.shape[0], dtype=jnp.float32)
+                    * ww[:, None])
+            b_oh = jax.nn.one_hot(bb, qbins, dtype=jnp.float32)
+            return acc + jnp.einsum("rn,rb->nb", n_oh, b_oh), None
+
+        init = jnp.zeros((n_nodes, qbins), jnp.float32)
+        h, _ = jax.lax.scan(body, init, (nd_r.reshape(nblk, rb),
+                                         bins_r.reshape(nblk, rb),
+                                         w_r.reshape(nblk, rb)))
+        return jax.lax.psum(h, ROWS)
+
+    # stage 1: find a robust [0.5%, 99.5%] residual span by iterative
+    # histogram refinement (the reference's exact-quantile machinery is the
+    # same shape, `hex/quantile/Quantile.java`). A single extreme outlier
+    # must not set every leaf's bin width — one coarse pass leaves bin width
+    # ~span/256, so three unrolled refinements contract by up to 256³ and
+    # converge onto the central-mass span.
+    lo = jax.lax.pmin(jnp.min(jnp.where(ok, resid, jnp.inf)), ROWS)
+    hi = jax.lax.pmax(jnp.max(jnp.where(ok, resid, -jnp.inf)), ROWS)
+    for _ in range(3):
+        span = jnp.maximum(hi - lo, 1e-12)
+        b = jnp.clip(((resid - lo) / span * qbins).astype(jnp.int32),
+                     0, qbins - 1)
+        g = node_hist(jnp.zeros_like(node), b, wz)[0]
+        gcum = jnp.cumsum(g)
+        gtot = jnp.maximum(gcum[-1], 1e-12)
+        blo = jnp.argmax(gcum >= 0.005 * gtot)
+        bhi = jnp.argmax(gcum >= 0.995 * gtot)
+        lo, hi = (lo + blo.astype(jnp.float32) / qbins * span,
+                  lo + (bhi.astype(jnp.float32) + 1.0) / qbins * span)
+    span = jnp.maximum(hi - lo, 1e-12)
+
+    # stage 2: per-leaf histogram over the robust range; tail values clamp
+    # into the edge bins (still counted, so interior quantiles stay correct)
+    bins = jnp.clip(((resid - lo) / span * qbins).astype(jnp.int32),
+                    0, qbins - 1)
+    hist = node_hist(node, bins, wz)
+    cum = jnp.cumsum(hist, axis=1)
+    tot = cum[:, -1]
+    target = q * tot
+    idx = jnp.argmax(cum >= target[:, None], axis=1)
+    val = lo + (idx.astype(jnp.float32) + 0.5) / qbins * span
+    return jnp.where(tot > 0, val, 0.0)
 
 
 def _node_totals(node, vals, n_nodes, block):
@@ -258,7 +324,7 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
 # Grow one tree fully on device (shard-local function; psums inside).
 # ---------------------------------------------------------------------------
 def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
-               mono=None, imat=None):
+               mono=None, imat=None, resid=None):
     """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,)).
 
     ``mono`` (F,) f32 in {-1,0,1}: monotone constraints. Split candidates
@@ -378,11 +444,19 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     # max-depth leaves and early-stopped internal nodes).
     tot = _node_totals(node, vals3, N, cfg.block_rows)
     scale = 1.0 if cfg.drf_mode else cfg.learn_rate
-    gleaf = tot[:, 1]
-    if cfg.reg_alpha > 0:
-        gleaf = jnp.sign(gleaf) * jnp.maximum(jnp.abs(gleaf) - cfg.reg_alpha, 0.0)
-    newton = jnp.where(tot[:, 0] > 0,
-                       -gleaf / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0)
+    if cfg.leaf_quantile is not None and resid is not None:
+        # laplace/quantile gamma leaves: the leaf value is a QUANTILE of the
+        # in-leaf residuals, not a Newton step (`GBM.java:730,814`)
+        newton = _leaf_quantile_vals(resid, w, node, N, cfg.leaf_quantile,
+                                     cfg.block_rows)
+        newton = jnp.where(tot[:, 0] > 0, newton, 0.0)
+    else:
+        gleaf = tot[:, 1]
+        if cfg.reg_alpha > 0:
+            gleaf = jnp.sign(gleaf) * jnp.maximum(
+                jnp.abs(gleaf) - cfg.reg_alpha, 0.0)
+        newton = jnp.where(tot[:, 0] > 0,
+                           -gleaf / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0)
     if constrained:
         newton = jnp.clip(newton, lo, hi)
     val = newton * scale
@@ -442,9 +516,10 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                 return _onehot_pick(oh, vlk)
 
             if K == 1:
+                resid = (y - f) if cfg.leaf_quantile is not None else None
                 ft, th, nl, vl, ga, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
-                    mono_arg, imat_arg)
+                    mono_arg, imat_arg, resid)
                 vl = vl * rate
                 delta = leaf_delta(vl, node)
             else:

@@ -238,3 +238,54 @@ def test_histogram_types():
     assert not np.allclose(np.sort(qe)[:len(ue)][:3], np.sort(ue)[:3])
     # uniform edges are equally spaced
     assert np.allclose(np.diff(ue), np.diff(ue)[0], rtol=1e-3)
+
+
+def test_quantile_leaf_refit():
+    """Laplace/quantile distributions fit QUANTILE leaves (`GBM.java:730,814`
+    gamma refit): the quantile-0.9 model's predictions sit near the 90th
+    conditional percentile, clearly above the quantile-0.1 model's."""
+    rng = np.random.default_rng(0)
+    n = 4000
+    x = rng.uniform(-2, 2, n).astype(np.float32)
+    noise = rng.normal(0, 1.0, n).astype(np.float32)
+    y = (x + noise).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+
+    def fit(alpha):
+        return GBM(GBMParameters(training_frame=fr, response_column="y",
+                                 ntrees=40, max_depth=3, learn_rate=0.3,
+                                 seed=1, distribution="quantile",
+                                 quantile_alpha=alpha)).train_model()
+
+    hi = fit(0.9).predict(fr).vec(0).to_numpy()
+    lo = fit(0.1).predict(fr).vec(0).to_numpy()
+    # empirical coverage: P(y <= pred_alpha) ~ alpha
+    cov_hi = float(np.mean(y <= hi))
+    cov_lo = float(np.mean(y <= lo))
+    assert 0.8 < cov_hi < 0.97, cov_hi
+    assert 0.03 < cov_lo < 0.2, cov_lo
+    assert np.mean(hi - lo) > 1.5  # ~2*z(0.9)*sigma apart
+
+    # laplace: median leaves -> ~50% coverage, robust to outliers
+    med = GBM(GBMParameters(training_frame=fr, response_column="y",
+                            ntrees=40, max_depth=3, learn_rate=0.3, seed=1,
+                            distribution="laplace")).train_model()
+    cov = float(np.mean(y <= med.predict(fr).vec(0).to_numpy()))
+    assert 0.4 < cov < 0.6, cov
+
+
+def test_laplace_leaf_outlier_robust():
+    """A single extreme outlier must not destroy quantile-leaf resolution:
+    the histogram range clips to the [0.5%, 99.5%] span."""
+    rng = np.random.default_rng(2)
+    n = 2000
+    x = rng.uniform(-2, 2, n).astype(np.float32)
+    y = (x + 0.3 * rng.normal(size=n)).astype(np.float32)
+    y[0] = 1e6  # one corrupted row
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=30,
+                          max_depth=3, learn_rate=0.3, seed=1,
+                          distribution="laplace")).train_model()
+    pred = m.predict(fr).vec(0).to_numpy()
+    mae = float(np.mean(np.abs(pred[1:] - y[1:])))
+    assert mae < 0.5, mae  # ~noise scale; was thousands with a global span
