@@ -8,10 +8,11 @@ Per-class trees for multinomial are one fused vmapped pass
 (`SharedTree.java:361-363`).
 
 Leaf values: Newton steps -G/(H+λ) for most families; laplace/quantile fit
-QUANTILE gamma leaves like the reference (`GBM.java:730,814`) via a
-distributed 256-bin residual histogram (bin-resolution exactness — the one
-remaining leaf divergence is huber's hybrid gamma, `GBM.java:685`, still a
-Newton step). Binning is global-quantile by default with
+QUANTILE gamma leaves and huber fits its hybrid gamma (median + clipped
+mean, per-tree δ) like the reference (`GBM.java:685,730,814`), all via
+distributed residual histograms with iterative range refinement. The one
+huber residue: split-search gradients clip at unit delta rather than the
+per-iteration δ. Binning is global-quantile by default with
 UniformAdaptive/Random selectable (see tree/binning.py).
 """
 
@@ -331,6 +332,10 @@ class GBM(ModelBuilder):
             cfg = dataclasses.replace(
                 cfg, leaf_quantile=(0.5 if dist.name == "laplace"
                                     else p.quantile_alpha))
+        elif not self.drf_mode and K == 1 and dist.name == "huber":
+            # hybrid gamma leaves (`GBM.java:685`); the split-search
+            # gradients still clip at unit delta (documented residue)
+            cfg = dataclasses.replace(cfg, huber_leaf_alpha=p.huber_alpha)
         # the cache key must pin everything grad_fn's behavior depends on;
         # custom distribution UDFs bypass the cache entirely (an id()-based
         # key could alias a new UDF at a recycled address after GC)

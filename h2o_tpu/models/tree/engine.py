@@ -74,6 +74,11 @@ class TreeConfig:
                                    # distributed via a 256-bin residual
                                    # histogram (bin-resolution exactness —
                                    # documented divergence)
+    huber_leaf_alpha: float | None = None  # huber hybrid gamma leaf
+                                   # (`GBM.java:685` fitBestConstantsHuber):
+                                   # median(resid) + mean(sign·min(|resid −
+                                   # median|, δ)), δ = alpha-quantile of
+                                   # |resid| per tree
 
     @property
     def n_nodes(self) -> int:
@@ -149,12 +154,17 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
 
 
 def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
+    # refinement tails must BRACKET q: a fixed [0.5%, 99.5%] clamp would bias
+    # extreme quantiles (huber_alpha → 1.0 means δ = max|resid|)
+
     """Per-node q-quantile of the residuals, distributed: one (node, bin)
     weight histogram over a linear residual grid (one-hot einsums riding the
     MXU like every other accumulation here), psum across shards, then the
     quantile read off the cumulative histogram. Exact to grid resolution."""
     ok = w > 0
     wz = jnp.where(ok, w, 0.0)
+    lo_frac = min(0.005, q * 0.5)
+    hi_frac = max(0.995, q + (1.0 - q) * 0.5)
     Rl = resid.shape[0]
     rb = _block_rows(Rl, block)
     nblk = Rl // rb
@@ -188,8 +198,8 @@ def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
         g = node_hist(jnp.zeros_like(node), b, wz)[0]
         gcum = jnp.cumsum(g)
         gtot = jnp.maximum(gcum[-1], 1e-12)
-        blo = jnp.argmax(gcum >= 0.005 * gtot)
-        bhi = jnp.argmax(gcum >= 0.995 * gtot)
+        blo = jnp.argmax(gcum >= lo_frac * gtot)
+        bhi = jnp.argmax(gcum >= hi_frac * gtot)
         lo, hi = (lo + blo.astype(jnp.float32) / qbins * span,
                   lo + (bhi.astype(jnp.float32) + 1.0) / qbins * span)
     span = jnp.maximum(hi - lo, 1e-12)
@@ -444,7 +454,24 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     # max-depth leaves and early-stopped internal nodes).
     tot = _node_totals(node, vals3, N, cfg.block_rows)
     scale = 1.0 if cfg.drf_mode else cfg.learn_rate
-    if cfg.leaf_quantile is not None and resid is not None:
+    if cfg.huber_leaf_alpha is not None and resid is not None:
+        # huber hybrid gamma (`GBM.java:685`): per-leaf median, then the
+        # leaf mean of sign(r−med)·min(|r−med|, δ) with δ the per-tree
+        # alpha-quantile of |residual| (Friedman 1999 eq. 24)
+        med = _leaf_quantile_vals(resid, w, node, N, 0.5, cfg.block_rows)
+        delta = _leaf_quantile_vals(jnp.abs(resid), w,
+                                    jnp.zeros_like(node), 1,
+                                    cfg.huber_leaf_alpha, cfg.block_rows)[0]
+        med_row = _onehot_pick(jax.nn.one_hot(node, N, dtype=jnp.float32),
+                               med)
+        d = resid - med_row
+        clipped = jnp.sign(d) * jnp.minimum(jnp.abs(d), delta)
+        tot2 = _node_totals(node, (w * clipped)[:, None], N, cfg.block_rows)
+        # per-node weight sums already live in tot[:, 0]
+        gamma = jnp.where(tot[:, 0] > 0,
+                          tot2[:, 0] / jnp.maximum(tot[:, 0], 1e-10), 0.0)
+        newton = jnp.where(tot[:, 0] > 0, med + gamma, 0.0)
+    elif cfg.leaf_quantile is not None and resid is not None:
         # laplace/quantile gamma leaves: the leaf value is a QUANTILE of the
         # in-leaf residuals, not a Newton step (`GBM.java:730,814`)
         newton = _leaf_quantile_vals(resid, w, node, N, cfg.leaf_quantile,
@@ -516,7 +543,9 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                 return _onehot_pick(oh, vlk)
 
             if K == 1:
-                resid = (y - f) if cfg.leaf_quantile is not None else None
+                resid = ((y - f) if (cfg.leaf_quantile is not None or
+                                     cfg.huber_leaf_alpha is not None)
+                         else None)
                 ft, th, nl, vl, ga, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
                     mono_arg, imat_arg, resid)

@@ -289,3 +289,28 @@ def test_laplace_leaf_outlier_robust():
     pred = m.predict(fr).vec(0).to_numpy()
     mae = float(np.mean(np.abs(pred[1:] - y[1:])))
     assert mae < 0.5, mae  # ~noise scale; was thousands with a global span
+
+
+def test_huber_hybrid_leaf_outlier_robust():
+    """Huber hybrid gamma leaves (`GBM.java:685`): median + clipped-mean —
+    robust to a corrupted row while tracking the mean on clean data."""
+    rng = np.random.default_rng(3)
+    n = 3000
+    x = rng.uniform(-2, 2, n).astype(np.float32)
+    y = (2 * x + 0.3 * rng.normal(size=n)).astype(np.float32)
+    y[:5] = 1e5  # corrupted rows
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=40,
+                          max_depth=3, learn_rate=0.3, seed=1,
+                          distribution="huber",
+                          huber_alpha=0.9)).train_model()
+    pred = m.predict(fr).vec(0).to_numpy()
+    mae = float(np.mean(np.abs(pred[5:] - y[5:])))
+    assert mae < 0.6, mae
+    # gaussian on the same data is wrecked by the outliers
+    g = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=40,
+                          max_depth=3, learn_rate=0.3, seed=1,
+                          distribution="gaussian")).train_model()
+    gmae = float(np.mean(np.abs(
+        g.predict(fr).vec(0).to_numpy()[5:] - y[5:])))
+    assert mae < 0.25 * gmae, (mae, gmae)
