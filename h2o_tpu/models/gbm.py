@@ -51,6 +51,8 @@ class GBMParameters(Parameters):
                                   # (`hex/tree/SharedTreeModel.HistogramType`)
     col_sample_rate: float = 1.0
     col_sample_rate_per_tree: float = 1.0
+    col_sample_rate_change_per_level: float = 1.0
+    max_abs_leafnode_pred: float = float("inf")
     nbins: int = 20
     nbins_cats: int = 1024
     min_split_improvement: float = 1e-5
@@ -251,6 +253,8 @@ class GBM(ModelBuilder):
             min_split_improvement=p.min_split_improvement,
             sample_rate=p.sample_rate, col_sample_rate=p.col_sample_rate,
             col_sample_rate_per_tree=p.col_sample_rate_per_tree,
+            col_sample_rate_change_per_level=p.col_sample_rate_change_per_level,
+            max_abs_leafnode_pred=p.max_abs_leafnode_pred,
             drf_mode=self.drf_mode, nclass=K,
         )
 

@@ -74,6 +74,13 @@ class TreeConfig:
                                    # distributed via a 256-bin residual
                                    # histogram (bin-resolution exactness —
                                    # documented divergence)
+    max_abs_leafnode_pred: float = float("inf")  # cap on the STORED leaf
+                                   # prediction, i.e. AFTER the learn-rate
+                                   # scale (`GBM.java:718` clips
+                                   # learn_rate·gamma)
+    col_sample_rate_change_per_level: float = 1.0  # multiplies the per-level
+                                   # column sample rate each level deeper
+                                   # (`SharedTreeModel` parameter)
     huber_leaf_alpha: float | None = None  # huber hybrid gamma leaf
                                    # (`GBM.java:685` fitBestConstantsHuber):
                                    # median(resid) + mean(sign·min(|resid −
@@ -234,15 +241,25 @@ def _node_totals(node, vals, n_nodes, block):
     return jax.lax.psum(tot, ROWS)
 
 
-def _level_col_mask(lkey, F, n_lv, cfg: "TreeConfig", tree_cols):
+def _level_col_mask(lkey, F, n_lv, cfg: "TreeConfig", tree_cols,
+                    level: int = 0):
     """Per-(feature, node) sampling mask for one level: mtries k-of-F draw
-    (DRF, `hex/tree/drf/DRF.java` mtry) or Bernoulli col_sample_rate (GBM)."""
+    (DRF, `hex/tree/drf/DRF.java` mtry) or Bernoulli col_sample_rate (GBM),
+    scaled by col_sample_rate_change_per_level^level (clamped to (0, 1])."""
+    rate = min(max(cfg.col_sample_rate
+                   * cfg.col_sample_rate_change_per_level ** level, 1e-6),
+               1.0)
     if cfg.mtries > 0:
+        # per-level rate shrinks the k-of-F draw too (H2O applies
+        # col_sample_rate_change_per_level to DRF's per-level sampling)
+        k = max(1, int(round(min(cfg.mtries, F)
+                             * min(cfg.col_sample_rate_change_per_level
+                                   ** level, 1.0))))
         u = jax.random.uniform(lkey, (F, n_lv))
-        kth = jnp.sort(u, axis=0)[min(cfg.mtries, F) - 1]
+        kth = jnp.sort(u, axis=0)[k - 1]
         cmask = u <= kth[None, :]
-    elif cfg.col_sample_rate < 1.0:
-        cmask = jax.random.uniform(lkey, (F, n_lv)) < cfg.col_sample_rate
+    elif rate < 1.0:
+        cmask = jax.random.uniform(lkey, (F, n_lv)) < rate
         cmask = jnp.where(jnp.any(cmask, axis=0, keepdims=True), cmask, True)
     else:
         cmask = jnp.ones((F, n_lv), dtype=jnp.bool_)
@@ -381,7 +398,7 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
                                  cfg.block_rows, use_pallas)
 
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
-                                cfg, tree_cols)
+                                cfg, tree_cols, level)
         if interacting:
             allowed_n = jax.lax.dynamic_slice(allowed, (offset, 0), (n_lv, F))
             cmask = cmask & allowed_n.T  # (F, n_lv)
@@ -487,6 +504,10 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     if constrained:
         newton = jnp.clip(newton, lo, hi)
     val = newton * scale
+    if math.isfinite(cfg.max_abs_leafnode_pred):
+        # the reference caps the FINAL stored pred = learn_rate·gamma
+        val = jnp.clip(val, -cfg.max_abs_leafnode_pred,
+                       cfg.max_abs_leafnode_pred)
     return feat, thr, nanL, val, garr, node
 
 

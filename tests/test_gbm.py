@@ -314,3 +314,19 @@ def test_huber_hybrid_leaf_outlier_robust():
     gmae = float(np.mean(np.abs(
         g.predict(fr).vec(0).to_numpy()[5:] - y[5:])))
     assert mae < 0.25 * gmae, (mae, gmae)
+
+
+def test_max_abs_leafnode_pred_and_col_rate_per_level():
+    fr = _regression_frame()
+    m = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=10,
+                          max_depth=4, seed=1,
+                          max_abs_leafnode_pred=0.05)).train_model()
+    val = np.asarray(m.forest["val"])
+    # the STORED pred (learn_rate already applied) caps at 0.05 (`GBM.java:718`)
+    assert np.max(np.abs(val)) <= 0.05 + 1e-7
+    assert np.max(np.abs(val)) > 0.05 * 0.5  # the cap actually binds
+    m2 = GBM(GBMParameters(training_frame=fr, response_column="y", ntrees=10,
+                           max_depth=4, seed=1, col_sample_rate=1.0,
+                           col_sample_rate_change_per_level=0.5)
+             ).train_model()
+    assert m2.output.training_metrics.r2 > 0.5  # still learns, just sampled
