@@ -161,17 +161,20 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
 
 
 def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
-    # refinement tails must BRACKET q: a fixed [0.5%, 99.5%] clamp would bias
-    # extreme quantiles (huber_alpha → 1.0 means δ = max|resid|)
-
-    """Per-node q-quantile of the residuals, distributed: one (node, bin)
-    weight histogram over a linear residual grid (one-hot einsums riding the
-    MXU like every other accumulation here), psum across shards, then the
-    quantile read off the cumulative histogram. Exact to grid resolution."""
+    """Per-node q-quantile of the residuals, distributed: (node, bin) weight
+    histograms over a linear residual grid (one-hot einsums riding the MXU
+    like every other accumulation here), psum across shards, the quantile read
+    off the cumulative histogram, then the PER-NODE bracket refined and the
+    histogram rebuilt — three passes contract each node's bracket by qbins³
+    from the true global range (`hex/quantile/Quantile.java` iterates the same
+    way). Refining per node (not one global robust span) means a leaf whose
+    residuals sit entirely in the global tail reads its real quantile instead
+    of a clamped edge-bin midpoint; rows outside a node's bracket clip into
+    the edge bins but keep their cumulative mass, so the target index stays
+    exact as long as the true quantile lies inside the bracket (guaranteed by
+    the previous pass)."""
     ok = w > 0
     wz = jnp.where(ok, w, 0.0)
-    lo_frac = min(0.005, q * 0.5)
-    hi_frac = max(0.995, q + (1.0 - q) * 0.5)
     Rl = resid.shape[0]
     rb = _block_rows(Rl, block)
     nblk = Rl // rb
@@ -190,37 +193,26 @@ def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
                                          w_r.reshape(nblk, rb)))
         return jax.lax.psum(h, ROWS)
 
-    # stage 1: find a robust [0.5%, 99.5%] residual span by iterative
-    # histogram refinement (the reference's exact-quantile machinery is the
-    # same shape, `hex/quantile/Quantile.java`). A single extreme outlier
-    # must not set every leaf's bin width — one coarse pass leaves bin width
-    # ~span/256, so three unrolled refinements contract by up to 256³ and
-    # converge onto the central-mass span.
-    lo = jax.lax.pmin(jnp.min(jnp.where(ok, resid, jnp.inf)), ROWS)
-    hi = jax.lax.pmax(jnp.max(jnp.where(ok, resid, -jnp.inf)), ROWS)
+    gmin = jax.lax.pmin(jnp.min(jnp.where(ok, resid, jnp.inf)), ROWS)
+    gmax = jax.lax.pmax(jnp.max(jnp.where(ok, resid, -jnp.inf)), ROWS)
+    lo_n = jnp.full((n_nodes,), gmin, jnp.float32)
+    hi_n = jnp.full((n_nodes,), gmax, jnp.float32)
+    n_oh = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)
+    tot = jnp.zeros((n_nodes,), jnp.float32)
     for _ in range(3):
-        span = jnp.maximum(hi - lo, 1e-12)
-        b = jnp.clip(((resid - lo) / span * qbins).astype(jnp.int32),
-                     0, qbins - 1)
-        g = node_hist(jnp.zeros_like(node), b, wz)[0]
-        gcum = jnp.cumsum(g)
-        gtot = jnp.maximum(gcum[-1], 1e-12)
-        blo = jnp.argmax(gcum >= lo_frac * gtot)
-        bhi = jnp.argmax(gcum >= hi_frac * gtot)
-        lo, hi = (lo + blo.astype(jnp.float32) / qbins * span,
-                  lo + (bhi.astype(jnp.float32) + 1.0) / qbins * span)
-    span = jnp.maximum(hi - lo, 1e-12)
-
-    # stage 2: per-leaf histogram over the robust range; tail values clamp
-    # into the edge bins (still counted, so interior quantiles stay correct)
-    bins = jnp.clip(((resid - lo) / span * qbins).astype(jnp.int32),
-                    0, qbins - 1)
-    hist = node_hist(node, bins, wz)
-    cum = jnp.cumsum(hist, axis=1)
-    tot = cum[:, -1]
-    target = q * tot
-    idx = jnp.argmax(cum >= target[:, None], axis=1)
-    val = lo + (idx.astype(jnp.float32) + 0.5) / qbins * span
+        span_n = jnp.maximum(hi_n - lo_n, 1e-12)
+        lo_row = _onehot_pick(n_oh, lo_n)
+        span_row = jnp.maximum(_onehot_pick(n_oh, span_n), 1e-12)
+        bins = jnp.clip(((resid - lo_row) / span_row * qbins)
+                        .astype(jnp.int32), 0, qbins - 1)
+        hist = node_hist(node, bins, wz)
+        cum = jnp.cumsum(hist, axis=1)
+        tot = cum[:, -1]
+        target = q * tot
+        idx = jnp.argmax(cum >= target[:, None], axis=1).astype(jnp.float32)
+        lo_n, hi_n = (lo_n + idx / qbins * span_n,
+                      lo_n + (idx + 1.0) / qbins * span_n)
+    val = 0.5 * (lo_n + hi_n)
     return jnp.where(tot > 0, val, 0.0)
 
 
@@ -245,16 +237,19 @@ def _level_col_mask(lkey, F, n_lv, cfg: "TreeConfig", tree_cols,
                     level: int = 0):
     """Per-(feature, node) sampling mask for one level: mtries k-of-F draw
     (DRF, `hex/tree/drf/DRF.java` mtry) or Bernoulli col_sample_rate (GBM),
-    scaled by col_sample_rate_change_per_level^level (clamped to (0, 1])."""
+    scaled by col_sample_rate_change_per_level^level. The factor's range is
+    (0, 2]: the Bernoulli rate saturates at 1.0, but the mtries k keeps
+    growing past its base value up to F (DTree.actual_mtries())."""
     rate = min(max(cfg.col_sample_rate
                    * cfg.col_sample_rate_change_per_level ** level, 1e-6),
                1.0)
     if cfg.mtries > 0:
-        # per-level rate shrinks the k-of-F draw too (H2O applies
-        # col_sample_rate_change_per_level to DRF's per-level sampling)
-        k = max(1, int(round(min(cfg.mtries, F)
-                             * min(cfg.col_sample_rate_change_per_level
-                                   ** level, 1.0))))
+        # per-level factor scales the k-of-F draw in BOTH directions: the
+        # reference's DTree.actual_mtries() grows mtries via pow(factor,
+        # depth) up to ncols for factor > 1 (parameter range (0, 2])
+        k = min(F, max(1, int(round(
+            min(cfg.mtries, F)
+            * cfg.col_sample_rate_change_per_level ** level))))
         u = jax.random.uniform(lkey, (F, n_lv))
         kth = jnp.sort(u, axis=0)[k - 1]
         cmask = u <= kth[None, :]
@@ -351,7 +346,7 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
 # Grow one tree fully on device (shard-local function; psums inside).
 # ---------------------------------------------------------------------------
 def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
-               mono=None, imat=None, resid=None):
+               mono=None, imat=None, resid=None, w_full=None):
     """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,)).
 
     ``mono`` (F,) f32 in {-1,0,1}: monotone constraints. Split candidates
@@ -476,7 +471,11 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
         # leaf mean of sign(r−med)·min(|r−med|, δ) with δ the per-tree
         # alpha-quantile of |residual| (Friedman 1999 eq. 24)
         med = _leaf_quantile_vals(resid, w, node, N, 0.5, cfg.block_rows)
-        delta = _leaf_quantile_vals(jnp.abs(resid), w,
+        # δ is computed over ALL training rows with the unsampled weights
+        # (GBM.java:485 computeWeightedQuantile(_weights, diff, alpha) runs
+        # before tree fitting); the per-leaf median/gamma stay in-bag.
+        delta = _leaf_quantile_vals(jnp.abs(resid),
+                                    w if w_full is None else w_full,
                                     jnp.zeros_like(node), 1,
                                     cfg.huber_leaf_alpha, cfg.block_rows)[0]
         med_row = _onehot_pick(jax.nn.one_hot(node, N, dtype=jnp.float32),
@@ -503,11 +502,10 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
                            -gleaf / (tot[:, 2] + cfg.reg_lambda + 1e-10), 0.0)
     if constrained:
         newton = jnp.clip(newton, lo, hi)
+    # max_abs_leafnode_pred caps the FINAL stored pred =
+    # effective_learning_rate·gamma (GBM.java:716-719) — annealing included,
+    # so the clip happens in tree_step after the per-tree rate is applied.
     val = newton * scale
-    if math.isfinite(cfg.max_abs_leafnode_pred):
-        # the reference caps the FINAL stored pred = learn_rate·gamma
-        val = jnp.clip(val, -cfg.max_abs_leafnode_pred,
-                       cfg.max_abs_leafnode_pred)
     return feat, thr, nanL, val, garr, node
 
 
@@ -555,6 +553,15 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             else:
                 s = jnp.ones(w.shape[-1:], jnp.float32)
             g, h = grad_fn(y, f, w)
+
+            def scale_leaves(vlk):
+                # annealed rate first, THEN the cap: the reference clips
+                # effective_learning_rate()·gamma (GBM.java:716-719)
+                vlk = vlk * rate
+                if math.isfinite(cfg.max_abs_leafnode_pred):
+                    vlk = jnp.clip(vlk, -cfg.max_abs_leafnode_pred,
+                                   cfg.max_abs_leafnode_pred)
+                return vlk
             # leaf-value broadcast rides the MXU too (vl[node] is a per-row
             # dynamic gather otherwise — see the routing comment in _grow_tree)
             def leaf_delta(vlk, nodek):
@@ -569,8 +576,8 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                          else None)
                 ft, th, nl, vl, ga, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
-                    mono_arg, imat_arg, resid)
-                vl = vl * rate
+                    mono_arg, imat_arg, resid, w_full=w)
+                vl = scale_leaves(vl)
                 delta = leaf_delta(vl, node)
             else:
                 grow = jax.vmap(
@@ -579,7 +586,7 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                                                   mono_arg, imat_arg))
                 ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
                 ft, th, nl, vl, ga, node = grow(g, h, ckeys)
-                vl = vl * rate
+                vl = scale_leaves(vl)
                 delta = jax.vmap(leaf_delta)(vl, node)
             f = f + delta
             # OOB accumulation (`DRF.java` OOB scoring): rows outside this

@@ -268,6 +268,8 @@ def merge(left: Frame, right: Frame, by: list[str] | None = None,
                         for n in right.names)
             and not left.vec(by[0]).is_categorical()
             and not right.vec(by[0]).is_categorical()
+            # empty tables take the host path: the combined-sort fills in
+            # _merge_ranges/_merge_expand assume rn >= 1
             and left.nrow > 0 and right.nrow > 0):
         return _merge_device(left, right, by[0], all_x)
     ln, rn = left.nrow, right.nrow
@@ -329,8 +331,10 @@ def merge(left: Frame, right: Frame, by: list[str] | None = None,
             bj = by.index(name)
             lhost = v.to_numpy()
             fill = np.where(np.isfinite(rk[:, bj]), rk[:, bj], np.nan)
+            fill_at = (fill[np.clip(r_pos, 0, None)] if rn
+                       else np.full(len(r_pos), np.nan))
             out = np.where(l_idx >= 0, lhost[np.clip(l_idx, 0, None)],
-                           fill[np.clip(r_pos, 0, None)])
+                           fill_at)
             col = Vec.from_numpy(out.astype(np.float32), type=v.type,
                                  domain=v.domain)
         else:
@@ -360,5 +364,8 @@ def _take(v: Vec, idx: np.ndarray):
     if v.is_string():
         out = np.array([host[i] if i >= 0 else None for i in idx], dtype=object)
         return Vec(None, len(idx), type=T_STR, host_data=out)
-    out = np.where(idx >= 0, host[np.clip(idx, 0, None)], np.nan)
+    if len(host) == 0:
+        out = np.full(len(idx), np.nan)
+    else:
+        out = np.where(idx >= 0, host[np.clip(idx, 0, None)], np.nan)
     return Vec.from_numpy(out.astype(np.float32), type=v.type, domain=v.domain)

@@ -254,6 +254,20 @@ def test_merge_empty_left():
     assert merge(left, right, by=["k"]).nrow == 0
 
 
+def test_merge_empty_right():
+    """Empty right table (ADVICE r1): routed to the host path, which must
+    not index into size-0 right columns — inner join is empty, left join
+    keeps all left rows with NA right columns."""
+    left = Frame.from_dict({"k": np.array([1.0, 2.0], np.float32),
+                            "v": np.array([10.0, 20.0], np.float32)})
+    right = Frame.from_dict({"k": np.zeros(0, np.float32),
+                             "w": np.zeros(0, np.float32)})
+    assert merge(left, right, by=["k"]).nrow == 0
+    lj = merge(left, right, by=["k"], all_x=True)
+    assert lj.nrow == 2
+    assert np.isnan(lj.vec("w").to_numpy()).all()
+
+
 def test_merge_duplicate_keys_and_na_vs_pandas():
     """Randomized check of the combined-sort join against pandas: duplicate
     right keys (expansion), unmatched rows, NA keys, inner + left joins."""
