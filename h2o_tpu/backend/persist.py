@@ -94,6 +94,15 @@ def _to_host(obj):
 
 def save_model(model, path: str) -> str:
     """Binary model export. Frames on the params are replaced by their keys."""
+    if hasattr(model, "_ensure_covers"):
+        # Tree models compute SHAP node covers lazily from the attached
+        # training frame; the export strips frames, so materialize covers now
+        # (best effort — a model whose frame is already gone exports without
+        # them, and SHAP raises its imported-without-node-weights error).
+        try:
+            model._ensure_covers()
+        except ValueError:
+            pass
     state = dict(model.__dict__)
     params = state.get("params")
     if params is not None:

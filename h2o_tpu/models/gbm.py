@@ -126,10 +126,7 @@ class GBMModel(Model):
         if self.output.model_category not in ("Regression", "Binomial"):
             raise ValueError("predict_contributions supports regression and "
                              "binomial tree models only (as in the reference)")
-        if "cover" not in self.forest:
-            raise ValueError("model has no stored node covers (trained before "
-                             "SHAP support, or imported from a MOJO without "
-                             "node weights)")
+        self._ensure_covers()
         from .tree.shap import tree_shap
 
         X = np.asarray(self.adapt_frame(fr))[:fr.nrow]
@@ -142,6 +139,39 @@ class GBMModel(Model):
         names = list(self.output.names) + ["BiasTerm"]
         return Frame.from_dict(
             {n: phi[:, i].astype(np.float32) for i, n in enumerate(names)})
+
+    def _ensure_covers(self) -> None:
+        """Compute node covers lazily, on first SHAP use.
+
+        `forest_covers` is a full routing pass over the training rows — real
+        wall-clock (≈8 s at HIGGS scale) that the common train→predict path
+        never needs, so it runs here instead of inside training, from the
+        still-attached training frame (the reference pays this cost at
+        training time by writing node weights into the tree format;
+        `hex/genmodel/algos/tree/TreeSHAP.java` only reads them at SHAP
+        time)."""
+        if "cover" in self.forest:
+            return
+        p = self.params
+        fr = p.training_frame
+        if fr is None:
+            raise ValueError(
+                "model has no stored node covers and no attached training "
+                "frame to compute them from (model was imported without node "
+                "weights)")
+        from .tree.engine import forest_covers
+
+        X = self.adapt_frame(fr)  # padded device matrix, training column order
+        if p.weights_column:
+            w = jnp.nan_to_num(fr.vec(p.weights_column).data)  # padding -> 0
+        else:
+            w = jnp.ones(X.shape[0], jnp.float32)
+        # rows with NA response carried zero weight during training (and
+        # padding rows have NaN response), so covers must exclude them too
+        w = w * (~jnp.isnan(fr.vec(p.response_column).data)).astype(jnp.float32)
+        self.forest["cover"] = forest_covers(
+            X, w, self.forest["feat"], self.forest["thr"],
+            self.forest["nanL"], self.cfg.max_depth)
 
     def _leaf_nodes(self, X: np.ndarray) -> np.ndarray:
         """(R, T*[K]) final heap node index per row per tree via host routing."""
@@ -285,11 +315,13 @@ class GBM(ModelBuilder):
 
         X = fr.as_matrix(names)
         is_cat = np.array([fr.vec(n).is_categorical() for n in names])
-        w_host = np.ones(fr.nrow, dtype=np.float32)
         if p.weights_column:
             w_host = np.nan_to_num(fr.vec(p.weights_column).to_numpy())
-        w = Vec.from_numpy(w_host).data
-        w = jnp.nan_to_num(w)  # padding -> 0
+            w = jnp.nan_to_num(Vec.from_numpy(w_host).data)  # padding -> 0
+        else:
+            # device-side ones: no 4·R-byte host→device trip; padding rows
+            # zero out through the response mask below (padding y is NaN)
+            w = jnp.ones_like(y_dev, dtype=jnp.float32)
         y = jnp.nan_to_num(y_dev)
         ymask = ~jnp.isnan(y_dev)
         w = w * ymask.astype(jnp.float32)
@@ -348,7 +380,6 @@ class GBM(ModelBuilder):
         else:
             grad_key = (type(self).__name__, self.drf_mode, K, dist.name,
                         p.tweedie_power, p.quantile_alpha, p.huber_alpha)
-        train_fn = make_train_fn(cfg, grad_fn, mesh, cache_key=grad_key)
 
         if K > 1:
             y_k = jnp.broadcast_to(y, (K, y.shape[0]))
@@ -413,6 +444,12 @@ class GBM(ModelBuilder):
         chunks = [(all_keys[i:i + interval],
                    jnp.asarray(all_rates[i:i + interval]))
                   for i in range(0, n_new, interval)]
+        # The compiled program depends on the CHUNK length (the scan is over
+        # the per-chunk keys), never on the total tree count — keying the
+        # train-fn cache on the interval makes a 10-tree warm-up compile serve
+        # a 1000-tree run at the same scoring cadence.
+        train_fn = make_train_fn(dataclasses.replace(cfg, ntrees=interval),
+                                 grad_fn, mesh, cache_key=grad_key)
 
         output = ModelOutput()
         output.names = names
@@ -466,12 +503,10 @@ class GBM(ModelBuilder):
         output.training_metrics = history[-1]["training_metrics"]
 
         forest = _assemble_forest(parts)
-        # node covers for TreeSHAP (`forest_covers` docstring): one routing
-        # pass over the training rows, stored with the forest
-        from .tree.engine import forest_covers
-
-        forest["cover"] = forest_covers(X, w, forest["feat"], forest["thr"],
-                                        forest["nanL"], cfg.max_depth)
+        # node covers for TreeSHAP are computed lazily on first
+        # predict_contributions call (GBMModel._ensure_covers) — the routing
+        # pass over all training rows is pure overhead for the common
+        # train→predict path
         output.variable_importances = self._varimp(forest, names)
         model = GBMModel(p, output, forest, f0, dist, cfg, is_cat)
         if getattr(p, "calibrate_model", False):

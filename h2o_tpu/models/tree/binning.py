@@ -18,9 +18,39 @@ Layout:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("qs",))
+def _sampled_quantile_rows(X, idx, qs):
+    """(nq, F) linear-interpolated per-column quantiles of the sampled rows,
+    entirely on device. The gather + sort + read stays on the chip: shipping
+    even a 200k-row sample through the device tunnel measured 100s+, while
+    this program runs in ~0.2 s and moves only (nq, F) floats to the host."""
+    Xs = jnp.take(X, idx, axis=0)
+    S = jnp.sort(Xs, axis=0)  # NaN sorts to the end
+    nval = jnp.sum(~jnp.isnan(Xs), axis=0)
+    q = jnp.asarray(qs, jnp.float32)[:, None]
+    pos = q * (jnp.maximum(nval[None, :], 1) - 1).astype(jnp.float32)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, Xs.shape[0] - 1)
+    hi = jnp.clip(lo + 1, 0, Xs.shape[0] - 1)
+    frac = pos - lo.astype(jnp.float32)
+    vlo = jnp.take_along_axis(S, lo, axis=0)
+    vhi = jnp.take_along_axis(S, hi, axis=0)
+    # hi may point past the last valid value into the NaN tail; the
+    # interpolation weight there is 0 only when pos is integral, so clamp
+    vhi = jnp.where(hi >= nval[None, :], vlo, vhi)
+    out = vlo * (1.0 - frac) + vhi * frac
+    return jnp.where(nval[None, :] > 0, out, jnp.nan)
+
+
+@jax.jit
+def _col_minmax(X):
+    return jnp.nanmin(X, axis=0), jnp.nanmax(X, axis=0)
 
 
 def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
@@ -35,8 +65,9 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
     extremely-randomized-trees flavor). Categorical features always bin on
     their category codes.
 
-    X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles are taken on a
-    host-side row sample (the reference's QuantilesGlobal mode also samples).
+    X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles are taken on
+    a row sample, ON DEVICE (the reference's QuantilesGlobal mode also
+    samples) — only the (F, nbins-1) result crosses to the host.
     Returns (F, nbins-1) float32 edges, NaN-padded where a feature has fewer
     distinct cut points.
     """
@@ -45,36 +76,38 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
         raise ValueError(
             f"unsupported histogram_type '{histogram_type}' — supported: "
             f"AUTO, QuantilesGlobal, UniformAdaptive, Random")
-    R, F = X.shape
-    if R > sample:
-        rng = np.random.default_rng(seed)
-        idx = rng.choice(R, size=sample, replace=False)
-        Xs = np.asarray(X[np.sort(idx)])
-    else:
-        Xs = np.asarray(X)
-    edges = np.full((F, nbins - 1), np.nan, dtype=np.float32)
+    Xj = jnp.asarray(X)
+    R, F = Xj.shape
     qs = np.linspace(0, 1, nbins + 1)[1:-1]
+    col_min, col_max = (np.asarray(v) for v in _col_minmax(Xj))
+    qrows = None
+    if ht in ("auto", "quantilesglobal"):
+        rng = np.random.default_rng(seed)
+        idx = (np.sort(rng.choice(R, size=sample, replace=False))
+               if R > sample else np.arange(R))
+        qrows = np.asarray(_sampled_quantile_rows(Xj, jnp.asarray(idx),
+                                                  tuple(qs)))
+    edges = np.full((F, nbins - 1), np.nan, dtype=np.float32)
     for f in range(F):
-        col = Xs[:, f]
-        col = col[~np.isnan(col)]
-        if col.size == 0:
+        if not np.isfinite(col_max[f]):  # all-NaN column
             continue
         if is_cat[f]:
-            card = int(col.max()) + 1
+            card = int(col_max[f]) + 1
             cuts = np.arange(min(card - 1, nbins - 1), dtype=np.float32)
         elif ht == "uniformadaptive":
-            lo, hi = float(col.min()), float(col.max())
+            lo, hi = float(col_min[f]), float(col_max[f])
             cuts = (np.unique(np.linspace(lo, hi, nbins + 1)[1:-1]
                               .astype(np.float32)) if hi > lo
                     else np.zeros(0, np.float32))
         elif ht == "random":
-            lo, hi = float(col.min()), float(col.max())
+            lo, hi = float(col_min[f]), float(col_max[f])
             rrng = np.random.default_rng(seed + 7919 * f)
             cuts = (np.unique(rrng.uniform(lo, hi, nbins - 1)
                               .astype(np.float32)) if hi > lo
                     else np.zeros(0, np.float32))
         else:  # AUTO / QuantilesGlobal
-            cuts = np.unique(np.quantile(col, qs).astype(np.float32))
+            col = qrows[:, f]
+            cuts = np.unique(col[~np.isnan(col)].astype(np.float32))
         edges[f, : len(cuts)] = cuts
     return edges
 
