@@ -40,36 +40,75 @@ class MojoModel:
     # -- loading -------------------------------------------------------------
     @staticmethod
     def load(path: str) -> "MojoModel":
+        import os
+
+        if os.path.isdir(path):
+            # exploded MOJO directory (`FolderMojoReaderBackend` analog)
+            return MojoModel._from_reader(_DirReader(path))
         zr = MojoZipReader(path)
         try:
-            info, columns, dommap = parse_model_ini(zr.text("model.ini"))
-            domains = [None] * len(columns)
-            for ci, fname in dommap.items():
-                lines = zr.text(f"domains/{fname}").splitlines()
-                domains[ci] = [unescape_line(s) for s in lines]
-            algo = info.get("algo")
-            cls = {"gbm": _TreeMojo, "drf": _TreeMojo, "glm": _GlmMojo,
-                   "kmeans": _KMeansMojo, "deeplearning": _DeepLearningMojo,
-                   "isolationforest": _IsoForMojo,
-                   "extendedisolationforest": _IsoForMojo,
-                   "pca": _PcaMojo,
-                   "coxph": _CoxPHMojo,
-                   "isotonic": _IsotonicMojo,
-                   "word2vec": _Word2VecMojo,
-                   "glrm": _GlrmMojo,
-                   "targetencoder": _TargetEncoderMojo,
-                   "upliftdrf": _UpliftMojo,
-                   "gam": _GamMojo,
-                   "rulefit": _RuleFitMojo,
-                   "psvm": _PsvmMojo,
-                   "stackedensemble": _EnsembleMojo}.get(algo)
-            if cls is None:
-                raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
-            model = cls(info, columns, domains)
-            model._read(zr)
-            return model
+            return MojoModel._from_reader(zr)
         finally:
             zr.close()
+
+    @staticmethod
+    def _from_reader(zr) -> "MojoModel":
+        """Load from any reader backend (the top-level zip or a nested
+        sub-model directory inside an ensemble MOJO — the
+        `MultiModelMojoReader.NestedMojoReaderBackend` role)."""
+        info, columns, dommap = parse_model_ini(zr.text("model.ini"))
+        domains = [None] * len(columns)
+        for ci, fname in dommap.items():
+            if ci >= len(columns):
+                # some JVM exports carry a response-domain file indexed past
+                # n_columns; the reference skips it (ModelMojoReader.java:348)
+                continue
+            lines = zr.text(f"domains/{fname}").splitlines()
+            domains[ci] = [unescape_line(s) for s in lines]
+        algo = info.get("algo")
+        if algo is None:
+            # pre-`algo`-key MOJOs (mojo_version 1.0) carry only the display
+            # name; the reference dispatches on it too (ModelMojoFactory)
+            algo = {
+                "Gradient Boosting Machine": "gbm",
+                "Gradient Boosting Method": "gbm",
+                "Distributed Random Forest": "drf",
+                "Generalized Linear Modeling": "glm",
+                "Generalized Linear Model": "glm",
+                "K-means": "kmeans",
+                "Deep Learning": "deeplearning",
+                "Isolation Forest": "isolationforest",
+                "Extended Isolation Forest": "extendedisolationforest",
+                "Support Vector Machine (SVM)": "psvm",
+                "SVM": "psvm",
+                "Word2Vec": "word2vec",
+                "Generalized Low Rank Modeling": "glrm",
+                "Generalized Low Rank Model": "glrm",
+                "Stacked Ensemble": "stackedensemble",
+            }.get(info.get("algorithm"))
+            if algo is not None:
+                info["algo"] = algo  # MojoModel.__init__ reads info["algo"]
+        cls = {"gbm": _TreeMojo, "drf": _TreeMojo, "glm": _GlmMojo,
+               "kmeans": _KMeansMojo, "deeplearning": _DeepLearningMojo,
+               "isolationforest": _IsoForMojo,
+               "extendedisolationforest": _IsoForMojo,
+               "pca": _PcaMojo,
+               "coxph": _CoxPHMojo,
+               "isotonic": _IsotonicMojo,
+               "word2vec": _Word2VecMojo,
+               "glrm": _GlrmMojo,
+               "targetencoder": _TargetEncoderMojo,
+               "upliftdrf": _UpliftMojo,
+               "gam": _GamMojo,
+               "rulefit": _RuleFitMojo,
+               "psvm": _PsvmMojo,
+               "svm": _SparkSvmMojo,
+               "stackedensemble": _EnsembleMojo}.get(algo)
+        if cls is None:
+            raise NotImplementedError(f"no MOJO reader for algo '{algo}'")
+        model = cls(info, columns, domains)
+        model._read(zr)
+        return model
 
     def _read(self, zr: MojoZipReader):
         raise NotImplementedError
@@ -115,7 +154,17 @@ class _TreeMojo(MojoModel):
         self.tpc = parse_kv(self.info.get("n_trees_per_class"), 1)
         self.init_f = parse_kv(self.info.get("init_f"), 0.0)
         self.distribution = self.info.get("distribution", "gaussian")
-        self.link = self.info.get("link_function", "identity")
+        # absent link_function falls back to the family default, exactly as
+        # ModelMojoReader.readLinkFunction/defaultLinkFunction do (pre-1.2
+        # GBM zips carry only `distribution`)
+        default_link = {
+            "bernoulli": "logit", "fractionalbinomial": "logit",
+            "quasibinomial": "logit", "modified_huber": "logit",
+            "ordinal": "logit",
+            "multinomial": "log", "poisson": "log", "gamma": "log",
+            "tweedie": "log", "negativebinomial": "log",
+        }.get(self.info.get("distribution", ""), "identity")
+        self.link = self.info.get("link_function", default_link)
         self.trees = []  # [group][class] -> decoded root
         for j in range(self.n_groups):
             row = []
@@ -294,6 +343,34 @@ class _DeepLearningMojo(MojoModel):
     def _read(self, zr):
         g = lambda k, d=None: parse_kv(self.info.get(k), d)
         self.activation = self.info.get("activation", "Rectifier")
+        # genuine JVM DL MOJOs (`DeeplearningMojoReader.java`) carry
+        # `neural_network_sizes` + per-layer `weight_layer{i}`/`bias_layer{i}`
+        # kv arrays; our writer's layout stores binary weight files instead
+        self.jvm_layout = "neural_network_sizes" in self.info
+        if self.jvm_layout:
+            self.units = np.asarray(g("neural_network_sizes", []), np.int64)
+            self.cats = g("cats", 0)
+            self.nums = g("nums", 0)
+            self.cat_offsets = np.asarray(g("cat_offsets", [0]) or [0],
+                                          np.int64)
+            self.norm_mul = np.asarray(g("norm_mul", []) or [], np.float64)
+            self.norm_sub = np.asarray(g("norm_sub", []) or [], np.float64)
+            self.norm_resp_mul = g("norm_resp_mul")
+            self.norm_resp_sub = g("norm_resp_sub")
+            self.use_all = g("use_all_factor_levels", True)
+            self.dropout = np.asarray(g("hidden_dropout_ratios", []) or [],
+                                      np.float64)
+            self.distribution = self.info.get("distribution", "gaussian")
+            self.default_threshold = g("default_threshold", 0.5)
+            self.jvm_layers = []
+            for i in range(len(self.units) - 1):
+                W = np.asarray(g(f"weight_layer{i}", []), np.float64)
+                b = np.asarray(g(f"bias_layer{i}", []), np.float64)
+                # NeuralNetwork.formNNInputs: w[row*in + col], row = out node;
+                # weights round-trip through float like convertDouble2Float
+                self.jvm_layers.append((W.astype(np.float32)
+                                        .astype(np.float64), b))
+            return
         self._read_datainfo_spec()
         n_layers = g("n_layers")
         self.layers = []
@@ -329,7 +406,82 @@ class _DeepLearningMojo(MojoModel):
             blocks.append(col[:, None])
         return np.concatenate(blocks, axis=1)
 
+    def _score_jvm(self, X):
+        """Score a genuine JVM DL MOJO: `GenModel.setInput` input layout
+        (one-hot cats with the trained NA level, standardized numerics with
+        NaN→0 i.e. mean imputation) + `NeuralNetwork.formNNInputs` fprop."""
+        X = np.asarray(X, dtype=np.float64)
+        R = X.shape[0]
+        total_cat = int(self.cat_offsets[-1])
+        Z = np.zeros((R, total_cat + self.nums))
+        for i in range(self.cats):
+            col = X[:, i]
+            lo, hi = int(self.cat_offsets[i]), int(self.cat_offsets[i + 1])
+            nan = np.isnan(col)
+            c = np.where(nan, 0, col).astype(np.int64)
+            if self.use_all:
+                idx = c + lo
+            else:
+                idx = np.where(c != 0, c - 1 + lo, -1)
+            idx = np.where(nan | (idx >= hi), hi - 1, idx)  # NA/unseen level
+            ok = idx >= 0
+            Z[np.arange(R)[ok], idx[ok]] = 1.0
+        for j in range(self.nums):
+            d = X[:, self.cats + j]
+            if self.norm_mul.size:
+                d = (d - self.norm_sub[j]) * self.norm_mul[j]
+            Z[:, total_cat + j] = np.where(np.isnan(d), 0.0, d)
+
+        act_hidden = self.activation
+        maxout = act_hidden.startswith("Maxout")
+        h = Z
+        nl = len(self.jvm_layers)
+        for li, (W, b) in enumerate(self.jvm_layers):
+            out = int(self.units[li + 1])
+            n_in = h.shape[1]
+            last = li == nl - 1
+            if maxout and not last:
+                k = len(b) // out
+                Wk = W.reshape(out, n_in, k)  # w[k*(row*in+col)+kk]
+                z = np.einsum("ri,oik->rok", h, Wk) + b.reshape(out, k)[None]
+                z = z.max(axis=2)
+            else:
+                z = h @ W.reshape(out, n_in).T + b
+            if last:
+                h = z
+                break
+            name = act_hidden.lower().replace("withdropout", "")
+            if name == "tanh":
+                z = np.tanh(z)
+            elif name == "exprectifier":  # ELU
+                z = np.where(z >= 0, z, np.exp(np.minimum(z, 0)) - 1.0)
+            elif name != "maxout":  # rectifier (default)
+                z = np.maximum(z, 0.0)
+            if "WithDropout" in act_hidden and li < len(self.dropout) \
+                    and self.dropout[li] > 0:
+                z = z * (1.0 - self.dropout[li])
+            h = z
+        if self.n_classes > 1:
+            e = np.exp(h - h.max(axis=1, keepdims=True))
+            p = e / e.sum(axis=1, keepdims=True)
+            if self.n_classes == 2:
+                label = (p[:, 1] >= self.default_threshold).astype(np.float64)
+            else:
+                label = p.argmax(axis=1).astype(np.float64)
+            return np.concatenate([label[:, None], p], axis=1)
+        f = h[:, 0]
+        if self.norm_resp_mul is not None:
+            f = f / self.norm_resp_mul + self.norm_resp_sub
+        dist = self.distribution
+        if dist in ("bernoulli", "quasibinomial", "modified_huber", "ordinal"):
+            f = 1.0 / (1.0 + np.minimum(1e19, np.exp(-f)))
+        elif dist in ("multinomial", "poisson", "gamma", "tweedie"):
+            f = np.minimum(1e19, np.exp(f))
+        return f
+
     def score(self, X):
+        if self.jvm_layout:
+            return self._score_jvm(X)
         h = self._expand(np.asarray(X, dtype=np.float64))
         name = self.activation.lower().replace("withdropout", "")
         L = len(self.layers)
@@ -353,11 +505,32 @@ class _DeepLearningMojo(MojoModel):
 
 # ---------------------------------------------------------------------------
 class _IsoForMojo(MojoModel):
-    """`hex/genmodel/algos/isofor` role: hyperplane-tree traversal to average
-    path length, anomaly score 2^(−E[h]/c(n))."""
+    """`hex/genmodel/algos/isofor` + `algos/isoforextended` role. Three
+    layouts: our writer's hyperplane arrays (isofor/wvec.bin), the JVM
+    IsolationForest's shared compressed trees (`IsolationForestMojoModel`:
+    score = (max_path − Σtree)/(max_path − min_path)), and the JVM Extended
+    IsolationForest's record-stream trees (`ExtendedIsolationForestMojoModel.
+    scoreTree0`: hyperplane (row−p)·n ≤ 0 goes left, score 2^(−E[h]/c(n)))."""
 
     def _read(self, zr):
         g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.mode = ("ours" if zr.exists("isofor/wvec.bin") else
+                     "jvm_eif" if zr.exists("trees/t00.bin") else "jvm_if")
+        if self.mode == "jvm_if":
+            self.n_groups = g("n_trees")
+            self.min_path = g("min_path_length", 0)
+            self.max_path = g("max_path_length", 0)
+            self.anomaly_flag = g("output_anomaly_flag", False)
+            self.threshold = g("default_threshold", 0.5)
+            self.jvm_trees = [decode_tree(zr.blob(f"trees/t00_{j:03d}.bin"))
+                              for j in range(self.n_groups)]
+            return
+        if self.mode == "jvm_eif":
+            self.n_groups = g("ntrees", 0)
+            self.sample_size = g("sample_size", 0)
+            self.eif_trees = [self._parse_eif_tree(zr.blob(f"trees/t{j:02d}.bin"))
+                              for j in range(self.n_groups)]
+            return
         T, N = g("n_trees"), g("n_nodes")
         F = g("n_features")
         self.depth = g("max_depth")
@@ -372,12 +545,97 @@ class _IsoForMojo(MojoModel):
                                     dtype="<f4").reshape(T, N).astype(np.float64)
 
     @staticmethod
+    def _parse_eif_tree(buf: bytes):
+        """Record stream (`ExtendedIsolationForestMojoModel.scoreTree0`):
+        int32 size, then per node [int32 id, u8 type, NODE: n[size] f64 +
+        p[size] f64 | LEAF: int32 num_rows] — little-endian like all MOJO
+        blobs. Returns {id: ('N', n, p) | ('L', num_rows)}."""
+        import struct
+
+        size = struct.unpack_from("<i", buf, 0)[0]
+        pos = 4
+        nodes = {}
+        while pos < len(buf):
+            nid, typ = struct.unpack_from("<iB", buf, pos)
+            pos += 5
+            if typ == ord("N"):
+                n = np.frombuffer(buf, "<f8", size, pos)
+                p = np.frombuffer(buf, "<f8", size, pos + 8 * size)
+                pos += 16 * size
+                nodes[nid] = ("N", n, p)
+            elif typ == ord("L"):
+                num_rows = struct.unpack_from("<i", buf, pos)[0]
+                # precompute the c(num_rows) leaf constant: the traversal
+                # loop is per row per tree, the constant never changes
+                nodes[nid] = ("L", float(
+                    _IsoForMojo._c_unsuccessful(num_rows)))
+                pos += 4
+            elif typ == 0:  # AutoBuffer zero padding after the last record
+                break
+            else:
+                raise ValueError(f"unknown EIF node type {typ}")
+        return nodes
+
+    @staticmethod
     def _avg_path(n):
         n = np.maximum(n, 2.0)
         H = np.log(n - 1.0) + 0.5772156649
         return 2.0 * H - 2.0 * (n - 1.0) / n
 
+    def _score_jvm_if(self, X):
+        """`IsolationForestMojoModel.unifyPreds`: path-length sum over the
+        shared-format trees, normalized by the stored min/max path lengths."""
+        psum = np.zeros(X.shape[0])
+        for root in self.jvm_trees:
+            psum += score_tree(root, X, self.domains)
+        mp = psum / max(self.n_groups, 1)
+        if self.max_path > self.min_path:
+            score = (self.max_path - psum) / (self.max_path - self.min_path)
+        else:
+            score = np.ones(X.shape[0])
+        if self.anomaly_flag:
+            label = (score > self.threshold).astype(np.float64)
+            return np.stack([label, score, mp], axis=1)
+        return np.stack([score, mp], axis=1)
+
+    @staticmethod
+    def _c_unsuccessful(n):
+        """`MathUtils.averagePathLengthOfUnsuccessfulSearch` exactly."""
+        n = np.asarray(n, dtype=np.float64)
+        out = np.zeros_like(n)
+        out = np.where(n == 2, 1.0, out)
+        big = n > 2
+        nb = np.where(big, n, 3.0)
+        out = np.where(big, 2.0 * (np.log(nb - 1.0) + 0.5772156649)
+                       - 2.0 * (nb - 1.0) / nb, out)
+        return out
+
+    def _score_jvm_eif(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        R = X.shape[0]
+        plen = np.zeros(R)
+        for nodes in self.eif_trees:
+            for r in range(R):
+                nid, height = 0, 0
+                while True:
+                    kind = nodes[nid]
+                    if kind[0] == "L":
+                        plen[r] += height + kind[1]
+                        break
+                    _, n, p = kind
+                    mul = float(np.dot(X[r] - p, n))
+                    nid = 2 * nid + 1 if mul <= 0 else 2 * nid + 2
+                    height += 1
+        eh = plen / max(self.n_groups, 1)
+        cn = float(self._c_unsuccessful(self.sample_size))
+        score = np.power(2.0, -eh / max(cn, 1e-12))
+        return np.stack([score, eh], axis=1)
+
     def score(self, X):
+        if self.mode == "jvm_if":
+            return self._score_jvm_if(np.asarray(X, dtype=np.float64))
+        if self.mode == "jvm_eif":
+            return self._score_jvm_eif(X)
         X = np.nan_to_num(np.asarray(X, dtype=np.float64))
         R = X.shape[0]
         T = self.wvec.shape[0]
@@ -465,6 +723,19 @@ class _Word2VecMojo(MojoModel):
     def _read(self, zr):
         g = lambda k, d=None: parse_kv(self.info.get(k), d)
         self.vec_size = g("vec_size")
+        if zr.exists("vocabulary"):
+            # genuine JVM layout (`Word2VecMojoReader.java`): `vocabulary`
+            # text + `vectors` floats written through a plain ByteBuffer
+            # (big-endian, unlike the little-endian tree blobs)
+            words = [unescape_line(w)
+                     for w in zr.text("vocabulary").splitlines()]
+            self.vocab = {w: i for i, w in enumerate(words)}
+            self.vectors = np.frombuffer(
+                zr.blob("vectors"),
+                dtype=">f4").reshape(len(words), self.vec_size).astype(np.float64)
+            self._norm = self.vectors / np.maximum(
+                np.linalg.norm(self.vectors, axis=1, keepdims=True), 1e-12)
+            return
         words = [unescape_line(w)
                  for w in zr.text("word2vec/words.txt").splitlines()]
         self.vocab = {w: i for i, w in enumerate(words)}
@@ -511,6 +782,32 @@ class _GlrmMojo(_DeepLearningMojo):
 
     def _read(self, zr):
         g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.permutation = None
+        if "ncolY" in self.info:
+            # genuine JVM layout (`GlrmMojoReader.java`): kv geometry +
+            # big-endian archetypes blob (plain ByteBuffer putDouble);
+            # cols_permutation reorders raw columns into cats-first order
+            nrowY, ncolY = g("nrowY"), g("ncolY")
+            self.Y = np.frombuffer(zr.blob("archetypes"),
+                                   dtype=">f8").reshape(nrowY, ncolY)
+            self.cats = g("num_categories", 0)
+            self.nums = g("num_numeric", 0)
+            self.cat_offsets = np.asarray(g("catOffsets", [0]) or [0],
+                                          np.int64)
+            self.cat_modes = np.zeros(self.cats, np.int64)
+            self.use_all = True  # GLRM expands all factor levels
+            norm_sub = np.asarray(g("norm_sub", []) or [], np.float64)
+            norm_mul = np.asarray(g("norm_mul", []) or [], np.float64)
+            self.standardize = self.center = norm_mul.size > 0
+            self.num_means = (norm_sub if norm_sub.size
+                              else np.zeros(self.nums))
+            with np.errstate(divide="ignore"):
+                self.num_sigmas = (1.0 / norm_mul if norm_mul.size
+                                   else np.ones(self.nums))
+            perm = g("cols_permutation")
+            if perm is not None:
+                self.permutation = np.asarray(perm, np.int64)
+            return
         self._read_datainfo_spec()
         k = g("k")
         self.Y = np.frombuffer(zr.blob("glrm/archetypes.bin"),
@@ -528,6 +825,8 @@ class _GlrmMojo(_DeepLearningMojo):
 
     def project(self, X):
         X = np.asarray(X, dtype=np.float64)
+        if self.permutation is not None:
+            X = X[:, self.permutation]
         A = self._expand(X)
         M = self._mask(X)
         Y = self.Y
@@ -737,49 +1036,134 @@ class _PsvmMojo(_DeepLearningMojo):
 
 
 # ---------------------------------------------------------------------------
-class _EnsembleMojo(MojoModel):
-    """`hex/genmodel/algos/ensemble/StackedEnsembleMojoModel` role: nested
-    base-model MOJOs feed a level-one row, scored by the metalearner MOJO."""
+class _DirReader:
+    """Reader backend over an exploded MOJO directory — the reference's
+    `FolderMojoReaderBackend` analog (used by its own test fixtures)."""
+
+    def __init__(self, root: str):
+        self._root = root
+
+    def _p(self, name: str) -> str:
+        import os
+
+        return os.path.join(self._root, name)
+
+    def text(self, name: str) -> str:
+        with open(self._p(name), "r", encoding="utf-8") as fh:
+            return fh.read()
+
+    def blob(self, name: str) -> bytes:
+        with open(self._p(name), "rb") as fh:
+            return fh.read()
+
+    def exists(self, name: str) -> bool:
+        import os
+
+        return os.path.exists(self._p(name))
+
+
+class _SparkSvmMojo(MojoModel):
+    """`hex/genmodel/algos/svm/SvmMojoModel` role (the Sparkling-Water linear
+    SVM, distinct from PSVM): dense dot + interceptor, with the reference's
+    exact threshold/label emission."""
 
     def _read(self, zr):
-        import json
-        import os
-        import tempfile
-
-        spec = json.loads(zr.text("ensemble/mapping.json"))
-        self.mapping = spec["bases"]
-        self.meta_features = spec["metalearner_features"]
-        self.base = []
-        tmpdir = tempfile.mkdtemp()
-        try:
-            n = parse_kv(self.info.get("n_base_models"))
-            for i in range(n):
-                pth = os.path.join(tmpdir, f"b{i}.zip")
-                with open(pth, "wb") as fh:
-                    fh.write(zr.blob(f"models/base_{i}.zip"))
-                self.base.append(MojoModel.load(pth))
-            pth = os.path.join(tmpdir, "meta.zip")
-            with open(pth, "wb") as fh:
-                fh.write(zr.blob("models/metalearner.zip"))
-            self.meta = MojoModel.load(pth)
-        finally:
-            import shutil
-            shutil.rmtree(tmpdir, ignore_errors=True)
+        g = lambda k, d=None: parse_kv(self.info.get(k), d)
+        self.mean_imputation = g("meanImputation", False)
+        self.means = np.asarray(g("means", []) or [], np.float64)
+        self.weights = np.asarray(g("weights", []), np.float64)
+        self.interceptor = g("interceptor", 0.0)
+        self.default_threshold = g("defaultThreshold", 0.0)
+        self.threshold = g("threshold", 0.0)
 
     def score(self, X):
         X = np.asarray(X, dtype=np.float64)
-        feats = self.columns[:-1]
-        level_one = {}
-        for bm, mp in zip(self.base, self.mapping):
+        if self.mean_imputation and self.means.size:
+            X = np.where(np.isnan(X), self.means[None, :X.shape[1]], X)
+        f = X @ self.weights[:X.shape[1]] + self.interceptor
+        if self.n_classes == 1:
+            return f
+        hi = f > self.threshold
+        p1 = np.where(hi, np.maximum(f, self.default_threshold),
+                      np.where(f >= self.default_threshold,
+                               self.default_threshold - 1, f))
+        p0 = np.where(hi, p1 - 1, p1 + 1)
+        return np.stack([hi.astype(np.float64), p0, p1], axis=1)
+
+
+class _PrefixReader:
+    """Reader backend view into a sub-directory of the parent zip — the
+    `MultiModelMojoReader.NestedMojoReaderBackend` analog."""
+
+    def __init__(self, parent, prefix: str):
+        self._parent = parent
+        self._prefix = prefix
+
+    def text(self, name: str) -> str:
+        return self._parent.text(self._prefix + name)
+
+    def blob(self, name: str) -> bytes:
+        return self._parent.blob(self._prefix + name)
+
+    def exists(self, name: str) -> bool:
+        return self._parent.exists(self._prefix + name)
+
+
+class _EnsembleMojo(MojoModel):
+    """`hex/genmodel/algos/ensemble/StackedEnsembleMojoModel` +
+    `StackedEnsembleMojoReader` role: sub-model MOJOs live as nested
+    directories inside the same zip (``submodel_key_i``/``submodel_dir_i``
+    in model.ini — the `MultiModelMojoReader` convention), the meta-features
+    are the base predictions in ``base_model{i}`` index order, and the
+    metalearner scores that row (with the optional Logit transform)."""
+
+    def _read(self, zr):
+        if "submodel_count" not in self.info:
+            raise NotImplementedError(
+                "this stacked-ensemble MOJO uses the pre-round-2 legacy "
+                "layout (nested base_{i}.zip blobs); re-export it with the "
+                "current writer, which emits the reference's "
+                "MultiModelMojoReader directory layout")
+        subs = {}
+        for i in range(parse_kv(self.info.get("submodel_count"), 0)):
+            key = self.info[f"submodel_key_{i}"]
+            prefix = self.info[f"submodel_dir_{i}"]
+            subs[key] = MojoModel._from_reader(_PrefixReader(zr, prefix))
+        self.meta = subs[self.info["metalearner"]]
+        transform = self.info.get("metalearner_transform", "NONE") or "NONE"
+        if transform not in ("NONE", "Logit"):
+            raise NotImplementedError(
+                f"metalearner_transform '{transform}' is not supported")
+        self.logit_transform = transform == "Logit"
+        self.base = []
+        for i in range(parse_kv(self.info.get("base_models_num"), 0)):
+            key = self.info.get(f"base_model{i}")
+            # a missing key means the metalearner zero-weighted this slot
+            # (the reference writes no entry and scores it as 0.0)
+            self.base.append(subs.get(key) if key not in (None, "null")
+                             else None)
+
+    def score(self, X):
+        X = np.asarray(X, dtype=np.float64)
+        feats = self.columns[:-1] if self.supervised else self.columns
+        K = self.n_classes
+        R = X.shape[0]
+        cols = []
+        for bm in self.base:
+            if bm is None:  # unused slot: the reference leaves 0.0
+                cols.extend([np.zeros(R)] * (K if K > 2 else 1))
+                continue
             bfeats = bm.columns[:-1] if bm.supervised else bm.columns
             Xb = X[:, [feats.index(f) for f in bfeats]]
             pred = bm.score(Xb)
-            if mp["category"] == "Binomial":
-                level_one[mp["key"]] = pred[:, 2]
-            elif mp["category"] == "Multinomial":
-                for ki, cls in enumerate(mp["response_domain"]):
-                    level_one[f'{mp["key"]}/p{cls}'] = pred[:, 1 + ki]
-            else:
-                level_one[mp["key"]] = pred if pred.ndim == 1 else pred[:, 0]
-        D = np.stack([level_one[n] for n in self.meta_features], axis=1)
+            if K > 2:       # multinomial: class probabilities per base model
+                cols.extend(pred[:, 1 + j] for j in range(K))
+            elif K == 2:    # binomial: p1
+                cols.append(pred[:, 2])
+            else:           # regression: the prediction
+                cols.append(pred if pred.ndim == 1 else pred[:, 0])
+        D = np.stack(cols, axis=1)
+        if self.logit_transform and K >= 2:
+            p = np.clip(D, 1e-9, 1 - 1e-9)
+            D = np.maximum(-19.0, np.log(p / (1 - p)))
         return self.meta.score(D)

@@ -659,13 +659,17 @@ def _write_psvm_mojo(model, path: str):
 
 # ---------------------------------------------------------------------------
 def _write_ensemble_mojo(model, path: str):
-    """Stacked Ensemble MOJO — `hex/genmodel/algos/ensemble/
-    StackedEnsembleMojoWriter` role: the base models and the metalearner as
-    nested MOJO zips, plus the level-one column mapping."""
-    import json
+    """Stacked Ensemble MOJO in the reference's `MultiModelMojoReader`
+    layout (`hex/genmodel/algos/ensemble/StackedEnsembleMojoReader.java`):
+    every sub-model MOJO is a nested DIRECTORY inside the same zip
+    (``models/<ALGO>/<key>/...``), declared by ``submodel_count`` /
+    ``submodel_key_i`` / ``submodel_dir_i``, with ``base_models_num``,
+    ``base_model{i}`` and ``metalearner`` naming the roles. Genuine JVM
+    ensemble MOJOs load through the matching reader; ours load in the JVM."""
     import os
     import shutil
     import tempfile
+    import zipfile
 
     out = model.output
     category = out.model_category
@@ -682,28 +686,38 @@ def _write_ensemble_mojo(model, path: str):
     n_classes = {"Regression": 1, "Binomial": 2}.get(
         category, len(out.response_domain or []))
     info = _common_info(model, "stackedensemble", "Stacked Ensemble", category,
-                        n_classes, columns, domains, mojo_version=1.00)
-    info["n_base_models"] = len(model.base_models)
-    mapping = []
+                        n_classes, columns, domains, mojo_version=1.01)
+
+    meta = model.metalearner
+    submodels = [(str(meta.key), meta)] + [(str(bm.key), bm)
+                                           for bm in model.base_models]
+    seen = set()
+    for key, _ in submodels:
+        if key in seen:
+            raise ValueError(f"duplicate sub-model key '{key}' in ensemble")
+        seen.add(key)
+    dirs = {key: f"models/{type(m).algo_name.upper()}/{key}/"
+            for key, m in submodels}
+    info["submodel_count"] = len(submodels)
+    for i, (key, _) in enumerate(submodels):
+        info[f"submodel_key_{i}"] = key
+        info[f"submodel_dir_{i}"] = dirs[key]
+    info["base_models_num"] = len(model.base_models)
+    info["metalearner"] = str(meta.key)
+    info["metalearner_transform"] = "NONE"
+    for i, bm in enumerate(model.base_models):
+        info[f"base_model{i}"] = str(bm.key)
+
     zw = MojoZipWriter()
     tmpdir = tempfile.mkdtemp()
     try:
-        for i, bm in enumerate(model.base_models):
-            sub = os.path.join(tmpdir, f"base_{i}.zip")
-            export_mojo(bm, sub)
-            with open(sub, "rb") as fh:
-                zw.write_blob(f"models/base_{i}.zip", fh.read())
-            mapping.append({"key": str(bm.key),
-                            "category": bm.output.model_category,
-                            "response_domain": bm.output.response_domain})
-        sub = os.path.join(tmpdir, "meta.zip")
-        export_mojo(model.metalearner, sub)
-        with open(sub, "rb") as fh:
-            zw.write_blob("models/metalearner.zip", fh.read())
+        for key, m in submodels:
+            sub = os.path.join(tmpdir, "sub.zip")
+            export_mojo(m, sub)
+            with zipfile.ZipFile(sub) as sz:
+                for entry in sz.namelist():
+                    zw.write_blob(dirs[key] + entry, sz.read(entry))
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
-    zw.write_text("ensemble/mapping.json", json.dumps(
-        {"bases": mapping,
-         "metalearner_features": list(model.metalearner.output.names)}))
     _write_common(zw, info, columns, domains)
     zw.finish(path)
