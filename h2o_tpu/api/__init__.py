@@ -31,6 +31,7 @@ from .client import (H2OAdaBoostEstimator, H2OANOVAGLMEstimator,
                      H2OTargetEncoderEstimator,
                      H2OUpliftRandomForestEstimator, H2OWord2vecEstimator,
                      H2OXGBoostEstimator)
+from .client import H2OAutoML, H2OGridSearch, load_grid, save_grid
 from .server import H2OServer
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1017,6 +1017,30 @@ class H2OModelClient:
         return f"H2OModelClient({self.model_id})"
 
 
+def _train_body(params: dict, x, y, training_frame, validation_frame,
+                kw: dict) -> dict:
+    """Assemble the training POST body shared by estimators and grid search:
+    frame-valued params ride the wire as their keys (the server resolves them
+    back to Frames), and ``x`` maps to ignored_columns h2o-py-style (names or
+    integer indices)."""
+    body = dict(params)
+    body.update(kw)
+    body = {k: (v.frame_id if isinstance(v, H2OFrame) else v)
+            for k, v in body.items()}
+    if training_frame is not None:
+        body["training_frame"] = training_frame.frame_id
+    if validation_frame is not None:
+        body["validation_frame"] = validation_frame.frame_id
+    if y is not None:
+        body["response_column"] = y
+    if x is not None:
+        all_cols = training_frame.columns
+        keep = {all_cols[c] if isinstance(c, int) else c for c in x}
+        body["ignored_columns"] = [c for c in all_cols
+                                   if c not in keep and c != y]
+    return body
+
+
 class H2OEstimator:
     """Base estimator: collects kwargs, posts to /3/ModelBuilders/{algo},
     polls the job, exposes the trained model."""
@@ -1029,24 +1053,8 @@ class H2OEstimator:
 
     def train(self, x=None, y=None, training_frame: H2OFrame | None = None,
               validation_frame: H2OFrame | None = None, **kw):
-        body = dict(self._params)
-        body.update(kw)
-        # frame-valued params (pre_trained, calibration_frame, …) ride the
-        # wire as their keys; the server resolves them back to Frames
-        body = {k: (v.frame_id if isinstance(v, H2OFrame) else v)
-                for k, v in body.items()}
-        if training_frame is not None:
-            body["training_frame"] = training_frame.frame_id
-        if validation_frame is not None:
-            body["validation_frame"] = validation_frame.frame_id
-        if y is not None:
-            body["response_column"] = y
-        if x is not None:
-            all_cols = training_frame.columns
-            # h2o-py accepts names or integer indices in x
-            keep = {all_cols[c] if isinstance(c, int) else c for c in x}
-            body["ignored_columns"] = [c for c in all_cols
-                                       if c not in keep and c != y]
+        body = _train_body(dict(self._params), x, y, training_frame,
+                           validation_frame, kw)
         job = connection().request("POST", f"/3/ModelBuilders/{self.algo}",
                                    data=body)
         done = _poll_job(job)
@@ -1096,3 +1104,173 @@ H2OModelSelectionEstimator = _estimator("modelselection", "H2OModelSelectionEsti
 H2OTargetEncoderEstimator = _estimator("targetencoder", "H2OTargetEncoderEstimator")
 H2OAggregatorEstimator = _estimator("aggregator", "H2OAggregatorEstimator")
 H2OInfogram = _estimator("infogram", "H2OInfogram")
+
+
+# ---------------------------------------------------------------------------
+# Grid search over REST (`h2o-py/h2o/grid/grid_search.py` surface over
+# `POST /99/Grid/{algo}` + `GET /99/Grids/{id}`)
+# ---------------------------------------------------------------------------
+class H2OGridSearch:
+    """h2o-py-compatible grid search: wraps an estimator (instance or class),
+    posts the hyper space, polls the job, exposes ranked models."""
+
+    def __init__(self, model, hyper_params: dict, grid_id: str | None = None,
+                 search_criteria: dict | None = None, parallelism: int = 1):
+        self.model = model() if isinstance(model, type) else model
+        self.hyper_params = hyper_params
+        self.grid_id = grid_id
+        self.search_criteria = search_criteria or {}
+        self.parallelism = parallelism
+        self._grid_json: dict | None = None
+
+    def train(self, x=None, y=None, training_frame: "H2OFrame | None" = None,
+              validation_frame: "H2OFrame | None" = None, **kw):
+        import json as _json
+
+        body = _train_body(dict(getattr(self.model, "_params", {})),
+                           x, y, training_frame, validation_frame, kw)
+        body["hyper_parameters"] = _json.dumps(self.hyper_params)
+        if self.search_criteria:
+            body["search_criteria"] = _json.dumps(self.search_criteria)
+        if self.grid_id:
+            body["grid_id"] = self.grid_id
+        if self.parallelism != 1:
+            body["parallelism"] = self.parallelism
+        job = connection().request("POST", f"/99/Grid/{self.model.algo}",
+                                   data=body)
+        done = _poll_job(job)
+        self.grid_id = done["dest"]["name"]
+        self._fetch()
+        return self
+
+    def _fetch(self, sort_by: str | None = None, decreasing: bool | None = None):
+        params = {}
+        if sort_by:
+            params["sort_by"] = sort_by
+        if decreasing is not None:
+            params["decreasing"] = str(bool(decreasing)).lower()
+        self._grid_json = connection().request(
+            "GET", f"/99/Grids/{urllib.parse.quote(self.grid_id)}",
+            params=params)
+        return self._grid_json
+
+    @property
+    def model_ids(self) -> list:
+        return [k["name"] for k in self._grid_json["model_ids"]]
+
+    @property
+    def models(self) -> list:
+        return [get_model(mid) for mid in self.model_ids]
+
+    def get_grid(self, sort_by: str | None = None, decreasing: bool = False):
+        self._fetch(sort_by, decreasing)
+        return self
+
+    def summary_table(self):
+        return self._grid_json.get("summary_table")
+
+    @property
+    def failure_details(self) -> list:
+        return self._grid_json.get("failure_details", [])
+
+
+def save_grid(grid: "H2OGridSearch", grid_directory: str) -> str:
+    """`h2o.save_grid` — `POST /3/Grid.bin/{grid_id}/export`."""
+    connection().request(
+        "POST", f"/3/Grid.bin/{urllib.parse.quote(grid.grid_id)}/export",
+        data={"grid_directory": grid_directory})
+    return grid_directory
+
+
+def load_grid(grid_directory: str) -> "H2OGridSearch":
+    """`h2o.load_grid` — `POST /3/Grid.bin/import`. The rebuilt handle keeps
+    the original algo (from the server-side manifest), so it can continue
+    training with a fresh hyper space."""
+    j = connection().request("POST", "/3/Grid.bin/import",
+                             data={"grid_path": grid_directory})
+    gid = j["name"]
+    detail = connection().request(
+        "GET", f"/99/Grids/{urllib.parse.quote(gid)}")
+    est = H2OEstimator()
+    est.algo = detail.get("algo")
+    gs = H2OGridSearch(model=est, hyper_params={}, grid_id=gid)
+    gs._grid_json = detail
+    return gs
+
+
+# ---------------------------------------------------------------------------
+# AutoML over REST (`h2o-py/h2o/automl/_estimator.py` surface over
+# `POST /99/AutoMLBuilder` + `GET /99/Leaderboards/{project}`)
+# ---------------------------------------------------------------------------
+class H2OAutoML:
+    def __init__(self, max_models: int = 0, max_runtime_secs: float = 0.0,
+                 max_runtime_secs_per_model: float = 0.0, nfolds: int = 5,
+                 seed: int | None = None, project_name: str | None = None,
+                 include_algos: list | None = None,
+                 exclude_algos: list | None = None,
+                 sort_metric: str | None = None,
+                 stopping_rounds: int = 3, stopping_tolerance: float = 1e-3,
+                 stopping_metric: str = "AUTO"):
+        self.build_control = {
+            "project_name": project_name,
+            "nfolds": nfolds,
+            "stopping_criteria": {
+                "max_models": max_models,
+                "max_runtime_secs": max_runtime_secs,
+                "max_runtime_secs_per_model": max_runtime_secs_per_model,
+                "seed": -1 if seed is None else seed,
+                "stopping_rounds": stopping_rounds,
+                "stopping_tolerance": stopping_tolerance,
+                "stopping_metric": stopping_metric,
+            },
+        }
+        self.build_models = {"include_algos": include_algos,
+                             "exclude_algos": exclude_algos}
+        self.sort_metric = sort_metric
+        self.project_name = project_name
+        self._leaderboard_json: dict | None = None
+
+    def train(self, x=None, y=None,
+              training_frame: "H2OFrame | None" = None, **kw):
+        spec = {"training_frame": training_frame.frame_id,
+                "response_column": y}
+        if self.sort_metric:
+            spec["sort_metric"] = self.sort_metric
+        if x is not None:
+            all_cols = training_frame.columns
+            keep = {all_cols[c] if isinstance(c, int) else c for c in x}
+            spec["ignored_columns"] = [c for c in all_cols
+                                       if c not in keep and c != y]
+        resp = connection().request("POST", "/99/AutoMLBuilder", data={
+            "input_spec": spec,
+            "build_control": self.build_control,
+            "build_models": self.build_models,
+        })
+        self.project_name = resp["build_control"]["project_name"]
+        _poll_job(resp)
+        self._fetch()
+        return self
+
+    def _fetch(self):
+        self._leaderboard_json = connection().request(
+            "GET", f"/99/Leaderboards/{urllib.parse.quote(self.project_name)}")
+        return self._leaderboard_json
+
+    @property
+    def leaderboard(self):
+        return self._leaderboard_json["table"]
+
+    @property
+    def leader(self) -> "H2OModelClient":
+        models = self._leaderboard_json["models"]
+        if not models:
+            raise ValueError("no models trained")
+        return get_model(models[0]["name"])
+
+    def predict(self, test_data: "H2OFrame"):
+        return self.leader.predict(test_data)
+
+    def event_log(self) -> dict:
+        j = connection().request(
+            "GET", f"/99/AutoML/{urllib.parse.quote(self.project_name)}")
+        return j["event_log_table"]

@@ -137,19 +137,27 @@ def _err(status: int, msg: str, **extra) -> tuple[int, dict]:
                     "http_status": status, "exception_msg": msg, **extra}
 
 
-def _jobs_of(algo_cls, params_cls, body: dict) -> tuple[int, dict]:
+_FRAME_PARAMS = ("training_frame", "validation_frame", "blending_frame",
+                 "calibration_frame", "pre_trained")
+
+
+def _resolve_params(params_cls, body: dict, extra_names=()) -> dict:
+    """Validate body keys against the Parameters dataclass (the reference's
+    412 on unknown params) and resolve frame keys to Frames. Shared by the
+    ModelBuilders and Grid routes so frame-param handling can't drift."""
     import dataclasses
 
     valid = {f.name for f in dataclasses.fields(params_cls)}
-    unknown = [k for k in body if k not in valid]
-    if unknown:  # reject typos like the reference's 412 on unknown params
+    unknown = [k for k in body if k not in valid] + \
+              [k for k in extra_names if k not in valid]
+    if unknown:
         raise ValueError(f"unknown parameter(s) {unknown} for this algorithm")
-    kwargs = {}
-    for k, v in body.items():
-        if k in ("training_frame", "validation_frame", "blending_frame",
-                 "calibration_frame", "pre_trained"):
-            v = STORE.get(v)
-        kwargs[k] = v
+    return {k: (STORE.get(v) if k in _FRAME_PARAMS else v)
+            for k, v in body.items()}
+
+
+def _jobs_of(algo_cls, params_cls, body: dict) -> tuple[int, dict]:
+    kwargs = _resolve_params(params_cls, body)
     builder = algo_cls(params_cls(**kwargs))
     job = builder.train(background=True)
     return 200, {"job": schemas.job_schema(job),
@@ -491,6 +499,161 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             seed=int(p.get("seed", -1) or -1))
         return 200, {"permutation_varimp": schemas.table_schema(t)}
 
+    # -- grid search (`POST /99/Grid/{algo}`, `GET /99/Grids[/{id}]`,
+    #    `POST /3/Grid.bin/import`, `POST /3/Grid.bin/{id}/export` —
+    #    `water/api/GridSearchHandler`/`GridsHandler`/`GridImportExportHandler`)
+    if head == "Grid" and method == "POST" and rest[1:]:
+        import json as _json
+
+        from ..models.grid import GridSearch, SearchCriteria
+
+        algo = rest[1]
+        entry = registry.lookup(algo)
+        if entry is None:
+            return _err(404, f"unknown algorithm {algo}")
+        algo_cls, params_cls = entry
+        body2 = dict(p)
+        hp = body2.pop("hyper_parameters", None) or {}
+        if isinstance(hp, str):
+            hp = _json.loads(hp)
+        sc = body2.pop("search_criteria", None) or {}
+        if isinstance(sc, str):
+            sc = _json.loads(sc)
+        grid_id = body2.pop("grid_id", None)
+        parallelism = int(body2.pop("parallelism", 1) or 1)
+        recovery_dir = body2.pop("recovery_dir", None)
+        try:
+            kwargs = _resolve_params(params_cls, body2, extra_names=list(hp))
+        except ValueError as e:
+            return _err(412, str(e))
+        gs = GridSearch(algo_cls, params_cls(**kwargs), hp,
+                        SearchCriteria(**sc), recovery_dir=recovery_dir,
+                        parallelism=parallelism, grid_id=grid_id)
+        job = gs.train(background=True)
+        return 200, {"job": schemas.job_schema(job),
+                     "key": schemas.key_schema(job.dest_key)}
+    if head == "Grids":
+        from ..models.grid import Grid
+
+        if not rest[1:]:
+            return 200, {"grids": [{"grid_id": schemas.key_schema(g.key)}
+                                   for g in STORE.values(Grid)]}
+        gid = urllib.parse.unquote(rest[1])
+        g = STORE.get(gid)
+        if not isinstance(g, Grid):
+            return _err(404, f"grid {gid} not found")
+        if method == "DELETE":
+            # cascade like the reference's grid remove: the contained models
+            # die with the grid (h2o.remove(grid) contract)
+            for m in list(g.models):
+                STORE.remove(m.key)
+            STORE.remove(gid)
+            return 200, {}
+        by = p.get("sort_by") or None
+        decr = _truthy(p["decreasing"]) if "decreasing" in p else None
+        ms = g.sorted_models(by, decr)
+        return 200, {
+            "grid_id": schemas.key_schema(g.key),
+            "algo": g.builder_cls.algo_name,
+            "model_ids": [schemas.key_schema(m.key) for m in ms],
+            "hyper_names": list(g.hyper_params),
+            "failure_details": [f["error"] for f in g.failures],
+            "failed_raw_params": [f["params"] for f in g.failures],
+            "summary_table": schemas.table_schema(g.summary_table(by)),
+        }
+    if head == "Grid.bin" and method == "POST":
+        from ..models.grid import Grid, export_grid, import_grid
+
+        if rest[1:] and rest[1] == "import":
+            d = p.get("grid_path") or p.get("grid_directory") or ""
+            if not os.path.isdir(d):
+                return _err(404, f"no grid export at {d}")
+            g = import_grid(d)
+            return 200, {"name": g.key, "grid_id": schemas.key_schema(g.key)}
+        if rest[2:] and rest[2] == "export":
+            gid = urllib.parse.unquote(rest[1])
+            g = STORE.get(gid)
+            if not isinstance(g, Grid):
+                return _err(404, f"grid {gid} not found")
+            d = p.get("grid_directory") or p.get("grid_path") or ""
+            if not d:
+                return _err(400, "grid_directory is required")
+            export_grid(g, d)
+            return 200, {"grid_directory": d}
+        return _err(404, "Grid.bin: use /import or /{grid_id}/export")
+
+    # -- AutoML (`POST /99/AutoMLBuilder`, `GET /99/AutoML/{id}`,
+    #    `GET /99/Leaderboards[/{project}]` — h2o-automl REST surface)
+    if head == "AutoMLBuilder" and method == "POST":
+        from ..models.automl import H2OAutoML as _AutoML
+
+        spec = p.get("input_spec") or {}
+        ctrl = p.get("build_control") or {}
+        bm = p.get("build_models") or {}
+        fr = STORE.get(spec.get("training_frame", ""))
+        y = spec.get("response_column")
+        if isinstance(y, dict):  # h2o-py sends {column_name: y}
+            y = y.get("column_name")
+        if fr is None or not y:
+            return _err(404, "input_spec.training_frame and "
+                             "input_spec.response_column are required")
+        crit = ctrl.get("stopping_criteria") or {}
+        aml = _AutoML(
+            max_models=int(crit.get("max_models", 0) or 0),
+            max_runtime_secs=float(crit.get("max_runtime_secs", 0) or 0),
+            max_runtime_secs_per_model=float(
+                crit.get("max_runtime_secs_per_model", 0) or 0),
+            nfolds=int(ctrl.get("nfolds", 5) or 5),
+            seed=(None if crit.get("seed") in (None, -1) else int(crit["seed"])),
+            project_name=ctrl.get("project_name") or None,
+            include_algos=bm.get("include_algos") or None,
+            exclude_algos=bm.get("exclude_algos") or None,
+            sort_metric=(spec.get("sort_metric") or None),
+            stopping_rounds=int(crit.get("stopping_rounds", 3) or 3),
+            stopping_tolerance=float(crit.get("stopping_tolerance", 1e-3)
+                                     or 1e-3),
+            stopping_metric=crit.get("stopping_metric", "AUTO") or "AUTO")
+        job = Job("AutoML", work=1.0)
+        job.dest_key = aml.key
+
+        def run_automl():
+            aml.train(y=y, training_frame=fr, job=job)
+            return aml
+
+        job.start(run_automl, background=True)
+        return 200, {"job": schemas.job_schema(job),
+                     "build_control": {"project_name": aml.key}}
+    if head == "AutoML" and rest[1:]:
+        from ..models.automl import H2OAutoML as _AutoML
+
+        aml = STORE.get(urllib.parse.unquote(rest[1]))
+        if not isinstance(aml, _AutoML):
+            return _err(404, f"automl {rest[1]} not found")
+        lb = aml.leaderboard
+        return 200, {
+            "automl_id": {"name": aml.key},
+            "project_name": aml.key,
+            "leader": schemas.key_schema(aml.leader.key) if aml.leader else None,
+            "leaderboard_table": schemas.table_schema(
+                lb.as_table()) if lb else None,
+            "event_log_table": schemas.table_schema(aml.event_log.as_table()),
+        }
+    if head == "Leaderboards":
+        from ..models.automl import H2OAutoML as _AutoML
+
+        if not rest[1:]:
+            return 200, {"projects": [a.key for a in STORE.values(_AutoML)]}
+        aml = STORE.get(urllib.parse.unquote(rest[1]))
+        if not isinstance(aml, _AutoML) or aml.leaderboard is None:
+            return _err(404, f"no leaderboard for {rest[1]}")
+        lb = aml.leaderboard
+        return 200, {
+            "project_name": aml.key,
+            "table": schemas.table_schema(lb.as_table()),
+            "models": [schemas.key_schema(m.key) for m in lb.sorted()],
+            "sort_metric": lb.sort_metric,
+        }
+
     # -- jobs ----------------------------------------------------------------
     if head == "Jobs":
         if rest[1:]:
@@ -695,6 +858,16 @@ _ROUTES_DOC = [
         ("GET", "/3/Typeahead/files", "path completion for import"),
         ("GET", "/3/Metadata/endpoints", "this listing"),
         ("GET", "/3/Metadata/schemas", "schema catalog"),
+        ("POST", "/99/Grid/{algo}", "launch a grid search"),
+        ("GET", "/99/Grids", "list grids"),
+        ("GET", "/99/Grids/{id}", "grid detail with ranked models"),
+        ("DELETE", "/99/Grids/{id}", "remove a grid"),
+        ("POST", "/3/Grid.bin/import", "import an exported grid"),
+        ("POST", "/3/Grid.bin/{id}/export", "export a grid and its models"),
+        ("POST", "/99/AutoMLBuilder", "launch an AutoML run"),
+        ("GET", "/99/AutoML/{id}", "AutoML run detail + event log"),
+        ("GET", "/99/Leaderboards", "list AutoML projects"),
+        ("GET", "/99/Leaderboards/{project}", "project leaderboard"),
     ]
 ]
 

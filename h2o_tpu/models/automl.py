@@ -55,6 +55,16 @@ class EventLog:
         return Frame(names, [Vec(None, len(self.events), type="string",
                                  host_data=cols[n]) for n in names])
 
+    def as_table(self):
+        """`water/api/schemas3/TwoDimTableV3` shape for `/99/AutoML/{id}`."""
+        from ..utils.twodimtable import TwoDimTable
+
+        if not self.events:
+            return TwoDimTable(table_header="Event Log")
+        return TwoDimTable.from_dict("Event Log", {
+            k: [e[k] for e in self.events]
+            for k in ("timestamp", "level", "stage", "message")})
+
 
 # ---------------------------------------------------------------------------
 # Leaderboard (`hex/leaderboard/Leaderboard.java`)
@@ -124,6 +134,20 @@ class Leaderboard:
         for n in metric_names:
             vecs.append(Vec.from_numpy(np.asarray(cols[n], dtype=np.float32)))
         return Frame(names, vecs)
+
+    def as_table(self):
+        """Leaderboard as a TwoDimTable (`/99/Leaderboards/{project}`)."""
+        from ..utils.twodimtable import TwoDimTable
+
+        metric_names = self.METRIC_COLS.get(self.category,
+                                            self.METRIC_COLS["Regression"])
+        ms = self.sorted()
+        if not ms:
+            return TwoDimTable(table_header="Leaderboard")
+        cols = {"model_id": [m.key for m in ms]}
+        for n in metric_names:
+            cols[n] = [self._metric(m, n) for m in ms]
+        return TwoDimTable.from_dict("Leaderboard", cols)
 
 
 # ---------------------------------------------------------------------------
@@ -344,7 +368,9 @@ class H2OAutoML(Keyed):
 
     # -- train (the h2o-py surface) ------------------------------------------
     def train(self, y: str | None = None, training_frame: Frame | None = None,
-              **kw) -> "H2OAutoML":
+              job: Job | None = None, **kw) -> "H2OAutoML":
+        if kw:
+            raise ValueError(f"unsupported train() arguments: {sorted(kw)}")
         if training_frame is None or y is None:
             raise ValueError("y and training_frame are required")
         self.training_frame = training_frame
@@ -358,7 +384,13 @@ class H2OAutoML(Keyed):
         self._t0 = time.time()
         log = self.event_log
         log.log("Workflow", f"AutoML build started: {self.key}")
-        self.job = Job("AutoML", work=float(len(self.plan)))
+        # an externally supplied Job (the /99/AutoMLBuilder route's) receives
+        # the per-step progress updates instead of an orphaned inner job
+        if job is not None:
+            job.work = float(len(self.plan))
+            self.job = job
+        else:
+            self.job = Job("AutoML", work=float(len(self.plan)))
 
         for step in self.plan:
             if self._budget_exhausted(step):

@@ -106,13 +106,15 @@ class GridSearch:
 
     def __init__(self, builder_cls, params, hyper_params: dict,
                  search_criteria: SearchCriteria | None = None,
-                 recovery_dir: str | None = None, parallelism: int = 1):
+                 recovery_dir: str | None = None, parallelism: int = 1,
+                 grid_id: str | None = None):
         self.builder_cls = builder_cls
         self.base_params = params
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.criteria = search_criteria or SearchCriteria()
         self.recovery_dir = recovery_dir
         self.parallelism = max(1, int(parallelism))  # ParallelModelBuilder
+        self.grid_id = grid_id
         self._recovered_models: list = []
         self._recovered_done: list = []
 
@@ -128,9 +130,11 @@ class GridSearch:
             yield dict(zip(names, combo))
 
     def train(self, background: bool = False) -> "Grid | Job":
-        grid = Grid(self.builder_cls, list(self.hyper_params))
+        grid = Grid(self.builder_cls, list(self.hyper_params),
+                    key=self.grid_id)
         grid.models.extend(self._recovered_models)
         job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
+        job.dest_key = grid.key  # the REST job polls to the grid key
         rec = self._init_recovery() if self.recovery_dir else None
         done = list(self._recovered_done)
 
@@ -293,3 +297,48 @@ class GridSearch:
 
 def _combo_key(overrides: dict) -> str:
     return repr(sorted(overrides.items()))
+
+
+# -- grid export/import (`water/api/GridImportExportHandler`) ----------------
+def export_grid(grid: Grid, directory: str) -> str:
+    """Write the grid's manifest + every model binary into ``directory``
+    (the `POST /3/Grid.bin/{grid_id}/export` payload)."""
+    import json
+
+    from ..backend.persist import save_model
+
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    for i, m in enumerate(grid.models):
+        name = f"model_{i}.bin"
+        save_model(m, os.path.join(directory, name))
+        paths.append(name)
+    manifest = {"grid_id": grid.key,
+                "builder_module": grid.builder_cls.__module__,
+                "builder_name": grid.builder_cls.__name__,
+                "hyper_params": list(grid.hyper_params),
+                "models": paths,
+                "failures": grid.failures}
+    with open(os.path.join(directory, "grid_manifest.json"), "w") as fh:
+        json.dump(manifest, fh)
+    return directory
+
+
+def import_grid(directory: str) -> Grid:
+    """Rebuild a Grid (and re-register its models) from an export directory
+    (the `POST /3/Grid.bin/import` role)."""
+    import importlib
+    import json
+
+    from ..backend.persist import load_model
+
+    with open(os.path.join(directory, "grid_manifest.json")) as fh:
+        manifest = json.load(fh)
+    builder_cls = getattr(importlib.import_module(manifest["builder_module"]),
+                          manifest["builder_name"])
+    grid = Grid(builder_cls, manifest["hyper_params"],
+                key=manifest["grid_id"])
+    grid.models = [load_model(os.path.join(directory, p))
+                   for p in manifest["models"]]
+    grid.failures = list(manifest.get("failures", []))
+    return grid
