@@ -1232,6 +1232,10 @@ class H2OAutoML:
 
     def train(self, x=None, y=None,
               training_frame: "H2OFrame | None" = None, **kw):
+        if kw:
+            raise ValueError(
+                f"unsupported H2OAutoML.train() arguments: {sorted(kw)} — "
+                "supported: x, y, training_frame")
         spec = {"training_frame": training_frame.frame_id,
                 "response_column": y}
         if self.sort_metric:

@@ -612,7 +612,8 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             stopping_rounds=int(crit.get("stopping_rounds", 3) or 3),
             stopping_tolerance=float(crit.get("stopping_tolerance", 1e-3)
                                      or 1e-3),
-            stopping_metric=crit.get("stopping_metric", "AUTO") or "AUTO")
+            stopping_metric=crit.get("stopping_metric", "AUTO") or "AUTO",
+            ignored_columns=spec.get("ignored_columns") or None)
         job = Job("AutoML", work=1.0)
         job.dest_key = aml.key
 

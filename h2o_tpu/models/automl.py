@@ -303,8 +303,10 @@ class H2OAutoML(Keyed):
                  stopping_rounds: int = 3, stopping_tolerance: float = 1e-3,
                  stopping_metric: str = "AUTO",
                  keep_cross_validation_predictions: bool = True,
-                 modeling_plan: list | None = None):
+                 modeling_plan: list | None = None,
+                 ignored_columns: list | None = None):
         super().__init__(key=project_name, prefix="automl")
+        self.ignored_columns = list(ignored_columns or [])
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0  # the reference's default total budget
         self.max_models = max_models
@@ -351,6 +353,7 @@ class H2OAutoML(Keyed):
 
     def _common_params(self) -> dict:
         return dict(training_frame=self.training_frame, response_column=self.y,
+                    ignored_columns=list(self.ignored_columns),
                     nfolds=self.nfolds,
                     keep_cross_validation_predictions=self.keep_cv_preds,
                     fold_assignment="Modulo",  # shared folds → stackable

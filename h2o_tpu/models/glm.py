@@ -22,6 +22,7 @@ via device one-hot cross-products + host Henderson/EM solve).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 
 import jax
@@ -244,6 +245,74 @@ def _admm_solve(G, b, l1, l2, free: np.ndarray, rho=None, iters=500, tol=1e-6):
     return z
 
 
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _cod_kernel(G, xy, beta0, diag_inv, thr, lo, hi, eps2, max_iter: int):
+    """One compiled COD program: Gauss-Seidel sweeps (lax.scan over
+    coordinates) inside a convergence while_loop. Carries (beta, grads) with
+    grads[j] = xy[j] − Σ_k G[j,k]β_k + G[j,j]β_j — exactly the reference's
+    CODGradients invariant (`hex/glm/ComputationState.java:1356`), updated
+    per accepted coordinate like `GLM.doUpdateCD` (grads[j] itself stays
+    put: its own-diagonal term is excluded by construction)."""
+    P = G.shape[0]
+    eye = jnp.eye(P, dtype=G.dtype)
+    grads0 = xy - G @ beta0 + jnp.diag(G) * beta0
+
+    def coord(carry, xs):
+        beta, grads = carry
+        grow, e, dinv, t, l, h = xs
+        gj = jnp.sum(grads * e)
+        bnew = jnp.clip(jnp.sign(gj) * jnp.maximum(jnp.abs(gj) - t, 0.0)
+                        * dinv, l, h)
+        bd = jnp.sum(beta * e) - bnew
+        grads = grads + bd * grow * (1.0 - e)
+        beta = beta - bd * e
+        return (beta, grads), bd * bd * jnp.sum(grow * e)
+
+    def sweep(state):
+        beta, grads, it, _ = state
+        (beta, grads), diffs = jax.lax.scan(
+            coord, (beta, grads), (G, eye, diag_inv, thr, lo, hi))
+        return beta, grads, it + 1, jnp.max(diffs)
+
+    def keep_going(state):
+        _, _, it, maxdiff = state
+        return (it < max_iter) & (maxdiff >= eps2)
+
+    state = (beta0, grads0, jnp.array(0, jnp.int32),
+             jnp.array(jnp.inf, G.dtype))
+    beta, _, it, _ = jax.lax.while_loop(keep_going, sweep, state)
+    return beta, it
+
+
+def _cod_solve(G, b, l1, l2, free: np.ndarray, beta0, beta_epsilon=1e-5,
+               lo=None, hi=None):
+    """Cyclic coordinate descent on the Gram — the reference's distinct
+    COORDINATE_DESCENT solver (`hex/glm/GLM.java:4373` COD_solve), not an
+    IRLSM alias: per coordinate, a soft-threshold step on the residual
+    gradient b = S(grads_j, λα)/(G_jj + λ(1−α)), unpenalized coordinates
+    (the intercept) step by grads_j/G_jj, convergence when
+    max_j Δβ_j²·G_jj < beta_epsilon², max(P, 500) sweeps. The whole solve
+    is ONE jitted XLA loop over the tiny Gram (no P host round trips)."""
+    P = G.shape[0]
+    diag = np.diag(G).copy()
+    diag_inv = 1.0 / np.where(free, np.maximum(diag, 1e-12),
+                              np.maximum(diag + l2, 1e-12))
+    thr = np.where(free, 0.0, l1)
+    lo = np.full(P, -np.inf) if lo is None else np.asarray(lo, np.float64)
+    hi = np.full(P, np.inf) if hi is None else np.asarray(hi, np.float64)
+    # device f32 (x64 is off in this runtime): the Gauss-Seidel sweeps are
+    # self-correcting — each step re-reads the residual gradient — so f32
+    # carries converge to the same coefficients as the f64 ADMM path (match
+    # verified at 1e-4 on elastic-net problems)
+    f32 = jnp.float32
+    beta, _ = _cod_kernel(
+        jnp.asarray(G, f32), jnp.asarray(b, f32),
+        jnp.asarray(beta0, f32), jnp.asarray(diag_inv, f32),
+        jnp.asarray(thr, f32), jnp.asarray(lo, f32), jnp.asarray(hi, f32),
+        jnp.asarray(max(beta_epsilon ** 2, 1e-10), f32), max(P, 500))
+    return np.asarray(beta, np.float64)
+
+
 # ---------------------------------------------------------------------------
 # parameters / model / builder
 # ---------------------------------------------------------------------------
@@ -253,7 +322,10 @@ class GLMParameters(Parameters):
 
     family: str = "AUTO"
     link: str | None = None
-    solver: str = "IRLSM"          # IRLSM | COORDINATE_DESCENT (maps to same path)
+    solver: str = "IRLSM"          # IRLSM | COORDINATE_DESCENT | L_BFGS —
+                                   # COD is a distinct inner solver (cyclic
+                                   # soft-threshold sweeps on the Gram,
+                                   # GLM.java:4373), not an IRLSM alias
     alpha: float | None = None     # elastic-net mix; default .5 like reference
     lambda_: float | None = None   # penalty strength; None -> 0 or search
     lambda_search: bool = False
@@ -818,6 +890,20 @@ class GLM(ModelBuilder):
                 iters_total += result[5]
             return (*result[:5], iters_total)
 
+        use_cod = bool(p.solver) and p.solver.upper() in (
+            "COORDINATE_DESCENT", "COORDINATE_DESCENT_NAIVE")
+        cod_lo = cod_hi = None
+        if use_cod:
+            # COD applies bounds per coordinate like the reference's
+            # bc.applyBounds inside the sweep
+            P1 = len(beta)
+            cod_lo, cod_hi = np.full(P1, -np.inf), np.full(P1, np.inf)
+            if p.non_negative:
+                cod_lo[:-1] = 0.0
+            if getattr(self, "_bounds", None) is not None:
+                lo_b, hi_b = self._bounds
+                cod_lo, cod_hi = np.maximum(cod_lo, lo_b), np.minimum(cod_hi, hi_b)
+
         best = None
         iters_total = 0
         for lam in lambdas:
@@ -833,7 +919,11 @@ class GLM(ModelBuilder):
                 G, b, dev, _ = step(Xi, y, w, jnp.asarray(beta, jnp.float32), offset)
                 iters_total += 1
                 Gn, bn = np.asarray(G, np.float64), np.asarray(b, np.float64)
-                beta_new = _admm_solve(Gn, bn, l1, l2, free)
+                if use_cod:
+                    beta_new = _cod_solve(Gn, bn, l1, l2, free, beta,
+                                          p.beta_epsilon, cod_lo, cod_hi)
+                else:
+                    beta_new = _admm_solve(Gn, bn, l1, l2, free)
                 if p.non_negative:
                     nb = beta_new[:-1]
                     beta_new[:-1] = np.clip(nb, 0, None)

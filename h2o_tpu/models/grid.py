@@ -130,8 +130,16 @@ class GridSearch:
             yield dict(zip(names, combo))
 
     def train(self, background: bool = False) -> "Grid | Job":
-        grid = Grid(self.builder_cls, list(self.hyper_params),
-                    key=self.grid_id)
+        # re-training an existing grid_id APPENDS to it (the h2o contract:
+        # a grid accumulates models across train calls / after load_grid)
+        existing = STORE.get(self.grid_id) if self.grid_id else None
+        if isinstance(existing, Grid):
+            grid = existing
+            grid.hyper_params = sorted(set(grid.hyper_params)
+                                       | set(self.hyper_params))
+        else:
+            grid = Grid(self.builder_cls, list(self.hyper_params),
+                        key=self.grid_id)
         grid.models.extend(self._recovered_models)
         job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
         job.dest_key = grid.key  # the REST job polls to the grid key
