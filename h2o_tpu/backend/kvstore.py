@@ -99,6 +99,38 @@ class KVStore:
         with self._lock:
             self._store.clear()
 
+    def snapshot(self) -> frozenset:
+        """Current key set — the `CheckKeysTask` view the leak rule diffs."""
+        with self._lock:
+            return frozenset(self._store)
+
+
+class leak_check:
+    """Context manager asserting an operation leaves no new keys behind —
+    the `water/junit/rules/CheckLeakedKeysRule.java:20-35` analog for the
+    single-controller store. Keys the caller DID mean to create are passed
+    out by returning them from the block and listing them in ``expect``
+    (a callable evaluated at exit, or an iterable of keys)."""
+
+    def __init__(self, store: KVStore | None = None, expect=()):
+        self.store = store or STORE
+        self.expect = expect
+
+    def __enter__(self):
+        self._before = self.store.snapshot()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        expected = self.expect() if callable(self.expect) else self.expect
+        leaked = self.store.snapshot() - self._before - frozenset(expected)
+        if leaked:
+            raise AssertionError(
+                f"leaked keys: {sorted(leaked)} — operations must remove "
+                f"their temporaries (CheckLeakedKeysRule)")
+        return False
+
 
 #: Process-global store (the analog of `H2O.STORE`).
 STORE = KVStore()

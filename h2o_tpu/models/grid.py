@@ -140,11 +140,20 @@ class GridSearch:
         else:
             grid = Grid(self.builder_cls, list(self.hyper_params),
                         key=self.grid_id)
+        # combos already materialized in the grid (a prior train on this
+        # grid_id, or crash-recovered models) are skipped, and the budget
+        # counts only THIS search's models — recovered ones were part of this
+        # search's combo space, pre-existing appended ones were not
+        prior_combos = {
+            _combo_key({k: getattr(m.params, k) for k in self.hyper_params
+                        if hasattr(m.params, k)})
+            for m in grid.models}
         grid.models.extend(self._recovered_models)
         job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
         job.dest_key = grid.key  # the REST job polls to the grid key
         rec = self._init_recovery() if self.recovery_dir else None
         done = list(self._recovered_done)
+        built = {"n": len(self._recovered_models)}
 
         def run():
             t0 = time.time()
@@ -163,6 +172,7 @@ class GridSearch:
             def accept(m, overrides, err):
                 if m is not None:
                     grid.models.append(m)
+                    built["n"] += 1
                     if rec is not None:
                         self._record(rec, done, _combo_key(overrides), m,
                                      len(grid.models) - 1)
@@ -170,21 +180,24 @@ class GridSearch:
                     grid.failures.append({"params": overrides, "error": err})
                 job.update(0.0)
 
+            def skip(overrides) -> bool:
+                key = _combo_key(overrides)
+                return key in self._recovered_done or key in prior_combos
+
             if self.parallelism > 1 and c.stopping_rounds <= 0:
                 # concurrent builds (`hex/ParallelModelBuilder` role): device
                 # work interleaves while host orchestration overlaps. Early
                 # stopping needs sequential scores, so it forces 1-at-a-time.
                 import concurrent.futures as cf
 
-                combos = [o for o in self._walk()
-                          if _combo_key(o) not in self._recovered_done]
+                combos = [o for o in self._walk() if not skip(o)]
                 with cf.ThreadPoolExecutor(max_workers=self.parallelism) as ex:
                     futs = {ex.submit(build_one, o): o for o in combos}
                     try:
                         for fut in cf.as_completed(futs):
                             if (job.stop_requested
                                     or (c.max_models
-                                        and grid.model_count >= c.max_models)
+                                        and built["n"] >= c.max_models)
                                     or (c.max_runtime_secs
                                         and time.time() - t0 > c.max_runtime_secs)):
                                 for f2 in futs:
@@ -198,12 +211,12 @@ class GridSearch:
                 return grid
             for i, overrides in enumerate(self._walk()):
                 job.check_cancelled()
-                if c.max_models and grid.model_count >= c.max_models:
+                if c.max_models and built["n"] >= c.max_models:
                     break
                 if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
                     break
-                if _combo_key(overrides) in self._recovered_done:
-                    continue  # already trained before the crash
+                if skip(overrides):
+                    continue  # trained before the crash / already in the grid
                 m, overrides, err = build_one(overrides)
                 accept(m, overrides, err)
                 if (m is not None and c.stopping_rounds > 0

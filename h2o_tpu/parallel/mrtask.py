@@ -24,7 +24,6 @@ monoid identity; ``rows.mask`` makes that a one-liner.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
@@ -60,6 +59,62 @@ def _row_info(shard_rows: int, nrow: int) -> RowInfo:
     return RowInfo(ids=ids, mask=ids < nrow, nrow=nrow)
 
 
+def _driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
+                    out_rows: bool):
+    """Build (and cache) the jitted shard_map for one (map_fn, mesh, shapes,
+    nrow, reduction) signature. Without this every generic driver call paid a
+    fresh trace + compile-cache lookup — the tree engine caches its train fn
+    for exactly this reason (`engine.py` _TRAIN_FN_CACHE). Programs cache ON
+    the map function object (the compiled program necessarily closes over
+    map_fn, so any global cache would pin the closure — and every frame or
+    array it captured — forever; as a function attribute the whole thing is
+    one self-cycle the gc reclaims the moment the caller drops map_fn)."""
+    per_fn = getattr(map_fn, "__h2o_mr_programs__", None)
+    if per_fn is None:
+        per_fn = {}
+        try:
+            map_fn.__h2o_mr_programs__ = per_fn
+        except AttributeError:  # bound methods / partials: no caching
+            per_fn = None
+    sig = (mesh, nrow, reduce_key, avt, out_rows)
+    if per_fn is not None:
+        hit = per_fn.get(sig)
+        if hit is not None:
+            return hit
+    prog = _build_driver_program(map_fn, mesh, nrow, reduce_key, avt,
+                                 out_rows)
+    if per_fn is not None:
+        per_fn[sig] = prog
+    return prog
+
+
+def _build_driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
+                          out_rows: bool):
+    reduce = reduce_key if isinstance(reduce_key, (str, type(None))) \
+        else dict(reduce_key)
+    shard_rows = avt[0][0][0] // mesh.shape[ROWS]
+
+    def spmd(*cols):
+        rows = _row_info(shard_rows, nrow)
+        out = map_fn(cols, rows)
+        if out_rows:
+            return out
+        if isinstance(reduce, str):
+            return jax.tree.map(lambda x: _REDUCERS[reduce](x, ROWS), out)
+        return {k: jax.tree.map(lambda x: _REDUCERS[reduce[k]](x, ROWS), v)
+                for k, v in out.items()}
+
+    in_specs = tuple(P(ROWS) + P(*([None] * (len(shape) - 1)))
+                     for shape, _ in avt)
+    out_specs = P(ROWS) if out_rows else P()
+    return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs))
+
+
+def _avt(arrays) -> tuple:
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
 def mr_reduce(
     map_fn: Callable[[Sequence[jax.Array], RowInfo], Any],
     arrays: Sequence[jax.Array],
@@ -73,22 +128,15 @@ def mr_reduce(
     across the ``rows`` mesh axis with the given monoid ("sum"|"min"|"max", or a
     dict keyed by top-level output name for mixed reductions). The result is
     replicated (every shard returns the full reduction) and returned to host.
+    The compiled program is cached per (map_fn, mesh, shapes, nrow, reduction)
+    — a second invocation with the same signature traces nothing.
     """
     mesh = mesh or default_mesh()
     arrays = tuple(arrays)
-    shard_rows = arrays[0].shape[0] // mesh.shape[ROWS]
-
-    def spmd(*cols):
-        rows = _row_info(shard_rows, nrow)
-        out = map_fn(cols, rows)
-        if isinstance(reduce, str):
-            return jax.tree.map(lambda x: _REDUCERS[reduce](x, ROWS), out)
-        return {k: jax.tree.map(lambda x: _REDUCERS[reduce[k]](x, ROWS), v)
-                for k, v in out.items()}
-
-    in_specs = tuple(P(ROWS) + P(*([None] * (a.ndim - 1))) for a in arrays)
-    fn = shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=P())
-    return jax.jit(fn)(*arrays)
+    reduce_key = reduce if isinstance(reduce, str) \
+        else tuple(sorted(reduce.items()))
+    fn = _driver_program(map_fn, mesh, nrow, reduce_key, _avt(arrays), False)
+    return fn(*arrays)
 
 
 def mr_map(
@@ -101,15 +149,9 @@ def mr_map(
 
     This is the `outputFrame` path: map returns one or more per-row arrays
     (same leading dim as the shard); outputs stay sharded on the rows axis.
+    Programs are cached like ``mr_reduce``'s.
     """
     mesh = mesh or default_mesh()
     arrays = tuple(arrays)
-    shard_rows = arrays[0].shape[0] // mesh.shape[ROWS]
-
-    def spmd(*cols):
-        rows = _row_info(shard_rows, nrow)
-        return map_fn(cols, rows)
-
-    in_specs = tuple(P(ROWS) + P(*([None] * (a.ndim - 1))) for a in arrays)
-    fn = shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=P(ROWS))
-    return jax.jit(fn)(*arrays)
+    fn = _driver_program(map_fn, mesh, nrow, None, _avt(arrays), True)
+    return fn(*arrays)

@@ -28,3 +28,29 @@ def cloud():
     """stall_till_cloudsize analog: assert the virtual mesh came up with 8 devices."""
     assert len(jax.devices()) == 8, f"expected 8 virtual devices, got {len(jax.devices())}"
     yield
+
+
+@pytest.fixture(autouse=True)
+def key_leak_rule(request):
+    """`water/junit/rules/CheckLeakedKeysRule.java:20-35` analog: snapshot the
+    KVStore before each test, and afterwards remove every key the test left
+    behind — tests are isolated and the store stays bounded across the suite
+    (the reference's Scope auto-tracking role). Keys created by outer-scoped
+    fixtures predate the snapshot, so shared fixtures survive. Set
+    H2O_TPU_KEY_STRICT=1 to FAIL on leaks instead of reaping them (the
+    reference rule's strict mode, for hunting untracked temporaries).
+    """
+    import os
+
+    from h2o_tpu.backend.kvstore import STORE
+
+    before = STORE.snapshot()
+    yield
+    leaked = STORE.snapshot() - before
+    if leaked and os.environ.get("H2O_TPU_KEY_STRICT", "0") not in ("", "0"):
+        for k in leaked:
+            STORE.remove(k, cascade=False)
+        pytest.fail(f"leaked keys: {sorted(leaked)} "
+                    f"(CheckLeakedKeysRule strict mode)")
+    for k in leaked:
+        STORE.remove(k, cascade=False)

@@ -43,6 +43,30 @@ def test_grid_cartesian(binom_frame):
     assert len(summ) == 4 and "max_depth" in summ[0]
 
 
+def test_grid_retrain_appends_without_duplicates(binom_frame):
+    """Re-training an existing grid_id accumulates NEW combos only (the h2o
+    contract): already-trained combos are skipped, and max_models budgets the
+    new request, not the grid total."""
+    params = GBMParameters(training_frame=binom_frame, response_column="y",
+                           ntrees=3, seed=1)
+    g1 = GridSearch(GBM, params, {"max_depth": [2, 3]},
+                    grid_id="append_grid").train()
+    assert g1.model_count == 2
+    # same combos again: nothing new trains
+    g2 = GridSearch(GBM, params, {"max_depth": [2, 3]},
+                    grid_id="append_grid").train()
+    assert g2 is g1 or g2.key == g1.key
+    assert g2.model_count == 2
+    # a widened space trains only the new value, even with max_models == the
+    # count already in the grid
+    g3 = GridSearch(GBM, params, {"max_depth": [2, 3, 5]},
+                    SearchCriteria(max_models=2),
+                    grid_id="append_grid").train()
+    assert g3.model_count == 3
+    depths = sorted(m.params.max_depth for m in g3.models)
+    assert depths == [2, 3, 5]
+
+
 def test_grid_random_discrete_max_models(binom_frame):
     gs = GridSearch(
         GBM,
