@@ -386,13 +386,32 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             path = p.get("path", "")
             if not path:
                 return _err(400, "export: path is required")
-            if not _truthy(p.get("force")) and os.path.exists(path):
+            if path.startswith("file://"):
+                path = path[len("file://"):]
+            remote = "://" in path
+            if not remote and not _truthy(p.get("force")) \
+                    and os.path.exists(path):
                 return _err(400, f"export: {path} exists (use force)")
             df = fr.to_pandas()
+            local = path
+            if remote:  # s3://... / gs://... ride the Persist store SPI
+                import tempfile as _tf
+
+                suffix = os.path.splitext(path)[1] or ".csv"
+                tf = _tf.NamedTemporaryFile(suffix=suffix, delete=False)
+                tf.close()
+                local = tf.name
             if path.endswith((".parquet", ".pq")):
-                df.to_parquet(path)
+                df.to_parquet(local)
             else:
-                df.to_csv(path, index=False)
+                df.to_csv(local, index=False)
+            if remote:
+                from ..io.persist import store as _store
+
+                try:
+                    _store(path, local)
+                finally:
+                    os.unlink(local)
             return 200, {"job": {"status": "DONE", "dest": path}}
         if rest[2:] and rest[2] == "summary":
             return 200, {"frames": [schemas.frame_schema(fr, npreview=0)]}

@@ -121,15 +121,37 @@ def save_model(model, path: str) -> str:
     payload = {"class_module": type(model).__module__,
                "class_name": type(model).__name__,
                "state": state}
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    if "://" in path:
+        # cloud destinations ride the Persist store SPI (s3://, gs://)
+        import tempfile
+
+        from ..io.persist import store as _persist_store
+
+        tf = tempfile.NamedTemporaryFile(suffix=".bin", delete=False)
+        try:
+            pickle.dump(payload, tf)
+            tf.close()
+            _persist_store(path, tf.name)
+        finally:
+            tf.close()
+            os.unlink(tf.name)
+        return path
     with open(path, "wb") as f:
         pickle.dump(payload, f)
     return path
 
 
 def load_model(path: str):
-    """Binary model import — registers the model back into the store."""
+    """Binary model import — registers the model back into the store.
+    Cloud URIs (s3://, gs://) localize through the Persist SPI first."""
     import importlib
 
+    if "://" in path:
+        from ..io.persist import localize
+
+        path = localize(path)
     with open(path, "rb") as f:
         payload = pickle.load(f)
     cls = getattr(importlib.import_module(payload["class_module"]),

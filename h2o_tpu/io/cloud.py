@@ -1,0 +1,280 @@
+"""S3 and GCS persist backends over raw HTTP — no cloud SDKs.
+
+Analog of `h2o-persist-s3/src/main/java/water/persist/PersistS3.java` and
+`h2o-persist-gcs` (each a full SDK-backed gradle module in the reference).
+Here the wire protocols are implemented directly:
+
+- **S3**: AWS Signature V4 request signing in stdlib ``hmac``/``hashlib``
+  (GET/PUT object + ListObjectsV2), credentials from the standard env vars or
+  ``~/.aws/credentials``; anonymous requests when no credentials exist
+  (public buckets). ``AWS_ENDPOINT_URL``/``AWS_ENDPOINT_URL_S3`` switch to a
+  path-style custom endpoint — which is also how tests point the backend at
+  a local mock server.
+- **GCS**: the JSON/XML storage API with a bearer token from
+  ``GOOGLE_OAUTH_ACCESS_TOKEN`` (or anonymous for public objects);
+  ``STORAGE_EMULATOR_HOST`` — the standard GCS emulator variable — reroutes
+  to a local endpoint.
+
+Both register into the Persist SPI (`io/persist.py`), replacing the round-1
+gate with working fetch/store.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import tempfile
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# AWS Signature Version 4 (stdlib)
+# ---------------------------------------------------------------------------
+def _aws_credentials():
+    """Standard resolution order: env vars, then ~/.aws/credentials."""
+    key = os.environ.get("AWS_ACCESS_KEY_ID")
+    secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    token = os.environ.get("AWS_SESSION_TOKEN")
+    if key and secret:
+        return key, secret, token
+    path = os.path.expanduser(
+        os.environ.get("AWS_SHARED_CREDENTIALS_FILE", "~/.aws/credentials"))
+    if os.path.exists(path):
+        import configparser
+
+        cp = configparser.ConfigParser()
+        cp.read(path)
+        profile = os.environ.get("AWS_PROFILE", "default")
+        if cp.has_section(profile):
+            sec = cp[profile]
+            if sec.get("aws_access_key_id") and sec.get("aws_secret_access_key"):
+                return (sec["aws_access_key_id"],
+                        sec["aws_secret_access_key"],
+                        sec.get("aws_session_token"))
+    return None, None, None
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(method: str, url: str, region: str, headers: dict,
+                  payload_sha256: str, access_key: str, secret_key: str,
+                  session_token: str | None = None, service: str = "s3",
+                  now: datetime.datetime | None = None) -> dict:
+    """Compute the SigV4 ``Authorization`` (+ x-amz-*) headers for a request.
+
+    Pure function of its inputs (``now`` injectable) so it can be pinned
+    against the AWS documentation's published signature vectors.
+    """
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+
+    hdrs = {k.lower(): " ".join(str(v).split()) for k, v in headers.items()}
+    hdrs["host"] = host
+    hdrs["x-amz-date"] = amz_date
+    hdrs["x-amz-content-sha256"] = payload_sha256
+    if session_token:
+        hdrs["x-amz-security-token"] = session_token
+
+    signed = ";".join(sorted(hdrs))
+    canonical_headers = "".join(f"{k}:{hdrs[k]}\n" for k in sorted(hdrs))
+    # canonical query: sorted, each key/value URI-encoded
+    q = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q))
+    # S3 canonical URI is the path AS SENT (already percent-encoded once) —
+    # the S3 service explicitly does NOT double-encode, unlike other AWS
+    # services, so re-quoting here would 403 any key with encodable chars
+    canonical_uri = parsed.path or "/"
+    canonical = "\n".join([method, canonical_uri, canonical_query,
+                           canonical_headers, signed, payload_sha256])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+
+    out = {k2: v for k2, v in hdrs.items() if k2 != "host"}
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed}, Signature={signature}")
+    return out
+
+
+def _s3_endpoint(bucket: str, region: str) -> tuple[str, bool]:
+    """(base_url, path_style). Custom endpoints use path-style addressing."""
+    ep = (os.environ.get("AWS_ENDPOINT_URL_S3")
+          or os.environ.get("AWS_ENDPOINT_URL"))
+    if ep:
+        return ep.rstrip("/"), True
+    return f"https://{bucket}.s3.{region}.amazonaws.com", False
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _s3_request(method: str, bucket: str, key: str, query: str = "",
+                body_path: str | None = None, timeout: float = 600.0):
+    """Signed S3 request. Uploads stream from ``body_path`` (http.client
+    sends file-like bodies in blocks when Content-Length is known — no
+    whole-file bytes object in memory)."""
+    region = (os.environ.get("AWS_REGION")
+              or os.environ.get("AWS_DEFAULT_REGION") or "us-east-1")
+    base, path_style = _s3_endpoint(bucket, region)
+    path = (f"/{bucket}/{urllib.parse.quote(key)}" if path_style
+            else f"/{urllib.parse.quote(key)}")
+    url = base + path + (f"?{query}" if query else "")
+    extra = {}
+    if body_path is not None:
+        payload_sha = _file_sha256(body_path)
+        extra["Content-Length"] = str(os.path.getsize(body_path))
+    else:
+        payload_sha = _EMPTY_SHA256
+    headers = {}
+    access, secret, token = _aws_credentials()
+    if access:
+        headers = sigv4_headers(method, url, region, dict(extra), payload_sha,
+                                access, secret, token)
+    headers.update(extra)
+    data = open(body_path, "rb") if body_path is not None else None
+    try:
+        req = urllib.request.Request(url, data=data, headers=headers,
+                                     method=method)
+        return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
+    finally:
+        if data is not None:
+            data.close()
+
+
+def s3_get(uri: str) -> str:
+    """Download ``s3://bucket/key`` to a temp file, return the local path."""
+    bucket, key = _split_uri(uri)
+    with _s3_request("GET", bucket, key) as resp:
+        # temp file only after the request succeeds: a 403/404 must not
+        # leak an fd per retry attempt
+        return _stream_to_tmp(resp, key, "h2o_tpu_s3_")
+
+
+def s3_put(uri: str, local_path: str) -> None:
+    """Upload a local file to ``s3://bucket/key`` (PersistS3.store role),
+    streamed — no whole-file bytes object in host memory."""
+    bucket, key = _split_uri(uri)
+    _s3_request("PUT", bucket, key, body_path=local_path).read()
+
+
+def s3_list(uri: str) -> list[str]:
+    """List keys under an ``s3://bucket/prefix`` (ListObjectsV2) —
+    the PersistS3 importFiles/calcTypeaheadMatches role."""
+    bucket, prefix = _split_uri(uri)
+    q = "list-type=2&prefix=" + urllib.parse.quote(prefix, safe="")
+    with _s3_request("GET", bucket, "", query=q) as resp:
+        tree = ET.fromstring(resp.read())
+    ns = ""
+    if tree.tag.startswith("{"):
+        ns = tree.tag.split("}")[0] + "}"
+    return [c.findtext(f"{ns}Key")
+            for c in tree.iter(f"{ns}Contents")]
+
+
+# ---------------------------------------------------------------------------
+# GCS (JSON storage API)
+# ---------------------------------------------------------------------------
+def _gcs_base() -> str:
+    ep = os.environ.get("STORAGE_EMULATOR_HOST")
+    if ep:
+        if "://" not in ep:
+            ep = "http://" + ep
+        return ep.rstrip("/")
+    return "https://storage.googleapis.com"
+
+
+def _gcs_headers() -> dict:
+    token = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+    return {"Authorization": f"Bearer {token}"} if token else {}
+
+
+def gcs_get(uri: str) -> str:
+    """Download ``gs://bucket/object`` to a temp file (PersistGcs role)."""
+    bucket, obj = _split_uri(uri)
+    url = (f"{_gcs_base()}/storage/v1/b/{bucket}/o/"
+           f"{urllib.parse.quote(obj, safe='')}?alt=media")
+    req = urllib.request.Request(url, headers=_gcs_headers())
+    with urllib.request.urlopen(req, timeout=600) as resp:  # noqa: S310
+        return _stream_to_tmp(resp, obj, "h2o_tpu_gs_")
+
+
+def gcs_put(uri: str, local_path: str) -> None:
+    bucket, obj = _split_uri(uri)
+    url = (f"{_gcs_base()}/upload/storage/v1/b/{bucket}/o"
+           f"?uploadType=media&name={urllib.parse.quote(obj, safe='')}")
+    headers = dict(_gcs_headers())
+    headers["Content-Type"] = "application/octet-stream"
+    headers["Content-Length"] = str(os.path.getsize(local_path))
+    with open(local_path, "rb") as fh:  # streamed by http.client
+        req = urllib.request.Request(url, data=fh, headers=headers,
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=600).read()  # noqa: S310
+
+
+def gcs_list(uri: str) -> list[str]:
+    import json
+
+    bucket, prefix = _split_uri(uri)
+    url = (f"{_gcs_base()}/storage/v1/b/{bucket}/o"
+           f"?prefix={urllib.parse.quote(prefix, safe='')}")
+    req = urllib.request.Request(url, headers=_gcs_headers())
+    with urllib.request.urlopen(req, timeout=60) as resp:  # noqa: S310
+        payload = json.loads(resp.read())
+    return [item["name"] for item in payload.get("items", [])]
+
+
+# ---------------------------------------------------------------------------
+def _stream_to_tmp(resp, key: str, prefix: str) -> str:
+    """Stream an open HTTP response into a fresh temp file (1 MB chunks).
+    Created only after the request succeeded — failed requests leak no fd."""
+    suffix = os.path.splitext(key)[1] or ".dat"
+    fd, tmp = tempfile.mkstemp(suffix=suffix, prefix=prefix)
+    with os.fdopen(fd, "wb") as out:
+        while True:
+            chunk = resp.read(1 << 20)
+            if not chunk:
+                break
+            out.write(chunk)
+    return tmp
+
+
+def _split_uri(uri: str) -> tuple[str, str]:
+    rest = uri.split("://", 1)[1]
+    bucket, _, key = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"no bucket in {uri!r}")
+    return bucket, key
+
+
+def register_all() -> None:
+    from .persist import register_scheme, register_store
+
+    for scheme in ("s3", "s3a", "s3n"):
+        register_scheme(scheme, s3_get)
+        register_store(scheme, s3_put)
+    register_scheme("gs", gcs_get)
+    register_store("gs", gcs_put)

@@ -103,9 +103,14 @@ def _check_frame_size(n_rows: int, n_cols: int) -> None:
 
 def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
                dest_key: str | None = None) -> Frame:
-    """Parse one file into a sharded Frame (the ParseDataset.parse analog)."""
+    """Parse one file into a sharded Frame (the ParseDataset.parse analog).
+    URI schemes (s3://, gs://, http(s)://) localize through the Persist SPI."""
     import pyarrow as pa
 
+    if "://" in path:
+        from .persist import localize
+
+        path = localize(path)
     ext = os.path.splitext(path)[1].lower()
     if ext in (".parquet", ".pq"):
         import pyarrow.parquet as pq

@@ -2,9 +2,9 @@
 
 Analog of the `water/persist/Persist.java` SPI + `PersistManager` scheme
 routing (local FS, NFS, HDFS, S3, GCS, HTTP in the reference; each backend a
-separate gradle module). Here: local paths and http(s) are built in; cloud
-schemes raise a clear gate (their SDKs aren't in the image — the SPI point to
-extend is `register_scheme`)."""
+separate gradle module). Local paths, http(s), s3:// (stdlib SigV4, see
+io/cloud.py) and gs:// (GCS JSON API) are built in; hdfs raises a clear gate
+(no Hadoop in the image — the SPI point to extend is `register_scheme`)."""
 
 from __future__ import annotations
 
@@ -14,12 +14,19 @@ import urllib.request
 from typing import Callable
 
 _SCHEMES: dict[str, Callable[[str], str]] = {}
+_STORES: dict[str, Callable[[str, str], None]] = {}
 
 
 def register_scheme(scheme: str, fetch: Callable[[str], str]) -> None:
     """Register a handler mapping a URI to a local file path — the Persist
     SPI extension point (`water/persist/PersistManager.java`)."""
     _SCHEMES[scheme] = fetch
+
+
+def register_store(scheme: str, store_fn: Callable[[str, str], None]) -> None:
+    """Register an upload handler store_fn(uri, local_path) for a scheme —
+    the export half of the SPI (`Persist.create`/`open` write path)."""
+    _STORES[scheme] = store_fn
 
 
 def _fetch_http(uri: str) -> str:
@@ -43,9 +50,37 @@ def localize(path: str) -> str:
     scheme = path.split("://", 1)[0].lower()
     if scheme in _SCHEMES:
         return _SCHEMES[scheme](path)
-    if scheme in ("s3", "s3a", "s3n", "gs", "hdfs", "drive"):
+    if scheme in ("hdfs", "drive"):
         raise NotImplementedError(
-            f"persist backend '{scheme}://' needs its cloud SDK (not in this "
+            f"persist backend '{scheme}://' needs its runtime (not in this "
             f"image); register one with h2o_tpu.io.persist.register_scheme("
             f"'{scheme}', fetch_fn) — the Persist SPI hook")
     raise ValueError(f"unknown URI scheme in {path!r}")
+
+
+def store(uri: str, local_path: str) -> str:
+    """Write a local file out to a URI destination. Local paths copy in
+    place; registered schemes (s3/gs) upload. Returns the destination."""
+    if "://" not in uri:
+        if os.path.abspath(uri) != os.path.abspath(local_path):
+            import shutil
+
+            shutil.copyfile(local_path, uri)
+        return uri
+    scheme = uri.split("://", 1)[0].lower()
+    if scheme == "file":
+        import shutil
+
+        shutil.copyfile(local_path, uri[len("file://"):])
+        return uri
+    if scheme in _STORES:
+        _STORES[scheme](uri, local_path)
+        return uri
+    raise NotImplementedError(
+        f"no store backend for '{scheme}://'; register one with "
+        f"h2o_tpu.io.persist.register_store('{scheme}', store_fn)")
+
+
+from . import cloud as _cloud  # noqa: E402  (registers s3/gs handlers)
+
+_cloud.register_all()

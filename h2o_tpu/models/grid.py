@@ -143,11 +143,11 @@ class GridSearch:
         # combos already materialized in the grid (a prior train on this
         # grid_id, or crash-recovered models) are skipped, and the budget
         # counts only THIS search's models — recovered ones were part of this
-        # search's combo space, pre-existing appended ones were not
-        prior_combos = {
-            _combo_key({k: getattr(m.params, k) for k in self.hyper_params
-                        if hasattr(m.params, k)})
-            for m in grid.models}
+        # search's combo space, pre-existing appended ones were not.
+        # Dedup keys cover the FULL effective params (the reference's
+        # checksum), not just this search's hyper names — a retrain with
+        # different base params or hyper dimensions is a new model.
+        prior_combos = {_full_params_key(m.params) for m in grid.models}
         grid.models.extend(self._recovered_models)
         job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
         job.dest_key = grid.key  # the REST job polls to the grid key
@@ -181,8 +181,10 @@ class GridSearch:
                 job.update(0.0)
 
             def skip(overrides) -> bool:
-                key = _combo_key(overrides)
-                return key in self._recovered_done or key in prior_combos
+                if _combo_key(overrides) in self._recovered_done:
+                    return True
+                full = _full_params_key(self.base_params.clone(**overrides))
+                return full in prior_combos
 
             if self.parallelism > 1 and c.stopping_rounds <= 0:
                 # concurrent builds (`hex/ParallelModelBuilder` role): device
@@ -318,6 +320,18 @@ class GridSearch:
 
 def _combo_key(overrides: dict) -> str:
     return repr(sorted(overrides.items()))
+
+
+def _full_params_key(params) -> str:
+    """Canonical signature over ALL parameter fields (frames by key) — the
+    `Grid` dedup checksum role (`hex/grid/Grid.java` appendModel by params)."""
+    import dataclasses
+
+    items = []
+    for f in dataclasses.fields(params):
+        v = getattr(params, f.name)
+        items.append((f.name, getattr(v, "key", None) or repr(v)))
+    return repr(sorted(items))
 
 
 # -- grid export/import (`water/api/GridImportExportHandler`) ----------------

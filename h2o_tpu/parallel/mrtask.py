@@ -129,7 +129,9 @@ def mr_reduce(
     dict keyed by top-level output name for mixed reductions). The result is
     replicated (every shard returns the full reduction) and returned to host.
     The compiled program is cached per (map_fn, mesh, shapes, nrow, reduction)
-    — a second invocation with the same signature traces nothing.
+    — a second invocation with the same signature traces nothing. Like
+    ``jax.jit``, values ``map_fn`` closes over are baked in at trace time:
+    pass varying data through ``arrays``, not through captured mutable state.
     """
     mesh = mesh or default_mesh()
     arrays = tuple(arrays)
