@@ -32,6 +32,8 @@ from .client import (H2OAdaBoostEstimator, H2OANOVAGLMEstimator,
                      H2OUpliftRandomForestEstimator, H2OWord2vecEstimator,
                      H2OXGBoostEstimator)
 from .client import H2OAutoML, H2OGridSearch, load_grid, save_grid
+from .client import (create_frame, download_csv, insert_missing_values,
+                     log_and_echo, remove_all, split_frame_rest)
 from .server import H2OServer
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -55,7 +55,9 @@ class H2OConnection:
                 f"{username}:{password or ''}".encode()).decode()
 
     def request(self, method: str, path: str, data: dict | None = None,
-                params: dict | None = None) -> dict:
+                params: dict | None = None, raw: bool = False) -> dict | str:
+        """``raw=True`` returns the response body as text (non-JSON
+        endpoints like DownloadDataset) through the same auth/SSL path."""
         url = f"{self.url}{path}"
         if params:
             url += "?" + urllib.parse.urlencode(params)
@@ -71,7 +73,8 @@ class H2OConnection:
         try:
             with urllib.request.urlopen(req, timeout=600,
                                         context=self._ssl_ctx) as resp:
-                return json.loads(resp.read().decode())
+                text = resp.read().decode()
+                return text if raw else json.loads(text)
         except urllib.error.HTTPError as e:
             try:
                 payload = json.loads(e.read().decode())
@@ -221,6 +224,64 @@ def remove(key: str):
         c.request("DELETE", f"/3/Frames/{urllib.parse.quote(key)}")
     except H2OConnectionError:
         c.request("DELETE", f"/3/Models/{urllib.parse.quote(key)}")
+
+
+def remove_all():
+    """`h2o.remove_all` — `DELETE /3/DKV` (RemoveAllHandler)."""
+    connection().request("DELETE", "/3/DKV")
+
+
+def create_frame(rows: int = 10000, cols: int = 10, seed: int = -1,
+                 categorical_fraction: float = 0.2,
+                 integer_fraction: float = 0.2,
+                 binary_fraction: float = 0.1,
+                 missing_fraction: float = 0.0, factors: int = 100,
+                 has_response: bool = False, response_factors: int = 2,
+                 frame_id: str | None = None, **kw) -> "H2OFrame":
+    """`h2o.create_frame` — `POST /3/CreateFrame` (CreateFrameHandler)."""
+    body = dict(rows=rows, cols=cols, seed=seed,
+                categorical_fraction=categorical_fraction,
+                integer_fraction=integer_fraction,
+                binary_fraction=binary_fraction,
+                missing_fraction=missing_fraction, factors=factors,
+                has_response=str(bool(has_response)).lower(),
+                response_factors=response_factors, **kw)
+    if frame_id:
+        body["dest"] = frame_id
+    j = connection().request("POST", "/3/CreateFrame", data=body)
+    return H2OFrame._by_id(j["key"]["name"])
+
+
+def split_frame_rest(frame: "H2OFrame", ratios=(0.75,), seed: int = -1,
+                     destination_frames=None) -> list["H2OFrame"]:
+    """Server-side split — `POST /3/SplitFrame` (SplitFrameHandler); the
+    H2OFrame.split_frame method is the rapids path, this is the REST one."""
+    body = {"dataset": frame.frame_id,
+            "ratios": list(ratios), "seed": seed}
+    if destination_frames:
+        body["destination_frames"] = list(destination_frames)
+    j = connection().request("POST", "/3/SplitFrame", data=body)
+    return [H2OFrame._by_id(d["name"]) for d in j["destination_frames"]]
+
+
+def insert_missing_values(frame: "H2OFrame", fraction: float = 0.1,
+                          seed: int = -1) -> "H2OFrame":
+    """`h2o.insert_missing_values` — `POST /3/MissingInserter`."""
+    connection().request("POST", "/3/MissingInserter",
+                         data={"dataset": frame.frame_id,
+                               "fraction": fraction, "seed": seed})
+    return H2OFrame._by_id(frame.frame_id)
+
+
+def download_csv(frame: "H2OFrame") -> str:
+    """`h2o.download_csv` body — `GET /3/DownloadDataset` (raw CSV)."""
+    return connection().request("GET", "/3/DownloadDataset",
+                                params={"frame_id": frame.frame_id}, raw=True)
+
+
+def log_and_echo(message: str) -> None:
+    """`h2o.log_and_echo` — `POST /3/LogAndEcho`."""
+    connection().request("POST", "/3/LogAndEcho", data={"message": message})
 
 
 def rapids(expr: str) -> dict:
@@ -940,6 +1001,15 @@ class H2OModelClient:
 
     def _metrics(self, kind="training_metrics") -> dict:
         return (self._schema or {}).get("output", {}).get(kind) or {}
+
+    def model_performance(self, test_data: "H2OFrame") -> dict:
+        """Recompute metrics on a frame — `GET /3/ModelMetrics/models/{m}/
+        frames/{f}` (ModelMetricsHandler score-and-fetch)."""
+        j = connection().request(
+            "GET",
+            f"/3/ModelMetrics/models/{urllib.parse.quote(self.model_id)}"
+            f"/frames/{urllib.parse.quote(test_data.frame_id)}")
+        return j["model_metrics"][0]
 
     def auc(self, train=True, valid=False, xval=False):
         kind = ("cross_validation_metrics" if xval else

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import traceback
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -51,6 +52,7 @@ class H2OServer:
         `-jks`/https role of `water/network/SSLSocketChannelFactory`."""
         self.port = port
         self.name = name
+        self.started_at = time.time()
         self.httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self.ssl_certfile = ssl_certfile
@@ -175,14 +177,23 @@ def _make_handler(server: H2OServer):
 
         # -- plumbing --------------------------------------------------------
         def _reply(self, status: int, payload: dict):
+            filename = None
             if "__html__" in payload:
                 data = payload["__html__"].encode()
                 ctype = "text/html; charset=utf-8"
+            elif "__raw__" in payload:
+                # non-JSON bodies (DownloadDataset's CSV)
+                data = payload["__raw__"].encode()
+                ctype = payload.get("__ctype__", "text/plain")
+                filename = payload.get("__filename__")
             else:
                 data = json.dumps(payload).encode()
                 ctype = "application/json"
             self.send_response(status)
             self.send_header("Content-Type", ctype)
+            if filename:
+                self.send_header("Content-Disposition",
+                                 f'attachment; filename="{filename}"')
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -517,6 +528,256 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             n_repeats=int(p.get("n_repeats", 1) or 1),
             seed=int(p.get("seed", -1) or -1))
         return 200, {"permutation_varimp": schemas.table_schema(t)}
+
+    # -- model metrics (`water/api/ModelMetricsHandler`) --------------------
+    if head == "ModelMetrics":
+        from ..models.model_base import Model
+
+        if not rest[1:]:
+            # listing: every model's training metrics
+            return 200, {"model_metrics": [
+                {"model": schemas.key_schema(m.key),
+                 "frame": (schemas.key_schema(m.params.training_frame.key)
+                           if getattr(m.params, "training_frame", None)
+                           is not None else None),
+                 **(schemas.metrics_schema(m.output.training_metrics) or {})}
+                for m in STORE.values(Model)
+                if m.output.training_metrics is not None]}
+        # /3/ModelMetrics/models/{model}/frames/{frame} — recompute on frame
+        mid = urllib.parse.unquote(rest[2])
+        model = STORE.get(mid)
+        if model is None:
+            return _err(404, f"model {mid} not found")
+        if rest[3:] and rest[3] == "frames":
+            fid = urllib.parse.unquote(rest[4])
+            fr2 = STORE.get(fid)
+            if fr2 is None:
+                return _err(404, f"frame {fid} not found")
+            mm = model.model_performance(fr2)
+            return 200, {"model_metrics": [
+                {"model": schemas.key_schema(mid),
+                 "frame": schemas.key_schema(fid),
+                 **(schemas.metrics_schema(mm) or {})}]}
+        mm = model.output.training_metrics
+        return 200, {"model_metrics": [schemas.metrics_schema(mm) or {}]}
+
+    # -- frame factory / munging routes -------------------------------------
+    if head == "CreateFrame" and method == "POST":
+        # `water/api/CreateFrameHandler` — synthetic random frame
+        rows = int(p.get("rows", 10000) or 10000)
+        cols = int(p.get("cols", 10) or 10)
+        seed = int(p.get("seed", -1) or -1)
+        rng = np.random.default_rng(None if seed in (-1, None) else seed)
+        cat_frac = float(p.get("categorical_fraction", 0.2) or 0)
+        int_frac = float(p.get("integer_fraction", 0.2) or 0)
+        bin_frac = float(p.get("binary_fraction", 0.1) or 0)
+        miss_frac = float(p.get("missing_fraction", 0.0) or 0)
+        factors = int(p.get("factors", 100) or 100)
+        real_range = float(p.get("real_range", 100.0) or 100.0)
+        int_range = int(p.get("integer_range", 100) or 100)
+        from ..frame.vec import T_CAT, Vec as _Vec
+
+        n_cat = int(cols * cat_frac)
+        n_int = int(cols * int_frac)
+        n_bin = int(cols * bin_frac)
+        n_real = max(cols - n_cat - n_int - n_bin, 0)
+        fr2 = Frame([], [])
+        ci = 0
+        for _ in range(n_real):
+            x = rng.uniform(-real_range, real_range, rows).astype(np.float32)
+            x[rng.random(rows) < miss_frac] = np.nan
+            fr2.add(f"C{ci + 1}", _Vec.from_numpy(x)); ci += 1
+        for _ in range(n_int):
+            x = rng.integers(-int_range, int_range + 1, rows).astype(np.float32)
+            x[rng.random(rows) < miss_frac] = np.nan
+            fr2.add(f"C{ci + 1}", _Vec.from_numpy(x)); ci += 1
+        for _ in range(n_bin):
+            x = (rng.random(rows) < 0.5).astype(np.float32)
+            x[rng.random(rows) < miss_frac] = np.nan
+            fr2.add(f"C{ci + 1}", _Vec.from_numpy(x)); ci += 1
+        for _ in range(n_cat):
+            codes = rng.integers(0, factors, rows).astype(np.float32)
+            codes[rng.random(rows) < miss_frac] = np.nan
+            fr2.add(f"C{ci + 1}", _Vec.from_numpy(
+                codes, type=T_CAT,
+                domain=[f"c{ci}.l{j}" for j in range(factors)])); ci += 1
+        if _truthy(p.get("has_response")):
+            rf = int(p.get("response_factors", 2) or 2)
+            if rf <= 1:
+                y = rng.normal(size=rows).astype(np.float32)
+                fr2.add("response", _Vec.from_numpy(y))
+            else:
+                y = rng.integers(0, rf, rows).astype(np.float32)
+                fr2.add("response", _Vec.from_numpy(
+                    y, type=T_CAT, domain=[f"r{j}" for j in range(rf)]))
+        dest = p.get("dest") or p.get("destination_frame") or "createdFrame"
+        fr2.key = dest
+        STORE.put(dest, fr2)
+        return 200, {"key": schemas.key_schema(dest),
+                     "job": {"status": "DONE",
+                             "dest": schemas.key_schema(dest)}}
+
+    if head == "SplitFrame" and method == "POST":
+        # `water/api/SplitFrameHandler`
+        from ..frame.split import split_frame
+
+        fid = p.get("dataset", "")
+        fr2 = STORE.get(fid)
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {fid} not found")
+        ratios = p.get("ratios") or [0.75]
+        if isinstance(ratios, str):
+            ratios = [float(r) for r in ratios.strip("[]").split(",") if r]
+        seed = int(p.get("seed", -1) or -1)
+        parts = split_frame(fr2, ratios=tuple(float(r) for r in ratios),
+                            seed=None if seed == -1 else seed)
+        dests = p.get("destination_frames") or [
+            f"{fid}_part{i}" for i in range(len(parts))]
+        if isinstance(dests, str):
+            dests = [d.strip(" '\"") for d in dests.strip("[]").split(",")]
+        if len(dests) < len(parts):
+            return _err(400, f"destination_frames has {len(dests)} names "
+                             f"but the split produces {len(parts)} parts")
+        for part, dest in zip(parts, dests):
+            part.key = dest
+            STORE.put(dest, part)
+        return 200, {"destination_frames": [schemas.key_schema(d)
+                                            for d in dests[:len(parts)]],
+                     "job": {"status": "DONE"}}
+
+    if head == "Interaction" and method == "POST":
+        # `water/api/InteractionHandler` — combined categorical columns
+        from ..rapids import advmath
+
+        fid = p.get("source_frame") or p.get("dataset") or ""
+        fr2 = STORE.get(fid)
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {fid} not found")
+        factors = p.get("factor_columns") or p.get("factors") or []
+        if isinstance(factors, str):
+            factors = [f.strip(" '\"") for f in factors.strip("[]").split(",")]
+        out = advmath.interaction(
+            fr2, factors, _truthy(p.get("pairwise")),
+            int(p.get("max_factors", 100) or 100),
+            int(p.get("min_occurrence", 1) or 1))
+        dest = p.get("dest") or f"{fid}_interaction"
+        out.key = dest
+        STORE.put(dest, out)
+        return 200, {"dest": schemas.key_schema(dest),
+                     "job": {"status": "DONE"}}
+
+    if head == "MissingInserter" and method == "POST":
+        # `water/api/MissingInserterHandler` — corrupt a frame with NAs
+        fid = p.get("dataset", "")
+        fr2 = STORE.get(fid)
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {fid} not found")
+        frac = float(p.get("fraction", 0.1) or 0.1)
+        seed = int(p.get("seed", -1) or -1)
+        rng = np.random.default_rng(None if seed == -1 else seed)
+        from ..frame.vec import Vec as _Vec
+
+        for name in fr2.names:
+            v = fr2.vec(name)
+            if v.is_string():
+                continue
+            # keep float64: from_numpy detects f32-lossy values (time/int64
+            # columns) and retains the exact sidecar — an astype(f32) here
+            # would corrupt every row, not just the NA-inserted ones
+            x = v.to_numpy().astype(np.float64)
+            x[rng.random(len(x)) < frac] = np.nan
+            fr2.replace(name, _Vec.from_numpy(x, type=v.type,
+                                              domain=v.domain))
+        return 200, {"job": {"status": "DONE",
+                             "dest": schemas.key_schema(fid)}}
+
+    if head == "DownloadDataset":
+        # `water/api/DownloadDataHandler` — raw CSV body, not JSON
+        fid = p.get("frame_id", "")
+        fr2 = STORE.get(fid)
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {fid} not found")
+        csv = fr2.to_pandas().to_csv(
+            index=False, header=not _truthy(p.get("hex_string")))
+        return 200, {"__raw__": csv, "__ctype__": "text/csv",
+                     "__filename__": f"{fid}.csv"}
+
+    if head == "Tree":
+        # `hex/schemas/TreeV3` + `water/api/TreeHandler` — inspect one tree
+        model = STORE.get(p.get("model", ""))
+        if model is None or not hasattr(model, "forest"):
+            return _err(404, "tree model not found")
+        forest = model.forest
+        if not isinstance(forest, dict) or not all(
+                k in forest for k in ("feat", "thr", "val", "nanL")):
+            return _err(400, f"model {model.key} does not store inspectable "
+                             f"feat/thr/val trees (algo "
+                             f"{getattr(model, 'algo_name', '?')})")
+        t = int(p.get("tree_number", 0) or 0)
+        feat = np.asarray(model.forest["feat"])
+        if not (0 <= t < feat.shape[0]):
+            return _err(400, f"tree_number {t} out of range "
+                             f"[0, {feat.shape[0]})")
+        if feat.ndim == 3:  # multinomial: per-class trees
+            dom = model.output.response_domain or []
+            cls_name = p.get("tree_class") or (dom[0] if dom else "0")
+            k = dom.index(cls_name) if cls_name in dom else int(cls_name)
+            sel = (t, k)
+        else:
+            cls_name = None
+            sel = (t,)
+        ft = feat[sel]
+        thr = np.asarray(model.forest["thr"])[sel]
+        val = np.asarray(model.forest["val"])[sel]
+        nanl = np.asarray(model.forest["nanL"])[sel]
+        N = ft.shape[0]
+        names = model.output.names
+        lefts = np.where(np.arange(N) * 2 + 1 < N,
+                         np.arange(N) * 2 + 1, -1)
+        rights = np.where(np.arange(N) * 2 + 2 < N,
+                          np.arange(N) * 2 + 2, -1)
+        is_leaf = ft < 0
+        lefts[is_leaf] = -1
+        rights[is_leaf] = -1
+        return 200, {
+            "model_id": schemas.key_schema(str(model.key)),
+            "tree_number": t,
+            "tree_class": cls_name,
+            "left_children": lefts.tolist(),
+            "right_children": rights.tolist(),
+            "features": [None if f < 0 else names[int(f)] for f in ft],
+            "thresholds": [None if l else float(x)
+                           for l, x in zip(is_leaf, thr)],
+            "predictions": [float(x) if l else None
+                            for l, x in zip(is_leaf, val)],
+            "nas": ["L" if nl else "R" for nl in nanl],
+            "root_node_id": 0,
+        }
+
+    # -- key management / misc ----------------------------------------------
+    if head == "DKV" and method == "DELETE":
+        if rest[1:]:
+            STORE.remove(urllib.parse.unquote(rest[1]))
+            return 200, {}
+        for k in STORE.keys():  # `removeAll` (`water/api/RemoveAllHandler`)
+            STORE.remove(k, cascade=False)
+        return 200, {}
+    if head == "GarbageCollect" and method == "POST":
+        import gc as _gc
+
+        _gc.collect()
+        return 200, {}
+    if head == "LogAndEcho" and method == "POST":
+        from ..utils.log import info as _log_info
+
+        msg = p.get("message", "") or ""
+        _log_info(f"LogAndEcho: {msg}")
+        return 200, {"message": msg}
+    if head == "Ping":
+        import time as _time
+
+        return 200, {"cloud_uptime_millis": int(
+            (_time.time() - server.started_at) * 1000), "cloud_healthy": True}
 
     # -- grid search (`POST /99/Grid/{algo}`, `GET /99/Grids[/{id}]`,
     #    `POST /3/Grid.bin/import`, `POST /3/Grid.bin/{id}/export` —
@@ -878,6 +1139,20 @@ _ROUTES_DOC = [
         ("GET", "/3/Typeahead/files", "path completion for import"),
         ("GET", "/3/Metadata/endpoints", "this listing"),
         ("GET", "/3/Metadata/schemas", "schema catalog"),
+        ("GET", "/3/ModelMetrics", "list stored model metrics"),
+        ("GET", "/3/ModelMetrics/models/{m}/frames/{f}",
+         "compute metrics of a model on a frame"),
+        ("POST", "/3/CreateFrame", "synthesize a random frame"),
+        ("POST", "/3/SplitFrame", "random-split a frame"),
+        ("POST", "/3/Interaction", "combined categorical interaction columns"),
+        ("POST", "/3/MissingInserter", "inject NAs into a frame"),
+        ("GET", "/3/DownloadDataset", "frame as raw CSV"),
+        ("GET", "/3/Tree", "inspect one tree of a tree model"),
+        ("DELETE", "/3/DKV/{key}", "remove one key"),
+        ("DELETE", "/3/DKV", "remove all keys"),
+        ("POST", "/3/GarbageCollect", "force a gc cycle"),
+        ("POST", "/3/LogAndEcho", "log and echo a message"),
+        ("GET", "/3/Ping", "liveness + uptime"),
         ("POST", "/99/Grid/{algo}", "launch a grid search"),
         ("GET", "/99/Grids", "list grids"),
         ("GET", "/99/Grids/{id}", "grid detail with ranked models"),

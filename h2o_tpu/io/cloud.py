@@ -182,17 +182,28 @@ def s3_put(uri: str, local_path: str) -> None:
 
 
 def s3_list(uri: str) -> list[str]:
-    """List keys under an ``s3://bucket/prefix`` (ListObjectsV2) —
-    the PersistS3 importFiles/calcTypeaheadMatches role."""
+    """List keys under an ``s3://bucket/prefix`` (ListObjectsV2, following
+    continuation tokens past the 1000-key page size) — the PersistS3
+    importFiles/calcTypeaheadMatches role."""
     bucket, prefix = _split_uri(uri)
-    q = "list-type=2&prefix=" + urllib.parse.quote(prefix, safe="")
-    with _s3_request("GET", bucket, "", query=q) as resp:
-        tree = ET.fromstring(resp.read())
-    ns = ""
-    if tree.tag.startswith("{"):
-        ns = tree.tag.split("}")[0] + "}"
-    return [c.findtext(f"{ns}Key")
-            for c in tree.iter(f"{ns}Contents")]
+    keys: list[str] = []
+    token = None
+    while True:
+        q = "list-type=2&prefix=" + urllib.parse.quote(prefix, safe="")
+        if token:
+            q += "&continuation-token=" + urllib.parse.quote(token, safe="")
+        with _s3_request("GET", bucket, "", query=q) as resp:
+            tree = ET.fromstring(resp.read())
+        ns = ""
+        if tree.tag.startswith("{"):
+            ns = tree.tag.split("}")[0] + "}"
+        keys.extend(c.findtext(f"{ns}Key")
+                    for c in tree.iter(f"{ns}Contents"))
+        if tree.findtext(f"{ns}IsTruncated") != "true":
+            return keys
+        token = tree.findtext(f"{ns}NextContinuationToken")
+        if not token:
+            return keys
 
 
 # ---------------------------------------------------------------------------
@@ -239,12 +250,20 @@ def gcs_list(uri: str) -> list[str]:
     import json
 
     bucket, prefix = _split_uri(uri)
-    url = (f"{_gcs_base()}/storage/v1/b/{bucket}/o"
-           f"?prefix={urllib.parse.quote(prefix, safe='')}")
-    req = urllib.request.Request(url, headers=_gcs_headers())
-    with urllib.request.urlopen(req, timeout=60) as resp:  # noqa: S310
-        payload = json.loads(resp.read())
-    return [item["name"] for item in payload.get("items", [])]
+    names: list[str] = []
+    token = None
+    while True:
+        url = (f"{_gcs_base()}/storage/v1/b/{bucket}/o"
+               f"?prefix={urllib.parse.quote(prefix, safe='')}")
+        if token:
+            url += "&pageToken=" + urllib.parse.quote(token, safe="")
+        req = urllib.request.Request(url, headers=_gcs_headers())
+        with urllib.request.urlopen(req, timeout=60) as resp:  # noqa: S310
+            payload = json.loads(resp.read())
+        names.extend(item["name"] for item in payload.get("items", []))
+        token = payload.get("nextPageToken")
+        if not token:
+            return names
 
 
 # ---------------------------------------------------------------------------

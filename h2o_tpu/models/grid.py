@@ -46,6 +46,10 @@ class Grid(Keyed):
         self.hyper_params = hyper_params
         self.models: list = []
         self.failures: list = []
+        # full-params signatures of every trained combo, captured BEFORE
+        # training (builders may swap params in place, e.g. the categorical
+        # encoder re-keys training_frame) — the retrain dedup ledger
+        self.trained_param_keys: set = set()
         STORE.put_keyed(self)
 
     def sorted_models(self, by: str | None = None, decreasing: bool | None = None):
@@ -146,8 +150,11 @@ class GridSearch:
         # search's combo space, pre-existing appended ones were not.
         # Dedup keys cover the FULL effective params (the reference's
         # checksum), not just this search's hyper names — a retrain with
-        # different base params or hyper dimensions is a new model.
-        prior_combos = {_full_params_key(m.params) for m in grid.models}
+        # different base params or hyper dimensions is a new model. The
+        # grid's own ledger (pre-training signatures) is authoritative; the
+        # m.params fallback covers grids built before the ledger existed.
+        prior_combos = set(getattr(grid, "trained_param_keys", ()) or ())
+        prior_combos |= {_full_params_key(m.params) for m in grid.models}
         grid.models.extend(self._recovered_models)
         job = Job(f"grid {self.builder_cls.algo_name}", work=1.0)
         job.dest_key = grid.key  # the REST job polls to the grid key
@@ -161,17 +168,22 @@ class GridSearch:
             scores = []
             def build_one(overrides):
                 """Shared combo build for both execution modes: returns
-                (model|None, overrides, error|None)."""
+                (model|None, overrides, error|None). The full-params
+                signature is captured before training (builders may mutate
+                params in place)."""
+                params = self.base_params.clone(**overrides)
+                sig = _full_params_key(params)
                 try:
-                    params = self.base_params.clone(**overrides)
                     return (self.builder_cls(params).train_model(),
-                            overrides, None)
+                            overrides, None, sig)
                 except Exception as e:  # failed combos are data, not fatal
-                    return None, overrides, repr(e)
+                    return None, overrides, repr(e), sig
 
-            def accept(m, overrides, err):
+            def accept(m, overrides, err, sig=None):
                 if m is not None:
                     grid.models.append(m)
+                    if sig is not None:
+                        grid.trained_param_keys.add(sig)
                     built["n"] += 1
                     if rec is not None:
                         self._record(rec, done, _combo_key(overrides), m,
@@ -219,8 +231,8 @@ class GridSearch:
                     break
                 if skip(overrides):
                     continue  # trained before the crash / already in the grid
-                m, overrides, err = build_one(overrides)
-                accept(m, overrides, err)
+                m, overrides, err, sig = build_one(overrides)
+                accept(m, overrides, err, sig)
                 if (m is not None and c.stopping_rounds > 0
                         and self._early_stop(grid, scores, c)):
                     break
@@ -353,7 +365,9 @@ def export_grid(grid: Grid, directory: str) -> str:
                 "builder_name": grid.builder_cls.__name__,
                 "hyper_params": list(grid.hyper_params),
                 "models": paths,
-                "failures": grid.failures}
+                "failures": grid.failures,
+                "trained_param_keys": sorted(
+                    getattr(grid, "trained_param_keys", ()) or ())}
     with open(os.path.join(directory, "grid_manifest.json"), "w") as fh:
         json.dump(manifest, fh)
     return directory
@@ -376,4 +390,5 @@ def import_grid(directory: str) -> Grid:
     grid.models = [load_model(os.path.join(directory, p))
                    for p in manifest["models"]]
     grid.failures = list(manifest.get("failures", []))
+    grid.trained_param_keys = set(manifest.get("trained_param_keys", []))
     return grid
