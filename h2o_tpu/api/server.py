@@ -571,6 +571,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         cat_frac = float(p.get("categorical_fraction", 0.2) or 0)
         int_frac = float(p.get("integer_fraction", 0.2) or 0)
         bin_frac = float(p.get("binary_fraction", 0.1) or 0)
+        if cat_frac + int_frac + bin_frac > 1.0 + 1e-9:
+            return _err(400, "categorical_fraction + integer_fraction + "
+                             "binary_fraction must not exceed 1")
         miss_frac = float(p.get("missing_fraction", 0.0) or 0)
         factors = int(p.get("factors", 100) or 100)
         real_range = float(p.get("real_range", 100.0) or 100.0)

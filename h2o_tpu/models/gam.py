@@ -3,18 +3,23 @@
 Analog of `hex/gam/` (4,743 LoC): the reference expands each `gam_column` into
 a spline basis added as frame columns, then fits a penalized GLM
 (`hex/gam/GAMModel.java`, basis builders under `hex/gam/MatrixFrameUtils/`).
-Basis families there: cubic regression splines (CS, mgcv-style), I-splines
-(monotone), thin-plate. TPU-native rebuild: **P-splines** — a vectorized
-B-spline basis (Cox–de Boor, pure array ops) with a 2nd-order difference
-penalty (Eilers & Marx), which is numerically equivalent in practice to the CS
-basis + curvature penalty and keeps every shape static. I-spline/thin-plate are
-documented divergences (monotone constraints via `non_negative` on the basis
-block are a follow-up).
+All four of the reference's `bs` families are implemented, matching its codes:
+
+- ``bs=0`` **cubic regression splines** (mgcv 'cr', the reference default) —
+  values-at-knots natural-cubic basis with the EXACT integrated-squared-
+  second-derivative penalty S = DᵀB⁻¹D (`CubicRegressionSplines.java`);
+- ``bs=1`` **thin-plate** (1-D): |x−k|³ radial bumps + linear null space,
+  radial-energy penalty (`ThinPlateRegressionUtils.java` role);
+- ``bs=2`` **monotone I-splines**: I_i = Σ_{j≥i} B_j with non-negative
+  coefficients enforced per-coordinate inside the COD solver, giving a
+  non-decreasing smooth (`ISplines.java` + splines_non_negative);
+- ``bs=3`` **M/P-splines**: B-spline basis with the 2nd-order difference
+  penalty (Eilers & Marx; `NBSplinesTypeI.java` role).
 
 The fit is one penalized IRLS: the Gram/XᵀWz come from the same sharded einsum
-kernel GLM uses (`glm._make_irls_kernel`); the block-diagonal penalty
-S = scale_j · DᵀD is added to the Gram before the host-side elastic-net solve
-(`hex/gam/GAMModel` adds the same penalty in `_penaltyMatrix`).
+kernel GLM uses (`glm._make_irls_kernel`); the block-diagonal penalty is added
+to the Gram before the host-side solve (`hex/gam/GAMModel` _penaltyMatrix),
+which is ADMM normally and cyclic COD when monotone bounds are present.
 """
 
 from __future__ import annotations
@@ -28,11 +33,11 @@ import numpy as np
 from ..backend.jobs import Job
 from ..frame.frame import Frame
 from ..frame.vec import Vec
-# bspline_basis is pure numpy and lives with the standalone scorer so GAM
-# MOJOs score without the engine/JAX
-from ..mojo.format import bspline_basis
+# the basis evaluators are pure numpy and live with the standalone scorer so
+# GAM MOJOs score without the engine/JAX (gam_basis dispatches on spec["bs"])
+from ..mojo.format import cr_matrices, gam_basis
 from .datainfo import DataInfo
-from .glm import GLMParameters, _admm_solve, _make_irls_kernel
+from .glm import GLMParameters, _admm_solve, _cod_solve, _make_irls_kernel
 from .model_base import Model, ModelBuilder, ModelOutput, make_metrics
 
 
@@ -62,10 +67,13 @@ class GAMParameters(GLMParameters):
     """Mirrors `hex/schemas/GAMV3` (gam_columns, num_knots, scale, bs)."""
 
     gam_columns: list = field(default_factory=list)
-    num_knots: list | int = 8        # interior-knot count per gam column
+    num_knots: list | int = 8        # knot count per gam column
     scale: list | float = 1.0        # smoothing penalty weight per gam column
-    bs: list | int = 0               # basis type; 0 = splines (only option here)
+    bs: list | int = 0               # 0=cr | 1=thin plate | 2=monotone
+                                     # I-splines | 3=M/P-splines — the
+                                     # reference's `bs` codes (GAMV3.java:263)
     spline_degree: int = 3
+    splines_non_negative: list | bool = True  # bs=2: True → non-decreasing
     keep_gam_cols: bool = False
 
     def knots_for(self, j: int) -> int:
@@ -75,6 +83,14 @@ class GAMParameters(GLMParameters):
     def scale_for(self, j: int) -> float:
         return (self.scale[j] if isinstance(self.scale, (list, tuple))
                 else float(self.scale))
+
+    def bs_for(self, j: int) -> int:
+        return (int(self.bs[j]) if isinstance(self.bs, (list, tuple))
+                else int(self.bs))
+
+    def nonneg_for(self, j: int) -> bool:
+        v = self.splines_non_negative
+        return bool(v[j]) if isinstance(v, (list, tuple)) else bool(v)
 
 
 class GAMModel(Model):
@@ -96,9 +112,8 @@ class GAMModel(Model):
         nref = blocks[0].shape[0] if blocks else fr.vec(0).plen
         for spec in self.gam_specs:
             x = fr.vec(spec["column"]).to_numpy().astype(np.float64)
-            B = bspline_basis(x, spec["lo"], spec["hi"], spec["interior"],
-                              spec["degree"])
-            B = B - spec["col_means"][None, :]   # centering constraint
+            B = gam_basis(x, spec)
+            B = B - np.asarray(spec["col_means"])[None, :]  # centering
             pad = np.zeros((nref - B.shape[0], B.shape[1]))
             blocks.append(np.vstack([B, pad]).astype(np.float32))
         return jnp.asarray(np.concatenate(blocks, axis=1))
@@ -159,17 +174,53 @@ class GAM(ModelBuilder):
                                missing_values_handling=p.missing_values_handling)
                  if lin_names else None)
 
-        # build spline specs + blocks
-        gam_specs, pen_sizes = [], []
+        # build spline specs (basis family per column) + per-block penalties
+        gam_specs, pen_sizes, pen_blocks, mono_blocks = [], [], [], []
         for j, c in enumerate(p.gam_columns):
             x = fr.vec(c).to_numpy().astype(np.float64)
-            lo, hi, interior = bspline_knots(x, p.knots_for(j))
-            B = bspline_basis(x, lo, hi, interior, p.spline_degree)
-            col_means = B.mean(axis=0)
-            gam_specs.append(dict(column=c, lo=lo, hi=hi, interior=interior,
-                                  degree=p.spline_degree, col_means=col_means,
-                                  scale=p.scale_for(j)))
+            bs = p.bs_for(j)
+            if bs not in (0, 1, 2, 3):
+                raise ValueError(f"gam: bs={bs} unknown (0=cr, 1=thin plate, "
+                                 f"2=monotone I-splines, 3=M/P-splines)")
+            scale = p.scale_for(j)
+            if bs == 0:
+                # cr: knots at quantiles spanning the data; penalty DᵀB⁻¹D
+                xs = x[~np.isnan(x)]
+                K = max(p.knots_for(j), 3)
+                knots = np.unique(np.quantile(xs, np.linspace(0, 1, K)))
+                if len(knots) < 3:
+                    knots = np.linspace(float(xs.min()),
+                                        float(xs.min()) + 1.0, 3)
+                F, S_blk = cr_matrices(knots)
+                spec = dict(column=c, bs=0, knots=knots, F=F, scale=scale)
+            elif bs == 1:
+                # thin plate: null-space-projected radial block (PSD energy
+                # penalty) + unpenalized linear null space
+                from ..mojo.format import tp_constraint
+
+                xs = x[~np.isnan(x)]
+                K = max(p.knots_for(j), 3)
+                knots = np.unique(np.quantile(xs, np.linspace(0, 1, K)))
+                tp_scale = max(float(knots[-1] - knots[0]), 1e-12)
+                Z, S_rad = tp_constraint(knots, tp_scale)
+                nb = S_rad.shape[0] + 1  # projected radial + linear
+                S_blk = np.zeros((nb, nb))
+                S_blk[:-1, :-1] = S_rad
+                spec = dict(column=c, bs=1, knots=knots, tp_scale=tp_scale,
+                            Z=Z, scale=scale)
+            else:
+                lo, hi, interior = bspline_knots(x, p.knots_for(j))
+                spec = dict(column=c, bs=bs, lo=lo, hi=hi, interior=interior,
+                            degree=p.spline_degree, scale=scale)
+                nb = len(interior) + p.spline_degree + 1 - (1 if bs == 2
+                                                            else 0)
+                S_blk = diff_penalty(nb)
+            B = gam_basis(x, spec)
+            spec["col_means"] = B.mean(axis=0)
+            gam_specs.append(spec)
             pen_sizes.append(B.shape[1])
+            pen_blocks.append(scale * S_blk)
+            mono_blocks.append(bs == 2 and p.nonneg_for(j))
 
         output = ModelOutput()
         output.names = lin_names + list(p.gam_columns)
@@ -182,12 +233,17 @@ class GAM(ModelBuilder):
         P_lin = X.shape[1] - sum(pen_sizes)
         Ptot = X.shape[1]
 
-        # block-diagonal curvature penalty (zeros over linear block + intercept)
+        # block-diagonal smoothing penalty (zeros over linear block +
+        # intercept); per-coordinate lower bounds realize the monotone blocks
         S = np.zeros((Ptot + 1, Ptot + 1))
+        lo_bounds = np.full(Ptot + 1, -np.inf)
         off = P_lin
-        for spec, sz in zip(gam_specs, pen_sizes):
-            S[off:off + sz, off:off + sz] = spec["scale"] * diff_penalty(sz)
+        for blk, sz, mono in zip(pen_blocks, pen_sizes, mono_blocks):
+            S[off:off + sz, off:off + sz] = blk
+            if mono:
+                lo_bounds[off:off + sz] = 0.0
             off += sz
+        any_mono = any(mono_blocks)
 
         y = jnp.nan_to_num(y_dev)
         w = (~jnp.isnan(y_dev)).astype(jnp.float32)
@@ -219,8 +275,15 @@ class GAM(ModelBuilder):
             iters += 1
             Gn = np.asarray(G, np.float64) + S
             bn = np.asarray(b, np.float64)
-            beta_new = _admm_solve(Gn, bn, alpha * lam * neff,
-                                   (1 - alpha) * lam * neff, free)
+            if any_mono:
+                # COD applies the I-spline non-negativity per coordinate
+                # inside the sweep (ADMM has no bound projection)
+                beta_new = _cod_solve(Gn, bn, alpha * lam * neff,
+                                      (1 - alpha) * lam * neff, free, beta,
+                                      p.beta_epsilon, lo=lo_bounds)
+            else:
+                beta_new = _admm_solve(Gn, bn, alpha * lam * neff,
+                                       (1 - alpha) * lam * neff, free)
             diff = np.max(np.abs(beta_new - beta)) if it else np.inf
             beta = beta_new
             if diff < p.beta_epsilon:

@@ -929,7 +929,7 @@ class _GamMojo(_DeepLearningMojo):
     tweedie_link_power = 0.0
 
     def score(self, X):
-        from .format import bspline_basis
+        from .format import gam_basis
 
         X = np.asarray(X, dtype=np.float64)
         blocks = []
@@ -937,8 +937,7 @@ class _GamMojo(_DeepLearningMojo):
             blocks.append(self._expand(X[:, :self.n_lin]))
         for gi, spec in enumerate(self.gam_specs):
             x = X[:, self.n_lin + gi]
-            B = bspline_basis(x, spec["lo"], spec["hi"],
-                              np.asarray(spec["interior"]), spec["degree"])
+            B = gam_basis(x, spec)
             blocks.append(B - np.asarray(spec["col_means"])[None, :])
         D = np.concatenate(blocks, axis=1)
         eta = D @ self.beta[:-1] + self.beta[-1]
