@@ -188,9 +188,10 @@ class GAM(ModelBuilder):
                 xs = x[~np.isnan(x)]
                 K = max(p.knots_for(j), 3)
                 knots = np.unique(np.quantile(xs, np.linspace(0, 1, K)))
-                if len(knots) < 3:
+                if len(knots) < 3:  # degenerate quantiles: span the DATA
                     knots = np.linspace(float(xs.min()),
-                                        float(xs.min()) + 1.0, 3)
+                                        max(float(xs.max()),
+                                            float(xs.min()) + 1.0), 3)
                 F, S_blk = cr_matrices(knots)
                 spec = dict(column=c, bs=0, knots=knots, F=F, scale=scale)
             elif bs == 1:

@@ -253,7 +253,10 @@ def _score_fn(model: GBMModel, X):
         return model.dist.linkinv(f)
     if cat == "Binomial":
         p1 = model.dist.linkinv(f) if not model.cfg.drf_mode else jnp.clip(f, 0.0, 1.0)
-        label = (p1 > 0.5).astype(jnp.float32)
+        # default_threshold is settable via rapids model.reset.threshold;
+        # >= matches the MOJO reader and the reference's getPrediction
+        thr = float(getattr(model, "default_threshold", 0.5))
+        label = (p1 >= thr).astype(jnp.float32)
         return jnp.stack([label, 1 - p1, p1], axis=1)
     # Multinomial: f (R, K)
     if model.cfg.drf_mode:

@@ -637,7 +637,8 @@ class GLMModel(Model):
         eta = X @ beta[:-1] + beta[-1]
         mu = self.family.linkinv(eta)
         if self.output.model_category == "Binomial":
-            label = (mu > 0.5).astype(jnp.float32)
+            thr = float(getattr(self, "default_threshold", 0.5))
+            label = (mu >= thr).astype(jnp.float32)
             return jnp.stack([label, 1 - mu, mu], axis=1)
         if self.output.model_category == "Multinomial":
             pass  # handled by GLMMultinomialModel

@@ -99,7 +99,8 @@ def _common_info(model, algo, algo_full, category, n_classes, columns,
         "n_columns": len(columns),
         "n_domains": sum(d is not None for d in domains),
         "balance_classes": False,
-        "default_threshold": 0.5,
+        # a rapids model.reset.threshold must survive export
+        "default_threshold": float(getattr(model, "default_threshold", 0.5)),
         "prior_class_distrib": "null",
         "model_class_distrib": "null",
         "timestamp": "1970-01-01 00:00:00",
