@@ -1019,7 +1019,7 @@ class H2OModelClient:
     def rmse(self, train=True, valid=False, xval=False):
         kind = ("cross_validation_metrics" if xval else
                 "validation_metrics" if valid else "training_metrics")
-        return self._metrics(kind).get("rmse")
+        return self._metrics(kind).get("RMSE")
 
     def logloss(self, **kw):
         return self._metrics().get("logloss")
@@ -1031,7 +1031,7 @@ class H2OModelClient:
         return self._metrics().get("ks")
 
     def gini(self, **kw):
-        return self._metrics().get("gini")
+        return self._metrics().get("Gini")
 
     def confusion_matrix(self, **kw):
         cm = self._metrics().get("cm")

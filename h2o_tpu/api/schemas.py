@@ -113,7 +113,11 @@ def metrics_schema(m) -> dict | None:
               "residual_deviance", "aic", "gini"):
         v = getattr(m, f, None)
         if v is not None:
-            out[{"auc": "AUC", "pr_auc": "pr_auc", "aic": "AIC"}.get(f, f)] = _clean(v)
+            # wire casing follows the reference schemas exactly
+            # (ModelMetricsBaseV3.java:50 RMSE/MSE, BinomialV3 AUC/Gini/AIC)
+            wire = {"auc": "AUC", "aic": "AIC", "mse": "MSE",
+                    "rmse": "RMSE", "gini": "Gini"}.get(f, f)
+            out[wire] = _clean(v)
     cm = getattr(m, "confusion_matrix", None)
     if cm is not None:
         out["cm"] = {"table": _clean(cm)}

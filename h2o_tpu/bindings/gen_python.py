@@ -69,6 +69,14 @@ def _camel(algo: str) -> str:
 
 
 def _pydefault(v):
+    if isinstance(v, float):
+        # repr(inf) is the bare name `inf` — not valid source
+        if v != v:
+            return 'float("nan")'
+        if v == float("inf"):
+            return 'float("inf")'
+        if v == float("-inf"):
+            return 'float("-inf")'
     return repr(v)
 
 

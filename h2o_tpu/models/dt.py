@@ -1,12 +1,15 @@
-"""DT — single (exact) decision tree.
+"""DT — single decision tree.
 
 Analog of `hex/tree/dt/` (1,999 LoC; `hex/tree/dt/DT.java` builds one binary
 classification tree with exact binomial splits). TPU-native structure: one tree
 grown by the shared histogram engine (one jitted scan level pass, psum over the
-rows mesh axis) — the same quantile-binned split search, with leaf values fit
-as class probabilities. The reference limits DT to binomial classification;
-we additionally allow regression (leaf = mean) since the engine gives it for
-free.
+rows mesh axis). Split thresholds are therefore QUANTILE-BINNED, not the
+reference's exact per-value search — for a numeric feature with more than
+``nbins`` distinct values the chosen cut is the best bin edge, a documented
+divergence (identical split choice whenever distinct values ≤ nbins). Leaf
+values fit as class probabilities. The reference limits DT to binomial
+classification; we additionally allow regression (leaf = mean) since the
+engine gives it for free.
 """
 
 from __future__ import annotations
@@ -34,9 +37,10 @@ class DTParameters(GBMParameters):
 
 
 class DT(DRF):
-    """One unsampled DRF tree == a single exact-greedy decision tree: DRF mode
-    fits leaves at f=0 (per-leaf weighted response means / class frequencies,
-    the `hex/tree/dt/DT.java` leaf rule), and with sample_rate=1, mtries=all
-    there is no randomization left."""
+    """One unsampled DRF tree == a single greedy decision tree (binned
+    splits, see module docstring): DRF mode fits leaves at f=0 (per-leaf
+    weighted response means / class frequencies, the `hex/tree/dt/DT.java`
+    leaf rule), and with sample_rate=1, mtries=all there is no randomization
+    left."""
 
     algo_name = "dt"

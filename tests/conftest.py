@@ -20,6 +20,16 @@ import jax  # noqa: E402
 # the virtual CPU mesh, so override at the config level too.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache across test processes: the suite's wall-clock is
+# dominated by XLA compiles of the same programs every run (VERDICT r1 weak
+# #8); cache them on disk like the reference reuses its warm JVM.
+_cache_dir = os.environ.get("H2O_TPU_TEST_CACHE",
+                            os.path.join(os.path.dirname(__file__),
+                                         ".xla_cache"))
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
 
 
