@@ -44,12 +44,18 @@ class H2OServer:
     def __init__(self, port: int = 54321, name: str = "h2o_tpu",
                  hash_login: dict | str | None = None,
                  ssl_certfile: str | None = None,
-                 ssl_keyfile: str | None = None):
+                 ssl_keyfile: str | None = None,
+                 auth_check=None):
         """`hash_login`: {user: sha256-hex-or-plain} dict or a realm file of
         `user:sha256hex` lines — the `-hash_login` basic-auth analog
         (`h2o-security`, `water/webserver/H2OHttpViewImpl` auth hook).
+        `auth_check`: a callable `(user, password) -> bool` verifying Basic
+        credentials against an external directory — pass
+        `h2o_tpu.utils.ldap.LdapAuth(...)` for the `-ldap_login` role (the
+        pluggable seam JAAS login modules fill in the reference).
         `ssl_certfile`/`ssl_keyfile` terminate TLS on the REST socket — the
         `-jks`/https role of `water/network/SSLSocketChannelFactory`."""
+        self.auth_check = auth_check
         self.port = port
         self.name = name
         self.started_at = time.time()
@@ -68,7 +74,7 @@ class H2OServer:
         self.hash_login = hash_login
 
     def check_auth(self, header: str | None) -> bool:
-        if not self.hash_login:
+        if not self.hash_login and self.auth_check is None:
             return True
         if not header or not header.startswith("Basic "):
             return False
@@ -82,6 +88,8 @@ class H2OServer:
                 header[6:]).decode().partition(":")
         except Exception:
             return False
+        if self.auth_check is not None:
+            return bool(self.auth_check(user, pw))
         expect = self.hash_login.get(user)
         if expect is None:
             return False
@@ -303,13 +311,22 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
     if head == "Cloud":
         import jax
 
+        from ..backend.memory import CLEANER, hbm_stats
+
+        mem = hbm_stats() or {}
         return 200, {
             "version": __version__, "cloud_name": server.name,
             "cloud_size": 1, "cloud_healthy": True, "consensus": True,
             "locked": True,
             "nodes": [{"h2o": server.url, "healthy": True,
                        "num_cpus": len(jax.devices()),
-                       "backend": jax.default_backend()}],
+                       "backend": jax.default_backend(),
+                       # the free_mem/swap fields of NodeV3 — HBM here
+                       "free_mem": (mem.get("bytes_limit", 0)
+                                    - mem.get("bytes_in_use", 0)) or None,
+                       "max_mem": mem.get("bytes_limit"),
+                       "tracked_hbm_bytes": CLEANER.tracked_bytes(),
+                       "swap_count": CLEANER.spills}],
         }
     if head == "About":
         return 200, {"entries": [{"name": "Build version", "value": __version__},

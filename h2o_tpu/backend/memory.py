@@ -1,0 +1,190 @@
+"""HBM memory manager — the `water.Cleaner` / MemoryManager analog.
+
+The reference runs a background Cleaner that, under memory pressure, swaps
+least-recently-used values out of the K/V store to disk ("ice") and drops
+cached POJOs (`water/Cleaner.java`, `water/MemoryManager.java`). The TPU
+analog: bulk data lives in HBM as sharded `jax.Array`s hanging off Vecs, so
+the Cleaner tracks every device-resident Vec (weakly), and when tracked bytes
+exceed the budget it spills the coldest Vecs' device buffers to disk; the Vec
+rehydrates transparently on next `.data` access (`frame/vec.py`).
+
+Budget resolution order:
+- ``H2O_TPU_HBM_LIMIT_BYTES`` env (tests pin this for determinism),
+- ``jax.local_devices()[0].memory_stats()['bytes_limit']`` × 0.85 when the
+  backend reports it (real TPUs do; the CPU test backend does not) — resolved
+  once and cached,
+- otherwise unlimited (the Cleaner only observes).
+
+Accounting is a running counter (track/spill/rehydrate/GC adjust it), not a
+per-call scan; spill files are removed on rehydrate, on overwrite, and by a
+weakref finalizer when a spilled Vec is garbage-collected.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import weakref
+
+import numpy as np
+
+_UNRESOLVED = object()
+
+
+def hbm_stats() -> dict | None:
+    """Per-device memory stats when the backend exposes them (TPU does)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    return dict(stats) if stats else None
+
+
+def _vec_nbytes(arr) -> int:
+    return 0 if arr is None else arr.size * arr.dtype.itemsize
+
+
+class Cleaner:
+    def __init__(self):
+        import itertools
+
+        self._vecs: "weakref.WeakValueDictionary[int, object]" = \
+            weakref.WeakValueDictionary()
+        self._lock = threading.RLock()
+        # atomic in CPython — Vec.data reads must not contend on a lock
+        self._clock = itertools.count(1)
+        self._resident_bytes = 0
+        self._stats_limit = _UNRESOLVED  # memory_stats-based limit, cached
+        self.spill_dir = None            # lazy tempdir
+        self.spills = 0                  # observability (`/3/Cloud` swap ctr)
+
+    # -- budget ---------------------------------------------------------------
+    def limit_bytes(self) -> int | None:
+        env = os.environ.get("H2O_TPU_HBM_LIMIT_BYTES")
+        if env:
+            return int(env)
+        if self._stats_limit is _UNRESOLVED:
+            stats = hbm_stats()
+            self._stats_limit = (int(stats["bytes_limit"] * 0.85)
+                                 if stats and stats.get("bytes_limit")
+                                 else None)
+        return self._stats_limit
+
+    # -- tracking -------------------------------------------------------------
+    def touch(self, vec) -> int:
+        """Record an access; returns the new LRU clock stamp (lock-free)."""
+        return next(self._clock)
+
+    def track(self, vec, nbytes: int) -> None:
+        """Register a newly device-resident Vec (construction / rehydrate /
+        setter). The caller holds the vec's own lock if one exists."""
+        with self._lock:
+            if id(vec) not in self._vecs:
+                self._vecs[id(vec)] = vec
+                weakref.finalize(vec, self._on_dead,
+                                 getattr(vec, "key", None))
+            self._resident_bytes += nbytes
+        self.maybe_sweep(exclude=id(vec))
+
+    def note_freed(self, nbytes: int, spill_path: str | None = None) -> None:
+        """A device buffer went away outside a sweep (setter overwrite)."""
+        with self._lock:
+            self._resident_bytes -= nbytes
+        if spill_path:
+            self._remove_ice(spill_path)
+
+    def _on_dead(self, key):
+        # a spilled vec's ice file dies with it; resident bytes were already
+        # adjusted when its buffer was dropped (arrays self-account via the
+        # weak dict going stale — recompute lazily on drift)
+        if key and self.spill_dir:
+            self._remove_ice(os.path.join(self.spill_dir, f"{key}.npy"))
+
+    @staticmethod
+    def _remove_ice(path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def tracked_bytes(self) -> int:
+        with self._lock:
+            return max(self._resident_bytes, 0)
+
+    def _recount(self) -> tuple[int, dict]:
+        """Exact resync against live vecs, DEDUPED by device buffer: several
+        Vecs may wrap the same jax array (as_factor views etc.) — it holds
+        HBM once and spilling one alias frees nothing. Returns (total bytes,
+        {buffer id: alias count}); corrects drift from GC'd arrays."""
+        with self._lock:
+            vecs = list(self._vecs.values())
+            seen: dict = {}
+            total = 0
+            for v in vecs:
+                arr = getattr(v, "_data", None)
+                if arr is None:
+                    continue
+                bid = id(arr)
+                if bid not in seen:
+                    total += _vec_nbytes(arr)
+                seen[bid] = seen.get(bid, 0) + 1
+            self._resident_bytes = total
+            return total, seen
+
+    # -- the sweep (Cleaner.run's store_clean pass) ---------------------------
+    def maybe_sweep(self, exclude: int | None = None) -> int:
+        limit = self.limit_bytes()
+        if limit is None:
+            return 0
+        if self.tracked_bytes() <= limit:
+            return 0
+        used, aliases = self._recount()
+        if used <= limit:
+            return 0
+        with self._lock:
+            vecs = sorted((v for v in self._vecs.values()
+                           if getattr(v, "_data", None) is not None
+                           and id(v) != exclude
+                           # spilling an aliased buffer frees no HBM
+                           and aliases.get(id(v._data), 1) == 1),
+                          key=lambda v: getattr(v, "_last_access", 0))
+        freed = 0
+        for v in vecs:
+            if used - freed <= limit:
+                break
+            freed += self._spill(v)
+        return freed
+
+    def _spill(self, vec) -> int:
+        # non-blocking: a vec whose lock is held is in active use — skip it
+        # (this also prevents lock-order inversion against a rehydrating
+        # reader that holds its vec lock while sweeping others)
+        if not vec._lock.acquire(blocking=False):
+            return 0
+        try:
+            return self._spill_locked(vec)
+        finally:
+            vec._lock.release()
+
+    def _spill_locked(self, vec) -> int:
+        arr = vec._data
+        if arr is None:
+            return 0
+        nbytes = _vec_nbytes(arr)
+        if self.spill_dir is None:
+            self.spill_dir = tempfile.mkdtemp(prefix="h2o_tpu_ice_")
+        path = os.path.join(self.spill_dir, f"{vec.key}.npy")
+        np.save(path, np.asarray(arr))  # device -> host -> ice
+        vec._spill_path = path
+        vec._data = None                # HBM buffer becomes collectable
+        with self._lock:
+            self._resident_bytes -= nbytes
+            self.spills += 1
+        return nbytes
+
+
+#: process-global Cleaner (the `H2O.CLEANER` role)
+CLEANER = Cleaner()

@@ -95,7 +95,12 @@ class Vec(Keyed):
         exact_data: np.ndarray | None = None,
     ):
         super().__init__(key=key, prefix="vec")
-        self.data = data  # padded, row-sharded float32 (None for string vecs)
+        import threading
+
+        self._lock = threading.RLock()  # guards _data/_spill_path transitions
+        self._data = data  # padded, row-sharded float32 (None for string vecs)
+        self._spill_path: str | None = None  # Cleaner "ice" file when spilled
+        self._last_access = 0
         self.nrow = int(nrow)
         self.type = type
         self.domain = domain  # categorical level names (host-side)
@@ -103,6 +108,55 @@ class Vec(Keyed):
         self.exact_data = exact_data  # exact int64/f64 copy when f32 is lossy
         self._rollups: Rollups | None = None
         self._version = 0
+        if data is not None:
+            from ..backend.memory import CLEANER
+
+            self._last_access = CLEANER.touch(self)
+            CLEANER.track(self, data.size * data.dtype.itemsize)
+
+    @property
+    def data(self):
+        """The device column. Spilled Vecs rehydrate transparently (the
+        Cleaner's swap-in path, `water/Cleaner.java` lazy reload role).
+        Thread-safe: concurrent readers and Cleaner sweeps serialize on the
+        per-Vec lock, and the rehydrating access is excluded from the sweep
+        it may trigger — the getter never returns None for a numeric vec."""
+        from ..backend.memory import CLEANER
+
+        with self._lock:
+            if self._data is None and self._spill_path is not None:
+                import jax
+
+                from ..parallel.mesh import default_mesh, row_sharding
+
+                host = np.load(self._spill_path)
+                self._data = jax.device_put(
+                    host, row_sharding(default_mesh()))
+                CLEANER._remove_ice(self._spill_path)
+                self._spill_path = None
+                self._last_access = CLEANER.touch(self)
+                CLEANER.track(self,
+                              self._data.size * self._data.dtype.itemsize)
+            elif self._data is not None:
+                self._last_access = CLEANER.touch(self)
+            return self._data
+
+    @data.setter
+    def data(self, value):
+        from ..backend.memory import CLEANER
+
+        with self._lock:
+            old = self._data
+            old_path = self._spill_path
+            self._data = value
+            self._spill_path = None
+            if old is not None or old_path is not None:
+                CLEANER.note_freed(
+                    0 if old is None else old.size * old.dtype.itemsize,
+                    old_path)
+            if value is not None:
+                self._last_access = CLEANER.touch(self)
+                CLEANER.track(self, value.size * value.dtype.itemsize)
 
     # -- construction --------------------------------------------------------
     @staticmethod

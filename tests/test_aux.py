@@ -85,8 +85,11 @@ class TestPersistSPI:
         p.write_text("a\n1\n")
         assert localize(f"file://{p}") == str(p)
         assert localize(str(p)) == str(p)
-        with pytest.raises(NotImplementedError, match="s3"):
-            localize("s3://bucket/key.csv")
+        # s3/gs are real backends now (io/cloud.py); hdfs remains gated
+        with pytest.raises(NotImplementedError, match="hdfs"):
+            localize("hdfs://nn/key.csv")
+        with pytest.raises(ValueError, match="unknown URI scheme"):
+            localize("bogus://x")
 
     def test_custom_scheme_registration(self, tmp_path):
         from h2o_tpu.io import persist

@@ -1,0 +1,107 @@
+"""LDAP simple-bind auth (`-ldap_login` role) against a mock directory.
+
+The mock speaks just enough LDAPv3 BER to validate the client's wire bytes:
+it DECODES the BindRequest (rejecting malformed BER) and answers success
+only for one dn/password pair — so these tests pin both the request encoding
+and the response parsing.
+"""
+
+import socket
+import socketserver
+import threading
+
+import pytest
+
+from h2o_tpu.utils import ldap as l3
+
+
+class _MockLdap(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    good = ("uid=alice,ou=people,dc=example,dc=org", "secret")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        data = self.request.recv(4096)
+        try:
+            dn, pw = self._decode(data)
+            ok = (dn, pw) == _MockLdap.good
+        except Exception:
+            ok = False
+        code = 0 if ok else 49  # invalidCredentials
+        body = (bytes([0x02, 0x01, 0x01])                      # messageID
+                + bytes([0x61, 0x07,
+                         0x0A, 0x01, code,                     # resultCode
+                         0x04, 0x00, 0x04, 0x00]))             # dn, diag
+        self.request.sendall(bytes([0x30, len(body)]) + body)
+
+    @staticmethod
+    def _decode(buf):
+        def rl(pos):
+            first = buf[pos]
+            pos += 1
+            if first < 0x80:
+                return first, pos
+            n = first & 0x7F
+            return int.from_bytes(buf[pos:pos + n], "big"), pos + n
+
+        assert buf[0] == 0x30
+        _, pos = rl(1)
+        assert buf[pos] == 0x02           # messageID
+        n, pos = rl(pos + 1); pos += n
+        assert buf[pos] == 0x60           # BindRequest
+        _, pos = rl(pos + 1)
+        assert buf[pos] == 0x02           # version
+        n, pos = rl(pos + 1)
+        assert buf[pos:pos + n] == b"\x03"
+        pos += n
+        assert buf[pos] == 0x04           # name
+        n, pos = rl(pos + 1)
+        dn = buf[pos:pos + n].decode(); pos += n
+        assert buf[pos] == 0x80           # simple password
+        n, pos = rl(pos + 1)
+        return dn, buf[pos:pos + n].decode()
+
+
+@pytest.fixture()
+def mock_ldap():
+    srv = _MockLdap(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address
+    srv.shutdown()
+
+
+def test_bind_success_and_failure(mock_ldap):
+    host, port = mock_ldap
+    assert l3.ldap_bind(host, port,
+                        "uid=alice,ou=people,dc=example,dc=org", "secret")
+    assert not l3.ldap_bind(host, port,
+                            "uid=alice,ou=people,dc=example,dc=org", "wrong")
+    assert not l3.ldap_bind(host, port, "uid=bob,ou=people,dc=example,dc=org",
+                            "secret")
+    # empty password must NOT authenticate (unauthenticated-bind hole)
+    assert not l3.ldap_bind(host, port,
+                            "uid=alice,ou=people,dc=example,dc=org", "")
+
+
+def test_server_ldap_auth_over_rest(mock_ldap):
+    import h2o_tpu.api as h2o
+    from h2o_tpu.api.server import H2OServer
+    from h2o_tpu.utils.ldap import LdapAuth
+
+    host, port = mock_ldap
+    auth = LdapAuth(host, port,
+                    dn_template="uid={},ou=people,dc=example,dc=org")
+    srv = H2OServer(port=54699, auth_check=auth).start()
+    try:
+        good = h2o.H2OConnection(srv.url, "alice", "secret")
+        assert good.request("GET", "/3/Cloud")["cloud_healthy"]
+        bad = h2o.H2OConnection(srv.url, "alice", "nope")
+        with pytest.raises(h2o.H2OConnectionError):
+            bad.request("GET", "/3/Cloud")
+        anon = h2o.H2OConnection(srv.url)
+        with pytest.raises(h2o.H2OConnectionError):
+            anon.request("GET", "/3/Cloud")
+    finally:
+        srv.stop()
