@@ -55,6 +55,10 @@ class H2OServer:
         pluggable seam JAAS login modules fill in the reference).
         `ssl_certfile`/`ssl_keyfile` terminate TLS on the REST socket — the
         `-jks`/https role of `water/network/SSLSocketChannelFactory`."""
+        if auth_check is not None and hash_login:
+            raise ValueError("hash_login and auth_check are mutually "
+                             "exclusive — auth_check would silently lock "
+                             "hash_login users out")
         self.auth_check = auth_check
         self.port = port
         self.name = name
@@ -322,8 +326,10 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                        "num_cpus": len(jax.devices()),
                        "backend": jax.default_backend(),
                        # the free_mem/swap fields of NodeV3 — HBM here
-                       "free_mem": (mem.get("bytes_limit", 0)
-                                    - mem.get("bytes_in_use", 0)) or None,
+                       # (0 is a REAL value at full utilization, not null)
+                       "free_mem": (mem["bytes_limit"] - mem["bytes_in_use"]
+                                    if "bytes_limit" in mem
+                                    and "bytes_in_use" in mem else None),
                        "max_mem": mem.get("bytes_limit"),
                        "tracked_hbm_bytes": CLEANER.tracked_bytes(),
                        "swap_count": CLEANER.spills}],

@@ -57,6 +57,7 @@ class Cleaner:
         # atomic in CPython — Vec.data reads must not contend on a lock
         self._clock = itertools.count(1)
         self._resident_bytes = 0
+        self._sizes: dict[int, int] = {}  # id(vec) -> its resident bytes
         self._stats_limit = _UNRESOLVED  # memory_stats-based limit, cached
         self.spill_dir = None            # lazy tempdir
         self.spills = 0                  # observability (`/3/Cloud` swap ctr)
@@ -81,25 +82,33 @@ class Cleaner:
     def track(self, vec, nbytes: int) -> None:
         """Register a newly device-resident Vec (construction / rehydrate /
         setter). The caller holds the vec's own lock if one exists."""
+        vid = id(vec)
         with self._lock:
-            if id(vec) not in self._vecs:
-                self._vecs[id(vec)] = vec
-                weakref.finalize(vec, self._on_dead,
+            if vid not in self._vecs:
+                self._vecs[vid] = vec
+                weakref.finalize(vec, self._on_dead, vid,
                                  getattr(vec, "key", None))
             self._resident_bytes += nbytes
-        self.maybe_sweep(exclude=id(vec))
+            self._sizes[vid] = self._sizes.get(vid, 0) + nbytes
+        self.maybe_sweep(exclude=vid)
 
-    def note_freed(self, nbytes: int, spill_path: str | None = None) -> None:
+    def note_freed(self, vec, nbytes: int,
+                   spill_path: str | None = None) -> None:
         """A device buffer went away outside a sweep (setter overwrite)."""
         with self._lock:
             self._resident_bytes -= nbytes
+            vid = id(vec)
+            if vid in self._sizes:
+                self._sizes[vid] -= nbytes
         if spill_path:
             self._remove_ice(spill_path)
 
-    def _on_dead(self, key):
-        # a spilled vec's ice file dies with it; resident bytes were already
-        # adjusted when its buffer was dropped (arrays self-account via the
-        # weak dict going stale — recompute lazily on drift)
+    def _on_dead(self, vid, key):
+        # a spilled vec's ice file dies with it, and whatever bytes it still
+        # held resident leave the counter — otherwise churned temporaries
+        # drift the counter upward and every construction pays a recount
+        with self._lock:
+            self._resident_bytes -= self._sizes.pop(vid, 0)
         if key and self.spill_dir:
             self._remove_ice(os.path.join(self.spill_dir, f"{key}.npy"))
 
@@ -123,6 +132,7 @@ class Cleaner:
             vecs = list(self._vecs.values())
             seen: dict = {}
             total = 0
+            sizes: dict[int, int] = {}
             for v in vecs:
                 arr = getattr(v, "_data", None)
                 if arr is None:
@@ -131,7 +141,9 @@ class Cleaner:
                 if bid not in seen:
                     total += _vec_nbytes(arr)
                 seen[bid] = seen.get(bid, 0) + 1
+                sizes[id(v)] = _vec_nbytes(arr)
             self._resident_bytes = total
+            self._sizes = sizes
             return total, seen
 
     # -- the sweep (Cleaner.run's store_clean pass) ---------------------------
@@ -182,6 +194,9 @@ class Cleaner:
         vec._data = None                # HBM buffer becomes collectable
         with self._lock:
             self._resident_bytes -= nbytes
+            vid = id(vec)
+            if vid in self._sizes:
+                self._sizes[vid] -= nbytes
             self.spills += 1
         return nbytes
 

@@ -152,7 +152,7 @@ class Vec(Keyed):
             self._spill_path = None
             if old is not None or old_path is not None:
                 CLEANER.note_freed(
-                    0 if old is None else old.size * old.dtype.itemsize,
+                    self, 0 if old is None else old.size * old.dtype.itemsize,
                     old_path)
             if value is not None:
                 self._last_access = CLEANER.touch(self)

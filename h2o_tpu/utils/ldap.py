@@ -35,26 +35,33 @@ def bind_request(message_id: int, dn: str, password: str) -> bytes:
     return _ber(0x30, msg)
 
 
+def _read_ber_len(buf: bytes, pos: int) -> tuple[int, int]:
+    """Decode one BER length at ``pos``; returns (length, next_pos)."""
+    first = buf[pos]
+    pos += 1
+    if first < 0x80:
+        return first, pos
+    n = first & 0x7F
+    return int.from_bytes(buf[pos:pos + n], "big"), pos + n
+
+
 def parse_bind_response(data: bytes) -> int:
-    """Extract resultCode from the BindResponse (0 = success)."""
-
-    def read_len(buf, pos):
-        first = buf[pos]
-        pos += 1
-        if first < 0x80:
-            return first, pos
-        n = first & 0x7F
-        return int.from_bytes(buf[pos:pos + n], "big"), pos + n
-
-    pos = 1                               # 0x30 SEQUENCE
-    _, pos = read_len(data, pos)
-    assert data[pos] == 0x02              # messageID
-    mlen, pos = read_len(data, pos + 1)
+    """Extract resultCode from the BindResponse (0 = success). Explicit
+    checks, NOT assert — `python -O` strips asserts, and a misparsed
+    non-BindResponse must never read as success."""
+    if not data or data[0] != 0x30:
+        raise ValueError("not an LDAPMessage")
+    _, pos = _read_ber_len(data, 1)
+    if data[pos] != 0x02:                 # messageID
+        raise ValueError("missing messageID")
+    mlen, pos = _read_ber_len(data, pos + 1)
     pos += mlen
-    assert data[pos] == 0x61              # [APPLICATION 1] BindResponse
-    _, pos = read_len(data, pos + 1)
-    assert data[pos] in (0x0A, 0x02)      # resultCode ENUMERATED
-    rlen, pos = read_len(data, pos + 1)
+    if data[pos] != 0x61:                 # [APPLICATION 1] BindResponse
+        raise ValueError(f"not a BindResponse (tag 0x{data[pos]:02x})")
+    _, pos = _read_ber_len(data, pos + 1)
+    if data[pos] not in (0x0A, 0x02):     # resultCode ENUMERATED
+        raise ValueError("missing resultCode")
+    rlen, pos = _read_ber_len(data, pos + 1)
     return int.from_bytes(data[pos:pos + rlen], "big")
 
 
@@ -84,7 +91,7 @@ def ldap_bind(host: str, port: int, dn: str, password: str,
                 s.close()
     try:
         return parse_bind_response(data) == 0
-    except (AssertionError, IndexError):
+    except (ValueError, IndexError):
         return False
 
 
@@ -98,17 +105,30 @@ def _recv_ber_message(s: socket.socket) -> bytes:
         if len(buf) >= 2 and buf[1] & 0x80:
             need = 2 + (buf[1] & 0x7F)
         if len(buf) >= need:
-            if buf[1] < 0x80:
-                total = 2 + buf[1]
-            else:
-                n = buf[1] & 0x7F
-                total = 2 + n + int.from_bytes(buf[2:2 + n], "big")
+            blen, body_pos = _read_ber_len(buf, 1)
+            total = body_pos + blen
             if len(buf) >= total:
                 return buf[:total]
         chunk = s.recv(4096)
         if not chunk:
             return buf
         buf += chunk
+
+
+def escape_dn_value(value: str) -> str:
+    """RFC 4514 escaping of one DN attribute value — an attacker-controlled
+    username must not rewrite the bind DN ('admin,ou=service' injection)."""
+    out = []
+    for i, ch in enumerate(value):
+        if ch in ',+"\\<>;=':
+            out.append("\\" + ch)
+        elif ch in "# " and i in (0, len(value) - 1):
+            out.append("\\" + ch)
+        elif ord(ch) < 0x20:
+            out.append(f"\\{ord(ch):02x}")
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 class LdapAuth:
@@ -150,9 +170,11 @@ class LdapAuth:
             hit = self._cache.get(fp)
             if hit is not None and now - hit < self.cache_ttl_s:
                 return True
+        # str.replace, NOT .format: a username containing '{' must not be a
+        # format spec, and DN metacharacters are escaped (injection)
+        dn = self.dn_template.replace("{}", escape_dn_value(user))
         try:
-            ok = ldap_bind(self.host, self.port,
-                           self.dn_template.format(user), password,
+            ok = ldap_bind(self.host, self.port, dn, password,
                            use_tls=self.use_tls, ssl_context=self.ssl_context)
         except OSError:
             return False

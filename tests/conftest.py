@@ -43,6 +43,23 @@ def cloud():
     yield
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compile_state():
+    """Full-suite stability: hundreds of XLA CPU compilations in one process
+    eventually segfault inside backend_compile (observed twice at ~test 250,
+    with 120 GB free RAM — accumulated compiler/executable state, not OOM).
+    Dropping the live executables between modules keeps the compiler healthy;
+    per-module recompiles are what the suite pays anyway."""
+    yield
+    import gc
+
+    from h2o_tpu.models.tree import engine as _engine
+
+    _engine._TRAIN_FN_CACHE.clear()
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture(autouse=True)
 def key_leak_rule(request):
     """`water/junit/rules/CheckLeakedKeysRule.java:20-35` analog: snapshot the
