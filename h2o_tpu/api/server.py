@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import traceback
@@ -204,8 +205,11 @@ def _make_handler(server: H2OServer):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             if filename:
+                # frame keys are client-controlled; anything outside a safe
+                # charset could malform the header or inject CR/LF
+                safe = re.sub(r"[^A-Za-z0-9._-]", "_", str(filename))
                 self.send_header("Content-Disposition",
-                                 f'attachment; filename="{filename}"')
+                                 f'attachment; filename="{safe}"')
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

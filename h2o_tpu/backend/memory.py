@@ -56,8 +56,12 @@ class Cleaner:
         self._lock = threading.RLock()
         # atomic in CPython — Vec.data reads must not contend on a lock
         self._clock = itertools.count(1)
+        # ledger keys are per-vec monotonic tokens, NOT id(vec): CPython can
+        # reuse a dead vec's address for a new vec before the old finalizer
+        # fires, and a stale _on_dead must never pop the new vec's bytes
+        self._token_ctr = itertools.count(1)
         self._resident_bytes = 0
-        self._sizes: dict[int, int] = {}  # id(vec) -> its resident bytes
+        self._sizes: dict[int, int] = {}  # vec token -> its resident bytes
         self._stats_limit = _UNRESOLVED  # memory_stats-based limit, cached
         self.spill_dir = None            # lazy tempdir
         self.spills = 0                  # observability (`/3/Cloud` swap ctr)
@@ -79,36 +83,46 @@ class Cleaner:
         """Record an access; returns the new LRU clock stamp (lock-free)."""
         return next(self._clock)
 
+    def _token(self, vec) -> int:
+        tok = getattr(vec, "_cleaner_token", None)
+        if tok is None:
+            tok = next(self._token_ctr)
+            vec._cleaner_token = tok
+        return tok
+
     def track(self, vec, nbytes: int) -> None:
         """Register a newly device-resident Vec (construction / rehydrate /
         setter). The caller holds the vec's own lock if one exists."""
-        vid = id(vec)
         with self._lock:
-            if vid not in self._vecs:
-                self._vecs[vid] = vec
-                weakref.finalize(vec, self._on_dead, vid,
+            tok = self._token(vec)
+            if tok not in self._vecs:
+                self._vecs[tok] = vec
+                weakref.finalize(vec, self._on_dead, tok,
                                  getattr(vec, "key", None))
             self._resident_bytes += nbytes
-            self._sizes[vid] = self._sizes.get(vid, 0) + nbytes
-        self.maybe_sweep(exclude=vid)
+            self._sizes[tok] = self._sizes.get(tok, 0) + nbytes
+        self.maybe_sweep(exclude=tok)
 
     def note_freed(self, vec, nbytes: int,
                    spill_path: str | None = None) -> None:
         """A device buffer went away outside a sweep (setter overwrite)."""
-        with self._lock:
-            self._resident_bytes -= nbytes
-            vid = id(vec)
-            if vid in self._sizes:
-                self._sizes[vid] -= nbytes
+        self._debit(vec, nbytes)
         if spill_path:
             self._remove_ice(spill_path)
 
-    def _on_dead(self, vid, key):
+    def _debit(self, vec, nbytes: int) -> None:
+        with self._lock:
+            self._resident_bytes -= nbytes
+            tok = getattr(vec, "_cleaner_token", None)
+            if tok in self._sizes:
+                self._sizes[tok] = max(self._sizes[tok] - nbytes, 0)
+
+    def _on_dead(self, tok, key):
         # a spilled vec's ice file dies with it, and whatever bytes it still
         # held resident leave the counter — otherwise churned temporaries
         # drift the counter upward and every construction pays a recount
         with self._lock:
-            self._resident_bytes -= self._sizes.pop(vid, 0)
+            self._resident_bytes -= self._sizes.pop(tok, 0)
         if key and self.spill_dir:
             self._remove_ice(os.path.join(self.spill_dir, f"{key}.npy"))
 
@@ -129,19 +143,22 @@ class Cleaner:
         HBM once and spilling one alias frees nothing. Returns (total bytes,
         {buffer id: alias count}); corrects drift from GC'd arrays."""
         with self._lock:
-            vecs = list(self._vecs.values())
+            vecs = [v for v in self._vecs.values()
+                    if getattr(v, "_data", None) is not None]
             seen: dict = {}
             total = 0
+            for v in vecs:
+                bid = id(v._data)
+                if bid not in seen:
+                    total += _vec_nbytes(v._data)
+                seen[bid] = seen.get(bid, 0) + 1
+            # a shared buffer's bytes are SPLIT across its alias tokens so the
+            # per-token ledger sums to _resident_bytes: when one alias dies,
+            # _on_dead debits only its share, not the whole still-live buffer
             sizes: dict[int, int] = {}
             for v in vecs:
-                arr = getattr(v, "_data", None)
-                if arr is None:
-                    continue
-                bid = id(arr)
-                if bid not in seen:
-                    total += _vec_nbytes(arr)
-                seen[bid] = seen.get(bid, 0) + 1
-                sizes[id(v)] = _vec_nbytes(arr)
+                sizes[self._token(v)] = \
+                    _vec_nbytes(v._data) // seen[id(v._data)]
             self._resident_bytes = total
             self._sizes = sizes
             return total, seen
@@ -159,7 +176,7 @@ class Cleaner:
         with self._lock:
             vecs = sorted((v for v in self._vecs.values()
                            if getattr(v, "_data", None) is not None
-                           and id(v) != exclude
+                           and getattr(v, "_cleaner_token", None) != exclude
                            # spilling an aliased buffer frees no HBM
                            and aliases.get(id(v._data), 1) == 1),
                           key=lambda v: getattr(v, "_last_access", 0))
@@ -192,11 +209,8 @@ class Cleaner:
         np.save(path, np.asarray(arr))  # device -> host -> ice
         vec._spill_path = path
         vec._data = None                # HBM buffer becomes collectable
+        self._debit(vec, nbytes)
         with self._lock:
-            self._resident_bytes -= nbytes
-            vid = id(vec)
-            if vid in self._sizes:
-                self._sizes[vid] -= nbytes
             self.spills += 1
         return nbytes
 

@@ -1118,11 +1118,12 @@ class _EnsembleMojo(MojoModel):
 
     def _read(self, zr):
         if "submodel_count" not in self.info:
-            raise NotImplementedError(
-                "this stacked-ensemble MOJO uses the pre-round-2 legacy "
-                "layout (nested base_{i}.zip blobs); re-export it with the "
-                "current writer, which emits the reference's "
-                "MultiModelMojoReader directory layout")
+            # pre-round-2 exports from this framework: nested base_{i}.zip
+            # blobs plus an ensemble/mapping.json. Kept as a read-only
+            # fallback so earlier exports still load.
+            self._read_legacy(zr)
+            return
+        self._legacy = False
         subs = {}
         for i in range(parse_kv(self.info.get("submodel_count"), 0)):
             key = self.info[f"submodel_key_{i}"]
@@ -1142,8 +1143,56 @@ class _EnsembleMojo(MojoModel):
             self.base.append(subs.get(key) if key not in (None, "null")
                              else None)
 
+    def _read_legacy(self, zr):
+        import io as _io
+        import json as _json
+
+        self._legacy = True
+        if not zr.exists("ensemble/mapping.json"):
+            raise NotImplementedError(
+                "unrecognized stacked-ensemble MOJO layout: model.ini has no "
+                "submodel_count (MultiModelMojoReader convention) and the "
+                "zip has no ensemble/mapping.json (this framework's "
+                "pre-round-2 legacy layout); re-export with a current writer")
+        spec = _json.loads(zr.text("ensemble/mapping.json"))
+        self.mapping = spec["bases"]
+        self.meta_features = spec["metalearner_features"]
+        self.logit_transform = False
+        self.base = []
+        n = parse_kv(self.info.get("n_base_models"), 0)
+        for i in range(n):
+            sub = MojoZipReader(_io.BytesIO(zr.blob(f"models/base_{i}.zip")))
+            try:
+                self.base.append(MojoModel._from_reader(sub))
+            finally:
+                sub.close()
+        sub = MojoZipReader(_io.BytesIO(zr.blob("models/metalearner.zip")))
+        try:
+            self.meta = MojoModel._from_reader(sub)
+        finally:
+            sub.close()
+
+    def _score_legacy(self, X):
+        feats = self.columns[:-1]
+        level_one = {}
+        for bm, mp in zip(self.base, self.mapping):
+            bfeats = bm.columns[:-1] if bm.supervised else bm.columns
+            Xb = X[:, [feats.index(f) for f in bfeats]]
+            pred = bm.score(Xb)
+            if mp["category"] == "Binomial":
+                level_one[mp["key"]] = pred[:, 2]
+            elif mp["category"] == "Multinomial":
+                for ki, cls in enumerate(mp["response_domain"]):
+                    level_one[f'{mp["key"]}/p{cls}'] = pred[:, 1 + ki]
+            else:
+                level_one[mp["key"]] = pred if pred.ndim == 1 else pred[:, 0]
+        D = np.stack([level_one[n] for n in self.meta_features], axis=1)
+        return self.meta.score(D)
+
     def score(self, X):
         X = np.asarray(X, dtype=np.float64)
+        if getattr(self, "_legacy", False):
+            return self._score_legacy(X)
         feats = self.columns[:-1] if self.supervised else self.columns
         K = self.n_classes
         R = X.shape[0]

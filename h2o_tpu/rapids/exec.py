@@ -450,8 +450,11 @@ def _sumaxis(fr: Frame, na_rm: bool, axis: int):
 # (%% x 0) → nan, (^ -1 0.5) → nan — never a Python ZeroDivisionError
 _SCALAR_BINOPS = {
     "+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide,
-    "^": np.float_power, "%%": np.mod,
-    "intDiv": lambda a, b: np.floor(np.divide(a, b)),
+    "^": np.float_power, "%%": np.fmod,  # Java %: sign follows dividend
+    # operands truncate BEFORE the divide (AstIntDiv: `(int) l / (int) r`)
+    "intDiv": lambda a, b: np.where(np.trunc(b) == 0, np.nan,
+                                    np.trunc(np.trunc(a) / np.trunc(b))),
+    "%/%": lambda a, b: np.where(b == 0, np.nan, np.trunc(np.divide(a, b))),
     "==": np.equal, "!=": np.not_equal,
     "<": np.less, "<=": np.less_equal,
     ">": np.greater, ">=": np.greater_equal,
@@ -795,7 +798,7 @@ _PRIMS = {
                             for v in _as_frame(fr).vecs],
     "any.na": lambda R, fr: float(any(v.nacnt() > 0
                                       for v in _as_frame(fr).vecs)),
-    "%/%": _prim_binop("intDiv"),
+    "%/%": _prim_binop("%/%"),
     # uniform random column keyed to the frame's rows (`AstRunif`) — the
     # h2o-py split_frame building block
     "h2o.runif": lambda R, fr, seed=-1: (lambda f: Vec.from_numpy(

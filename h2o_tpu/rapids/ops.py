@@ -45,10 +45,18 @@ def _mask(v: Vec):
 _BINOPS = {
     "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
     "^": jnp.power,
-    "%%": lambda a, b: jnp.where(b == 0, jnp.nan,
-                                 a - jnp.floor(a / b) * b),  # R-style mod
-    # Java truncation toward zero ((int) l / (int) r), NaN on divide-by-zero
-    "intDiv": lambda a, b: jnp.where(b == 0, jnp.nan, jnp.trunc(a / b)),
+    # Java truncated remainder (sign follows dividend): AstMod/AstModR both
+    # evaluate `l % r` on doubles (operators/AstMod.java:11, AstModR.java:11),
+    # so (% -7 3) == -1, not the floored +2. x % 0 is NaN on Java doubles.
+    "%%": lambda a, b: jnp.where(b == 0, jnp.nan, jnp.fmod(a, b)),
+    # AstIntDiv: `(int) l / (int) r` — each operand truncates BEFORE the
+    # divide (so intDiv(-7.9, 3.9) == -7/3 == -2), NaN when (int) r == 0.
+    # AstIntDivR (`%/%`): `(int) (l / r)` — the real quotient truncates.
+    # Divergence: Java's (int) of NaN/±Inf collapses to 0/Integer.MAX_VALUE;
+    # we propagate NaN and return NaN on zero divisors instead.
+    "intDiv": lambda a, b: jnp.where(jnp.trunc(b) == 0, jnp.nan,
+                                     jnp.trunc(jnp.trunc(a) / jnp.trunc(b))),
+    "%/%": lambda a, b: jnp.where(b == 0, jnp.nan, jnp.trunc(a / b)),
 }
 
 _CMPOPS = {

@@ -204,3 +204,60 @@ def test_stackedensemble_mojo(tmp_path):
     eng_p1 = se.predict(fr).vec(2).to_numpy()
     got = scorer.predict(fr)
     np.testing.assert_allclose(got[:, 2], eng_p1, atol=1e-4, rtol=1e-3)
+
+    # pre-round-2 exports of this framework used a legacy layout (nested
+    # base_{i}.zip blobs + ensemble/mapping.json); the reader keeps a
+    # fallback branch so those files still load
+    legacy = str(tmp_path / "legacy_se.zip")
+    _write_legacy_ensemble(se, legacy)
+    legacy_scorer = MojoModel.load(legacy)
+    np.testing.assert_allclose(legacy_scorer.predict(fr)[:, 2], eng_p1,
+                               atol=1e-4, rtol=1e-3)
+
+
+def _write_legacy_ensemble(model, path):
+    """Reproduce the pre-round-2 writer's layout byte-for-byte in spirit:
+    nested base_{i}.zip / metalearner.zip blobs + ensemble/mapping.json."""
+    import json
+
+    from h2o_tpu.mojo.format import MojoZipWriter
+    from h2o_tpu.mojo.writer import _common_info, _write_common, export_mojo
+
+    out = model.output
+    category = out.model_category
+    feats, doms = [], []
+    for bm in model.base_models:
+        for n in bm.output.names:
+            if n not in feats:
+                feats.append(n)
+                doms.append(bm.output.domains.get(n))
+    columns = feats + [model.params.response_column]
+    domains = doms + [out.response_domain]
+    n_classes = {"Regression": 1, "Binomial": 2}.get(
+        category, len(out.response_domain or []))
+    info = _common_info(model, "stackedensemble", "Stacked Ensemble",
+                        category, n_classes, columns, domains,
+                        mojo_version=1.00)
+    info["n_base_models"] = len(model.base_models)
+    mapping = []
+    zw = MojoZipWriter()
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmpdir:
+        import os
+        for i, bm in enumerate(model.base_models):
+            sub = os.path.join(tmpdir, f"base_{i}.zip")
+            export_mojo(bm, sub)
+            with open(sub, "rb") as fh:
+                zw.write_blob(f"models/base_{i}.zip", fh.read())
+            mapping.append({"key": str(bm.key),
+                            "category": bm.output.model_category,
+                            "response_domain": bm.output.response_domain})
+        sub = os.path.join(tmpdir, "meta.zip")
+        export_mojo(model.metalearner, sub)
+        with open(sub, "rb") as fh:
+            zw.write_blob("models/metalearner.zip", fh.read())
+    zw.write_text("ensemble/mapping.json", json.dumps(
+        {"bases": mapping,
+         "metalearner_features": list(model.metalearner.output.names)}))
+    _write_common(zw, info, columns, domains)
+    zw.finish(path)

@@ -24,6 +24,34 @@ def test_binop_arith_and_na(v):
     assert r[1] == 4 and np.isnan(r[2])
 
 
+def test_mod_truncated_remainder_java_semantics():
+    # AstMod/AstModR both evaluate Java's `l % r` on doubles — truncated
+    # remainder, sign follows the DIVIDEND: (-7) % 3 == -1, 7 % -3 == 1.
+    # np.mod/floored semantics would give +2 / -2 here.
+    a = Vec.from_numpy(np.array([-7.0, 7.0, 7.0, 5.0], np.float32))
+    b = Vec.from_numpy(np.array([3.0, -3.0, 3.0, 0.0], np.float32))
+    got = binop("%%", a, b).to_numpy()
+    assert got[0] == -1.0 and got[1] == 1.0 and got[2] == 1.0
+    assert np.isnan(got[3])  # x % 0 is NaN on Java doubles
+    # the scalar path through the rapids evaluator agrees
+    from h2o_tpu.rapids.exec import Session, rapids_exec
+    s = Session()
+    assert rapids_exec("(% -7 3)", s) == -1.0
+    assert rapids_exec("(%% -7 3)", s) == -1.0
+    # AstIntDiv truncates each OPERAND first ((int) l / (int) r), so
+    # intDiv(-7.9, 3.9) == -7/3 == -2; AstIntDivR truncates the quotient
+    assert rapids_exec("(intDiv -7.9 3.9)", s) == -2.0
+    assert rapids_exec("(intDiv -7 3)", s) == -2.0
+    assert rapids_exec("(%/% -7 3)", s) == -2.0
+    assert np.isnan(rapids_exec("(intDiv 5 0.5)", s))  # (int) 0.5 == 0
+    a2 = Vec.from_numpy(np.array([-7.9, -7.0], np.float32))
+    b2 = Vec.from_numpy(np.array([3.9, 3.0], np.float32))
+    assert binop("intDiv", a2, b2).to_numpy().tolist() == [-2.0, -2.0]
+    assert binop("%/%", a2, b2).to_numpy().tolist() == [-2.0, -2.0]
+    assert binop("%/%", Vec.from_numpy(np.array([-7.0], np.float32)),
+                 2.0).to_numpy().tolist() == [-3.0]
+
+
 def test_cmp_and_logical_na_semantics(v):
     c = binop(">", v, 1.5).to_numpy()
     assert c[0] == 0 and c[1] == 1 and np.isnan(c[2])
