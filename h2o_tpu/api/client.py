@@ -55,9 +55,16 @@ class H2OConnection:
                 f"{username}:{password or ''}".encode()).decode()
 
     def request(self, method: str, path: str, data: dict | None = None,
-                params: dict | None = None, raw: bool = False) -> dict | str:
+                params: dict | None = None, raw: bool = False,
+                filename: str | None = None,
+                save_to: str | None = None) -> dict | str:
         """``raw=True`` returns the response body as text (non-JSON
-        endpoints like DownloadDataset) through the same auth/SSL path."""
+        endpoints like DownloadDataset) through the same auth/SSL path.
+        ``filename`` streams that local file as the request body (the h2o-py
+        connection's file-upload mode — http.client reads file objects in
+        8KB blocks, so large pushes never materialize in memory).
+        ``save_to`` streams a binary response body to that local path and
+        returns the path (the h2o-py save_to download mode)."""
         url = f"{self.url}{path}"
         if params:
             url += "?" + urllib.parse.urlencode(params)
@@ -65,14 +72,33 @@ class H2OConnection:
         headers = {}
         if self._auth:
             headers["Authorization"] = self._auth
-        if data is not None:
+        if filename is not None:
+            body = open(filename, "rb")  # closed in the finally below
+            headers["Content-Type"] = "application/octet-stream"
+            headers["Content-Length"] = str(os.path.getsize(filename))
+        elif data is not None:
             body = json.dumps(data).encode()
             headers["Content-Type"] = "application/json"
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
         try:
+            return self._send(req, raw, save_to)
+        finally:
+            if filename is not None and body is not None:
+                body.close()
+
+    def _send(self, req, raw: bool, save_to: str | None):
+        try:
             with urllib.request.urlopen(req, timeout=600,
                                         context=self._ssl_ctx) as resp:
+                if save_to is not None:
+                    with open(save_to, "wb") as out:
+                        while True:
+                            chunk = resp.read(1 << 20)
+                            if not chunk:
+                                break
+                            out.write(chunk)
+                    return save_to
                 text = resp.read().decode()
                 return text if raw else json.loads(text)
         except urllib.error.HTTPError as e:
@@ -202,6 +228,75 @@ def upload_frame(python_obj, destination_frame: str | None = None) -> "H2OFrame"
         return import_file(tmp, destination_frame=destination_frame)
     finally:
         os.unlink(tmp)
+
+
+def upload_file(path: str, destination_frame: str | None = None,
+                **parse_kw) -> "H2OFrame":
+    """`h2o.upload_file` (`h2o-py/h2o/h2o.py:341`): push a LOCAL file to the
+    server — `POST /3/PostFile` → ParseSetup → Parse on the raw upload key.
+    The push streams in 8KB blocks; nothing loads into client memory."""
+    c = connection()
+    if path.startswith("~"):
+        path = os.path.expanduser(path)
+    ret = c.request("POST", "/3/PostFile",
+                    params={"filename": os.path.basename(path)},
+                    filename=path)
+    rawkey = ret["destination_frame"]
+    setup = c.request("POST", "/3/ParseSetup",
+                      data={"source_frames": [rawkey]})
+    dest = destination_frame or setup["destination_frame"]
+    job = c.request("POST", "/3/Parse",
+                    data={"source_frames": [rawkey],
+                          "destination_frame": dest, **parse_kw})
+    done = _poll_job(job)
+    return H2OFrame._by_id(done["dest"]["name"])
+
+
+def _model_id_of(model) -> str:
+    return model if isinstance(model, str) else model.model_id
+
+
+def save_model(model, path: str = "", force: bool = False,
+               filename: str | None = None) -> str:
+    """`h2o.save_model` (`h2o-py/h2o/h2o.py:1490`): the SERVER saves the
+    binary model to ``path`` — `GET /99/Models.bin/{id}?dir=...`."""
+    mid = _model_id_of(model)
+    filename = filename or mid
+    full = os.path.join(os.getcwd() if path == "" else path, filename)
+    return connection().request(
+        "GET", f"/99/Models.bin/{urllib.parse.quote(mid)}",
+        params={"dir": full, "force": str(bool(force)).lower()})["dir"]
+
+
+def load_model(path: str) -> "H2OModelClient":
+    """`h2o.load_model` (`h2o-py/h2o/h2o.py:1578`): the SERVER loads a
+    binary model from ``path`` — `POST /99/Models.bin`."""
+    res = connection().request("POST", "/99/Models.bin",
+                               data={"dir": path})
+    return get_model(res["models"][0]["model_id"]["name"])
+
+
+def download_model(model, path: str = "",
+                   filename: str | None = None) -> str:
+    """`h2o.download_model` (`h2o-py/h2o/h2o.py:1527`): stream the binary
+    model to the CLIENT machine — `GET /3/Models.fetch.bin/{id}`."""
+    mid = _model_id_of(model)
+    filename = filename or mid
+    full = os.path.join(os.getcwd() if path == "" else path, filename)
+    return connection().request(
+        "GET", f"/3/Models.fetch.bin/{urllib.parse.quote(mid)}",
+        save_to=full)
+
+
+def upload_model(path: str) -> "H2OModelClient":
+    """`h2o.upload_model` (`h2o-py/h2o/h2o.py:1563`): push a CLIENT-side
+    binary model to the server — PostFile.bin then Models.upload.bin."""
+    c = connection()
+    response = c.request("POST", "/3/PostFile.bin", filename=path)
+    frame_key = response["destination_frame"]
+    res = c.request("POST", "/99/Models.upload.bin",
+                    data={"dir": frame_key})
+    return get_model(res["models"][0]["model_id"]["name"])
 
 
 def ls() -> list[str]:

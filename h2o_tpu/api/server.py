@@ -195,8 +195,10 @@ def _make_handler(server: H2OServer):
                 data = payload["__html__"].encode()
                 ctype = "text/html; charset=utf-8"
             elif "__raw__" in payload:
-                # non-JSON bodies (DownloadDataset's CSV)
-                data = payload["__raw__"].encode()
+                # non-JSON bodies (DownloadDataset's CSV, Models.fetch.bin)
+                data = payload["__raw__"]
+                if isinstance(data, str):
+                    data = data.encode()
                 ctype = payload.get("__ctype__", "text/plain")
                 filename = payload.get("__filename__")
             else:
@@ -237,6 +239,16 @@ def _make_handler(server: H2OServer):
             parts = [p for p in parsed.path.split("/") if p]
             query = {k: v[0] if len(v) == 1 else v
                      for k, v in urllib.parse.parse_qs(parsed.query).items()}
+            if method == "POST" and parts and \
+                    parts[-1] in ("PostFile", "PostFile.bin"):
+                # binary body — must not go through the text _body() path
+                try:
+                    status, payload = _post_file(self, query)
+                except Exception as e:  # noqa: BLE001
+                    status, payload = _err(500, repr(e),
+                                           stacktrace=traceback.format_exc())
+                self._reply(status, payload)
+                return
             try:
                 status, payload = route(server, method, parts, query,
                                         self._body() if method in ("POST", "PUT")
@@ -297,6 +309,102 @@ async function refresh(){
 }
 refresh();setInterval(refresh,2000);
 </script></body></html>"""
+
+
+def _post_file(handler, query: dict) -> tuple[int, dict]:
+    """`POST /3/PostFile[.bin]` (`water/api/PostFileServlet.java:14`): spool
+    the pushed bytes server-side and register them in the DKV under
+    ``destination_frame``. A raw body streams to disk in 1MB chunks; a
+    multipart/form-data body (what h2o-py's requests layer sends) is parsed
+    with the stdlib email machinery."""
+    from ..backend.kvstore import STORE, make_key
+    from ..io import upload
+
+    n = int(handler.headers.get("Content-Length") or 0)
+    ctype = handler.headers.get("Content-Type", "")
+    dest = query.get("destination_frame") or make_key("upload")
+    fname = query.get("filename", "")
+    # stream the request body to disk first — a multi-GB push (raw OR
+    # multipart) must never materialize in server memory
+    path, total = upload.spool_stream(handler.rfile, n)
+    if ctype.startswith("multipart/"):
+        raw = path
+        try:
+            path, total, part_name = upload.extract_multipart(raw, ctype)
+        finally:
+            os.unlink(raw)
+        fname = fname or part_name
+    with open(path, "rb") as fh:
+        head = fh.read(8)
+    suffix = upload.guess_suffix(fname, dest, head=head)
+    if suffix != ".bin":
+        os.replace(path, path[:-len(".bin")] + suffix)
+        path = path[:-len(".bin")] + suffix
+    uf = upload.UploadedFile(dest, path, total,
+                             name=fname or os.path.basename(path))
+    STORE.put(dest, uf)
+    return 200, {"destination_frame": dest, "total_bytes": total}
+
+
+def _csv_head_preview(path: str, setup) -> tuple[list, list]:
+    """(column names, guessed types) from the first lines of a CSV — the
+    ParseSetup preview. Transparent for gz/zip heads via pyarrow streams."""
+    import csv as _csv
+    import io as _io
+
+    try:
+        if path.endswith(".gz"):
+            import pyarrow as pa
+
+            with pa.input_stream(path, compression="gzip") as st:
+                head = st.read(1 << 16)
+        elif path.endswith(".zip"):
+            import zipfile as _zipfile
+
+            with _zipfile.ZipFile(path) as zf:
+                with zf.open(zf.namelist()[0]) as st:
+                    head = st.read(1 << 16)
+        else:
+            with open(path, "rb") as fh:
+                head = fh.read(1 << 16)
+    except Exception:  # noqa: BLE001 — preview is best-effort
+        return None, None
+    lines = head.decode("utf-8", errors="replace").splitlines()
+    rows = list(_csv.reader(_io.StringIO("\n".join(lines[:50])),
+                            delimiter=setup.separator or ","))
+    rows = [r for r in rows if r]
+    if not rows:
+        return None, None
+    ncol = len(rows[0])
+    names = rows[0] if setup.header else [f"C{i+1}" for i in range(ncol)]
+    data = rows[1:] if setup.header else rows
+    types = []
+    for j in range(ncol):
+        vals = [r[j] for r in data[:30] if j < len(r) and r[j].strip()]
+        numeric = vals and all(_is_float(v) for v in vals)
+        types.append("Numeric" if numeric else "Enum")
+    return names, types
+
+
+def _is_float(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return tok.strip().upper() in ("NA", "NAN", "")
+
+
+def _resolve_upload(source: str) -> tuple[str, str]:
+    """A Parse source may be a filesystem path OR the key of a PostFile
+    upload; returns (path to read, display name whose extension drives
+    parse-type guessing)."""
+    from ..backend.kvstore import STORE
+    from ..io.upload import UploadedFile
+
+    obj = STORE.get(source)
+    if isinstance(obj, UploadedFile):
+        return obj.path, obj.name
+    return source, source
 
 
 def route(server: H2OServer, method: str, parts: list[str], query: dict,
@@ -370,8 +478,17 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if isinstance(paths, str):
             paths = [paths]
         paths = [s.strip('"') for s in paths]
-        setup = guess_setup(paths[0])
-        ext = paths[0].rsplit(".", 1)[-1].lower()
+        path0, name0 = _resolve_upload(paths[0])
+        setup = guess_setup(path0)
+        ext = name0.rsplit(".", 1)[-1].lower()
+        if setup.column_names is None and ext not in (
+                "parquet", "pq", "orc", "avro", "svm", "svmlight", "xlsx"):
+            # sample the head for names/types the way ParseSetupHandler's
+            # preview pass does (`water/parser/ParseSetup.java` guessSetup)
+            names, types = _csv_head_preview(path0, setup)
+            setup.column_names = names
+            if setup.column_types is None:
+                setup.column_types = types
         ptype = {"parquet": "PARQUET", "pq": "PARQUET", "orc": "ORC",
                  "svm": "SVMLight", "svmlight": "SVMLight"}.get(ext, "CSV")
         return 200, {
@@ -393,14 +510,21 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         paths = [s.strip('"') for s in paths]
         dest = p.get("destination_frame") or _dest_name(paths[0])
         job = Job(f"Parse {paths[0]}", work=1.0)
+        # sources may be PostFile upload keys; resolve to their spool files
+        srcs = [_resolve_upload(s)[0] for s in paths]
 
         def run():
-            fr = parse_file(paths[0], dest_key=dest)
+            fr = parse_file(srcs[0], dest_key=dest)
             if paths[1:]:  # multi-file import: rbind the remaining files
-                rest_frames = [parse_file(q) for q in paths[1:]]
+                rest_frames = [parse_file(q) for q in srcs[1:]]
                 fr = fr.concat_rows(*rest_frames)
                 fr.key = dest
                 STORE.put(dest, fr)
+            from ..io.upload import UploadedFile
+
+            for s in paths:  # delete_on_done: uploads are spent after parse
+                if isinstance(STORE.get(s), UploadedFile):
+                    STORE.remove(s)
             job.dest_key = fr.key
             return fr
 
@@ -500,6 +624,55 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                 os.makedirs(path, exist_ok=True)
                 path = os.path.join(path, f"{mid}.zip")
             return 200, {"dir": m.save_mojo(path)}
+        return 200, {"models": [schemas.model_schema(m)]}
+
+    # -- binary model persistence over the wire ------------------------------
+    # (`water/api/ModelsHandler` importModel/exportModel + fetchBinaryModel;
+    #  client verbs h2o.save_model/load_model/upload_model, h2o.py:1490-1602)
+    if head == "Models.bin":
+        from ..backend import persist
+
+        if method == "GET" and rest[1:]:
+            mid = urllib.parse.unquote(rest[1])
+            m = STORE.get(mid)
+            if m is None:
+                return _err(404, f"model {mid} not found")
+            path = p.get("dir", "")
+            if not path:
+                return _err(400, "Models.bin: dir is required")
+            if path.startswith("file://"):
+                path = path[len("file://"):]
+            if "://" not in path and not _truthy(p.get("force")) \
+                    and os.path.exists(path):
+                return _err(400, f"Models.bin: {path} exists (use force)")
+            return 200, {"dir": persist.save_model(m, path)}
+        if method == "POST":
+            path = p.get("dir", "")
+            if not path:
+                return _err(400, "Models.bin: dir is required")
+            m = persist.load_model(path)
+            return 200, {"models": [schemas.model_schema(m)]}
+        return _err(404, "Models.bin: GET /{id}?dir= or POST with dir")
+    if head == "Models.fetch.bin" and method == "GET" and rest[1:]:
+        from ..backend import persist
+
+        mid = urllib.parse.unquote(rest[1])
+        m = STORE.get(mid)
+        if m is None:
+            return _err(404, f"model {mid} not found")
+        return 200, {"__raw__": persist.model_bytes(m),
+                     "__ctype__": "application/octet-stream",
+                     "__filename__": mid}
+    if head == "Models.upload.bin" and method == "POST":
+        from ..backend import persist
+        from ..io.upload import UploadedFile
+
+        src = p.get("dir", "")
+        uf = STORE.get(src)
+        if not isinstance(uf, UploadedFile):
+            return _err(404, f"Models.upload.bin: no uploaded file '{src}'")
+        m = persist.load_model(uf.path)
+        STORE.remove(src)
         return 200, {"models": [schemas.model_schema(m)]}
 
     # -- predictions ---------------------------------------------------------
@@ -1136,6 +1309,12 @@ _ROUTES_DOC = [
         ("GET", "/3/About", "version info"),
         ("POST", "/3/Shutdown", "shut the cluster down"),
         ("GET", "/3/ImportFiles", "import files by path/URI"),
+        ("POST", "/3/PostFile", "upload raw bytes for parsing"),
+        ("POST", "/3/PostFile.bin", "upload a binary artifact"),
+        ("GET", "/99/Models.bin/{id}", "save a binary model server-side"),
+        ("POST", "/99/Models.bin", "load a binary model server-side"),
+        ("GET", "/3/Models.fetch.bin/{id}", "download a binary model"),
+        ("POST", "/99/Models.upload.bin", "import an uploaded binary model"),
         ("POST", "/3/ParseSetup", "guess parse setup"),
         ("POST", "/3/Parse", "parse files into a Frame"),
         ("GET", "/3/Frames", "list frames"),

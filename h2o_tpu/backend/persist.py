@@ -92,8 +92,12 @@ def _to_host(obj):
     return obj
 
 
-def save_model(model, path: str) -> str:
-    """Binary model export. Frames on the params are replaced by their keys."""
+def model_bytes(model) -> bytes:
+    """The binary model export as in-memory bytes (Models.fetch.bin)."""
+    return pickle.dumps(_model_payload(model))
+
+
+def _model_payload(model) -> dict:
     if hasattr(model, "_ensure_covers"):
         # Tree models compute SHAP node covers lazily from the attached
         # training frame; the export strips frames, so materialize covers now
@@ -118,9 +122,14 @@ def save_model(model, path: str) -> str:
         state["params"] = params
         state["__frame_keys__"] = reps
     state = _to_host(state)
-    payload = {"class_module": type(model).__module__,
-               "class_name": type(model).__name__,
-               "state": state}
+    return {"class_module": type(model).__module__,
+            "class_name": type(model).__name__,
+            "state": state}
+
+
+def save_model(model, path: str) -> str:
+    """Binary model export. Frames on the params are replaced by their keys."""
+    payload = _model_payload(model)
     if path.startswith("file://"):
         path = path[len("file://"):]
     if "://" in path:
@@ -143,9 +152,35 @@ def save_model(model, path: str) -> str:
     return path
 
 
+#: modules a model pickle may legitimately reference. The binary format is
+#: pickle, and Models.upload.bin puts it on the wire — an unrestricted
+#: pickle.load would hand any client arbitrary code execution via __reduce__
+#: (the reference's Iced deserializer is not exec-capable, so the wire route
+#: must not be either). Everything outside this list fails to load.
+_SAFE_BUILTINS = frozenset({
+    "object", "dict", "list", "tuple", "set", "frozenset", "bytearray",
+    "complex", "range", "slice", "bool", "int", "float", "str", "bytes",
+    "NoneType",
+})
+
+
+class _ModelUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        root = module.split(".", 1)[0]
+        if root in ("h2o_tpu", "numpy", "collections", "datetime"):
+            return super().find_class(module, name)
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"model file references {module}.{name}, which is outside the "
+            "model-state allowlist — refusing to load")
+
+
 def load_model(path: str):
     """Binary model import — registers the model back into the store.
-    Cloud URIs (s3://, gs://) localize through the Persist SPI first."""
+    Cloud URIs (s3://, gs://) localize through the Persist SPI first.
+    Deserialization is allowlisted (see _ModelUnpickler): a crafted file
+    cannot reach os/subprocess/eval through __reduce__."""
     import importlib
 
     if "://" in path:
@@ -153,7 +188,9 @@ def load_model(path: str):
 
         path = localize(path)
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        payload = _ModelUnpickler(f).load()
+    if payload["class_module"].split(".", 1)[0] != "h2o_tpu":
+        raise ValueError("model class must live in h2o_tpu")
     cls = getattr(importlib.import_module(payload["class_module"]),
                   payload["class_name"])
     model = object.__new__(cls)

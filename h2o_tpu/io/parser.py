@@ -60,8 +60,20 @@ def guess_setup(path: str, setup: ParseSetup | None = None) -> ParseSetup:
     if path.endswith((".parquet", ".pq", ".orc", ".avro", ".svm", ".svmlight",
                       ".xlsx")):
         return setup
-    with open(path, "rb") as f:
-        head = f.read(1 << 16).decode("utf-8", errors="replace")
+    if path.endswith(".gz"):
+        import gzip as _gzip
+
+        with _gzip.open(path, "rb") as f:
+            head = f.read(1 << 16).decode("utf-8", errors="replace")
+    elif path.endswith(".zip"):
+        import zipfile as _zipfile
+
+        with _zipfile.ZipFile(path) as zf:
+            with zf.open(zf.namelist()[0]) as f:
+                head = f.read(1 << 16).decode("utf-8", errors="replace")
+    else:
+        with open(path, "rb") as f:
+            head = f.read(1 << 16).decode("utf-8", errors="replace")
     lines = [ln for ln in head.splitlines() if ln.strip()][:50]
     if not lines:
         return setup
@@ -144,12 +156,22 @@ def _read_csv(path: str, setup: ParseSetup):
     parse_opts = pacsv.ParseOptions(delimiter=setup.separator or ",")
     conv_opts = pacsv.ConvertOptions(null_values=setup.na_strings,
                                      strings_can_be_null=True)
-    if path.endswith(".gz") or path.endswith(".zip"):
+    if path.endswith(".gz"):
         import pyarrow as pa
 
         return pacsv.read_csv(pa.input_stream(path, compression="gzip"),
                               read_options=read_opts, parse_options=parse_opts,
                               convert_options=conv_opts)
+    if path.endswith(".zip"):
+        # a zip archive's first member is the dataset (`water/parser/
+        # ZipUtil.java` takes the first entry the same way)
+        import zipfile as _zipfile
+
+        with _zipfile.ZipFile(path) as zf:
+            with zf.open(zf.namelist()[0]) as st:
+                return pacsv.read_csv(st, read_options=read_opts,
+                                      parse_options=parse_opts,
+                                      convert_options=conv_opts)
     return pacsv.read_csv(path, read_options=read_opts, parse_options=parse_opts,
                           convert_options=conv_opts)
 

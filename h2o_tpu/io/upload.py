@@ -1,0 +1,127 @@
+"""Client-pushed file staging — the `water/fvec/UploadFileVec` role.
+
+`POST /3/PostFile` (`water/api/PostFileServlet.java:14`) reads the request
+body — a raw octet stream or one multipart/form-data file part — and puts the
+bytes into the DKV under ``destination_frame`` so ParseSetup/Parse (or
+Models.upload.bin) can consume them by key. Here the bytes are spooled to a
+server-side temp file and the DKV holds this light handle; raw-body uploads
+stream to disk in chunks so a large push never materializes in memory.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from ..backend.kvstore import Keyed
+
+_SPOOL_DIR: str | None = None
+_CHUNK = 1 << 20
+
+
+def spool_dir() -> str:
+    global _SPOOL_DIR
+    if _SPOOL_DIR is None:
+        _SPOOL_DIR = tempfile.mkdtemp(prefix="h2o_tpu_uploads_")
+    return _SPOOL_DIR
+
+
+class UploadedFile(Keyed):
+    """Spooled upload: ``path`` holds the bytes, ``name`` the client-side
+    filename (its extension drives parse-type guessing)."""
+
+    def __init__(self, key: str, path: str, nbytes: int, name: str = ""):
+        super().__init__(key)
+        self.path = path
+        self.nbytes = nbytes
+        self.name = name or key
+
+    def remove_impl(self, store) -> None:
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
+def spool_stream(stream, length: int, suffix: str = ".bin") -> tuple[str, int]:
+    """Stream ``length`` bytes from ``stream`` to a spool file in chunks."""
+    fd, path = tempfile.mkstemp(dir=spool_dir(), suffix=suffix or ".bin")
+    total = 0
+    with os.fdopen(fd, "wb") as out:
+        while total < length:
+            chunk = stream.read(min(_CHUNK, length - total))
+            if not chunk:
+                break
+            out.write(chunk)
+            total += len(chunk)
+    return path, total
+
+
+#: magic-byte → extension, for uploads whose name carries no usable extension
+#: (the reference's ParseSetup sniffs content the same way, `water/parser/
+#: ZipUtil.java` + format guessers). Extension hints always win over magic.
+_MAGIC = [(b"\x1f\x8b", ".gz"), (b"PAR1", ".parquet"),
+          (b"Obj\x01", ".avro"), (b"PK\x03\x04", ".zip")]
+
+
+def guess_suffix(*name_hints: str, head: bytes = b"") -> str:
+    """Spool-file extension: first usable extension among the hints
+    (multipart filename, ?filename=, destination_frame), else content magic,
+    else .bin (parsed as CSV)."""
+    for hint in name_hints:
+        ext = os.path.splitext(hint or "")[1].lower()
+        if ext and ext != ".bin":
+            return ext
+    for magic, ext in _MAGIC:
+        if head.startswith(magic):
+            return ext
+    return ".bin"
+
+
+def _boundary_of(content_type: str) -> bytes:
+    for piece in content_type.split(";"):
+        k, _, v = piece.strip().partition("=")
+        if k.lower() == "boundary":
+            return v.strip().strip('"').encode()
+    raise ValueError("multipart content-type has no boundary")
+
+
+def extract_multipart(src_path: str, content_type: str,
+                      suffix: str = ".bin") -> tuple[str, int, str]:
+    """First file part of an on-disk multipart/form-data body →
+    (spool path, nbytes, filename). The body is scanned through mmap and the
+    payload copied out in chunks, so a 10GB upload never materializes in
+    memory (cgi is gone in 3.12+; email.message_from_bytes would buffer)."""
+    import mmap
+    import re as _re
+
+    delim = b"--" + _boundary_of(content_type)
+    if os.path.getsize(src_path) == 0:
+        raise ValueError("multipart body is empty")
+    with open(src_path, "rb") as fh, \
+            mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+        pos = mm.find(delim)
+        while pos != -1:
+            hdr_start = pos + len(delim)
+            if mm[hdr_start:hdr_start + 2] == b"--":
+                break  # closing boundary
+            hdr_end = mm.find(b"\r\n\r\n", hdr_start)
+            if hdr_end == -1:
+                break
+            headers = bytes(mm[hdr_start:hdr_end]).decode(
+                "utf-8", errors="replace")
+            m = _re.search(r'filename="([^"]*)"', headers)
+            fname = m.group(1) if m else ""
+            payload_start = hdr_end + 4
+            nxt = mm.find(b"\r\n" + delim, payload_start)
+            payload_end = nxt if nxt != -1 else len(mm)
+            if m or _re.search(r'name="[^"]*"', headers):
+                fd, out_path = tempfile.mkstemp(dir=spool_dir(),
+                                                suffix=suffix)
+                total = payload_end - payload_start
+                with os.fdopen(fd, "wb") as out:
+                    for off in range(payload_start, payload_end, _CHUNK):
+                        out.write(mm[off:min(off + _CHUNK, payload_end)])
+                return out_path, total, fname
+            pos = -1 if nxt == -1 else nxt + 2
+    raise ValueError("multipart body contains no file part")
