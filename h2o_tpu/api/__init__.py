@@ -9,7 +9,7 @@ from .client import (H2OConnection, H2OConnectionError, H2OEstimator,
                      H2OFrame, H2OGroupBy, H2OModelClient, assign,
                      cluster_status, connect, connection, deep_copy,
                      export_file, get_frame, get_model, get_timezone,
-                     download_model, import_file, init, interaction,
+                     as_list, download_model, import_file, init, interaction,
                      list_timezones, load_model, ls, rapids, remove,
                      save_model, set_timezone, shutdown, upload_file,
                      upload_frame, upload_model)

@@ -39,6 +39,8 @@ class H2OConnection:
                  cacert: str | None = None):
         self.url = url.rstrip("/")
         self.session_id: str | None = None
+        self.requests_count = 0  # h2o-py connection counter (lazy-op tests)
+        self.connected = True
         self._auth = None
         self._ssl_ctx = None
         if url.startswith("https"):
@@ -81,6 +83,7 @@ class H2OConnection:
             headers["Content-Type"] = "application/json"
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
+        self.requests_count += 1
         try:
             return self._send(req, raw, save_to)
         finally:
@@ -211,21 +214,87 @@ def import_file(path: str, destination_frame: str | None = None) -> "H2OFrame":
     return H2OFrame._by_id(done["dest"]["name"])
 
 
-def upload_frame(python_obj, destination_frame: str | None = None) -> "H2OFrame":
-    """Build a frame from a dict/pandas object via a temp CSV round-trip —
-    the h2o.H2OFrame(python_obj) upload path."""
+def _python_obj_to_pandas(obj, column_names=None, header=0):
+    """h2o-py `H2OFrame(python_obj)` conversion semantics (`h2o-py/h2o/
+    frame.py` _upload_python_object): a flat list/tuple is ONE column; a
+    list/tuple of lists/tuples is a list of ROWS (jagged rows NA-pad to the
+    widest); a dict maps names -> columns (jagged columns NA-pad); numpy
+    1-D is one column, 2-D is rows; pandas passes through. Unnamed columns
+    become C1..Cn."""
+    import numpy as _np
+    import pandas as pd
+
+    if isinstance(obj, pd.DataFrame):
+        df = obj.copy()
+    elif isinstance(obj, dict):
+        cols = {k: list(v) if hasattr(v, "__iter__")
+                and not isinstance(v, str) else [v]
+                for k, v in obj.items()}
+        depth = max(len(v) for v in cols.values())
+        cols = {k: v + [_np.nan] * (depth - len(v))
+                for k, v in cols.items()}
+        df = pd.DataFrame(cols)
+    else:
+        if isinstance(obj, _np.ndarray):
+            rows = obj.tolist()
+        elif isinstance(obj, (list, tuple)):
+            rows = list(obj)
+        else:
+            rows = [obj]  # single scalar
+        if rows and not any(isinstance(r, (list, tuple)) for r in rows):
+            rows = [[r] for r in rows]  # flat sequence = one column
+        if header == 1 and rows and column_names is None:
+            # h2o-py header=1: the first row IS the column names
+            column_names = [str(c) for c in rows[0]]
+            rows = rows[1:]
+        width = max((len(r) for r in rows), default=0)
+        rows = [list(r) + [_np.nan] * (width - len(r)) for r in rows]
+        names = list(column_names) if column_names else \
+            [f"C{i+1}" for i in range(width)]
+        df = pd.DataFrame(rows, columns=names)
+    if column_names:
+        df.columns = list(column_names)
+    elif not isinstance(obj, dict):
+        # positional pandas columns (0, 1, ...) get h2o's C1..Cn names
+        df.columns = [f"C{i+1}" if isinstance(c, int) else str(c)
+                      for i, c in enumerate(df.columns)]
+    for c in df.columns:  # h2o uploads booleans as 0/1 numerics
+        if df[c].dtype == bool:
+            df[c] = df[c].astype(int)
+    return df
+
+
+def upload_frame(python_obj, destination_frame: str | None = None,
+                 column_names=None, column_types=None,
+                 na_strings=None, header: int = 0) -> "H2OFrame":
+    """Build a frame from a python object via a CSV push through
+    `POST /3/PostFile` — the h2o.H2OFrame(python_obj) upload path."""
     import os
     import tempfile
 
-    import pandas as pd
-
-    df = python_obj if isinstance(python_obj, pd.DataFrame) \
-        else pd.DataFrame(python_obj)
+    df = _python_obj_to_pandas(python_obj, column_names=column_names,
+                               header=header)
     fd, tmp = tempfile.mkstemp(suffix=".csv")
     os.close(fd)
     try:
-        df.to_csv(tmp, index=False)
-        return import_file(tmp, destination_frame=destination_frame)
+        # QUOTE_NONNUMERIC like h2o-py's uploader: strings (incl. empty
+        # ones) ride quoted so a lone "" row isn't dropped as a blank line;
+        # the parser maps quoted "" to NA for numerics but keeps it as the
+        # empty string for string/enum columns unless na_strings says so
+        import csv as _csv
+
+        df.to_csv(tmp, index=False, quoting=_csv.QUOTE_NONNUMERIC)
+        parse_kw = {"column_names": list(df.columns), "check_header": 1}
+        if column_types is not None:
+            if isinstance(column_types, dict):
+                parse_kw["column_types"] = {str(k): v for k, v
+                                            in column_types.items()}
+            else:
+                parse_kw["column_types"] = list(column_types)
+        if na_strings is not None:
+            parse_kw["na_strings"] = list(na_strings)
+        return upload_file(tmp, destination_frame=destination_frame,
+                           **parse_kw)
     finally:
         os.unlink(tmp)
 
@@ -313,12 +382,25 @@ def get_model(model_id: str) -> "H2OModelClient":
     return H2OModelClient(model_id, j["models"][0])
 
 
-def remove(key: str):
+def remove(key):
+    """`h2o.remove`: accepts a key string, an H2OFrame/model handle, or a
+    list of either (h2o-py signature)."""
+    if isinstance(key, (list, tuple)):
+        for k in key:
+            remove(k)
+        return
+    if not isinstance(key, str):
+        key = getattr(key, "frame_id", None) or getattr(key, "model_id", key)
     c = connection()
     try:
         c.request("DELETE", f"/3/Frames/{urllib.parse.quote(key)}")
     except H2OConnectionError:
         c.request("DELETE", f"/3/Models/{urllib.parse.quote(key)}")
+
+
+def as_list(frame: "H2OFrame", use_pandas: bool = True, header: bool = True):
+    """`h2o.as_list` (`h2o-py/h2o/h2o.py`)."""
+    return frame.as_data_frame(use_pandas=use_pandas, header=header)
 
 
 def remove_all():
@@ -398,16 +480,30 @@ _TMP_COUNTER = _itertools.count(1)  # atomic under the GIL (worker threads)
 
 
 class H2OFrame:
-    def __init__(self, python_obj=None, destination_frame: str | None = None):
+    def __init__(self, python_obj=None, destination_frame: str | None = None,
+                 header: int = 0, column_names=None, column_types=None,
+                 na_strings=None):
         self._pending: str | None = None  # un-materialized rapids expression
         self._inlined = False  # pending expr already embedded somewhere once
         if python_obj is not None:
-            other = upload_frame(python_obj, destination_frame)
+            other = upload_frame(python_obj, destination_frame,
+                                 column_names=column_names,
+                                 column_types=column_types,
+                                 na_strings=na_strings, header=header)
             self._id = other.frame_id
             self._schema = other._schema
         else:
             self._id = None
             self._schema = None
+
+    @classmethod
+    def from_python(cls, python_obj, destination_frame=None, header=0,
+                    separator=",", column_names=None, column_types=None,
+                    na_strings=None) -> "H2OFrame":
+        """`H2OFrame.from_python` (`h2o-py/h2o/frame.py:155`)."""
+        return cls(python_obj, destination_frame=destination_frame,
+                   header=header, column_names=column_names,
+                   column_types=column_types, na_strings=na_strings)
 
     @classmethod
     def _by_id(cls, frame_id: str) -> "H2OFrame":
@@ -465,22 +561,32 @@ class H2OFrame:
     def refresh(self):
         self._schema = None
 
+    _meta: dict | None = None  # local dims/names/types for lazy frames
+
     @property
     def nrow(self) -> int:
+        if self._id is None and self._meta:
+            return self._meta["rows"]
         return self._summary()["rows"]
 
     @property
     def ncol(self) -> int:
+        if self._id is None and self._meta:
+            return self._meta["cols"]
         return self._summary()["num_columns"]
 
     @property
     def columns(self) -> list[str]:
+        if self._id is None and self._meta:
+            return list(self._meta["names"])
         return [c["label"] for c in self._summary()["columns"]]
 
     names = columns
 
     @property
     def types(self) -> dict:
+        if self._id is None and self._meta:
+            return dict(self._meta["types"])
         return {c["label"]: c["type"] for c in self._summary()["columns"]}
 
     def __len__(self):
@@ -500,18 +606,71 @@ class H2OFrame:
     def _quoted(self) -> str:
         return self.frame_id
 
-    def __getitem__(self, sel):
+    def _slice_bounds(self, sl: slice, n: int) -> tuple[int, int]:
+        start = 0 if sl.start is None else sl.start
+        stop = n if sl.stop is None else min(sl.stop, n)
+        if sl.step not in (None, 1):
+            raise ValueError("h2o frame slices are contiguous (step 1)")
+        return start, stop
+
+    def _col_expr(self, ref: str, sel) -> str:
         if isinstance(sel, str):
-            return self._fr(f"(cols {self._ref()} '{sel}')")
+            return f"(cols {ref} '{sel}')"
         if isinstance(sel, int):
-            return self._fr(f"(cols {self._ref()} {sel})")
+            return f"(cols {ref} {sel})"
+        if isinstance(sel, slice):
+            a, b = self._slice_bounds(sel, self.ncol)
+            return f"(cols {ref} [{a}:{b}])"
         if isinstance(sel, list):
             inner = " ".join(f"'{s}'" if isinstance(s, str) else str(s)
                              for s in sel)
-            return self._fr(f"(cols {self._ref()} [{inner}])")
+            return f"(cols {ref} [{inner}])"
+        raise TypeError(f"bad column selector {sel!r}")
+
+    def __getitem__(self, sel):
+        if isinstance(sel, tuple) and len(sel) == 2:
+            rows, cols = sel
+            scalar = isinstance(rows, int) and isinstance(cols, (int, str))
+            # column select first (never changes row identity), rows second
+            ref = self._ref()
+            expr = ref if (isinstance(cols, slice) and cols == slice(None)) \
+                else self._col_expr(ref, cols)
+            if isinstance(rows, int):
+                expr = f"(rows {expr} [{rows}:{rows + 1}])"
+            elif isinstance(rows, slice):
+                if rows != slice(None):
+                    a, b = self._slice_bounds(rows, self.nrow)
+                    expr = f"(rows {expr} [{a}:{b}])"
+            elif isinstance(rows, list):
+                inner = " ".join(str(int(r)) for r in rows)
+                expr = f"(rows {expr} [{inner}])"
+            elif isinstance(rows, H2OFrame):
+                expr = f"(rows {expr} (cols {rows._ref()} 0))"
+            else:
+                raise TypeError(f"bad row selector {rows!r}")
+            out = self._fr(expr)
+            return out._scalar() if scalar else out
+        if isinstance(sel, (str, int, slice, list)):
+            return self._fr(self._col_expr(self._ref(), sel))
         if isinstance(sel, H2OFrame):  # boolean mask frame
             return self._fr(f"(rows {self._ref()} (cols {sel._ref()} 0))")
         raise TypeError(f"bad selector {sel!r}")
+
+    def _scalar(self):
+        """The single cell of a 1x1 frame as a python value (the h2o-py
+        `flatten()` read used by `fr[r, c]`)."""
+        j = connection().request(
+            "GET", f"/3/Frames/{urllib.parse.quote(self.frame_id)}",
+            params={"row_count": 1})["frames"][0]
+        c = j["columns"][0]
+        if c.get("string_data") is not None:
+            return c["string_data"][0]
+        v = (c["data"] or [None])[0]
+        if v is None:
+            return float("nan")
+        if c["domain"]:
+            return c["domain"][int(v)]
+        return v
 
     @staticmethod
     def _src_expr(value) -> str:
@@ -602,6 +761,36 @@ class H2OFrame:
     def __or__(self, o):
         return self._binop("|", o)
 
+    def __pow__(self, o):
+        return self._binop("^", o)
+
+    def __rpow__(self, o):
+        return self._binop("^", o, reverse=True)
+
+    def __mod__(self, o):
+        return self._binop("%", o)
+
+    def __rmod__(self, o):
+        return self._binop("%", o, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binop("intDiv", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("/", o, reverse=True)
+
+    def __rsub__(self, o):
+        return self._binop("-", o, reverse=True)
+
+    def __abs__(self):
+        return self._unop("abs")
+
+    def __neg__(self):
+        return self._binop("*", -1)
+
+    def __invert__(self):
+        return self._unop("not")
+
     # unary math surface (h2o-py H2OFrame.cos/log/... — each compiles to
     # the matching rapids prim lazily)
     def _unop(self, op) -> "H2OFrame":
@@ -648,6 +837,52 @@ class H2OFrame:
 
     def sd(self):
         return self._exec(f"(sd {self._ref()} true)")
+
+    def prod(self, na_rm=True):
+        return self._exec(f"(prod {self._ref()} "
+                          f"{'true' if na_rm else 'false'})")
+
+    def all(self) -> bool:
+        return bool(self._exec(f"(all {self._ref()} true)"))
+
+    def any(self) -> bool:
+        return bool(self._exec(f"(any {self._ref()} true)"))
+
+    def cumsum(self, axis=0): return self._unop("cumsum")    # noqa: E704
+    def cumprod(self, axis=0): return self._unop("cumprod")  # noqa: E704
+    def cummin(self, axis=0): return self._unop("cummin")    # noqa: E704
+    def cummax(self, axis=0): return self._unop("cummax")    # noqa: E704
+
+    @property
+    def dim(self) -> list:
+        return [self.nrow, self.ncol]
+
+    @property
+    def shape(self) -> tuple:
+        return (self.nrow, self.ncol)
+
+    def __iter__(self):
+        return (self[i] for i in range(self.ncol))
+
+    def show(self, use_pandas=False):
+        print(self)
+
+    def summary(self, return_data=False):
+        """Per-column summary print (`H2OFrame.summary`)."""
+        if return_data:
+            return self._summary()
+        self.describe()
+
+    def insert_missing_values(self, fraction=0.1, seed=None) -> "H2OFrame":
+        """In-place NA injection — `POST /3/MissingInserter` on this frame's
+        key (h2o-py's method of the same name mutates server-side too)."""
+        insert_missing_values(self, fraction=fraction,
+                              seed=-1 if seed is None else seed)
+        self.refresh()
+        return self
+
+    def flatten(self):
+        return self._scalar()
 
     def asfactor(self) -> "H2OFrame":
         return self._fr(f"(as.factor {self._ref()})")
@@ -791,7 +1026,13 @@ class H2OFrame:
         return bool(self._exec(f"(any.factor {self.frame_id})"))
 
     def isna(self) -> "H2OFrame":
-        return self._exec(f"(is.na {self.frame_id})")
+        out = self._fr(f"(is.na {self._ref()})")
+        # h2o-py's ExprNode knows the result's dims/names/types locally —
+        # reading them must not force evaluation (pyunit_isna pins this)
+        out._meta = {"rows": self.nrow, "cols": self.ncol,
+                     "names": [f"isNA({n})" for n in self.columns],
+                     "types": {f"isNA({n})": "int" for n in self.columns}}
+        return out
 
     def columns_by_type(self, coltype="numeric"):
         return self._exec(f"(columnsByType {self.frame_id} '{coltype}')")
@@ -826,6 +1067,51 @@ class H2OFrame:
 
     def entropy(self) -> "H2OFrame":
         return self._exec(f"(entropy {self.frame_id})")
+
+    @property
+    def nrows(self) -> int:
+        return self.nrow
+
+    @property
+    def ncols(self) -> int:
+        return self.ncol
+
+    def merge(self, other: "H2OFrame", all_x: bool = False,
+              all_y: bool = False, by_x=None, by_y=None,
+              method: str = "auto") -> "H2OFrame":
+        """`H2OFrame.merge` — `(merge x y all_x all_y [bx] [by])`
+        (AstMerge); by_x/by_y are column names or indices."""
+        ax = "TRUE" if all_x else "FALSE"
+        ay = "TRUE" if all_y else "FALSE"
+
+        def idxs(fr, by):
+            if by is None:
+                return "[]"
+            cols = by if isinstance(by, list) else [by]
+            return "[" + " ".join(
+                str(fr.columns.index(c) if isinstance(c, str) else int(c))
+                for c in cols) + "]"
+
+        return self._fr(f"(merge {self._ref()} {other._ref()} {ax} {ay} "
+                        f"{idxs(self, by_x)} {idxs(other, by_y)} "
+                        f"'{method}')")
+
+    def sort(self, by, ascending=None) -> "H2OFrame":
+        cols = by if isinstance(by, list) else [by]
+        inner = " ".join(f"'{c}'" if isinstance(c, str) else str(c)
+                         for c in cols)
+        if ascending is None:
+            return self._fr(f"(sort {self._ref()} [{inner}])")
+        asc = ascending if isinstance(ascending, list) else [ascending]
+        flags = " ".join("1" if a else "0" for a in asc)
+        return self._fr(f"(sort {self._ref()} [{inner}] [{flags}])")
+
+    def strdistance(self, y: "H2OFrame", measure: str = "lv",
+                    compare_empty: bool = True) -> "H2OFrame":
+        """`H2OFrame.strdistance` — `(strDistance x y measure ce)`."""
+        ce = "true" if compare_empty else "false"
+        return self._fr(f"(strDistance {self._ref()} {y._ref()} "
+                        f"'{measure}' {ce})")
 
     def strsplit(self, pattern: str) -> "H2OFrame":
         return self._exec(f"(strsplit {self.frame_id} '{pattern}')")
@@ -884,7 +1170,11 @@ class H2OFrame:
         return self
 
     # -- materialization -----------------------------------------------------
-    def as_data_frame(self, use_pandas: bool = True, rows: int | None = None):
+    def as_data_frame(self, use_pandas: bool = True, header: bool = True,
+                      rows: int | None = None):
+        """pandas DataFrame, or with ``use_pandas=False`` the h2o-py wire
+        shape: a list of ROWS of strings, ``header`` controlling whether the
+        first row is the column names (`h2o-py/h2o/frame.py as_data_frame`)."""
         j = connection().request(
             "GET", f"/3/Frames/{urllib.parse.quote(self.frame_id)}",
             params={"row_count": rows if rows is not None else self.nrow}
@@ -903,7 +1193,17 @@ class H2OFrame:
             import pandas as pd
 
             return pd.DataFrame(cols)
-        return cols
+
+        def cell(v):
+            if v is None or (isinstance(v, float) and v != v):
+                return ""
+            if isinstance(v, float) and v == int(v):
+                return str(int(v))
+            return str(v)
+
+        names = list(cols)
+        out = [[cell(v) for v in row] for row in zip(*cols.values())]
+        return ([names] + out) if header else out
 
     def describe(self, chunk_summary=False):
         """Print the per-column summary table (`H2OFrame.describe`)."""
@@ -1031,6 +1331,27 @@ def export_file(frame: H2OFrame, path: str, force: bool = False) -> None:
         params={"path": path, "force": "true" if force else "false"})
 
 
+class _ClientMetrics(dict):
+    """Metrics payload with h2o-py `ModelMetrics` getter methods, still a
+    plain dict of the ModelMetricsBaseV3 wire fields."""
+
+    def auc(self): return self.get("AUC")                    # noqa: E704
+    def aucpr(self): return self.get("pr_auc")               # noqa: E704
+    def mse(self): return self.get("MSE")                    # noqa: E704
+    def rmse(self): return self.get("RMSE")                  # noqa: E704
+    def mae(self): return self.get("mae")                    # noqa: E704
+    def logloss(self): return self.get("logloss")            # noqa: E704
+    def gini(self): return self.get("Gini")                  # noqa: E704
+    def r2(self): return self.get("r2")                      # noqa: E704
+    def null_deviance(self): return self.get("null_deviance")        # noqa: E704,E501
+    def residual_deviance(self): return self.get("residual_deviance")  # noqa: E704,E501
+    def mean_residual_deviance(self):                        # noqa: E704
+        return self.get("mean_residual_deviance")
+
+    def show(self):
+        print(self)
+
+
 class H2OModelClient:
     """Client handle on a trained server-side model."""
 
@@ -1097,14 +1418,34 @@ class H2OModelClient:
     def _metrics(self, kind="training_metrics") -> dict:
         return (self._schema or {}).get("output", {}).get(kind) or {}
 
-    def model_performance(self, test_data: "H2OFrame") -> dict:
+    def model_performance(self, test_data: "H2OFrame | None" = None,
+                          train=False, valid=False, xval=False) -> dict:
         """Recompute metrics on a frame — `GET /3/ModelMetrics/models/{m}/
-        frames/{f}` (ModelMetricsHandler score-and-fetch)."""
+        frames/{f}` (ModelMetricsHandler score-and-fetch); without a frame,
+        the stored training/validation/xval metrics (h2o-py signature)."""
+        if test_data is None:
+            kind = ("cross_validation_metrics" if xval else
+                    "validation_metrics" if valid else "training_metrics")
+            return _ClientMetrics(self._metrics(kind))
         j = connection().request(
             "GET",
             f"/3/ModelMetrics/models/{urllib.parse.quote(self.model_id)}"
             f"/frames/{urllib.parse.quote(test_data.frame_id)}")
-        return j["model_metrics"][0]
+        return _ClientMetrics(j["model_metrics"][0])
+
+    @property
+    def _id(self) -> str:
+        return self.model_id
+
+    @property
+    def _model_json(self) -> dict:
+        return self._schema
+
+    def show(self):
+        print(self)
+
+    def summary(self):
+        return ((self._schema or {}).get("output") or {}).get("model_summary")
 
     def auc(self, train=True, valid=False, xval=False):
         kind = ("cross_validation_metrics" if xval else
@@ -1196,10 +1537,14 @@ def _train_body(params: dict, x, y, training_frame, validation_frame,
         body["training_frame"] = training_frame.frame_id
     if validation_frame is not None:
         body["validation_frame"] = validation_frame.frame_id
+    frame_for_names = training_frame if training_frame is not None \
+        else validation_frame
     if y is not None:
+        if isinstance(y, int):  # h2o-py accepts a column index for y
+            y = frame_for_names.columns[y]
         body["response_column"] = y
     if x is not None:
-        all_cols = training_frame.columns
+        all_cols = frame_for_names.columns
         keep = {all_cols[c] if isinstance(c, int) else c for c in x}
         body["ignored_columns"] = [c for c in all_cols
                                    if c not in keep and c != y]

@@ -151,6 +151,9 @@ def model_schema(model) -> dict:
             "training_metrics": metrics_schema(o.training_metrics),
             "validation_metrics": metrics_schema(o.validation_metrics),
             "cross_validation_metrics": metrics_schema(o.cross_validation_metrics),
+            "cross_validation_models": (
+                [key_schema(m.key, "Key<Model>") for m in o.cv_models]
+                if getattr(o, "cv_models", None) else None),
             "variable_importances": _clean(o.variable_importances),
             "scoring_history_length": len(o.scoring_history),
             "run_time_ms": o.run_time_ms,

@@ -346,6 +346,51 @@ def _post_file(handler, query: dict) -> tuple[int, dict]:
     return 200, {"destination_frame": dest, "total_bytes": total}
 
 
+#: ParseV3 wire type names → Vec type strings (`water/parser/ParseSetup`)
+_PARSE_TYPES = {"numeric": "real", "real": "real", "double": "real",
+                "float": "real", "int": "int", "enum": "enum",
+                "categorical": "enum", "factor": "enum", "string": "string",
+                "time": "time", "uuid": "string", "unknown": "real"}
+
+
+def _parse_setup_of(p: dict):
+    """Build a ParseSetup from a ParseV3 request body (`water/api/
+    ParseHandler` applies the client's overrides the same way); returns None
+    when the body carries no overrides so guessing stays in charge."""
+    from ..io.parser import ParseSetup
+
+    names = p.get("column_names") or None
+    if isinstance(names, str):
+        names = json.loads(names) if names.startswith("[") else [names]
+    ctypes = p.get("column_types") or None
+    if isinstance(ctypes, str):
+        ctypes = json.loads(ctypes) if ctypes.startswith("[") else [ctypes]
+    nas = p.get("na_strings") or None
+    if isinstance(nas, str):
+        nas = json.loads(nas) if nas.startswith("[") else [nas]
+    check_header = p.get("check_header")
+    sep = p.get("separator")
+    if not any(v is not None for v in (names, ctypes, nas, check_header,
+                                       sep)):
+        return None
+    tmap = None
+    if isinstance(ctypes, dict):
+        tmap = {k: _PARSE_TYPES.get(str(v).lower(), "real")
+                for k, v in ctypes.items()}
+    elif ctypes is not None and names:
+        tmap = {n: _PARSE_TYPES.get(str(t).lower(), "real")
+                for n, t in zip(names, ctypes) if t is not None}
+    header = None
+    if check_header is not None:
+        check_header = int(check_header)
+        header = True if check_header == 1 else \
+            False if check_header == -1 else None
+    if isinstance(sep, int):
+        sep = chr(sep)
+    return ParseSetup(separator=sep, header=header, column_names=names,
+                      column_types=tmap, na_strings=nas)
+
+
 def _csv_head_preview(path: str, setup) -> tuple[list, list]:
     """(column names, guessed types) from the first lines of a CSV — the
     ParseSetup preview. Transparent for gz/zip heads via pyarrow streams."""
@@ -512,11 +557,13 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         job = Job(f"Parse {paths[0]}", work=1.0)
         # sources may be PostFile upload keys; resolve to their spool files
         srcs = [_resolve_upload(s)[0] for s in paths]
+        setup = _parse_setup_of(p)
 
         def run():
-            fr = parse_file(srcs[0], dest_key=dest)
+            fr = parse_file(srcs[0], setup=setup, dest_key=dest)
             if paths[1:]:  # multi-file import: rbind the remaining files
-                rest_frames = [parse_file(q) for q in srcs[1:]]
+                # the client's ParseV3 overrides apply to EVERY source file
+                rest_frames = [parse_file(q, setup=setup) for q in srcs[1:]]
                 fr = fr.concat_rows(*rest_frames)
                 fr.key = dest
                 STORE.put(dest, fr)
@@ -875,8 +922,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         fr2 = STORE.get(fid)
         if not isinstance(fr2, Frame):
             return _err(404, f"frame {fid} not found")
-        frac = float(p.get("fraction", 0.1) or 0.1)
-        seed = int(p.get("seed", -1) or -1)
+        frac = float(p["fraction"]) if p.get("fraction") not in (None, "") \
+            else 0.1
+        seed = int(p["seed"]) if p.get("seed") not in (None, "") else -1
         rng = np.random.default_rng(None if seed == -1 else seed)
         from ..frame.vec import Vec as _Vec
 

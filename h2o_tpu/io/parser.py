@@ -51,6 +51,10 @@ class ParseSetup:
         self.column_names = list(column_names) if column_names else None
         self.column_types = dict(column_types or {})
         self.na_strings = list(na_strings if na_strings is not None else DEFAULT_NA_STRINGS)
+        #: whether the caller SPECIFIED na_strings: string/enum columns only
+        #: nullify on an explicit spelling list (numerics always use the
+        #: default spellings) — `water/parser/CsvParser` NA asymmetry
+        self.na_strings_user = na_strings is not None
         self.skipped_columns = list(skipped_columns or [])
 
 
@@ -146,6 +150,7 @@ def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
 
 
 def _read_csv(path: str, setup: ParseSetup):
+    import pyarrow as pa
     import pyarrow.csv as pacsv
 
     read_opts = pacsv.ReadOptions(
@@ -153,9 +158,35 @@ def _read_csv(path: str, setup: ParseSetup):
     )
     if setup.column_names:
         read_opts.column_names = setup.column_names
+        if setup.header:
+            # pyarrow treats the first row as data once column_names are
+            # given; the file's own header row must be skipped explicitly
+            read_opts.skip_rows = 1
     parse_opts = pacsv.ParseOptions(delimiter=setup.separator or ",")
-    conv_opts = pacsv.ConvertOptions(null_values=setup.na_strings,
-                                     strings_can_be_null=True)
+    # string/enum columns only go NA on an EXPLICIT na_strings match; a bare
+    # empty field stays the empty string (numeric empties are NA regardless)
+    # — `water/parser/CsvParser` string-vs-numeric NA asymmetry
+    nas = list(setup.na_strings)
+    if "" not in nas:
+        # numeric empties must stay NA (pyarrow otherwise demotes the whole
+        # column to string on the first empty cell). Documented divergence:
+        # with an EXPLICIT na_strings list this also nullifies empty
+        # string-column cells, because the null-spelling set is global in
+        # pyarrow — "" is implicitly part of any user na_strings list.
+        nas.append("")
+    conv_opts = pacsv.ConvertOptions(
+        null_values=nas,
+        strings_can_be_null=getattr(setup, "na_strings_user", False))
+    if setup.column_types:
+        # pin arrow types for user-typed columns: an all-empty quoted string
+        # column otherwise infers as `null` and every value turns NA
+        atypes = {}
+        for name, want in setup.column_types.items():
+            if want in (T_STR, T_CAT):
+                atypes[name] = pa.string()
+            elif want in (T_NUM, T_INT):
+                atypes[name] = pa.float64()
+        conv_opts.column_types = atypes
     if path.endswith(".gz"):
         import pyarrow as pa
 
@@ -195,6 +226,13 @@ def _table_to_frame(table, setup: ParseSetup, mesh=None, dest_key=None) -> Frame
         col = table.column(name).combine_chunks()
         want = setup.column_types.get(name)
         t = col.type
+        if pa.types.is_null(t) and want in (None, T_NUM, T_INT):
+            # a 0-row or all-NA column with no type hint is numeric (the
+            # reference's all-NA columns default to numeric, not string)
+            vecs.append(Vec.from_numpy(
+                np.full(len(col), np.nan, np.float64), type=T_NUM, mesh=mesh))
+            names.append(name)
+            continue
         if want == T_STR:
             vecs.append(Vec(None, len(col), type=T_STR,
                             host_data=np.asarray(col.to_pylist(), dtype=object)))
@@ -215,7 +253,19 @@ def _table_to_frame(table, setup: ParseSetup, mesh=None, dest_key=None) -> Frame
             if want == T_NUM:
                 vecs.append(Vec.from_numpy(arr.astype(np.float64), type=T_NUM, mesh=mesh))
             else:
-                vecs.append(Vec.from_numpy(arr, mesh=mesh))
+                # h2o reports a column as "int" when every parsed value is
+                # integral (NAs aside) even if nulls forced a float dtype
+                # (`water/parser/ParseSetup` type promotion)
+                t_out = want
+                if t_out is None:
+                    if np.issubdtype(arr.dtype, np.integer):
+                        t_out = T_INT
+                    elif np.issubdtype(arr.dtype, np.floating):
+                        finite = arr[np.isfinite(arr)]
+                        t_out = T_INT if finite.size and \
+                            np.all(finite == np.floor(finite)) else T_NUM
+                vecs.append(Vec.from_numpy(arr, type=t_out or T_NUM,
+                                           mesh=mesh))
         names.append(name)
     fr = Frame(names, vecs, key=dest_key)
     STORE.put_keyed(fr)

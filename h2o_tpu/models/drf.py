@@ -43,8 +43,8 @@ class DRF(GBM):
     algo_name = "drf"
     drf_mode = True
 
-    def _tree_config(self, K):
-        cfg = super()._tree_config(K)
+    def _tree_config(self, K, nbins=None):
+        cfg = super()._tree_config(K, nbins=nbins)
         p = self.params
         F = len(self.feature_names())
         mtries = getattr(p, "mtries", -1)

@@ -271,8 +271,9 @@ def _score_fn(model: GBMModel, X):
 class GBM(ModelBuilder):
     algo_name = "gbm"
     drf_mode = False
+    _constant_response_check = True  # `hex/tree/SharedTree.init` check
 
-    def _tree_config(self, K) -> TreeConfig:
+    def _tree_config(self, K, nbins: int | None = None) -> TreeConfig:
         p = self.params
         if getattr(p, "monotone_constraints", None) and K > 1:
             raise ValueError("monotone_constraints are not supported for "
@@ -280,7 +281,8 @@ class GBM(ModelBuilder):
         return TreeConfig(
             use_monotone=bool(getattr(p, "monotone_constraints", None)),
             use_interaction=bool(getattr(p, "interaction_constraints", None)),
-            ntrees=p.ntrees, max_depth=p.max_depth, nbins=p.nbins,
+            ntrees=p.ntrees, max_depth=p.max_depth,
+            nbins=p.nbins if nbins is None else nbins,
             min_rows=p.min_rows, learn_rate=p.learn_rate,
             reg_lambda=getattr(p, "reg_lambda", 0.0),
             min_split_improvement=p.min_split_improvement,
@@ -363,7 +365,9 @@ class GBM(ModelBuilder):
             f0 = jnp.nan_to_num(dist.init_f(y, w))
 
         grad_fn = self._make_grad_fn(dist, K)
-        cfg = self._tree_config(K)
+        # effective bin count follows the edge matrix: small-data exact
+        # binning may widen it past p.nbins (the nbins_top_level analog)
+        cfg = self._tree_config(K, nbins=edges_np.shape[1] + 1)
         if not self.drf_mode and K == 1 and dist.name in ("laplace",
                                                           "quantile"):
             # exact gamma leaves: median (laplace) / alpha-quantile of the
@@ -406,7 +410,10 @@ class GBM(ModelBuilder):
             prior_mono = getattr(prior.params, "monotone_constraints", None) or {}
             for fld, ours, theirs in (
                     ("max_depth", p.max_depth, prior.cfg.max_depth),
-                    ("nbins", p.nbins, prior.cfg.nbins),
+                    # cfg.nbins is the EFFECTIVE bin count (small-data exact
+                    # binning may widen it); the user contract is the param
+                    ("nbins", p.nbins,
+                     getattr(prior.params, "nbins", prior.cfg.nbins)),
                     ("nclasses", K, prior.cfg.nclass),
                     ("drf_mode", self.drf_mode, prior.cfg.drf_mode),
                     ("monotone_constraints",

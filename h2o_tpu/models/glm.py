@@ -680,6 +680,8 @@ class GLM(ModelBuilder):
 
     def build_impl(self, job: Job) -> Model:
         p = self.params
+        if isinstance(p.alpha, (list, tuple)):
+            return self._build_alpha_search(job)
         fr = p.training_frame
         names = self.feature_names()
         y_dev, category, resp_domain = self.response_info()
@@ -696,6 +698,39 @@ class GLM(ModelBuilder):
                                              self._interaction_cols)
         if getattr(p, "HGLM", False):
             return self._build_hglm(job, names, y_dev, category)
+        return self._build_single(job, p, fr, names, y_dev, category,
+                                  resp_domain)
+
+    def _build_alpha_search(self, job: Job) -> Model:
+        """`alpha` given as an ARRAY (`hex/glm/GLM.java` submodel scan over
+        alphas × lambdas): fit one model per alpha and keep the best by
+        deviance — validation when present, else training."""
+        import dataclasses
+
+        p = self.params
+        alphas = [float(a) for a in p.alpha]
+        if not alphas:
+            raise ValueError("alpha: empty array")
+        best, best_dev, best_alpha = None, float("inf"), None
+        for a in alphas:
+            sub = type(self)(dataclasses.replace(p, alpha=a, nfolds=0))
+            m = sub.build_impl(job)
+            mm = (m.output.validation_metrics
+                  if p.validation_frame is not None
+                  else m.output.training_metrics)
+            dev = None
+            for attr in ("residual_deviance", "mean_residual_deviance",
+                         "logloss", "mse"):
+                dev = getattr(mm, attr, None)
+                if dev is not None and dev == dev:
+                    break
+            if best is None or (dev is not None and dev < best_dev):
+                best, best_alpha = m, a
+                best_dev = dev if dev is not None else best_dev
+        best.best_alpha = best_alpha
+        return best
+
+    def _build_single(self, job, p, fr, names, y_dev, category, resp_domain):
         if category == "Multinomial":
             if p.compute_p_values:  # AUTO family resolving to multinomial
                 raise ValueError("compute_p_values is not supported for "

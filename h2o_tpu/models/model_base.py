@@ -59,6 +59,8 @@ class Parameters:
     distribution: str = "AUTO"
     categorical_encoding: str = "AUTO"
     ignore_const_cols: bool = True
+    check_constant_response: bool = True  # `hex/tree/SharedTree` refuses a
+                                          # constant response unless disabled
     balance_classes: bool = False
     stopping_rounds: int = 0
     stopping_metric: str = "AUTO"
@@ -273,6 +275,7 @@ class ModelBuilder:
     supervised = True
     supports_cv = True  # False for transformers that consume fold_column
                         # themselves (TargetEncoder's KFold strategy)
+    _constant_response_check = False  # True in tree builders (SharedTree)
 
     def __init__(self, params: Parameters):
         self.params = params
@@ -289,6 +292,15 @@ class ModelBuilder:
                 raise ValueError(f"{self.algo_name}: response_column is required")
             if p.training_frame.find(p.response_column) < 0:
                 raise ValueError(f"response_column '{p.response_column}' not in frame")
+            if p.check_constant_response and self._constant_response_check:
+                rv = p.training_frame.vec(p.response_column)
+                if not rv.is_string() and rv.data is not None:
+                    r = rv.rollups()
+                    if r.nacnt < rv.nrow and r.mins == r.maxs:
+                        raise ValueError(
+                            f"{self.algo_name}: response is constant — set "
+                            "check_constant_response=False to train anyway "
+                            "(hex/tree/SharedTree constant-response check)")
 
     # -- feature selection ----------------------------------------------------
     def feature_names(self) -> list[str]:

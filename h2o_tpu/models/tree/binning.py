@@ -53,9 +53,35 @@ def _col_minmax(X):
     return jnp.nanmin(X, axis=0), jnp.nanmax(X, axis=0)
 
 
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _distinct_values(X, cap: int):
+    """Per-column distinct values, on device: (cap, F) ascending and
+    NaN-padded, plus the true (F,) distinct counts (which may exceed cap —
+    callers treat such columns as continuous). One sort + scatter."""
+    R, F = X.shape
+    S = jnp.sort(X, axis=0)  # NaN to the end
+    new = jnp.concatenate(
+        [jnp.ones((1, F), bool), S[1:] != S[:-1]], axis=0) & ~jnp.isnan(S)
+    counts = new.sum(axis=0)
+    pos = jnp.cumsum(new, axis=0) - 1
+    rows = jnp.where(new, jnp.minimum(pos, cap - 1), cap)  # cap = dump slot
+    out = jnp.full((cap + 1, F), jnp.nan, jnp.float32)
+    cols = jnp.broadcast_to(jnp.arange(F), (R, F))
+    out = out.at[rows, cols].set(S.astype(jnp.float32), mode="drop")
+    return out[:cap], counts
+
+
+#: rows at or below which small-data exact binning may engage (env override)
+def _exact_bin_row_limit() -> int:
+    import os
+
+    return int(os.environ.get("H2O_TPU_EXACT_BIN_ROWS", 16384))
+
+
 def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
                       sample: int = 200_000, seed: int = 1234,
-                      histogram_type: str = "QuantilesGlobal") -> np.ndarray:
+                      histogram_type: str = "QuantilesGlobal",
+                      nbins_top_level: int = 1024) -> np.ndarray:
     """Global bin edges per feature.
 
     ``histogram_type`` mirrors `hex/tree/SharedTreeModel.HistogramType`:
@@ -78,6 +104,20 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
             f"AUTO, QuantilesGlobal, UniformAdaptive, Random")
     Xj = jnp.asarray(X)
     R, F = Xj.shape
+    # Small-data exact binning — the `nbins_top_level` role: the reference's
+    # DHistogram re-bins each node at up to 1024 cuts, so on small data its
+    # splits are effectively exact. Matching that with static shapes: when
+    # the dataset is small and a column's distinct count fits under
+    # nbins_top_level, its cuts are the exact midpoints BETWEEN distinct
+    # values; high-cardinality columns keep the sampled-quantile cuts. Big
+    # data (above H2O_TPU_EXACT_BIN_ROWS) is untouched — histogram cost
+    # scales with the bin-axis length, and 20 global quantile bins is the
+    # measured-fast design there.
+    exact = None
+    if (R <= _exact_bin_row_limit() and nbins_top_level > nbins
+            and ht in ("auto", "quantilesglobal", "uniformadaptive")):
+        vals, counts = _distinct_values(Xj, int(nbins_top_level))
+        exact = (np.asarray(vals), np.asarray(counts))
     qs = np.linspace(0, 1, nbins + 1)[1:-1]
     col_min, col_max = (np.asarray(v) for v in _col_minmax(Xj))
     qrows = None
@@ -87,13 +127,22 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
                if R > sample else np.arange(R))
         qrows = np.asarray(_sampled_quantile_rows(Xj, jnp.asarray(idx),
                                                   tuple(qs)))
-    edges = np.full((F, nbins - 1), np.nan, dtype=np.float32)
+    all_cuts: list = []
     for f in range(F):
         if not np.isfinite(col_max[f]):  # all-NaN column
+            all_cuts.append(np.zeros(0, np.float32))
+            continue
+        if exact is not None and not is_cat[f] and \
+                0 < int(exact[1][f]) <= nbins_top_level:
+            u = exact[0][:int(exact[1][f]), f].astype(np.float64)
+            cuts = ((u[:-1] + u[1:]) / 2).astype(np.float32)
+            all_cuts.append(cuts)
             continue
         if is_cat[f]:
             card = int(col_max[f]) + 1
-            cuts = np.arange(min(card - 1, nbins - 1), dtype=np.float32)
+            nb_cat = max(nbins, min(card, nbins_top_level)) \
+                if exact is not None else nbins
+            cuts = np.arange(min(card - 1, nb_cat - 1), dtype=np.float32)
         elif ht == "uniformadaptive":
             lo, hi = float(col_min[f]), float(col_max[f])
             cuts = (np.unique(np.linspace(lo, hi, nbins + 1)[1:-1]
@@ -108,6 +157,10 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
         else:  # AUTO / QuantilesGlobal
             col = qrows[:, f]
             cuts = np.unique(col[~np.isnan(col)].astype(np.float32))
+        all_cuts.append(cuts)
+    width = max(nbins - 1, max((len(c) for c in all_cuts), default=0))
+    edges = np.full((F, width), np.nan, dtype=np.float32)
+    for f, cuts in enumerate(all_cuts):
         edges[f, : len(cuts)] = cuts
     return edges
 

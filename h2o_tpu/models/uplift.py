@@ -334,16 +334,19 @@ class UpliftDRF(ModelBuilder):
         F = len(names)
         mtries = p.mtries if p.mtries and p.mtries > 0 else max(
             1, int(math.sqrt(F)))
+        mesh = default_mesh()
+        edges_np = compute_bin_edges(X, is_cat, p.nbins,
+                                     seed=p.seed if p.seed not in (-1, None) else 1234)
         cfg = TreeConfig(
-            ntrees=p.ntrees, max_depth=min(p.max_depth, 12), nbins=p.nbins,
+            ntrees=p.ntrees, max_depth=min(p.max_depth, 12),
+            # effective bin count follows the edge matrix (small-data exact
+            # binning may widen it past p.nbins)
+            nbins=edges_np.shape[1] + 1,
             min_rows=p.min_rows, sample_rate=p.sample_rate, mtries=mtries,
             min_split_improvement=max(p.min_split_improvement, 1e-9),
             col_sample_rate_per_tree=p.col_sample_rate_per_tree,
             drf_mode=True)
 
-        mesh = default_mesh()
-        edges_np = compute_bin_edges(X, is_cat, p.nbins,
-                                     seed=p.seed if p.seed not in (-1, None) else 1234)
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf),
                                replicated(mesh))
         edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))

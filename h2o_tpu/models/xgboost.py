@@ -82,7 +82,7 @@ class XGBoostParameters(GBMParameters):
 class XGBoost(GBM):
     algo_name = "xgboost"
 
-    def _tree_config(self, K):
+    def _tree_config(self, K, nbins=None):
         import dataclasses
-        cfg = super()._tree_config(K)
+        cfg = super()._tree_config(K, nbins=nbins)
         return dataclasses.replace(cfg, reg_alpha=self.params.reg_alpha)

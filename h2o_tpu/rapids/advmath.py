@@ -117,7 +117,10 @@ def quantile_frame(fr: Frame, probs, interpolation: str = "interpolate") -> Fram
             q = np.quantile(xs, probs,
                             method="linear" if interpolation != "low"
                             else "lower")
-        cols[f"{name}Quantiles"] = Vec.from_numpy(q.astype(np.float32))
+        # float64 out: from_numpy keeps an exact sidecar when f32 is lossy,
+        # so the client reads full-precision quantiles (the reference is
+        # float64 end-to-end)
+        cols[f"{name}Quantiles"] = Vec.from_numpy(q.astype(np.float64))
     return Frame(list(cols), list(cols.values()))
 
 
@@ -465,13 +468,27 @@ def melt(fr: Frame, id_vars, value_vars=None, var_name: str = "variable",
 
 
 def transpose(fr: Frame) -> Frame:
-    X = np.stack([fr.vec(i).to_numpy() for i in range(fr.ncol)], axis=0)
+    # to_numpy returns the exact f64 sidecar when present; keep that
+    # precision through the transpose (from_numpy re-derives sidecars)
+    X = np.stack([fr.vec(i).to_numpy().astype(np.float64)
+                  for i in range(fr.ncol)], axis=0)
     return Frame([f"C{i+1}" for i in range(X.shape[1])],
-                 [Vec.from_numpy(X[:, i].astype(np.float32))
-                  for i in range(X.shape[1])])
+                 [Vec.from_numpy(X[:, i]) for i in range(X.shape[1])])
 
 
 def mmult(fx: Frame, fy: Frame) -> Frame:
+    # f64 host path when either side carries exact sidecars (values that
+    # don't round-trip f32) — the reference multiplies doubles; device f32
+    # (MXU) remains the path for exactly-representable data
+    if any(fx.vec(i).exact_data is not None for i in range(fx.ncol)) or \
+            any(fy.vec(i).exact_data is not None for i in range(fy.ncol)):
+        X = np.stack([fx.vec(i).to_numpy().astype(np.float64)
+                      for i in range(fx.ncol)], axis=1)
+        Y = np.stack([fy.vec(i).to_numpy().astype(np.float64)
+                      for i in range(fy.ncol)], axis=1)
+        Z = X @ Y
+        return Frame([f"C{i+1}" for i in range(Z.shape[1])],
+                     [Vec.from_numpy(Z[:, i]) for i in range(Z.shape[1])])
     X = jnp.stack([fx.vec(i).data[:fx.nrow] for i in range(fx.ncol)], axis=1)
     Y = jnp.stack([fy.vec(i).data[:fy.nrow] for i in range(fy.ncol)], axis=1)
     Z = np.asarray(X @ Y)

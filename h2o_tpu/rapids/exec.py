@@ -182,7 +182,13 @@ def _row_mask(fr: Frame, sel) -> np.ndarray | None:
     if isinstance(sel, tuple):
         return np.arange(sel[0], sel[1])
     if isinstance(sel, list):
-        return np.asarray([int(_x) for _x in sel])
+        out: list = []
+        for _x in sel:
+            if isinstance(_x, tuple):  # [a:b] span inside a list
+                out.extend(range(_x[0], _x[1]))
+            else:
+                out.append(int(_x))
+        return np.asarray(out, dtype=np.int64)
     return None
 
 
@@ -190,7 +196,27 @@ def _subset_rows(fr: Frame, rows) -> Frame:
     if rows is None:
         return fr
     idx = np.where(rows)[0] if rows.dtype == bool else rows
-    return fr.take(idx)
+    oob = (idx < 0) | (idx >= fr.nrow)
+    if not oob.any():
+        return fr.take(idx)
+    # h2o semantics: selecting past the last row yields NA rows, not an
+    # error (`AstRows` reads beyond the Vec as NA)
+    out = fr.take(np.clip(idx, 0, max(fr.nrow - 1, 0)))
+    from ..frame.vec import Vec as _Vec
+
+    for name in list(out.names):
+        v = out.vec(name)
+        if v.is_string():
+            hd = v.host_data.copy()
+            hd[oob] = None
+            out.replace(name, _Vec(None, len(idx), type=v.type,
+                                   host_data=hd))
+        else:
+            x = v.to_numpy().astype(np.float64)
+            x[oob] = np.nan
+            out.replace(name, _Vec.from_numpy(x, type=v.type,
+                                              domain=v.domain))
+    return out
 
 
 class Rapids:
@@ -420,6 +446,28 @@ def _rect_assign_prim(R, dst, src, cols, rows=None):
     return mungers.rectangle_assign(fr, src, cidx, _row_mask(fr, rows))
 
 
+def _merge_prim(R, l, r, all_l=False, all_r=False, by_l=None, by_r=None,
+                method="auto"):
+    """(merge l r all_x all_y [bx] [by] method) — `AstMerge.java`. Explicit
+    by-columns come as index lists; differently-named right keys are
+    realigned onto the left names before the join."""
+    lf, rf = _as_frame(l), _as_frame(r)
+    bx = _col_indices(lf, by_l) if by_l not in (None, []) else None
+    by_ = _col_indices(rf, by_r) if by_r not in (None, []) else None
+    by_names = None
+    if bx:
+        by_names = [lf.names[i] for i in bx]
+        if by_:
+            if len(by_) != len(bx):
+                raise ValueError("merge: by_x and by_y lengths differ")
+            rnames = list(rf.names)
+            for li, ri in zip(bx, by_):
+                rnames[ri] = lf.names[li]
+            rf = Frame(rnames, list(rf.vecs))
+    return merge_fn(lf, rf, by=by_names,
+                    all_x=bool(all_l), all_y=bool(all_r))
+
+
 def _rename_key(R, old: str, new: str):
     """(rename "old" "new") — rename a DKV key (`AstRename.java`)."""
     obj = R.session.lookup(old)
@@ -471,13 +519,36 @@ def _prim_binop(op):
             with np.errstate(divide="ignore", invalid="ignore"):
                 return float(_SCALAR_BINOPS[op](np.float64(l),
                                                 np.float64(r)))
+        # multi-column frames apply column-wise (`AstBinOp.frame_op_frame`)
+        lm = isinstance(l, Frame) and l.ncol > 1
+        rm = isinstance(r, Frame) and r.ncol > 1
+        if lm or rm:
+            n = l.ncol if lm else r.ncol
+            if lm and rm and r.ncol != n:
+                raise ValueError(
+                    f"binop '{op}': frames have {l.ncol} vs {r.ncol} columns")
+            vecs = [binop(op,
+                          l.vec(i) if lm else _as_vec(l)
+                          if isinstance(l, (Frame, Vec)) else l,
+                          r.vec(i) if rm else _as_vec(r)
+                          if isinstance(r, (Frame, Vec)) else r)
+                    for i in range(n)]
+            return Frame(list((l if lm else r).names), vecs)
         return binop(op, _as_vec(l), _as_vec(r))
     return fn
 
 
-def _prim_unop(op):
+def _prim_unop(op, rename=None):
+    """``rename``: per-column output naming (AstIsNa's "isNA(col)")."""
     def fn(R, v):
-        return unop(op, _as_vec(v))
+        if isinstance(v, Frame) and v.ncol > 1:
+            names = [rename(n) if rename else n for n in v.names]
+            return Frame(names,
+                         [unop(op, v.vec(i)) for i in range(v.ncol)])
+        out = unop(op, _as_vec(v))
+        if rename and isinstance(v, Frame):
+            return Frame([rename(v.names[0])], [out])
+        return out
     return fn
 
 
@@ -627,7 +698,7 @@ _PRIMS = {
         "log2", "log1p", "sqrt", "sin", "cos", "tan", "asin", "acos", "atan",
         "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "sign", "not",
         "gamma", "lgamma", "digamma", "trigamma", "cospi", "sinpi", "tanpi")},
-    "is.na": lambda R, v: unop("isna", _as_vec(v)),
+    "is.na": _prim_unop("isna", rename=lambda n: f"isNA({n})"),
     **{op: _prim_reduce(op) for op in
        ("min", "max", "sum", "mean", "median", "sd", "var", "prod", "all",
         "any")},
@@ -651,8 +722,7 @@ _PRIMS = {
     "as.factor": lambda R, v: _asfactor(_as_vec(v)),
     "as.numeric": lambda R, v: _asnumeric(_as_vec(v)),
     "GB": _group_by,
-    "merge": lambda R, l, r, all_l=False, all_r=False, by_l=None, by_r=None, method="auto":
-        merge_fn(_as_frame(l), _as_frame(r), all_x=bool(all_l), all_y=bool(all_r)),
+    "merge": _merge_prim,
     "sort": lambda R, fr, by, asc=None: sort_fn(
         _as_frame(fr),
         [_as_frame(fr).names[i] for i in _col_indices(_as_frame(fr), by)],

@@ -333,8 +333,9 @@ def merge(left: Frame, right: Frame, by: list[str] | None = None,
             fill = np.where(np.isfinite(rk[:, bj]), rk[:, bj], np.nan)
             fill_at = (fill[np.clip(r_pos, 0, None)] if rn
                        else np.full(len(r_pos), np.nan))
-            out = np.where(l_idx >= 0, lhost[np.clip(l_idx, 0, None)],
-                           fill_at)
+            lvals = (lhost[np.clip(l_idx, 0, None)] if ln
+                     else np.full(len(l_idx), np.nan))
+            out = np.where(l_idx >= 0, lvals, fill_at)
             col = Vec.from_numpy(out.astype(np.float32), type=v.type,
                                  domain=v.domain)
         else:

@@ -109,8 +109,20 @@ def rectangle_assign(dst: Frame, src, cols, rows) -> Frame:
         rows = np.where(rows)[0]
     out = Frame(list(dst.names), list(dst.vecs))
     col_list = cols if isinstance(cols, list) else [cols]
+    # strictly 0..nrow-1 IN ORDER: a permuted or duplicated full-length row
+    # list is a scatter, not a column replacement
+    whole_column = len(rows) == nrow and \
+        (nrow == 0 or bool(np.array_equal(rows, np.arange(nrow))))
     for k, ci in enumerate(col_list):
         ci = int(ci)
+        if whole_column and isinstance(src, (Frame, Vec)):
+            # assigning a full column REPLACES it, adopting the source's
+            # type/domain (h2o-py `f[col] = numeric_frame` drops the old
+            # enum domain — `AstRectangleAssign` whole-vec path)
+            sv = src.vec(k) if isinstance(src, Frame) else src
+            if sv.nrow == nrow:
+                out._vecs[ci] = sv
+                continue
         if isinstance(src, Frame):
             if src.ncol != len(col_list):
                 raise ValueError(f"Frame src has {src.ncol} cols; assigning "

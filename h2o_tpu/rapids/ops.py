@@ -65,8 +65,62 @@ _CMPOPS = {
 }
 
 
+#: numpy float64 twins of the device tables — the reference computes in
+#: double everywhere, so columns whose values don't round-trip f32 (they
+#: carry an exact host sidecar) evaluate element-wise ops host-side in f64.
+#: Device f32 remains the path for exactly-representable data and big frames.
+_NP_BINOPS = {
+    "+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide,
+    "^": np.float_power,
+    "%%": lambda a, b: np.where(b == 0, np.nan, np.fmod(a, b)),
+    "intDiv": lambda a, b: np.where(np.trunc(b) == 0, np.nan,
+                                    np.trunc(np.trunc(a) / np.trunc(b))),
+    "%/%": lambda a, b: np.where(b == 0, np.nan, np.trunc(np.divide(a, b))),
+}
+
+_NP_CMPOPS = {
+    "==": np.equal, "!=": np.not_equal, "<": np.less, "<=": np.less_equal,
+    ">": np.greater, ">=": np.greater_equal,
+}
+
+
+def _exact_np(v, nrow: int):
+    if isinstance(v, Vec):
+        return v.to_numpy().astype(np.float64)
+    return np.float64(v)
+
+
+def _wants_f64(v) -> bool:
+    return isinstance(v, Vec) and v.exact_data is not None
+
+
+def _binop_host(op: str, l, r, nrow: int) -> Vec:
+    a, b = _exact_np(l, nrow), _exact_np(r, nrow)
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        if op in _NP_BINOPS:
+            return Vec.from_numpy(np.asarray(_NP_BINOPS[op](a, b),
+                                             dtype=np.float64))
+        if op in _NP_CMPOPS:
+            res = _NP_CMPOPS[op](a, b).astype(np.float64)
+            res = np.where(np.isnan(a) | np.isnan(b), np.nan, res)
+            return Vec.from_numpy(res, type=T_INT)
+        if op in ("&", "&&"):
+            out = np.where((a == 0) | (b == 0), 0.0,
+                           np.where(np.isnan(a) | np.isnan(b), np.nan, 1.0))
+            return Vec.from_numpy(out, type=T_INT)
+        if op in ("|", "||"):
+            a1 = (a != 0) & ~np.isnan(a)
+            b1 = (b != 0) & ~np.isnan(b)
+            out = np.where(a1 | b1, 1.0,
+                           np.where(np.isnan(a) | np.isnan(b), np.nan, 0.0))
+            return Vec.from_numpy(out, type=T_INT)
+    raise ValueError(f"unknown op {op!r}")
+
+
 def binop(op: str, l, r) -> Vec:
     nrow = _nrow(l, r)
+    if _wants_f64(l) or _wants_f64(r):
+        return _binop_host(op, l, r, nrow)
     a, b = _data(l), _data(r)
     if op in _BINOPS:
         out = _BINOPS[op](a, b)
@@ -133,6 +187,10 @@ _UNARY = {
 
 def unop(op: str, v: Vec) -> Vec:
     if op == "isna":
+        if v.data is None:  # string column: host-side None check
+            out = np.array([1.0 if x is None else 0.0
+                            for x in v.host_data], np.float32)
+            return Vec.from_numpy(out, type=T_INT)
         out = jnp.isnan(v.data).astype(jnp.float32)
         out = jnp.where(_mask(v), out, jnp.nan)  # padding stays NA
         return Vec.from_device(out, v.nrow, type=T_INT)
@@ -170,7 +228,33 @@ def _valid(v: Vec):
     return ~jnp.isnan(v.data)
 
 
+def _reduce_host(op: str, v: Vec, na_rm: bool) -> float:
+    x = v.to_numpy().astype(np.float64)
+    ok = ~np.isnan(x)
+    if not na_rm and not ok.all():
+        return float("nan")
+    xv = x[ok]
+    if xv.size == 0 and op in ("sum", "prod", "min", "max", "mean", "median"):
+        return float("nan") if op not in ("sum", "prod") else \
+            (0.0 if op == "sum" else 1.0)
+    fns = {"sum": np.sum, "prod": np.prod, "min": np.min, "max": np.max,
+           "mean": np.mean, "median": np.median,
+           "sd": lambda a: np.std(a, ddof=1), "sdev": lambda a: np.std(a, ddof=1),
+           "var": lambda a: np.var(a, ddof=1)}
+    if op in fns:
+        return float(fns[op](xv))
+    if op == "all":
+        return bool(np.all(xv != 0))
+    if op == "any":
+        return bool(np.any(xv != 0))
+    if op == "nacnt":
+        return v.nacnt()
+    raise ValueError(f"unknown reducer {op!r}")
+
+
 def reduce_op(op: str, v: Vec, na_rm: bool = True) -> float:
+    if _wants_f64(v):
+        return _reduce_host(op, v, na_rm)
     ok = _valid(v)
     x = v.data
     has_na = bool(jnp.sum(~ok) > (v.plen - v.nrow))
@@ -206,6 +290,13 @@ def reduce_op(op: str, v: Vec, na_rm: bool = True) -> float:
 
 def cumulative(op: str, v: Vec) -> Vec:
     """cumsum/cumprod/cummin/cummax with NA propagation from first NA on."""
+    if _wants_f64(v):
+        x = v.to_numpy().astype(np.float64)
+        hf = {"cumsum": np.cumsum, "cumprod": np.cumprod,
+              "cummin": np.minimum.accumulate,
+              "cummax": np.maximum.accumulate}[op]
+        out = hf(x)  # NaN poisons every later prefix naturally
+        return Vec.from_numpy(out)
     fns = {"cumsum": jnp.cumsum, "cumprod": jnp.cumprod,
            "cummin": jnp.minimum.accumulate, "cummax": jnp.maximum.accumulate}
     neutral = {"cumsum": 0.0, "cumprod": 1.0, "cummin": jnp.inf,

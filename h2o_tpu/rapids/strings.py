@@ -206,7 +206,58 @@ def strdistance(v1: Vec, v2: Vec, measure: str = "lv",
         sa, sb = set(a), set(b)
         return 1.0 - len(sa & sb) / max(len(sa | sb), 1)
 
-    fn = jac if measure == "jaccard" else lev
+    def jw(a, b):
+        # Jaro-Winkler SIMILARITY with the standard p=0.1 prefix boost —
+        # the reference's 'jw' measure (util.comparison.string.StringComparator)
+        if a == b:
+            return 1.0
+        la, lb = len(a), len(b)
+        if la == 0 or lb == 0:
+            return 0.0
+        window = max(la, lb) // 2 - 1
+        ma = [False] * la
+        mb = [False] * lb
+        m = 0
+        for i in range(la):
+            lo, hi = max(0, i - window), min(lb, i + window + 1)
+            for j in range(lo, hi):
+                if not mb[j] and a[i] == b[j]:
+                    ma[i] = mb[j] = True
+                    m += 1
+                    break
+        if m == 0:
+            return 0.0
+        t = 0
+        k = 0
+        for i in range(la):
+            if ma[i]:
+                while not mb[k]:
+                    k += 1
+                if a[i] != b[k]:
+                    t += 1
+                k += 1
+        jaro = (m / la + m / lb + (m - t / 2) / m) / 3.0
+        prefix = 0
+        for ca, cb in zip(a[:4], b[:4]):
+            if ca != cb:
+                break
+            prefix += 1
+        return jaro + prefix * 0.1 * (1.0 - jaro)
+
+    def lcs_dist(a, b):
+        # longest-common-subsequence edit distance (stringdist 'lcs')
+        dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+        for i, ca in enumerate(a, 1):
+            for j, cb in enumerate(b, 1):
+                dp[i][j] = dp[i - 1][j - 1] + 1 if ca == cb else \
+                    max(dp[i - 1][j], dp[i][j - 1])
+        return len(a) + len(b) - 2 * dp[len(a)][len(b)]
+
+    fns = {"jaccard": jac, "jw": jw, "lcs": lcs_dist, "lv": lev}
+    if measure not in fns:
+        raise ValueError(f"strDistance: unsupported measure '{measure}' "
+                         f"(supported: {sorted(fns)})")
+    fn = fns[measure]
     h1, h2 = _host_strings(v1), _host_strings(v2)
     out = np.full(len(h1), np.nan, dtype=np.float32)
     for i, (a, b) in enumerate(zip(h1, h2)):

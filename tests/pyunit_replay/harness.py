@@ -1,0 +1,163 @@
+"""Replay harness for GENUINE h2o-py pyunit scripts (VERDICT r2 item #1).
+
+The .py files under ``scripts/`` are verbatim copies of reference tests from
+`/root/reference/h2o-py/tests/testdir_{munging,algos/gbm,algos/rf,algos/glm}`
+— intentionally unmodified (provenance is the point: they prove the client
+and server honor the real h2o-py contract). This module supplies what the
+scripts import:
+
+- a synthetic ``h2o`` package alias tree (h2o, h2o.estimators.*,
+  h2o.exceptions, h2o.grid) resolving to ``h2o_tpu.api``,
+- a ``tests.pyunit_utils`` shim with the helper functions the chosen
+  scripts call (fresh implementations mirroring
+  `h2o-py/tests/pyunit_utils/utilsPY.py` semantics),
+- ``locate()`` resolution into ``data/`` — the real smalldata repository is
+  not in-image, so iris/prostate come from the reference's extdata copies and
+  prostate_train/test are a deterministic seeded split (README in data/).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import types
+
+import h2o_tpu.api as _api
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, "data")
+SCRIPTS_DIR = os.path.join(_HERE, "scripts")
+
+
+# ---------------------------------------------------------------------------
+# pyunit_utils shim
+# ---------------------------------------------------------------------------
+def locate(path: str) -> str:
+    """`pyunit_utils.locate`: resolve a smalldata-relative path."""
+    full = os.path.join(DATA_DIR, path)
+    if not os.path.exists(full):
+        raise ValueError(f"pyunit replay: no staged data for {path!r} "
+                         f"(see {DATA_DIR})")
+    return full
+
+
+def standalone_test(test, init_options={}):
+    _api.remove_all()
+    test()
+
+
+def check_dims_values(python_obj, h2o_frame, rows, cols, dim_only=False):
+    """Mirror of utilsPY.check_dims_values:293."""
+    h2o_rows, h2o_cols = h2o_frame.dim
+    assert h2o_rows == rows and h2o_cols == cols, \
+        f"failed dim check! h2o:{h2o_rows}x{h2o_cols} expected:{rows}x{cols}"
+    if dim_only:
+        return
+    if isinstance(python_obj, dict):
+        for r in range(rows):
+            for k in python_obj:
+                pval = python_obj[k]
+                if hasattr(pval, "__iter__") and not isinstance(pval, str):
+                    pval = list(pval)[r]
+                hval = h2o_frame[r, k]
+                assert pval == hval, f"row {r} col {k}: h2o {hval!r} " \
+                                     f"python {pval!r}"
+    else:
+        plist = python_obj.tolist() if hasattr(python_obj, "tolist") \
+            else list(python_obj)
+        for c in range(cols):
+            for r in range(rows):
+                pval = plist[r]
+                if isinstance(pval, (list, tuple)):
+                    pval = pval[c]
+                hval = h2o_frame[r, c]
+                assert pval == hval or \
+                    (isinstance(pval, (int, float)) and
+                     isinstance(hval, (int, float)) and
+                     abs(pval - hval) < 1e-10), \
+                    f"row {r} col {c}: h2o {hval!r} python {pval!r}"
+
+
+def np_comparison_check(h2o_data, np_data, num_elements):
+    """Mirror of utilsPY.np_comparison_check:326."""
+    import random
+
+    import numpy as np
+
+    rows, cols = h2o_data.dim
+    for _ in range(num_elements):
+        r = random.randint(0, rows - 1)
+        c = random.randint(0, cols - 1)
+        h2o_val = h2o_data[r, c]
+        np_val = np_data[r, c] if len(np_data.shape) > 1 else np_data[r]
+        if isinstance(np_val, np.bool_):
+            np_val = bool(np_val)
+        assert np.absolute(h2o_val - np_val) < 1e-5, \
+            f"failed comparison check! h2o: {h2o_val} numpy: {np_val}"
+
+
+def assertEqualCoeffDicts(coef1Dict, coef2Dict, tol=1e-6):
+    assert len(coef1Dict) == len(coef2Dict), "coefficient dict lengths differ"
+    for key in coef1Dict:
+        v1, v2 = coef1Dict[key], coef2Dict[key]
+        if math.isnan(v1):
+            assert math.isnan(v2), f"{key}: {v1} vs {v2}"
+        elif math.isinf(v1):
+            assert math.isinf(v2), f"{key}: {v1} vs {v2}"
+        else:
+            assert abs(v1 - v2) < tol, f"{key}: {v1} vs {v2}"
+
+
+# ---------------------------------------------------------------------------
+# module alias tree
+# ---------------------------------------------------------------------------
+def _submodule(name: str, **attrs) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules[name] = mod
+    return mod
+
+
+def install_aliases() -> None:
+    """Register ``h2o`` / ``tests`` in sys.modules so the verbatim scripts'
+    imports resolve to h2o_tpu. Idempotent."""
+    if sys.modules.get("h2o") is _api:
+        return
+    sys.modules["h2o"] = _api
+    est = _submodule(
+        "h2o.estimators",
+        **{n: getattr(_api, n) for n in dir(_api)
+           if n.startswith("H2O") and n.endswith("Estimator")})
+    _api.estimators = est
+    _submodule("h2o.estimators.gbm",
+               H2OGradientBoostingEstimator=_api.H2OGradientBoostingEstimator)
+    _submodule("h2o.estimators.random_forest",
+               H2ORandomForestEstimator=_api.H2ORandomForestEstimator)
+    _submodule("h2o.estimators.glm",
+               H2OGeneralizedLinearEstimator=_api.H2OGeneralizedLinearEstimator)
+    _api.exceptions = _submodule(
+        "h2o.exceptions",
+        H2OValueError=ValueError,
+        H2OTypeError=TypeError,
+        H2OResponseError=_api.H2OConnectionError,
+        H2OConnectionError=_api.H2OConnectionError)
+    _submodule("h2o.grid", H2OGridSearch=_api.H2OGridSearch)
+    shim = _submodule("tests.pyunit_utils",
+                      locate=locate, standalone_test=standalone_test,
+                      check_dims_values=check_dims_values,
+                      np_comparison_check=np_comparison_check,
+                      assertEqualCoeffDicts=assertEqualCoeffDicts)
+    _submodule("tests", pyunit_utils=shim)
+
+
+def run_script(name: str) -> None:
+    """Exec one verbatim pyunit script; its module-level ``else`` branch
+    invokes the test function (``__name__`` is not ``__main__`` here)."""
+    install_aliases()
+    path = os.path.join(SCRIPTS_DIR, name)
+    with open(path) as fh:
+        src = fh.read()
+    code = compile(src, path, "exec")
+    exec(code, {"__name__": f"pyunit_replay.{name[:-3]}", "__file__": path})

@@ -1,0 +1,39 @@
+"""Run ONE replayed pyunit script in a fresh process — the
+`scripts/run.py` model: the reference harness also gives every pyunit its
+own python process against a running cluster. Here the cluster is an
+in-process `h2o.init()` server; process isolation additionally sidesteps
+XLA-CPU's accumulated-compiler-state fragility under threaded training.
+
+Usage: python -m pyunit_replay.run_one <script.py> <port>
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    "--xla_force_host_platform_device_count=8")
+
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))  # repo root
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import h2o_tpu.api as h2o
+
+    from . import harness
+
+    script, port = sys.argv[1], int(sys.argv[2])
+    h2o.init(port=port)
+    harness.run_script(script)
+    print(f"PYUNIT-OK {script}")
+
+
+if __name__ == "__main__":
+    main()
