@@ -357,6 +357,55 @@ def download_model(model, path: str = "",
         save_to=full)
 
 
+def upload_custom_metric(func, func_file: str = "metrics.py",
+                         func_name: str | None = None,
+                         class_name: str | None = None) -> str:
+    """`h2o.upload_custom_metric` (`h2o-py/h2o/h2o.py:2165`): push a metric
+    class (a class object or its source string) to the server as a zipped
+    module via PostFile; returns the ``python:{key}={module}.{Class}``
+    reference any model's ``custom_metric_func`` can name. The class must
+    implement ``map(pred, act, w, o, model)``, ``reduce(l, r)`` and
+    ``metric(l)`` — the CMetricFunc triple."""
+    import inspect
+    import tempfile
+    import zipfile
+
+    if not func_file.endswith(".py"):
+        raise ValueError("func_file must end with .py")
+    module = func_file[:-3]
+    if isinstance(func, str):
+        if class_name is None:
+            raise ValueError("class_name is required when func is a source "
+                             "string")
+        code = func
+        derived = f"metrics_{class_name}"
+        path = f"{module}.{class_name}"
+    else:
+        if not inspect.isclass(func):
+            raise TypeError("func must be a class or a source string")
+        for method in ("map", "reduce", "metric"):
+            if method not in func.__dict__:
+                raise ValueError(f"the class must define `{method}`")
+        import textwrap
+
+        code = textwrap.dedent(inspect.getsource(func))
+        derived = f"metrics_{func.__name__}"
+        path = f"{module}.{func.__name__}"
+    key = func_name or derived
+    fd, tmp = tempfile.mkstemp(suffix=".zip")
+    os.close(fd)
+    try:
+        with zipfile.ZipFile(tmp, "w") as zf:
+            zf.writestr(func_file, code)
+        connection().request("POST", "/3/PostFile",
+                             params={"destination_frame": key,
+                                     "filename": f"{key}.zip"},
+                             filename=tmp)
+    finally:
+        os.unlink(tmp)
+    return f"python:{key}={path}"
+
+
 def upload_model(path: str) -> "H2OModelClient":
     """`h2o.upload_model` (`h2o-py/h2o/h2o.py:1563`): push a CLIENT-side
     binary model to the server — PostFile.bin then Models.upload.bin."""

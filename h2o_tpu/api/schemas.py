@@ -118,6 +118,11 @@ def metrics_schema(m) -> dict | None:
             wire = {"auc": "AUC", "aic": "AIC", "mse": "MSE",
                     "rmse": "RMSE", "gini": "Gini"}.get(f, f)
             out[wire] = _clean(v)
+    cmn = getattr(m, "custom_metric_name", None)
+    if cmn is not None:
+        out["custom_metric_name"] = cmn
+        out["custom_metric_value"] = _clean(
+            getattr(m, "custom_metric_value", None))
     cm = getattr(m, "confusion_matrix", None)
     if cm is not None:
         out["cm"] = {"table": _clean(cm)}

@@ -1,0 +1,149 @@
+"""WebHDFS persist backend — the `h2o-persist-hdfs` analog over plain HTTP.
+
+The reference's PersistHdfs (`h2o-persist-hdfs/src/main/java/water/persist/
+PersistHdfs.java`, 583 LoC) links the Hadoop client libraries; there is no
+Hadoop runtime in this image, so `hdfs://` rides the WebHDFS REST API
+instead (`?op=OPEN/CREATE/LISTSTATUS/GETFILESTATUS/MKDIRS/DELETE`) with
+nothing but stdlib HTTP — the same design as the S3 SigV4 and GCS JSON-API
+backends in io/cloud.py.
+
+Endpoint resolution, in order:
+- ``H2O_TPU_WEBHDFS_URL`` — explicit base, e.g. ``http://namenode:9870``
+  (the hdfs:// URI's own authority names the RPC port, not the HTTP one);
+- otherwise the URI authority with port ``H2O_TPU_WEBHDFS_PORT`` (default
+  9870, the Hadoop 3 namenode HTTP port).
+
+Auth is WebHDFS "simple" (``user.name=`` query param, ``H2O_TPU_HDFS_USER``
+or ``USER``); Kerberos-secured clusters need SPNEGO on this seam (see
+utils/krb.py). CREATE/OPEN follow the namenode's 307 redirect to a datanode
+manually — urllib will not replay a PUT body through a redirect.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+
+_CHUNK = 1 << 20
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None  # surface 3xx as HTTPError("redirect")
+
+
+_OPENER = urllib.request.build_opener(_NoRedirect)
+
+
+def _base_url(authority: str) -> str:
+    env = os.environ.get("H2O_TPU_WEBHDFS_URL")
+    if env:
+        return env.rstrip("/")
+    host = authority.split(":")[0] or "localhost"
+    port = os.environ.get("H2O_TPU_WEBHDFS_PORT", "9870")
+    return f"http://{host}:{port}"
+
+
+def _split(uri: str) -> tuple[str, str]:
+    """hdfs://authority/path → (authority, /path)."""
+    rest = uri.split("://", 1)[1]
+    authority, _, path = rest.partition("/")
+    return authority, "/" + path
+
+
+def _url(uri: str, op: str, **params) -> str:
+    authority, path = _split(uri)
+    q = {"op": op, **params}
+    user = os.environ.get("H2O_TPU_HDFS_USER") or os.environ.get("USER")
+    if user:
+        q["user.name"] = user
+    return (f"{_base_url(authority)}/webhdfs/v1"
+            f"{urllib.parse.quote(path)}?{urllib.parse.urlencode(q)}")
+
+
+def _request(url: str, method: str = "GET", data=None,
+             follow: bool = True):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        return _OPENER.open(req, timeout=120)
+    except urllib.error.HTTPError as e:
+        if follow and e.code in (301, 302, 307):
+            loc = e.headers.get("Location")
+            if not loc:
+                raise
+            e.close()
+            return _OPENER.open(
+                urllib.request.Request(loc, data=data, method=method),
+                timeout=600)
+        raise
+
+
+def hdfs_get(uri: str) -> str:
+    """OPEN → local temp file (namenode 307 → datanode stream)."""
+    from .cloud import _stream_to_tmp
+
+    with _request(_url(uri, "OPEN"), "GET") as resp:
+        return _stream_to_tmp(resp, uri, "h2o_tpu_hdfs_")
+
+
+def hdfs_put(uri: str, local_path: str) -> None:
+    """CREATE, two-step per the WebHDFS spec: a bodyless PUT to the
+    namenode answers 307 with the datanode Location; the bytes then STREAM
+    to that URL (http.client reads file objects in blocks — a large model
+    never materializes in memory)."""
+    url = _url(uri, "CREATE", overwrite="true")
+    loc = url  # direct-accepting server: re-PUT the body to the same URL
+    try:
+        resp = _OPENER.open(urllib.request.Request(url, method="PUT"),
+                            timeout=120)
+        resp.close()
+    except urllib.error.HTTPError as e:
+        if e.code not in (301, 302, 307):
+            raise
+        loc = e.headers.get("Location") or url
+        e.close()
+    size = os.path.getsize(local_path)
+    with open(local_path, "rb") as fh:
+        req = urllib.request.Request(loc, data=fh, method="PUT")
+        req.add_header("Content-Length", str(size))
+        req.add_header("Content-Type", "application/octet-stream")
+        _OPENER.open(req, timeout=600).close()
+
+
+def hdfs_list(uri: str) -> list[str]:
+    """LISTSTATUS → child paths under the URI (one level)."""
+    with _request(_url(uri, "LISTSTATUS"), "GET") as resp:
+        doc = json.loads(resp.read())
+    base = uri.rstrip("/")
+    out = []
+    for st in doc.get("FileStatuses", {}).get("FileStatus", []):
+        name = st.get("pathSuffix", "")
+        out.append(f"{base}/{name}" if name else base)
+    return out
+
+
+def hdfs_status(uri: str) -> dict:
+    with _request(_url(uri, "GETFILESTATUS"), "GET") as resp:
+        return json.loads(resp.read())["FileStatus"]
+
+
+def hdfs_mkdirs(uri: str) -> bool:
+    with _request(_url(uri, "MKDIRS"), "PUT") as resp:
+        return bool(json.loads(resp.read()).get("boolean"))
+
+
+def hdfs_delete(uri: str, recursive: bool = False) -> bool:
+    url = _url(uri, "DELETE", recursive=str(recursive).lower())
+    with _request(url, "DELETE") as resp:
+        return bool(json.loads(resp.read()).get("boolean"))
+
+
+def register_all() -> None:
+    from .persist import register_scheme, register_store
+
+    register_scheme("hdfs", hdfs_get)
+    register_store("hdfs", hdfs_put)

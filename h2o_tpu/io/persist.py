@@ -3,8 +3,8 @@
 Analog of the `water/persist/Persist.java` SPI + `PersistManager` scheme
 routing (local FS, NFS, HDFS, S3, GCS, HTTP in the reference; each backend a
 separate gradle module). Local paths, http(s), s3:// (stdlib SigV4, see
-io/cloud.py) and gs:// (GCS JSON API) are built in; hdfs raises a clear gate
-(no Hadoop in the image — the SPI point to extend is `register_scheme`)."""
+io/cloud.py), gs:// (GCS JSON API) and hdfs:// (WebHDFS REST, io/hdfs.py)
+are built in; the SPI point to extend is `register_scheme`."""
 
 from __future__ import annotations
 
@@ -50,11 +50,11 @@ def localize(path: str) -> str:
     scheme = path.split("://", 1)[0].lower()
     if scheme in _SCHEMES:
         return _SCHEMES[scheme](path)
-    if scheme in ("hdfs", "drive"):
+    if scheme == "drive":
         raise NotImplementedError(
-            f"persist backend '{scheme}://' needs its runtime (not in this "
-            f"image); register one with h2o_tpu.io.persist.register_scheme("
-            f"'{scheme}', fetch_fn) — the Persist SPI hook")
+            "persist backend 'drive://' needs its runtime (not in this "
+            "image); register one with h2o_tpu.io.persist.register_scheme("
+            "'drive', fetch_fn) — the Persist SPI hook")
     raise ValueError(f"unknown URI scheme in {path!r}")
 
 
@@ -82,5 +82,7 @@ def store(uri: str, local_path: str) -> str:
 
 
 from . import cloud as _cloud  # noqa: E402  (registers s3/gs handlers)
+from . import hdfs as _hdfs  # noqa: E402  (registers hdfs via WebHDFS)
 
 _cloud.register_all()
+_hdfs.register_all()

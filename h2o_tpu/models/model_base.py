@@ -400,6 +400,11 @@ class ModelBuilder:
         """One extra scoring pass evaluating the user's metric UDF, attached
         to the training metrics — `hex/CMetricScoringTask` role."""
         cmf = getattr(self.params, "custom_metric_func", None)
+        if isinstance(cmf, str) and cmf.startswith("python:"):
+            # wire-uploaded UDF reference (`water/udf/CFuncRef` format)
+            from .custom_udf import resolve_custom_metric
+
+            cmf = resolve_custom_metric(cmf)
         m = model.output.training_metrics
         if not callable(cmf) or m is None or not self.supervised:
             return

@@ -85,9 +85,10 @@ class TestPersistSPI:
         p.write_text("a\n1\n")
         assert localize(f"file://{p}") == str(p)
         assert localize(str(p)) == str(p)
-        # s3/gs are real backends now (io/cloud.py); hdfs remains gated
-        with pytest.raises(NotImplementedError, match="hdfs"):
-            localize("hdfs://nn/key.csv")
+        # s3/gs/hdfs are real backends now (io/cloud.py, io/hdfs.py);
+        # drive remains gated
+        with pytest.raises(NotImplementedError, match="drive"):
+            localize("drive://nn/key.csv")
         with pytest.raises(ValueError, match="unknown URI scheme"):
             localize("bogus://x")
 
