@@ -1600,13 +1600,69 @@ def _train_body(params: dict, x, y, training_frame, validation_frame,
     return body
 
 
+#: algo -> parameter-name set for client-side validation (None = unknown,
+#: keep trying). Module-level by ALGO, never a class attribute: a class-
+#: level cache would leak through inheritance and poison every subclass.
+_VALID_PARAMS: dict = {}
+
+
+def _valid_param_names(algo: str) -> set | None:
+    """Per-algo parameter names — the client-side validation surface
+    h2o-py's generated estimators carry (`estimator_base.py` rejects
+    unknown kwargs locally). Connected clients read the server's
+    `/3/ModelBuilders/{algo}` metadata (no local modeling-stack import);
+    in-process sessions fall back to the registry. None when neither is
+    reachable — validation is skipped, the server still rejects."""
+    if algo in _VALID_PARAMS:
+        return _VALID_PARAMS[algo]
+    names = None
+    if _conn is not None:
+        try:
+            meta = _conn.request("GET", f"/3/ModelBuilders/{algo}")
+            names = {p["name"] for p in meta.get("parameters", [])}
+        except Exception:
+            names = None
+    if names is None:
+        try:
+            from ..models import registry
+
+            entry = registry.lookup(algo)
+            if entry is not None:
+                import dataclasses
+
+                names = {f.name for f in dataclasses.fields(entry[1])}
+        except Exception:
+            names = None
+    if names:
+        _VALID_PARAMS[algo] = names
+    return names
+
+
 class H2OEstimator:
     """Base estimator: collects kwargs, posts to /3/ModelBuilders/{algo},
-    polls the job, exposes the trained model."""
+    polls the job, exposes the trained model. Unknown keyword arguments
+    fail at CONSTRUCTION with the valid-names list, like h2o-py's
+    generated per-algo estimators."""
 
     algo = None
 
     def __init__(self, **params):
+        cls = type(self)
+        valid = _valid_param_names(self.algo) if self.algo else None
+        if valid:
+            unknown = sorted(k for k in params if k not in valid)
+            if unknown:
+                import difflib
+
+                hints = {
+                    k: difflib.get_close_matches(k, valid, n=1)
+                    for k in unknown}
+                hint_txt = "; ".join(
+                    f"'{k}'" + (f" (did you mean '{m[0]}'?)" if m else "")
+                    for k, m in hints.items())
+                raise TypeError(
+                    f"{cls.__name__} got unknown parameter(s) {hint_txt}. "
+                    f"Valid parameters: {sorted(valid)}")
         self._params = params
         self._model: H2OModelClient | None = None
 

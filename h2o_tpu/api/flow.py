@@ -1,0 +1,198 @@
+"""Minimal interactive Flow — the `h2o-web` notebook's working core.
+
+One static HTML page (no build step) over the JSON API: list/inspect
+frames, import a file, launch a training run with live job progress, and
+inspect the resulting model's metrics. The reference ships a full
+CoffeeScript notebook IDE (`h2o-web/README.md:1-20`); this covers the
+quickstart's browser flow end-to-end against the same REST routes.
+"""
+
+FLOW_HTML = """<!doctype html><html><head><title>h2o_tpu flow</title><style>
+body{font-family:monospace;margin:1.5em;background:#fafafa;color:#222}
+h1{color:#333;margin-bottom:0}h2{color:#444;border-bottom:1px solid #ddd}
+table{border-collapse:collapse;margin:.6em 0}td,th{border:1px solid #ccc;
+padding:3px 9px;text-align:left}th{background:#eee}
+a{color:#06c;cursor:pointer;text-decoration:underline}
+input,select{font-family:monospace;margin:2px;padding:2px 4px}
+button{font-family:monospace;padding:3px 10px;cursor:pointer}
+#detail{background:#fff;border:1px solid #ccc;padding:.8em;margin:.8em 0}
+.err{color:#b00}.ok{color:#080}#jobstate{font-weight:bold}
+small{color:#777}</style></head><body>
+<h1>h2o_tpu</h1><div id=cloud><small>connecting…</small></div>
+
+<h2>Import</h2>
+<form id=importform onsubmit="return doImport(event)">
+<input id=importpath size=60 placeholder="/path/or/uri/to/data.csv">
+<button>Import &amp; parse</button> <span id=importmsg></span></form>
+
+<h2>Frames</h2><table id=frames></table>
+
+<h2>Train</h2>
+<form id=trainform onsubmit="return doTrain(event)">
+algo <select id=algo></select>
+frame <select id=trframe></select>
+response <select id=trresp></select>
+params <input id=trparams size=32 placeholder='{"ntrees": 20}'>
+<button>Train</button>
+<div>job <span id=jobkey>—</span> <span id=jobstate></span>
+<progress id=jobbar max=1 value=0></progress> <span id=jobmsg></span></div>
+</form>
+
+<h2>Models</h2><table id=models></table>
+<h2>Jobs</h2><table id=jobs></table>
+<div id=detail><small>click a frame or model key to inspect it</small></div>
+
+<script>
+async function j(u, opts){const r = await fetch(u, opts);
+ const body = await r.json();
+ if(!r.ok) throw new Error(body.msg || r.statusText); return body}
+function row(cells, links){const tr = document.createElement('tr');
+ cells.forEach(function(c, i){const td = document.createElement('td');
+  if(links && links[i]){const a = document.createElement('a');
+   a.textContent = c==null?'':String(c); a.onclick = links[i];
+   td.appendChild(a)}
+  else td.textContent = c==null?'':String(c);
+  tr.appendChild(td)}); return tr}
+function fill(id, head, rows){const t = document.getElementById(id);
+ t.replaceChildren(); const hr = document.createElement('tr');
+ head.forEach(function(h){const th = document.createElement('th');
+  th.textContent = h; hr.appendChild(th)}); t.appendChild(hr);
+ rows.forEach(function(r){t.appendChild(r)})}
+function opt(sel, vals, keep){const s = document.getElementById(sel);
+ const cur = s.value; s.replaceChildren();
+ vals.forEach(function(v){const o = document.createElement('option');
+  o.value = o.textContent = v; s.appendChild(o)});
+ if(keep && vals.indexOf(cur) >= 0) s.value = cur}
+
+async function inspectFrame(fid){
+ const fr = (await j('/3/Frames/' + encodeURIComponent(fid)
+   + '/summary')).frames[0];
+ const d = document.getElementById('detail');
+ d.replaceChildren();
+ d.insertAdjacentHTML('beforeend',
+  '<b></b> — ' + fr.rows + ' rows × ' + fr.num_columns + ' cols');
+ d.querySelector('b').textContent = fid;
+ const t = document.createElement('table');
+ const hr = document.createElement('tr');
+ ['column','type','min','mean','max','missing'].forEach(function(h){
+  const th = document.createElement('th'); th.textContent = h;
+  hr.appendChild(th)}); t.appendChild(hr);
+ fr.columns.forEach(function(c){
+  t.appendChild(row([c.label, c.type,
+   c.mins && c.mins.length ? c.mins[0] : '',
+   c.mean == null ? '' : Number(c.mean).toFixed(4),
+   c.maxs && c.maxs.length ? c.maxs[0] : '', c.missing_count]))});
+ d.appendChild(t)}
+
+async function inspectModel(mid){
+ const m = (await j('/3/Models/' + encodeURIComponent(mid))).models[0];
+ const d = document.getElementById('detail');
+ d.replaceChildren();
+ d.insertAdjacentHTML('beforeend', '<b></b> — ' + m.algo + ' ('
+   + m.output.model_category + ')');
+ d.querySelector('b').textContent = mid;
+ const tm = m.output.training_metrics || {};
+ const t = document.createElement('table');
+ const hr = document.createElement('tr');
+ ['metric','value'].forEach(function(h){const th =
+  document.createElement('th'); th.textContent = h; hr.appendChild(th)});
+ t.appendChild(hr);
+ Object.keys(tm).forEach(function(k){
+  if(typeof tm[k] === 'number')
+   t.appendChild(row([k, Number(tm[k]).toFixed(6)]))});
+ d.appendChild(t)}
+
+async function loadRespCols(fid){
+ // columns of the SELECTED frame only — the listing stays O(frames)
+ const d = await j('/3/Frames/' + encodeURIComponent(fid) + '/columns');
+ opt('trresp', d.frames[0].columns.map(function(c){return c.label}), true)}
+
+async function refresh(){
+ try{
+  const c = await j('/3/Cloud');
+  document.getElementById('cloud').textContent = 'cloud ' + c.cloud_name
+    + ' v' + c.version + ' — ' + c.nodes[0].num_cpus
+    + ' device(s), backend ' + c.nodes[0].backend;
+  const fr = await j('/3/Frames');
+  fill('frames', ['key','rows','cols'], fr.frames.map(function(f){
+   const fid = f.frame_id.name;
+   return row([fid, f.rows, f.num_columns],
+              [function(){inspectFrame(fid)}, null, null])}));
+  const hadSel = document.getElementById('trframe').value;
+  opt('trframe', fr.frames.map(function(f){return f.frame_id.name}), true);
+  const sel = document.getElementById('trframe').value;
+  if(sel && sel !== hadSel) await loadRespCols(sel);
+  const mo = await j('/3/Models');
+  fill('models', ['key','algo','category'], mo.models.map(function(m){
+   const mid = m.model_id.name;
+   return row([mid, m.algo, m.output.model_category],
+              [function(){inspectModel(mid)}, null, null])}));
+  const jb = await j('/3/Jobs');
+  fill('jobs', ['key','description','status','progress'],
+   jb.jobs.map(function(x){return row([x.key.name, x.description,
+    x.status, (100 * x.progress).toFixed(0) + '%'])}));
+ }catch(e){document.getElementById('cloud').textContent =
+   'error: ' + e.message}}
+
+async function doImport(ev){
+ ev.preventDefault();
+ const msg = document.getElementById('importmsg');
+ try{
+  const path = document.getElementById('importpath').value;
+  const imp = await j('/3/ImportFiles?path=' + encodeURIComponent(path));
+  if(imp.fails.length) throw new Error('not found: ' + imp.fails[0]);
+  const setup = await j('/3/ParseSetup', {method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body: JSON.stringify({source_frames: imp.files})});
+  const parse = await j('/3/Parse', {method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body: JSON.stringify({source_frames: imp.files,
+                         destination_frame: setup.destination_frame})});
+  await pollJob(parse.job.key.name);
+  msg.className = 'ok'; msg.textContent = 'parsed → '
+    + setup.destination_frame;
+  refresh();
+ }catch(e){msg.className = 'err'; msg.textContent = e.message}
+ return false}
+
+async function pollJob(key){
+ for(;;){
+  const jj = (await j('/3/Jobs/' + encodeURIComponent(key))).jobs[0];
+  document.getElementById('jobkey').textContent = key;
+  document.getElementById('jobstate').textContent = jj.status;
+  document.getElementById('jobbar').value = jj.progress;
+  if(jj.status === 'DONE') return jj;
+  if(jj.status === 'FAILED') throw new Error(jj.exception || 'job failed');
+  if(jj.status === 'CANCELLED') throw new Error('job cancelled');
+  await new Promise(function(res){setTimeout(res, 300)})}}
+
+async function doTrain(ev){
+ ev.preventDefault();
+ const msg = document.getElementById('jobmsg');
+ msg.textContent = ''; msg.className = '';
+ try{
+  const algo = document.getElementById('algo').value;
+  const body = JSON.parse(
+    document.getElementById('trparams').value || '{}');
+  body.training_frame = document.getElementById('trframe').value;
+  body.response_column = document.getElementById('trresp').value;
+  const resp = await j('/3/ModelBuilders/' + algo, {method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body: JSON.stringify(body)});
+  const done = await pollJob(resp.job.key.name);
+  msg.className = 'ok';
+  msg.textContent = 'model → ' + done.dest.name;
+  refresh(); inspectModel(done.dest.name);
+ }catch(e){msg.className = 'err'; msg.textContent = e.message}
+ return false}
+
+async function boot(){
+ try{const mb = await j('/3/ModelBuilders');
+  opt('algo', mb.model_builders ? Object.keys(mb.model_builders)
+      : mb.algos || []);
+ }catch(e){}
+ document.getElementById('trframe').onchange = function(){
+  loadRespCols(document.getElementById('trframe').value)};
+ refresh(); setInterval(refresh, 3000)}
+boot();
+</script></body></html>"""

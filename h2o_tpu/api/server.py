@@ -46,7 +46,7 @@ class H2OServer:
                  hash_login: dict | str | None = None,
                  ssl_certfile: str | None = None,
                  ssl_keyfile: str | None = None,
-                 auth_check=None):
+                 auth_check=None, negotiate_auth=None):
         """`hash_login`: {user: sha256-hex-or-plain} dict or a realm file of
         `user:sha256hex` lines — the `-hash_login` basic-auth analog
         (`h2o-security`, `water/webserver/H2OHttpViewImpl` auth hook).
@@ -56,11 +56,15 @@ class H2OServer:
         pluggable seam JAAS login modules fill in the reference).
         `ssl_certfile`/`ssl_keyfile` terminate TLS on the REST socket — the
         `-jks`/https role of `water/network/SSLSocketChannelFactory`."""
-        if auth_check is not None and hash_login:
-            raise ValueError("hash_login and auth_check are mutually "
-                             "exclusive — auth_check would silently lock "
-                             "hash_login users out")
+        if sum(x is not None and x != {} for x in
+               (auth_check, hash_login, negotiate_auth)) > 1:
+            raise ValueError("hash_login, auth_check and negotiate_auth are "
+                             "mutually exclusive — one mechanism owns the "
+                             "port, like the reference's login-module flags")
         self.auth_check = auth_check
+        #: SPNEGO acceptor (`utils/krb.py` SpnegoAuth) — the `-spnego_login`
+        #: role; when set, 401s advertise `WWW-Authenticate: Negotiate`
+        self.negotiate_auth = negotiate_auth
         self.port = port
         self.name = name
         self.started_at = time.time()
@@ -79,6 +83,8 @@ class H2OServer:
         self.hash_login = hash_login
 
     def check_auth(self, header: str | None) -> bool:
+        if self.negotiate_auth is not None:
+            return self.negotiate_auth.check_header(header) is not None
         if not self.hash_login and self.auth_check is None:
             return True
         if not header or not header.startswith("Basic "):
@@ -230,8 +236,9 @@ def _make_handler(server: H2OServer):
         def _route(self, method: str):
             if not server.check_auth(self.headers.get("Authorization")):
                 self.send_response(401)
-                self.send_header("WWW-Authenticate",
-                                 'Basic realm="h2o_tpu"')
+                challenge = ("Negotiate" if server.negotiate_auth is not None
+                             else 'Basic realm="h2o_tpu"')
+                self.send_header("WWW-Authenticate", challenge)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
@@ -277,40 +284,6 @@ def _make_handler(server: H2OServer):
 # ---------------------------------------------------------------------------
 # routing table (`RequestServer.java:157` route registration)
 # ---------------------------------------------------------------------------
-_FLOW_HTML = """<!doctype html><html><head><title>h2o_tpu</title><style>
-body{font-family:monospace;margin:2em;background:#fafafa}h1{color:#333}
-table{border-collapse:collapse;margin:1em 0}td,th{border:1px solid #ccc;
-padding:4px 10px;text-align:left}th{background:#eee}</style></head><body>
-<h1>h2o_tpu</h1><div id=cloud></div>
-<h2>Frames</h2><table id=frames><tr><th>key</th><th>rows</th><th>cols</th></tr></table>
-<h2>Models</h2><table id=models><tr><th>key</th><th>algo</th><th>category</th></tr></table>
-<h2>Jobs</h2><table id=jobs><tr><th>key</th><th>description</th><th>status</th><th>progress</th></tr></table>
-<script>
-async function j(u){return (await fetch(u)).json()}
-function row(cells){const tr=document.createElement('tr');
- for(const c of cells){const td=document.createElement('td');
-  td.textContent=c==null?'':String(c);tr.appendChild(td)}return tr}
-function fill(id,head,rows){const t=document.getElementById(id);
- t.replaceChildren();const hr=document.createElement('tr');
- for(const h of head){const th=document.createElement('th');
-  th.textContent=h;hr.appendChild(th)}t.appendChild(hr);
- for(const r of rows)t.appendChild(row(r))}
-async function refresh(){
- const c=await j('/3/Cloud');
- document.getElementById('cloud').textContent=
-   `cloud ${c.cloud_name} v${c.version} — ${c.nodes[0].num_cpus} device(s), backend ${c.nodes[0].backend}`;
- const fr=await j('/3/Frames');
- fill('frames',['key','rows','cols'],fr.frames.map(f=>[f.frame_id.name,f.rows,f.num_columns]));
- const mo=await j('/3/Models');
- fill('models',['key','algo','category'],mo.models.map(m=>[m.model_id.name,m.algo,m.output.model_category]));
- const jb=await j('/3/Jobs');
- fill('jobs',['key','description','status','progress'],
-   jb.jobs.map(x=>[x.key.name,x.description,x.status,(100*x.progress).toFixed(0)+'%']));
-}
-refresh();setInterval(refresh,2000);
-</script></body></html>"""
-
-
 def _post_file(handler, query: dict) -> tuple[int, dict]:
     """`POST /3/PostFile[.bin]` (`water/api/PostFileServlet.java:14`): spool
     the pushed bytes server-side and register them in the DKV under
@@ -455,9 +428,12 @@ def _resolve_upload(source: str) -> tuple[str, str]:
 def route(server: H2OServer, method: str, parts: list[str], query: dict,
           body: dict) -> tuple[int, dict]:
     if not parts or parts[0] in ("flow", "index.html"):
-        # minimal Flow stand-in: a live status page over the JSON API
-        # (the reference serves the h2o-flow notebook UI here, `h2o-web/`)
-        return 200, {"__html__": _FLOW_HTML}
+        # minimal interactive Flow over the JSON API: import/parse, frame
+        # and model inspection, train form with live job progress (the
+        # reference serves the h2o-flow notebook IDE here, `h2o-web/`)
+        from .flow import FLOW_HTML
+
+        return 200, {"__html__": FLOW_HTML}
     ver, rest = parts[0], parts[1:]
     if ver not in ("3", "99", "4"):
         return _err(404, f"unknown api version {ver}")

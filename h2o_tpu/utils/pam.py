@@ -1,0 +1,114 @@
+"""PAM authentication — the `h2o-jaas-pam` analog (`de/codedo/jaas/
+PamLoginModule.java`), straight onto ``libpam`` through ctypes (no JAAS, no
+python-pam dependency: the conversation callback supplies the password and
+``pam_authenticate`` + ``pam_acct_mgmt`` decide).
+
+Usage: ``H2OServer(auth_check=PamAuth(service="login"))`` — the same
+pluggable Basic-auth seam LDAP uses (`utils/ldap.py`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+
+PAM_SUCCESS = 0
+PAM_PROMPT_ECHO_OFF = 1
+PAM_PROMPT_ECHO_ON = 2
+
+
+class _PamMessage(ctypes.Structure):
+    _fields_ = [("msg_style", ctypes.c_int), ("msg", ctypes.c_char_p)]
+
+
+class _PamResponse(ctypes.Structure):
+    _fields_ = [("resp", ctypes.c_char_p), ("resp_retcode", ctypes.c_int)]
+
+
+#: int conv(int num_msg, const struct pam_message **msg,
+#:          struct pam_response **resp, void *appdata) — Linux-PAM passes an
+#: array of POINTERS to messages (msg[i] is message i)
+_CONV_FUNC = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.POINTER(_PamMessage)),
+    ctypes.POINTER(ctypes.POINTER(_PamResponse)), ctypes.c_void_p)
+
+
+class _PamConv(ctypes.Structure):
+    _fields_ = [("conv", _CONV_FUNC), ("appdata_ptr", ctypes.c_void_p)]
+
+
+def _load_libpam():
+    name = ctypes.util.find_library("pam") or "libpam.so.0"
+    lib = ctypes.CDLL(name)
+    lib.pam_start.restype = ctypes.c_int
+    lib.pam_start.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.POINTER(_PamConv),
+                              ctypes.POINTER(ctypes.c_void_p)]
+    lib.pam_authenticate.restype = ctypes.c_int
+    lib.pam_authenticate.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pam_acct_mgmt.restype = ctypes.c_int
+    lib.pam_acct_mgmt.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pam_end.restype = ctypes.c_int
+    lib.pam_end.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    return lib
+
+
+_LIBC = ctypes.CDLL(None)
+_LIBC.malloc.restype = ctypes.c_void_p  # default int truncates on 64-bit
+_LIBC.malloc.argtypes = [ctypes.c_size_t]
+
+
+def make_conv(password: str) -> _PamConv:
+    """The PAM conversation: answer every echo-off/echo-on prompt with the
+    password (the PamLoginModule does exactly this for its two-prompt
+    flow). Responses must be malloc'd — pam frees them."""
+    pw = password.encode()
+
+    def conv(n_msg, msgs, resp_out, appdata):
+        # pam frees the response array AND each resp string: allocate both
+        # with libc malloc, never python-managed memory
+        arr = _LIBC.malloc(n_msg * ctypes.sizeof(_PamResponse))
+        if not arr:
+            return 5  # PAM_BUF_ERR
+        ctypes.memset(arr, 0, n_msg * ctypes.sizeof(_PamResponse))
+        responses = ctypes.cast(arr, ctypes.POINTER(_PamResponse))
+        for i in range(n_msg):
+            style = msgs[i].contents.msg_style
+            if style in (PAM_PROMPT_ECHO_OFF, PAM_PROMPT_ECHO_ON):
+                buf = _LIBC.malloc(len(pw) + 1)
+                ctypes.memmove(buf, pw + b"\0", len(pw) + 1)
+                responses[i].resp = ctypes.cast(buf, ctypes.c_char_p)
+        resp_out[0] = responses
+        return PAM_SUCCESS
+
+    cb = _CONV_FUNC(conv)
+    out = _PamConv(cb, None)
+    out._cb_ref = cb  # the struct stores only the C pointer: keep the
+    out._fn_ref = conv  # python objects alive for the pam handle's lifetime
+    return out
+
+
+class PamAuth:
+    """``(user, password) -> bool`` against a PAM service stack."""
+
+    def __init__(self, service: str = "login"):
+        self.service = service
+        self._lib = _load_libpam()
+
+    def __call__(self, user: str, password: str) -> bool:
+        if not user or "\0" in user or "\0" in password:
+            return False
+        conv = make_conv(password)
+        handle = ctypes.c_void_p()
+        rc = self._lib.pam_start(self.service.encode(), user.encode(),
+                                 ctypes.byref(conv), ctypes.byref(handle))
+        if rc != PAM_SUCCESS:
+            return False
+        try:
+            rc = self._lib.pam_authenticate(handle, 0)
+            if rc != PAM_SUCCESS:
+                return False
+            return self._lib.pam_acct_mgmt(handle, 0) == PAM_SUCCESS
+        finally:
+            self._lib.pam_end(handle, rc)

@@ -212,9 +212,23 @@ class TestRestApi:
 
     def test_unknown_param_rejected(self, csv_frame):
         fr, _ = csv_frame
-        bad = h2o.H2OGradientBoostingEstimator(learnrate=0.5)  # typo
-        with pytest.raises(h2o.H2OConnectionError, match="unknown parameter"):
-            bad.train(y="y", training_frame=fr)
+        # typo'd kwargs now fail CLIENT-side at construction (h2o-py
+        # estimator_base behavior); the server's 412-style rejection still
+        # guards raw REST posts
+        with pytest.raises(TypeError, match="unknown parameter"):
+            h2o.H2OGradientBoostingEstimator(learnrate=0.5)
+        import json
+        import urllib.request
+
+        body = json.dumps({"training_frame": fr.frame_id,
+                           "response_column": "y",
+                           "learnrate": 0.5}).encode()
+        req = urllib.request.Request(
+            h2o.connection().url + "/3/ModelBuilders/gbm", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
 
     def test_setitem_new_and_overwrite(self, cloud):
         fr = h2o.H2OFrame({"a": [1.0, 2.0, 3.0], "b": [4.0, 5.0, 6.0]})
