@@ -502,8 +502,10 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         path0, name0 = _resolve_upload(paths[0])
         setup = guess_setup(path0)
         ext = name0.rsplit(".", 1)[-1].lower()
-        if setup.column_names is None and ext not in (
-                "parquet", "pq", "orc", "avro", "svm", "svmlight", "xlsx"):
+        from ..io.parser import BINARY_FORMAT_EXTS
+
+        if setup.column_names is None and \
+                "." + ext not in BINARY_FORMAT_EXTS:
             # sample the head for names/types the way ParseSetupHandler's
             # preview pass does (`water/parser/ParseSetup.java` guessSetup)
             names, types = _csv_head_preview(path0, setup)
@@ -511,6 +513,7 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             if setup.column_types is None:
                 setup.column_types = types
         ptype = {"parquet": "PARQUET", "pq": "PARQUET", "orc": "ORC",
+                 "xls": "XLS", "xlsx": "XLSX",
                  "svm": "SVMLight", "svmlight": "SVMLight"}.get(ext, "CSV")
         return 200, {
             "source_frames": [schemas.key_schema(s) for s in paths],

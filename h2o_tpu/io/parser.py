@@ -30,6 +30,12 @@ from ..frame.vec import T_CAT, T_INT, T_NUM, T_STR, T_TIME, Vec
 #: NA token vocabulary — mirrors `water/parser/ParseSetup` NA string handling.
 DEFAULT_NA_STRINGS = ["", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "?", "None"]
 
+#: extensions whose content is NOT line-oriented text — CSV head sampling
+#: (separator/header/column-name guessing) must skip these. ONE list shared
+#: by guess_setup and the ParseSetup REST preview so they cannot drift.
+BINARY_FORMAT_EXTS = (".parquet", ".pq", ".orc", ".avro", ".svm",
+                      ".svmlight", ".xlsx", ".xls")
+
 
 class ParseSetup:
     """Parse configuration, guessed from a sample or user-overridden.
@@ -61,8 +67,7 @@ class ParseSetup:
 def guess_setup(path: str, setup: ParseSetup | None = None) -> ParseSetup:
     """Sample the file head and guess separator/header (ParseSetup pass 1)."""
     setup = setup or ParseSetup()
-    if path.endswith((".parquet", ".pq", ".orc", ".avro", ".svm", ".svmlight",
-                      ".xlsx")):
+    if path.endswith(BINARY_FORMAT_EXTS):
         return setup
     if path.endswith(".gz"):
         import gzip as _gzip
@@ -140,6 +145,8 @@ def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
         return _parse_avro(path, mesh=mesh, dest_key=dest_key)
     elif ext == ".xlsx":
         return _parse_xlsx(path, mesh=mesh, dest_key=dest_key)
+    elif ext == ".xls":
+        return _parse_xls(path, mesh=mesh, dest_key=dest_key)
     elif ext in (".svm", ".svmlight"):
         return _parse_svmlight(path, mesh=mesh, dest_key=dest_key)
     elif ext == ".arff":
@@ -331,13 +338,11 @@ def _parse_avro(path: str, mesh=None, dest_key: str | None = None) -> Frame:
     return fr
 
 
-def _parse_xlsx(path: str, mesh=None, dest_key: str | None = None) -> Frame:
-    """XLSX ingest (`water/parser/XlsParser.java` role, `io/xlsx.py`
-    stdlib-zip reader): header row + typed columns, string columns interned
-    to categoricals like the CSV path."""
-    from .xlsx import read_xlsx
-
-    header, rows = read_xlsx(path)
+def _spreadsheet_to_frame(header, rows, mesh=None,
+                          dest_key: str | None = None) -> Frame:
+    """Shared cell-grid → Frame step for the XLSX and legacy XLS readers:
+    header row + typed columns, string columns interned to categoricals
+    like the CSV path."""
     # dedupe duplicate header names (cbind-style suffixing) — a dict would
     # silently drop all but the last same-named column
     seen: dict[str, int] = {}
@@ -352,7 +357,7 @@ def _parse_xlsx(path: str, mesh=None, dest_key: str | None = None) -> Frame:
     header = uniq
     out = {}
     for j, name in enumerate(header):
-        vals = [r[j] for r in rows]
+        vals = [r[j] if j < len(r) else None for r in rows]
         if any(isinstance(v, str) for v in vals):
             import pyarrow as pa
 
@@ -365,6 +370,31 @@ def _parse_xlsx(path: str, mesh=None, dest_key: str | None = None) -> Frame:
     fr = Frame(list(out), list(out.values()), key=dest_key)
     STORE.put_keyed(fr)
     return fr
+
+
+def _parse_xlsx(path: str, mesh=None, dest_key: str | None = None) -> Frame:
+    """XLSX ingest (`water/parser/XlsParser.java` role, `io/xlsx.py`
+    stdlib-zip reader)."""
+    from .xlsx import read_xlsx
+
+    header, rows = read_xlsx(path)
+    return _spreadsheet_to_frame(header, rows, mesh=mesh, dest_key=dest_key)
+
+
+def _parse_xls(path: str, mesh=None, dest_key: str | None = None) -> Frame:
+    """Legacy BIFF8 .xls ingest (`water/parser/XlsParser.java` analog,
+    `io/xls.py` OLE2+BIFF reader). First row = header, matching the
+    XLSX reader's spreadsheet header convention."""
+    from .xls import cells_to_rows, parse_xls_cells
+
+    with open(path, "rb") as fh:
+        grid = cells_to_rows(parse_xls_cells(fh.read()))
+    if not grid:
+        raise ValueError(f"xls: no cells in {path}")
+    header = [str(v) if v is not None else f"C{i + 1}"
+              for i, v in enumerate(grid[0])]
+    return _spreadsheet_to_frame(header, grid[1:], mesh=mesh,
+                                 dest_key=dest_key)
 
 
 def _parse_svmlight(path: str, mesh=None, dest_key=None) -> Frame:

@@ -61,7 +61,8 @@ def spool_stream(stream, length: int, suffix: str = ".bin") -> tuple[str, int]:
 #: (the reference's ParseSetup sniffs content the same way, `water/parser/
 #: ZipUtil.java` + format guessers). Extension hints always win over magic.
 _MAGIC = [(b"\x1f\x8b", ".gz"), (b"PAR1", ".parquet"),
-          (b"Obj\x01", ".avro"), (b"PK\x03\x04", ".zip")]
+          (b"Obj\x01", ".avro"), (b"\xd0\xcf\x11\xe0", ".xls"),
+          (b"PK\x03\x04", ".zip")]
 
 
 def guess_suffix(*name_hints: str, head: bytes = b"") -> str:

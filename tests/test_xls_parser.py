@@ -1,0 +1,361 @@
+"""Legacy BIFF8 .xls parser (`water/parser/XlsParser.java` role, io/xls.py).
+
+No .xls fixtures exist anywhere in the image (reference smalldata is not
+checked out), so the test builds fixtures with an INDEPENDENT spec-driven
+generator below: a real OLE2 compound file (header, FAT, directory,
+MiniStream+MiniFAT for the small-stream path) wrapping a BIFF8 Workbook
+stream (BOF/BOUNDSHEET/SST/LABELSST/NUMBER/RK/MULRK/BOOLERR/EOF). The
+generator follows [MS-CFB]/[MS-XLS] directly — it shares no code or layout
+assumptions with the reader. The parse is then asserted equal to the SAME
+sheet written as .xlsx through the existing writer — the "parse identically
+to their .xlsx twins" criterion.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from h2o_tpu.io.parser import parse_file
+from h2o_tpu.io.xls import cells_to_rows, parse_xls_cells
+from h2o_tpu.io.xlsx import write_xlsx
+
+FREE = 0xFFFFFFFF
+END = 0xFFFFFFFE
+
+
+# ---------------------------------------------------------------------------
+# independent BIFF8 + OLE2 fixture generator
+# ---------------------------------------------------------------------------
+def _rec(rid, payload):
+    return struct.pack("<HH", rid, len(payload)) + payload
+
+
+def _unistr(s, compressed=True):
+    if compressed:
+        return struct.pack("<HB", len(s), 0) + s.encode("latin-1")
+    return struct.pack("<HB", len(s), 1) + s.encode("utf-16-le")
+
+
+def _biff_workbook(header, rows):
+    """Workbook globals + one worksheet, cells typed per value."""
+    strings = []
+
+    def sst_index(s):
+        if s not in strings:
+            strings.append(s)
+        return strings.index(s)
+
+    sheet_cells = []
+    grid = [list(header)] + [list(r) for r in rows]
+    for r, row in enumerate(grid):
+        for c, v in enumerate(row):
+            if v is None:
+                continue
+            if isinstance(v, tuple):  # explicit record-type override
+                kind, val = v
+                sheet_cells.append((kind, r, c,
+                                    sst_index(val) if kind == "labelsst"
+                                    else val))
+            elif isinstance(v, bool):
+                sheet_cells.append(("boolerr", r, c, v))
+            elif isinstance(v, str):
+                sheet_cells.append(("labelsst", r, c, sst_index(v)))
+            elif isinstance(v, float) and v == int(v) and abs(v) < 2**29 \
+                    and (r + c) % 2 == 0:
+                sheet_cells.append(("rk_int", r, c, int(v)))
+            else:
+                sheet_cells.append(("number", r, c, float(v)))
+
+    def _rk(v: int) -> int:
+        rk = (v << 2) | 2
+        if v < 0:
+            rk = (((v + (1 << 30)) << 2) | 2) | 0x80000000
+        return rk & 0xFFFFFFFF
+
+    # worksheet substream: coalesce CONSECUTIVE rk_int cells in one row
+    # into a MULRK record (how Excel actually writes them)
+    ws = _rec(0x809, struct.pack("<HHHHH", 0x600, 0x10, 0, 0, 0))
+    i = 0
+    while i < len(sheet_cells):
+        kind, r, c, v = sheet_cells[i]
+        run = [v]
+        while (kind == "rk_int" and i + len(run) < len(sheet_cells)
+               and sheet_cells[i + len(run)][:3] == ("rk_int", r,
+                                                     c + len(run))):
+            run.append(sheet_cells[i + len(run)][3])
+        if kind == "rk_int" and len(run) > 1:
+            body = struct.pack("<HH", r, c)
+            for rv in run:
+                body += struct.pack("<HI", 0, _rk(rv))
+            body += struct.pack("<H", c + len(run) - 1)
+            ws += _rec(0xBD, body)  # MULRK
+            i += len(run)
+            continue
+        if kind == "number":
+            ws += _rec(0x203, struct.pack("<HHH", r, c, 0)
+                       + struct.pack("<d", v))
+        elif kind == "rk_int":
+            ws += _rec(0x27E, struct.pack("<HHHI", r, c, 0, _rk(v)))
+        elif kind == "labelsst":
+            ws += _rec(0xFD, struct.pack("<HHHI", r, c, 0, v))
+        elif kind == "boolerr":
+            ws += _rec(0x205, struct.pack("<HHHBB", r, c, 0, int(v), 0))
+        elif kind == "formula_num":
+            res = struct.pack("<d", v)
+            ws += _rec(0x6, struct.pack("<HHH", r, c, 0) + res
+                       + struct.pack("<HI", 0, 0))
+        elif kind == "label":
+            ws += _rec(0x204, struct.pack("<HHH", r, c, 0) + _unistr(v))
+        i += 1
+    ws += _rec(0xA, b"")  # EOF
+
+    # globals substream: BOF, BOUNDSHEET (offset patched below), SST, EOF
+    sst_payload = struct.pack("<II", len(strings), len(strings))
+    for s in strings:
+        sst_payload += _unistr(s, compressed=all(ord(ch) < 256 for ch in s))
+    # BOUNDSHEET uses the 8-bit-length string form
+    bs_name = struct.pack("<B", len("Sheet1")) + b"\0" + b"Sheet1"
+    glob = _rec(0x809, struct.pack("<HHHHH", 0x600, 0x5, 0, 0, 0))
+    bs_placeholder = _rec(0x85, struct.pack("<IH", 0, 0) + bs_name)
+    glob_rest = _rec(0xFC, sst_payload) + _rec(0xA, b"")
+    sheet_off = len(glob) + len(bs_placeholder) + len(glob_rest)
+    bs = _rec(0x85, struct.pack("<IH", sheet_off, 0) + bs_name)
+    return glob + bs + glob_rest + ws
+
+
+def _ole2(stream: bytes, force_big: bool = False) -> bytes:
+    """Wrap one 'Workbook' stream in a minimal OLE2 compound file.
+    Streams < 4096 bytes go to the MiniStream (per spec) unless forced."""
+    sector = 512
+    mini = 64
+    use_mini = len(stream) < 4096 and not force_big
+
+    def pad(b, size):
+        return b + b"\0" * (-len(b) % size)
+
+    sectors = []  # data sectors after the header, fat ids assigned in order
+    fat = []
+
+    def add(data):
+        start = len(sectors)
+        chunks = [data[i:i + sector] for i in range(0, len(data), sector)]
+        for i, ch in enumerate(chunks):
+            sectors.append(pad(ch, sector))
+            fat.append(start + i + 1 if i + 1 < len(chunks) else END)
+        return start
+
+    if use_mini:
+        ministream = pad(stream, mini)
+        n_mini = len(ministream) // mini
+        minifat = b"".join(
+            struct.pack("<I", i + 1 if (i + 1) * mini < len(stream) else END)
+            for i in range(n_mini))
+        wb_start, wb_size = 0, len(stream)
+        ms_start = add(ministream)         # root's ministream chain
+        minifat_start = add(pad(minifat, sector))
+        root_size = len(ministream)
+    else:
+        wb_start = add(pad(stream, sector))
+        wb_size = len(stream)
+        ms_start, minifat_start, root_size = END, END, 0
+
+    # directory: Root Entry + Workbook
+    def dirent(name, etype, start, size, child=FREE):
+        raw = name.encode("utf-16-le") + b"\0\0"
+        e = raw + b"\0" * (64 - len(raw))
+        e += struct.pack("<H", len(raw))
+        e += bytes([etype, 0])
+        e += struct.pack("<III", FREE, FREE, child)
+        e += b"\0" * 16 + b"\0" * 4 + b"\0" * 8 + b"\0" * 8
+        e += struct.pack("<II", start, size)
+        e += b"\0" * 4
+        assert len(e) == 128, len(e)
+        return e
+
+    directory = (dirent("Root Entry", 5,
+                        ms_start if use_mini else 0, root_size, child=1)
+                 + dirent("Workbook", 2, wb_start, wb_size)
+                 + b"\xff" * 0)
+    dir_start = add(pad(directory, sector))
+
+    # FAT itself occupies sectors; assign after data
+    n_data = len(sectors)
+    n_fat_sectors = 1
+    while (n_data + n_fat_sectors) * 4 > n_fat_sectors * sector:
+        n_fat_sectors += 1
+    fat_start = len(sectors)
+    for i in range(n_fat_sectors):
+        fat.append(0xFFFFFFFD)  # FAT sector marker
+        sectors.append(b"")     # placeholder
+    fat_bytes = pad(b"".join(struct.pack("<I", f) for f in fat), sector)
+    for i in range(n_fat_sectors):
+        sectors[fat_start + i] = pad(
+            fat_bytes[i * sector:(i + 1) * sector], sector)
+
+    header = bytearray(512)
+    header[0:8] = b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1"
+    struct.pack_into("<H", header, 26, 0x3E)   # minor version
+    struct.pack_into("<H", header, 28, 0x3)    # major version 3
+    struct.pack_into("<H", header, 24, 0)
+    struct.pack_into("<H", header, 30, 9)      # sector shift 512
+    struct.pack_into("<H", header, 32, 6)      # mini shift 64
+    struct.pack_into("<I", header, 44, n_fat_sectors)
+    struct.pack_into("<I", header, 48, dir_start)
+    struct.pack_into("<I", header, 56, 4096)   # mini cutoff
+    struct.pack_into("<I", header, 60,
+                     minifat_start if use_mini else END)
+    struct.pack_into("<I", header, 64, 1 if use_mini else 0)
+    struct.pack_into("<I", header, 68, END)    # no DIFAT sectors
+    struct.pack_into("<I", header, 72, 0)
+    difat = [fat_start + i for i in range(n_fat_sectors)]
+    difat += [FREE] * (109 - len(difat))
+    struct.pack_into("<109I", header, 76, *difat)
+    return bytes(header) + b"".join(sectors)
+
+
+def _write_xls(path, header, rows, force_big=False):
+    with open(path, "wb") as fh:
+        fh.write(_ole2(_biff_workbook(header, rows), force_big=force_big))
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+HEADER = ["name", "age", "score", "big"]
+ROWS = [
+    ["alice", 31.0, 4.25, 1234567.0],
+    ["bob", 47.0, -3.5, None],
+    ["carol", 19.0, 0.001, 77.0],
+    ["dave", -5.0, 100.0, 0.0],
+]
+
+
+def test_cells_roundtrip_ministream(tmp_path):
+    xls = tmp_path / "t.xls"
+    _write_xls(xls, HEADER, ROWS)
+    grid = cells_to_rows(parse_xls_cells(xls.read_bytes()))
+    assert grid[0] == HEADER
+    for want, got in zip(ROWS, grid[1:]):
+        for w, g in zip(want, got):
+            if w is None:
+                assert g is None
+            elif isinstance(w, str):
+                assert g == w
+            else:
+                assert abs(g - w) < 1e-9, (w, g)
+
+
+def test_cells_roundtrip_regular_fat_stream(tmp_path):
+    # >4096-byte workbook exercises the regular FAT chain, MULRK-free
+    big_rows = [[f"row{i}", float(i), float(i) * 0.5, float(i * i)]
+                for i in range(300)]
+    xls = tmp_path / "big.xls"
+    _write_xls(xls, HEADER, big_rows, force_big=True)
+    grid = cells_to_rows(parse_xls_cells(xls.read_bytes()))
+    assert len(grid) == 301
+    assert grid[150][0] == "row149"
+    assert abs(grid[150][3] - 149.0 ** 2) < 1e-9
+
+
+def test_xls_parses_identically_to_xlsx_twin(tmp_path):
+    """The VERDICT done-criterion: the same sheet as .xls and .xlsx must
+    produce identical frames through parse_file."""
+    xls = tmp_path / "twin.xls"
+    xlsx = tmp_path / "twin.xlsx"
+    _write_xls(xls, HEADER, ROWS)
+    write_xlsx(str(xlsx), HEADER, ROWS)
+    fa = parse_file(str(xls))
+    fb = parse_file(str(xlsx))
+    assert fa.names == fb.names
+    assert fa.nrow == fb.nrow
+    for name in fa.names:
+        va, vb = fa.vec(name), fb.vec(name)
+        assert va.type == vb.type, name
+        if va.is_categorical():
+            assert va.domain == vb.domain
+        np.testing.assert_allclose(va.to_numpy(), vb.to_numpy(),
+                                   rtol=1e-12, atol=0, equal_nan=True)
+
+
+def test_all_record_types_parse(tmp_path):
+    """MULRK (coalesced consecutive RK run), BOOLERR, inline LABEL, and
+    FORMULA cached numbers — every cell-record branch the reader carries."""
+    header = ["a", "b", "c", "d", "e"]
+    rows = [
+        # row of consecutive RK ints → ONE MULRK record
+        [("rk_int", 2), ("rk_int", 4), ("rk_int", 6), ("rk_int", 8),
+         ("rk_int", 10)],
+        [True, False, ("label", "inline"), ("formula_num", 12.5), 3.25],
+    ]
+    xls = tmp_path / "rec.xls"
+    _write_xls(xls, header, rows)
+    raw = xls.read_bytes()
+    # the writer really did emit the records under test
+    from h2o_tpu.io.xls import ole2_stream
+
+    stream = ole2_stream(raw, "Workbook")
+    ids = [struct.unpack_from("<H", stream, 0)]  # just sanity on access
+    found = set()
+    pos = 0
+    while pos + 4 <= len(stream):
+        rid, ln = struct.unpack_from("<HH", stream, pos)
+        found.add(rid)
+        pos += 4 + ln
+    assert {0xBD, 0x205, 0x204, 0x6} <= found, hex(sorted(found)[0])
+    grid = cells_to_rows(parse_xls_cells(raw))
+    assert grid[1] == [2.0, 4.0, 6.0, 8.0, 10.0]
+    assert grid[2][0] == 1.0 and grid[2][1] == 0.0     # BOOLERR
+    assert grid[2][2] == "inline"                      # LABEL
+    assert grid[2][3] == 12.5                          # FORMULA cached
+    assert grid[2][4] == 3.25
+
+
+def test_sst_continuation_mid_string(tmp_path):
+    """Excel splits SST character data across CONTINUE records, re-emitting
+    a grbit byte at the boundary (and may switch width). Build that layout
+    explicitly and require exact strings back."""
+    # SST with 3 strings; the second splits mid-characters at a CONTINUE
+    # whose fresh grbit switches compressed -> utf-16
+    s1, s2a, s2b, s3 = "first", "long-", "tailž", "third"
+    sst1 = struct.pack("<II", 3, 3)
+    sst1 += _unistr(s1)
+    sst1 += struct.pack("<HB", len(s2a) + len(s2b), 0) + s2a.encode()
+    cont = bytes([1]) + s2b.encode("utf-16-le")  # fresh grbit: wide
+    cont += _unistr(s3)
+    stream = (_rec(0x809, struct.pack("<HHHHH", 0x600, 0x5, 0, 0, 0))
+              + _rec(0x85, struct.pack("<IH", 0, 0)
+                     + struct.pack("<B", 6) + b"\0" + b"Sheet1"))
+    # patch BOUNDSHEET offset afterwards: compute stream layout first
+    body = _rec(0xFC, sst1) + _rec(0x3C, cont) + _rec(0xA, b"")
+    ws = (_rec(0x809, struct.pack("<HHHHH", 0x600, 0x10, 0, 0, 0))
+          + _rec(0xFD, struct.pack("<HHHI", 0, 0, 0, 1))
+          + _rec(0xFD, struct.pack("<HHHI", 0, 1, 0, 2))
+          + _rec(0xA, b""))
+    sheet_off = len(stream) + len(body)
+    stream = (_rec(0x809, struct.pack("<HHHHH", 0x600, 0x5, 0, 0, 0))
+              + _rec(0x85, struct.pack("<IH", sheet_off, 0)
+                     + struct.pack("<B", 6) + b"\0" + b"Sheet1")
+              + body + ws)
+    cells = parse_xls_cells(_ole2(stream))
+    assert cells[(0, 0)] == s2a + s2b
+    assert cells[(0, 1)] == s3
+
+
+def test_utf16_strings_and_magic_guess(tmp_path):
+    rows = [["žluťoučký", 1.0], ["ascii", 2.0]]
+    xls = tmp_path / "uni.xls"
+    _write_xls(xls, ["s", "x"], rows)
+    grid = cells_to_rows(parse_xls_cells(xls.read_bytes()))
+    assert grid[1][0] == "žluťoučký"
+    # the upload magic sniffer recognizes the OLE2 signature
+    from h2o_tpu.io.upload import guess_suffix
+
+    assert guess_suffix("noext", head=xls.read_bytes()[:8]) == ".xls"
+
+
+def test_non_ole2_rejected(tmp_path):
+    bad = tmp_path / "bad.xls"
+    bad.write_bytes(b"this is not a compound document at all")
+    with pytest.raises(ValueError, match="OLE2"):
+        parse_xls_cells(bad.read_bytes())
