@@ -18,7 +18,8 @@
   stop("jsonlite is required for the R client")
 }
 
-.h2o.request <- function(method, path, body = NULL, params = NULL) {
+.h2o.request <- function(method, path, body = NULL, params = NULL,
+                         upload = NULL) {
   url <- paste0(get("url", envir = .h2o), path)
   if (!is.null(params)) {
     qs <- paste(mapply(function(k, v) paste0(k, "=", utils::URLencode(
@@ -28,7 +29,12 @@
   }
   h <- curl::new_handle()
   curl::handle_setopt(h, customrequest = method)
-  if (!is.null(body)) {
+  if (!is.null(upload)) {
+    # raw octet-stream push (POST /3/PostFile) — the bytes of a local file
+    raw <- readBin(upload, what = "raw", n = file.info(upload)$size)
+    curl::handle_setopt(h, postfieldsize = length(raw), postfields = raw)
+    curl::handle_setheaders(h, "Content-Type" = "application/octet-stream")
+  } else if (!is.null(body)) {
     json <- if (requireNamespace("jsonlite", quietly = TRUE))
       jsonlite::toJSON(body, auto_unbox = TRUE) else stop("jsonlite required")
     curl::handle_setopt(h, postfields = as.character(json))
@@ -161,3 +167,79 @@ h2o.rmse <- function(model) h2o.performance(model)$RMSE
 h2o.saveMojo <- function(model, path) .h2o.request(
   "GET", paste0("/3/Models/", model$model_id, "/mojo"),
   params = list(dir = path))$dir
+
+# -- binary model persistence over the wire (`h2o-r` h2o.saveModel /
+#    h2o.loadModel; the /99/Models.bin routes) --------------------------------
+h2o.saveModel <- function(model, path, force = FALSE) .h2o.request(
+  "GET", paste0("/99/Models.bin/", model$model_id),
+  params = list(dir = path, force = tolower(as.character(force))))$dir
+
+h2o.loadModel <- function(path) {
+  res <- .h2o.request("POST", "/99/Models.bin", body = list(dir = path))
+  mid <- res$models[[1]]$model_id$name
+  structure(list(model_id = mid,
+                 schema = .h2o.request("GET", paste0("/3/Models/", mid)
+                                       )$models[[1]]),
+            class = "H2OModel")
+}
+
+h2o.getModel <- function(id) structure(
+  list(model_id = id,
+       schema = .h2o.request("GET", paste0("/3/Models/", id))$models[[1]]),
+  class = "H2OModel")
+
+# -- file upload: as.h2o on a data.frame writes a CSV and pushes it through
+#    POST /3/PostFile, then parses the upload key (h2o-r as.h2o.data.frame) --
+h2o.uploadFile <- function(path, destination_frame = NULL) {
+  raw <- .h2o.request("POST", "/3/PostFile",
+                      params = list(filename = basename(path)),
+                      upload = path)
+  setup <- .h2o.request("POST", "/3/ParseSetup",
+                        body = list(source_frames = list(raw$destination_frame)))
+  dest <- destination_frame %||% setup$destination_frame
+  job <- .h2o.request("POST", "/3/Parse",
+                      body = list(source_frames = list(raw$destination_frame),
+                                  destination_frame = dest))
+  done <- .h2o.poll(job)
+  structure(list(frame_id = done$dest$name), class = "H2OFrame")
+}
+
+as.h2o <- function(df, destination_frame = NULL) {
+  tmp <- tempfile(fileext = ".csv")
+  utils::write.csv(df, tmp, row.names = FALSE)
+  on.exit(unlink(tmp))
+  h2o.uploadFile(tmp, destination_frame = destination_frame)
+}
+
+# -- frame verbs over rapids / REST ------------------------------------------
+h2o.ncol <- function(fr) .h2o.request(
+  "GET", paste0("/3/Frames/", fr$frame_id, "/summary")
+  )$frames[[1]]$num_columns
+
+h2o.head <- function(fr, n = 6) .h2o.request(
+  "GET", paste0("/3/Frames/", fr$frame_id),
+  params = list(row_count = n))$frames[[1]]
+
+h2o.describe <- function(fr) .h2o.request(
+  "GET", paste0("/3/Frames/", fr$frame_id, "/summary"))$frames[[1]]$columns
+
+h2o.splitFrame <- function(fr, ratios = 0.75, seed = -1) {
+  res <- .h2o.request("POST", "/3/SplitFrame",
+                      body = list(dataset = fr$frame_id,
+                                  ratios = as.list(ratios), seed = seed))
+  lapply(res$destination_frames, function(k) h2o.getFrame(k$name))
+}
+
+h2o.exportFile <- function(fr, path, force = FALSE) invisible(
+  .h2o.request("POST", paste0("/3/Frames/", fr$frame_id, "/export"),
+               params = list(path = path,
+                             force = tolower(as.character(force)))))
+
+h2o.varimp <- function(model)
+  model$schema$output$variable_importances
+
+h2o.confusionMatrix <- function(model)
+  h2o.performance(model)$cm$table
+
+h2o.logloss <- function(model) h2o.performance(model)$logloss
+h2o.mse <- function(model) h2o.performance(model)$MSE

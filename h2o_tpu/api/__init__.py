@@ -11,9 +11,10 @@ from .client import (H2OConnection, H2OConnectionError, H2OEstimator,
                      export_file, get_frame, get_model, get_timezone,
                      as_list, download_model, import_file, init, interaction,
                      list_timezones, load_model, ls, rapids, remove,
-                     save_model, set_timezone, shutdown,
+                     import_mojo, save_model, set_timezone, shutdown,
                      upload_custom_metric, upload_file, upload_frame,
-                     upload_model)
+                     upload_model, upload_mojo)
+from .client import H2OGenericEstimator
 from .client import (H2OAdaBoostEstimator, H2OANOVAGLMEstimator,
                      H2OAggregatorEstimator,
                      H2OCoxProportionalHazardsEstimator,

@@ -406,6 +406,30 @@ def upload_custom_metric(func, func_file: str = "metrics.py",
     return f"python:{key}={path}"
 
 
+def import_mojo(path: str) -> "H2OModelClient":
+    """`h2o.import_mojo`: load a SERVER-side MOJO zip as a Generic model
+    (`hex/generic/Generic` — first-class import)."""
+    est = H2OGenericEstimator(path=path)
+    est.train(training_frame=None)
+    return est._model
+
+
+def upload_mojo(path: str) -> "H2OModelClient":
+    """`h2o.upload_mojo` (`h2o-py/h2o/h2o.py:2375`): push a CLIENT-side
+    MOJO through PostFile, then import it as a Generic model."""
+    c = connection()
+    resp = c.request("POST", "/3/PostFile",
+                     params={"filename": os.path.basename(path)},
+                     filename=path)
+    key = resp["destination_frame"]
+    # the upload key resolves to its spool path server-side via Parse's
+    # upload seam; Generic takes a filesystem path, so ask the server
+    # where the bytes landed through the ImportFiles echo
+    est = H2OGenericEstimator(path=key)
+    est.train(training_frame=None)
+    return est._model
+
+
 def upload_model(path: str) -> "H2OModelClient":
     """`h2o.upload_model` (`h2o-py/h2o/h2o.py:1563`): push a CLIENT-side
     binary model to the server — PostFile.bin then Models.upload.bin."""
@@ -1719,6 +1743,7 @@ H2OModelSelectionEstimator = _estimator("modelselection", "H2OModelSelectionEsti
 H2OTargetEncoderEstimator = _estimator("targetencoder", "H2OTargetEncoderEstimator")
 H2OAggregatorEstimator = _estimator("aggregator", "H2OAggregatorEstimator")
 H2OInfogram = _estimator("infogram", "H2OInfogram")
+H2OGenericEstimator = _estimator("generic", "H2OGenericEstimator")
 
 
 # ---------------------------------------------------------------------------

@@ -624,10 +624,48 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         entry = registry.lookup(algo)
         if entry is None:
             return _err(404, f"unknown algorithm {algo}")
+        if rest[2:] and rest[2] == "parameters" and method == "POST":
+            # validation-only pass (`ModelBuilderHandler.validate_parameters`
+            # — POST /3/ModelBuilders/{algo}/parameters): construct the
+            # builder, report messages, train NOTHING
+            messages = []
+            try:
+                kwargs = _resolve_params(entry[1], p)
+                entry[0](entry[1](**kwargs))
+            except (ValueError, TypeError, KeyError) as e:
+                messages.append({"message_type": "ERRR", "field_name": "",
+                                 "message": str(e)})
+            return 200, {"algo": algo,
+                         "parameters": registry.param_metadata(algo),
+                         "messages": messages,
+                         "error_count": len(messages)}
         if method == "POST":
             return _jobs_of(entry[0], entry[1], p)
         return 200, {"algo": algo,
                      "parameters": registry.param_metadata(algo)}
+
+    if head == "Word2VecSynonyms":
+        # `hex/api/Word2VecHandler.findSynonyms` (Word2VecSynonymsV3)
+        m = STORE.get(p.get("model", ""))
+        if m is None:
+            return _err(404, f"model {p.get('model')} not found")
+        count = int(p.get("count", 10) or 10)
+        syn = m.find_synonyms(p.get("word", ""), count=count)
+        return 200, {"model": schemas.key_schema(m.key, "Key<Model>"),
+                     "word": p.get("word", ""), "count": count,
+                     "synonyms": list(syn.keys()),
+                     "scores": [float(v) for v in syn.values()]}
+
+    if head == "Capabilities":
+        # `water/api/CapabilitiesHandler` — core/API/algo extension listing
+        core = [{"name": n, "extension_type": "core"}
+                for n in ("Algos", "AutoML", "TargetEncoder", "Infogram",
+                          "MOJO", "Grid", "SegmentModels")]
+        rest_caps = [{"name": "API v3", "extension_type": "rest"},
+                     {"name": "Rapids", "extension_type": "rest"}]
+        which = rest[1].lower() if rest[1:] else "all"
+        caps = {"core": core, "api": rest_caps}.get(which, core + rest_caps)
+        return 200, {"capabilities": caps}
 
     # -- models --------------------------------------------------------------
     if head == "Models":
@@ -1352,6 +1390,10 @@ _ROUTES_DOC = [
         ("GET", "/3/ModelBuilders", "list algorithms"),
         ("GET", "/3/ModelBuilders/{algo}", "algorithm parameter metadata"),
         ("POST", "/3/ModelBuilders/{algo}", "launch a training job"),
+        ("POST", "/3/ModelBuilders/{algo}/parameters",
+         "validate parameters without training"),
+        ("GET", "/3/Word2VecSynonyms", "nearest words in a w2v embedding"),
+        ("GET", "/3/Capabilities", "core + REST extension listing"),
         ("GET", "/3/Models", "list models"),
         ("GET", "/3/Models/{id}", "model detail"),
         ("GET", "/3/Models/{id}/mojo", "export MOJO"),

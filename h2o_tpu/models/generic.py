@@ -52,7 +52,17 @@ class Generic(ModelBuilder):
             raise ValueError("generic: 'path' to a MOJO file is required")
 
     def build_impl(self, job: Job) -> Model:
-        scorer = _MojoScorer.load(self.params.path)
+        path = self.params.path
+        # `h2o.upload_mojo` hands the PostFile upload KEY as the path —
+        # resolve it to the spooled bytes (the _mojo_key seam of
+        # `hex/generic/GenericModelParameters`)
+        from ..backend.kvstore import STORE
+        from ..io.upload import UploadedFile
+
+        obj = STORE.get(path) if isinstance(path, str) else None
+        if isinstance(obj, UploadedFile):
+            path = obj.path
+        scorer = _MojoScorer.load(path)
         output = ModelOutput()
         feats = (scorer.columns[:-1] if scorer.supervised
                  else list(scorer.columns))

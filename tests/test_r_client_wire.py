@@ -132,3 +132,83 @@ def test_train_predict_perf_mojo_sequence(cloud, csv_path, tmp_path):
 
     # h2o.rm
     _req("DELETE", "/3/Frames/r_wire_train")
+
+
+def test_save_load_model_sequence(cloud, csv_path, tmp_path):
+    """h2o.saveModel / h2o.loadModel / h2o.getModel replay."""
+    imp = _req("GET", "/3/ImportFiles", params={"path": csv_path})
+    job = _req("POST", "/3/Parse", body={"source_frames": imp["files"],
+                                         "destination_frame": "r_slm"})
+    _poll(job)
+    job = _req("POST", "/3/ModelBuilders/gbm",
+               body={"training_frame": "r_slm", "response_column": "y",
+                     "ntrees": 3, "seed": 1})
+    mid = _poll(job)["dest"]["name"]
+    # h2o.saveModel: GET /99/Models.bin/{id}?dir=&force=
+    saved = _req("GET", f"/99/Models.bin/{mid}",
+                 params={"dir": str(tmp_path / "rmodel.bin"),
+                         "force": "false"})
+    assert saved["dir"]  # the R code returns $dir
+    # h2o.loadModel: POST /99/Models.bin {dir}; R reads models[0].model_id.name
+    res = _req("POST", "/99/Models.bin", body={"dir": saved["dir"]})
+    assert res["models"][0]["model_id"]["name"] == mid
+    # h2o.getModel: GET /3/Models/{id}; R stores models[0] as schema
+    m = _req("GET", f"/3/Models/{mid}")["models"][0]
+    assert m["output"]["training_metrics"]["AUC"] is not None
+
+
+def test_upload_file_sequence(cloud, csv_path):
+    """h2o.uploadFile / as.h2o replay: raw octet-stream POST /3/PostFile
+    (exactly what the curl postfields push sends), then ParseSetup/Parse on
+    the upload key."""
+    import json
+    import urllib.request
+
+    with open(csv_path, "rb") as fh:
+        payload = fh.read()
+    req = urllib.request.Request(
+        h2o.connection().url + "/3/PostFile?filename=updata.csv",
+        data=payload, method="POST",
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req) as r:
+        raw = json.loads(r.read())
+    assert raw["destination_frame"]  # R reads $destination_frame
+    setup = _req("POST", "/3/ParseSetup",
+                 body={"source_frames": [raw["destination_frame"]]})
+    job = _req("POST", "/3/Parse",
+               body={"source_frames": [raw["destination_frame"]],
+                     "destination_frame": "r_upload"})
+    done = _poll(job)
+    assert done["dest"]["name"] == "r_upload"
+    summ = _req("GET", "/3/Frames/r_upload/summary")["frames"][0]
+    assert summ["rows"] == 300 and summ["num_columns"] == 3
+
+
+def test_frame_verbs_sequence(cloud, csv_path):
+    """h2o.head / h2o.describe / h2o.splitFrame / h2o.exportFile replay."""
+    if h2o.connection().request("GET", "/3/Frames")["frames"] is not None \
+            and "r_upload" not in [f["frame_id"]["name"] for f in
+                                   _req("GET", "/3/Frames")["frames"]]:
+        imp = _req("GET", "/3/ImportFiles", params={"path": csv_path})
+        _poll(_req("POST", "/3/Parse",
+                   body={"source_frames": imp["files"],
+                         "destination_frame": "r_upload"}))
+    head = _req("GET", "/3/Frames/r_upload",
+                params={"row_count": 6})["frames"][0]
+    assert len(head["columns"][0]["data"]) == 6  # h2o.head reads $data
+    desc = _req("GET", "/3/Frames/r_upload/summary")["frames"][0]["columns"]
+    assert {c["label"] for c in desc} == {"x1", "x2", "y"}
+    res = _req("POST", "/3/SplitFrame",
+               body={"dataset": "r_upload", "ratios": [0.75], "seed": 42})
+    parts = [k["name"] for k in res["destination_frames"]]
+    assert len(parts) == 2
+    n0 = _req("GET", f"/3/Frames/{parts[0]}/summary")["frames"][0]["rows"]
+    n1 = _req("GET", f"/3/Frames/{parts[1]}/summary")["frames"][0]["rows"]
+    assert n0 + n1 == 300
+    import tempfile as _tf
+
+    out = _tf.mktemp(suffix=".csv")
+    _req("POST", f"/3/Frames/{parts[0]}/export",
+         params={"path": out, "force": "true"})
+    assert os.path.exists(out)
+    os.unlink(out)
