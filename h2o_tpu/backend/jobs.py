@@ -21,6 +21,40 @@ class JobCancelled(Exception):
     pass
 
 
+#: long-lived server hygiene: XLA's compiler accumulates per-program state
+#: across hundreds of distinct trainings and the CPU backend has been
+#: observed to destabilize under it (the test suite resets per module —
+#: `tests/conftest.py`; a server process needs the same bound). After every
+#: H2O_TPU_CLEAR_CACHES_EVERY finished jobs (default 64, 0 disables) the
+#: NEXT job boundary drops XLA's compilation caches — compiled programs are
+#: re-derivable, so the only cost is a recompile on reuse.
+_jobs_finished = 0
+_jobs_lock = threading.Lock()
+
+
+def _note_job_finished() -> None:
+    global _jobs_finished
+    import os
+
+    every = int(os.environ.get("H2O_TPU_CLEAR_CACHES_EVERY", 64) or 0)
+    if every <= 0:
+        return
+    with _jobs_lock:
+        _jobs_finished += 1
+        due = _jobs_finished % every == 0
+    if due:
+        import gc
+
+        import jax
+
+        gc.collect()
+        jax.clear_caches()
+        from ..utils.log import info
+
+        info(f"cleared XLA compilation caches after {_jobs_finished} jobs "
+             "(H2O_TPU_CLEAR_CACHES_EVERY)")
+
+
 class Job(Keyed):
     CREATED = "CREATED"
     RUNNING = "RUNNING"
@@ -61,6 +95,7 @@ class Job(Keyed):
                 self.status = Job.FAILED
             finally:
                 self.end_time = time.time()
+                _note_job_finished()
 
         if background:
             self._thread = threading.Thread(target=_run, daemon=True, name=self.key)
