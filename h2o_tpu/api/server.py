@@ -37,6 +37,9 @@ from ..rapids.exec import Rapids, Session
 from . import schemas
 
 _SESSIONS: dict[str, Session] = {}
+#: `/3/SessionProperties` store, keyed (session_key, property) —
+#: `water/rapids/Session` attributes in the reference
+_SESSION_PROPS: dict[tuple[str, str], str | None] = {}
 
 
 class H2OServer:
@@ -68,6 +71,11 @@ class H2OServer:
         self.port = port
         self.name = name
         self.started_at = time.time()
+        #: last REST activity stamp — `/3/SteamMetrics` idle_millis source
+        self.last_activity = self.started_at
+        #: `POST /3/CloudLock` reason (`water/Paxos.lockCloud`); the cloud
+        #: here is born locked (single controller) so this is bookkeeping
+        self.locked_reason: str | None = "new cloud"
         self.httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self.ssl_certfile = ssl_certfile
@@ -220,7 +228,8 @@ def _make_handler(server: H2OServer):
                                  f'attachment; filename="{safe}"')
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
-            self.wfile.write(data)
+            if not getattr(self, "_suppress_body", False):
+                self.wfile.write(data)
 
         def _body(self) -> dict:
             n = int(self.headers.get("Content-Length") or 0)
@@ -234,6 +243,11 @@ def _make_handler(server: H2OServer):
                     for k, v in urllib.parse.parse_qs(raw).items()}
 
         def _route(self, method: str):
+            # handler instances persist across keep-alive requests; a HEAD
+            # must not leave the suppress-body flag set for the next request
+            head_only = getattr(self, "_head_only", False)
+            self._head_only = False
+            self._suppress_body = head_only
             if not server.check_auth(self.headers.get("Authorization")):
                 self.send_response(401)
                 challenge = ("Negotiate" if server.negotiate_auth is not None
@@ -246,6 +260,12 @@ def _make_handler(server: H2OServer):
             parts = [p for p in parsed.path.split("/") if p]
             query = {k: v[0] if len(v) == 1 else v
                      for k, v in urllib.parse.parse_qs(parsed.query).items()}
+            # monitoring polls don't count as activity for SteamMetrics'
+            # idle clock (`water/api/SteamMetricsHandler` semantics)
+            head = parts[1] if len(parts) > 1 else (parts[0] if parts else "")
+            if head not in ("Cloud", "Ping", "Jobs", "SteamMetrics",
+                            "Sample"):
+                server.last_activity = time.time()
             if method == "POST" and parts and \
                     parts[-1] in ("PostFile", "PostFile.bin"):
                 # binary body — must not go through the text _body() path
@@ -277,6 +297,11 @@ def _make_handler(server: H2OServer):
 
         def do_DELETE(self):
             self._route("DELETE")
+
+        def do_HEAD(self):
+            # `HEAD /3/Cloud` (RegisterV3Api) — same handler as GET, no body
+            self._head_only = True
+            self._route("GET")
 
     return Handler
 
@@ -445,7 +470,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
     head = rest[0]
 
     # -- cloud / about / shutdown -------------------------------------------
-    if head == "Cloud":
+    if head in ("Cloud", "Sample"):
+        # `GET /99/Sample` registers CloudHandler.status too
+        # (`RegisterV3Api.java:495` — "example of an experimental endpoint")
         import jax
 
         from ..backend.memory import CLEANER, hbm_stats
@@ -475,23 +502,36 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, {}
 
     # -- import / parse ------------------------------------------------------
-    if head == "ImportFiles":
-        path = p.get("path", "")
+    if head in ("ImportFiles", "ImportFilesMulti"):
+        # `POST /3/ImportFilesMulti` (`ImportFilesHandler.importFilesMulti`)
+        # is the same resolution over a `paths` array
+        paths_in = ([p.get("path", "")] if head == "ImportFiles"
+                    else p.get("paths") or [])
+        if isinstance(paths_in, str):
+            paths_in = [s.strip(" '\"") for s in
+                        paths_in.strip("[]").split(",") if s.strip(" '\"")]
         import glob as _glob
 
-        if "://" in path:  # URI schemes resolve through the Persist SPI
-            from ..io.persist import localize
+        hits, fails = [], []
+        for path in paths_in:
+            if "://" in path:  # URI schemes resolve through the Persist SPI
+                from ..io.persist import localize
 
-            try:
-                hits = [localize(path)]
-            except (OSError, ValueError, NotImplementedError):
-                hits = []
-        elif any(c in path for c in "*?["):
-            hits = sorted(_glob.glob(path))
-        else:
-            hits = [path] if os.path.exists(path) else []
+                try:
+                    hits.append(localize(path))
+                except (OSError, ValueError, NotImplementedError):
+                    fails.append(path)
+            elif any(c in path for c in "*?["):
+                got = sorted(_glob.glob(path))
+                hits.extend(got)
+                if not got:
+                    fails.append(path)
+            elif os.path.exists(path):
+                hits.append(path)
+            else:
+                fails.append(path)
         return 200, {"files": hits, "destination_frames": hits,
-                     "fails": [] if hits else [path], "dels": []}
+                     "fails": fails, "dels": []}
     if head == "ParseSetup" and method == "POST":
         from ..io.parser import guess_setup
 
@@ -562,6 +602,24 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if method == "GET" and not rest[1:]:
             frames = STORE.values(Frame)
             return 200, {"frames": [schemas.frame_base(f) for f in frames]}
+        if method == "DELETE" and not rest[1:]:
+            # `DELETE /3/Frames` — remove ALL frames (`FramesHandler.deleteAll`)
+            for k in STORE.keys(Frame):
+                STORE.remove(k)
+            return 200, {}
+        if method == "POST" and rest[1:] and rest[1] == "load":
+            # `POST /3/Frames/load` — binary frame import
+            # (`water/fvec/persist/FramePersist.loadFrom`)
+            from ..backend import persist
+
+            d = p.get("dir", "")
+            if not d:
+                return _err(400, "Frames/load: dir is required")
+            fr2 = persist.load_frame(d)
+            job = Job(f"Load frame {fr2.key}", work=1.0)
+            job.start(lambda: fr2, background=False)
+            return 200, {"job": schemas.job_schema(job),
+                         "frame_id": schemas.key_schema(fr2.key, "Key<Frame>")}
         fid = urllib.parse.unquote(rest[1]) if rest[1:] else None
         fr = STORE.get(fid)
         if not isinstance(fr, Frame):
@@ -569,6 +627,34 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if method == "DELETE":
             STORE.remove(fid)
             return 200, {}
+        if rest[2:] and rest[2] == "save" and method == "POST":
+            # `POST /3/Frames/{id}/save` — binary frame export
+            # (`water/fvec/persist/FramePersist.saveTo`)
+            from ..backend import persist
+
+            d = p.get("dir", "")
+            if not d:
+                return _err(400, "Frames/save: dir is required")
+            if not _truthy(p.get("force", True)) and os.path.exists(d):
+                return _err(400, f"Frames/save: {d} exists (use force)")
+            out = persist.save_frame(fr, d)
+            job = Job(f"Save frame {fid}", work=1.0)
+            job.start(lambda: out, background=False)
+            return 200, {"job": schemas.job_schema(job), "dir": out}
+        if rest[2:] and rest[2] == "light":
+            # `GET /3/Frames/{id}/light` (`FramesHandler.fetchLight`) —
+            # names/types only, no rollups and no row preview
+            return 200, {"frames": [{
+                "frame_id": schemas.key_schema(fr.key, "Key<Frame>"),
+                "rows": fr.nrow, "num_columns": fr.ncol,
+                "column_names": list(fr.names),
+                "column_types": [fr.vec(n).type for n in fr.names]}]}
+        if rest[2:] and rest[2] == "export" and \
+                method == "GET" and rest[3:]:
+            # `GET /3/Frames/{id}/export/{path}/overwrite/{force}`
+            p["path"] = urllib.parse.unquote(rest[3])
+            p["force"] = rest[5] if rest[5:] else "true"
+            method = "POST"  # fall through to the POST export body below
         if rest[2:] and rest[2] == "export" and method == "POST":
             # `water/api/FramesHandler.export` — CSV/parquet by extension
             path = p.get("path", "")
@@ -604,6 +690,46 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if rest[2:] and rest[2] == "summary":
             return 200, {"frames": [schemas.frame_schema(fr, npreview=0)]}
         if rest[2:] and rest[2] == "columns":
+            if rest[3:]:
+                # `GET /3/Frames/{id}/columns/{column}[/domain|/summary]`
+                col = urllib.parse.unquote(rest[3])
+                if col not in fr.names:
+                    return _err(404, f"column {col} not found in {fid}")
+                v = fr.vec(col)
+                if rest[4:] and rest[4] == "domain":
+                    # `FramesHandler.columnDomain` — levels + per-level counts
+                    if v.domain is None:
+                        return 200, {"domain": [None], "map_keys": None,
+                                     "num_levels": [0]}
+                    codes = v.to_numpy()
+                    counts = np.bincount(
+                        codes[~np.isnan(codes)].astype(np.int64),
+                        minlength=len(v.domain))
+                    return 200, {"domain": [list(v.domain)],
+                                 "map_keys": {"string": list(v.domain)},
+                                 "num_levels": [int(len(v.domain))],
+                                 "counts": [counts.tolist()]}
+                summary = schemas.col_summary(col, v, npreview=0)
+                if rest[4:] and rest[4] == "summary" and not v.is_string() \
+                        and v.data is not None:
+                    # `FramesHandler.columnSummary` — histogram + percentiles
+                    x = v.to_numpy()
+                    x = x[~np.isnan(x)]
+                    if x.size:
+                        counts, edges = np.histogram(x, bins=20)
+                        summary["histogram_bins"] = counts.tolist()
+                        summary["histogram_base"] = float(edges[0])
+                        summary["histogram_stride"] = float(
+                            edges[1] - edges[0])
+                        probs = [0.001, 0.01, 0.1, 0.25, 0.333, 0.5,
+                                 0.667, 0.75, 0.9, 0.99, 0.999]
+                        summary["percentiles"] = np.quantile(
+                            x, probs).tolist()
+                        summary["default_percentiles"] = probs
+                return 200, {"frames": [{
+                    "frame_id": schemas.key_schema(fr.key, "Key<Frame>"),
+                    "rows": fr.nrow, "num_columns": fr.ncol,
+                    "columns": [summary]}]}
             # columns-only payload, no row preview (`FramesHandler.columns`)
             full = schemas.frame_schema(fr, npreview=0)
             return 200, {"frames": [{
@@ -674,6 +800,11 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if method == "GET" and not rest[1:]:
             return 200, {"models": [schemas.model_schema(m)
                                     for m in STORE.values(Model)]}
+        if method == "DELETE" and not rest[1:]:
+            # `DELETE /3/Models` — remove ALL models (`ModelsHandler.deleteAll`)
+            for k in STORE.keys(Model):
+                STORE.remove(k)
+            return 200, {}
         mid = urllib.parse.unquote(rest[1]) if rest[1:] else None
         m = STORE.get(mid)
         if m is None:
@@ -959,8 +1090,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, {"job": {"status": "DONE",
                              "dest": schemas.key_schema(fid)}}
 
-    if head == "DownloadDataset":
-        # `water/api/DownloadDataHandler` — raw CSV body, not JSON
+    if head in ("DownloadDataset", "DownloadDataset.bin"):
+        # `water/api/DownloadDataHandler` — raw CSV body, not JSON; the .bin
+        # registration (`RegisterV3Api`) streams the same CSV for big frames
         fid = p.get("frame_id", "")
         fr2 = STORE.get(fid)
         if not isinstance(fr2, Frame):
@@ -1046,6 +1178,116 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
 
         return 200, {"cloud_uptime_millis": int(
             (_time.time() - server.started_at) * 1000), "cloud_healthy": True}
+    if head == "KillMinus3":
+        # `GET /3/KillMinus3` (`water/util/JStackCollectorTask`) — the JVM
+        # analog logs all stack traces to stdout; log the controller's here
+        import sys
+        import traceback as tb
+
+        from ..utils.log import info as _log_info
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            _log_info(f"KillMinus3 thread [{names.get(tid, tid)}]:\n"
+                      + "".join(tb.format_stack(frame)))
+        return 200, {}
+    if head == "CloudLock" and method == "POST":
+        # `water/api/CloudLockHandler` → Paxos.lockCloud(reason)
+        from ..utils.log import info as _log_info
+
+        reason = "requested via REST api." + (
+            f" Reason: {p['reason']}" if p.get("reason") else "")
+        server.locked_reason = reason
+        _log_info(f"Cloud locked: {reason}")
+        return 200, {"reason": p.get("reason")}
+    if head == "UnlockKeys" and method == "POST":
+        # `water/api/UnlockKeysHandler` → UnlockTask over all nodes; keys
+        # here carry no write-locks (single controller), so this releases
+        # nothing but keeps the verb for clients that call it defensively
+        return 200, {}
+    if head == "SessionProperties":
+        # `RapidsHandler.{get,set}SessionProperty` (RegisterV3Api:483-487)
+        sid = p.get("session_key", "default")
+        key = p.get("key", "")
+        if not key:
+            return _err(400, "SessionProperties: key is required")
+        if method == "POST":
+            _SESSION_PROPS[(sid, key)] = p.get("value")
+            return 200, {"session_key": sid, "key": key,
+                         "value": p.get("value")}
+        return 200, {"session_key": sid, "key": key,
+                     "value": _SESSION_PROPS.get((sid, key))}
+    if head == "SteamMetrics":
+        # `water/api/SteamMetricsHandler` — cluster idle time for Steam's
+        # auto-suspend decision
+        import time as _time
+
+        from ..backend.jobs import any_running
+
+        idle = 0 if any_running() else int(
+            (_time.time() - server.last_activity) * 1000)
+        return 200, {"version": 1, "idle_millis": idle}
+    if head == "Find":
+        # `water/api/FindHandler` — scan forward from `row` for `match`,
+        # reporting the previous and next hit row indices
+        fr2 = STORE.get(p.get("key", ""))
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {p.get('key')} not found")
+        names = [p["column"]] if p.get("column") else list(fr2.names)
+        if p.get("column") and p["column"] not in fr2.names:
+            return _err(404, f"column {p['column']} not found")
+        row = int(p.get("row", 0) or 0)
+        match = p.get("match")
+        prev_hit, next_hit = -1, -1
+        for name in names:
+            v = fr2.vec(name)
+            if v.is_string():
+                vals = np.asarray([x == match for x in v.host_data])
+            elif v.domain is not None:
+                if match not in v.domain:
+                    if len(names) == 1:
+                        return _err(404, f"level {match!r} not found in "
+                                         f"column {name}")
+                    continue
+                vals = fr2.vec(name).to_numpy() == v.domain.index(match)
+            else:
+                try:
+                    target = float("nan") if match is None else float(match)
+                except (TypeError, ValueError):
+                    if len(names) == 1:
+                        return _err(400, f"column {name} is numeric and the "
+                                         f"find pattern is not: {match!r}")
+                    continue
+                x = v.to_numpy()
+                vals = np.isnan(x) if np.isnan(target) else (x == target)
+            hits = np.flatnonzero(vals)
+            before = hits[hits < row]
+            after = hits[hits >= row]
+            if before.size:
+                prev_hit = max(prev_hit, int(before[-1]))
+            if after.size:
+                next_hit = int(after[0]) if next_hit < 0 \
+                    else min(next_hit, int(after[0]))
+        return 200, {"prev": prev_hit, "next": next_hit}
+    if head == "FrameChunks":
+        # `water/api/FrameChunksHandler` — chunk layout of a frame; chunks
+        # here are the row-shards of the device mesh
+        fid2 = urllib.parse.unquote(rest[1]) if rest[1:] else ""
+        fr2 = STORE.get(fid2)
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {fid2} not found")
+        shards = 1
+        if fr2.ncol and fr2.vecs[0].data is not None:
+            try:
+                shards = len(fr2.vecs[0].data.sharding.device_set)
+            except (AttributeError, TypeError):
+                shards = 1
+        per = -(-fr2.nrow // shards)  # even padded shards (the ESPC analog)
+        counts = [min(per, fr2.nrow - i * per) for i in range(shards)]
+        return 200, {"frame_id": schemas.key_schema(fid2, "Key<Frame>"),
+                     "chunks": [{"chunk_id": i, "row_count": max(c, 0),
+                                 "node_idx": i % shards}
+                                for i, c in enumerate(counts)]}
 
     # -- grid search (`POST /99/Grid/{algo}`, `GET /99/Grids[/{id}]`,
     #    `POST /3/Grid.bin/import`, `POST /3/Grid.bin/{id}/export` —
@@ -1218,6 +1460,14 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                               for j in STORE.values(Job)]}
 
     # -- rapids (`/99/Rapids`) ----------------------------------------------
+    if head == "Rapids" and method == "GET" and rest[1:] \
+            and rest[1] == "help":
+        # `GET /99/Rapids/help` (`RapidsHandler.genHelp`) — the language's
+        # registered primitives
+        from ..rapids.exec import _PRIMS
+
+        return 200, {"syntax": [
+            {"name": n, "is_abstract": False} for n in sorted(_PRIMS)]}
     if head == "Rapids" and method == "POST":
         ast = p.get("ast", "")
         sid = p.get("session_id", "default")
@@ -1226,9 +1476,12 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, _rapids_result(result)
     if head == "InitID":
         if method == "DELETE":
-            s = _SESSIONS.pop(rest[1] if rest[1:] else "default", None)
+            sid = rest[1] if rest[1:] else "default"
+            s = _SESSIONS.pop(sid, None)
             if s:
                 s.end()
+            for k in [k for k in _SESSION_PROPS if k[0] == sid]:
+                del _SESSION_PROPS[k]  # props die with their session
             return 200, {}
         sid = f"_sid_{np.random.randint(1 << 30)}"
         _SESSIONS[sid] = Session(sid)
@@ -1266,6 +1519,20 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
     if head == "Logs":
         from ..utils.log import get_buffer
 
+        if rest[1:] and rest[1] == "nodes" and len(rest) >= 5:
+            # `GET /3/Logs/nodes/{nodeidx}/files/{name}`
+            # (`water/api/LogsHandler`) — one controller, so every nodeidx
+            # serves the same ring; `name` filters by level prefix
+            name = rest[4].lower()
+            lines = get_buffer()
+            level_names = {"trace": "DEBUG", "debug": "DEBUG",
+                           "info": "INFO", "warn": "WARN",
+                           "error": "ERRR", "fatal": "FATAL"}
+            want = level_names.get(name)
+            if want:  # ring lines lead with "MM-DD HH:MM:SS LEVEL"
+                lines = [ln for ln in lines if want in ln[:30]]
+            return 200, {"log": "\n".join(lines),
+                         "name": rest[4], "nodeidx": int(rest[2])}
         return 200, {"log": "\n".join(get_buffer())}
     if head == "Timeline":
         from ..utils.timeline import snapshot
@@ -1330,6 +1597,17 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         # (`water/api/MetadataHandler`, consumed by h2o-bindings)
         sub = rest[1] if rest[1:] else "endpoints"
         if sub == "endpoints":
+            if rest[2:]:
+                # `GET /3/Metadata/endpoints/{path}` — one route, by index
+                # or by url fragment (`MetadataHandler.fetchRoute`)
+                which = urllib.parse.unquote("/".join(rest[2:]))
+                if which.isdigit() and int(which) < len(_ROUTES_DOC):
+                    return 200, {"routes": [_ROUTES_DOC[int(which)]]}
+                hits = [r for r in _ROUTES_DOC
+                        if which in r["url_pattern"]]
+                if not hits:
+                    return _err(404, f"no endpoint matching {which}")
+                return 200, {"routes": hits}
             return 200, {"routes": _ROUTES_DOC}
         if sub == "schemas":
             # reference schema-class naming (`hex/schemas/*V3`): acronym
@@ -1360,8 +1638,19 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                    "TimelineV3", "ProfilerV3", "NetworkTestV3",
                    "PartialDependenceV3", "PermutationVarImpV3",
                    "TwoDimTableV3", "KeyV3", "H2OErrorV3"})
+            if rest[2:]:
+                # `GET /3/Metadata/schemas/{schemaname}`
+                want = urllib.parse.unquote(rest[2])
+                if want not in names:
+                    return _err(404, f"unknown schema {want}")
+                return 200, {"schemas": [{"name": want, "version": 3}]}
             return 200, {"schemas": [{"name": n, "version": 3}
                                      for n in names]}
+        if sub == "schemaclasses" and rest[2:]:
+            # `GET /3/Metadata/schemaclasses/{classname}` — the schema-class
+            # view is the schema view here (no Java class layer)
+            want = urllib.parse.unquote(rest[2])
+            return 200, {"schemas": [{"name": want, "version": 3}]}
         return _err(404, f"unknown metadata view {sub}")
 
     return _err(404, f"no route for {method} /{'/'.join(parts)}")
@@ -1371,7 +1660,15 @@ _ROUTES_DOC = [
     {"http_method": m, "url_pattern": u, "summary": s}
     for m, u, s in [
         ("GET", "/3/Cloud", "cluster status"),
+        ("HEAD", "/3/Cloud", "cluster liveness, headers only"),
+        ("GET", "/99/Sample", "cluster status (experimental alias)"),
         ("GET", "/3/About", "version info"),
+        ("GET", "/3/KillMinus3", "log all stack traces"),
+        ("POST", "/3/CloudLock", "lock the cloud with a reason"),
+        ("POST", "/3/UnlockKeys", "unlock all write-locked keys"),
+        ("GET", "/3/SessionProperties", "read a session property"),
+        ("POST", "/3/SessionProperties", "set a session property"),
+        ("GET", "/3/SteamMetrics", "cluster idle time for Steam"),
         ("POST", "/3/Shutdown", "shut the cluster down"),
         ("GET", "/3/ImportFiles", "import files by path/URI"),
         ("POST", "/3/PostFile", "upload raw bytes for parsing"),
@@ -1383,10 +1680,25 @@ _ROUTES_DOC = [
         ("POST", "/3/ParseSetup", "guess parse setup"),
         ("POST", "/3/Parse", "parse files into a Frame"),
         ("GET", "/3/Frames", "list frames"),
+        ("GET", "/3/Frames/{id}", "frame detail with row preview"),
         ("GET", "/3/Frames/{id}/summary", "frame summary with column stats"),
+        ("GET", "/3/Frames/{id}/light", "frame names/types only"),
         ("GET", "/3/Frames/{id}/columns", "frame columns"),
+        ("GET", "/3/Frames/{id}/columns/{column}", "one column's stats"),
+        ("GET", "/3/Frames/{id}/columns/{column}/domain",
+         "categorical levels + counts"),
+        ("GET", "/3/Frames/{id}/columns/{column}/summary",
+         "column histogram + percentiles"),
+        ("GET", "/3/FrameChunks/{id}", "chunk/shard layout of a frame"),
         ("POST", "/3/Frames/{id}/export", "export a frame to csv/parquet"),
+        ("GET", "/3/Frames/{id}/export/{path}/overwrite/{force}",
+         "export a frame (GET form)"),
+        ("POST", "/3/Frames/{id}/save", "save a frame in binary form"),
+        ("POST", "/3/Frames/load", "load a binary-saved frame"),
         ("DELETE", "/3/Frames/{id}", "remove a frame"),
+        ("DELETE", "/3/Frames", "remove all frames"),
+        ("GET", "/3/Find", "find a value in a frame"),
+        ("POST", "/3/ImportFilesMulti", "import many paths/patterns"),
         ("GET", "/3/ModelBuilders", "list algorithms"),
         ("GET", "/3/ModelBuilders/{algo}", "algorithm parameter metadata"),
         ("POST", "/3/ModelBuilders/{algo}", "launch a training job"),
@@ -1398,6 +1710,7 @@ _ROUTES_DOC = [
         ("GET", "/3/Models/{id}", "model detail"),
         ("GET", "/3/Models/{id}/mojo", "export MOJO"),
         ("DELETE", "/3/Models/{id}", "remove a model"),
+        ("DELETE", "/3/Models", "remove all models"),
         ("POST", "/3/Predictions/models/{m}/frames/{f}", "score a frame"),
         ("POST", "/3/PartialDependence", "partial dependence"),
         ("POST", "/3/PermutationVarImp", "permutation importance"),
@@ -1405,18 +1718,27 @@ _ROUTES_DOC = [
         ("GET", "/3/Jobs/{id}", "poll a job"),
         ("POST", "/3/Jobs/{id}/cancel", "cancel a job"),
         ("POST", "/99/Rapids", "execute a rapids expression"),
+        ("GET", "/99/Rapids/help", "rapids language primitives"),
         ("POST", "/3/InitID", "open a session"),
+        ("GET", "/3/InitID", "open a session"),
         ("DELETE", "/3/InitID", "end a session"),
         ("GET", "/3/JStack", "thread stack dump"),
         ("GET", "/3/Logs", "node log ring"),
+        ("GET", "/3/Logs/nodes/{nodeidx}/files/{name}",
+         "one node's log file, filtered by level"),
         ("GET", "/3/Timeline", "event timeline ring"),
         ("GET", "/3/Profiler", "stack-sample profile"),
         ("GET", "/3/WaterMeterCpuTicks/{node}", "cpu tick counters"),
         ("GET", "/3/WaterMeterIo", "io counters"),
+        ("GET", "/3/WaterMeterIo/{nodeidx}", "one node's io counters"),
         ("GET", "/3/NetworkTest", "device microbenchmarks"),
         ("GET", "/3/Typeahead/files", "path completion for import"),
         ("GET", "/3/Metadata/endpoints", "this listing"),
+        ("GET", "/3/Metadata/endpoints/{path}", "one endpoint's doc"),
         ("GET", "/3/Metadata/schemas", "schema catalog"),
+        ("GET", "/3/Metadata/schemas/{schemaname}", "one schema's doc"),
+        ("GET", "/3/Metadata/schemaclasses/{classname}",
+         "one schema class's doc"),
         ("GET", "/3/ModelMetrics", "list stored model metrics"),
         ("GET", "/3/ModelMetrics/models/{m}/frames/{f}",
          "compute metrics of a model on a frame"),
@@ -1425,6 +1747,7 @@ _ROUTES_DOC = [
         ("POST", "/3/Interaction", "combined categorical interaction columns"),
         ("POST", "/3/MissingInserter", "inject NAs into a frame"),
         ("GET", "/3/DownloadDataset", "frame as raw CSV"),
+        ("GET", "/3/DownloadDataset.bin", "frame as raw CSV (streaming)"),
         ("GET", "/3/Tree", "inspect one tree of a tree model"),
         ("DELETE", "/3/DKV/{key}", "remove one key"),
         ("DELETE", "/3/DKV", "remove all keys"),

@@ -156,3 +156,11 @@ class Job(Keyed):
 
     def is_running(self) -> bool:
         return self.status in (Job.CREATED, Job.RUNNING)
+
+
+def any_running() -> bool:
+    """True when any job is live — `/3/SteamMetrics` reports zero idle time
+    while the cluster is working (`water/api/SteamMetricsHandler`)."""
+    from .kvstore import STORE
+
+    return any(j.is_running() for j in STORE.values(Job))
