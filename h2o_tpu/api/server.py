@@ -40,6 +40,24 @@ _SESSIONS: dict[str, Session] = {}
 #: `/3/SessionProperties` store, keyed (session_key, property) —
 #: `water/rapids/Session` attributes in the reference
 _SESSION_PROPS: dict[tuple[str, str], str | None] = {}
+#: on-frame metric recomputes, keyed (model_id, frame_id) — the reference
+#: keeps ModelMetrics objects in the DKV under a model×frame checksum key;
+#: the listing/fetch/DELETE ModelMetrics routes operate on this. Guarded by
+#: a lock: ThreadingHTTPServer serves requests concurrently.
+_METRICS_CACHE: dict[tuple[str, str], object] = {}
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics_cache_items() -> list:
+    """Live cache entries; entries whose model or frame died since caching
+    are purged here so a long-lived server can't accumulate garbage."""
+    with _METRICS_LOCK:
+        items = list(_METRICS_CACHE.items())
+        dead = [(m, f) for (m, f), _ in items
+                if STORE.get(m) is None or STORE.get(f) is None]
+        for k in dead:
+            del _METRICS_CACHE[k]
+        return [(k, v) for k, v in items if k not in dead]
 
 
 class H2OServer:
@@ -164,6 +182,27 @@ def _err(status: int, msg: str, **extra) -> tuple[int, dict]:
     return status, {"__meta": {"schema_type": "H2OError"},
                     "error_url": "", "msg": msg, "dev_msg": msg,
                     "http_status": status, "exception_msg": msg, **extra}
+
+
+def _export_path(p: dict, default_name: str, what: str,
+                 force_default: bool = False):
+    """Shared dir-export contract (Models.bin / Models.mojo / Models json /
+    frame export all follow `ModelsHandler`'s): strip file://, append
+    `default_name` when the target is a directory, refuse an existing file
+    unless force. Returns (path, None) on success or (None, error reply)."""
+    path = p.get("dir", "")
+    if not path:
+        return None, _err(400, f"{what}: dir is required")
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    if "://" not in path:  # remote URIs ride the Persist SPI untouched
+        if os.path.isdir(path) or path.endswith(os.sep):
+            os.makedirs(path, exist_ok=True)
+            path = os.path.join(path, default_name)
+        force = _truthy(p["force"]) if "force" in p else force_default
+        if not force and os.path.exists(path):
+            return None, _err(400, f"{what}: {path} exists (use force)")
+    return path, None
 
 
 _FRAME_PARAMS = ("training_frame", "validation_frame", "blending_frame",
@@ -750,6 +789,13 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         entry = registry.lookup(algo)
         if entry is None:
             return _err(404, f"unknown algorithm {algo}")
+        if rest[2:] and rest[2] == "model_id" and method == "POST":
+            # `POST /3/ModelBuilders/{algo}/model_id`
+            # (`ModelBuildersHandler.calcModelId`) — a fresh unique id
+            from ..backend.kvstore import make_key
+
+            return 200, {"model_id": schemas.key_schema(
+                make_key(f"{algo.upper()}_model"), "Key<Model>")}
         if rest[2:] and rest[2] == "parameters" and method == "POST":
             # validation-only pass (`ModelBuilderHandler.validate_parameters`
             # — POST /3/ModelBuilders/{algo}/parameters): construct the
@@ -812,12 +858,26 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if method == "DELETE":
             STORE.remove(mid)
             return 200, {}
+        if rest[2:] and rest[2] == "json":
+            # `GET /99/Models/{id}/json` (`ModelsHandler.exportModelDetails`)
+            # — full model detail; with dir=, also written server-side
+            payload = schemas.model_schema(m)
+            if p.get("dir"):
+                path, err = _export_path(p, f"{mid}.json", "Models/json")
+                if err:
+                    return err
+                with open(path, "w") as fh:
+                    json.dump(payload, fh)
+                return 200, {"dir": path, "models": [payload]}
+            return 200, {"models": [payload]}
         if rest[2:] and rest[2] == "mojo":
-
-            path = p.get("dir") or "."
-            if os.path.isdir(path) or path.endswith(os.sep):
-                os.makedirs(path, exist_ok=True)
-                path = os.path.join(path, f"{mid}.zip")
+            # force_default True: the download path always rewrites its temp
+            # target (the `fetchMojo` contract h2o-py download_mojo relies on)
+            path, err = _export_path({**p, "dir": p.get("dir") or "."},
+                                     f"{mid}.zip", "Models/mojo",
+                                     force_default=True)
+            if err:
+                return err
             return 200, {"dir": m.save_mojo(path)}
         return 200, {"models": [schemas.model_schema(m)]}
 
@@ -832,14 +892,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             m = STORE.get(mid)
             if m is None:
                 return _err(404, f"model {mid} not found")
-            path = p.get("dir", "")
-            if not path:
-                return _err(400, "Models.bin: dir is required")
-            if path.startswith("file://"):
-                path = path[len("file://"):]
-            if "://" not in path and not _truthy(p.get("force")) \
-                    and os.path.exists(path):
-                return _err(400, f"Models.bin: {path} exists (use force)")
+            path, err = _export_path(p, mid, "Models.bin")
+            if err:
+                return err
             return 200, {"dir": persist.save_model(m, path)}
         if method == "POST":
             path = p.get("dir", "")
@@ -858,6 +913,42 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, {"__raw__": persist.model_bytes(m),
                      "__ctype__": "application/octet-stream",
                      "__filename__": mid}
+    if head == "Models.java" and method == "GET" and rest[1:]:
+        # `GET /3/Models.java/{id}[/preview]` (`ModelsHandler.fetchJavaCode`
+        # / `fetchPreview`) — the POJO source as a java file download
+        from ..mojo.pojo import pojo_source
+
+        mid = urllib.parse.unquote(rest[1])
+        m = STORE.get(mid)
+        if m is None:
+            return _err(404, f"model {mid} not found")
+        cls_name = re.sub(r"[^A-Za-z0-9_]", "_", mid)
+        if not cls_name or not (cls_name[0].isalpha() or cls_name[0] == "_"):
+            # `JCodeGen.toJavaId`: a leading digit is not a Java identifier
+            cls_name = "_" + cls_name
+        try:
+            src = pojo_source(m, class_name=cls_name)
+        except NotImplementedError as e:
+            return _err(400, str(e))
+        if rest[2:] and rest[2] == "preview":
+            # the reference truncates the preview to its first kilobytes
+            lines = src.splitlines()
+            src = "\n".join(lines[:1000])
+            if len(lines) > 1000:
+                src += "\n// ... truncated preview ..."
+        return 200, {"__raw__": src, "__ctype__": "text/x-java",
+                     "__filename__": f"{cls_name}.java"}
+    if head == "Models.mojo" and method == "GET" and rest[1:]:
+        # `GET /99/Models.mojo/{id}?dir=` (`ModelsHandler.exportMojo`) —
+        # server-side MOJO export, returns the written path
+        mid = urllib.parse.unquote(rest[1])
+        m = STORE.get(mid)
+        if m is None:
+            return _err(404, f"model {mid} not found")
+        path, err = _export_path(p, f"{mid}.zip", "Models.mojo")
+        if err:
+            return err
+        return 200, {"dir": m.save_mojo(path)}
     if head == "Models.upload.bin" and method == "POST":
         from ..backend import persist
         from ..io.upload import UploadedFile
@@ -881,15 +972,36 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if fr is None:
             return _err(404, f"frame {fid} not found")
         if _truthy(p.get("predict_contributions")):
-            pred = model.predict_contributions(fr)
+            def score_fn():
+                return model.predict_contributions(fr)
         elif _truthy(p.get("leaf_node_assignment")):
-            pred = model.predict_leaf_node_assignment(
-                fr, type=p.get("leaf_node_assignment_type") or "Path")
+            def score_fn():
+                return model.predict_leaf_node_assignment(
+                    fr, type=p.get("leaf_node_assignment_type") or "Path")
         elif _truthy(p.get("predict_staged_proba")):
-            pred = model.staged_predict_proba(fr)
+            def score_fn():
+                return model.staged_predict_proba(fr)
         else:
-            pred = model.predict(fr)
+            def score_fn():
+                return model.predict(fr)
         dest = p.get("predictions_frame") or f"predictions_{mid}_{fid}"
+        if ver == "4":
+            # `POST /4/Predictions/...` — the async registration: EVERY
+            # scoring mode runs in a background job the client polls
+            # (water/api/RegisterV3Api's /4 route contract)
+            job = Job(f"Prediction {mid} on {fid}", work=1.0)
+
+            def run_predict():
+                out = score_fn()
+                out.key = dest
+                STORE.put(dest, out)
+                job.dest_key = dest
+                return out
+
+            job.start(run_predict, background=True)
+            return 200, {"job": schemas.job_schema(job),
+                         "predictions_frame": schemas.key_schema(dest)}
+        pred = score_fn()
         pred.key = dest
         STORE.put(dest, pred)
         return 200, {"predictions_frame": schemas.key_schema(dest),
@@ -910,8 +1022,23 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         tables = model.partial_dependence(
             fr, cols, nbins=int(p.get("nbins", 20) or 20),
             weight_column=p.get("weight_column") or None, targets=targets)
-        return 200, {"partial_dependence_data":
-                     [schemas.table_schema(t) for t in tables]}
+        from ..backend.kvstore import make_key
+
+        dest = p.get("destination_key") or make_key("PartialDependence")
+        payload = {"destination_key": schemas.key_schema(dest),
+                   "partial_dependence_data":
+                   [schemas.table_schema(t) for t in tables]}
+        # keep the result fetchable by key — `GET /3/PartialDependence/{id}`
+        STORE.put(dest, payload)
+        return 200, payload
+    if head == "PartialDependence" and method == "GET" and rest[1:]:
+        # `ModelMetricsHandler.fetchPartialDependenceData`
+        dest = urllib.parse.unquote(rest[1])
+        payload = STORE.get(dest)
+        if not isinstance(payload, dict) \
+                or "partial_dependence_data" not in payload:
+            return _err(404, f"no partial dependence result {dest}")
+        return 200, payload
 
     if head == "PermutationVarImp" and method == "POST":
         model = STORE.get(p.get("model_id", ""))
@@ -928,33 +1055,89 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
     if head == "ModelMetrics":
         from ..models.model_base import Model
 
-        if not rest[1:]:
-            # listing: every model's training metrics
-            return 200, {"model_metrics": [
-                {"model": schemas.key_schema(m.key),
-                 "frame": (schemas.key_schema(m.params.training_frame.key)
+        def _mm_entry(mid2, fid2, mm2):
+            return {"model": schemas.key_schema(mid2),
+                    "frame": schemas.key_schema(fid2) if fid2 else None,
+                    **(schemas.metrics_schema(mm2) or {})}
+
+        def _training_entries(models):
+            return [
+                _mm_entry(m.key,
+                          (m.params.training_frame.key
                            if getattr(m.params, "training_frame", None)
                            is not None else None),
-                 **(schemas.metrics_schema(m.output.training_metrics) or {})}
-                for m in STORE.values(Model)
-                if m.output.training_metrics is not None]}
-        # /3/ModelMetrics/models/{model}/frames/{frame} — recompute on frame
-        mid = urllib.parse.unquote(rest[2])
-        model = STORE.get(mid)
-        if model is None:
+                          m.output.training_metrics)
+                for m in models if m.output.training_metrics is not None]
+
+        if not rest[1:]:
+            if method == "DELETE":  # `DELETE /3/ModelMetrics` — drop cache
+                with _METRICS_LOCK:
+                    _METRICS_CACHE.clear()
+                return 200, {}
+            # listing: training metrics + every cached on-frame recompute
+            return 200, {"model_metrics": _training_entries(
+                STORE.values(Model)) + [
+                _mm_entry(m2, f2, mm) for (m2, f2), mm
+                in _metrics_cache_items()]}
+
+        # `/3/ModelMetrics/predictions_frame/{p}/actuals_frame/{a}` — build
+        # metrics from a predictions frame + an actuals frame with no model
+        # (`ModelMetricsHandler.make`, h2o-py `h2o.make_metrics`)
+        if rest[1] == "predictions_frame" and method == "POST":
+            return _make_metrics_route(rest, p)
+
+        # resolve the {models,frames} path pair in either order
+        mid = fid = None
+        seg = rest[1:]
+        while seg:
+            kind, val = seg[0], (seg[1] if seg[1:] else None)
+            if val is None:
+                break
+            if kind == "models":
+                mid = urllib.parse.unquote(val)
+            elif kind == "frames":
+                fid = urllib.parse.unquote(val)
+            else:
+                return _err(404, f"ModelMetrics: unknown segment {kind}")
+            seg = seg[2:]
+        if method == "DELETE":
+            # scoped cache invalidation (`ModelMetricsHandler.delete`) —
+            # runs BEFORE existence checks: entries for already-deleted
+            # models/frames must stay deletable
+            with _METRICS_LOCK:
+                for k in [k for k in _METRICS_CACHE
+                          if (mid is None or k[0] == mid)
+                          and (fid is None or k[1] == fid)]:
+                    del _METRICS_CACHE[k]
+            return 200, {}
+        model = STORE.get(mid) if mid else None
+        if mid and model is None:
             return _err(404, f"model {mid} not found")
-        if rest[3:] and rest[3] == "frames":
-            fid = urllib.parse.unquote(rest[4])
-            fr2 = STORE.get(fid)
-            if fr2 is None:
-                return _err(404, f"frame {fid} not found")
-            mm = model.model_performance(fr2)
-            return 200, {"model_metrics": [
-                {"model": schemas.key_schema(mid),
-                 "frame": schemas.key_schema(fid),
-                 **(schemas.metrics_schema(mm) or {})}]}
-        mm = model.output.training_metrics
-        return 200, {"model_metrics": [schemas.metrics_schema(mm) or {}]}
+        if fid and not isinstance(STORE.get(fid), Frame):
+            return _err(404, f"frame {fid} not found")
+        if mid and fid:
+            # always recompute: the model or frame may have been replaced
+            # under the same key since the last score (the reference's
+            # checksum-keyed DKV entry invalidates on replacement)
+            if method == "POST" and p.get("predictions_frame"):
+                # one scoring pass serves both outputs (BigScore semantics)
+                pred, mm = model.score_with_metrics(STORE.get(fid))
+                pred.key = str(p["predictions_frame"])
+                STORE.put(pred.key, pred)
+            else:
+                mm = model.model_performance(STORE.get(fid))
+            with _METRICS_LOCK:
+                _METRICS_CACHE[(mid, fid)] = mm
+            return 200, {"model_metrics": [_mm_entry(mid, fid, mm)]}
+        if mid:  # all metrics known for one model
+            entries = _training_entries([model]) + [
+                _mm_entry(m2, f2, mm) for (m2, f2), mm
+                in _metrics_cache_items() if m2 == mid]
+            return 200, {"model_metrics": entries}
+        # all metrics computed on one frame
+        return 200, {"model_metrics": [
+            _mm_entry(m2, f2, mm) for (m2, f2), mm
+            in _metrics_cache_items() if f2 == fid]}
 
     # -- frame factory / munging routes -------------------------------------
     if head == "CreateFrame" and method == "POST":
@@ -1351,6 +1534,21 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             "failed_raw_params": [f["params"] for f in g.failures],
             "summary_table": schemas.table_schema(g.summary_table(by)),
         }
+    if head == "Recovery" and method == "POST" and rest[1:] \
+            and rest[1] == "resume":
+        # `POST /3/Recovery/resume` (`water/api/RecoveryHandler`, the
+        # `-auto_recovery_dir` restart protocol): resume every incomplete
+        # grid found under the recovery dir, skipping finished models
+        from ..models.grid import GridSearch
+
+        d = p.get("recovery_dir", "")
+        if not d or not os.path.isdir(d):
+            return _err(404, f"Recovery: no recovery dir at {d!r}")
+        gs = GridSearch.resume(d)
+        job = gs.train(background=True)
+        return 200, {"job": schemas.job_schema(job),
+                     "grid_id": schemas.key_schema(job.dest_key)}
+
     if head == "Grid.bin" and method == "POST":
         from ..models.grid import Grid, export_grid, import_grid
 
@@ -1709,10 +1907,20 @@ _ROUTES_DOC = [
         ("GET", "/3/Models", "list models"),
         ("GET", "/3/Models/{id}", "model detail"),
         ("GET", "/3/Models/{id}/mojo", "export MOJO"),
+        ("GET", "/3/Models.java/{id}", "POJO scoring source"),
+        ("GET", "/3/Models.java/{id}/preview", "POJO source preview"),
+        ("GET", "/99/Models.mojo/{id}", "export MOJO server-side"),
+        ("GET", "/99/Models/{id}/json", "model detail as exportable JSON"),
+        ("POST", "/3/ModelBuilders/{algo}/model_id", "fresh unique model id"),
         ("DELETE", "/3/Models/{id}", "remove a model"),
         ("DELETE", "/3/Models", "remove all models"),
         ("POST", "/3/Predictions/models/{m}/frames/{f}", "score a frame"),
+        ("POST", "/4/Predictions/models/{m}/frames/{f}",
+         "score a frame asynchronously (job)"),
         ("POST", "/3/PartialDependence", "partial dependence"),
+        ("GET", "/3/PartialDependence/{name}",
+         "fetch a stored partial dependence result"),
+        ("POST", "/3/Recovery/resume", "resume grids from a recovery dir"),
         ("POST", "/3/PermutationVarImp", "permutation importance"),
         ("GET", "/3/Jobs", "list jobs"),
         ("GET", "/3/Jobs/{id}", "poll a job"),
@@ -1740,8 +1948,26 @@ _ROUTES_DOC = [
         ("GET", "/3/Metadata/schemaclasses/{classname}",
          "one schema class's doc"),
         ("GET", "/3/ModelMetrics", "list stored model metrics"),
+        ("DELETE", "/3/ModelMetrics", "drop all cached metrics"),
+        ("GET", "/3/ModelMetrics/models/{m}", "all metrics of one model"),
+        ("DELETE", "/3/ModelMetrics/models/{m}",
+         "drop one model's cached metrics"),
+        ("GET", "/3/ModelMetrics/frames/{f}", "metrics computed on a frame"),
+        ("DELETE", "/3/ModelMetrics/frames/{f}",
+         "drop one frame's cached metrics"),
         ("GET", "/3/ModelMetrics/models/{m}/frames/{f}",
          "compute metrics of a model on a frame"),
+        ("POST", "/3/ModelMetrics/models/{m}/frames/{f}",
+         "recompute metrics, optionally storing predictions"),
+        ("DELETE", "/3/ModelMetrics/models/{m}/frames/{f}",
+         "drop one cached model-on-frame metric"),
+        ("GET", "/3/ModelMetrics/frames/{f}/models/{m}",
+         "metrics of a model on a frame (frame-first form)"),
+        ("DELETE", "/3/ModelMetrics/frames/{f}/models/{m}",
+         "drop one cached metric (frame-first form)"),
+        ("POST",
+         "/3/ModelMetrics/predictions_frame/{p}/actuals_frame/{a}",
+         "make metrics from a predictions frame + actuals"),
         ("POST", "/3/CreateFrame", "synthesize a random frame"),
         ("POST", "/3/SplitFrame", "random-split a frame"),
         ("POST", "/3/Interaction", "combined categorical interaction columns"),
@@ -1766,6 +1992,63 @@ _ROUTES_DOC = [
         ("GET", "/99/Leaderboards/{project}", "project leaderboard"),
     ]
 ]
+
+
+def _make_metrics_route(rest: list[str], p: dict) -> tuple[int, dict]:
+    """`POST /3/ModelMetrics/predictions_frame/{p}/actuals_frame/{a}`
+    (`ModelMetricsHandler.make`, `ModelMetricsBinomial.make` et al.): build
+    metrics straight from a predictions frame and an actuals column, no
+    model involved. `domain` (class labels) picks the category: absent →
+    regression (1 prediction column), 2 labels → binomial (1 column of
+    class-1 probabilities), >2 → multinomial (k probability columns)."""
+    import jax.numpy as jnp
+
+    from ..models import metrics as M
+
+    pid = urllib.parse.unquote(rest[2])
+    if not (rest[3:] and rest[3] == "actuals_frame" and rest[4:]):
+        return _err(404, "ModelMetrics make: "
+                         "/predictions_frame/{p}/actuals_frame/{a}")
+    aid = urllib.parse.unquote(rest[4])
+    pred, act = STORE.get(pid), STORE.get(aid)
+    if not isinstance(pred, Frame):
+        return _err(404, f"predictions frame {pid} not found")
+    if not isinstance(act, Frame):
+        return _err(404, f"actuals frame {aid} not found")
+    domain = p.get("domain")
+    if isinstance(domain, str) and domain:
+        domain = [d.strip(" '\"") for d in domain.strip("[]").split(",")]
+    av = act.vecs[0]
+    y = av.to_numpy()
+    if domain:
+        if av.domain and list(av.domain) != list(domain):
+            # actuals encoded against their own domain: remap to the
+            # caller's label order (the reference adapts via the domain)
+            remap = {lv: i for i, lv in enumerate(domain)}
+            codes = np.array([remap.get(av.domain[int(c)], -1)
+                              if not np.isnan(c) else np.nan for c in y])
+            y = codes
+        if len(domain) == 2:
+            if pred.ncol != 1:
+                return _err(400, "binomial make: predictions_frame must "
+                                 "have exactly one class-1 probability "
+                                 "column")
+            mm = M.make_binomial_metrics(jnp.asarray(y),
+                                         jnp.asarray(pred.vecs[0].to_numpy()))
+        else:
+            if pred.ncol != len(domain):
+                return _err(400, f"multinomial make: predictions_frame must "
+                                 f"have {len(domain)} probability columns")
+            probs = np.stack([v.to_numpy() for v in pred.vecs], axis=1)
+            mm = M.make_multinomial_metrics(jnp.asarray(y),
+                                            jnp.asarray(probs))
+    else:
+        if pred.ncol != 1:
+            return _err(400, "regression make (domain=null): "
+                             "predictions_frame must have exactly 1 column")
+        mm = M.make_regression_metrics(jnp.asarray(y),
+                                       jnp.asarray(pred.vecs[0].to_numpy()))
+    return 200, {"model_metrics": [schemas.metrics_schema(mm) or {}]}
 
 
 def _dest_name(path: str) -> str:

@@ -169,6 +169,19 @@ class Model(Keyed):
         w = fr.vec(self.params.weights_column).data if self.params.weights_column else None
         return make_metrics(self.output.model_category, y, raw, w)
 
+    def score_with_metrics(self, fr: Frame) -> tuple[Frame, object]:
+        """One scoring pass serving both the predictions frame and the
+        metrics — the reference's BigScore MRTask computes both in a single
+        map (`hex/Model.java:2232` score + MetricBuilder.perRow)."""
+        X = self.adapt_frame(fr)
+        raw = self.score0(X)
+        y = _response_device(fr, self.params.response_column,
+                             self.output.response_domain)
+        w = fr.vec(self.params.weights_column).data \
+            if self.params.weights_column else None
+        return (self._predictions_frame(raw, fr.nrow),
+                make_metrics(self.output.model_category, y, raw, w))
+
     def auc(self):
         return getattr(self.output.training_metrics, "auc", None)
 

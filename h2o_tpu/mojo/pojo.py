@@ -16,16 +16,21 @@ from __future__ import annotations
 import numpy as np
 
 
-def export_pojo(model, path: str, class_name: str | None = None) -> str:
+def pojo_source(model, class_name: str | None = None) -> str:
+    """The generated Java source as a string — `GET /3/Models.java/{id}`
+    serves this directly (`ModelsHandler.fetchJavaCode`)."""
     algo = model.algo_name
     if algo in ("gbm", "drf", "xrt"):
-        src = _tree_pojo(model, class_name)
-    elif algo == "glm":
-        src = _glm_pojo(model, class_name)
-    else:
-        raise NotImplementedError(f"POJO export not implemented for '{algo}' "
-                                  "(the reference generates POJOs for tree "
-                                  "and linear models)")
+        return _tree_pojo(model, class_name)
+    if algo == "glm":
+        return _glm_pojo(model, class_name)
+    raise NotImplementedError(f"POJO export not implemented for '{algo}' "
+                              "(the reference generates POJOs for tree "
+                              "and linear models)")
+
+
+def export_pojo(model, path: str, class_name: str | None = None) -> str:
+    src = pojo_source(model, class_name)
     with open(path, "w") as fh:
         fh.write(src)
     return path
