@@ -1,0 +1,199 @@
+"""Server-side Assembly — `water/api/AssemblyHandler` + the
+`water/rapids/transforms/*` munging pipeline behind `POST /99/Assembly` and
+`GET /99/Assembly.java/{assembly_id}/{pojo_name}`.
+
+The wire format is h2o-py's `H2OAssembly.fit`: a JSON-ish list of step
+strings ``name__Class__ast__inplace__names`` where the rapids ast uses the
+literal frame-id placeholder ``dummy`` (`H2OColOp.FRAME_ID_PLACEHOLDER`).
+Fitting substitutes the target frame, executes each step's ast through a
+rapids session, and applies the reference's inplace/append semantics;
+`to_java` renders the fitted pipeline as one self-contained Java class
+(`Transform.genClassImpl` role — structural Java, validated by tests the
+same way the model POJOs are, there being no JVM in the image)."""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..backend.kvstore import STORE, Keyed, make_key
+from ..frame.frame import Frame
+
+#: rapids unary op → java.lang.Math expression template
+_JAVA_UNOPS = {
+    "cos": "Math.cos(%s)", "sin": "Math.sin(%s)", "tan": "Math.tan(%s)",
+    "acos": "Math.acos(%s)", "asin": "Math.asin(%s)",
+    "atan": "Math.atan(%s)", "cosh": "Math.cosh(%s)",
+    "sinh": "Math.sinh(%s)", "tanh": "Math.tanh(%s)",
+    "abs": "Math.abs(%s)", "sqrt": "Math.sqrt(%s)",
+    "log": "Math.log(%s)", "log10": "Math.log10(%s)",
+    "log2": "(Math.log(%s)/Math.log(2))",
+    "log1p": "Math.log1p(%s)", "exp": "Math.exp(%s)",
+    "expm1": "Math.expm1(%s)", "floor": "Math.floor(%s)",
+    "ceiling": "Math.ceil(%s)", "sign": "Math.signum(%s)",
+}
+_JAVA_BINOPS = {"+": "+", "-": "-", "*": "*", "/": "/",
+                "<": "<", "<=": "<=", ">": ">", ">=": ">=",
+                "==": "==", "!=": "!="}
+
+
+class AssemblyStep:
+    def __init__(self, spec: str):
+        parts = spec.split("__")
+        if len(parts) != 5:
+            raise ValueError(f"malformed assembly step {spec!r} "
+                             "(name__class__ast__inplace__names)")
+        self.name, self.cls, self.ast, inplace, names = parts
+        self.inplace = inplace in ("True", "true")
+        self.new_names = None if names == "|" else names.split("|")
+        m = re.search(r"\(cols(?:_py)? dummy ['\"]([^'\"]+)['\"]\)",
+                      self.ast)
+        self.old_col = m.group(1) if m else None
+        #: rapids operator at the head of the ast, e.g. cos/+/strlen
+        m2 = re.match(r"\(\s*([^\s()]+)", self.ast)
+        self.op = m2.group(1) if m2 else None
+
+
+class Assembly(Keyed):
+    """Fitted pipeline, keyed in the DKV (`water/rapids/Assembly`)."""
+
+    def __init__(self, steps: list[AssemblyStep], key: str | None = None):
+        super().__init__(key or make_key("assembly"))
+        self.steps = steps
+        self.scaler_stats: dict[int, tuple[list, list]] = {}
+
+    # -- fit ------------------------------------------------------------------
+    def fit(self, fr: Frame) -> Frame:
+        from ..frame.vec import Vec
+        from ..rapids.exec import Rapids, Session
+
+        session = Session(f"{self.key}_fit")
+        try:
+            cur = fr
+            for si, step in enumerate(self.steps):
+                if step.cls == "H2OColSelect":
+                    cols = re.findall(r"['\"]([^'\"]+)['\"]",
+                                      step.ast.split("dummy", 1)[1])
+                    cur = Frame(list(cols), [cur.vec(c) for c in cols])
+                elif step.cls == "H2OScaler":
+                    means, sds = [], []
+                    vecs, names = [], []
+                    for n in cur.names:
+                        v = cur.vec(n)
+                        r = v.rollups()
+                        means.append(float(r.mean))
+                        sds.append(float(r.sigma) or 1.0)
+                        x = (v.to_numpy().astype(np.float64) - means[-1]) \
+                            / (sds[-1] or 1.0)
+                        vecs.append(Vec.from_numpy(x))
+                        names.append(n)
+                    self.scaler_stats[si] = (means, sds)
+                    cur = Frame(names, vecs)
+                elif step.cls in ("H2OColOp", "H2OBinaryOp"):
+                    # bind a shallow COPY under the temp key — `cur` may
+                    # still be the caller's STORE-resident frame, whose .key
+                    # must never be rebound by a fit
+                    tmp_key = make_key("assembly_in")
+                    tmp_fr = Frame(list(cur.names), list(cur.vecs),
+                                   key=tmp_key)
+                    STORE.put(tmp_key, tmp_fr)
+                    try:
+                        ast = step.ast.replace("dummy", tmp_key)
+                        res = Rapids(session).exec(ast)
+                    finally:
+                        STORE.remove(tmp_key, cascade=False)
+                    if not isinstance(res, Frame):
+                        from ..frame.vec import Vec as _V
+
+                        if isinstance(res, _V):
+                            res = Frame(["C1"], [res])
+                        else:
+                            raise ValueError(
+                                f"step {step.name}: ast returned "
+                                f"{type(res).__name__}, expected a column")
+                    if step.inplace and step.old_col:
+                        cur = Frame(list(cur.names),
+                                    [res.vecs[0] if n == step.old_col
+                                     else cur.vec(n) for n in cur.names])
+                    else:
+                        add = list(step.new_names or
+                                   [f"{step.name}" for _ in res.names])
+                        names = list(cur.names) + add[:len(res.names)]
+                        cur = Frame(names, list(cur.vecs) + list(res.vecs))
+                else:
+                    raise ValueError(
+                        f"unknown assembly transform {step.cls!r} "
+                        "(H2OColSelect|H2OColOp|H2OBinaryOp|H2OScaler)")
+            return cur
+        finally:
+            session.end()
+
+    # -- codegen --------------------------------------------------------------
+    def to_java(self, class_name: str) -> str:
+        """One self-contained class: `Map<String,Object> transform(row)`
+        chaining every step (the AssemblyHandler download surface)."""
+        cls = re.sub(r"[^A-Za-z0-9_]", "_", class_name) or "Assembly"
+        if not (cls[0].isalpha() or cls[0] == "_"):
+            cls = "_" + cls
+        body = []
+        for si, step in enumerate(self.steps):
+            if step.cls == "H2OColSelect":
+                cols = re.findall(r"['\"]([^'\"]+)['\"]",
+                                  step.ast.split("dummy", 1)[1])
+                quoted = ", ".join(f'"{c}"' for c in cols)
+                body.append(f"    row.keySet().retainAll("
+                            f"java.util.Arrays.asList({quoted}));"
+                            f" // {step.name}")
+            elif step.cls == "H2OScaler":
+                means, sds = self.scaler_stats.get(si, ([], []))
+                body.append(f"    double[] means_{si} = "
+                            "{" + ", ".join(f"{m!r}" for m in means) + "};")
+                body.append(f"    double[] sds_{si} = "
+                            "{" + ", ".join(f"{s!r}" for s in sds) + "};")
+                body.append(f"    int ci_{si} = 0;")
+                body.append(f"    for (String k : row.keySet()) "
+                            f"{{ row.put(k, ((Double) row.get(k) - "
+                            f"means_{si}[ci_{si}]) / sds_{si}[ci_{si}]); "
+                            f"ci_{si}++; }} // {step.name}")
+            else:
+                col = step.old_col or "C1"
+                expr = f"(Double) row.get(\"{col}\")"
+                if step.op in _JAVA_UNOPS:
+                    expr = _JAVA_UNOPS[step.op] % expr
+                elif step.op in _JAVA_BINOPS:
+                    rhs = re.search(r"\)\s*([-0-9.eE]+)\s*\)?\s*$", step.ast)
+                    rv = rhs.group(1) if rhs else "0"
+                    jop = _JAVA_BINOPS[step.op]
+                    cmp = f"({expr} {jop} {rv})"
+                    expr = f"{cmp} ? 1.0 : 0.0" \
+                        if jop in ("<", "<=", ">", ">=", "==", "!=") else cmp
+                else:
+                    expr = f"{expr} /* unmapped rapids op: {step.op} */"
+                target = col if step.inplace else \
+                    (step.new_names[0] if step.new_names else step.name)
+                body.append(f"    row.put(\"{target}\", {expr});"
+                            f" // {step.name}")
+        lines = "\n".join(body)
+        return (
+            "import java.util.Map;\n\n"
+            f"public class {cls} {{\n"
+            "  public static Map<String, Object> transform("
+            "Map<String, Object> row) {\n"
+            f"{lines}\n"
+            "    return row;\n"
+            "  }\n"
+            "}\n")
+
+
+def parse_steps(steps_param) -> list[AssemblyStep]:
+    """Decode the `steps` request param: either a JSON array or the
+    stringified `["step","step"]` h2o-py sends (double-quoted items, inner
+    quotes already flattened to single quotes by the client)."""
+    if isinstance(steps_param, str):
+        items = re.findall(r'"([^"]*)"', steps_param)
+        if not items:
+            raise ValueError("Assembly: no steps found in request")
+    else:
+        items = list(steps_param or [])
+    return [AssemblyStep(s) for s in items]

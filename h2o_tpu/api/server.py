@@ -29,7 +29,7 @@ import numpy as np
 
 from .. import __version__
 from ..backend.jobs import Job
-from ..backend.kvstore import STORE
+from ..backend.kvstore import STORE, make_key
 from ..frame.frame import Frame
 from ..frame.vec import Vec
 from ..models import registry
@@ -489,6 +489,37 @@ def _resolve_upload(source: str) -> tuple[str, str]:
     return source, source
 
 
+def _maybe_decrypt(path: str, name: str, p: dict) -> tuple[str, str, str | None]:
+    """When the request names a decrypt_tool (`ParseSetupV3.decrypt_tool`),
+    run the source bytes through it into a temp file the parser reads —
+    `water/parser/DecryptionTool.decryptionOf` in the reference's 2-pass
+    parse. The plaintext name drops a trailing .aes/.enc so extension-based
+    type guessing sees the real format. The third return is the temp path
+    when one was created — the CALLER must unlink it after parsing so
+    plaintext never outlives the request."""
+    tool_id = p.get("decrypt_tool") or p.get("decrypt_tool_id")
+    if not tool_id:
+        return path, name, None
+    from ..io.crypto import DecryptionTool
+
+    tool = STORE.get(tool_id)
+    if not isinstance(tool, DecryptionTool):
+        raise KeyError(f"decrypt tool {tool_id!r} not found")
+    import tempfile
+
+    with open(path, "rb") as fh:
+        plain = tool.decrypt(fh.read())
+    for ext in (".aes", ".enc", ".encrypted"):
+        if name.endswith(ext):
+            name = name[:-len(ext)]
+            break
+    suffix = os.path.splitext(name)[1] or ".csv"
+    tf = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+    tf.write(plain)
+    tf.close()
+    return tf.name, name, tf.name
+
+
 def route(server: H2OServer, method: str, parts: list[str], query: dict,
           body: dict) -> tuple[int, dict]:
     if not parts or parts[0] in ("flow", "index.html"):
@@ -578,19 +609,23 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if isinstance(paths, str):
             paths = [paths]
         paths = [s.strip('"') for s in paths]
-        path0, name0 = _resolve_upload(paths[0])
-        setup = guess_setup(path0)
-        ext = name0.rsplit(".", 1)[-1].lower()
-        from ..io.parser import BINARY_FORMAT_EXTS
+        path0, name0, tmp0 = _maybe_decrypt(*_resolve_upload(paths[0]), p)
+        try:
+            setup = guess_setup(path0)
+            ext = name0.rsplit(".", 1)[-1].lower()
+            from ..io.parser import BINARY_FORMAT_EXTS
 
-        if setup.column_names is None and \
-                "." + ext not in BINARY_FORMAT_EXTS:
-            # sample the head for names/types the way ParseSetupHandler's
-            # preview pass does (`water/parser/ParseSetup.java` guessSetup)
-            names, types = _csv_head_preview(path0, setup)
-            setup.column_names = names
-            if setup.column_types is None:
-                setup.column_types = types
+            if setup.column_names is None and \
+                    "." + ext not in BINARY_FORMAT_EXTS:
+                # sample the head for names/types the way ParseSetupHandler's
+                # preview pass does (`water/parser/ParseSetup.java` guessSetup)
+                names, types = _csv_head_preview(path0, setup)
+                setup.column_names = names
+                if setup.column_types is None:
+                    setup.column_types = types
+        finally:
+            if tmp0:  # decrypted plaintext must not outlive the request
+                os.unlink(tmp0)
         ptype = {"parquet": "PARQUET", "pq": "PARQUET", "orc": "ORC",
                  "xls": "XLS", "xlsx": "XLSX",
                  "svm": "SVMLight", "svmlight": "SVMLight"}.get(ext, "CSV")
@@ -614,17 +649,26 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         dest = p.get("destination_frame") or _dest_name(paths[0])
         job = Job(f"Parse {paths[0]}", work=1.0)
         # sources may be PostFile upload keys; resolve to their spool files
-        srcs = [_resolve_upload(s)[0] for s in paths]
+        # (and through the decrypt tool when the request names one)
+        resolved = [_maybe_decrypt(*_resolve_upload(s), p) for s in paths]
+        srcs = [r[0] for r in resolved]
+        temps = [r[2] for r in resolved if r[2]]
         setup = _parse_setup_of(p)
 
         def run():
-            fr = parse_file(srcs[0], setup=setup, dest_key=dest)
-            if paths[1:]:  # multi-file import: rbind the remaining files
-                # the client's ParseV3 overrides apply to EVERY source file
-                rest_frames = [parse_file(q, setup=setup) for q in srcs[1:]]
-                fr = fr.concat_rows(*rest_frames)
-                fr.key = dest
-                STORE.put(dest, fr)
+            try:
+                fr = parse_file(srcs[0], setup=setup, dest_key=dest)
+                if paths[1:]:  # multi-file import: rbind the remaining files
+                    # the client's ParseV3 overrides apply to EVERY source
+                    rest_frames = [parse_file(q, setup=setup)
+                                   for q in srcs[1:]]
+                    fr = fr.concat_rows(*rest_frames)
+                    fr.key = dest
+                    STORE.put(dest, fr)
+            finally:
+                for t in temps:  # decrypted plaintext dies with the parse
+                    if os.path.exists(t):
+                        os.unlink(t)
             from ..io.upload import UploadedFile
 
             for s in paths:  # delete_on_done: uploads are spent after parse
@@ -792,8 +836,6 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         if rest[2:] and rest[2] == "model_id" and method == "POST":
             # `POST /3/ModelBuilders/{algo}/model_id`
             # (`ModelBuildersHandler.calcModelId`) — a fresh unique id
-            from ..backend.kvstore import make_key
-
             return 200, {"model_id": schemas.key_schema(
                 make_key(f"{algo.upper()}_model"), "Key<Model>")}
         if rest[2:] and rest[2] == "parameters" and method == "POST":
@@ -1022,8 +1064,6 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         tables = model.partial_dependence(
             fr, cols, nbins=int(p.get("nbins", 20) or 20),
             weight_column=p.get("weight_column") or None, targets=targets)
-        from ..backend.kvstore import make_key
-
         dest = p.get("destination_key") or make_key("PartialDependence")
         payload = {"destination_key": schemas.key_schema(dest),
                    "partial_dependence_data":
@@ -1534,6 +1574,237 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             "failed_raw_params": [f["params"] for f in g.failures],
             "summary_table": schemas.table_schema(g.summary_table(by)),
         }
+    # -- tree interaction statistics ----------------------------------------
+    if head == "FeatureInteraction" and method == "POST":
+        # `ModelsHandler.makeFeatureInteraction` (hex/FeatureInteractions,
+        # the xgbfi algorithm)
+        from ..models.interactions import feature_interactions_tables
+
+        m = STORE.get(p.get("model_id", ""))
+        if m is None:
+            return _err(404, f"model {p.get('model_id')} not found")
+        if not hasattr(m, "forest") or not hasattr(m, "_ensure_covers"):
+            return _err(400, f"{getattr(m, 'algo_name', '?')} does not "
+                             "support feature interactions calculation")
+        tables = feature_interactions_tables(
+            m, int(p.get("max_interaction_depth", 100) or 100),
+            int(p.get("max_tree_depth", 100) or 100),
+            int(p.get("max_deepening", -1) if p.get("max_deepening")
+                not in (None, "") else -1))
+        return 200, {"feature_interaction":
+                     [schemas.table_schema(t) for t in tables]}
+    if head == "FriedmansPopescusH" and method == "POST":
+        # `ModelsHandler.makeFriedmansPopescusH` (hex/tree/FriedmanPopescusH)
+        from ..models.interactions import friedman_popescu_h
+
+        m = STORE.get(p.get("model_id", ""))
+        fr2 = STORE.get(p.get("frame", "") or p.get("frame_id", ""))
+        if m is None or not isinstance(fr2, Frame):
+            return _err(404, "model or frame not found")
+        if not hasattr(m, "forest") or not hasattr(m, "_ensure_covers"):
+            return _err(400, f"{getattr(m, 'algo_name', '?')} does not "
+                             "support Friedman Popescus H calculation")
+        variables = p.get("variables") or []
+        if isinstance(variables, str):
+            variables = [v.strip(" '\"") for v in
+                         variables.strip("[]").split(",") if v.strip(" '\"")]
+        h = friedman_popescu_h(m, fr2, variables)
+        return 200, {"h": None if np.isnan(h) else float(h)}
+    if head == "SignificantRules" and method == "POST":
+        # `ModelsHandler.makeSignificantRulesTable` (RuleFit)
+        m = STORE.get(p.get("model_id", ""))
+        if m is None:
+            return _err(404, f"model {p.get('model_id')} not found")
+        if not hasattr(m, "rule_importance"):
+            return _err(400, f"{getattr(m, 'algo_name', '?')} does not "
+                             "support significant rules collection")
+        from ..utils.twodimtable import TwoDimTable
+
+        rows = m.rule_importance()
+        t = TwoDimTable.from_dict("Significant Rules", {
+            "variable": [r["rule"] for r in rows],
+            "coefficient": [float(r["coefficient"]) for r in rows],
+            "support": [float(r["support"]) for r in rows]})
+        return 200, {"significant_rules_table": schemas.table_schema(t)}
+
+    # -- tabulate / DCT / SQL import ----------------------------------------
+    if head == "Tabulate" and method == "POST":
+        # `water/api/TabulateHandler` → `water/util/Tabulate`
+        from ..rapids.advmath import tabulate as _tabulate
+
+        fr2 = STORE.get(p.get("dataset", ""))
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {p.get('dataset')} not found")
+        count_t, resp_t = _tabulate(
+            fr2, p.get("predictor", ""), p.get("response", ""),
+            weight=p.get("weight") or None,
+            nbins_predictor=int(p.get("nbins_predictor", 20) or 20),
+            nbins_response=int(p.get("nbins_response", 10) or 10))
+        return 200, {"count_table": schemas.table_schema(count_t),
+                     "response_table": schemas.table_schema(resp_t)}
+    if head == "DCTTransformer" and method == "POST":
+        # `water/api/DCTTransformerHandler` → MathUtils.DCT, on the MXU
+        from ..frame.vec import Vec as _Vec
+        from ..ops.dct import dct_frame
+
+        fr2 = STORE.get(p.get("dataset", ""))
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {p.get('dataset')} not found")
+        dims = p.get("dimensions") or []
+        if isinstance(dims, str):
+            dims = [int(d) for d in dims.strip("[]").split(",") if d.strip()]
+        if len(dims) != 3:
+            return _err(400, "Need 3 dimensions (width/height/depth): "
+                             "WxHxD (1D: Wx1x1, 2D: WxHx1, 3D: WxHxD)")
+        X = np.stack([fr2.vec(n).to_numpy() for n in fr2.names], axis=1)
+        Y = dct_frame(X, dims[0], dims[1], dims[2],
+                      inverse=_truthy(p.get("inverse")))
+        dest = p.get("destination_frame") or f"{p.get('dataset')}_dct"
+        out = Frame([f"C{i + 1}" for i in range(Y.shape[1])],
+                    [_Vec.from_numpy(Y[:, i]) for i in range(Y.shape[1])],
+                    key=dest)
+        STORE.put_keyed(out)
+        return 200, {"key": schemas.key_schema(dest, "Key<Frame>"),
+                     "job": {"status": "DONE",
+                             "dest": schemas.key_schema(dest)}}
+    if head == "ImportSQLTable" and method == "POST":
+        # `water/jdbc/SQLManager` (`POST /99/ImportSQLTable`)
+        from ..io.sqlimport import import_sql
+
+        fr2 = import_sql(
+            p.get("connection_url", ""), table=p.get("table", "") or "",
+            select_query=p.get("select_query", "") or "",
+            columns=p.get("columns", "*") or "*")
+        job = Job(f"ImportSQLTable {fr2.key}", work=1.0)
+        job.dest_key = fr2.key
+        job.start(lambda: fr2, background=False)
+        return 200, {"job": schemas.job_schema(job),
+                     "destination_frame": schemas.key_schema(fr2.key)}
+    if head in ("ImportHiveTable", "SaveToHiveTable") and method == "POST":
+        # `water/hive/HiveTableImporter` — needs a live Hive metastore;
+        # gate unless one is configured (the reference fails identically
+        # without a Hive cluster on the classpath)
+        if not os.environ.get("H2O_TPU_HIVE_JDBC"):
+            return _err(501, f"{head}: no Hive metastore configured "
+                             "(set H2O_TPU_HIVE_JDBC to a reachable "
+                             "HiveServer2 JDBC url)")
+        return _err(501, f"{head}: Hive JDBC transport not implemented "
+                         "in this build")
+    if head == "ParseSVMLight" and method == "POST":
+        # `POST /3/ParseSVMLight` (`ParseHandler.parseSVMLight`) — force the
+        # SVMLight reader regardless of the source's extension
+        from ..io.parser import _parse_svmlight
+
+        paths = p.get("source_frames") or p.get("source_keys") or []
+        if isinstance(paths, str):
+            paths = [paths]
+        paths = [s.strip('"') for s in paths]
+        if not paths:
+            return _err(400, "ParseSVMLight: source_frames is required")
+        dest = p.get("destination_frame") or _dest_name(paths[0])
+        src = _resolve_upload(paths[0])[0]
+        job = Job(f"ParseSVMLight {paths[0]}", work=1.0)
+
+        def run_svm():
+            fr3 = _parse_svmlight(src, dest_key=dest)
+            job.dest_key = fr3.key
+            return fr3
+
+        job.start(run_svm, background=True)
+        return 200, {"job": schemas.job_schema(job),
+                     "destination_frame": schemas.key_schema(dest)}
+
+    # -- decryption setup ----------------------------------------------------
+    if head == "DecryptionSetup" and method == "POST":
+        # `water/api/DecryptionSetupHandler` → DecryptionTool; the keystore
+        # is an uploaded key file (PostFile) or a server-side path
+        from ..io.crypto import DecryptionTool, parse_key_material
+        from ..io.upload import UploadedFile
+
+        ks = p.get("keystore_id", "")
+        obj = STORE.get(ks)
+        if isinstance(obj, UploadedFile):
+            with open(obj.path, "rb") as fh:
+                raw = fh.read()
+        elif ks and os.path.exists(ks):
+            with open(ks, "rb") as fh:
+                raw = fh.read()
+        else:
+            return _err(404, f"DecryptionSetup: keystore {ks!r} not found "
+                             "(upload the key via PostFile first)")
+        secret = parse_key_material(raw, p.get("keystore_type", "raw"))
+        key = p.get("decrypt_tool_id") or make_key("decrypt_tool")
+        tool = DecryptionTool(key, secret,
+                              p.get("cipher_spec", "AES/CBC/PKCS5Padding"))
+        STORE.put(key, tool)
+        return 200, {"decrypt_tool_id": schemas.key_schema(key),
+                     "decrypt_impl": "GenericDecryptionTool",
+                     "cipher_spec": tool.cipher_spec}
+
+    # -- node persistent storage --------------------------------------------
+    if head == "NodePersistentStorage":
+        from ..backend.nps import NPS
+
+        if rest[1:] and rest[1] == "configured":
+            return 200, {"configured": NPS.configured()}
+        if rest[1:] and rest[1] == "categories":
+            # /categories/{cat}/exists | /categories/{cat}/names/{n}/exists
+            cat = rest[2] if rest[2:] else ""
+            if rest[3:] and rest[3] == "names" and rest[4:]:
+                return 200, {"exists": NPS.exists(cat, rest[4])}
+            return 200, {"exists": NPS.exists(cat)}
+        cat = rest[1] if rest[1:] else ""
+        if not cat:
+            return _err(404, "NodePersistentStorage: category required")
+        name = rest[2] if rest[2:] else None
+        if method == "GET" and name:
+            return 200, {"__raw__": NPS.get(cat, name),
+                         "__ctype__": "application/octet-stream",
+                         "__filename__": name}
+        if method == "GET":
+            return 200, {"entries": NPS.list(cat)}
+        if method == "POST":
+            if name is None:
+                import uuid
+
+                name = str(uuid.uuid4())
+            NPS.put(cat, name, p.get("value", ""))
+            return 200, {"category": cat, "name": name}
+        if method == "DELETE" and name:
+            NPS.delete(cat, name)
+            return 200, {}
+        return _err(404, "NodePersistentStorage: bad request")
+
+    # -- assembly (`POST /99/Assembly`, `GET /99/Assembly.java/...`) --------
+    if head == "Assembly" and method == "POST":
+        from .assembly_server import Assembly, parse_steps
+
+        fr2 = STORE.get(p.get("frame", ""))
+        if not isinstance(fr2, Frame):
+            return _err(404, f"frame {p.get('frame')} not found")
+        steps = parse_steps(p.get("steps"))
+        asm = Assembly(steps)
+        result = asm.fit(fr2)
+        if result is fr2:  # empty pipeline: never rebind the input's key
+            result = Frame(list(fr2.names), list(fr2.vecs))
+        result.key = make_key("assembly_result")
+        STORE.put_keyed(result)
+        STORE.put(asm.key, asm)
+        return 200, {"assembly": schemas.key_schema(asm.key, "Key<Assembly>"),
+                     "result": schemas.key_schema(result.key, "Key<Frame>")}
+    if head == "Assembly.java" and method == "GET" and rest[2:]:
+        from .assembly_server import Assembly
+
+        asm = STORE.get(urllib.parse.unquote(rest[1]))
+        if not isinstance(asm, Assembly):
+            return _err(404, f"assembly {rest[1]} not found")
+        pojo_name = urllib.parse.unquote(rest[2])
+        if pojo_name.endswith(".java"):
+            pojo_name = pojo_name[:-5]
+        return 200, {"__raw__": asm.to_java(pojo_name),
+                     "__ctype__": "text/x-java",
+                     "__filename__": f"{pojo_name}.java"}
+
     if head == "Recovery" and method == "POST" and rest[1:] \
             and rest[1] == "resume":
         # `POST /3/Recovery/resume` (`water/api/RecoveryHandler`, the
@@ -1986,6 +2257,35 @@ _ROUTES_DOC = [
         ("DELETE", "/99/Grids/{id}", "remove a grid"),
         ("POST", "/3/Grid.bin/import", "import an exported grid"),
         ("POST", "/3/Grid.bin/{id}/export", "export a grid and its models"),
+        ("POST", "/3/FeatureInteraction",
+         "xgbfi feature-interaction tables for a tree model"),
+        ("POST", "/3/FriedmansPopescusH",
+         "Friedman-Popescu H interaction statistic"),
+        ("POST", "/3/SignificantRules", "RuleFit rule-importance table"),
+        ("POST", "/99/Tabulate", "co-occurrence tabulation of two columns"),
+        ("POST", "/99/DCTTransformer", "row-wise discrete cosine transform"),
+        ("POST", "/99/ImportSQLTable", "import a SQL table (sqlite3)"),
+        ("POST", "/3/ImportHiveTable", "import a Hive table (gated)"),
+        ("POST", "/3/SaveToHiveTable", "export to a Hive table (gated)"),
+        ("POST", "/3/ParseSVMLight", "parse SVMLight files directly"),
+        ("POST", "/3/DecryptionSetup", "register an AES decryption tool"),
+        ("GET", "/3/NodePersistentStorage/configured", "NPS availability"),
+        ("GET", "/3/NodePersistentStorage/categories/{category}/exists",
+         "category existence"),
+        ("GET", "/3/NodePersistentStorage/categories/{category}"
+                "/names/{name}/exists", "entry existence"),
+        ("GET", "/3/NodePersistentStorage/{category}", "list a category"),
+        ("GET", "/3/NodePersistentStorage/{category}/{name}",
+         "fetch an entry"),
+        ("POST", "/3/NodePersistentStorage/{category}",
+         "store under a fresh uuid name"),
+        ("POST", "/3/NodePersistentStorage/{category}/{name}",
+         "store under a name"),
+        ("DELETE", "/3/NodePersistentStorage/{category}/{name}",
+         "delete an entry"),
+        ("POST", "/99/Assembly", "fit a munging pipeline"),
+        ("GET", "/99/Assembly.java/{assembly_id}/{file_name}",
+         "pipeline as a self-contained Java class"),
         ("POST", "/99/AutoMLBuilder", "launch an AutoML run"),
         ("GET", "/99/AutoML/{id}", "AutoML run detail + event log"),
         ("GET", "/99/Leaderboards", "list AutoML projects"),

@@ -1,0 +1,77 @@
+"""Node-persistent storage — `water/init/NodePersistentStorage` behind the
+`/3/NodePersistentStorage` REST family (Flow saves notebooks through it).
+
+A category/name → bytes store rooted in an on-disk directory (the reference
+roots it at `-flow_dir`/ice; here `H2O_TPU_NPS_DIR` or `<ice>/nps`). Names
+and categories are restricted to a safe charset so a REST caller can never
+path-escape the root."""
+
+from __future__ import annotations
+
+import os
+import re
+
+_SAFE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+class NodePersistentStorage:
+    def __init__(self, root: str | None = None):
+        self.root = root or os.environ.get("H2O_TPU_NPS_DIR") or \
+            os.path.join(os.environ.get("H2O_TPU_ICE_DIR", "/tmp/h2o_tpu"),
+                         "nps")
+
+    def configured(self) -> bool:
+        return True  # always rooted (the reference is unconfigured only
+        # when no flow_dir could be determined)
+
+    def _dir(self, category: str, name: str | None = None) -> str:
+        if not _SAFE.match(category or ""):
+            raise ValueError(f"bad category {category!r}")
+        if name is not None and not _SAFE.match(name):
+            raise ValueError(f"bad name {name!r}")
+        p = os.path.join(self.root, category)
+        return p if name is None else os.path.join(p, name)
+
+    def put(self, category: str, name: str, value: str | bytes) -> None:
+        path = self._dir(category, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        data = value.encode() if isinstance(value, str) else value
+        # dot-prefixed temp name lives OUTSIDE the entry namespace (_SAFE
+        # requires a leading alphanumeric) — it can never collide with or
+        # destroy a legitimate entry
+        tmp = os.path.join(os.path.dirname(path), f".tmp-{name}")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic wrt concurrent readers
+
+    def get(self, category: str, name: str) -> bytes:
+        path = self._dir(category, name)
+        if not os.path.exists(path):
+            raise KeyError(f"no NPS entry {category}/{name}")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, category: str, name: str | None = None) -> bool:
+        return os.path.exists(self._dir(category, name))
+
+    def delete(self, category: str, name: str) -> None:
+        path = self._dir(category, name)
+        if os.path.exists(path):
+            os.unlink(path)
+
+    def list(self, category: str) -> list[dict]:
+        d = self._dir(category)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for name in sorted(os.listdir(d)):
+            if name.startswith("."):  # in-flight temp files
+                continue
+            st = os.stat(os.path.join(d, name))
+            out.append({"category": category, "name": name,
+                        "size": st.st_size,
+                        "timestamp_millis": int(st.st_mtime * 1000)})
+        return out
+
+
+NPS = NodePersistentStorage()

@@ -1,0 +1,385 @@
+"""Tree-model interaction statistics.
+
+Two reference facilities live here, both driven off the engine's heap forest
+arrays (``feat/thr/val/nanL`` + lazy ``cover``):
+
+- **Feature interactions** (`hex/FeatureInteractions.java`, the xgbfi
+  algorithm behind `POST /3/FeatureInteraction`): every path prefix of every
+  tree up to ``max_interaction_depth`` becomes an interaction with
+  gain/cover/FScore/weighted-FScore statistics, aggregated per sorted
+  feature-name tuple, published as per-depth ranked tables plus a
+  leaf-statistics table and per-root-feature split-value histograms.
+- **Friedman & Popescu's H statistic** (`hex/tree/FriedmanPopescusH.java`,
+  `POST /3/FriedmansPopescusH`): variance share of the joint partial
+  dependence not explained by lower-order effects, computed via
+  cover-weighted partial-dependence tree traversal over the unique rows of
+  the chosen variables (Ann. Appl. Stat. 2:916-954 s.8.1).
+
+Node gains use the squared-error formulation the JVM applies when trees
+carry no stored gains (`SharedTreeNode.getGain(useSquaredErrorForGain=true)`
+= SE(node) - SE(left) - SE(right)); with node values being cover-weighted
+means, that reduces to cover_L·v_L² + cover_R·v_R² − cover·v² — computable
+from covers and values alone, no data pass."""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.twodimtable import TwoDimTable
+
+
+# ---------------------------------------------------------------------------
+# shared heap-tree helpers
+# ---------------------------------------------------------------------------
+def _tree_list(model):
+    """Yield (tree_index, class_index, feat, thr, val, nanL, cover) with
+    1-D node arrays; multinomial forests iterate per class like
+    `GBMModel.getFeatureInteractions` does."""
+    model._ensure_covers()
+    F = np.asarray(model.forest["feat"])
+    T = np.asarray(model.forest["thr"])
+    V = np.asarray(model.forest["val"], dtype=np.float64)
+    L = np.asarray(model.forest["nanL"])
+    C = np.asarray(model.forest["cover"], dtype=np.float64)
+    if F.ndim == 3:
+        for t in range(F.shape[0]):
+            for k in range(F.shape[1]):
+                yield t, k, F[t, k], T[t, k], V[t, k], L[t, k], C[t, k]
+    else:
+        for t in range(F.shape[0]):
+            yield t, 0, F[t], T[t], V[t], L[t], C[t]
+
+
+def _internal_values(feat, val, cover):
+    """Fill internal-node values bottom-up as cover-weighted child means —
+    the node prediction a JVM tree stores for every node."""
+    v = np.array(val, dtype=np.float64)
+    N = len(v)
+    for j in range(N - 1, -1, -1):
+        l, r = 2 * j + 1, 2 * j + 2
+        if feat[j] >= 0 and l < N:
+            cl, cr = cover[l], cover[r]
+            tot = cl + cr
+            if tot > 0:
+                v[j] = (cl * v[l] + cr * v[r]) / tot
+    return v
+
+
+def _node_gain(j, feat, vint, cover):
+    l, r = 2 * j + 1, 2 * j + 2
+    if feat[j] < 0 or l >= len(feat):
+        return 0.0
+    return (cover[l] * vint[l] ** 2 + cover[r] * vint[r] ** 2
+            - cover[j] * vint[j] ** 2)
+
+
+# ---------------------------------------------------------------------------
+# feature interactions (xgbfi)
+# ---------------------------------------------------------------------------
+@dataclass
+class _FI:
+    name: str
+    depth: int
+    gain: float = 0.0
+    cover: float = 0.0
+    fscore: float = 0.0
+    fscore_weighted: float = 0.0
+    expected_gain: float = 0.0
+    tree_index: float = 0.0
+    tree_depth: float = 0.0
+    has_leaf_stats: bool = False
+    sum_leaf_values_left: float = 0.0
+    sum_leaf_covers_left: float = 0.0
+    sum_leaf_values_right: float = 0.0
+    sum_leaf_covers_right: float = 0.0
+    split_value_histogram: dict = field(default_factory=dict)
+
+    @property
+    def average_fscore_weighted(self):
+        return self.fscore_weighted / self.fscore
+
+    @property
+    def average_gain(self):
+        return self.gain / self.fscore
+
+    @property
+    def average_tree_index(self):
+        return self.tree_index / self.fscore
+
+    @property
+    def average_tree_depth(self):
+        return self.tree_depth / self.fscore
+
+
+def collect_feature_interactions(model, max_interaction_depth=100,
+                                 max_tree_depth=100, max_deepening=-1):
+    """The `FeatureInteractions.collectFeatureInteractions` recursion over
+    every tree; returns {name: _FI} aggregated across trees."""
+    names = list(model.output.names)
+    out: dict[str, _FI] = {}
+
+    for tree_idx, _k, feat, thr, val, nanL, cover in _tree_list(model):
+        vint = _internal_values(feat, val, cover)
+        per_tree: dict[str, _FI] = {}
+        memo: set[tuple] = set()
+        N = len(feat)
+
+        def is_leaf(j):
+            return j >= N or feat[j] < 0 or cover[j] <= 0
+
+        def recurse(j, path, cur_gain, cur_cover, path_proba, depth,
+                    deepening):
+            if is_leaf(j) or depth == max_tree_depth:
+                return
+            path = path + [j]
+            cur_gain += _node_gain(j, feat, vint, cover)
+            cur_cover += cover[j]
+            l, r = 2 * j + 1, 2 * j + 2
+            cj = max(cover[j], 1e-300)
+            ppl = path_proba * (cover[l] / cj)
+            ppr = path_proba * (cover[r] / cj)
+
+            fi_name = "|".join(sorted(names[int(feat[p])] for p in path))
+            fi_depth = len(path) - 1
+
+            # the reference gates restarts on tree depth, not the deepening
+            # counter (`FeatureInteractions.java:250` `depth < maxDeepening`)
+            if depth < max_deepening or max_deepening < 0:
+                # restart sub-collections below this node (deepening pass)
+                recurse(l, [], 0.0, 0.0, ppl, depth + 1, deepening + 1)
+                recurse(r, [], 0.0, 0.0, ppr, depth + 1, deepening + 1)
+
+            epath = tuple(path)
+            fi = per_tree.get(fi_name)
+            if fi is None:
+                fi = _FI(fi_name, fi_depth)
+                fi.gain = cur_gain
+                fi.cover = cur_cover
+                fi.fscore = 1.0
+                fi.fscore_weighted = path_proba
+                fi.expected_gain = cur_gain * path_proba
+                fi.tree_index = tree_idx
+                fi.tree_depth = depth
+                if fi_depth == 0:
+                    sv = float(thr[path[0]])
+                    fi.split_value_histogram[sv] = \
+                        fi.split_value_histogram.get(sv, 0) + 1
+                per_tree[fi_name] = fi
+                memo.add(epath)
+            else:
+                if epath in memo:
+                    return
+                memo.add(epath)
+                fi.gain += cur_gain
+                fi.cover += cur_cover
+                fi.fscore += 1
+                fi.fscore_weighted += path_proba
+                fi.expected_gain += cur_gain * path_proba
+                fi.tree_depth += depth
+                fi.tree_index += tree_idx
+                if fi_depth == 0:
+                    sv = float(thr[path[0]])
+                    fi.split_value_histogram[sv] = \
+                        fi.split_value_histogram.get(sv, 0) + 1
+
+            if len(path) - 1 == max_interaction_depth:
+                return
+            fi = per_tree[fi_name]
+            if is_leaf(l) and l < N and deepening == 0 and cover[l] > 0:
+                fi.sum_leaf_values_left += vint[l]
+                fi.sum_leaf_covers_left += cover[l]
+                fi.has_leaf_stats = True
+            if is_leaf(r) and r < N and deepening == 0 and cover[r] > 0:
+                fi.sum_leaf_values_right += vint[r]
+                fi.sum_leaf_covers_right += cover[r]
+                fi.has_leaf_stats = True
+            # the reference passes currentGain into the COVER slot of the
+            # continuing recursion (`hex/FeatureInteractions.java:300-302`,
+            # faithfully mirroring xgbfi); parity beats plausibility here
+            recurse(l, list(path), cur_gain, cur_gain, ppl, depth + 1,
+                    deepening)
+            recurse(r, list(path), cur_gain, cur_gain, ppr, depth + 1,
+                    deepening)
+
+        recurse(0, [], 0.0, 0.0, 1.0, 0, 0)
+
+        # merge this tree's interactions into the global map
+        for name, fi in per_tree.items():
+            g = out.get(name)
+            if g is None:
+                out[name] = fi
+            else:
+                g.gain += fi.gain
+                g.cover += fi.cover
+                g.fscore += fi.fscore
+                g.fscore_weighted += fi.fscore_weighted
+                g.expected_gain += fi.expected_gain
+                g.tree_index += fi.tree_index
+                g.tree_depth += fi.tree_depth
+                g.sum_leaf_values_left += fi.sum_leaf_values_left
+                g.sum_leaf_covers_left += fi.sum_leaf_covers_left
+                g.sum_leaf_values_right += fi.sum_leaf_values_right
+                g.sum_leaf_covers_right += fi.sum_leaf_covers_right
+                g.has_leaf_stats = g.has_leaf_stats or fi.has_leaf_stats
+                for sv, c in fi.split_value_histogram.items():
+                    g.split_value_histogram[sv] = \
+                        g.split_value_histogram.get(sv, 0) + c
+    return out
+
+
+def _rank(fis, key):
+    order = sorted(fis, key=key)
+    return {id(fi): i + 1 for i, fi in enumerate(order)}
+
+
+def feature_interactions_tables(model, max_interaction_depth=100,
+                                max_tree_depth=100, max_deepening=-1):
+    """`FeatureInteractions.getFeatureInteractionsTable`: one ranked table
+    per interaction depth, then the leaf-statistics table, then one
+    split-value histogram table per singleton feature. Returns a list of
+    TwoDimTables (the flattened layout `ModelsHandler.makeFeatureInteraction`
+    ships)."""
+    fis = collect_feature_interactions(model, max_interaction_depth,
+                                       max_tree_depth, max_deepening)
+    if not fis:
+        return []
+    tables = []
+    max_depth = max(fi.depth for fi in fis.values())
+    for depth in range(max_depth + 1):
+        level = [fi for fi in fis.values() if fi.depth == depth]
+        ranks = {crit: _rank(level, key) for crit, key in [
+            ("gain", lambda f: -f.gain), ("fscore", lambda f: -f.fscore),
+            ("wfscore", lambda f: -f.fscore_weighted),
+            ("avg_wfscore", lambda f: -f.average_fscore_weighted),
+            ("avg_gain", lambda f: -f.average_gain),
+            ("exp_gain", lambda f: -f.expected_gain)]}
+        rows = []
+        for fi in level:
+            rs = [ranks[c][id(fi)] for c in
+                  ("gain", "fscore", "wfscore", "avg_wfscore", "avg_gain",
+                   "exp_gain")]
+            rows.append([fi.name, fi.gain, fi.fscore, fi.fscore_weighted,
+                         fi.average_fscore_weighted, fi.average_gain,
+                         fi.expected_gain, *rs, float(np.mean(rs)),
+                         fi.average_tree_index, fi.average_tree_depth])
+        tables.append(TwoDimTable(
+            f"Interaction Depth {depth}", "",
+            ["Interaction", "Gain", "FScore", "wFScore", "Average wFScore",
+             "Average Gain", "Expected Gain", "Gain Rank", "FScore Rank",
+             "wFScore Rank", "Avg wFScore Rank", "Avg Gain Rank",
+             "Expected Gain Rank", "Average Rank", "Average Tree Index",
+             "Average Tree Depth"],
+            ["string"] + ["double"] * 6 + ["int"] * 6 + ["double"] * 3,
+            None, rows))
+    leaf = [fi for fi in fis.values() if fi.has_leaf_stats]
+    tables.append(TwoDimTable(
+        "Leaf Statistics", "",
+        ["Interaction", "Sum Leaf Values Left", "Sum Leaf Values Right",
+         "Sum Leaf Covers Left", "Sum Leaf Covers Right"],
+        ["string"] + ["double"] * 4, None,
+        [[fi.name, fi.sum_leaf_values_left, fi.sum_leaf_values_right,
+          fi.sum_leaf_covers_left, fi.sum_leaf_covers_right]
+         for fi in leaf]))
+    for fi in fis.values():
+        if fi.depth == 0 and fi.split_value_histogram:
+            svs = sorted(fi.split_value_histogram)
+            tables.append(TwoDimTable(
+                f"Split Value Histogram for {fi.name}", "",
+                ["Split Value", "Count"], ["double", "double"], None,
+                [[sv, float(fi.split_value_histogram[sv])] for sv in svs]))
+    return tables
+
+
+# ---------------------------------------------------------------------------
+# Friedman & Popescu H
+# ---------------------------------------------------------------------------
+def _pdp_tree(feat, thr, nanL, vleaf, cover, rows, var_cols):
+    """Cover-weighted partial-dependence traversal of one heap tree
+    (`FriedmanPopescusH.partialDependenceTree`): splits on a chosen variable
+    follow the branch, all other splits fan out weighted by child cover.
+    ``rows`` is (U, len(var_cols)) of values for the chosen variables;
+    returns (U,) partial-dependence contributions."""
+    N = len(feat)
+    col_of = {c: i for i, c in enumerate(var_cols)}
+    out = np.zeros(len(rows))
+    for i, row in enumerate(rows):
+        stack = [(0, 1.0)]
+        acc = 0.0
+        while stack:
+            j, wgt = stack.pop()
+            if j >= N or cover[j] <= 0:
+                continue
+            f = int(feat[j])
+            if f < 0:  # leaf
+                acc += wgt * vleaf[j]
+                continue
+            l, r = 2 * j + 1, 2 * j + 2
+            if f in col_of:
+                x = row[col_of[f]]
+                if np.isnan(x):
+                    stack.append((l if nanL[j] else r, wgt))
+                else:
+                    stack.append((l if x < thr[j] else r, wgt))
+            else:
+                cj = max(cover[j], 1e-300)
+                stack.append((l, wgt * cover[l] / cj))
+                stack.append((r, wgt * cover[r] / cj))
+        out[i] = acc
+    return out
+
+
+def friedman_popescu_h(model, fr, variables) -> float:
+    """H statistic for the interaction among ``variables`` in a tree model
+    (`hex/tree/FriedmanPopescusH.h`). Centered partial-dependence values on
+    the unique rows of the variables, inclusion-exclusion numerator, joint-F
+    denominator; NaN when rounding noise swamps the effect (numer>=denom)."""
+    names = list(model.output.names)
+    idx = []
+    for v in variables:
+        if v not in names:
+            raise ValueError(f"Column {v} is not present in the input frame!")
+        idx.append(names.index(v))
+    k = len(idx)
+    # unique rows of the full variable set, with multiplicities
+    X = np.stack([np.asarray(fr.vec(v).to_numpy(), dtype=np.float64)
+                  for v in variables], axis=1)
+    uniq, counts = np.unique(X, axis=0, return_counts=True)
+    nrows = float(X.shape[0])
+
+    model._ensure_covers()
+    # internal-node values hoisted: every variable-subset evaluation walks
+    # the same trees, so compute the O(nodes) fill once per tree
+    trees = [(feat, thr, nanL, _internal_values(feat, val, cover), cover)
+             for _t, cls, feat, thr, val, nanL, cover in _tree_list(model)
+             if cls == 0]  # reference: computeHValue reads class-0 pdp
+
+    def f_values(sub):  # sub: tuple of positions into `variables`
+        cols = [idx[s] for s in sub]
+        sub_rows, inv = np.unique(uniq[:, list(sub)], axis=0,
+                                  return_inverse=True)
+        f = np.zeros(len(sub_rows))
+        for feat, thr, nanL, vint, cover in trees:
+            f += _pdp_tree(feat, thr, nanL, vint, cover, sub_rows, cols)
+        full = f[inv]  # back to the full unique-row grid
+        mean = float(np.sum(full * counts) / nrows)
+        return full - mean
+
+    all_pos = tuple(range(k))
+    fvals = {}
+    for n in range(1, k + 1):
+        for sub in itertools.combinations(all_pos, n):
+            fvals[sub] = f_values(sub)
+
+    numer_els = np.zeros(len(uniq))
+    sign = 1
+    for n in range(k, 0, -1):
+        for sub in itertools.combinations(all_pos, n):
+            numer_els += sign * fvals[sub]
+        sign *= -1
+    denom_els = fvals[all_pos]
+    numer = float(np.sum(numer_els ** 2 * counts))
+    denom = float(np.sum(denom_els ** 2 * counts))
+    return float(np.sqrt(numer / denom)) if numer < denom else float("nan")

@@ -1,0 +1,50 @@
+"""Discrete cosine transform of frame rows — `water/util/MathUtils.DCT`
+(`POST /99/DCTTransformer`).
+
+Each row is a W[×H[×D]] signal laid out across the frame's columns; the
+orthonormal DCT-II (JTransforms' ``DoubleDCT_1D.forward(a, true)`` scaling:
+factor √(2/N) with the DC term divided by √2) is applied along every
+dimension, the inverse being the orthonormal DCT-III. On device this is a
+dense matmul per axis — exactly the MXU's shape, unlike the reference's
+per-row JTransforms loop."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _dct_matrix(n: int) -> np.ndarray:
+    """Orthonormal DCT-II matrix C (forward y = C @ x; inverse x = Cᵀ @ y)."""
+    k = np.arange(n)[:, None]
+    i = np.arange(n)[None, :]
+    C = np.sqrt(2.0 / n) * np.cos(np.pi * (i + 0.5) * k / n)
+    C[0] /= np.sqrt(2.0)
+    return C
+
+
+def dct_frame(X: np.ndarray, width: int, height: int = 1, depth: int = 1,
+              inverse: bool = False) -> np.ndarray:
+    """(R, W*H*D) row-major signals → transformed, same shape.
+
+    Matches `MathUtils.DCT.initCheck`: dimensions must multiply to the
+    column count, values must be finite."""
+    import jax.numpy as jnp
+
+    if width < 1 or height < 1 or depth < 1:
+        raise ValueError("dimensions must be >= 1")
+    if width * height * depth != X.shape[1]:
+        raise ValueError("dimensions WxHxD must match the # columns "
+                         f"of the frame ({X.shape[1]})")
+    if np.isnan(X).any():
+        raise ValueError("DCT can not be computed on rows with missing "
+                         "values")
+    R = X.shape[0]
+    sig = jnp.asarray(X, dtype=jnp.float32).reshape(R, width, height, depth)
+    for axis, n in ((1, width), (2, height), (3, depth)):
+        if n == 1:
+            continue
+        C = jnp.asarray(_dct_matrix(n), dtype=jnp.float32)
+        M = C.T if inverse else C
+        sig = jnp.moveaxis(
+            jnp.tensordot(sig, M, axes=[[axis], [1]]), -1, axis)
+    return np.asarray(sig.reshape(R, -1))

@@ -617,3 +617,81 @@ def moment(year, month, day, hour=0.0, minute=0.0, second=0.0, msec=0.0) -> Vec:
     # float64 in: Vec keeps an exact host copy when f32 would be lossy
     # (ms-since-epoch exceeds 2^24)
     return Vec.from_numpy(out, type=T_TIME)
+
+
+# ---------------------------------------------------------------------------
+# Tabulate (`water/util/Tabulate`, `POST /99/Tabulate`)
+# ---------------------------------------------------------------------------
+def tabulate(fr: Frame, predictor: str, response: str,
+             weight: str | None = None, nbins_predictor: int = 20,
+             nbins_response: int = 10):
+    """Co-occurrence tabulation of predictor vs response: a weighted count
+    grid over (x-bin, y-bin) and the per-x-bin weighted response mean —
+    `Tabulate.execImpl`'s two tables. Categoricals keep one bin per level,
+    numerics bin uniformly over [min,max], missing values get a leading
+    "missing(NA)" bin when present (the reference's `_missing` offset)."""
+    from ..utils.twodimtable import TwoDimTable
+
+    if nbins_predictor < 1 or nbins_response < 1:
+        raise ValueError("number of bins must be >= 1")
+    vx, vy = fr.vec(predictor), fr.vec(response)
+    if vx is None or vy is None:
+        missing = predictor if vx is None else response
+        raise KeyError(f"column {missing} not found")
+    w = (fr.vec(weight).to_numpy() if weight else
+         np.ones(fr.nrow, dtype=np.float64))
+
+    def axis(v, nbins):
+        x = v.to_numpy().astype(np.float64)
+        has_na = bool(np.isnan(x).any())
+        if v.domain is not None:
+            nb = v.cardinality()
+            bins = np.where(np.isnan(x), -1, x).astype(np.int64)
+            labels = list(v.domain)
+        else:
+            lo, hi = np.nanmin(x), np.nanmax(x)
+            if v.type == T_INT and (hi - lo + 1) <= nbins:
+                nb = int(hi - lo + 1)
+                bins = np.where(np.isnan(x), -1, x - lo).astype(np.int64)
+                labels = [str(int(lo + b)) for b in range(nb)]
+            else:
+                nb = nbins
+                d = (hi - lo) / nbins or 1.0
+                bins = np.where(np.isnan(x), -1,
+                                np.minimum((x - lo) / d, nbins - 1)
+                                ).astype(np.int64)
+                labels = [f"{lo + (b + 0.5) * d:5f}" for b in range(nb)]
+        if has_na:  # NA bin leads, like `Tabulate.bin()`'s +_missing offset
+            bins = bins + 1
+            labels = ["missing(NA)"] + labels
+            nb += 1
+        return bins, labels, nb
+
+    xb, xlabels, nx = axis(vx, nbins_predictor)
+    yb, ylabels, ny = axis(vy, nbins_response)
+    yraw = vy.to_numpy().astype(np.float64)
+
+    counts = np.zeros((nx, ny))
+    np.add.at(counts, (xb, yb), w)
+    resp_w = np.zeros(nx)
+    resp_sum = np.zeros(nx)
+    ok = ~np.isnan(yraw)
+    np.add.at(resp_w, xb[ok], w[ok])
+    np.add.at(resp_sum, xb[ok], (w * yraw)[ok])
+    with np.errstate(invalid="ignore", divide="ignore"):
+        resp_mean = resp_sum / resp_w
+
+    count_rows = [[xlabels[i], ylabels[j], float(counts[i, j])]
+                  for i in range(nx) for j in range(ny)]
+    count_table = TwoDimTable(
+        f"(Weighted) co-occurrence counts of {predictor} vs {response}", "",
+        [predictor, response, "counts"], ["string", "string", "double"],
+        None, count_rows)
+    resp_rows = [[xlabels[i],
+                  None if resp_w[i] == 0 else float(resp_mean[i]),
+                  float(resp_w[i])] for i in range(nx)]
+    response_table = TwoDimTable(
+        f"(Weighted) response means of {response} by {predictor}", "",
+        [predictor, f"mean {response}", "counts"],
+        ["string", "double", "double"], None, resp_rows)
+    return count_table, response_table

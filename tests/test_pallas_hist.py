@@ -56,8 +56,9 @@ def test_pallas_end_to_end_gbm_matches():
     preds = {}
     try:
         for up in (False, True):
-            GBM._tree_config = (lambda u: lambda self, K: dataclasses.replace(
-                orig(self, K), use_pallas=u))(up)
+            GBM._tree_config = (
+                lambda u: lambda self, K, **kw: dataclasses.replace(
+                    orig(self, K, **kw), use_pallas=u))(up)
             m = GBM(params).train_model()
             preds[up] = m.predict(fr).vec(2).to_numpy()
     finally:
