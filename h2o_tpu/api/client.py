@@ -20,6 +20,7 @@ import json
 import os
 import time
 import urllib.error
+import uuid
 import urllib.parse
 import urllib.request
 
@@ -482,22 +483,51 @@ def remove_all():
 
 
 def create_frame(rows: int = 10000, cols: int = 10, seed: int = -1,
-                 categorical_fraction: float = 0.2,
-                 integer_fraction: float = 0.2,
-                 binary_fraction: float = 0.1,
+                 real_fraction: float | None = None,
+                 categorical_fraction: float | None = None,
+                 integer_fraction: float | None = None,
+                 binary_fraction: float | None = None,
+                 time_fraction: float | None = None,
+                 string_fraction: float | None = None,
                  missing_fraction: float = 0.0, factors: int = 100,
                  has_response: bool = False, response_factors: int = 2,
                  frame_id: str | None = None, **kw) -> "H2OFrame":
-    """`h2o.create_frame` — `POST /3/CreateFrame` (CreateFrameHandler)."""
+    """`h2o.create_frame` — `POST /3/CreateFrame` (CreateFrameHandler).
+
+    Unset fractions share the remainder by the reference client's weights
+    (real .5, cat .2, int .2, bin .1, time/string 0 — `h2o.py:1807-1837`),
+    so `string_fraction=1.0` yields a pure string frame like h2o-py."""
+    frcs = [real_fraction, categorical_fraction, integer_fraction,
+            binary_fraction, time_fraction, string_fraction]
+    wgts = [0.5, 0.2, 0.2, 0.1, 0.0, 0.0]
+    explicit = sum(0 if f is None else f for f in frcs)
+    if explicit >= 1 + 1e-10:
+        raise ValueError("column-type fractions must add up to <= 1")
+    if explicit < 1 - 1e-10:
+        remainder = 1 - explicit
+        sum_w = sum(wgts[i] for i in range(6) if frcs[i] is None)
+        for i in range(6):
+            if frcs[i] is not None:
+                continue
+            frcs[i] = remainder if sum_w == 0 else \
+                remainder * wgts[i] / sum_w
+            remainder -= frcs[i]
+            sum_w -= wgts[i]
+    frcs = [0.0 if f is None else f for f in frcs]
     body = dict(rows=rows, cols=cols, seed=seed,
-                categorical_fraction=categorical_fraction,
-                integer_fraction=integer_fraction,
-                binary_fraction=binary_fraction,
+                real_fraction=frcs[0],
+                categorical_fraction=frcs[1],
+                integer_fraction=frcs[2],
+                binary_fraction=frcs[3],
+                time_fraction=frcs[4],
+                string_fraction=frcs[5],
                 missing_fraction=missing_fraction, factors=factors,
                 has_response=str(bool(has_response)).lower(),
                 response_factors=response_factors, **kw)
-    if frame_id:
-        body["dest"] = frame_id
+    # always send a unique dest (h2o-py sends py_tmp_key when unset) — two
+    # back-to-back create_frame calls must not overwrite each other under
+    # the server's shared default key
+    body["dest"] = frame_id or f"py_createframe_{uuid.uuid4().hex[:12]}"
     j = connection().request("POST", "/3/CreateFrame", data=body)
     return H2OFrame._by_id(j["key"]["name"])
 
@@ -654,7 +684,14 @@ class H2OFrame:
             return list(self._meta["names"])
         return [c["label"] for c in self._summary()["columns"]]
 
-    names = columns
+    @property
+    def names(self) -> list[str]:
+        return self.columns
+
+    @names.setter
+    def names(self, value: list[str]) -> None:
+        # h2o-py allows `fr.names = [...]` as a rename-in-place
+        self.set_names(list(value))
 
     @property
     def types(self) -> dict:
@@ -1520,6 +1557,79 @@ class H2OModelClient:
     def summary(self):
         return ((self._schema or {}).get("output") or {}).get("model_summary")
 
+    @property
+    def parms(self) -> dict:
+        """h2o-py `ModelBase.parms`: {name: {actual_value, default_value}}
+        off the model schema's parameters list."""
+        return {p["name"]: p
+                for p in (self._schema or {}).get("parameters", [])}
+
+    @property
+    def actual_params(self) -> dict:
+        return {k: v.get("actual_value") for k, v in self.parms.items()}
+
+    def cross_validation_models(self) -> list:
+        """The N fold models (`ModelBase.cross_validation_models`)."""
+        refs = ((self._schema or {}).get("output") or {}).get(
+            "cross_validation_models") or []
+        return [get_model(r["name"]) for r in refs]
+
+    def cross_validation_fold_assignment(self) -> "H2OFrame":
+        ref = ((self._schema or {}).get("output") or {}).get(
+            "cross_validation_fold_assignment_frame_id")
+        if not ref:
+            raise ValueError("no fold assignment kept (train with "
+                             "keep_cross_validation_fold_assignment=True)")
+        return get_frame(ref["name"])
+
+    def cross_validation_holdout_predictions(self) -> "H2OFrame":
+        ref = ((self._schema or {}).get("output") or {}).get(
+            "cross_validation_holdout_predictions_frame_id")
+        if not ref:
+            raise ValueError("no holdout predictions kept (train with "
+                             "keep_cross_validation_predictions=True)")
+        return get_frame(ref["name"])
+
+    def cross_validation_predictions(self) -> list:
+        refs = ((self._schema or {}).get("output") or {}).get(
+            "cross_validation_predictions") or []
+        return [get_frame(r["name"]) for r in refs]
+
+    def to_frame(self) -> "H2OFrame":
+        """Word2vec embeddings as a [Word, V1..VD] frame
+        (`H2OWordEmbeddingModel.to_frame` → rapids word2vec.to.frame)."""
+        j = rapids(f"(word2vec.to.frame {self.model_id})")
+        return H2OFrame._by_id(j["key"]["name"])
+
+    def weights(self, matrix_id: int = 0) -> "H2OFrame":
+        """Layer weight frame (units_out × units_in) —
+        `ModelBase.weights`, served when export_weights_and_biases=True."""
+        refs = ((self._schema or {}).get("output") or {}).get("weights")
+        if not refs:
+            raise ValueError("no exported weights (train with "
+                             "export_weights_and_biases=True)")
+        return get_frame(refs[matrix_id]["name"])
+
+    def biases(self, vector_id: int = 0) -> "H2OFrame":
+        refs = ((self._schema or {}).get("output") or {}).get("biases")
+        if not refs:
+            raise ValueError("no exported biases (train with "
+                             "export_weights_and_biases=True)")
+        return get_frame(refs[vector_id]["name"])
+
+    def num_iterations(self):
+        """Clustering/GLM iteration count (`ModelBase.num_iterations`)."""
+        return ((self._schema or {}).get("output") or {}).get(
+            "num_iterations")
+
+    def centers(self):
+        """Cluster means in row-major form (`H2OClusteringModel.centers`)."""
+        c = ((self._schema or {}).get("output") or {}).get("centers")
+        if not c:
+            return None
+        cols = c["data"]
+        return [[col[i] for col in cols] for i in range(len(cols[0]))]
+
     def auc(self, train=True, valid=False, xval=False):
         kind = ("cross_validation_metrics" if xval else
                 "validation_metrics" if valid else "training_metrics")
@@ -1757,6 +1867,9 @@ class H2OGridSearch:
     def __init__(self, model, hyper_params: dict, grid_id: str | None = None,
                  search_criteria: dict | None = None, parallelism: int = 1):
         self.model = model() if isinstance(model, type) else model
+        #: estimator class the contained models present as — h2o-py's grid
+        #: yields estimator instances (`for m in grid: isinstance(m, Est)`)
+        self._est_cls = model if isinstance(model, type) else type(model)
         self.hyper_params = hyper_params
         self.grid_id = grid_id
         self.search_criteria = search_criteria or {}
@@ -1800,7 +1913,24 @@ class H2OGridSearch:
 
     @property
     def models(self) -> list:
-        return [get_model(mid) for mid in self.model_ids]
+        out = []
+        for mid in self.model_ids:
+            est = self._est_cls()
+            est._model = get_model(mid)
+            out.append(est)
+        return out
+
+    def __iter__(self):
+        return iter(self.models)
+
+    def __len__(self) -> int:
+        return len(self.model_ids)
+
+    def __getitem__(self, i):
+        # one REST fetch for one model, not len(grid) of them
+        est = self._est_cls()
+        est._model = get_model(self.model_ids[i])
+        return est
 
     def get_grid(self, sort_by: str | None = None, decreasing: bool = False):
         self._fetch(sort_by, decreasing)

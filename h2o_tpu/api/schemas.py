@@ -130,6 +130,27 @@ def metrics_schema(m) -> dict | None:
         t = getattr(m, f, None)
         if t is not None:
             out[f] = table_schema(t)
+    if hasattr(m, "tot_withinss"):
+        # `ModelMetricsClusteringV3`: SS decomposition + per-cluster stats;
+        # combined CV metrics carry centroid_stats = null (the reference
+        # cannot pool per-cluster rows across folds)
+        out["totss"] = _clean(m.totss)
+        out["tot_withinss"] = _clean(m.tot_withinss)
+        out["betweenss"] = _clean(m.betweenss)
+        if getattr(m, "sizes", None) is not None and not getattr(
+                m, "_cv_combined", False):
+            out["centroid_stats"] = {
+                "name": "Centroid Statistics",
+                "columns": [{"name": "centroid", "type": "int"},
+                            {"name": "size", "type": "double"},
+                            {"name": "within_cluster_sum_of_squares",
+                             "type": "double"}],
+                "data": [
+                    list(range(1, len(np.asarray(m.sizes)) + 1)),
+                    _clean(np.asarray(m.sizes)),
+                    _clean(np.asarray(m.withinss))]}
+        else:
+            out["centroid_stats"] = None
     ts = getattr(m, "thresholds_and_metric_scores", None)
     if ts is not None:
         # downsample the 1024-bin per-threshold arrays (stride 8 → 128 rows):
@@ -164,6 +185,61 @@ def model_schema(model) -> dict:
             "run_time_ms": o.run_time_ms,
         },
     }
+    if getattr(o, "num_iterations", None) is not None:
+        out["output"]["num_iterations"] = int(o.num_iterations)
+    import dataclasses as _dc
+
+    if _dc.is_dataclass(model.params):
+        # `ModelSchemaV3.parameters` — actual vs default values per param
+        # (h2o-py `model.parms[name]['actual_value']` reads these)
+        plist = []
+        for fld in _dc.fields(model.params):
+            v = getattr(model.params, fld.name)
+            if isinstance(v, Frame) or hasattr(v, "vecs"):
+                v = {"name": getattr(v, "key", None)}
+            default = None if fld.default is _dc.MISSING else fld.default
+            if fld.default_factory is not _dc.MISSING:  # type: ignore
+                default = fld.default_factory()
+            plist.append({"name": fld.name, "label": fld.name,
+                          "actual_value": _clean(v),
+                          "default_value": _clean(default)})
+        out["parameters"] = plist
+
+    def _frame_ref(frobj):
+        return {"name": frobj.key, "type": "Key<Frame>",
+                "URL": f"/3/Frames/{frobj.key}"}
+
+    if getattr(o, "cv_holdout_predictions", None) is not None \
+            and o.cv_holdout_predictions.key:
+        out["output"]["cross_validation_holdout_predictions_frame_id"] = \
+            _frame_ref(o.cv_holdout_predictions)
+    if getattr(o, "cv_fold_predictions", None):
+        out["output"]["cross_validation_predictions"] = [
+            _frame_ref(f) for f in o.cv_fold_predictions if f.key]
+    if getattr(o, "cv_fold_assignment", None) is not None:
+        out["output"]["cross_validation_fold_assignment_frame_id"] = \
+            _frame_ref(o.cv_fold_assignment)
+    if getattr(o, "weights_keys", None):
+        # `DeepLearningModelOutputV3.weights/biases` — frame key refs with
+        # the /3/Frames URL h2o-py's model.weights() splits apart
+        out["output"]["weights"] = [
+            {"name": k, "type": "Key<Frame>", "URL": f"/3/Frames/{k}"}
+            for k in o.weights_keys]
+        out["output"]["biases"] = [
+            {"name": k, "type": "Key<Frame>", "URL": f"/3/Frames/{k}"}
+            for k in o.biases_keys]
+    if hasattr(model, "centers"):  # clustering: KMeansModelOutputV3.centers
+        import numpy as _np
+
+        c = _np.asarray(model.centers)
+        # centers live in the EXPANDED feature space (one-hot categoricals),
+        # which may be wider than the input names
+        cnames = list(o.names) if len(o.names) == c.shape[1] else \
+            [f"C{j + 1}" for j in range(c.shape[1])]
+        out["output"]["centers"] = {
+            "name": "Cluster means",
+            "columns": [{"name": n, "type": "double"} for n in cnames],
+            "data": _clean([c[:, j].tolist() for j in range(c.shape[1])])}
     if hasattr(model, "coef"):  # GLM-family: `hex/schemas/GLMModelV3`
         try:
             coefs = model.coef()

@@ -1196,14 +1196,46 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         factors = int(p.get("factors", 100) or 100)
         real_range = float(p.get("real_range", 100.0) or 100.0)
         int_range = int(p.get("integer_range", 100) or 100)
-        from ..frame.vec import T_CAT, Vec as _Vec
+        from ..frame.vec import T_CAT, T_STR, T_TIME, Vec as _Vec
 
-        n_cat = int(cols * cat_frac)
-        n_int = int(cols * int_frac)
-        n_bin = int(cols * bin_frac)
-        n_real = max(cols - n_cat - n_int - n_bin, 0)
+        str_frac = float(p.get("string_fraction", 0.0) or 0)
+        time_frac = float(p.get("time_fraction", 0.0) or 0)
+        real_frac = (float(p["real_fraction"])
+                     if p.get("real_fraction") not in (None, "") else None)
+        if (cat_frac + int_frac + bin_frac + str_frac + time_frac
+                + (real_frac or 0.0)) > 1.0 + 1e-9:
+            return _err(400, "column-type fractions must not exceed 1")
+        # +0.1 before the floor absorbs 0.2999999997-style client rounding
+        # (`OriginalCreateFrameRecipe.buildRecipe`'s comment)
+        n_cat = int(cols * cat_frac + 0.1)
+        n_int = int(cols * int_frac + 0.1)
+        n_bin = int(cols * bin_frac + 0.1)
+        n_str = int(cols * str_frac + 0.1)
+        n_time = int(cols * time_frac + 0.1)
+        if real_frac is not None:
+            n_real = int(cols * real_frac + 0.1)
+            # explicit fractions must account for every column
+            total = n_cat + n_int + n_bin + n_str + n_time + n_real
+            if total != cols:
+                n_real += cols - total  # rounding slack goes to reals
+        else:
+            n_real = max(cols - n_cat - n_int - n_bin - n_str - n_time, 0)
         fr2 = Frame([], [])
         ci = 0
+        _alpha = np.array(list("abcdefghijklmnopqrstuvwxyz"))
+        for _ in range(n_str):
+            lens = rng.integers(4, 9, rows)
+            words = np.array(
+                ["".join(rng.choice(_alpha, size=ln)) for ln in lens],
+                dtype=object)
+            words[rng.random(rows) < miss_frac] = None
+            fr2.add(f"C{ci + 1}", _Vec(None, rows, type=T_STR,
+                                       host_data=words)); ci += 1
+        for _ in range(n_time):
+            t = rng.integers(1_400_000_000_000, 1_700_000_000_000,
+                             rows).astype(np.float64)
+            t[rng.random(rows) < miss_frac] = np.nan
+            fr2.add(f"C{ci + 1}", _Vec.from_numpy(t, type=T_TIME)); ci += 1
         for _ in range(n_real):
             x = rng.uniform(-real_range, real_range, rows).astype(np.float32)
             x[rng.random(rows) < miss_frac] = np.nan

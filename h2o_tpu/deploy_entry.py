@@ -25,12 +25,29 @@ import time
 
 
 def main() -> None:
+    assisted = os.environ.get("H2O_ASSISTED_CLUSTERING", "").lower() in (
+        "1", "true") or os.environ.get(
+        "H2O_TPU_ASSISTED_CLUSTERING", "").lower() in ("1", "true")
+    if assisted:
+        # the reference's H2O_ASSISTED_CLUSTERING flag: stand up the
+        # port-8080 sidecar API and BLOCK until the operator's flatfile has
+        # formed the cloud — jax.distributed.initialize must run before any
+        # backend is touched, so nothing below may proceed first
+        from .parallel.assisted import AssistedClusteringApi
+        from .utils.log import info
+
+        api = AssistedClusteringApi().start()
+        info(f"assisted clustering API on :{api.port} — waiting for "
+             "POST /clustering/flatfile")
+        api.wait_until_clustered()
+        info("assisted clustering: cloud formed")
     driver = os.environ.get("H2O_TPU_DRIVER")
     if driver:
         from .parallel.cluster import init_cluster
         from .utils.log import info
 
-        init_cluster()
+        if not assisted:  # assisted mode already initialized the cloud
+            init_cluster()
         import jax
 
         info(f"cloud up: process {jax.process_index()}/{jax.process_count()}, "

@@ -50,11 +50,6 @@ def localize(path: str) -> str:
     scheme = path.split("://", 1)[0].lower()
     if scheme in _SCHEMES:
         return _SCHEMES[scheme](path)
-    if scheme == "drive":
-        raise NotImplementedError(
-            "persist backend 'drive://' needs its runtime (not in this "
-            "image); register one with h2o_tpu.io.persist.register_scheme("
-            "'drive', fetch_fn) — the Persist SPI hook")
     raise ValueError(f"unknown URI scheme in {path!r}")
 
 
@@ -82,7 +77,9 @@ def store(uri: str, local_path: str) -> str:
 
 
 from . import cloud as _cloud  # noqa: E402  (registers s3/gs handlers)
+from . import drive as _drive  # noqa: E402  (drive:// via delegate client)
 from . import hdfs as _hdfs  # noqa: E402  (registers hdfs via WebHDFS)
 
 _cloud.register_all()
 _hdfs.register_all()
+_drive.register_all()

@@ -36,6 +36,10 @@ class DeepLearningParameters(Parameters):
     """Mirrors `hex/schemas/DeepLearningV3` (subset actually used by h2o-py)."""
 
     hidden: list = field(default_factory=lambda: [200, 200])
+    #: publish per-layer weight/bias frames in the DKV
+    #: (`DeepLearningParameters._export_weights_and_biases`; h2o-py
+    #: `model.weights(i)` / `model.biases(i)` read them back)
+    export_weights_and_biases: bool = False
     activation: str = "Rectifier"  # Tanh|TanhWithDropout|Rectifier|RectifierWithDropout|Maxout|MaxoutWithDropout
     epochs: float = 10.0
     mini_batch_size: int = 1  # reference default; we lift to >= 32 for the MXU
@@ -296,6 +300,24 @@ class DeepLearning(ModelBuilder):
         if not p.autoencoder:
             output.response_domain = list(resp_domain) if resp_domain else None
         model = DeepLearningModel(p, output, net, dinfo, loss_kind)
+        if p.export_weights_and_biases:
+            # publish per-layer weight/bias frames under DKV keys, the
+            # reference's layout: weight frames are (units_out, units_in)
+            from ..backend.kvstore import STORE, make_key
+
+            wrefs, brefs = [], []
+            for li, layer in enumerate(net):
+                Wt = np.asarray(layer["W"]).T
+                bv = np.asarray(layer["b"]).reshape(-1)
+                wk = make_key(f"weights_{li}")
+                Frame.from_dict({f"C{j + 1}": Wt[:, j]
+                                 for j in range(Wt.shape[1])}, key=wk)
+                bk = make_key(f"biases_{li}")
+                Frame.from_dict({"C1": bv}, key=bk)
+                wrefs.append(wk)
+                brefs.append(bk)
+            output.weights_keys = wrefs
+            output.biases_keys = brefs
         if p.autoencoder:
             out = _forward(net, X, p.activation, key, 0.0, None, train=False)
             mse = float(jnp.sum(w * jnp.mean((out - X) ** 2, axis=1))

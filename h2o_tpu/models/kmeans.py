@@ -158,6 +158,9 @@ class KMeans(ModelBuilder):
         output.training_metrics = ClusteringMetrics(
             totss, float(stats["tot_withinss"]), stats["withinss"], stats["counts"])
         output.scoring_history = history
+        #: Lloyd iterations actually run (`ModelSummary number_of_iterations`
+        #: — h2o-py `num_iterations()` reads this)
+        output.num_iterations = len(history)
 
         # de-standardize centers back to the input scale for reporting
         centers_np = np.asarray(centers)

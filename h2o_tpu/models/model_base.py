@@ -54,6 +54,7 @@ class Parameters:
     fold_assignment: str = "AUTO"  # AUTO|Random|Modulo|Stratified
     keep_cross_validation_models: bool = True
     keep_cross_validation_predictions: bool = False
+    keep_cross_validation_fold_assignment: bool = False
     seed: int = -1
     max_runtime_secs: float = 0.0
     distribution: str = "AUTO"
@@ -462,17 +463,43 @@ class ModelBuilder:
                     holdout_preds = np.full((fr.nrow, pf.ncol), np.nan,
                                             dtype=np.float32)
                     holdout_preds_names = pf.names
+                    # the reference also keeps the N per-fold prediction
+                    # frames (full-length, zero outside the fold) behind
+                    # keep_cross_validation_predictions
+                    fold_pred_frames = []
                 holdout_preds[va_idx] = cols
+                full = np.zeros((fr.nrow, pf.ncol), dtype=np.float32)
+                full[va_idx] = cols
+                fold_pred_frames.append(Frame(
+                    list(pf.names),
+                    [Vec.from_numpy(full[:, j])
+                     for j in range(pf.ncol)]))
             cv_models.append(m)
         main = self.build_impl(job)
         main.output.cross_validation_metrics = _mean_metrics(holdout_metrics)
         if p.keep_cross_validation_models:
             main.output.cv_models = cv_models
+        from ..backend.kvstore import STORE, make_key
+
         if holdout_preds is not None:
-            main.output.cv_holdout_predictions = Frame(
-                list(holdout_preds_names),
-                [Vec.from_numpy(holdout_preds[:, j])
-                 for j in range(holdout_preds.shape[1])])
+            hp = Frame(list(holdout_preds_names),
+                       [Vec.from_numpy(holdout_preds[:, j])
+                        for j in range(holdout_preds.shape[1])],
+                       key=make_key("cv_holdout_prediction"))
+            STORE.put_keyed(hp)  # fetchable over the wire by key
+            main.output.cv_holdout_predictions = hp
+            for i, fp in enumerate(fold_pred_frames):
+                fp.key = make_key(f"cv_{i + 1}_prediction")
+                STORE.put_keyed(fp)
+            main.output.cv_fold_predictions = fold_pred_frames
+        if p.keep_cross_validation_fold_assignment:
+            # `ModelBase.cross_validation_fold_assignment` — the per-row
+            # fold index as a one-column frame
+            fa = Frame(["fold_assignment"],
+                       [Vec.from_numpy(folds.astype(np.float32))],
+                       key=make_key("cv_fold_assignment"))
+            STORE.put_keyed(fa)
+            main.output.cv_fold_assignment = fa
         return main
 
     def _fold_assignment(self, fr: Frame) -> np.ndarray:
@@ -508,4 +535,8 @@ def _mean_metrics(ms: list):
         vals = [v for v in vals if v is not None and not np.isnan(v)]
         if vals and hasattr(out, fname):
             setattr(out, fname, float(np.mean(vals)))
+    # combined CV metrics must not publish per-cluster stats — the
+    # reference's ModelMetricsClustering for pooled folds has no
+    # centroid_stats (pyunit_kmeans_cv pins this as null on the wire)
+    out._cv_combined = True
     return out

@@ -1,0 +1,182 @@
+"""Assisted-clustering REST API — the `h2o-clustering` module's analog
+(`water/clustering/api/AssistedClusteringRestApi.java` +
+`AssistedClusteringEndpoint.java` + `H2OClusterStatusEndpoint.java`).
+
+In the reference, a Kubernetes operator POSTs a flatfile of node IPs to
+every pod's port-8080 sidecar API; the pod then forms the cloud from that
+list instead of multicast discovery. Here the flatfile feeds the JAX
+distributed runtime: the FIRST line is the coordinator, the line count is
+``num_processes``, and the consumer (injectable, like the reference's
+``Consumer<String>``) calls `parallel.cluster.init_cluster` with them.
+
+Endpoints (paths and codes mirror the reference exactly):
+
+- ``POST /clustering/flatfile`` — one IPv4/IPv6[:port] per line. Accepted
+  once; later calls answer 400 "Flatfile already provided.". Invalid lines
+  answer 400 with the reference's parse-error message.
+- ``GET  /cluster/status`` — 204 until the cloud spans every flatfile node,
+  then ``{"healthy_nodes": [...], "unhealthy_nodes": [...]}``.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PARSE_ERR = ("Unable to parse IP addresses in body. Only one IPv4/IPv6 "
+              "address per line is accepted.")
+
+
+def _valid_node(line: str) -> bool:
+    host, sep, port = line.rpartition(":")
+    if sep and host and not host.count(":"):  # IPv4:port
+        if not port.isdigit():
+            return False
+        line = host
+    try:
+        ipaddress.ip_address(line)
+        return True
+    except ValueError:
+        return False
+
+
+def default_port() -> int:
+    # the reference reads H2O_ASSISTED_CLUSTERING_API_PORT (default 8080)
+    for var in ("H2O_TPU_ASSISTED_CLUSTERING_API_PORT",
+                "H2O_ASSISTED_CLUSTERING_API_PORT"):
+        v = os.environ.get(var)
+        if v:
+            if not v.isdigit() or not (0 < int(v) < 65536):
+                raise ValueError("Unusable port for Assisted clustering "
+                                 f"REST API to bind to: '{v}'")
+            return int(v)
+    return 8080
+
+
+class AssistedClusteringApi:
+    """Sidecar HTTP API; ``flat_file_consumer(flatfile_text)`` runs once in
+    a worker thread after a valid flatfile lands (default consumer joins
+    the jax.distributed cloud from it)."""
+
+    def __init__(self, port: int | None = None, flat_file_consumer=None,
+                 clustered_check=None):
+        self.port = default_port() if port is None else port
+        self.flat_file_consumer = flat_file_consumer or self._join_cloud
+        self._clustered_check = clustered_check
+        self.flatfile: list[str] | None = None
+        self._lock = threading.Lock()
+        self.httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        #: set once the consumer has RUN (not merely been scheduled) —
+        #: deploy_entry blocks on this before touching any JAX backend,
+        #: because jax.distributed.initialize must run first
+        self.consumed = threading.Event()
+
+    def wait_until_clustered(self, timeout: float | None = None) -> bool:
+        return self.consumed.wait(timeout)
+
+    # -- default consumer ----------------------------------------------------
+    def _join_cloud(self, flatfile_text: str) -> None:
+        from ..utils.log import info
+        from .cluster import init_cluster
+
+        nodes = [ln.strip() for ln in flatfile_text.splitlines()
+                 if ln.strip()]
+        first = nodes[0]
+        try:
+            # a line that parses whole as an IP carries NO port — true for
+            # bare IPv6 too, where ':' in the string is not a port separator
+            ipaddress.ip_address(first)
+            coordinator = (f"[{first}]:1234" if ":" in first
+                           else f"{first}:1234")
+        except ValueError:
+            coordinator = first  # host:port form, pass through
+        pid = int(os.environ.get("H2O_TPU_PROCESS_ID", 0))
+        info(f"assisted clustering: joining cloud of {len(nodes)} via "
+             f"{coordinator} as process {pid}")
+        init_cluster(coordinator_address=coordinator,
+                     num_processes=len(nodes), process_id=pid)
+
+    def _clustered(self) -> bool:
+        if self.flatfile is None:
+            return False
+        if self._clustered_check is not None:
+            return bool(self._clustered_check(self.flatfile))
+        import jax
+
+        return jax.process_count() == len(self.flatfile)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "AssistedClusteringApi":
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                from ..utils.log import debug
+
+                debug(f"assisted-api {fmt % args}")
+
+            def _answer(self, code: int, body: str = "",
+                        ctype: str = "text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                if data or code != 204:
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/clustering/flatfile":
+                    return self._answer(404)
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n).decode().strip()
+                nodes = [ln.strip() for ln in body.splitlines()
+                         if ln.strip()]
+                if not nodes or not all(_valid_node(x) for x in nodes):
+                    return self._answer(400, _PARSE_ERR)
+                with api._lock:
+                    if api.flatfile is not None:
+                        return self._answer(400, "Flatfile already "
+                                                 "provided.")
+                    api.flatfile = nodes
+                # do not block the response on cloud formation
+                def consume():
+                    try:
+                        api.flat_file_consumer(body)
+                    finally:
+                        api.consumed.set()
+
+                threading.Thread(target=consume, daemon=True).start()
+                return self._answer(200)
+
+            def do_GET(self):
+                if self.path.rstrip("/") != "/cluster/status":
+                    return self._answer(404)
+                if not api._clustered():
+                    return self._answer(204)
+                import json
+
+                return self._answer(200, json.dumps({
+                    "healthy_nodes": list(api.flatfile or []),
+                    "unhealthy_nodes": []}), "application/json")
+
+            def do_HEAD(self):  # k8s liveness probes often HEAD
+                self._answer(200 if api._clustered() else 204)
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True,
+                                        name="assisted-clustering-api")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None

@@ -610,6 +610,24 @@ def _group_by(R, fr, by, *aggspec):
     return group_by(fr, by_names, aggs)
 
 
+def _w2v_to_frame(m) -> Frame:
+    """`water/rapids/ast/prims/models/AstWord2VecToFrame` — dump a word2vec
+    model's learned embeddings as a frame of [Word, V1..VD]."""
+    import numpy as np
+
+    from ..frame.vec import T_STR, Vec
+
+    words = sorted(m.vocab, key=m.vocab.get)
+    W = np.asarray(m.vectors)
+    vecs = [Vec(None, len(words), type=T_STR,
+                host_data=np.array(words, dtype=object))]
+    names = ["Word"] + [f"V{j + 1}" for j in range(W.shape[1])]
+    for j in range(W.shape[1]):
+        vecs.append(Vec.from_numpy(W[[m.vocab[w] for w in words], j]
+                                   .astype(np.float32)))
+    return Frame(names, vecs)
+
+
 def _resolve_model(obj):
     m = STORE.get(obj) if isinstance(obj, str) else obj
     if m is None:
@@ -891,6 +909,8 @@ _PRIMS = {
         if _as_frame(fr).vec(nm).nacnt() < _as_frame(fr).nrow * float(frac)],
     "model.reset.threshold": _reset_threshold_prim,
     "segment_models_as_frame": lambda R, key: _resolve_model(key).as_frame(),
+    # `AstWord2VecToFrame` — embeddings as a [Word, V1..VD] frame
+    "word2vec.to.frame": lambda R, key: _w2v_to_frame(_resolve_model(key)),
     "PermutationVarImp": _permutation_varimp_prim,
     "makeLeaderboard": _make_leaderboard_prim,
 }

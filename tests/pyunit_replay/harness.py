@@ -97,6 +97,43 @@ def np_comparison_check(h2o_data, np_data, num_elements):
             f"failed comparison check! h2o: {h2o_val} numpy: {np_val}"
 
 
+def compare_frames_local(f1, f2, prob=0.5, tol=1e-6, returnResult=False):
+    """Mirror of utilsPY.compare_frames_local:3633 — column-by-column value
+    agreement within tol, NA positions matching; `prob` subsampling is
+    ignored (full compare is strictly stronger)."""
+    import numpy as np
+
+    if f1.nrow != f2.nrow or f1.ncol != f2.ncol:
+        if returnResult:
+            return False
+        raise AssertionError(
+            f"Frame 1 {f1.nrow}x{f1.ncol} vs Frame 2 {f2.nrow}x{f2.ncol}")
+    d1 = f1.as_data_frame(use_pandas=True)
+    d2 = f2.as_data_frame(use_pandas=True)
+    for c in range(f1.ncol):
+        a = d1.iloc[:, c].to_numpy()
+        b = d2.iloc[:, c].to_numpy()
+        if a.dtype.kind in "fiu" and b.dtype.kind in "fiu":
+            a = a.astype(float)
+            b = b.astype(float)
+            na_ok = np.isnan(a) == np.isnan(b)
+            if not na_ok.all() and returnResult:
+                return False
+            assert na_ok.all(), f"col {c}: NA mismatch"
+            ok = np.isnan(a) | (np.abs(a - b) <= tol * np.maximum(
+                1.0, np.maximum(np.abs(a), np.abs(b))))
+            if not ok.all() and returnResult:
+                return False
+            assert ok.all(), f"col {c}: values differ beyond {tol}"
+        else:
+            same = [x == y or (x is None and y is None)
+                    for x, y in zip(a.tolist(), b.tolist())]
+            if not all(same) and returnResult:
+                return False
+            assert all(same), f"col {c}: values differ"
+    return True
+
+
 def assertEqualCoeffDicts(coef1Dict, coef2Dict, tol=1e-6):
     assert len(coef1Dict) == len(coef2Dict), "coefficient dict lengths differ"
     for key in coef1Dict:
@@ -137,6 +174,23 @@ def install_aliases() -> None:
                H2ORandomForestEstimator=_api.H2ORandomForestEstimator)
     _submodule("h2o.estimators.glm",
                H2OGeneralizedLinearEstimator=_api.H2OGeneralizedLinearEstimator)
+    _submodule("h2o.estimators.kmeans",
+               H2OKMeansEstimator=_api.H2OKMeansEstimator)
+    _submodule("h2o.estimators.naive_bayes",
+               H2ONaiveBayesEstimator=_api.H2ONaiveBayesEstimator)
+    _submodule("h2o.estimators.deeplearning",
+               H2ODeepLearningEstimator=_api.H2ODeepLearningEstimator,
+               H2OAutoEncoderEstimator=_api.H2ODeepLearningEstimator)
+    _submodule("h2o.estimators.pca",
+               H2OPrincipalComponentAnalysisEstimator=(
+                   _api.H2OPrincipalComponentAnalysisEstimator))
+    _submodule("h2o.estimators.glrm",
+               H2OGeneralizedLowRankEstimator=(
+                   _api.H2OGeneralizedLowRankEstimator))
+    _submodule("h2o.estimators.isolation_forest",
+               H2OIsolationForestEstimator=_api.H2OIsolationForestEstimator)
+    _submodule("h2o.estimators.word2vec",
+               H2OWord2vecEstimator=_api.H2OWord2vecEstimator)
     _api.exceptions = _submodule(
         "h2o.exceptions",
         H2OValueError=ValueError,
@@ -144,10 +198,12 @@ def install_aliases() -> None:
         H2OResponseError=_api.H2OConnectionError,
         H2OConnectionError=_api.H2OConnectionError)
     _submodule("h2o.grid", H2OGridSearch=_api.H2OGridSearch)
+    _submodule("h2o.grid.grid_search", H2OGridSearch=_api.H2OGridSearch)
     shim = _submodule("tests.pyunit_utils",
                       locate=locate, standalone_test=standalone_test,
                       check_dims_values=check_dims_values,
                       np_comparison_check=np_comparison_check,
+                      compare_frames_local=compare_frames_local,
                       assertEqualCoeffDicts=assertEqualCoeffDicts)
     _submodule("tests", pyunit_utils=shim)
 

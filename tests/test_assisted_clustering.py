@@ -1,0 +1,118 @@
+"""Assisted-clustering sidecar API (`h2o-clustering`:
+AssistedClusteringEndpoint + H2OClusterStatusEndpoint behaviors)."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from h2o_tpu.parallel.assisted import (AssistedClusteringApi, _valid_node,
+                                       default_port)
+
+
+def _req(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(method, path, body=body)
+    r = conn.getresponse()
+    data = r.read()
+    conn.close()
+    return r.status, data
+
+
+@pytest.fixture
+def api():
+    got = {}
+    done = threading.Event()
+
+    def consumer(text):
+        got["flatfile"] = text
+        done.set()
+
+    a = AssistedClusteringApi(
+        port=0, flat_file_consumer=consumer,
+        clustered_check=lambda nodes: done.is_set()).start()
+    a._test_done = done
+    a._test_got = got
+    yield a
+    a.stop()
+
+
+def test_flatfile_accepted_once(api):
+    # before the flatfile: no content on status (the 204 contract)
+    st, _ = _req(api.port, "GET", "/cluster/status")
+    assert st == 204
+    st, _ = _req(api.port, "POST", "/clustering/flatfile",
+                 "192.168.0.149:54321\n10.0.0.7:54321\n")
+    assert st == 200
+    assert api._test_done.wait(5)
+    assert "10.0.0.7:54321" in api._test_got["flatfile"]
+    # second submission refused (`flatFileReceived` latch)
+    st, body = _req(api.port, "POST", "/clustering/flatfile",
+                    "10.1.1.1\n")
+    assert st == 400 and b"already provided" in body
+    # clustered now: healthy nodes listed
+    st, body = _req(api.port, "GET", "/cluster/status")
+    assert st == 200
+    out = json.loads(body)
+    assert out["healthy_nodes"] == ["192.168.0.149:54321",
+                                    "10.0.0.7:54321"]
+    assert out["unhealthy_nodes"] == []
+
+
+def test_flatfile_rejects_garbage(api):
+    st, body = _req(api.port, "POST", "/clustering/flatfile",
+                    "not-an-ip\n")
+    assert st == 400 and b"Unable to parse IP addresses" in body
+    st, body = _req(api.port, "POST", "/clustering/flatfile", "")
+    assert st == 400
+    # a rejected body does not latch the endpoint
+    st, _ = _req(api.port, "POST", "/clustering/flatfile", "127.0.0.1\n")
+    assert st == 200
+
+
+def test_wrong_paths_and_methods(api):
+    st, _ = _req(api.port, "POST", "/nope")
+    assert st == 404
+    st, _ = _req(api.port, "GET", "/clustering/flatfile")
+    assert st == 404
+
+
+def test_valid_node_forms():
+    assert _valid_node("192.168.0.1")
+    assert _valid_node("192.168.0.1:54321")
+    assert _valid_node("::1")
+    assert _valid_node("fe80::1")
+    assert not _valid_node("example.com")
+    assert not _valid_node("192.168.0.1:notaport")
+    assert not _valid_node("999.1.1.1")
+
+
+def test_default_port_env(monkeypatch):
+    monkeypatch.setenv("H2O_ASSISTED_CLUSTERING_API_PORT", "9191")
+    assert default_port() == 9191
+    monkeypatch.setenv("H2O_ASSISTED_CLUSTERING_API_PORT", "bogus")
+    with pytest.raises(ValueError, match="Unusable port"):
+        default_port()
+    monkeypatch.delenv("H2O_ASSISTED_CLUSTERING_API_PORT")
+    assert default_port() == 8080
+
+
+def test_default_clustered_check_uses_process_count():
+    """Without an injected check, clustered == (process_count == #nodes):
+    a single-process cloud with a 1-line flatfile reports clustered."""
+    a = AssistedClusteringApi(port=0,
+                              flat_file_consumer=lambda text: None).start()
+    try:
+        st, _ = _req(a.port, "POST", "/clustering/flatfile", "127.0.0.1\n")
+        assert st == 200
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            st, _ = _req(a.port, "GET", "/cluster/status")
+            if st == 200:
+                break
+            time.sleep(0.1)
+        assert st == 200
+    finally:
+        a.stop()

@@ -85,12 +85,57 @@ class TestPersistSPI:
         p.write_text("a\n1\n")
         assert localize(f"file://{p}") == str(p)
         assert localize(str(p)) == str(p)
-        # s3/gs/hdfs are real backends now (io/cloud.py, io/hdfs.py);
-        # drive remains gated
+        # s3/gs/hdfs are real backends (io/cloud.py, io/hdfs.py); drive
+        # routes through the delegate client (io/drive.py) and gates only
+        # while no delegate is installed — the reference's own architecture
+        # (its client lives in the external h2o_drive package)
         with pytest.raises(NotImplementedError, match="drive"):
             localize("drive://nn/key.csv")
         with pytest.raises(ValueError, match="unknown URI scheme"):
             localize("bogus://x")
+
+    def test_drive_delegate_backend(self, tmp_path):
+        """`h2o-persist-drive` delegate protocol: download_file path,
+        presigned-url fast path, typeahead — all through drive:// URIs."""
+        from h2o_tpu.io import drive
+        from h2o_tpu.io.persist import localize
+
+        class Delegate:
+            def __init__(self):
+                self.calls = []
+
+            def download_file(self, path, file):
+                self.calls.append(("download", path))
+                with open(file, "w") as fh:
+                    fh.write("a,b\n1,2\n")
+
+            def calc_typeahead_matches(self, partial, limit):
+                return [f"{partial}/one.csv", f"{partial}/two.csv"][:limit]
+
+        d = Delegate()
+        drive.set_delegate(d)
+        try:
+            local = localize("drive://home/data.csv")
+            assert open(local).read() == "a,b\n1,2\n"
+            assert d.calls == [("download", "home/data.csv")]
+            assert drive.DriveClient(d).typeahead("home", 1) == \
+                ["home/one.csv"]
+
+            class Presigned(Delegate):
+                def supports_presigned_urls(self):
+                    return True
+
+                def generate_presigned_url(self, path):
+                    src = tmp_path / "presigned.csv"
+                    src.write_text("x\n9\n")
+                    return f"file://{src}"
+
+            # urlretrieve handles file:// — the presigned fast path
+            drive.set_delegate(Presigned())
+            local2 = localize("drive://home/p.csv")
+            assert open(local2).read() == "x\n9\n"
+        finally:
+            drive.set_delegate(None)
 
     def test_custom_scheme_registration(self, tmp_path):
         from h2o_tpu.io import persist
