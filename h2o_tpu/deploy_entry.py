@@ -58,7 +58,12 @@ def main() -> None:
 
     # server mode: single host, local chips only
     from .api.server import H2OServer
+    from .utils import compile_cache
     from .utils.log import info
+
+    cache = compile_cache.enable()
+    if cache:
+        info(f"persistent XLA compile cache at {cache}")
 
     port = int(os.environ.get("H2O_TPU_REST_PORT", 54321))
     server = H2OServer(port=port).start()

@@ -310,7 +310,12 @@ class GBM(ModelBuilder):
                                 quantile_alpha=p.quantile_alpha,
                                 huber_alpha=p.huber_alpha)
 
-    def build_impl(self, job: Job) -> GBMModel:
+    def _setup_build(self):
+        """Shared pre-training setup: design matrix, weights/mask, bin
+        edges, constraints, init prediction, grad fn, tree config, initial
+        margin — used by the standard boosting loop and the DART driver."""
+        import types as _types
+
         p = self.params
         fr = p.training_frame
         names = self.feature_names()
@@ -394,6 +399,25 @@ class GBM(ModelBuilder):
         else:
             y_k = y
             f = jnp.full_like(y, f0, dtype=jnp.float32)
+        return _types.SimpleNamespace(
+            p=p, fr=fr, names=names, category=category,
+            resp_domain=resp_domain, dist=dist, K=K, X=X, is_cat=is_cat,
+            w=w, y=y, ymask=ymask, edges_np=edges_np, mesh=mesh,
+            edges=edges, mono=mono, imat=imat, edge_ok=edge_ok, Xb=Xb,
+            f0=f0, grad_fn=grad_fn, cfg=cfg, grad_key=grad_key, y_k=y_k,
+            f=f)
+
+    def build_impl(self, job: Job) -> GBMModel:
+        s = self._setup_build()
+        p, fr, names = s.p, s.fr, s.names
+        category, resp_domain, dist, K = (s.category, s.resp_domain,
+                                          s.dist, s.K)
+        X, is_cat, w, y, ymask = s.X, s.is_cat, s.w, s.y, s.ymask
+        edges, mono, imat, edge_ok, Xb = (s.edges, s.mono, s.imat,
+                                          s.edge_ok, s.Xb)
+        mesh, f0, grad_fn, cfg, grad_key = (s.mesh, s.f0, s.grad_fn,
+                                            s.cfg, s.grad_key)
+        y_k, f = s.y_k, s.f
 
         # checkpoint restart (`hex/tree/SharedTree.java:146,243,470`): resume
         # the boosting sequence from a prior model's carried link predictions.

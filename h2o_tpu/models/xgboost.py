@@ -15,8 +15,9 @@ Supported param aliases (mirroring `XGBoostV3.XGBoostParametersV3`):
   ntrees/n_estimators, eta/learn_rate, max_depth, min_child_weight/min_rows,
   subsample/sample_rate, colsample_bytree/col_sample_rate_per_tree,
   colsample_bylevel/col_sample_rate, reg_lambda, reg_alpha, max_bins,
-  booster (gbtree|dart — dart falls back to gbtree), tree_method (ignored:
-  always hist), backend (ignored: always TPU).
+  booster (gbtree | dart — a real DART driver with rate_drop/skip_drop/
+  one_drop/normalize_type, see `XGBoost._build_dart`), tree_method
+  (ignored: always hist), backend (ignored: always TPU).
 """
 
 from __future__ import annotations
@@ -42,6 +43,12 @@ class XGBoostParameters(GBMParameters):
     min_rows: float = 1.0     # xgboost default min_child_weight
     reg_lambda: float = 1.0   # xgboost default lambda
     reg_alpha: float = 0.0
+
+    # DART booster knobs (`XGBoostParameters._rate_drop` et al.)
+    rate_drop: float = 0.0
+    skip_drop: float = 0.0
+    one_drop: bool = False
+    normalize_type: str = "tree"   # tree|forest
 
     # xgboost-native spellings; sentinel = "not set"
     n_estimators: int = 0          # alias of ntrees
@@ -86,3 +93,161 @@ class XGBoost(GBM):
         import dataclasses
         cfg = super()._tree_config(K, nbins=nbins)
         return dataclasses.replace(cfg, reg_alpha=self.params.reg_alpha)
+
+    def build_impl(self, job):
+        if (self.params.booster or "gbtree").lower() == "dart":
+            return self._build_dart(job)
+        return super().build_impl(job)
+
+    def _build_dart(self, job):
+        """DART booster (Rashmi & Gilad-Bachrach 2015; xgboost `booster=
+        dart`): each round drops a random subset of the existing trees,
+        fits the new tree against the DROPPED ensemble's residuals, then
+        renormalizes so the expected prediction is unchanged —
+        normalize_type="tree": new-tree weight lr/(k+lr), dropped trees
+        scaled k/(k+lr); "forest": lr/(1+lr) and 1/(1+lr). skip_drop
+        short-circuits a round to plain boosting; one_drop forces at least
+        one dropped tree. Predictions are linear in leaf values, so the
+        final forest stores each tree's leaves pre-scaled by its weight —
+        scoring, MOJO export and SHAP all work unchanged.
+
+        The engine builds each round's tree at rate 1.0 with the carried
+        margin = f0 + Σ_{i∉D} w_i·tree_i; the new tree's raw contribution
+        falls out of the train step (f_out − f_in), so each round costs
+        |D| single-tree evaluations plus one tree build."""
+        import dataclasses
+        import time as _t
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..backend.jobs import Job  # noqa: F401  (signature parity)
+        from .gbm import GBMModel, _assemble_forest, _metrics_raw
+        from .model_base import ModelOutput, make_metrics
+        from .tree.engine import make_train_fn, predict_forest
+
+        s = self._setup_build()
+        p = s.p
+        if s.K > 1:
+            raise NotImplementedError(
+                "booster='dart' supports regression/binomial here; "
+                "multinomial dart is not implemented")
+        for unsupported in ("checkpoint", "export_checkpoints_dir"):
+            if getattr(p, unsupported, None):
+                raise NotImplementedError(
+                    f"booster='dart' does not support {unsupported} "
+                    "(the dropout trajectory cannot resume from a plain "
+                    "boosted forest)")
+        rng = np.random.default_rng(
+            p.seed if p.seed not in (-1, None) else 1234)
+        # trees build UNSCALED (engine's effective rate = cfg.learn_rate x
+        # per-tree rate; DART owns the scaling via the weight vector), and
+        # unclipped: max_abs_leafnode_pred caps the FINAL stored leaf, so
+        # the clip applies at weight-bake time below (GBM.java:716 parity)
+        cfg1 = dataclasses.replace(s.cfg, ntrees=1, learn_rate=1.0,
+                                   max_abs_leafnode_pred=float("inf"))
+        train_fn = make_train_fn(cfg1, s.grad_fn, s.mesh,
+                                 cache_key=s.grad_key)
+        keys = jax.random.split(
+            jax.random.PRNGKey(p.seed if p.seed not in (-1, None)
+                               else 1234), p.ntrees)
+        one_rate = jnp.ones((1,), dtype=jnp.float32)
+
+        lr = float(p.learn_rate)
+        parts, weights = [], []
+        S = jnp.zeros_like(s.f)        # sum of w_i * raw_i over built trees
+        history = []
+        stop_series: list = []
+        interval = min(p.score_tree_interval or p.ntrees, p.ntrees)
+
+        def dropped_sum(idxs):
+            """sum_{i in D} w_i * raw_i in ONE forest evaluation: stack the
+            dropped trees with their weights pre-multiplied into the leaves
+            — O(1) extra memory, no per-tree prediction cache."""
+            feat = jnp.concatenate([parts[i][0] for i in idxs], axis=0)
+            thr = jnp.concatenate([parts[i][1] for i in idxs], axis=0)
+            nanL = jnp.concatenate([parts[i][2] for i in idxs], axis=0)
+            val = jnp.concatenate(
+                [jnp.asarray(parts[i][3]) * jnp.float32(weights[i])
+                 for i in idxs], axis=0)
+            return predict_forest(s.X, feat, thr, nanL, val,
+                                  s.cfg.max_depth)
+
+        for t in range(p.ntrees):
+            job.check_cancelled()
+            if history and job.time_exceeded():  # keep the partial forest
+                break
+            dropped: list[int] = []
+            if t > 0 and rng.random() >= p.skip_drop:
+                dropped = [i for i in range(t)
+                           if rng.random() < p.rate_drop]
+                if not dropped and p.one_drop:
+                    dropped = [int(rng.integers(t))]
+            if dropped:
+                drop_raw = dropped_sum(dropped)
+                margin = s.f0 + S - drop_raw
+            else:
+                drop_raw = None
+                margin = s.f0 + S
+            f_out, _os, _oc, trees = train_fn(
+                s.Xb, s.y_k, s.w, margin.astype(jnp.float32), s.edges,
+                s.edge_ok, keys[t:t + 1], one_rate, s.mono, s.imat)
+            raw_new = f_out - margin
+            k = len(dropped)
+            if k == 0:
+                w_new, scale_dropped = lr, 1.0
+            elif (p.normalize_type or "tree").lower() == "forest":
+                w_new, scale_dropped = lr / (1.0 + lr), 1.0 / (1.0 + lr)
+            else:
+                w_new = lr / (k + lr)
+                scale_dropped = k / (k + lr)
+            if dropped:
+                # S' = S + (scale-1) * sum w_i raw_i — the dropped sum is
+                # already in hand, no re-evaluation
+                S = S + (scale_dropped - 1.0) * drop_raw
+                for i in dropped:
+                    weights[i] *= scale_dropped
+            S = S + w_new * raw_new
+            parts.append(trees)
+            weights.append(w_new)
+            if (t + 1) % interval == 0 or t + 1 == p.ntrees:
+                m = make_metrics(
+                    s.category, jnp.where(s.ymask, s.y, jnp.nan),
+                    _metrics_raw(s.category, s.dist, s.f0 + S,
+                                 False, t + 1),
+                    None if p.weights_column is None else s.w)
+                history.append({"timestamp": _t.time(),
+                                "number_of_trees": t + 1,
+                                "training_metrics": m})
+                job.update(interval / p.ntrees)  # incremental, like gbtree
+                if self._should_stop(m, stop_series):
+                    break
+
+        # bake each tree's DART weight into its stored leaf values; the
+        # max_abs_leafnode_pred cap applies HERE, on the final stored leaf
+        # (the reference clips after the effective rate, GBM.java:716-719)
+        cap = float(getattr(p, "max_abs_leafnode_pred", float("inf"))
+                    or float("inf"))
+        scaled = []
+        for (feat, thr, nanL, val, gain), wgt in zip(parts, weights):
+            v = jnp.asarray(val) * jnp.float32(wgt)
+            if np.isfinite(cap):
+                v = jnp.clip(v, -cap, cap)
+            scaled.append((feat, thr, nanL, v, gain))
+        output = ModelOutput()
+        output.names = list(s.names)
+        output.domains = {n: s.fr.vec(n).domain for n in s.names}
+        output.response_domain = (list(s.resp_domain) if s.resp_domain
+                                  else None)
+        output.model_category = s.category
+        output.scoring_history = history
+        output.training_metrics = history[-1]["training_metrics"]
+        forest = _assemble_forest(scaled)
+        output.variable_importances = self._varimp(forest, s.names)
+        model = GBMModel(p, output, forest, s.f0, s.dist, s.cfg, s.is_cat)
+        if p.validation_frame is not None:
+            output.validation_metrics = model.model_performance(
+                p.validation_frame)
+        return model
+

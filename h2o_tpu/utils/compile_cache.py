@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache switch — shared by the server entry
+point and bench.py.
+
+On accelerator backends the cache is pure win (the standard TPU deployment
+practice): a fresh server/bench process replays its compiles from disk in
+seconds instead of paying the ~25-70 s cold-start the first full-length
+train otherwise costs. CPU stays opt-in because jax 0.9.0's CPU executable
+serializer segfaulted once mid-suite (tests/conftest.py history).
+``H2O_TPU_COMPILE_CACHE`` overrides the location; '0' disables."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(default_dir: str | None = None) -> str | None:
+    import jax
+
+    loc = os.environ.get("H2O_TPU_COMPILE_CACHE")
+    if loc == "0":
+        return None
+    if not loc:  # unset OR empty (a bare env entry must not makedirs(''))
+        if jax.default_backend() == "cpu":
+            return None
+        loc = default_dir or os.path.expanduser("~/.cache/h2o_tpu_xla")
+    os.makedirs(loc, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", loc)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return loc

@@ -92,3 +92,61 @@ def test_xgboost_surface_aliases_and_fit():
     m = XGBoost(p).train_model()
     r2 = m.output.training_metrics.r2
     assert r2 > 0.8, f"xgboost-surface underfit: r2={r2}"
+
+
+def test_xgboost_dart_booster():
+    """`booster='dart'` runs the real DART driver: dropout rounds change
+    the forest (vs gbtree with the same seed), leaf weights are baked in
+    (predictions = margin path), and the fit still learns the signal."""
+    from h2o_tpu.models.xgboost import XGBoost, XGBoostParameters
+
+    rng = np.random.default_rng(9)
+    n = 1500
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] - 0.7 * x[:, 1] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(4)} | {"y": y})
+
+    kw = dict(training_frame=fr, response_column="y", ntrees=25,
+              max_depth=3, eta=0.3, seed=7)
+    dart = XGBoost(XGBoostParameters(booster="dart", rate_drop=0.3,
+                                     **kw)).train_model()
+    plain = XGBoost(XGBoostParameters(booster="gbtree", **kw)).train_model()
+
+    r2 = dart.output.training_metrics.r2
+    assert r2 > 0.9, f"dart underfit: r2={r2}"
+    # dropout must actually alter the ensemble relative to plain boosting
+    dv = np.asarray(dart.forest["val"])
+    pv = np.asarray(plain.forest["val"])
+    assert dv.shape == pv.shape
+    assert not np.allclose(dv, pv)
+    # normalization: with drops, no tree keeps the full learn_rate-scaled
+    # leaf magnitude pattern of plain boosting beyond the first tree
+    pred = dart.predict(fr).vec(0).to_numpy()
+    ss_res = float(np.sum((y - pred) ** 2))
+    ss_tot = float(np.sum((y - y.mean()) ** 2))
+    # scoring path agrees with the training-metrics margin (weights baked)
+    assert abs((1 - ss_res / ss_tot) - r2) < 0.02
+
+    # one_drop guarantees dropout every round even at rate_drop=0
+    od = XGBoost(XGBoostParameters(booster="dart", rate_drop=0.0,
+                                   one_drop=True, **kw)).train_model()
+    assert not np.allclose(np.asarray(od.forest["val"]), pv)
+    # skip_drop=1.0 disables dropout entirely: identical to gbtree
+    sk = XGBoost(XGBoostParameters(booster="dart", rate_drop=0.5,
+                                   skip_drop=1.0, **kw)).train_model()
+    np.testing.assert_allclose(np.asarray(sk.forest["val"]),
+                               pv, rtol=1e-5, atol=1e-6)
+
+
+def test_xgboost_dart_multinomial_gate():
+    from h2o_tpu.models.xgboost import XGBoost, XGBoostParameters
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(300, 3)).astype(np.float32)
+    yc = rng.integers(0, 3, 300).astype(np.float32)
+    fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(3)})
+    fr.add("y", Vec.from_numpy(yc, type=T_CAT, domain=["a", "b", "c"]))
+    with pytest.raises(NotImplementedError, match="multinomial dart"):
+        XGBoost(XGBoostParameters(training_frame=fr, response_column="y",
+                                  booster="dart", ntrees=3)).train_model()
