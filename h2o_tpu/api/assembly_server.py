@@ -61,7 +61,7 @@ class Assembly(Keyed):
     def __init__(self, steps: list[AssemblyStep], key: str | None = None):
         super().__init__(key or make_key("assembly"))
         self.steps = steps
-        self.scaler_stats: dict[int, tuple[list, list]] = {}
+        self.scaler_stats: dict[int, tuple[list, list, list]] = {}
 
     # -- fit ------------------------------------------------------------------
     def fit(self, fr: Frame) -> Frame:
@@ -88,7 +88,7 @@ class Assembly(Keyed):
                             / (sds[-1] or 1.0)
                         vecs.append(Vec.from_numpy(x))
                         names.append(n)
-                    self.scaler_stats[si] = (means, sds)
+                    self.scaler_stats[si] = (list(names), means, sds)
                     cur = Frame(names, vecs)
                 elif step.cls in ("H2OColOp", "H2OBinaryOp"):
                     # bind a shallow COPY under the temp key — `cur` may
@@ -146,16 +146,14 @@ class Assembly(Keyed):
                             f"java.util.Arrays.asList({quoted}));"
                             f" // {step.name}")
             elif step.cls == "H2OScaler":
-                means, sds = self.scaler_stats.get(si, ([], []))
-                body.append(f"    double[] means_{si} = "
-                            "{" + ", ".join(f"{m!r}" for m in means) + "};")
-                body.append(f"    double[] sds_{si} = "
-                            "{" + ", ".join(f"{s!r}" for s in sds) + "};")
-                body.append(f"    int ci_{si} = 0;")
-                body.append(f"    for (String k : row.keySet()) "
-                            f"{{ row.put(k, ((Double) row.get(k) - "
-                            f"means_{si}[ci_{si}]) / sds_{si}[ci_{si}]); "
-                            f"ci_{si}++; }} // {step.name}")
+                # explicit per-column statements: HashMap keySet() iteration
+                # order is unspecified, so positional means_/sds_ indexing
+                # would scale columns with the wrong statistics
+                names, means, sds = self.scaler_stats.get(si, ([], [], []))
+                for cn, m, sd in zip(names, means, sds):
+                    body.append(f"    row.put(\"{cn}\", ((Double) "
+                                f"row.get(\"{cn}\") - {m!r}) / {sd!r});"
+                                f" // {step.name}")
             else:
                 col = step.old_col or "C1"
                 expr = f"(Double) row.get(\"{col}\")"

@@ -1394,6 +1394,22 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         is_leaf = ft < 0
         lefts[is_leaf] = -1
         rights[is_leaf] = -1
+        # categorical set-split nodes report their LEFT level set
+        # (`TreeHandler` fills levels from the split bitset)
+        levels = [None] * N
+        if (getattr(getattr(model, "cfg", None), "use_sets", False)
+                and "catd" in forest):
+            catd = np.asarray(forest["catd"])[sel]
+            iscat = np.asarray(model.is_cat)
+            ne = np.asarray(model.cat_nedges, dtype=np.int64)
+            for j in range(N):
+                f = int(ft[j])
+                if f < 0 or not iscat[f]:
+                    continue
+                dom = model.output.domains.get(names[f]) or []
+                lv = [d for li, d in enumerate(dom)
+                      if catd[j, min(li, int(ne[f]))] <= 0.5]
+                levels[j] = lv
         return 200, {
             "model_id": schemas.key_schema(str(model.key)),
             "tree_number": t,
@@ -1401,8 +1417,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             "left_children": lefts.tolist(),
             "right_children": rights.tolist(),
             "features": [None if f < 0 else names[int(f)] for f in ft],
-            "thresholds": [None if l else float(x)
-                           for l, x in zip(is_leaf, thr)],
+            "thresholds": [None if l or levels[i] is not None else float(x)
+                           for i, (l, x) in enumerate(zip(is_leaf, thr))],
+            "levels": levels,
             "predictions": [float(x) if l else None
                             for l, x in zip(is_leaf, val)],
             "nas": ["L" if nl else "R" for nl in nanl],

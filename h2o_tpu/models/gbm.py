@@ -78,19 +78,46 @@ class GBMParameters(Parameters):
 class GBMModel(Model):
     algo_name = "gbm"
 
-    def __init__(self, params, output, forest, f0, dist, cfg, is_cat, key=None):
-        self.forest = forest    # dict feat/thr/nanL/val: (T,[K,]N) device arrays
+    def __init__(self, params, output, forest, f0, dist, cfg, is_cat, key=None,
+                 cat_nedges=None):
+        self.forest = forest    # dict feat/thr/nanL/val[/catd]: (T,[K,]N[,B])
         self.f0 = f0            # scalar or (K,) initial link prediction
         self.dist = dist
         self.cfg = cfg
         self.is_cat = is_cat
+        # per-feature cut counts (categorical level->bin map: bin =
+        # min(level, n_edges)); only read when cfg.use_sets
+        self.cat_nedges = cat_nedges
         super().__init__(params, output, key=key)
+
+    def _set_args(self):
+        """(catd, iscat, nedges) for the routing helpers — Nones when this
+        model has no categorical set splits."""
+        if not getattr(self.cfg, "use_sets", False) \
+                or "catd" not in self.forest:
+            return None, None, None
+        return (self.forest["catd"], jnp.asarray(np.asarray(self.is_cat)),
+                jnp.asarray(np.asarray(self.cat_nedges, dtype=np.int32)))
+
+    def set_split_arrays_np(self):
+        """Host-side (catd, iscat, nedges, cards) for codegen/export paths
+        (MOJO writer, POJO) — all None when the model has no set splits.
+        ``cards`` is the per-feature domain cardinality (0 for numeric):
+        level -> bin is always ``min(level, nedges[f])``."""
+        if not getattr(self.cfg, "use_sets", False) \
+                or "catd" not in self.forest:
+            return None, None, None, None
+        cards = np.array([len(self.output.domains.get(n) or [])
+                          for n in self.output.names], dtype=np.int64)
+        return (np.asarray(self.forest["catd"]), np.asarray(self.is_cat),
+                np.asarray(self.cat_nedges, dtype=np.int64), cards)
 
     @property
     def ntrees(self) -> int:
         return int(self.forest["feat"].shape[0])
 
     calib = None   # (a, b) Platt coefficients when calibrate_model was set
+    cat_nedges = None  # class fallback for models persisted before round 4
 
     def score0(self, X: jax.Array) -> jax.Array:
         return _score_fn(self, X)
@@ -108,10 +135,29 @@ class GBMModel(Model):
             out.add("cal_p1", Vec.from_device(cal, fr.nrow))
         return out
 
+    #: row budget for one scoring pass when set-split tables are wide —
+    #: caps the (rows, nbins) bin one-hot the routing builds per depth step
+    _SET_SCORE_CELLS = 1 << 26
+
+    def _score_chunk_rows(self, X, catd):
+        """Rows per predict_forest call: unbounded without set splits;
+        bounded so rows x catd-width stays under the cell budget with them
+        (the training-side router blocks the same intermediate)."""
+        if catd is None:
+            return X.shape[0]
+        return max(8192, self._SET_SCORE_CELLS // max(catd.shape[-1], 1))
+
     def _raw_f(self, X):
-        s = predict_forest(X, self.forest["feat"], self.forest["thr"],
-                           self.forest["nanL"], self.forest["val"],
-                           self.cfg.max_depth)
+        catd, iscat, nedges = self._set_args()
+        fo = self.forest
+        step = self._score_chunk_rows(X, catd)
+        parts = []
+        for s0 in range(0, X.shape[0], step):
+            parts.append(predict_forest(
+                X[s0:s0 + step], fo["feat"], fo["thr"], fo["nanL"],
+                fo["val"], self.cfg.max_depth, catd=catd, iscat=iscat,
+                nedges=nedges))
+        s = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
         if self.cfg.drf_mode:
             n = self.ntrees
             return self.f0 + s / jnp.maximum(n, 1)
@@ -131,11 +177,12 @@ class GBMModel(Model):
 
         X = np.asarray(self.adapt_frame(fr))[:fr.nrow]
         scale = 1.0 / max(self.ntrees, 1) if self.cfg.drf_mode else 1.0
+        catd, iscat, nedges, _ = self.set_split_arrays_np()
         phi = tree_shap(
             X, np.asarray(self.forest["feat"]), np.asarray(self.forest["thr"]),
             np.asarray(self.forest["nanL"]), np.asarray(self.forest["val"]),
             np.asarray(self.forest["cover"]), bias0=float(self.f0),
-            scale=scale)
+            scale=scale, catd=catd, iscat=iscat, nedges=nedges)
         names = list(self.output.names) + ["BiasTerm"]
         return Frame.from_dict(
             {n: phi[:, i].astype(np.float32) for i, n in enumerate(names)})
@@ -169,30 +216,55 @@ class GBMModel(Model):
         # rows with NA response carried zero weight during training (and
         # padding rows have NaN response), so covers must exclude them too
         w = w * (~jnp.isnan(fr.vec(p.response_column).data)).astype(jnp.float32)
-        self.forest["cover"] = forest_covers(
-            X, w, self.forest["feat"], self.forest["thr"],
-            self.forest["nanL"], self.cfg.max_depth)
+        catd, iscat, nedges = self._set_args()
+        step = self._score_chunk_rows(X, catd)
+        cover = None
+        for s0 in range(0, X.shape[0], step):  # counts sum across chunks
+            c = forest_covers(
+                X[s0:s0 + step], w[s0:s0 + step], self.forest["feat"],
+                self.forest["thr"], self.forest["nanL"], self.cfg.max_depth,
+                catd=catd, iscat=iscat, nedges=nedges)
+            cover = c if cover is None else cover + c
+        self.forest["cover"] = cover
 
     def _leaf_nodes(self, X: np.ndarray) -> np.ndarray:
         """(R, T*[K]) final heap node index per row per tree via host routing."""
         feat = np.asarray(self.forest["feat"])
         thr = np.asarray(self.forest["thr"])
         nanL = np.asarray(self.forest["nanL"]).astype(bool)
+        catd_a, _, _ = self._set_args()
+        catd = None if catd_a is None else np.asarray(catd_a)
+        iscat = np.asarray(self.is_cat) if catd is not None else None
+        ne = (np.asarray(self.cat_nedges, dtype=np.int64)
+              if catd is not None else None)
         multi = feat.ndim == 3
-        trees = [(feat[t], thr[t], nanL[t]) for t in range(feat.shape[0])] \
-            if not multi else \
-            [(feat[t, k], thr[t, k], nanL[t, k])
-             for t in range(feat.shape[0]) for k in range(feat.shape[1])]
+        idxs = ([(t, None) for t in range(feat.shape[0])] if not multi else
+                [(t, k) for t in range(feat.shape[0])
+                 for k in range(feat.shape[1])])
+        trees = [(feat[t] if k is None else feat[t, k],
+                  thr[t] if k is None else thr[t, k],
+                  nanL[t] if k is None else nanL[t, k],
+                  None if catd is None else
+                  (catd[t] if k is None else catd[t, k]))
+                 for t, k in idxs]
         R = X.shape[0]
         out = np.zeros((R, len(trees)), dtype=np.int64)
         rows = np.arange(R)
-        for ti, (f, th, nl) in enumerate(trees):
+        for ti, (f, th, nl, cd) in enumerate(trees):
             node = np.zeros(R, dtype=np.int64)
             for _ in range(self.cfg.max_depth):
                 fs = f[node]
                 leaf = fs < 0
-                x = X[rows, np.clip(fs, 0, None)]
+                fc = np.clip(fs, 0, None)
+                x = X[rows, fc]
                 right = np.where(np.isnan(x), ~nl[node], x > th[node])
+                if cd is not None:
+                    isset = iscat[fc] & (fs >= 0)
+                    xb = np.clip(np.nan_to_num(x), 0,
+                                 ne[fc]).astype(np.int64)
+                    set_right = cd[node, xb] > 0.5
+                    right = np.where(np.isnan(x), right,
+                                     np.where(isset, set_right, right))
                 node = np.where(leaf, node, 2 * node + 1 + right)
             out[:, ti] = node
         return out
@@ -339,7 +411,8 @@ class GBM(ModelBuilder):
         edges_np = compute_bin_edges(
             X, is_cat, p.nbins,
             seed=p.seed if p.seed not in (-1, None) else 1234,
-            histogram_type=p.histogram_type)
+            histogram_type=p.histogram_type,
+            nbins_cats=int(getattr(p, "nbins_cats", 1024) or 1024))
         mesh = default_mesh()
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf), replicated(mesh))
         mono_np = np.zeros(len(names), dtype=np.float32)
@@ -371,8 +444,24 @@ class GBM(ModelBuilder):
 
         grad_fn = self._make_grad_fn(dist, K)
         # effective bin count follows the edge matrix: small-data exact
-        # binning may widen it past p.nbins (the nbins_top_level analog)
+        # binning and nbins_cats may widen it past p.nbins
         cfg = self._tree_config(K, nbins=edges_np.shape[1] + 1)
+        # categorical SET splits (IcedBitSet analog) whenever categorical
+        # features exist; RuleFit's internal forests opt out (threshold-only
+        # rule language)
+        use_sets = bool(is_cat.any()) and getattr(self, "_use_set_splits",
+                                                  True)
+        nedges_np = (~np.isnan(edges_np)).sum(axis=1).astype(np.int32)
+        iscat_dev = jax.device_put(is_cat, replicated(mesh))
+        nedges_dev = jax.device_put(nedges_np, replicated(mesh))
+        # wide bin spaces (high-cardinality categoricals / exact binning)
+        # shrink the histogram row block so the per-block (rows, F, B)
+        # one-hot keeps a bounded footprint
+        B_hist = cfg.nbins + 1
+        blk = cfg.block_rows
+        while blk > 512 and blk * B_hist > 8192 * 128:
+            blk //= 2
+        cfg = dataclasses.replace(cfg, use_sets=use_sets, block_rows=blk)
         if not self.drf_mode and K == 1 and dist.name in ("laplace",
                                                           "quantile"):
             # exact gamma leaves: median (laplace) / alpha-quantile of the
@@ -405,7 +494,8 @@ class GBM(ModelBuilder):
             w=w, y=y, ymask=ymask, edges_np=edges_np, mesh=mesh,
             edges=edges, mono=mono, imat=imat, edge_ok=edge_ok, Xb=Xb,
             f0=f0, grad_fn=grad_fn, cfg=cfg, grad_key=grad_key, y_k=y_k,
-            f=f)
+            f=f, iscat_dev=iscat_dev, nedges_dev=nedges_dev,
+            nedges_np=nedges_np)
 
     def build_impl(self, job: Job) -> GBMModel:
         s = self._setup_build()
@@ -438,6 +528,8 @@ class GBM(ModelBuilder):
                     # binning may widen it); the user contract is the param
                     ("nbins", p.nbins,
                      getattr(prior.params, "nbins", prior.cfg.nbins)),
+                    ("nbins_cats", getattr(p, "nbins_cats", 1024),
+                     getattr(prior.params, "nbins_cats", 1024)),
                     ("nclasses", K, prior.cfg.nclass),
                     ("drf_mode", self.drf_mode, prior.cfg.drf_mode),
                     ("monotone_constraints",
@@ -450,14 +542,24 @@ class GBM(ModelBuilder):
             # the stored params reference the prior by key, not by object —
             # keeps binary export/import free of nested models/frames
             p = self.params = dataclasses.replace(p, checkpoint=prior.key)
+            # continuation trees must speak the prior forest's split
+            # language: inherit its use_sets so pre-round-4 models (ordinal
+            # categorical splits) stay continuable, and a set-split prior
+            # keeps its routing tables live
+            prior_sets = bool(getattr(prior.cfg, "use_sets", False))
+            if cfg.use_sets != prior_sets:
+                cfg = dataclasses.replace(cfg, use_sets=prior_sets)
             f0 = prior.f0
             fprev = prior._raw_f(X)  # includes f0, link scale
             f = fprev.T.astype(jnp.float32) if K > 1 else fprev.astype(jnp.float32)
             if self.drf_mode:
                 # _raw_f averages DRF trees; the carried f is the raw sum
                 f = f * prior.ntrees
-            prior_parts = [tuple(prior.forest[k] for k in
-                                 ("feat", "thr", "nanL", "val", "gain"))]
+            pf = prior.forest
+            prior_parts = [tuple(
+                pf[k] if k in pf else
+                jnp.zeros(pf["feat"].shape + (1,), jnp.float32)
+                for k in ("feat", "thr", "nanL", "val", "gain", "catd"))]
 
         n_prior = prior.ntrees if prior else 0
         n_new = p.ntrees - n_prior
@@ -502,7 +604,8 @@ class GBM(ModelBuilder):
             if history and job.time_exceeded():  # keep the partial forest
                 break
             f, osum, ocnt, trees = train_fn(Xb, y_k, w, f, edges, edge_ok,
-                                            keys, rates, mono, imat)
+                                            keys, rates, mono, imat,
+                                            s.iscat_dev, s.nedges_dev)
             oob_sum = osum if oob_sum is None else oob_sum + osum
             oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
             parts.append(trees)
@@ -526,7 +629,8 @@ class GBM(ModelBuilder):
             job.update(len(keys) / max(n_new, 1))
             if p.export_checkpoints_dir:
                 self._export_snapshot(p, output, parts, f0, dist, cfg, is_cat,
-                                      ntrees_done, m)
+                                      ntrees_done, m,
+                                      cat_nedges=s.nedges_np)
             if self._should_stop(m, stop_metric_series):
                 break
         output.scoring_history = history
@@ -542,7 +646,8 @@ class GBM(ModelBuilder):
         # pass over all training rows is pure overhead for the common
         # train→predict path
         output.variable_importances = self._varimp(forest, names)
-        model = GBMModel(p, output, forest, f0, dist, cfg, is_cat)
+        model = GBMModel(p, output, forest, f0, dist, cfg, is_cat,
+                         cat_nedges=s.nedges_np)
         if getattr(p, "calibrate_model", False):
             model.calib = self._fit_calibration(model, category)
         if p.validation_frame is not None:
@@ -610,7 +715,7 @@ class GBM(ModelBuilder):
         return prior
 
     def _export_snapshot(self, p, output, parts, f0, dist, cfg, is_cat,
-                         ntrees_done, metrics):
+                         ntrees_done, metrics, cat_nedges=None):
         """In-training checkpoint to disk every scoring interval
         (`hex/tree/SharedTree.java:164,202-204,515` _in_training_checkpoints)."""
         import os
@@ -623,7 +728,8 @@ class GBM(ModelBuilder):
         snap_out.__dict__.update(output.__dict__)
         snap_out.training_metrics = metrics
         snap = GBMModel(p, snap_out, forest, f0, dist, cfg, is_cat,
-                        key=f"{self.algo_name}_checkpoint_snapshot")
+                        key=f"{self.algo_name}_checkpoint_snapshot",
+                        cat_nedges=cat_nedges)
         try:
             os.makedirs(p.export_checkpoints_dir, exist_ok=True)
             save_model(snap, os.path.join(
@@ -699,9 +805,30 @@ def _heap_path(node: int) -> str:
 
 
 def _assemble_forest(parts) -> dict:
-    """Stack per-chunk tree arrays into the model's forest dict."""
-    return {k: jnp.concatenate([t[i] for t in parts], axis=0)
-            for i, k in enumerate(("feat", "thr", "nanL", "val", "gain"))}
+    """Stack per-chunk tree arrays into the model's forest dict.
+
+    catd widths may differ across chunks (a checkpoint prior built on data
+    whose exact binning chose a different edge width, or whose categorical
+    domains have since grown). Pad narrower tables on the right with each
+    node's NA direction — a level landing in a bin the prior build never had
+    is routed like missing, the engine's empty-bin/out-of-bitset rule."""
+    out = {}
+    for i, k in enumerate(("feat", "thr", "nanL", "val", "gain", "catd")):
+        arrs = [t[i] for t in parts]
+        if k == "catd":
+            w = max(a.shape[-1] for a in arrs)
+            padded = []
+            for a, part in zip(arrs, parts):
+                if a.shape[-1] < w:
+                    na_right = 1.0 - jnp.asarray(part[2], jnp.float32)
+                    ext = jnp.broadcast_to(na_right[..., None],
+                                           a.shape[:-1]
+                                           + (w - a.shape[-1],))
+                    a = jnp.concatenate([a, ext], axis=-1)
+                padded.append(a)
+            arrs = padded
+        out[k] = jnp.concatenate(arrs, axis=0)
+    return out
 
 
 def _interaction_matrix(names, groups) -> np.ndarray:

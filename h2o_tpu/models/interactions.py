@@ -44,13 +44,18 @@ def _tree_list(model):
     V = np.asarray(model.forest["val"], dtype=np.float64)
     L = np.asarray(model.forest["nanL"])
     C = np.asarray(model.forest["cover"], dtype=np.float64)
+    use_sets = (getattr(model.cfg, "use_sets", False)
+                and "catd" in model.forest)
+    D = np.asarray(model.forest["catd"]) if use_sets else None
     if F.ndim == 3:
         for t in range(F.shape[0]):
             for k in range(F.shape[1]):
-                yield t, k, F[t, k], T[t, k], V[t, k], L[t, k], C[t, k]
+                yield (t, k, F[t, k], T[t, k], V[t, k], L[t, k], C[t, k],
+                       None if D is None else D[t, k])
     else:
         for t in range(F.shape[0]):
-            yield t, 0, F[t], T[t], V[t], L[t], C[t]
+            yield (t, 0, F[t], T[t], V[t], L[t], C[t],
+                   None if D is None else D[t])
 
 
 def _internal_values(feat, val, cover):
@@ -119,9 +124,10 @@ def collect_feature_interactions(model, max_interaction_depth=100,
     """The `FeatureInteractions.collectFeatureInteractions` recursion over
     every tree; returns {name: _FI} aggregated across trees."""
     names = list(model.output.names)
+    iscat_arr = np.asarray(model.is_cat) if hasattr(model, "is_cat") else None
     out: dict[str, _FI] = {}
 
-    for tree_idx, _k, feat, thr, val, nanL, cover in _tree_list(model):
+    for tree_idx, _k, feat, thr, val, nanL, cover, _catd in _tree_list(model):
         vint = _internal_values(feat, val, cover)
         per_tree: dict[str, _FI] = {}
         memo: set[tuple] = set()
@@ -129,6 +135,13 @@ def collect_feature_interactions(model, max_interaction_depth=100,
 
         def is_leaf(j):
             return j >= N or feat[j] < 0 or cover[j] <= 0
+
+        def _is_set_node(j):
+            # set-split nodes have no scalar split value: thr holds a
+            # sorted-prefix cut index, not a data value — keep them out of
+            # the split-value histograms
+            return _catd is not None and iscat_arr is not None \
+                and bool(iscat_arr[int(feat[j])])
 
         def recurse(j, path, cur_gain, cur_cover, path_proba, depth,
                     deepening):
@@ -163,7 +176,7 @@ def collect_feature_interactions(model, max_interaction_depth=100,
                 fi.expected_gain = cur_gain * path_proba
                 fi.tree_index = tree_idx
                 fi.tree_depth = depth
-                if fi_depth == 0:
+                if fi_depth == 0 and not _is_set_node(path[0]):
                     sv = float(thr[path[0]])
                     fi.split_value_histogram[sv] = \
                         fi.split_value_histogram.get(sv, 0) + 1
@@ -180,7 +193,7 @@ def collect_feature_interactions(model, max_interaction_depth=100,
                 fi.expected_gain += cur_gain * path_proba
                 fi.tree_depth += depth
                 fi.tree_index += tree_idx
-                if fi_depth == 0:
+                if fi_depth == 0 and not _is_set_node(path[0]):
                     sv = float(thr[path[0]])
                     fi.split_value_histogram[sv] = \
                         fi.split_value_histogram.get(sv, 0) + 1
@@ -296,12 +309,13 @@ def feature_interactions_tables(model, max_interaction_depth=100,
 # ---------------------------------------------------------------------------
 # Friedman & Popescu H
 # ---------------------------------------------------------------------------
-def _pdp_tree(feat, thr, nanL, vleaf, cover, rows, var_cols):
+def _pdp_tree(feat, thr, nanL, vleaf, cover, rows, var_cols, route=None):
     """Cover-weighted partial-dependence traversal of one heap tree
     (`FriedmanPopescusH.partialDependenceTree`): splits on a chosen variable
     follow the branch, all other splits fan out weighted by child cover.
     ``rows`` is (U, len(var_cols)) of values for the chosen variables;
-    returns (U,) partial-dependence contributions."""
+    returns (U,) partial-dependence contributions. ``route(j, x) -> bool``
+    overrides the go-right decision (categorical set splits)."""
     N = len(feat)
     col_of = {c: i for i, c in enumerate(var_cols)}
     out = np.zeros(len(rows))
@@ -321,14 +335,35 @@ def _pdp_tree(feat, thr, nanL, vleaf, cover, rows, var_cols):
                 x = row[col_of[f]]
                 if np.isnan(x):
                     stack.append((l if nanL[j] else r, wgt))
+                elif (route is not None
+                      and (rr := route(j, f, x)) is not None):
+                    stack.append((r if rr else l, wgt))
                 else:
-                    stack.append((l if x < thr[j] else r, wgt))
+                    # ties go LEFT, matching the engine's go_right = x > thr
+                    stack.append((l if x <= thr[j] else r, wgt))
             else:
                 cj = max(cover[j], 1e-300)
                 stack.append((l, wgt * cover[l] / cj))
                 stack.append((r, wgt * cover[r] / cj))
         out[i] = acc
     return out
+
+
+def _set_split_router(model, catd_t):
+    """route(j, f, x) for one tree's set-split nodes; None for numeric
+    features (fall through to the threshold test)."""
+    if catd_t is None:
+        return None
+    iscat = np.asarray(model.is_cat)
+    ne = np.asarray(model.cat_nedges, dtype=np.int64)
+
+    def route(j, f, x):
+        if not iscat[f]:
+            return None
+        xb = int(min(max(x, 0), ne[f]))
+        return bool(catd_t[j, xb] > 0.5)
+
+    return route
 
 
 def friedman_popescu_h(model, fr, variables) -> float:
@@ -352,8 +387,10 @@ def friedman_popescu_h(model, fr, variables) -> float:
     model._ensure_covers()
     # internal-node values hoisted: every variable-subset evaluation walks
     # the same trees, so compute the O(nodes) fill once per tree
-    trees = [(feat, thr, nanL, _internal_values(feat, val, cover), cover)
-             for _t, cls, feat, thr, val, nanL, cover in _tree_list(model)
+    trees = [(feat, thr, nanL, _internal_values(feat, val, cover), cover,
+              _set_split_router(model, catd))
+             for _t, cls, feat, thr, val, nanL, cover, catd
+             in _tree_list(model)
              if cls == 0]  # reference: computeHValue reads class-0 pdp
 
     def f_values(sub):  # sub: tuple of positions into `variables`
@@ -361,8 +398,9 @@ def friedman_popescu_h(model, fr, variables) -> float:
         sub_rows, inv = np.unique(uniq[:, list(sub)], axis=0,
                                   return_inverse=True)
         f = np.zeros(len(sub_rows))
-        for feat, thr, nanL, vint, cover in trees:
-            f += _pdp_tree(feat, thr, nanL, vint, cover, sub_rows, cols)
+        for feat, thr, nanL, vint, cover, route in trees:
+            f += _pdp_tree(feat, thr, nanL, vint, cover, sub_rows, cols,
+                           route=route)
         full = f[inv]  # back to the full unique-row grid
         mean = float(np.sum(full * counts) / nrows)
         return full - mean

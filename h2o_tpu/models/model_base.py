@@ -59,6 +59,8 @@ class Parameters:
     max_runtime_secs: float = 0.0
     distribution: str = "AUTO"
     categorical_encoding: str = "AUTO"
+    max_categorical_levels: int = 10  # EnumLimited top-k
+                                      # (`hex/Model.java` _max_categorical_levels)
     ignore_const_cols: bool = True
     check_constant_response: bool = True  # `hex/tree/SharedTree` refuses a
                                           # constant response unless disabled
@@ -389,17 +391,22 @@ class ModelBuilder:
         return self.train(background=False).join()
 
     def _apply_categorical_encoding(self):
-        """Eigen/OneHotExplicit categorical_encoding: freeze the transform on
-        the training frame, swap the params to the encoded frames, and return
-        the state the trained model replays at score time
-        (`hex/Model.Parameters.CategoricalEncodingScheme` + ToEigenVec)."""
+        """Eigen/OneHotExplicit/Binary/LabelEncoder/EnumLimited/SortByResponse
+        categorical_encoding: freeze the transform on the training frame,
+        swap the params to the encoded frames, and return the state the
+        trained model replays at score time
+        (`hex/Model.Parameters.CategoricalEncodingScheme` +
+        `water/util/FrameUtils.java` encoder drivers)."""
         p = self.params
         from ..utils.linalg import apply_encoding_state, build_encoding_state
 
         skip = [p.response_column, p.weights_column, p.offset_column,
                 p.fold_column] + list(p.ignored_columns)
-        state = build_encoding_state(p.training_frame, p.categorical_encoding,
-                                     skip=[s for s in skip if s])
+        state = build_encoding_state(
+            p.training_frame, p.categorical_encoding,
+            skip=[s for s in skip if s], response=p.response_column,
+            weights=p.weights_column,
+            max_levels=int(getattr(p, "max_categorical_levels", 10) or 10))
         if state is None:
             return None
         updates = {"training_frame": apply_encoding_state(p.training_frame,

@@ -193,6 +193,10 @@ class RuleFit(ModelBuilder):
                     sub = DRF(DRFParameters(**common))
                 else:
                     sub = GBM(GBMParameters(**common))
+                # the rule language is threshold conjunctions (`hex/rulefit/
+                # Rule.java` conditions) — keep the internal forests on
+                # ordinal categorical splits so every path stays expressible
+                sub._use_set_splits = False
                 m = sub.build_impl(Job(f"rulefit_trees_d{depth}", 1.0))
                 rules += extract_rules(m.forest, m.cfg.max_depth,
                                        p.min_rule_length, p.max_rule_length)

@@ -81,7 +81,8 @@ def _exact_bin_row_limit() -> int:
 def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
                       sample: int = 200_000, seed: int = 1234,
                       histogram_type: str = "QuantilesGlobal",
-                      nbins_top_level: int = 1024) -> np.ndarray:
+                      nbins_top_level: int = 1024,
+                      nbins_cats: int = 1024) -> np.ndarray:
     """Global bin edges per feature.
 
     ``histogram_type`` mirrors `hex/tree/SharedTreeModel.HistogramType`:
@@ -89,7 +90,9 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
     bins adapt to the data distribution); UniformAdaptive → equal-width
     between per-feature min/max; Random → uniform random cut points (the
     extremely-randomized-trees flavor). Categorical features always bin on
-    their category codes.
+    their category codes, one bin per level up to ``nbins_cats`` bins
+    (`hex/tree/SharedTreeModel.java:57` nbins_cats — the categorical
+    histogram width; levels at/above the cap share the top bin).
 
     X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles are taken on
     a row sample, ON DEVICE (the reference's QuantilesGlobal mode also
@@ -139,10 +142,10 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
             all_cuts.append(cuts)
             continue
         if is_cat[f]:
+            # one bin per level, capped by nbins_cats: cuts at codes
+            # 0..min(card, nbins_cats)-2 so bin = min(level, n_cuts)
             card = int(col_max[f]) + 1
-            nb_cat = max(nbins, min(card, nbins_top_level)) \
-                if exact is not None else nbins
-            cuts = np.arange(min(card - 1, nb_cat - 1), dtype=np.float32)
+            cuts = np.arange(min(card - 1, nbins_cats - 1), dtype=np.float32)
         elif ht == "uniformadaptive":
             lo, hi = float(col_min[f]), float(col_max[f])
             cuts = (np.unique(np.linspace(lo, hi, nbins + 1)[1:-1]

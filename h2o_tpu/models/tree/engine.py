@@ -86,6 +86,17 @@ class TreeConfig:
                                    # median(resid) + mean(sign·min(|resid −
                                    # median|, δ)), δ = alpha-quantile of
                                    # |resid| per tree
+    use_sets: bool = False         # categorical SET splits: send an arbitrary
+                                   # subset of levels left (`hex/tree/
+                                   # DTree.java:198` IcedBitSet splits), found
+                                   # by the sorted-by-G/H prefix search
+                                   # (optimal for binary/regression losses —
+                                   # Fisher/Breiman; same search the
+                                   # reference's histogram runs after sorting
+                                   # bins by response). Off = ordinal
+                                   # code<=cut splits (pre-round-4 behavior,
+                                   # kept for RuleFit's threshold-language
+                                   # rules and models without categoricals).
 
     @property
     def n_nodes(self) -> int:
@@ -264,15 +275,25 @@ def _level_col_mask(lkey, F, n_lv, cfg: "TreeConfig", tree_cols,
 # ---------------------------------------------------------------------------
 # Split finding (DTree.DecidedNode analog), vectorized on device.
 # ---------------------------------------------------------------------------
-def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
+def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None,
+                 iscat=None, nedges=None):
     """hist: (F, n_lv, B, 3). Returns per-node best (gain, feat, bin, nan_left,
-    node weight, left/right Newton values of the chosen split).
+    node weight, left/right Newton values of the chosen split[, bin-direction
+    rows + set flags when cfg.use_sets]).
 
     Candidates: split at bin b (left = bins <= b), b in 0..nb-2, NA bucket sent
     left or right (`hex/tree/DHistogram.java` NA bucket; direction chosen by
     gain like the reference's NASplitDir). ``mono`` (F,) in {-1,0,1} kills
     candidates whose child values violate the feature's monotone direction
     (`hex/tree/Constraints.java` role).
+
+    With ``cfg.use_sets`` (and ``iscat``/``nedges`` arrays given), categorical
+    features search SET splits instead of ordinal cuts: bins sorted by G/H
+    (their Newton-value order), candidate k = best k-bin prefix goes left —
+    the exact-optimal subset search for convex losses, equivalent to the
+    reference's bitset split enumeration (`hex/tree/DTree.java:198`). The
+    candidate axis is shared with the numeric search (prefix size k ≙ cut
+    index b = k-1), so one argmax picks across both kinds.
     """
     nb = cfg.nbins
     W, G, H = hist[..., 0], hist[..., 1], hist[..., 2]
@@ -284,6 +305,27 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
     cw = jnp.cumsum(W[:, :, :nb], axis=2)[:, :, :-1]  # (F, n_lv, nb-1)
     cg = jnp.cumsum(G[:, :, :nb], axis=2)[:, :, :-1]
     ch = jnp.cumsum(H[:, :, :nb], axis=2)[:, :, :-1]
+    rank = None
+    if cfg.use_sets and iscat is not None:
+        # sorted-order prefix candidates for categorical features: empty bins
+        # key to +inf (sorted last, never in a left prefix); stable argsort
+        # twice gives each bin's rank, which the chosen node's direction row
+        # reads back in _grow_tree
+        Wr, Gr, Hr = W[:, :, :nb], G[:, :, :nb], H[:, :, :nb]
+        key = jnp.where(Wr > 0, Gr / (Hr + 1e-10), jnp.inf)
+        order = jnp.argsort(key, axis=2, stable=True)
+        rank = jnp.argsort(order, axis=2, stable=True)
+        cw_c = jnp.cumsum(jnp.take_along_axis(Wr, order, 2), 2)[:, :, :-1]
+        cg_c = jnp.cumsum(jnp.take_along_axis(Gr, order, 2), 2)[:, :, :-1]
+        ch_c = jnp.cumsum(jnp.take_along_axis(Hr, order, 2), 2)[:, :, :-1]
+        isc = iscat[:, None, None]
+        cw = jnp.where(isc, cw_c, cw)
+        cg = jnp.where(isc, cg_c, cg)
+        ch = jnp.where(isc, ch_c, ch)
+        # a prefix of size k (candidate b = k-1) is meaningful up to ALL real
+        # bins left + NA right (k = width_f, the NA-vs-rest split)
+        cat_ok = jnp.arange(nb - 1)[None, :] <= nedges[:, None]
+        edge_ok = jnp.where(iscat[:, None], cat_ok, edge_ok)
     wna = W[:, :, nb][:, :, None]
     gna = G[:, :, nb][:, :, None]
     hna = H[:, :, nb][:, :, None]
@@ -339,15 +381,36 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None):
     bf = (best // per_f).astype(jnp.int32)
     bb = ((best % per_f) // 2).astype(jnp.int32)
     bnal = (best % 2).astype(jnp.bool_)
-    return best_gain, bf, bb, bnal, Wt, best_vL, best_vR
+    if rank is None:
+        return best_gain, bf, bb, bnal, Wt, best_vL, best_vR, None, None
+    # Direction row per node over REAL bins (0 = left, 1 = right): for a set
+    # split, bin b goes left iff its sorted rank is inside the chosen prefix;
+    # empty bins follow the NA direction (a level unseen at this node is
+    # treated like missing — the genmodel out-of-bitset-range rule). Numeric
+    # nodes get the ordinal pattern b > cut (unused by routing, which keeps
+    # the exact raw-threshold test for them).
+    n_lv = bf.shape[0]
+    rank_sel = jnp.take_along_axis(jnp.transpose(rank, (1, 0, 2)),
+                                   bf[:, None, None], axis=1)[:, 0, :]
+    w_sel = jnp.take_along_axis(jnp.transpose(W[:, :, :nb], (1, 0, 2)),
+                                bf[:, None, None], axis=1)[:, 0, :]
+    dir_c = rank_sel > bb[:, None]
+    dir_c = jnp.where(w_sel > 0, dir_c, ~bnal[:, None])
+    isset = jnp.take(iscat, bf)
+    catd_lv = jnp.where(isset[:, None], dir_c,
+                        jnp.arange(nb)[None, :] > bb[:, None]
+                        ).astype(jnp.float32)
+    return best_gain, bf, bb, bnal, Wt, best_vL, best_vR, catd_lv, isset
 
 
 # ---------------------------------------------------------------------------
 # Grow one tree fully on device (shard-local function; psums inside).
 # ---------------------------------------------------------------------------
 def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
-               mono=None, imat=None, resid=None, w_full=None):
-    """Returns (feat (N,), thr (N,), nanL (N,), val (N,), node (Rl,)).
+               mono=None, imat=None, resid=None, w_full=None,
+               iscat=None, nedges=None):
+    """Returns (feat (N,), thr (N,), nanL (N,), val (N,), gain (N,),
+    catd (N, nb|1), node (Rl,)).
 
     ``mono`` (F,) f32 in {-1,0,1}: monotone constraints. Split candidates
     violating a direction are masked in _find_splits; per-node [lo, hi] value
@@ -364,10 +427,14 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     N = cfg.n_nodes
     B = cfg.nbins + 1
 
+    use_sets = cfg.use_sets and iscat is not None
     feat = jnp.full((N,), -1, dtype=jnp.int32)
     thr = jnp.zeros((N,), dtype=jnp.float32)
     nanL = jnp.zeros((N,), dtype=jnp.bool_)
     garr = jnp.zeros((N,), dtype=jnp.float32)  # split gains (variable importance)
+    # per-node bin-direction table for categorical set splits (1 dummy column
+    # when off so scan/stack shapes stay uniform across configs)
+    catd = jnp.zeros((N, cfg.nbins if use_sets else 1), dtype=jnp.float32)
     node = jnp.zeros((Rl,), dtype=jnp.int32)
     vals3 = jnp.stack([w, g, h], axis=1)
     constrained = mono is not None
@@ -398,8 +465,9 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
             allowed_n = jax.lax.dynamic_slice(allowed, (offset, 0), (n_lv, F))
             cmask = cmask & allowed_n.T  # (F, n_lv)
 
-        gain, bf, bb, bnal, Wt, vLs, vRs = _find_splits(
-            hist, cmask, edge_ok, cfg, mono if constrained else None)
+        gain, bf, bb, bnal, Wt, vLs, vRs, catd_lv, isset = _find_splits(
+            hist, cmask, edge_ok, cfg, mono if constrained else None,
+            iscat if use_sets else None, nedges if use_sets else None)
         do_split = (gain > cfg.min_split_improvement) & (Wt >= 2 * cfg.min_rows)
 
         if constrained:
@@ -435,6 +503,8 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
         nanL = jax.lax.dynamic_update_slice(nanL, bnal, (offset,))
         garr = jax.lax.dynamic_update_slice(
             garr, jnp.where(do_split, gain, 0.0).astype(jnp.float32), (offset,))
+        if use_sets:
+            catd = jax.lax.dynamic_update_slice(catd, catd_lv, (offset, 0))
 
         # Route rows: only rows at split nodes of this level descend.
         # Per-row dynamic gathers (bf[lc], Xb[r, bf]) are catastrophically
@@ -442,25 +512,52 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
         # every per-node quantity is broadcast to rows through one-hot
         # matmuls, which ride the MXU (SURVEY.md §"hard parts" — TPUs lack
         # fast generic scatter/gather).
-        local = node - offset
-        active = (local >= 0) & (local < n_lv)
-        lc = jnp.clip(local, 0, n_lv - 1)
-        n_oh = jax.nn.one_hot(lc, n_lv, dtype=jnp.float32)        # (Rl, n_lv)
-        S = jax.nn.one_hot(bf, F, dtype=jnp.float32)              # (n_lv, F)
         # TPU matmuls default to bf16 multiplies; these dots move small
         # INTEGERS (bin ids < nbins, 0/1 flags) through 0/1 one-hots, which
         # bf16 represents exactly up to 256 — above that, force full f32.
         prec = (jax.lax.Precision.HIGHEST if cfg.nbins >= 255
                 else jax.lax.Precision.DEFAULT)
-        # bin of each row's split feature: Σ_n n_oh[r,n]·(Xb·Sᵀ)[r,n]
-        xbs = jnp.dot(Xb.astype(jnp.float32), S.T, precision=prec,
-                      preferred_element_type=jnp.float32)         # (Rl, n_lv)
-        rb_val = jnp.sum(xbs * n_oh, axis=1)
-        row_bb = jnp.dot(n_oh, bb.astype(jnp.float32), precision=prec)
-        row_nal = jnp.dot(n_oh, bnal.astype(jnp.float32)) > 0.5
-        row_split = (jnp.dot(n_oh, do_split.astype(jnp.float32)) > 0.5) & active
-        go_right = jnp.where(rb_val == cfg.nbins, ~row_nal, rb_val > row_bb)
-        node = jnp.where(row_split, 2 * node + 1 + go_right.astype(jnp.int32), node)
+        S = jax.nn.one_hot(bf, F, dtype=jnp.float32)              # (n_lv, F)
+
+        def _route(xb_blk, node_blk):
+            local = node_blk - offset
+            active = (local >= 0) & (local < n_lv)
+            lc = jnp.clip(local, 0, n_lv - 1)
+            n_oh = jax.nn.one_hot(lc, n_lv, dtype=jnp.float32)  # (rb, n_lv)
+            # bin of each row's split feature: Σ_n n_oh[r,n]·(Xb·Sᵀ)[r,n]
+            xbs = jnp.dot(xb_blk.astype(jnp.float32), S.T, precision=prec,
+                          preferred_element_type=jnp.float32)   # (rb, n_lv)
+            rb_val = jnp.sum(xbs * n_oh, axis=1)
+            row_bb = jnp.dot(n_oh, bb.astype(jnp.float32), precision=prec)
+            row_nal = jnp.dot(n_oh, bnal.astype(jnp.float32)) > 0.5
+            row_split = (jnp.dot(n_oh, do_split.astype(jnp.float32))
+                         > 0.5) & active
+            num_right = rb_val > row_bb
+            if use_sets:
+                # table route: the row's direction is its bin's entry in the
+                # node's direction row — two more small matmuls, no gathers
+                Drow = jnp.dot(n_oh, catd_lv,
+                               preferred_element_type=jnp.float32)  # (rb, nb)
+                bin_oh = jax.nn.one_hot(rb_val.astype(jnp.int32), cfg.nbins,
+                                        dtype=jnp.float32)
+                cat_right = jnp.sum(bin_oh * Drow, axis=1) > 0.5
+                row_isset = jnp.dot(n_oh, isset.astype(jnp.float32)) > 0.5
+                num_right = jnp.where(row_isset, cat_right, num_right)
+            go_right = jnp.where(rb_val == cfg.nbins, ~row_nal, num_right)
+            return jnp.where(row_split,
+                             2 * node_blk + 1 + go_right.astype(jnp.int32),
+                             node_blk)
+
+        if use_sets:
+            # blocked: the (rows, nbins) bin one-hot lives per block, never
+            # materializing an (Rl, nbins) intermediate at wide nbins_cats
+            rb_ = _block_rows(Rl, cfg.block_rows)
+            _, node_b = jax.lax.scan(
+                lambda c, blk: (c, _route(*blk)), None,
+                (Xb.reshape(Rl // rb_, rb_, F), node.reshape(Rl // rb_, rb_)))
+            node = node_b.reshape(Rl)
+        else:
+            node = _route(Xb, node)
 
     # Leaf/stop-node values from one final per-node accumulation (covers both
     # max-depth leaves and early-stopped internal nodes).
@@ -506,7 +603,7 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     # effective_learning_rate·gamma (GBM.java:716-719) — annealing included,
     # so the clip happens in tree_step after the per-tree rate is applied.
     val = newton * scale
-    return feat, thr, nanL, val, garr, node
+    return feat, thr, nanL, val, garr, catd, node
 
 
 _TRAIN_FN_CACHE: dict = {}
@@ -526,10 +623,12 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     a fresh closure and jax's compile cache misses (AdaBoost re-trains a
     learner per round; a per-learner recompile turned 30 stumps into minutes).
 
-    Returns train(Xb, y, w, f0, edges, edge_ok, keys, rates, mono, imat) ->
-    (f, oob_sum, oob_cnt, (feat, thr, nanL, val, gain) stacked over trees);
-    oob_sum/oob_cnt accumulate each row's out-of-bag tree outputs for DRF's
-    OOB scoring (zeros when sample_rate == 1).
+    Returns train(Xb, y, w, f0, edges, edge_ok, keys, rates, mono, imat,
+    iscat, nedges) -> (f, oob_sum, oob_cnt, (feat, thr, nanL, val, gain,
+    catd) stacked over trees); oob_sum/oob_cnt accumulate each row's
+    out-of-bag tree outputs for DRF's OOB scoring (zeros when
+    sample_rate == 1). ``iscat``/``nedges`` are (F,) bool/int32 arrays (only
+    read under cfg.use_sets — pass zeros otherwise).
     """
     mesh = mesh or default_mesh()
     if cache_key is not None:
@@ -539,9 +638,12 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             return hit
     K = cfg.nclass
 
-    def spmd(Xb, y, w, f, edges, edge_ok, keys, rates, mono, imat):
+    def spmd(Xb, y, w, f, edges, edge_ok, keys, rates, mono, imat, iscat,
+             nedges):
         mono_arg = mono if cfg.use_monotone else None
         imat_arg = imat if cfg.use_interaction else None
+        iscat_arg = iscat if cfg.use_sets else None
+        nedges_arg = nedges if cfg.use_sets else None
 
         def tree_step(carry, key_rate):
             f, osum, ocnt = carry
@@ -574,18 +676,21 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                 resid = ((y - f) if (cfg.leaf_quantile is not None or
                                      cfg.huber_leaf_alpha is not None)
                          else None)
-                ft, th, nl, vl, ga, node = _grow_tree(
+                ft, th, nl, vl, ga, cd, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
-                    mono_arg, imat_arg, resid, w_full=w)
+                    mono_arg, imat_arg, resid, w_full=w,
+                    iscat=iscat_arg, nedges=nedges_arg)
                 vl = scale_leaves(vl)
                 delta = leaf_delta(vl, node)
             else:
                 grow = jax.vmap(
                     lambda gk, hk, ck: _grow_tree(Xb, gk * s, hk * s, w * s,
                                                   edges, edge_ok, ck, cfg,
-                                                  mono_arg, imat_arg))
+                                                  mono_arg, imat_arg,
+                                                  iscat=iscat_arg,
+                                                  nedges=nedges_arg))
                 ckeys = jax.random.split(jax.random.fold_in(key, 31), K)
-                ft, th, nl, vl, ga, node = grow(g, h, ckeys)
+                ft, th, nl, vl, ga, cd, node = grow(g, h, ckeys)
                 vl = scale_leaves(vl)
                 delta = jax.vmap(leaf_delta)(vl, node)
             f = f + delta
@@ -594,7 +699,7 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             oob = 1.0 - s
             osum = osum + delta * (oob if K == 1 else oob[None, :])
             ocnt = ocnt + oob
-            return (f, osum, ocnt), (ft, th, nl, vl, ga)
+            return (f, osum, ocnt), (ft, th, nl, vl, ga, cd)
 
         init = (f, jnp.zeros_like(f), jnp.zeros(w.shape[-1:], jnp.float32))
         (f, osum, ocnt), trees = jax.lax.scan(tree_step, init, (keys, rates))
@@ -604,8 +709,8 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     fn = shard_map(
         spmd, mesh=mesh,
         in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P(),
-                  P(), P()),
-        out_specs=(fspec, fspec, P(ROWS), (P(), P(), P(), P(), P())),
+                  P(), P(), P(), P()),
+        out_specs=(fspec, fspec, P(ROWS), (P(), P(), P(), P(), P(), P())),
         check_vma=False,
     )
     jitted = jax.jit(fn)
@@ -618,7 +723,32 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
 # Forest prediction (vectorized CompressedTree traversal; `hex/tree/
 # CompressedTree.java` score0 analog).
 # ---------------------------------------------------------------------------
-def forest_covers(X, w, feat, thr, nanL, max_depth: int):
+def _split_right(x, x_nan, n_oh, ftk, thk, nlk, cdk, iscat, nedges):
+    """Shared per-level decision: (R,) go-right for rows sitting at each
+    node. Numeric nodes test the raw threshold; categorical set-split nodes
+    (``cdk`` (N, nb) direction rows present + feature flagged in ``iscat``)
+    read their level's bin direction; NA follows the node's NA direction."""
+    row_thr = _onehot_pick(n_oh, thk)
+    row_nal = jnp.dot(n_oh, nlk.astype(jnp.float32)) > 0.5
+    num_right = x > row_thr
+    if cdk is not None:
+        isset_n = (jnp.take(iscat, jnp.clip(ftk, 0)) & (ftk >= 0))
+        nedge_n = jnp.take(nedges, jnp.clip(ftk, 0)).astype(jnp.float32)
+        row_isset = jnp.dot(n_oh, isset_n.astype(jnp.float32)) > 0.5
+        row_ne = _onehot_pick(n_oh, nedge_n)
+        # level -> bin is closed-form for categorical codes binned on
+        # 0..n_edges-1 integer cuts: bin = min(level, n_edges)
+        xb = jnp.clip(x, 0.0, row_ne)
+        Drow = jnp.dot(n_oh, cdk, preferred_element_type=jnp.float32)
+        bin_oh = jax.nn.one_hot(xb.astype(jnp.int32), cdk.shape[1],
+                                dtype=jnp.float32)
+        cat_right = jnp.sum(bin_oh * Drow, axis=1) > 0.5
+        num_right = jnp.where(row_isset, cat_right, num_right)
+    return jnp.where(x_nan, ~row_nal, num_right)
+
+
+def forest_covers(X, w, feat, thr, nanL, max_depth: int, catd=None,
+                  iscat=None, nedges=None):
     """Per-node weighted training-row counts ("cover"), shape (T, [K,] N).
 
     The reference stores these node weights in the tree format for TreeSHAP
@@ -631,7 +761,7 @@ def forest_covers(X, w, feat, thr, nanL, max_depth: int):
     Xz = jnp.nan_to_num(X)
     isnan_f = jnp.isnan(X).astype(jnp.float32)
 
-    def traverse(ftk, thk, nlk):
+    def traverse(ftk, thk, nlk, cdk):
         node = jnp.zeros(X.shape[0], dtype=jnp.int32)
         S = jax.nn.one_hot(jnp.clip(ftk, 0), X.shape[1], dtype=jnp.float32)
         counts = jnp.zeros(N, jnp.float32).at[0].set(jnp.sum(w))
@@ -641,9 +771,8 @@ def forest_covers(X, w, feat, thr, nanL, max_depth: int):
             x = jnp.sum(P_feat * Xz, axis=1)
             x_nan = jnp.sum(P_feat * isnan_f, axis=1) > 0.5
             is_leaf = jnp.dot(n_oh, (ftk < 0).astype(jnp.float32)) > 0.5
-            row_thr = _onehot_pick(n_oh, thk)
-            row_nal = jnp.dot(n_oh, nlk.astype(jnp.float32)) > 0.5
-            go_right = jnp.where(x_nan, ~row_nal, x > row_thr)
+            go_right = _split_right(x, x_nan, n_oh, ftk, thk, nlk, cdk,
+                                    iscat, nedges)
             node = jnp.where(is_leaf, node,
                              2 * node + 1 + go_right.astype(jnp.int32))
             moved = w * (~is_leaf).astype(jnp.float32)
@@ -652,29 +781,36 @@ def forest_covers(X, w, feat, thr, nanL, max_depth: int):
                 preferred_element_type=jnp.float32)
         return counts
 
+    has_cd = catd is not None
+    cd = catd if has_cd else jnp.zeros(feat.shape + (1,), jnp.float32)
+
     def one_tree(carry, tree):
-        ft, th, nl = tree
-        out = jax.vmap(traverse)(ft, th, nl) if multi else traverse(ft, th, nl)
+        ft, th, nl, cdt = tree
+        fn = lambda a, b, c, d: traverse(a, b, c, d if has_cd else None)
+        out = jax.vmap(fn)(ft, th, nl, cdt) if multi else fn(ft, th, nl, cdt)
         return carry, out
 
-    _, covers = jax.lax.scan(one_tree, 0, (feat, thr, nanL))
+    _, covers = jax.lax.scan(one_tree, 0, (feat, thr, nanL, cd))
     return covers
 
 
-def predict_forest(X, feat, thr, nanL, val, max_depth: int):
+def predict_forest(X, feat, thr, nanL, val, max_depth: int, catd=None,
+                   iscat=None, nedges=None):
     """X: (R, F) raw values. feat/thr/nanL/val: (T, [K,] N). Returns summed
     tree outputs (R,) or (R, K).
 
     Traversal broadcasts per-node split params to rows through one-hot
     matmuls instead of per-row gathers (same MXU-over-gather rationale as the
-    training-side routing in _grow_tree)."""
+    training-side routing in _grow_tree). ``catd`` (T, [K,] N, nb) +
+    ``iscat``/``nedges`` (F,) activate categorical set-split routing."""
     multi = feat.ndim == 3
     N = feat.shape[-1]
+    has_cd = catd is not None
 
     def one_tree(acc, tree):
-        ft, th, nl, vl = tree
+        ft, th, nl, vl, cdt = tree
 
-        def traverse(ftk, thk, nlk, vlk):
+        def traverse(ftk, thk, nlk, vlk, cdk):
             node = jnp.zeros(X.shape[0], dtype=jnp.int32)
             S = jax.nn.one_hot(jnp.clip(ftk, 0), X.shape[1],
                                dtype=jnp.float32)               # (N, F)
@@ -689,21 +825,23 @@ def predict_forest(X, feat, thr, nanL, val, max_depth: int):
                 is_leaf = jnp.dot(n_oh, (ftk < 0).astype(jnp.float32)) > 0.5
                 # thresholds are real f32 values: a plain bf16 multiply would
                 # misroute rows whose value falls inside the rounding gap
-                row_thr = _onehot_pick(n_oh, thk)
-                row_nal = jnp.dot(n_oh, nlk.astype(jnp.float32)) > 0.5
-                go_right = jnp.where(x_nan, ~row_nal, x > row_thr)
+                go_right = _split_right(x, x_nan, n_oh, ftk, thk, nlk, cdk,
+                                        iscat, nedges)
                 nxt = 2 * node + 1 + go_right.astype(jnp.int32)
                 node = jnp.where(is_leaf, node, nxt)
             n_oh = jax.nn.one_hot(node, N, dtype=jnp.float32)
             return _onehot_pick(n_oh, vlk)
 
+        fn = lambda a, b, c, d, e: traverse(a, b, c, d,
+                                            e if has_cd else None)
         if multi:
-            out = jax.vmap(traverse)(ft, th, nl, vl).T  # (R, K)
+            out = jax.vmap(fn)(ft, th, nl, vl, cdt).T  # (R, K)
         else:
-            out = traverse(ft, th, nl, vl)
+            out = fn(ft, th, nl, vl, cdt)
         return acc + out, None
 
+    cd = catd if has_cd else jnp.zeros(feat.shape + (1,), jnp.float32)
     K = feat.shape[1] if multi else None
     init = jnp.zeros((X.shape[0], K) if multi else (X.shape[0],), jnp.float32)
-    out, _ = jax.lax.scan(one_tree, init, (feat, thr, nanL, val))
+    out, _ = jax.lax.scan(one_tree, init, (feat, thr, nanL, val, cd))
     return out

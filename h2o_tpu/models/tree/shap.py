@@ -70,7 +70,8 @@ def _unwound_sum(pw, zf, of, i):
     return total
 
 
-def _tree_shap_one(X, feat, thr, nanL, val, cover, phi, scale):
+def _tree_shap_one(X, feat, thr, nanL, val, cover, phi, scale,
+                   catd=None, iscat=None, nedges=None):
     """Accumulate one tree's SHAP values into phi (R, F+1)."""
     R = X.shape[0]
     f = feat.astype(np.int64)
@@ -78,6 +79,15 @@ def _tree_shap_one(X, feat, thr, nanL, val, cover, phi, scale):
     xv = X[:, idx] if X.shape[1] else np.zeros((R, len(f)))
     nan_x = np.isnan(xv)
     right = np.where(nan_x, ~nanL.astype(bool)[None, :], xv > thr[None, :])
+    if catd is not None:
+        # categorical set-split nodes: direction = the level's bin entry in
+        # the node's direction row (bin = min(level, n_edges))
+        isset = iscat[idx] & (f >= 0)
+        xb = np.clip(np.nan_to_num(xv), 0, nedges[idx][None, :]).astype(np.int64)
+        set_right = np.take_along_axis(
+            catd.astype(np.float64).T, xb, axis=0) > 0.5  # (R, N)
+        right = np.where(nan_x, right,
+                         np.where(isset[None, :], set_right, right))
 
     root_cover = max(cover[0], _EPS)
     leaves = (f < 0) & (cover > 0)
@@ -118,12 +128,15 @@ def _tree_shap_one(X, feat, thr, nanL, val, cover, phi, scale):
 
 
 def tree_shap(X, feat, thr, nanL, val, cover, bias0: float = 0.0,
-              scale: float = 1.0, block: int = 8192) -> np.ndarray:
+              scale: float = 1.0, block: int = 8192, catd=None,
+              iscat=None, nedges=None) -> np.ndarray:
     """SHAP contributions for a forest.
 
     X: (R, F) raw feature matrix (NaN = missing). feat/thr/nanL/val/cover:
-    (T, N) numpy arrays. Returns (R, F+1): per-feature phi + BiasTerm last,
-    in margin/link space; rows sum to the raw forest prediction + bias0."""
+    (T, N) numpy arrays. ``catd`` (T, N, B) + ``iscat``/``nedges`` (F,)
+    route categorical set-split nodes. Returns (R, F+1): per-feature phi +
+    BiasTerm last, in margin/link space; rows sum to the raw forest
+    prediction + bias0."""
     R, F = X.shape
     out = np.zeros((R, F + 1), dtype=np.float64)
     X64 = np.asarray(X, dtype=np.float64)
@@ -132,6 +145,8 @@ def tree_shap(X, feat, thr, nanL, val, cover, bias0: float = 0.0,
         phi = out[blk]
         for t in range(feat.shape[0]):
             _tree_shap_one(X64[blk], feat[t], thr[t], nanL[t], val[t],
-                           np.asarray(cover[t], dtype=np.float64), phi, scale)
+                           np.asarray(cover[t], dtype=np.float64), phi, scale,
+                           catd=None if catd is None else catd[t],
+                           iscat=iscat, nedges=nedges)
     out[:, -1] += bias0
     return out

@@ -335,8 +335,12 @@ class UpliftDRF(ModelBuilder):
         mtries = p.mtries if p.mtries and p.mtries > 0 else max(
             1, int(math.sqrt(F)))
         mesh = default_mesh()
+        # nbins_cats pinned to nbins: the uplift engine splits categoricals
+        # ordinally (no set splits), where a wider-than-nbins bin space only
+        # inflates the (F, n_lv, B, 4) histograms without adding split power
         edges_np = compute_bin_edges(X, is_cat, p.nbins,
-                                     seed=p.seed if p.seed not in (-1, None) else 1234)
+                                     seed=p.seed if p.seed not in (-1, None) else 1234,
+                                     nbins_cats=p.nbins)
         cfg = TreeConfig(
             ntrees=p.ntrees, max_depth=min(p.max_depth, 12),
             # effective bin count follows the edge matrix (small-data exact

@@ -160,6 +160,9 @@ class XGBoost(GBM):
         history = []
         stop_series: list = []
         interval = min(p.score_tree_interval or p.ntrees, p.ntrees)
+        last_scored = 0
+
+        use_sets = s.cfg.use_sets
 
         def dropped_sum(idxs):
             """sum_{i in D} w_i * raw_i in ONE forest evaluation: stack the
@@ -171,8 +174,12 @@ class XGBoost(GBM):
             val = jnp.concatenate(
                 [jnp.asarray(parts[i][3]) * jnp.float32(weights[i])
                  for i in idxs], axis=0)
+            catd = (jnp.concatenate([parts[i][5] for i in idxs], axis=0)
+                    if use_sets else None)
             return predict_forest(s.X, feat, thr, nanL, val,
-                                  s.cfg.max_depth)
+                                  s.cfg.max_depth, catd=catd,
+                                  iscat=s.iscat_dev if use_sets else None,
+                                  nedges=s.nedges_dev if use_sets else None)
 
         for t in range(p.ntrees):
             job.check_cancelled()
@@ -192,7 +199,8 @@ class XGBoost(GBM):
                 margin = s.f0 + S
             f_out, _os, _oc, trees = train_fn(
                 s.Xb, s.y_k, s.w, margin.astype(jnp.float32), s.edges,
-                s.edge_ok, keys[t:t + 1], one_rate, s.mono, s.imat)
+                s.edge_ok, keys[t:t + 1], one_rate, s.mono, s.imat,
+                s.iscat_dev, s.nedges_dev)
             raw_new = f_out - margin
             k = len(dropped)
             if k == 0:
@@ -220,7 +228,10 @@ class XGBoost(GBM):
                 history.append({"timestamp": _t.time(),
                                 "number_of_trees": t + 1,
                                 "training_metrics": m})
-                job.update(interval / p.ntrees)  # incremental, like gbtree
+                # incremental progress by trees actually elapsed since the
+                # last update (the final round may be shorter than interval)
+                job.update((t + 1 - last_scored) / p.ntrees)
+                last_scored = t + 1
                 if self._should_stop(m, stop_series):
                     break
 
@@ -230,11 +241,11 @@ class XGBoost(GBM):
         cap = float(getattr(p, "max_abs_leafnode_pred", float("inf"))
                     or float("inf"))
         scaled = []
-        for (feat, thr, nanL, val, gain), wgt in zip(parts, weights):
+        for (feat, thr, nanL, val, gain, catd), wgt in zip(parts, weights):
             v = jnp.asarray(val) * jnp.float32(wgt)
             if np.isfinite(cap):
                 v = jnp.clip(v, -cap, cap)
-            scaled.append((feat, thr, nanL, v, gain))
+            scaled.append((feat, thr, nanL, v, gain, catd))
         output = ModelOutput()
         output.names = list(s.names)
         output.domains = {n: s.fr.vec(n).domain for n in s.names}
@@ -245,7 +256,12 @@ class XGBoost(GBM):
         output.training_metrics = history[-1]["training_metrics"]
         forest = _assemble_forest(scaled)
         output.variable_importances = self._varimp(forest, s.names)
-        model = GBMModel(p, output, forest, s.f0, s.dist, s.cfg, s.is_cat)
+        model = GBMModel(p, output, forest, s.f0, s.dist, s.cfg, s.is_cat,
+                         cat_nedges=s.nedges_np)
+        if getattr(p, "calibrate_model", False):
+            # same Platt step as the gbtree path — leaves are already baked,
+            # so the margin the calibrator sees is the final DART margin
+            model.calib = self._fit_calibration(model, s.category)
         if p.validation_frame is not None:
             output.validation_metrics = model.model_performance(
                 p.validation_frame)

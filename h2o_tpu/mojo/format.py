@@ -42,7 +42,8 @@ _LEAF_COL = 0xFFFF
 # ---------------------------------------------------------------------------
 # Tree encoding: dense perfect-binary-tree arrays -> MOJO bytecode
 # ---------------------------------------------------------------------------
-def encode_tree(feat, thr, nanL, val):
+def encode_tree(feat, thr, nanL, val, catd=None, iscat=None, nedges=None,
+                cards=None):
     """Encode one tree given engine arrays (N,) with N = 2^(d+1)-1.
 
     feat[i] < 0 marks a leaf with value val[i]; otherwise the node splits on
@@ -50,12 +51,30 @@ def encode_tree(feat, thr, nanL, val):
     left iff nanL[i]. The MOJO numeric test sends x >= splitVal right, so we
     emit splitVal = nextafter(thr, +inf) which is exactly equivalent for every
     float32. Returns (tree_bytes, aux_bytes).
+
+    Categorical SET splits (``catd`` (N, B) bin-direction rows + ``iscat``/
+    ``nedges``/``cards`` (F,) arrays given): the node is emitted as the
+    reference's bitset split (`SharedTreeMojoModel.java` equal==12 layout,
+    u16 bitoff + i32 nbits + bytes) with one bit per DOMAIN level — bit set =
+    level goes right, exactly the `GenmodelBitSet.contains -> go right`
+    convention; levels at/above the engine's bin cap share the top bin's
+    direction (bin = min(level, n_edges)).
     """
     feat = np.asarray(feat)
     thr = np.asarray(thr, dtype=np.float32)
     nanL = np.asarray(nanL)
     val = np.asarray(val, dtype=np.float32)
     aux = []
+
+    def set_split_bytes(i) -> bytes | None:
+        f = int(feat[i])
+        if catd is None or iscat is None or not iscat[f]:
+            return None
+        card = int(cards[f])
+        levels = np.minimum(np.arange(card), int(nedges[f]))
+        bits_right = np.asarray(catd[i])[levels] > 0.5
+        packed = np.packbits(bits_right, bitorder="little")
+        return struct.pack("<Hi", 0, card) + packed.tobytes()
 
     def node_bytes(i) -> bytes:
         if feat[i] < 0:  # leaf
@@ -85,8 +104,14 @@ def encode_tree(feat, thr, nanL, val):
             nodetype |= nbytes - 1
             offs = n.to_bytes(nbytes, "little")
         nsd = NSD_NA_LEFT if nanL[i] else NSD_NA_RIGHT
-        split = np.nextafter(thr[i], np.float32(np.inf), dtype=np.float32)
-        head = struct.pack("<BHBf", nodetype, int(feat[i]), nsd, float(split))
+        bset = set_split_bytes(i)
+        if bset is not None:
+            nodetype |= 12  # equal == 12: extended bitset split
+            head = struct.pack("<BHB", nodetype, int(feat[i]), nsd) + bset
+        else:
+            split = np.nextafter(thr[i], np.float32(np.inf), dtype=np.float32)
+            head = struct.pack("<BHBf", nodetype, int(feat[i]), nsd,
+                               float(split))
         return head + offs + left + right
 
     if feat[0] < 0:  # degenerate single-leaf tree

@@ -43,8 +43,14 @@ def _jd(x: float) -> str:
     return repr(float(x))
 
 
-def _tree_method(feat, thr, nanL, val, name: str) -> str:
-    """One tree as a recursive-descent if/else over the heap arrays."""
+def _tree_method(feat, thr, nanL, val, name: str, catd=None, iscat=None,
+                 nedges=None, cards=None) -> str:
+    """One tree as a recursive-descent if/else over the heap arrays.
+
+    Categorical set-split nodes (``catd`` routing tables present) emit a
+    per-node `static final boolean[]` go-right group — the POJO analog of
+    the reference's GenmodelBitSet splits — indexed by the clipped level."""
+    groups = []
 
     def emit(j, indent) -> str:
         pad = "    " * indent
@@ -53,7 +59,23 @@ def _tree_method(feat, thr, nanL, val, name: str) -> str:
         f, t = int(feat[j]), float(thr[j])
         na_left = bool(nanL[j])
         left, right = 2 * j + 1, 2 * j + 2
-        if na_left:
+        if catd is not None and iscat is not None and iscat[f]:
+            card = int(cards[f])
+            bits = catd[j][np.minimum(np.arange(card), int(nedges[f]))] > 0.5
+            gname = f"GRP_{name}_{j}"
+            groups.append(
+                f"  static final boolean[] {gname} = {{"
+                + ", ".join("true" if b else "false" for b in bits) + "};\n")
+            # out-of-domain codes follow the NA direction, like the engine
+            # (adapt_frame maps unseen levels to NaN) and the MOJO scorer
+            # (score_tree's beyond-domain -> cond); in-domain indexes GRP
+            bad = (f"(Double.isNaN(data[{f}]) || data[{f}] < 0.0 "
+                   f"|| data[{f}] >= {card}.0)")
+            if na_left:
+                cond = f"{bad} || !{gname}[(int) data[{f}]]"
+            else:
+                cond = f"!{bad} && !{gname}[(int) data[{f}]]"
+        elif na_left:
             cond = f"Double.isNaN(data[{f}]) || data[{f}] <= {_jd(t)}"
         else:
             cond = f"!Double.isNaN(data[{f}]) && data[{f}] <= {_jd(t)}"
@@ -64,8 +86,9 @@ def _tree_method(feat, thr, nanL, val, name: str) -> str:
         s += f"{pad}}}\n"
         return s
 
-    return (f"  static double {name}(double[] data) {{\n"
-            + emit(0, 2) + "  }\n")
+    body = emit(0, 2)
+    return ("".join(groups)
+            + f"  static double {name}(double[] data) {{\n" + body + "  }\n")
 
 
 def _tree_pojo(model, class_name) -> str:
@@ -75,6 +98,7 @@ def _tree_pojo(model, class_name) -> str:
     thr = np.asarray(model.forest["thr"])
     nanL = np.asarray(model.forest["nanL"])
     val = np.asarray(model.forest["val"], dtype=np.float64)
+    catd, iscat, nedges, cards = model.set_split_arrays_np()
     multi = feat.ndim == 3
     T = feat.shape[0]
     K = feat.shape[1] if multi else 1
@@ -88,7 +112,9 @@ def _tree_pojo(model, class_name) -> str:
             nm = f"tree_{t}_{k}"
             tree = (feat[t, k], thr[t, k], nanL[t, k], val[t, k]) if multi \
                 else (feat[t], thr[t], nanL[t], val[t])
-            methods.append(_tree_method(*tree, name=nm))
+            cd = None if catd is None else (catd[t, k] if multi else catd[t])
+            methods.append(_tree_method(*tree, name=nm, catd=cd, iscat=iscat,
+                                        nedges=nedges, cards=cards))
             calls[k].append(f"{nm}(data)")
 
     body = []

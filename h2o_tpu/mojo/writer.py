@@ -166,6 +166,8 @@ def _write_tree_mojo(model, path: str):
     thr = np.asarray(model.forest["thr"])
     nanL = np.asarray(model.forest["nanL"])
     val = np.asarray(model.forest["val"]).astype(np.float64)
+    # categorical set-split routing tables -> reference bitset splits
+    catd, iscat, nedges, cards = model.set_split_arrays_np()
     multi = feat.ndim == 3
     T = feat.shape[0]
     K = feat.shape[1] if multi else 1
@@ -208,7 +210,9 @@ def _write_tree_mojo(model, path: str):
         for i in range(K):
             tree = (feat[j, i], thr[j, i], nanL[j, i], val[j, i]) if multi \
                 else (feat[j], thr[j], nanL[j], val[j])
-            blob, aux = encode_tree(*tree)
+            cd = None if catd is None else (catd[j, i] if multi else catd[j])
+            blob, aux = encode_tree(*tree, catd=cd, iscat=iscat,
+                                    nedges=nedges, cards=cards)
             zw.write_blob(f"trees/t{i:02d}_{j:03d}.bin", blob)
             zw.write_blob(f"trees/t{i:02d}_{j:03d}_aux.bin", aux)
     zw.finish(path)

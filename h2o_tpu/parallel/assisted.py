@@ -30,11 +30,22 @@ _PARSE_ERR = ("Unable to parse IP addresses in body. Only one IPv4/IPv6 "
 
 
 def _valid_node(line: str) -> bool:
-    host, sep, port = line.rpartition(":")
-    if sep and host and not host.count(":"):  # IPv4:port
-        if not port.isdigit():
+    if line.startswith("["):  # [IPv6]:port (bracketed, RFC 3986 style)
+        host, sep, port = line.rpartition("]:")
+        if sep:
+            if not port.isdigit():
+                return False
+            line = host[1:]
+        elif line.endswith("]"):
+            line = line[1:-1]
+        else:
             return False
-        line = host
+    else:
+        host, sep, port = line.rpartition(":")
+        if sep and host and not host.count(":"):  # IPv4:port
+            if not port.isdigit():
+                return False
+            line = host
     try:
         ipaddress.ip_address(line)
         return True

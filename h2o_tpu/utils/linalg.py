@@ -53,31 +53,95 @@ def _eigen_loadings(v: Vec) -> np.ndarray:
     return v1.astype(np.float32)
 
 
+#: wire-name spellings accepted for each frozen scheme
+_ENC_CANON = {
+    "eigen": "Eigen", "onehotexplicit": "OneHotExplicit",
+    "one_hot_explicit": "OneHotExplicit", "binary": "Binary",
+    "labelencoder": "LabelEncoder", "label_encoder": "LabelEncoder",
+    "enumlimited": "EnumLimited", "enum_limited": "EnumLimited",
+    "sortbyresponse": "SortByResponse", "sort_by_response": "SortByResponse",
+}
+
+
 def build_encoding_state(fr: Frame, encoding: str,
-                         skip: list[str] | None = None) -> dict | None:
+                         skip: list[str] | None = None,
+                         response: str | None = None,
+                         weights: str | None = None,
+                         max_levels: int = 10) -> dict | None:
     """Freeze a categorical_encoding transform on the training frame so the
     IDENTICAL mapping replays at score time (levels matched by name, unseen
-    levels → NA). Returns None for AUTO/Enum (builders one-hot internally via
-    DataInfo)."""
+    levels → NA). Returns None for AUTO/Enum/OneHotInternal (builders one-hot
+    internally via DataInfo).
+
+    Schemes (`hex/Model.Parameters.CategoricalEncodingScheme` +
+    `water/util/FrameUtils.java` encoder drivers):
+    - Eigen: per-level dominant-eigenvector loading (ToEigenVec).
+    - OneHotExplicit: k indicator columns ``name.level``.
+    - Binary: ⌈log2⌉ bit columns ``name:k`` of (code+1); NA → all zeros
+      (`FrameUtils.CategoricalBinaryEncoder`, val = isNA ? 0 : 1+code).
+    - LabelEncoder: the integer level code as one numeric column.
+    - EnumLimited: columns with card > max_levels keep their ``max_levels``
+      most frequent levels, the rest collapse into a trailing ``other``
+      level; column renamed ``name.top_N_levels``
+      (`FrameUtils.CategoricalEnumLimitedEncoder` + `CreateInteractions`).
+    - SortByResponse: levels reordered by weighted mean response ascending,
+      column stays categorical with the permuted domain
+      (`hex/ModelBuilder.java:1650` MeanResponsePerLevelTask + ReorderTask).
+    """
     skip = set(skip or [])
-    enc = (encoding or "AUTO").lower()
-    if enc not in ("eigen", "onehotexplicit", "one_hot_explicit"):
+    enc = _ENC_CANON.get((encoding or "AUTO").lower())
+    if enc is None:
         return None
+    if enc == "SortByResponse" and (response is None
+                                    or response not in fr.names):
+        return None  # unsupervised: nothing to sort by (reference gates the
+        #              scheme on needsResponse() && isSupervised())
     cols = {}
     for name in fr.names:
         v = fr.vec(name)
-        if v.is_categorical() and name not in skip:
-            cols[name] = {"domain": list(v.domain)}
-            if enc == "eigen":
-                cols[name]["loadings"] = _eigen_loadings(v)
+        if not v.is_categorical() or name in skip:
+            continue
+        card = len(v.domain)
+        if enc == "EnumLimited":
+            if card <= max_levels:
+                continue  # reference leaves small columns untouched
+            host = v.to_numpy()
+            ok = ~np.isnan(host)
+            counts = np.bincount(host[ok].astype(np.int64), minlength=card)
+            top = np.sort(np.argsort(-counts, kind="stable")[:max_levels])
+            cols[name] = {"domain": list(v.domain),
+                          "keep": [v.domain[i] for i in top],
+                          "max_levels": int(max_levels)}
+            continue
+        cols[name] = {"domain": list(v.domain)}
+        if enc == "Eigen":
+            cols[name]["loadings"] = _eigen_loadings(v)
+        elif enc == "Binary":
+            # 1 + floor(log2(card-1+1)): enough bits for val = 1+max_code
+            cols[name]["nbits"] = 1 + int(np.floor(np.log2(max(card, 1))))
+        elif enc == "SortByResponse":
+            host = v.to_numpy()
+            y = fr.vec(response).to_numpy().astype(np.float64)
+            w = (fr.vec(weights).to_numpy().astype(np.float64)
+                 if weights and weights in fr.names
+                 else np.ones_like(y))
+            ok = ~(np.isnan(host) | np.isnan(y) | np.isnan(w))
+            c = host[ok].astype(np.int64)
+            wsum = np.bincount(c, weights=w[ok], minlength=card)
+            ysum = np.bincount(c, weights=(w * y)[ok], minlength=card)
+            mean = np.where(wsum > 0, ysum / np.maximum(wsum, 1e-300),
+                            np.inf)  # empty levels sort last
+            order = np.argsort(mean, kind="stable")
+            cols[name] = {"domain": [v.domain[i] for i in order]}
     if not cols:
         return None
-    return {"encoding": "Eigen" if enc == "eigen" else "OneHotExplicit",
-            "columns": cols}
+    return {"encoding": enc, "columns": cols}
 
 
 def apply_encoding_state(fr: Frame, state: dict) -> Frame:
     """Replay a frozen encoding on any frame (train or score time)."""
+    from ..frame.vec import T_CAT
+
     enc = state["encoding"]
     names, vecs = [], []
     for name in fr.names:
@@ -88,6 +152,21 @@ def apply_encoding_state(fr: Frame, state: dict) -> Frame:
             vecs.append(v)
             continue
         host = v.to_numpy()
+        if enc == "EnumLimited":
+            # frozen top-k + catch-all: kept levels keep their rank order,
+            # every other TRAINING-DOMAIN level (and any unseen level) maps
+            # to the trailing "other" code
+            keep = spec["keep"]
+            new_dom = list(keep) + ["other"]
+            lut = {lvl: i for i, lvl in enumerate(keep)}
+            other = len(keep)
+            out = np.full(host.shape, np.nan, dtype=np.float32)
+            ok = ~np.isnan(host)
+            out[ok] = [lut.get((v.domain or [])[int(c)], other)
+                       for c in host[ok]]
+            names.append(f"{name}.top_{spec['max_levels']}_levels")
+            vecs.append(Vec.from_numpy(out, type=T_CAT, domain=new_dom))
+            continue
         # remap this frame's codes onto the TRAINING domain by level name
         lut = {lvl: i for i, lvl in enumerate(spec["domain"])}
         codes = np.full(host.shape, np.nan, dtype=np.float32)
@@ -101,6 +180,22 @@ def apply_encoding_state(fr: Frame, state: dict) -> Frame:
             out[okc] = load[codes[okc].astype(np.int64)]
             names.append(name)
             vecs.append(Vec.from_numpy(out, type=T_NUM))
+        elif enc == "LabelEncoder":
+            names.append(name)
+            vecs.append(Vec.from_numpy(codes, type=T_NUM))
+        elif enc == "SortByResponse":
+            names.append(name)
+            vecs.append(Vec.from_numpy(codes, type=T_CAT,
+                                       domain=list(spec["domain"])))
+        elif enc == "Binary":
+            # val = NA ? 0 : 1+code, little-endian bits across name:k
+            # (`FrameUtils.CategoricalBinaryEncoder.BinaryConverter`)
+            val = np.where(np.isnan(codes), 0,
+                           codes + 1).astype(np.int64)
+            for k in range(int(spec["nbits"])):
+                names.append(f"{name}:{k}")
+                vecs.append(Vec.from_numpy(
+                    ((val >> k) & 1).astype(np.float32)))
         else:  # OneHotExplicit
             for j, lvl in enumerate(spec["domain"]):
                 col = np.where(np.isnan(codes), np.nan,

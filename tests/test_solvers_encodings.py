@@ -220,3 +220,112 @@ class TestEncodingWiredIntoBuilders:
         assert "c.a" in m.output.names
         pf = m.predict(fr)
         assert np.allclose(pf.vec(0).to_numpy(), y, atol=0.1)
+
+
+class TestNewEncodingSchemes:
+    """Binary / LabelEncoder / EnumLimited / SortByResponse — the remaining
+    `hex/Model.Parameters.CategoricalEncodingScheme` members
+    (`water/util/FrameUtils.java` encoder drivers)."""
+
+    def _frame(self, n=400, card=12, seed=5):
+        rng = np.random.default_rng(seed)
+        codes = rng.integers(0, card, n)
+        # skewed frequencies so EnumLimited's top-k is deterministic
+        codes = np.where(rng.random(n) < 0.6, codes % 3, codes)
+        y = (codes % 2).astype(np.float32) \
+            + 0.05 * rng.normal(size=n).astype(np.float32)
+        fr = Frame.from_dict({"y": y})
+        fr.add("c", Vec.from_numpy(codes.astype(np.float32), type=T_CAT,
+                                   domain=[f"L{i}" for i in range(card)]))
+        return fr, codes
+
+    def test_binary_bits(self):
+        fr, codes = self._frame(card=5)
+        out = apply_categorical_encoding(fr, "Binary", skip=["y"])
+        # 5 levels -> val in 1..5 -> 3 bits: c:0..c:2
+        assert [n for n in out.names if n.startswith("c:")] == \
+            ["c:0", "c:1", "c:2"]
+        b0 = out.vec("c:0").to_numpy()
+        b1 = out.vec("c:1").to_numpy()
+        b2 = out.vec("c:2").to_numpy()
+        np.testing.assert_array_equal(
+            b0 + 2 * b1 + 4 * b2, (codes + 1).astype(np.float32))
+
+    def test_binary_na_is_all_zero_bits(self):
+        v = Vec.from_numpy(np.array([0, np.nan, 1], np.float32), type=T_CAT,
+                           domain=["a", "b"])
+        fr = Frame(["c"], [v])
+        out = apply_categorical_encoding(fr, "Binary")
+        assert out.vec("c:0").to_numpy()[1] == 0.0
+
+    def test_label_encoder(self):
+        fr, codes = self._frame()
+        out = apply_categorical_encoding(fr, "LabelEncoder", skip=["y"])
+        assert not out.vec("c").is_categorical()
+        np.testing.assert_array_equal(out.vec("c").to_numpy(),
+                                      codes.astype(np.float32))
+
+    def test_enum_limited_topk_plus_other(self):
+        from h2o_tpu.utils.linalg import (apply_encoding_state,
+                                          build_encoding_state)
+
+        fr, codes = self._frame(card=12)
+        state = build_encoding_state(fr, "EnumLimited", skip=["y"],
+                                     max_levels=3)
+        out = apply_encoding_state(fr, state)
+        name = "c.top_3_levels"
+        assert name in out.names
+        v = out.vec(name)
+        assert v.is_categorical() and len(v.domain) == 4
+        assert v.domain[-1] == "other"
+        # the kept levels are the 3 most frequent (0,1,2 by construction)
+        assert set(v.domain[:3]) == {"L0", "L1", "L2"}
+        enc = v.to_numpy()
+        assert (enc[codes >= 3] == 3).all()
+
+    def test_enum_limited_leaves_small_columns(self):
+        from h2o_tpu.utils.linalg import build_encoding_state
+
+        fr, _ = self._frame(card=12)
+        assert build_encoding_state(fr, "EnumLimited", skip=["y"],
+                                    max_levels=20) is None
+
+    def test_sort_by_response_orders_levels(self):
+        from h2o_tpu.utils.linalg import (apply_encoding_state,
+                                          build_encoding_state)
+
+        fr, codes = self._frame()
+        state = build_encoding_state(fr, "SortByResponse", skip=["y"],
+                                     response="y")
+        out = apply_encoding_state(fr, state)
+        v = out.vec("c")
+        assert v.is_categorical()
+        # mean response by NEW code must be nondecreasing
+        enc = v.to_numpy().astype(np.int64)
+        y = fr.vec("y").to_numpy()
+        means = [y[enc == k].mean() for k in range(len(v.domain))
+                 if (enc == k).any()]
+        assert all(a <= b + 1e-9 for a, b in zip(means, means[1:]))
+
+    def test_models_train_under_each_scheme(self):
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+
+        fr, _ = self._frame()
+        for scheme in ("Binary", "LabelEncoder", "EnumLimited",
+                       "SortByResponse"):
+            m = GBM(GBMParameters(
+                training_frame=fr, response_column="y", ntrees=5,
+                max_depth=3, seed=1, categorical_encoding=scheme,
+                max_categorical_levels=4)).train_model()
+            assert m.output.encoding_state["encoding"] == scheme
+            preds = m.predict(fr)
+            assert np.isfinite(preds.vec(0).to_numpy()).all(), scheme
+            var_y = fr.vec("y").sigma() ** 2
+            assert m.output.training_metrics.mse < var_y, scheme
+
+    def test_glm_trains_under_binary(self):
+        fr, _ = self._frame()
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              categorical_encoding="Binary")).train_model()
+        assert m.output.training_metrics.r2 > 0.2
