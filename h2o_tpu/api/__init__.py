@@ -39,5 +39,11 @@ from .client import H2OAutoML, H2OGridSearch, load_grid, save_grid
 from .client import (create_frame, download_csv, insert_missing_values,
                      log_and_echo, remove_all, split_frame_rest)
 from .server import H2OServer
+from . import explanation
+from .explanation import (explain, explain_row, varimp_heatmap,
+                          model_correlation_heatmap, pd_multi_plot, varimp,
+                          model_correlation)
+
+explanation.register_explain_methods()
 
 __all__ = [n for n in dir() if not n.startswith("_")]

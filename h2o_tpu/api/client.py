@@ -1679,14 +1679,27 @@ class H2OModelClient:
         return vi
 
     def partial_plot(self, frame: H2OFrame, cols=None, nbins: int = 20,
-                     plot: bool = False):
-        """Partial dependence tables (h2o-py `partial_plot` data surface)."""
+                     plot: bool = False, row_index: int = -1, targets=None):
+        """Partial dependence tables (h2o-py `partial_plot` data surface);
+        ``row_index >= 0`` returns the row's ICE curve instead."""
         params = {"model_id": self.model_id, "frame_id": frame.frame_id,
-                  "nbins": nbins}
+                  "nbins": nbins, "row_index": row_index}
         if cols:
             params["cols"] = ",".join(cols)
+        if targets:
+            params["targets"] = ",".join(
+                [targets] if isinstance(targets, str) else list(targets))
         j = connection().request("POST", "/3/PartialDependence", params=params)
         return j["partial_dependence_data"]
+
+    def scoring_history(self, use_pandas: bool = True):
+        """The model's scoring-history table (`model.scoring_history()`)."""
+        sh = ((self._schema or {}).get("output") or {}).get("scoring_history")
+        if sh and use_pandas:
+            import pandas as pd
+
+            return pd.DataFrame(sh)
+        return sh
 
     def permutation_importance(self, frame: H2OFrame, metric: str = "AUTO",
                                n_repeats: int = 1, seed: int = -1):

@@ -1,0 +1,1045 @@
+"""Client explanation module — the `h2o-py/h2o/explanation/_explain.py`
+surface (3,614 LoC in the reference) rebuilt on this client.
+
+Public registry (matching `h2o/explanation/__init__.py` `__all__` plus the
+per-model methods `register_explain_methods` installs):
+
+- ``explain(models, frame, ...)`` / ``explain_row(models, frame, row_index)``
+  — orchestrators returning an :class:`H2OExplanation` ordered dict of
+  headers, descriptions, figures and tables (`_explain.py:3080,3364`).
+- ``shap_summary_plot`` / ``shap_explain_row_plot`` — TreeSHAP beeswarm and
+  per-row contribution bars off ``predict_contributions``
+  (`_explain.py:616,765`).
+- ``pd_plot`` / ``ice_plot`` / ``pd_multi_plot`` — partial dependence and
+  individual conditional expectation off the `/3/PartialDependence` route
+  (row_index sweeps are server-side, one predict per curve)
+  (`_explain.py:1411,1751,1485`).
+- ``varimp`` / ``varimp_heatmap`` — consolidated variable-importance matrix
+  and its clustered heatmap (`_explain.py:2171,2095`).
+- ``model_correlation`` / ``model_correlation_heatmap`` — prediction
+  correlation across models (`_explain.py:2315,2222`).
+- ``residual_analysis_plot`` — fitted vs residual with zero line
+  (`_explain.py:2364`).
+- ``learning_curve_plot`` — metric vs iteration from the scoring history
+  (`_explain.py:2452`).
+
+Figures come back wrapped by ``decorate_plot_result`` so ``res.figure()``
+returns the matplotlib Figure (the `h2o/plot/_plot_result.py` contract the
+explain pyunits assert on). Everything is Agg-safe: matplotlib is imported
+lazily and never requires a display.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# display primitives (`_explain.py:56,78,179`)
+# ---------------------------------------------------------------------------
+class Header:
+    """A rendered section header (repr-friendly for notebooks/terminals)."""
+
+    def __init__(self, content: str, level: int = 1):
+        self.content = content
+        self.level = level
+
+    def __repr__(self):
+        return "\n\n{} {}\n".format("#" * self.level, self.content)
+
+
+class Description:
+    """Canned explanation descriptions (`_explain.py` DESCRIPTIONS map)."""
+
+    DESCRIPTIONS = {
+        "leaderboard": "Leaderboard shows models with their metrics.",
+        "confusion_matrix": "Confusion matrix shows a predicted class vs "
+                            "an actual class.",
+        "residual_analysis": "Residual Analysis plots the fitted values vs "
+                             "residuals on a test dataset.",
+        "learning_curve": "Learning curve plot shows the loss function/metric "
+                          "dependent on number of iterations or trees for "
+                          "tree-based algorithms.",
+        "variable_importance": "The variable importance plot shows the "
+                               "relative importance of the most important "
+                               "variables in the model.",
+        "varimp_heatmap": "Variable importance heatmap shows variable "
+                          "importance across multiple models.",
+        "model_correlation_heatmap": "This plot shows the correlation "
+                                     "between the predictions of the models.",
+        "shap_summary": "SHAP summary plot shows the contribution of the "
+                        "features for each instance (row of data).",
+        "pdp": "Partial dependence plot (PDP) gives a graphical depiction "
+               "of the marginal effect of a variable on the response.",
+        "ice": "An Individual Conditional Expectation (ICE) plot gives a "
+               "graphical depiction of the marginal effect of a variable "
+               "on the response for a single row.",
+        "shap_explain_row": "SHAP explanation shows contribution of "
+                            "features for a given instance.",
+    }
+
+    def __init__(self, for_what: str):
+        self.content = self.DESCRIPTIONS.get(for_what, "")
+
+    def __repr__(self):
+        return self.content
+
+
+class H2OExplanation(OrderedDict):
+    """Ordered container of explanation artifacts (`_explain.py:179`)."""
+
+
+# ---------------------------------------------------------------------------
+# figure wrapping (`h2o/plot/_plot_result.py` contract)
+# ---------------------------------------------------------------------------
+class _MObject(object):
+    pass
+
+
+def decorate_plot_result(res=None, figure=None):
+    """Attach a ``.figure()`` accessor to any result object."""
+
+    class _MTuple(tuple):
+        pass
+
+    class _MList(list):
+        pass
+
+    class _MDict(dict):
+        pass
+
+    class _MStr(str):
+        pass
+
+    if res is None:
+        dec = _MObject()
+    elif isinstance(res, tuple):
+        dec = _MTuple(res)
+    elif isinstance(res, list):
+        dec = _MList(res)
+    elif isinstance(res, dict):
+        dec = _MDict(res)
+    elif isinstance(res, str):
+        dec = _MStr(res)
+    else:
+        dec = res
+    dec.figure = lambda: figure
+    dec._is_decorated_plot_result = True
+    return dec
+
+
+def _plt():
+    """Lazy matplotlib import, headless-safe."""
+    import matplotlib
+
+    if matplotlib.get_backend().lower() not in ("agg", "module://ipykernel"
+                                                ".pylab.backend_inline"):
+        import os
+
+        if not os.environ.get("DISPLAY"):
+            matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def _figure(figsize):
+    """(fig, ax) WITHOUT registering in pyplot's global figure registry —
+    explain() opens a figure per section per model, and registry-held
+    figures are never GC-able (matplotlib's >20-figures warning); canvas
+    attached so fig.savefig works headlessly."""
+    _plt()  # backend selection only
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
+
+    fig = Figure(figsize=figsize)
+    FigureCanvasAgg(fig)
+    return fig, fig.add_subplot(111)
+
+
+# ---------------------------------------------------------------------------
+# model introspection helpers
+# ---------------------------------------------------------------------------
+def _get_algorithm(model, treat_xrt_as_algorithm=False):
+    algo = (getattr(model, "_schema", None) or {}).get("algo", "")
+    return algo or "unknown"
+
+
+def _get_xy(model):
+    """(x, y) the model was trained on: the output feature names minus the
+    special columns (`_explain.py:1913`)."""
+    out = (model._schema or {}).get("output", {})
+    names = list(out.get("names") or [])
+    parms = {}
+    try:
+        parms = model.parms
+    except Exception:
+        pass
+
+    def actual(k):
+        v = (parms.get(k) or {})
+        v = v.get("actual_value") if isinstance(v, dict) else v
+        return v
+
+    y = (model._schema or {}).get("response_column_name") or actual(
+        "response_column")
+    special = {y}
+    for k in ("weights_column", "offset_column", "fold_column"):
+        special.add(actual(k))
+    x = [n for n in names if n not in special]
+    return x, y
+
+
+def _has_varimp(model) -> bool:
+    return bool(((model._schema or {}).get("output") or {})
+                .get("variable_importances"))
+
+
+def _shorten_model_ids(model_ids):
+    """Drop the longest common AutoML suffix chatter while keeping ids
+    unique (`_explain.py:518`)."""
+    import re
+
+    shortened = [re.sub(r"(_AutoML_[\d_]+)", "", mid) for mid in model_ids]
+    if len(set(shortened)) == len(set(model_ids)):
+        return shortened
+    return list(model_ids)
+
+
+def _is_automl(obj) -> bool:
+    return hasattr(obj, "_leaderboard_json") or (
+        type(obj).__name__ == "H2OAutoML")
+
+
+def _model_ids_of(models):
+    """Normalize any supported 'models' argument to a list of model ids."""
+    from . import client as _c
+
+    if _is_automl(models):
+        return [m["name"] for m in models._leaderboard_json["models"]]
+    if isinstance(models, _c.H2OFrame):
+        col = models["model_id"]
+        return [str(v) for v in
+                col.as_data_frame()["model_id"].tolist()]
+    if isinstance(models, (list, tuple)):
+        return [m if isinstance(m, str) else m.model_id for m in models]
+    return [models if isinstance(models, str) else models.model_id]
+
+
+def _get_models(models):
+    """Resolve to live H2OModelClient objects."""
+    from . import client as _c
+
+    return [m if hasattr(m, "_schema") and not isinstance(m, str)
+            else _c.get_model(m) for m in _model_ids_of(models)]
+
+
+def _first_of_family(models):
+    """Keep the best (first-listed) model per algorithm family
+    (`_explain.py:551`)."""
+    seen = set()
+    out = []
+    for m in models:
+        algo = _get_algorithm(m)
+        if algo not in seen:
+            seen.add(algo)
+            out.append(m)
+    return out
+
+
+def _consolidate_varimps(model) -> dict:
+    """Map the model's varimp onto its training features, summing encoded
+    sub-columns (one-hot `col.level`, interactions) back onto base columns
+    and normalizing to percentages (`_explain.py:1944`)."""
+    x, _ = _get_xy(model)
+    vi = model.varimp() or {}
+    variables = list(vi.get("variable") or [])
+    pcts = list(vi.get("percentage") or [])
+    out = {col: 0.0 for col in x}
+    for var, pct in zip(variables, pcts):
+        if var in out:
+            out[var] += float(pct)
+        else:
+            base = var.split(".")[0]
+            if base in out:
+                out[base] += float(pct)
+    tot = sum(out.values())
+    if tot > 0:
+        out = {k: v / tot for k, v in out.items()}
+    return out
+
+
+def _calculate_clustering_indices(matrix: np.ndarray):
+    """Leaf order of an average-linkage clustering over rows — numpy-only
+    (the reference implements its own, `_explain.py:2060`)."""
+    n = matrix.shape[0]
+    if n <= 2:
+        return list(range(n))
+    clusters = [[i] for i in range(n)]
+
+    def dist(a, b):
+        da = matrix[a].mean(axis=0)
+        db = matrix[b].mean(axis=0)
+        return float(np.linalg.norm(da - db))
+
+    while len(clusters) > 1:
+        best = (0, 1, float("inf"))
+        for i in range(len(clusters)):
+            for j in range(i + 1, len(clusters)):
+                d = dist(clusters[i], clusters[j])
+                if d < best[2]:
+                    best = (i, j, d)
+        i, j, _d = best
+        clusters[i] = clusters[i] + clusters[j]
+        del clusters[j]
+    return clusters[0]
+
+
+def _is_classification(model) -> bool:
+    cat = ((model._schema or {}).get("output") or {}).get("model_category")
+    return cat in ("Binomial", "Multinomial")
+
+
+def _is_binomial(model) -> bool:
+    return ((model._schema or {}).get("output") or {}).get(
+        "model_category") == "Binomial"
+
+
+def _is_tree_model(model) -> bool:
+    return _get_algorithm(model) in ("gbm", "drf", "xrt", "xgboost",
+                                     "isolationforest")
+
+
+def _frame_df(frame):
+    return frame.as_data_frame()
+
+
+def _as_float(col):
+    """Pandas column -> float ndarray; non-numeric (string/categorical,
+    including Arrow-backed dtypes) fall back to sorted-level codes."""
+    try:
+        return col.to_numpy(dtype=float)
+    except (ValueError, TypeError):
+        codes = {v: i for i, v in enumerate(sorted(set(col.dropna())))}
+        return col.map(codes).to_numpy(dtype=float)
+
+
+# ---------------------------------------------------------------------------
+# varimp + heatmaps
+# ---------------------------------------------------------------------------
+def varimp(models, num_of_features=20, cluster=True, use_pandas=True):
+    """The varimp-heatmap matrix (`_explain.py:2171`): models x features of
+    consolidated percentage importances."""
+    models = [m for m in _get_models(models) if _has_varimp(m)]
+    if not models:
+        raise RuntimeError("No model with variable importance")
+    varimps = [_consolidate_varimps(m) for m in models]
+    # feature axis = ordered union over models (models may use different
+    # predictor subsets)
+    x = []
+    for m in models:
+        for col in _get_xy(m)[0]:
+            if col not in x:
+                x.append(col)
+    M = np.array([[vi.get(col, 0.0) for col in x] for vi in varimps])
+    if num_of_features is not None and M.shape[1] > num_of_features:
+        # argsort twice: ranks, not a permutation (a bare argsort keeps
+        # arbitrary features)
+        ranks = np.amax(M, axis=0).argsort().argsort()
+        mask = (ranks.max() - ranks) < num_of_features
+        M = M[:, mask]
+        x = [c for c, keep in zip(x, mask) if keep]
+    model_ids = _shorten_model_ids([m.model_id for m in models])
+    if cluster and len(models) > 2:
+        order = _calculate_clustering_indices(M.T)
+        x = [x[i] for i in order]
+        M = M[:, order]
+        order = _calculate_clustering_indices(M)
+        model_ids = [model_ids[i] for i in order]
+        M = M[order, :]
+    M = M.T  # features x models, like the reference's heatmap layout
+    if use_pandas:
+        import pandas as pd
+
+        return pd.DataFrame(M, columns=model_ids, index=x)
+    return M, model_ids, x
+
+
+def varimp_heatmap(models, top_n=None, num_of_features=20, figsize=(16, 9),
+                   cluster=True, colormap="RdYlBu_r", save_plot_path=None):
+    """Clustered variable-importance heatmap across models
+    (`_explain.py:2095`)."""
+    plt = _plt()
+    M, model_ids, x = varimp(models, num_of_features=num_of_features,
+                             cluster=cluster, use_pandas=False)
+    fig, ax = _figure(figsize)
+    im = ax.imshow(M, aspect="auto", cmap=colormap, vmin=0, vmax=1)
+    ax.set_xticks(range(len(model_ids)))
+    ax.set_xticklabels(model_ids, rotation=45, ha="right")
+    ax.set_yticks(range(len(x)))
+    ax.set_yticklabels(x)
+    fig.colorbar(im, ax=ax, label="Variable Importance (percentage)")
+    ax.set_title("Variable Importance Heatmap")
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+def _prediction_column(model, frame):
+    """The raw per-row 'predict' column (labels stay labels; the shared
+    encoding happens across models in model_correlation)."""
+    return _frame_df(model.predict(frame))["predict"]
+
+
+def model_correlation(models, frame, top_n=None, use_pandas=True):
+    """Pairwise correlation of model predictions on ``frame``
+    (`_explain.py:2315`). Classifier labels encode through ONE shared
+    level map across all models — per-model sorted-set codes would compress
+    differently when a model never predicts some label."""
+    models = _get_models(models)
+    model_ids = _shorten_model_ids([m.model_id for m in models])
+    cols = [_prediction_column(m, frame) for m in models]
+    numeric = []
+    try:
+        numeric = [c.to_numpy(dtype=float) for c in cols]
+    except (ValueError, TypeError):
+        levels = sorted({v for c in cols for v in c.dropna()},
+                        key=str)
+        codes = {v: i for i, v in enumerate(levels)}
+        numeric = [c.map(codes).to_numpy(dtype=float) for c in cols]
+    preds = np.stack(numeric)
+    C = np.corrcoef(preds)
+    if use_pandas:
+        import pandas as pd
+
+        return pd.DataFrame(C, columns=model_ids, index=model_ids)
+    return C, model_ids
+
+
+def model_correlation_heatmap(models, frame, top_n=None, figsize=(13, 13),
+                              cluster_models=True, triangular=True,
+                              colormap="RdYlBu_r", save_plot_path=None):
+    """Prediction-correlation heatmap (`_explain.py:2222`)."""
+    plt = _plt()
+    C, model_ids = model_correlation(models, frame, use_pandas=False)
+    if cluster_models and len(model_ids) > 2:
+        order = _calculate_clustering_indices(C)
+        C = C[np.ix_(order, order)]
+        model_ids = [model_ids[i] for i in order]
+    D = C.copy()
+    if triangular:
+        D[np.triu_indices_from(D, k=1)] = np.nan
+    fig, ax = _figure(figsize)
+    im = ax.imshow(D, cmap=colormap, vmin=-1, vmax=1)
+    ax.set_xticks(range(len(model_ids)))
+    ax.set_xticklabels(model_ids, rotation=45, ha="right")
+    ax.set_yticks(range(len(model_ids)))
+    ax.set_yticklabels(model_ids)
+    fig.colorbar(im, ax=ax, label="Correlation")
+    ax.set_title("Model Correlation")
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+# ---------------------------------------------------------------------------
+# SHAP plots
+# ---------------------------------------------------------------------------
+def _contributions(model, frame, samples=None):
+    contrib = model.predict_contributions(frame)
+    df = _frame_df(contrib)
+    X = _frame_df(frame)
+    if samples is not None and len(df) > samples:
+        idx = np.random.default_rng(42).choice(len(df), samples,
+                                               replace=False)
+        df = df.iloc[idx].reset_index(drop=True)
+        X = X.iloc[idx].reset_index(drop=True)
+    return df, X
+
+
+def shap_summary_plot(model, frame, columns=None, top_n_features=20,
+                      samples=1000, colorize_factors=True, alpha=1,
+                      colormap=None, figsize=(12, 12), jitter=0.35,
+                      save_plot_path=None):
+    """TreeSHAP beeswarm: per-feature contribution scatter colored by the
+    normalized feature value (`_explain.py:616`)."""
+    plt = _plt()
+    df, X = _contributions(model, frame, samples)
+    phi_cols = [c for c in df.columns if c != "BiasTerm"]
+    if columns is not None:
+        phi_cols = [c for c in phi_cols if c in set(columns)]
+    order = np.argsort([-float(np.abs(df[c]).mean()) for c in phi_cols])
+    phi_cols = [phi_cols[i] for i in order[:top_n_features]]
+    phi_cols = phi_cols[::-1]  # most important on top
+    rng = np.random.default_rng(7)
+    fig, ax = _figure(figsize)
+    cmap = plt.get_cmap(colormap or "RdYlBu_r")
+    for yi, col in enumerate(phi_cols):
+        phi = df[col].to_numpy(dtype=float)
+        if col in X.columns:
+            vals = _as_float(X[col])
+            lo, hi = np.nanmin(vals), np.nanmax(vals)
+            norm = (vals - lo) / (hi - lo) if hi > lo else np.full_like(
+                vals, 0.5)
+            colors = cmap(np.nan_to_num(norm, nan=0.5))
+        else:
+            colors = None
+        ys = yi + rng.uniform(-jitter, jitter, len(phi))
+        ax.scatter(phi, ys, c=colors, s=8, alpha=alpha)
+    ax.set_yticks(range(len(phi_cols)))
+    ax.set_yticklabels(phi_cols)
+    ax.axvline(0, color="grey", lw=0.8)
+    ax.set_xlabel("SHAP value (contribution)")
+    ax.set_title("SHAP Summary plot for \"{}\"".format(model.model_id))
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+def shap_explain_row_plot(model, frame, row_index, columns=None,
+                          top_n_features=10, figsize=(16, 9),
+                          plot_type="barplot", contribution_type="both",
+                          save_plot_path=None):
+    """Per-row contribution bar plot (`_explain.py:765`)."""
+    plt = _plt()
+    row = frame[int(row_index), :]
+    df, _X = _contributions(model, row)
+    phi = {c: float(df[c].iloc[0]) for c in df.columns if c != "BiasTerm"}
+    if columns is not None:
+        phi = {c: v for c, v in phi.items() if c in set(columns)}
+    items = sorted(phi.items(), key=lambda kv: abs(kv[1]),
+                   reverse=True)[:top_n_features]
+    if contribution_type == "positive":
+        items = [kv for kv in items if kv[1] > 0]
+    elif contribution_type == "negative":
+        items = [kv for kv in items if kv[1] < 0]
+    items = items[::-1]
+    fig, ax = _figure(figsize)
+    names = [k for k, _ in items]
+    vals = [v for _, v in items]
+    ax.barh(range(len(items)), vals,
+            color=["#b3ddf2" if v >= 0 else "#f5c8c8" for v in vals])
+    ax.set_yticks(range(len(items)))
+    ax.set_yticklabels(names)
+    ax.axvline(0, color="grey", lw=0.8)
+    ax.set_xlabel("SHAP contribution")
+    ax.set_title("SHAP explanation for \"{}\" on row {}".format(
+        model.model_id, row_index))
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+# ---------------------------------------------------------------------------
+# PD / ICE
+# ---------------------------------------------------------------------------
+def _pd_table(model, frame, column, nbins=20, row_index=-1, target=None):
+    """(values, means, stddevs) off the /3/PartialDependence route."""
+    tables = model.partial_plot(frame, cols=[column], nbins=nbins,
+                                row_index=row_index, targets=target)
+    t = tables[0]
+    cols = {c["name"]: i for i, c in enumerate(t["columns"])}
+    data = t["data"]
+    values = data[cols[column]]
+    means = np.array(data[cols["mean_response"]], dtype=float)
+    stds = np.array(data[cols["stddev_response"]], dtype=float)
+    return values, means, stds
+
+
+def _is_factor(frame, column) -> bool:
+    return frame.types.get(column) == "enum"
+
+
+def pd_plot(model, frame, column, row_index=None, target=None,
+            max_levels=30, figsize=(16, 9), colormap="Dark2", nbins=100,
+            show_rug=True, save_plot_path=None, binary_response_scale=None,
+            grouping_column=None, output_graphing_data=False,
+            grouping_variables=None, **_kw):
+    """Partial dependence of one column; with ``row_index`` the row's ICE
+    curve is drawn alongside (`_explain.py:1411`)."""
+    plt = _plt()
+    if frame.types.get(column) == "string":
+        raise ValueError("String columns are not supported!")
+    factor = _is_factor(frame, column)
+    eff_bins = nbins if not factor else max_levels
+    fig, ax = _figure(figsize)
+    vals, means, stds = _pd_table(model, frame, column, nbins=eff_bins,
+                                  target=target)
+    xs = np.arange(len(vals)) if factor else np.array(vals, dtype=float)
+    if factor:
+        ax.errorbar(xs, means, yerr=stds, fmt="o", capsize=3,
+                    label="Partial dependence")
+        ax.set_xticks(xs)
+        ax.set_xticklabels(vals, rotation=45, ha="right")
+    else:
+        ax.plot(xs, means, label="Partial dependence")
+        ax.fill_between(xs, means - stds, means + stds, alpha=0.2)
+    if row_index is not None:
+        v2, m2, _s2 = _pd_table(model, frame, column, nbins=eff_bins,
+                                row_index=int(row_index), target=target)
+        x2 = np.arange(len(v2)) if factor else np.array(v2, dtype=float)
+        ax.plot(x2, m2, "--", color="C1",
+                label="ICE (row {})".format(row_index))
+    ax.set_xlabel(column)
+    ax.set_ylabel("Mean response")
+    ax.set_title("Partial Dependence plot for \"{}\"{}".format(
+        column, " (row {})".format(row_index) if row_index is not None
+        else ""))
+    ax.legend()
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+def ice_plot(model, frame, column, target=None, max_levels=30,
+             figsize=(16, 9), colormap="plasma", save_plot_path=None,
+             show_pdp=True, binary_response_scale=None, centered=False,
+             grouping_column=None, output_graphing_data=False, nbins=100,
+             show_rug=True, **_kw):
+    """ICE curves at the response deciles + the PDP mean
+    (`_explain.py:1751`: one curve per decile of the response)."""
+    plt = _plt()
+    if frame.types.get(column) == "string":
+        raise ValueError("String columns are not supported!")
+    factor = _is_factor(frame, column)
+    eff_bins = nbins if not factor else max_levels
+    _x, y = _get_xy(model)
+    df = _frame_df(frame)
+    fig, ax = _figure(figsize)
+    cmap = plt.get_cmap(colormap)
+    # rows at the response deciles (the reference picks percentile rows)
+    if y in df.columns and df[y].dtype != object:
+        order = np.argsort(df[y].to_numpy())
+    else:
+        order = np.arange(len(df))
+    deciles = [int(q * (len(order) - 1) / 10) for q in range(11)]
+    rows = [int(order[i]) for i in deciles]
+    ice0 = None
+    for qi, ri in enumerate(rows):
+        vals, means, _ = _pd_table(model, frame, column, nbins=eff_bins,
+                                   row_index=ri, target=target)
+        xs = np.arange(len(vals)) if factor else np.array(vals, dtype=float)
+        curve = means - means[0] if centered else means
+        if ice0 is None:
+            ice0 = (xs, vals)
+        ax.plot(xs, curve, color=cmap(qi / 10.0), lw=1,
+                label="{}th percentile".format(qi * 10))
+    if show_pdp:
+        _v, pmeans, _s = _pd_table(model, frame, column, nbins=eff_bins,
+                                   target=target)
+        xs = ice0[0]
+        ax.plot(xs, pmeans - pmeans[0] if centered else pmeans,
+                color="k", lw=3, linestyle="dotted",
+                label="Partial dependence")
+    if factor:
+        ax.set_xticks(ice0[0])
+        ax.set_xticklabels(ice0[1], rotation=45, ha="right")
+    ax.set_xlabel(column)
+    ax.set_ylabel("Response")
+    ax.set_title("ICE plot for \"{}\"".format(column))
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+def pd_multi_plot(models, frame, column, best_of_family=True, row_index=None,
+                  target=None, max_levels=30, figsize=(16, 9),
+                  colormap="Dark2", markers=["o", "v", "s", "P", "*", "D",
+                                             "X", "^", "<", ">", "."],
+                  nbins=100, show_rug=True, save_plot_path=None, **_kw):
+    """One PD curve per model on a shared axis (`_explain.py:1485`)."""
+    plt = _plt()
+    if frame.types.get(column) == "string":
+        raise ValueError("String columns are not supported!")
+    models = _get_models(models)
+    if best_of_family:
+        models = _first_of_family(models)
+    factor = _is_factor(frame, column)
+    eff_bins = nbins if not factor else max_levels
+    model_ids = _shorten_model_ids([m.model_id for m in models])
+    fig, ax = _figure(figsize)
+    cmap = plt.get_cmap(colormap)
+    ticks = None
+    for i, (m, mid) in enumerate(zip(models, model_ids)):
+        vals, means, _ = _pd_table(
+            m, frame, column, nbins=eff_bins,
+            row_index=-1 if row_index is None else int(row_index),
+            target=target)
+        xs = np.arange(len(vals)) if factor else np.array(vals, dtype=float)
+        ticks = (xs, vals)
+        marker = markers[i % len(markers)]
+        ax.plot(xs, means, label=mid, color=cmap(i % 8),
+                marker=marker if factor else None)
+    if factor and ticks is not None:
+        ax.set_xticks(ticks[0])
+        ax.set_xticklabels(ticks[1], rotation=45, ha="right")
+    ax.set_xlabel(column)
+    ax.set_ylabel("Mean response")
+    ax.set_title("Partial dependence plot for \"{}\"".format(column))
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+# ---------------------------------------------------------------------------
+# residuals + learning curve
+# ---------------------------------------------------------------------------
+def residual_analysis_plot(model, frame, figsize=(16, 9),
+                           save_plot_path=None):
+    """Fitted vs residual scatter with the zero line (`_explain.py:2364`)."""
+    plt = _plt()
+    _x, y = _get_xy(model)
+    pred = _frame_df(model.predict(frame))["predict"].to_numpy(dtype=float)
+    actual = _frame_df(frame[y]).iloc[:, 0].to_numpy(dtype=float)
+    resid = actual - pred
+    fig, ax = _figure(figsize)
+    ax.scatter(pred, resid, s=8, alpha=0.6)
+    ax.axhline(0, color="k", lw=1)
+    ax.set_xlabel("Fitted")
+    ax.set_ylabel("Residuals")
+    ax.set_title("Residual Analysis for \"{}\"".format(model.model_id))
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+_METRIC_AUTO = {
+    "Binomial": "logloss", "Multinomial": "logloss",
+    "Regression": "deviance",
+}
+
+
+def learning_curve_plot(model, metric="AUTO", cv_ribbon=None, cv_lines=None,
+                        figsize=(16, 9), colormap=None, save_plot_path=None):
+    """Metric vs iteration/tree count from the scoring history
+    (`_explain.py:2452`)."""
+    plt = _plt()
+    sh = model.scoring_history(use_pandas=False)
+    if not sh:
+        raise ValueError(
+            "Model {} has no scoring history".format(model.model_id))
+    cat = ((model._schema or {}).get("output") or {}).get("model_category")
+    metric = (metric or "AUTO").lower()
+    if metric == "auto":
+        metric = _METRIC_AUTO.get(cat, "rmse")
+    # iteration axis: trees for tree models, iterations/epochs otherwise
+    for xkey in ("number_of_trees", "iterations", "iteration", "epochs"):
+        if xkey in sh and any(v is not None for v in sh[xkey]):
+            break
+    else:
+        xkey = None
+    n = max(len(v) for v in sh.values())
+    xs = (np.array([v if v is not None else np.nan for v in sh[xkey]],
+                   dtype=float) if xkey else np.arange(n, dtype=float))
+    fig, ax = _figure(figsize)
+    plotted = False
+    # GLM/GAM histories store the metric unprefixed ('deviance' per lambda
+    # step); treat a bare column as the training series
+    if "training_{}".format(metric) not in sh and metric in sh:
+        sh = dict(sh)
+        sh["training_{}".format(metric)] = sh[metric]
+    for prefix, style in (("training", "-"), ("validation", "--")):
+        key = "{}_{}".format(prefix, metric)
+        if key not in sh:
+            continue
+        ys = np.array([v if v is not None else np.nan for v in sh[key]],
+                      dtype=float)
+        if np.all(np.isnan(ys)):
+            continue
+        ax.plot(xs[:len(ys)], ys, style, marker="o", markersize=3,
+                label="Training" if prefix == "training" else "Validation")
+        plotted = True
+    if not plotted:
+        raise ValueError(
+            "Metric '{}' is not present in the scoring history of {} "
+            "(columns: {})".format(metric, model.model_id,
+                                   sorted(sh.keys())))
+    ax.set_xlabel(xkey or "iteration")
+    ax.set_ylabel(metric)
+    ax.set_title("Learning Curve for \"{}\"".format(model.model_id))
+    ax.legend()
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(figure=fig)
+
+
+# ---------------------------------------------------------------------------
+# orchestrators
+# ---------------------------------------------------------------------------
+def _display(obj):
+    return obj
+
+
+_ALL_EXPLANATIONS = ["leaderboard", "confusion_matrix", "residual_analysis",
+                     "learning_curve", "varimp", "varimp_heatmap",
+                     "model_correlation_heatmap", "shap_summary", "pdp",
+                     "ice", "shap_explain_row"]
+
+
+def _select(include, exclude):
+    if include in (None, "ALL", ["ALL"]):
+        chosen = list(_ALL_EXPLANATIONS)
+    else:
+        chosen = [include] if isinstance(include, str) else list(include)
+    for e in (exclude or []):
+        if e in chosen:
+            chosen.remove(e)
+    return chosen
+
+
+def _varimp_plot_single(model, figsize, num_of_features=10):
+    plt = _plt()
+    vi = model.varimp() or {}
+    variables = list(vi.get("variable") or [])[:num_of_features][::-1]
+    scaled = list(vi.get("scaled_importance") or [])[:num_of_features][::-1]
+    fig, ax = _figure(figsize)
+    ax.barh(range(len(variables)), scaled, color="#1F77B4")
+    ax.set_yticks(range(len(variables)))
+    ax.set_yticklabels(variables)
+    ax.set_title("Variable Importance for \"{}\"".format(model.model_id))
+    ax.set_xlabel("Scaled importance")
+    fig.tight_layout()
+    return decorate_plot_result(figure=fig)
+
+
+def _top_features(models, n):
+    """Union of each model's top-n varimp features, ranked."""
+    scores: dict = {}
+    for m in models:
+        if not _has_varimp(m):
+            continue
+        for col, pct in _consolidate_varimps(m).items():
+            scores[col] = scores.get(col, 0.0) + pct
+    return [c for c, _v in sorted(scores.items(), key=lambda kv: -kv[1])][:n]
+
+
+def explain(models, frame, columns=None, top_n_features=5,
+            include_explanations="ALL", exclude_explanations=[],
+            plot_overrides={}, figsize=(16, 9), render=True,
+            qualitative_colormap="Dark2", sequential_colormap="RdYlBu_r",
+            background_frame=None):
+    """Generate the standard model-explanation dashboard
+    (`_explain.py:3080`): global explanations for one model or a set of
+    models (AutoML / leaderboard slice / list)."""
+    models_list = _get_models(models)
+    multiple = len(models_list) > 1
+    leader = models_list[0]
+    classification = _is_classification(leader)
+    chosen = _select(include_explanations, exclude_explanations)
+    if columns is not None:
+        pd_cols = list(columns)
+    else:
+        pd_cols = _top_features(models_list, top_n_features)
+        if not pd_cols:
+            pd_cols = _get_xy(leader)[0][:top_n_features]
+    result = H2OExplanation()
+    if multiple and "leaderboard" in chosen and _is_automl(models):
+        result["leaderboard"] = H2OExplanation()
+        result["leaderboard"]["header"] = _display(Header("Leaderboard"))
+        result["leaderboard"]["description"] = _display(
+            Description("leaderboard"))
+        result["leaderboard"]["data"] = _display(models.leaderboard)
+    if classification and "confusion_matrix" in chosen:
+        result["confusion_matrix"] = H2OExplanation()
+        result["confusion_matrix"]["header"] = _display(
+            Header("Confusion Matrix"))
+        result["confusion_matrix"]["description"] = _display(
+            Description("confusion_matrix"))
+        result["confusion_matrix"]["subexplanations"] = sub = H2OExplanation()
+        for m in (models_list if not multiple else
+                  _first_of_family(models_list)):
+            try:
+                sub[m.model_id] = _display(m.confusion_matrix())
+            except Exception:
+                pass
+    if not classification and "residual_analysis" in chosen and not multiple:
+        result["residual_analysis"] = H2OExplanation()
+        result["residual_analysis"]["header"] = _display(
+            Header("Residual Analysis"))
+        result["residual_analysis"]["description"] = _display(
+            Description("residual_analysis"))
+        result["residual_analysis"]["plots"] = _display(
+            residual_analysis_plot(leader, frame, figsize=figsize))
+    if "learning_curve" in chosen:
+        result["learning_curve"] = H2OExplanation()
+        result["learning_curve"]["header"] = _display(
+            Header("Learning Curve Plot"))
+        result["learning_curve"]["description"] = _display(
+            Description("learning_curve"))
+        result["learning_curve"]["plots"] = plots = H2OExplanation()
+        for m in models_list:
+            try:
+                plots[m.model_id] = _display(
+                    learning_curve_plot(m, figsize=figsize))
+            except Exception:
+                pass
+    if multiple and "varimp_heatmap" in chosen:
+        try:
+            result["varimp_heatmap"] = H2OExplanation()
+            result["varimp_heatmap"]["header"] = _display(
+                Header("Variable Importance Heatmap"))
+            result["varimp_heatmap"]["description"] = _display(
+                Description("varimp_heatmap"))
+            result["varimp_heatmap"]["plots"] = _display(varimp_heatmap(
+                models_list, figsize=figsize,
+                colormap=sequential_colormap))
+        except RuntimeError:
+            del result["varimp_heatmap"]
+    if multiple and "model_correlation_heatmap" in chosen:
+        result["model_correlation_heatmap"] = H2OExplanation()
+        result["model_correlation_heatmap"]["header"] = _display(
+            Header("Model Correlation"))
+        result["model_correlation_heatmap"]["description"] = _display(
+            Description("model_correlation_heatmap"))
+        result["model_correlation_heatmap"]["plots"] = _display(
+            model_correlation_heatmap(
+                models_list, frame, figsize=figsize,
+                colormap=sequential_colormap))
+    if not multiple and "varimp" in chosen and _has_varimp(leader):
+        result["varimp"] = H2OExplanation()
+        result["varimp"]["header"] = _display(
+            Header("Variable Importance"))
+        result["varimp"]["description"] = _display(
+            Description("variable_importance"))
+        result["varimp"]["plots"] = _display(
+            _varimp_plot_single(leader, figsize))
+    if "shap_summary" in chosen and not multiple and _is_tree_model(leader):
+        try:
+            result["shap_summary"] = H2OExplanation()
+            result["shap_summary"]["header"] = _display(
+                Header("SHAP Summary"))
+            result["shap_summary"]["description"] = _display(
+                Description("shap_summary"))
+            result["shap_summary"]["plots"] = _display(shap_summary_plot(
+                leader, frame, **plot_overrides.get("shap_summary_plot",
+                                                    {})))
+        except Exception:
+            result.pop("shap_summary", None)
+    if "pdp" in chosen:
+        result["pdp"] = H2OExplanation()
+        result["pdp"]["header"] = _display(
+            Header("Partial Dependence Plots"))
+        result["pdp"]["description"] = _display(Description("pdp"))
+        result["pdp"]["plots"] = plots = H2OExplanation()
+        for col in pd_cols:
+            try:
+                if multiple:
+                    plots[col] = _display(pd_multi_plot(
+                        models_list, frame, col, figsize=figsize,
+                        colormap=qualitative_colormap))
+                else:
+                    plots[col] = _display(pd_plot(
+                        leader, frame, col, figsize=figsize,
+                        colormap=qualitative_colormap))
+            except ValueError:
+                pass
+    if "ice" in chosen and not multiple:
+        result["ice"] = H2OExplanation()
+        result["ice"]["header"] = _display(Header("ICE Plots"))
+        result["ice"]["description"] = _display(Description("ice"))
+        result["ice"]["plots"] = plots = H2OExplanation()
+        for col in pd_cols:
+            try:
+                plots[col] = _display(ice_plot(leader, frame, col,
+                                               figsize=figsize))
+            except ValueError:
+                pass
+    return result
+
+
+def explain_row(models, frame, row_index, columns=None, top_n_features=5,
+                include_explanations="ALL", exclude_explanations=[],
+                plot_overrides={}, qualitative_colormap="Dark2",
+                figsize=(16, 9), render=True, background_frame=None):
+    """Generate per-row explanations (`_explain.py:3364`): SHAP row plot +
+    per-column ICE curves."""
+    models_list = _get_models(models)
+    multiple = len(models_list) > 1
+    leader = models_list[0]
+    chosen = _select(include_explanations, exclude_explanations)
+    if columns is not None:
+        pd_cols = list(columns)
+    else:
+        pd_cols = _top_features(models_list, top_n_features)
+        if not pd_cols:
+            pd_cols = _get_xy(leader)[0][:top_n_features]
+    result = H2OExplanation()
+    if "shap_explain_row" in chosen and not multiple \
+            and _is_tree_model(leader):
+        try:
+            result["shap_explain_row"] = H2OExplanation()
+            result["shap_explain_row"]["header"] = _display(
+                Header("SHAP Explanation"))
+            result["shap_explain_row"]["description"] = _display(
+                Description("shap_explain_row"))
+            result["shap_explain_row"]["plots"] = _display(
+                shap_explain_row_plot(leader, frame, row_index,
+                                      figsize=figsize))
+        except Exception:
+            result.pop("shap_explain_row", None)
+    result["ice"] = H2OExplanation()
+    result["ice"]["header"] = _display(
+        Header("Individual Conditional Expectation"))
+    result["ice"]["description"] = _display(Description("ice"))
+    result["ice"]["plots"] = plots = H2OExplanation()
+    for col in pd_cols:
+        try:
+            if multiple:
+                plots[col] = _display(pd_multi_plot(
+                    models_list, frame, col, row_index=row_index,
+                    figsize=figsize, colormap=qualitative_colormap))
+            else:
+                plots[col] = _display(pd_plot(
+                    leader, frame, col, row_index=row_index,
+                    figsize=figsize, colormap=qualitative_colormap))
+        except ValueError:
+            pass
+    return result
+
+
+# ---------------------------------------------------------------------------
+# registration (`h2o/explanation/__init__.py` register_explain_methods)
+# ---------------------------------------------------------------------------
+def register_explain_methods():
+    """Install the explanation verbs on the client classes the way the
+    reference installs them on ModelBase / H2OAutoMLBaseMixin."""
+    from . import client as _c
+
+    _c.H2OModelClient.explain = explain
+    _c.H2OModelClient.explain_row = explain_row
+    _c.H2OModelClient.shap_summary_plot = shap_summary_plot
+    _c.H2OModelClient.shap_explain_row_plot = shap_explain_row_plot
+    _c.H2OModelClient.pd_plot = pd_plot
+    _c.H2OModelClient.ice_plot = ice_plot
+    _c.H2OModelClient.residual_analysis_plot = residual_analysis_plot
+    _c.H2OModelClient.learning_curve_plot = learning_curve_plot
+
+    _c.H2OAutoML.explain = explain
+    _c.H2OAutoML.explain_row = explain_row
+    _c.H2OAutoML.pd_multi_plot = pd_multi_plot
+    _c.H2OAutoML.varimp_heatmap = varimp_heatmap
+    _c.H2OAutoML.model_correlation_heatmap = model_correlation_heatmap
+    _c.H2OAutoML.model_correlation = model_correlation
+    _c.H2OAutoML.varimp = varimp
+
+
+__all__ = ["explain", "explain_row", "varimp_heatmap",
+           "model_correlation_heatmap", "pd_multi_plot", "varimp",
+           "model_correlation", "shap_summary_plot",
+           "shap_explain_row_plot", "pd_plot", "ice_plot",
+           "residual_analysis_plot", "learning_curve_plot",
+           "H2OExplanation", "decorate_plot_result",
+           "register_explain_methods"]

@@ -104,6 +104,39 @@ def table_schema(t) -> dict | None:
                             for i in range(len(t.col_header))])}
 
 
+def scoring_history_schema(history) -> dict | None:
+    """`ScoringHistoryV3` analog: one column-oriented table over the model's
+    scoring snapshots — iteration markers kept verbatim, the per-snapshot
+    metrics object flattened to `training_<metric>` columns (the column
+    names h2o-py's learning_curve_plot reads off the wire)."""
+    if not history:
+        return None
+    cols: dict[str, list] = {}
+    metric_keys = ("rmse", "mse", "mae", "logloss", "auc", "pr_auc",
+                   "mean_per_class_error", "r2", "residual_deviance",
+                   "null_deviance")
+    for i, h in enumerate(history):
+        row: dict = {}
+        for k, v in h.items():
+            if isinstance(v, (int, float, str)) or v is None:
+                row[k] = _clean(v)  # NaN/inf -> null (strict-JSON clients)
+        for prefix, mobj in (("training", h.get("training_metrics")),
+                             ("validation", h.get("validation_metrics"))):
+            if mobj is None:
+                continue
+            for mk in metric_keys:
+                v = getattr(mobj, mk, None)
+                if v is not None:
+                    row[f"{prefix}_{mk}"] = _clean(v)
+            dev = getattr(mobj, "mean_residual_deviance",
+                          getattr(mobj, "mse", None))
+            if dev is not None:
+                row[f"{prefix}_deviance"] = _clean(dev)
+        for k in set(cols) | set(row):
+            cols.setdefault(k, [None] * i).append(row.get(k))
+    return cols
+
+
 def metrics_schema(m) -> dict | None:
     if m is None:
         return None
@@ -182,6 +215,7 @@ def model_schema(model) -> dict:
                 if getattr(o, "cv_models", None) else None),
             "variable_importances": _clean(o.variable_importances),
             "scoring_history_length": len(o.scoring_history),
+            "scoring_history": scoring_history_schema(o.scoring_history),
             "run_time_ms": o.run_time_ms,
         },
     }

@@ -1063,7 +1063,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             else None
         tables = model.partial_dependence(
             fr, cols, nbins=int(p.get("nbins", 20) or 20),
-            weight_column=p.get("weight_column") or None, targets=targets)
+            weight_column=p.get("weight_column") or None, targets=targets,
+            row_index=int(p["row_index"]) if p.get("row_index")
+            is not None else -1)
         dest = p.get("destination_key") or make_key("PartialDependence")
         payload = {"destination_key": schemas.key_schema(dest),
                    "partial_dependence_data":

@@ -29,10 +29,15 @@ def _response_col(model, pred: Frame, target: str | None = None) -> np.ndarray:
 
 def partial_dependence(model, fr: Frame, cols=None, nbins: int = 20,
                        weight_column: str | None = None,
-                       targets=None) -> list[TwoDimTable]:
+                       targets=None, row_index: int = -1) -> list[TwoDimTable]:
     """One table per column (per target class for multinomial): grid value,
     weighted mean response, stddev, stderr of the per-row responses with the
-    column pinned to the value."""
+    column pinned to the value.
+
+    ``row_index >= 0`` computes the ICE curve of that single row instead of
+    the all-rows average (`hex/PartialDependence.java:21` _row_index) — the
+    grid still comes from the FULL frame's column range, and the stddev /
+    stderr columns are 0 (one row)."""
     cat = model.output.model_category
     if cat == "Multinomial" and not targets:
         raise ValueError("multinomial PDP requires `targets` (class labels), "
@@ -44,6 +49,7 @@ def partial_dependence(model, fr: Frame, cols=None, nbins: int = 20,
     w = None
     if weight_column is not None:
         w = np.nan_to_num(fr.vec(weight_column).to_numpy())
+    ice = row_index is not None and row_index >= 0
     out = []
     for col, target in [(c, t) for c in cols for t in targets]:
         v = fr.vec(col)
@@ -57,23 +63,74 @@ def partial_dependence(model, fr: Frame, cols=None, nbins: int = 20,
             grid = np.linspace(lo, hi, nbins)
             labels = None
         rows = []
-        for gi, val in enumerate(grid):
-            pinned = Frame(list(fr.names),
-                           [Vec.from_numpy(
-                               np.full(fr.nrow, val, dtype=np.float32),
-                               type=v.type, domain=v.domain)
-                            if n == col else fr.vec(n) for n in fr.names])
-            resp = _response_col(model, model.predict(pinned), target)
-            ok = ~np.isnan(resp)
-            ww = (w[ok] if w is not None else np.ones(ok.sum()))
-            n = max(ww.sum(), 1e-12)
-            mean = float(np.sum(ww * resp[ok]) / n)
-            var = float(np.sum(ww * (resp[ok] - mean) ** 2) / n)
-            std = np.sqrt(var)
-            rows.append([labels[gi] if labels else float(val), mean, std,
-                         std / np.sqrt(max(ok.sum(), 1))])
+        # only the model's features (plus the swept/weight columns) enter the
+        # rebuilt frames: string/id columns pass through predict unused in
+        # the original frame, but a float rebuild of them would throw
+        used = set(model.output.names) | {col}
+        if weight_column:
+            used.add(weight_column)
+        pd_names = [n for n in fr.names if n in used]
+        if ice:
+            # one predict over a G-row frame: the chosen row replicated with
+            # the column swept over the grid; the base row reads ONE element
+            # per column (a full to_numpy here would ship whole columns
+            # through the device tunnel for a single-row curve)
+            base = {n: float(np.asarray(fr.vec(n).data[row_index]))
+                    for n in pd_names if n != col}
+            reps = Frame(pd_names, [
+                Vec.from_numpy(
+                    grid.astype(np.float32) if n == col else
+                    np.full(len(grid), base[n], dtype=np.float32),
+                    type=fr.vec(n).type, domain=fr.vec(n).domain)
+                for n in pd_names])
+            resp = _response_col(model, model.predict(reps), target)
+            for gi, val in enumerate(grid):
+                rows.append([labels[gi] if labels else float(val),
+                             float(resp[gi]), 0.0, 0.0])
+        else:
+            # batched sweep: many grid points per predict as one tall frame
+            # (grid-block-major) — the per-point rescore loop paid one full
+            # REST+device round trip per bin (measured ~1 s/bin through the
+            # axon tunnel); batching turns a 20-bin PDP into 1-2 predicts
+            import os as _os
+
+            budget = int(_os.environ.get("H2O_TPU_PDP_BATCH_ROWS",
+                                         2_000_000))
+            per_batch = max(1, budget // max(fr.nrow, 1))
+            host_cols = {n: fr.vec(n).to_numpy() for n in pd_names
+                         if n != col}
+            R = fr.nrow
+            for b0 in range(0, len(grid), per_batch):
+                gb = grid[b0:b0 + per_batch]
+                k = len(gb)
+                vecs = []
+                for n2 in pd_names:
+                    if n2 == col:
+                        arr = np.repeat(np.asarray(gb, np.float32), R)
+                    else:
+                        arr = np.tile(host_cols[n2], k)
+                    vv = fr.vec(n2)
+                    vecs.append(Vec.from_numpy(arr.astype(np.float32),
+                                               type=vv.type,
+                                               domain=vv.domain))
+                tall = Frame(pd_names, vecs)
+                resp = _response_col(model, model.predict(tall), target)
+                resp = resp[:k * R].reshape(k, R)
+                for ki in range(k):
+                    gi = b0 + ki
+                    r = resp[ki]
+                    ok = ~np.isnan(r)
+                    ww = (w[ok] if w is not None else np.ones(ok.sum()))
+                    tot = max(ww.sum(), 1e-12)
+                    mean = float(np.sum(ww * r[ok]) / tot)
+                    var = float(np.sum(ww * (r[ok] - mean) ** 2) / tot)
+                    std = np.sqrt(var)
+                    rows.append([labels[gi] if labels else float(grid[gi]),
+                                 mean, std,
+                                 std / np.sqrt(max(ok.sum(), 1))])
         hdr = f"PartialDependence: {col}" + \
-            (f" (target {target})" if target is not None else "")
+            (f" (target {target})" if target is not None else "") + \
+            (f" for row {row_index}" if ice else "")
         out.append(TwoDimTable(
             table_header=hdr,
             col_header=[col, "mean_response", "stddev_response",

@@ -228,12 +228,16 @@ def _admm_solve(G, b, l1, l2, free: np.ndarray, rho=None, iters=500, tol=1e-6):
     # soft threshold l1/rho small relative to coefficient magnitudes.
     rho = rho or max(float(np.mean(np.diag(G))), l1, 1e-3)
     A = G + (l2 + rho) * np.eye(P)
-    L = np.linalg.cholesky(A + 1e-8 * np.eye(P))
+    # one inversion, then the x-update is a matvec: numpy's generic solve
+    # re-factorizes every call (it cannot exploit triangularity), which made
+    # the ADMM loop O(iters·P³) — RuleFit's ~600-rule Gram measured 170 s in
+    # exactly this loop before the hoist
+    Ainv = np.linalg.inv(A + 1e-8 * np.eye(P))
     z = np.zeros(P)
     u = np.zeros(P)
     thr = np.where(free, 0.0, l1 / rho)
     for _ in range(iters):
-        beta = np.linalg.solve(L.T, np.linalg.solve(L, b + rho * (z - u)))
+        beta = Ainv @ (b + rho * (z - u))
         z_new = np.clip(np.abs(beta + u) - thr, 0, None) * np.sign(beta + u)
         u = u + beta - z_new
         # converged when both primal (beta≈z) and dual (z stable) residuals die
@@ -360,6 +364,23 @@ class GLMParameters(Parameters):
                                      # (`hex/glm/GLM.BetaConstraint`); applied
                                      # by projection in IRLSM/COD; rejected
                                      # with L_BFGS like the reference
+    linear_constraints: object = None  # Frame or {names, values, types,
+                                     # constraint_numbers} — Equal /
+                                     # LessThanEqual constraints over
+                                     # coefficient linear combinations +
+                                     # 'constant' rows
+                                     # (`hex/glm/GLMModel.java:519`,
+                                     # `ConstrainedGLMUtils.java:214`);
+                                     # solved here by an exact active-set QP
+                                     # on the IRLS normal equations instead
+                                     # of the reference's exact-penalty
+                                     # augmented-Lagrangian loop (deliberate
+                                     # divergence: exact at GLM scale)
+    constraint_eta0: float = 0.1258925  # AL-loop tuning knobs, accepted for
+    constraint_tau: float = 10.0        # API parity; the QP solve has no
+    constraint_c0: float = 10.0         # use for them (see
+    constraint_alpha: float = 0.1       # linear_constraints note above)
+    constraint_beta: float = 0.9
     max_iterations: int = 50
     beta_epsilon: float = 1e-5
     objective_epsilon: float = 1e-6
@@ -415,6 +436,143 @@ def _beta_bounds(spec, di, pad_cols: int = 0):
         lo[P:P + pad_cols], hi[P:P + pad_cols] = -np.inf, np.inf
         lo[-1], hi[-1] = -np.inf, np.inf
     return lo, hi
+
+
+def _linear_constraint_system(spec, di, pad_cols: int = 0):
+    """Parse linear_constraints into (Aeq, ceq, Ain, cin) over the TRAINING
+    coefficient layout [expanded coefs..., pad..., intercept].
+
+    Wire format (`ConstrainedGLMUtils.extractLinearConstraints`): rows of
+    {names, values, types, constraint_numbers}; rows sharing a
+    constraint_number form one constraint Σ value·coef + constant (op) 0,
+    with the name 'constant' carrying the constant and types Equal /
+    LessThanEqual. Natural→standardized transform: β_nat_j = β_std_j/σ_j
+    for standardized numerics (the reference multiplies by _normMul), and a
+    constraint naming the intercept picks up the centering cross-terms
+    −a_int·m_j/σ_j (int_nat = int_std − Σ β_std_j·m_j/σ_j)."""
+    if spec is None:
+        return None
+    if hasattr(spec, "vec"):  # Frame
+        def _strings(col):
+            v = spec.vec(col)
+            if v.is_categorical():
+                return [v.domain[int(c)] for c in v.to_numpy()]
+            return [str(x) for x in (v.host_data if v.host_data is not None
+                                     else v.to_numpy())]
+
+        names = _strings("names")
+        values = np.asarray(spec.vec("values").to_numpy(), np.float64)
+        types = [t.lower() for t in _strings("types")]
+        numbers = np.asarray(spec.vec("constraint_numbers").to_numpy(),
+                             np.int64)
+    else:
+        names = list(spec["names"])
+        values = np.asarray(spec["values"], np.float64)
+        types = [str(t).lower() for t in spec["types"]]
+        numbers = np.asarray(spec["constraint_numbers"], np.int64)
+    P = di.ncols_expanded
+    P1 = P + pad_cols + 1
+    idx = {n: j for j, n in enumerate(di.expanded_names)}
+    rows_eq, rows_in = [], []
+    for cn in sorted(set(int(n) for n in numbers)):
+        sel = [i for i in range(len(names)) if int(numbers[i]) == cn]
+        ctypes = {types[i] for i in sel}
+        if len(ctypes) != 1 or not ctypes <= {"equal", "lessthanequal"}:
+            raise ValueError(
+                f"linear_constraints: constraint {cn} must have one type, "
+                f"Equal or LessThanEqual (got {sorted(ctypes)})")
+        a = np.zeros(P1)
+        c = 0.0
+        ncoef = 0
+        for i in sel:
+            n = names[i]
+            v = float(values[i])
+            if n == "constant":
+                c += v
+                continue
+            ncoef += 1
+            if n == "Intercept" or n == "intercept":
+                a[-1] += v
+                # centering cross-terms from int_nat = int_std − Σ β·m/σ
+                for j, en in enumerate(di.expanded_names):
+                    if en in di.num_means and di.effective_center:
+                        s = di.num_sigmas[en] if di.standardize else 1.0
+                        a[j] -= v * di.num_means[en] / s
+                continue
+            if n not in idx:
+                raise ValueError(
+                    f"linear_constraints: coefficient name '{n}' is not a "
+                    f"valid coefficient name (numeric column or "
+                    f"'col.level') or 'constant'")
+            s = (di.num_sigmas.get(n, 1.0)
+                 if di.standardize and n in di.num_means else 1.0)
+            a[idx[n]] += v / s
+        if ncoef < 2:
+            raise ValueError(
+                "Linear constraint must have at least two coefficients. For "
+                "constraints on just one coefficient use beta_constraints "
+                "instead.")
+        (rows_eq if "equal" in ctypes else rows_in).append((a, c))
+    Aeq = np.array([r[0] for r in rows_eq]).reshape(-1, P1)
+    ceq = np.array([r[1] for r in rows_eq], np.float64)
+    Ain = np.array([r[0] for r in rows_in]).reshape(-1, P1)
+    cin = np.array([r[1] for r in rows_in], np.float64)
+    # redundancy check (`checkAssignLinearConstraints` full-rank guard)
+    M = np.vstack([Aeq, Ain]) if len(Aeq) + len(Ain) else np.zeros((0, P1))
+    if len(M) and np.linalg.matrix_rank(M) < len(M):
+        raise ValueError("redundant and possibly conflicting linear "
+                         "constraints: the constraint matrix is not full "
+                         "rank — remove redundant constraints")
+    return Aeq, ceq, Ain, cin
+
+
+def _constrained_qp(G, b, Aeq, ceq, Ain, cin, tol=1e-8, max_iter=200):
+    """min ½βᵀGβ − bᵀβ  s.t.  Aeq·β + ceq = 0, Ain·β + cin ≤ 0.
+
+    Dense primal active-set over KKT solves — each iteration solves
+    [[G, Aᵀ], [A, 0]] [β; λ] = [b; −c] for the working set, adds the most
+    violated inactive inequality, drops the most negative multiplier.
+    Exact at GLM coefficient counts (the matrix is (P+m)²)."""
+    P = G.shape[0]
+    Greg = G + 1e-10 * np.eye(P)
+    active: list[int] = []
+
+    def solve(act):
+        rows = [Aeq] + [Ain[i:i + 1] for i in act]
+        A = np.vstack([r for r in rows if len(r)]) if (len(Aeq) or act) \
+            else np.zeros((0, P))
+        c = np.concatenate([ceq] + [cin[i:i + 1] for i in act]) \
+            if (len(ceq) or act) else np.zeros(0)
+        m = A.shape[0]
+        K = np.zeros((P + m, P + m))
+        K[:P, :P] = Greg
+        K[:P, P:] = A.T
+        K[P:, :P] = A
+        rhs = np.concatenate([b, -c])
+        try:
+            sol = np.linalg.solve(K, rhs)
+        except np.linalg.LinAlgError:
+            sol = np.linalg.lstsq(K, rhs, rcond=None)[0]
+        return sol[:P], sol[P + len(ceq):]  # β, inequality multipliers
+
+    beta, lam = solve(active)
+    for _ in range(max_iter):
+        # drop the most negative multiplier (constraint no longer binding)
+        if len(active) and len(lam) and lam.min() < -tol:
+            del active[int(np.argmin(lam))]
+            beta, lam = solve(active)
+            continue
+        # add the most violated inactive inequality
+        if len(Ain):
+            viol = Ain @ beta + cin
+            viol[active] = -np.inf
+            worst = int(np.argmax(viol))
+            if viol[worst] > tol:
+                active.append(worst)
+                beta, lam = solve(active)
+                continue
+        break
+    return beta
 
 
 def _tweedie_loglik(y, mu, phi, p):
@@ -662,6 +820,21 @@ class GLM(ModelBuilder):
                 raise NotImplementedError(
                     "compute_p_values with feature_parallelism: follow-up "
                     "(the Fisher information needs the unpadded design)")
+        if p.linear_constraints is not None:
+            # `GLM.checkInitLinearConstraints` mirror
+            if (p.solver or "IRLSM").upper() not in ("IRLSM", "AUTO"):
+                raise ValueError(
+                    "constrained GLM is only available for IRLSM. Please "
+                    "set solver to IRLSM/irlsm explicitly.")
+            if not p.intercept:
+                raise ValueError("constrained GLM is only supported with "
+                                 "intercept=true.")
+            if p.lambda_search or (p.lambda_ is not None and p.lambda_ > 0):
+                raise ValueError("Regularization is not allowed for "
+                                 "constrained GLM.")
+            if (p.family or "").lower() in ("multinomial", "ordinal"):
+                raise ValueError("Constrained GLM is not supported for "
+                                 "multinomial and ordinal families")
 
     def _family(self, category) -> Family:
         p = self.params
@@ -735,15 +908,15 @@ class GLM(ModelBuilder):
             if p.compute_p_values:  # AUTO family resolving to multinomial
                 raise ValueError("compute_p_values is not supported for "
                                  "multinomial family")
+            if p.linear_constraints is not None:
+                raise ValueError("Constrained GLM is not supported for "
+                                 "multinomial and ordinal families")
             if p.feature_parallelism > 1:
                 raise NotImplementedError(
                     "feature_parallelism for multinomial GLM is a planned "
                     "follow-up (per-class block IRLS needs per-block "
                     "resharding)")
             if (p.family or "").lower() == "ordinal":
-                if p.beta_constraints is not None:
-                    raise NotImplementedError("beta_constraints are not "
-                                              "supported for ordinal GLM")
                 return self._build_ordinal(job, names, y_dev, resp_domain)
             return self._build_multinomial(job, names, y_dev, resp_domain)
         family = self._family(category)
@@ -783,6 +956,8 @@ class GLM(ModelBuilder):
 
         self._bounds = _beta_bounds(p.beta_constraints, dinfo,
                                     pad_cols=pad_cols)
+        self._lincon = _linear_constraint_system(p.linear_constraints, dinfo,
+                                                 pad_cols=pad_cols)
         beta, lambda_used, dev, nulldev, neff, iters = self._fit(
             X, y, w, offset, family, job)
         if pad_cols:  # strip padding: coefficients (all ~0) and design cols
@@ -809,6 +984,32 @@ class GLM(ModelBuilder):
         output.scoring_history = [{"iterations": iters, "lambda": lambda_used,
                                    "deviance": float(dev)}]
         output.variable_importances = self._varimp_from_beta(dinfo, beta)
+        if getattr(self, "_lincon", None) is not None:
+            # `GLMModel.output._linear_constraint_states` analog: per
+            # constraint, its value at the solution and whether it holds
+            from ..utils.twodimtable import TwoDimTable
+
+            Aeq, ceq, Ain, cin = self._lincon
+            if Aeq.shape[1] != len(beta):
+                # feature_parallelism stripped the pad columns from beta;
+                # drop the matching (all-zero) constraint columns
+                keep = list(range(dinfo.ncols_expanded)) + [Aeq.shape[1] - 1]
+                Aeq, Ain = Aeq[:, keep], Ain[:, keep]
+            rows_t = []
+            for i in range(len(ceq)):
+                val = float(Aeq[i] @ beta + ceq[i])
+                rows_t.append([f"equality_{i}", "Equal", val,
+                               bool(abs(val) < 1e-5)])
+            for i in range(len(cin)):
+                val = float(Ain[i] @ beta + cin[i])
+                rows_t.append([f"lessthanequal_{i}", "LessThanEqual", val,
+                               bool(val < 1e-5)])
+            output.linear_constraints_table = TwoDimTable(
+                table_header="Linear Constraints", description="",
+                col_header=["constraint", "type", "value",
+                            "condition_satisfied"],
+                col_types=["string", "string", "double", "string"],
+                cell_values=rows_t)
         if family.name in ("gaussian", "gamma", "tweedie", "negativebinomial",
                            "quasibinomial"):
             mu = raw if raw.ndim == 1 else raw[:, -1]
@@ -955,15 +1156,45 @@ class GLM(ModelBuilder):
                 G, b, dev, _ = step(Xi, y, w, jnp.asarray(beta, jnp.float32), offset)
                 iters_total += 1
                 Gn, bn = np.asarray(G, np.float64), np.asarray(b, np.float64)
-                if use_cod:
+                lincon = getattr(self, "_lincon", None)
+                if lincon is not None:
+                    # exact active-set QP on the normal equations; box
+                    # bounds / non_negative fold into the inequality rows
+                    # (a post-hoc clip would break the linear constraints)
+                    Aeq, ceq, Ain, cin = lincon
+                    rows_in = [(Ain, cin)]
+                    P1 = len(beta)
+                    if p.non_negative:
+                        E = -np.eye(P1)[: P1 - 1]
+                        rows_in.append((E, np.zeros(P1 - 1)))
+                    if getattr(self, "_bounds", None) is not None:
+                        lo, hi = self._bounds
+                        for j in range(P1):
+                            if np.isfinite(hi[j]):
+                                e = np.zeros(P1)
+                                e[j] = 1.0
+                                rows_in.append((e[None, :],
+                                                np.array([-hi[j]])))
+                            if np.isfinite(lo[j]):
+                                e = np.zeros(P1)
+                                e[j] = -1.0
+                                rows_in.append((e[None, :],
+                                                np.array([lo[j]])))
+                    Ain_all = np.vstack([r[0] for r in rows_in])
+                    cin_all = np.concatenate([r[1] for r in rows_in])
+                    beta_new = _constrained_qp(Gn + l2 * np.eye(len(beta)),
+                                               bn, Aeq, ceq, Ain_all,
+                                               cin_all)
+                elif use_cod:
                     beta_new = _cod_solve(Gn, bn, l1, l2, free, beta,
                                           p.beta_epsilon, cod_lo, cod_hi)
                 else:
                     beta_new = _admm_solve(Gn, bn, l1, l2, free)
-                if p.non_negative:
+                if lincon is None and p.non_negative:
                     nb = beta_new[:-1]
                     beta_new[:-1] = np.clip(nb, 0, None)
-                if getattr(self, "_bounds", None) is not None:
+                if lincon is None \
+                        and getattr(self, "_bounds", None) is not None:
                     lo, hi = self._bounds
                     beta_new = np.clip(beta_new, lo, hi)
                 diff = np.max(np.abs(beta_new - beta)) if it else np.inf
@@ -1084,12 +1315,23 @@ class GLM(ModelBuilder):
                   "d": jnp.zeros(max(K - 2, 0), jnp.float32)}
         opt = optax.adam(1e-1)
         state = opt.init(params)
+        # box beta_constraints apply by projection after each step (the
+        # IRLSM/COD clip, here on the gradient path; closed the round-3
+        # 'ordinal beta_constraints' gate)
+        bounds = _beta_bounds(p.beta_constraints, dinfo)
+        blo = bhi = None
+        if bounds is not None:
+            blo = jnp.asarray(bounds[0][:P], jnp.float32)
+            bhi = jnp.asarray(bounds[1][:P], jnp.float32)
 
         @jax.jit
         def step(params, state):
             v, g = jax.value_and_grad(nll)(params)
             updates, state = opt.update(g, state, params)
-            return optax.apply_updates(params, updates), state, v
+            params = optax.apply_updates(params, updates)
+            if blo is not None:
+                params["beta"] = jnp.clip(params["beta"], blo, bhi)
+            return params, state, v
 
         prev = np.inf
         for i in range(max(p.max_iterations, 1) * 10):

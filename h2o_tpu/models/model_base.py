@@ -243,11 +243,12 @@ class Model(Keyed):
 
     # -- explanation surface (`hex/PartialDependence`, `hex/PermutationVarImp`)
     def partial_dependence(self, fr, cols=None, nbins: int = 20,
-                           weight_column=None, targets=None):
+                           weight_column=None, targets=None,
+                           row_index: int = -1):
         from .explain import partial_dependence
 
         return partial_dependence(self, fr, cols, nbins, weight_column,
-                                  targets)
+                                  targets, row_index=row_index)
 
     def permutation_importance(self, fr, metric: str = "AUTO",
                                n_repeats: int = 1, seed: int = -1):

@@ -16,7 +16,9 @@ Supported param aliases (mirroring `XGBoostV3.XGBoostParametersV3`):
   subsample/sample_rate, colsample_bytree/col_sample_rate_per_tree,
   colsample_bylevel/col_sample_rate, reg_lambda, reg_alpha, max_bins,
   booster (gbtree | dart — a real DART driver with rate_drop/skip_drop/
-  one_drop/normalize_type, see `XGBoost._build_dart`), tree_method
+  one_drop/normalize_type incl. multinomial + checkpoint continuation, see
+  `XGBoost._build_dart` | gblinear — the penalized linear model retargeted
+  onto the GLM elastic-net path, see `XGBoost._build_gblinear`), tree_method
   (ignored: always hist), backend (ignored: always TPU).
 """
 
@@ -49,6 +51,13 @@ class XGBoostParameters(GBMParameters):
     skip_drop: float = 0.0
     one_drop: bool = False
     normalize_type: str = "tree"   # tree|forest
+
+    # GBLinear booster knobs (`XGBoostParameters` gblinear section) —
+    # feature_selector/top_k/updater tune xgboost's shotgun coordinate
+    # descent; the GLM elastic-net retarget subsumes them (accepted, unused)
+    feature_selector: str = "cyclic"
+    top_k: int = 0
+    updater: str | None = None
 
     # xgboost-native spellings; sentinel = "not set"
     n_estimators: int = 0          # alias of ntrees
@@ -95,9 +104,49 @@ class XGBoost(GBM):
         return dataclasses.replace(cfg, reg_alpha=self.params.reg_alpha)
 
     def build_impl(self, job):
-        if (self.params.booster or "gbtree").lower() == "dart":
+        booster = (self.params.booster or "gbtree").lower()
+        if booster == "dart":
             return self._build_dart(job)
+        if booster == "gblinear":
+            return self._build_gblinear(job)
         return super().build_impl(job)
+
+    def _build_gblinear(self, job):
+        """booster='gblinear' (`XGBoostModel.java:56,150`): xgboost's
+        L1/L2-penalized LINEAR model (shotgun coordinate descent over the
+        same reg_alpha/reg_lambda penalty). TPU-native retarget: the GLM
+        elastic-net path — identical objective, solved by the engine's
+        sharded-Gram IRLSM/COD instead of per-feature shotgun updates.
+        xgboost's penalties are ABSOLUTE (not per-row-normalized):
+        alpha·λ·N = reg_alpha and (1−alpha)·λ·N = reg_lambda fixes the
+        (lambda, alpha) mapping."""
+        from .glm import GLM, GLMParameters
+
+        p = self.params
+        fr = p.training_frame
+        nrow = max(int(fr.nrow), 1)
+        l1, l2 = max(p.reg_alpha, 0.0), max(p.reg_lambda, 0.0)
+        tot = l1 + l2
+        lam = tot / nrow
+        alpha = (l1 / tot) if tot > 0 else 0.0
+        _, category, _ = self.response_info()
+        family = {"Binomial": "binomial", "Multinomial": "multinomial",
+                  "Regression": "gaussian"}[category]
+        gp = GLMParameters(
+            training_frame=fr, response_column=p.response_column,
+            validation_frame=p.validation_frame,
+            weights_column=p.weights_column, offset_column=p.offset_column,
+            ignored_columns=list(p.ignored_columns or []),
+            family=family, alpha=alpha, lambda_=lam,
+            solver="COORDINATE_DESCENT" if category != "Multinomial"
+            else "IRLSM",
+            standardize=False,  # xgboost's linear booster fits raw features
+            max_iterations=max(p.ntrees, 50),  # boosting rounds ≙ sweeps
+            seed=p.seed)
+        sub = GLM(gp)
+        model = sub.build_impl(job)
+        model.booster = "gblinear"
+        return model
 
     def _build_dart(self, job):
         """DART booster (Rashmi & Gilad-Bachrach 2015; xgboost `booster=
@@ -114,7 +163,13 @@ class XGBoost(GBM):
         The engine builds each round's tree at rate 1.0 with the carried
         margin = f0 + Σ_{i∉D} w_i·tree_i; the new tree's raw contribution
         falls out of the train step (f_out − f_in), so each round costs
-        |D| single-tree evaluations plus one tree build."""
+        |D| single-tree evaluations plus one tree build.
+
+        Multinomial drops whole ROUNDS (all K class-trees of a round share
+        one weight — xgboost's dart drops by boosting round); checkpoint
+        continuation restarts the dropout trajectory over the prior's BAKED
+        trees (leaves already carry their weights, so prior trees enter with
+        weight 1.0 and future drop-rescales just multiply their leaves)."""
         import dataclasses
         import time as _t
 
@@ -129,23 +184,50 @@ class XGBoost(GBM):
 
         s = self._setup_build()
         p = s.p
-        if s.K > 1:
-            raise NotImplementedError(
-                "booster='dart' supports regression/binomial here; "
-                "multinomial dart is not implemented")
-        for unsupported in ("checkpoint", "export_checkpoints_dir"):
-            if getattr(p, unsupported, None):
-                raise NotImplementedError(
-                    f"booster='dart' does not support {unsupported} "
-                    "(the dropout trajectory cannot resume from a plain "
-                    "boosted forest)")
+        K = s.K
         rng = np.random.default_rng(
             p.seed if p.seed not in (-1, None) else 1234)
+        cfg = s.cfg
+        f0 = s.f0
+
+        parts, weights = [], []
+        prior = None
+        if p.checkpoint is not None:
+            prior = self._resolve_checkpoint(p.checkpoint)
+            if p.ntrees <= prior.ntrees:
+                raise ValueError(
+                    f"checkpoint model already has {prior.ntrees} trees; "
+                    f"ntrees must exceed that (got {p.ntrees})")
+            for fld, ours, theirs in (
+                    ("max_depth", p.max_depth, prior.cfg.max_depth),
+                    ("nbins", p.nbins,
+                     getattr(prior.params, "nbins", prior.cfg.nbins)),
+                    ("nclasses", K, prior.cfg.nclass),
+                    ("drf_mode", False, prior.cfg.drf_mode)):
+                if ours != theirs:
+                    raise ValueError(
+                        f"checkpoint incompatible: {fld} differs "
+                        f"(checkpoint={theirs}, request={ours})")
+            p = self.params = dataclasses.replace(p, checkpoint=prior.key)
+            # continuation trees speak the prior forest's split language
+            prior_sets = bool(getattr(prior.cfg, "use_sets", False))
+            if cfg.use_sets != prior_sets:
+                cfg = dataclasses.replace(cfg, use_sets=prior_sets)
+            pf = prior.forest
+            keysx = ("feat", "thr", "nanL", "val", "gain", "catd")
+            for t in range(prior.ntrees):
+                parts.append(tuple(
+                    (pf[k][t:t + 1] if k in pf else
+                     jnp.zeros(pf["feat"][t:t + 1].shape + (1,),
+                               jnp.float32)) for k in keysx))
+                weights.append(1.0)
+            f0 = prior.f0
+
         # trees build UNSCALED (engine's effective rate = cfg.learn_rate x
         # per-tree rate; DART owns the scaling via the weight vector), and
         # unclipped: max_abs_leafnode_pred caps the FINAL stored leaf, so
         # the clip applies at weight-bake time below (GBM.java:716 parity)
-        cfg1 = dataclasses.replace(s.cfg, ntrees=1, learn_rate=1.0,
+        cfg1 = dataclasses.replace(cfg, ntrees=1, learn_rate=1.0,
                                    max_abs_leafnode_pred=float("inf"))
         train_fn = make_train_fn(cfg1, s.grad_fn, s.mesh,
                                  cache_key=s.grad_key)
@@ -155,19 +237,26 @@ class XGBoost(GBM):
         one_rate = jnp.ones((1,), dtype=jnp.float32)
 
         lr = float(p.learn_rate)
-        parts, weights = [], []
+        # f0 broadcast to the carried-margin shape ((K, R) for multinomial)
+        f0b = (jnp.asarray(f0)[:, None] if K > 1
+               else jnp.asarray(f0, jnp.float32))
         S = jnp.zeros_like(s.f)        # sum of w_i * raw_i over built trees
+        if prior is not None:
+            fprev = prior._raw_f(s.X)
+            S = (fprev.T if K > 1 else fprev).astype(jnp.float32) - f0b
         history = []
         stop_series: list = []
         interval = min(p.score_tree_interval or p.ntrees, p.ntrees)
-        last_scored = 0
+        last_scored = n_prior = len(parts)
 
-        use_sets = s.cfg.use_sets
+        use_sets = cfg.use_sets
 
         def dropped_sum(idxs):
             """sum_{i in D} w_i * raw_i in ONE forest evaluation: stack the
             dropped trees with their weights pre-multiplied into the leaves
-            — O(1) extra memory, no per-tree prediction cache."""
+            — O(1) extra memory, no per-tree prediction cache. Multinomial
+            trees carry a (D, K, N) class axis; the (R, K) output transposes
+            onto the carried-margin layout."""
             feat = jnp.concatenate([parts[i][0] for i in idxs], axis=0)
             thr = jnp.concatenate([parts[i][1] for i in idxs], axis=0)
             nanL = jnp.concatenate([parts[i][2] for i in idxs], axis=0)
@@ -176,12 +265,35 @@ class XGBoost(GBM):
                  for i in idxs], axis=0)
             catd = (jnp.concatenate([parts[i][5] for i in idxs], axis=0)
                     if use_sets else None)
-            return predict_forest(s.X, feat, thr, nanL, val,
-                                  s.cfg.max_depth, catd=catd,
-                                  iscat=s.iscat_dev if use_sets else None,
-                                  nedges=s.nedges_dev if use_sets else None)
+            out = predict_forest(s.X, feat, thr, nanL, val,
+                                 cfg.max_depth, catd=catd,
+                                 iscat=s.iscat_dev if use_sets else None,
+                                 nedges=s.nedges_dev if use_sets else None)
+            return out.T if K > 1 else out
 
-        for t in range(p.ntrees):
+        output = ModelOutput()
+        output.names = list(s.names)
+        output.domains = {n: s.fr.vec(n).domain for n in s.names}
+        output.response_domain = (list(s.resp_domain) if s.resp_domain
+                                  else None)
+        output.model_category = s.category
+
+        def bake(parts_w):
+            # bake each tree's DART weight into its stored leaf values; the
+            # max_abs_leafnode_pred cap applies on the FINAL stored leaf
+            # (the reference clips after the effective rate,
+            # GBM.java:716-719)
+            cap = float(getattr(p, "max_abs_leafnode_pred", float("inf"))
+                        or float("inf"))
+            out = []
+            for (feat, thr, nanL, val, gain, catd), wgt in parts_w:
+                v = jnp.asarray(val) * jnp.float32(wgt)
+                if np.isfinite(cap):
+                    v = jnp.clip(v, -cap, cap)
+                out.append((feat, thr, nanL, v, gain, catd))
+            return out
+
+        for t in range(n_prior, p.ntrees):
             job.check_cancelled()
             if history and job.time_exceeded():  # keep the partial forest
                 break
@@ -193,10 +305,10 @@ class XGBoost(GBM):
                     dropped = [int(rng.integers(t))]
             if dropped:
                 drop_raw = dropped_sum(dropped)
-                margin = s.f0 + S - drop_raw
+                margin = f0b + S - drop_raw
             else:
                 drop_raw = None
-                margin = s.f0 + S
+                margin = f0b + S
             f_out, _os, _oc, trees = train_fn(
                 s.Xb, s.y_k, s.w, margin.astype(jnp.float32), s.edges,
                 s.edge_ok, keys[t:t + 1], one_rate, s.mono, s.imat,
@@ -222,7 +334,7 @@ class XGBoost(GBM):
             if (t + 1) % interval == 0 or t + 1 == p.ntrees:
                 m = make_metrics(
                     s.category, jnp.where(s.ymask, s.y, jnp.nan),
-                    _metrics_raw(s.category, s.dist, s.f0 + S,
+                    _metrics_raw(s.category, s.dist, f0b + S,
                                  False, t + 1),
                     None if p.weights_column is None else s.w)
                 history.append({"timestamp": _t.time(),
@@ -232,31 +344,20 @@ class XGBoost(GBM):
                 # last update (the final round may be shorter than interval)
                 job.update((t + 1 - last_scored) / p.ntrees)
                 last_scored = t + 1
+                if p.export_checkpoints_dir:
+                    # in-training snapshots carry the CURRENT weights baked
+                    self._export_snapshot(
+                        p, output, bake(zip(parts, weights)), f0, s.dist,
+                        cfg, s.is_cat, t + 1, m, cat_nedges=s.nedges_np)
                 if self._should_stop(m, stop_series):
                     break
 
-        # bake each tree's DART weight into its stored leaf values; the
-        # max_abs_leafnode_pred cap applies HERE, on the final stored leaf
-        # (the reference clips after the effective rate, GBM.java:716-719)
-        cap = float(getattr(p, "max_abs_leafnode_pred", float("inf"))
-                    or float("inf"))
-        scaled = []
-        for (feat, thr, nanL, val, gain, catd), wgt in zip(parts, weights):
-            v = jnp.asarray(val) * jnp.float32(wgt)
-            if np.isfinite(cap):
-                v = jnp.clip(v, -cap, cap)
-            scaled.append((feat, thr, nanL, v, gain, catd))
-        output = ModelOutput()
-        output.names = list(s.names)
-        output.domains = {n: s.fr.vec(n).domain for n in s.names}
-        output.response_domain = (list(s.resp_domain) if s.resp_domain
-                                  else None)
-        output.model_category = s.category
+        scaled = bake(zip(parts, weights))
         output.scoring_history = history
         output.training_metrics = history[-1]["training_metrics"]
         forest = _assemble_forest(scaled)
         output.variable_importances = self._varimp(forest, s.names)
-        model = GBMModel(p, output, forest, s.f0, s.dist, s.cfg, s.is_cat,
+        model = GBMModel(p, output, forest, f0, s.dist, cfg, s.is_cat,
                          cat_nedges=s.nedges_np)
         if getattr(p, "calibrate_model", False):
             # same Platt step as the gbtree path — leaves are already baked,

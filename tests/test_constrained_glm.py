@@ -1,0 +1,193 @@
+"""Constrained GLM — linear (in)equality constraints over coefficients
+(`hex/glm/GLMModel.java:519` _linear_constraints +
+`ConstrainedGLMUtils.java` extraction rules), solved here by an exact
+active-set QP on the IRLS normal equations."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.glm import GLM, GLMParameters
+
+
+def _frame(n=800, seed=3):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = 2.0 * x1 + 3.0 * x2 + 1.0 + rng.normal(0, 0.2, size=n)
+    return Frame.from_dict({"x1": x1, "x2": x2, "y": y}), x1, x2, y
+
+
+def _lc(names, values, types, numbers):
+    return {"names": names, "values": values, "types": types,
+            "constraint_numbers": numbers}
+
+
+def _fit(fr, lc, standardize=True, family="gaussian", **kw):
+    params = dict(training_frame=fr, response_column="y", family=family,
+                  lambda_=0.0, standardize=standardize,
+                  linear_constraints=lc)
+    params.update(kw)
+    return GLM(GLMParameters(**params)).train_model()
+
+
+class TestEquality:
+    def test_constraint_holds_and_matches_closed_form(self):
+        fr, x1, x2, y = _frame()
+        # x1 + x2 = 4  <=>  1*b1 + 1*b2 - 4 = 0
+        lc = _lc(["x1", "x2", "constant"], [1.0, 1.0, -4.0],
+                 ["Equal"] * 3, [0, 0, 0])
+        m = _fit(fr, lc, standardize=False)
+        coef = {k: v for k, v in zip(
+            m.dinfo.expanded_names + ["Intercept"], m.beta_natural())} \
+            if hasattr(m, "beta_natural") else m.coef()
+        assert abs(coef["x1"] + coef["x2"] - 4.0) < 1e-5, coef
+        # closed-form constrained least squares via KKT on [x1 x2 1]
+        X = np.stack([x1, x2, np.ones_like(x1)], axis=1)
+        G = X.T @ X
+        b = X.T @ y
+        A = np.array([[1.0, 1.0, 0.0]])
+        K = np.block([[G, A.T], [A, np.zeros((1, 1))]])
+        sol = np.linalg.solve(K, np.concatenate([b, [4.0]]))
+        assert abs(coef["x1"] - sol[0]) < 1e-3
+        assert abs(coef["x2"] - sol[1]) < 1e-3
+        assert abs(coef["Intercept"] - sol[2]) < 1e-3
+
+    def test_standardize_invariance(self):
+        """Constraints are on the NATURAL scale: standardized and raw fits
+        must satisfy them identically and agree on coefficients."""
+        fr, *_ = _frame()
+        lc = _lc(["x1", "x2", "constant"], [1.0, 1.0, -4.0],
+                 ["Equal"] * 3, [0, 0, 0])
+        m_std = _fit(fr, lc, standardize=True)
+        m_raw = _fit(fr, lc, standardize=False)
+        c_s, c_r = m_std.coef(), m_raw.coef()
+        assert abs(c_s["x1"] + c_s["x2"] - 4.0) < 1e-4
+        for k in ("x1", "x2", "Intercept"):
+            assert abs(c_s[k] - c_r[k]) < 5e-3, (k, c_s[k], c_r[k])
+
+    def test_constraints_table(self):
+        fr, *_ = _frame()
+        lc = _lc(["x1", "x2", "constant"], [1.0, 1.0, -4.0],
+                 ["Equal"] * 3, [0, 0, 0])
+        m = _fit(fr, lc)
+        t = m.output.linear_constraints_table
+        assert t is not None
+        row = t.cell_values[0]
+        assert row[1] == "Equal" and abs(row[2]) < 1e-4 and row[3]
+
+
+class TestInequality:
+    def test_binding_inequality(self):
+        fr, *_ = _frame()
+        # b2 - b1 <= 0  (true fit has b2-b1 = 1 > 0, so it binds: b1 == b2)
+        lc = _lc(["x2", "x1"], [1.0, -1.0], ["LessThanEqual"] * 2, [0, 0])
+        m = _fit(fr, lc)
+        c = m.coef()
+        assert c["x2"] - c["x1"] < 1e-4
+        assert abs(c["x2"] - c["x1"]) < 1e-4  # binds to equality
+
+    def test_nonbinding_inequality_matches_unconstrained(self):
+        fr, *_ = _frame()
+        # b1 + b2 <= 100 — satisfied by the unconstrained optimum
+        lc = _lc(["x1", "x2", "constant"], [1.0, 1.0, -100.0],
+                 ["LessThanEqual"] * 3, [0, 0, 0])
+        m_c = _fit(fr, lc)
+        m_u = GLM(GLMParameters(training_frame=fr, response_column="y",
+                                family="gaussian", lambda_=0.0,
+                                solver="IRLSM")).train_model()
+        for k in ("x1", "x2", "Intercept"):
+            assert abs(m_c.coef()[k] - m_u.coef()[k]) < 1e-4
+
+    def test_mixed_with_beta_constraints(self):
+        fr, *_ = _frame()
+        lc = _lc(["x1", "x2", "constant"], [1.0, 1.0, -4.0],
+                 ["Equal"] * 3, [0, 0, 0])
+        bc = {"names": ["x1"], "lower_bounds": [0.0], "upper_bounds": [1.5]}
+        m = _fit(fr, lc, beta_constraints=bc)
+        c = m.coef()
+        assert abs(c["x1"] + c["x2"] - 4.0) < 1e-4
+        assert -1e-6 <= c["x1"] <= 1.5 + 1e-6
+
+
+class TestBinomialConstrained:
+    def test_binomial_constraint_holds(self):
+        rng = np.random.default_rng(9)
+        n = 1500
+        x1 = rng.normal(size=n)
+        x2 = rng.normal(size=n)
+        p1 = 1 / (1 + np.exp(-(1.5 * x1 - 0.5 * x2)))
+        lab = (rng.random(n) < p1).astype(np.float32)
+        fr = Frame.from_dict({"x1": x1, "x2": x2})
+        fr.add("y", Vec.from_numpy(lab, type=T_CAT, domain=["n", "p"]))
+        lc = _lc(["x1", "x2", "constant"], [1.0, 1.0, -0.8],
+                 ["Equal"] * 3, [0, 0, 0])
+        m = _fit(fr, lc, family="binomial")
+        c = m.coef()
+        assert abs(c["x1"] + c["x2"] - 0.8) < 1e-4
+        assert m.output.training_metrics.auc > 0.7
+
+
+class TestWireFormatAndValidation:
+    def test_frame_spec(self):
+        fr, *_ = _frame()
+        import pandas as pd
+
+        spec = Frame.from_pandas(pd.DataFrame({
+            "names": pd.Categorical(["x1", "x2", "constant"]),
+            "values": [1.0, 1.0, -4.0],
+            "types": pd.Categorical(["Equal"] * 3),
+            "constraint_numbers": [0.0, 0.0, 0.0]}))
+        m = _fit(fr, spec)
+        c = m.coef()
+        assert abs(c["x1"] + c["x2"] - 4.0) < 1e-4
+
+    def test_single_coefficient_rejected(self):
+        fr, *_ = _frame()
+        lc = _lc(["x1", "constant"], [1.0, -2.0], ["Equal"] * 2, [0, 0])
+        with pytest.raises(ValueError, match="at least two coefficients"):
+            _fit(fr, lc)
+
+    def test_lbfgs_rejected(self):
+        fr, *_ = _frame()
+        lc = _lc(["x1", "x2"], [1.0, 1.0], ["Equal"] * 2, [0, 0])
+        with pytest.raises(ValueError, match="IRLSM"):
+            _fit(fr, lc, solver="L_BFGS")
+
+    def test_regularization_rejected(self):
+        fr, *_ = _frame()
+        lc = _lc(["x1", "x2"], [1.0, 1.0], ["Equal"] * 2, [0, 0])
+        with pytest.raises(ValueError, match="Regularization"):
+            _fit(fr, lc, lambda_=0.1)
+
+    def test_redundant_constraints_rejected(self):
+        fr, *_ = _frame()
+        lc = _lc(["x1", "x2", "x1", "x2"], [1.0, 1.0, 2.0, 2.0],
+                 ["Equal"] * 4, [0, 0, 1, 1])
+        with pytest.raises(ValueError, match="redundant"):
+            _fit(fr, lc)
+
+    def test_unknown_name_rejected(self):
+        fr, *_ = _frame()
+        lc = _lc(["zz", "x2"], [1.0, 1.0], ["Equal"] * 2, [0, 0])
+        with pytest.raises(ValueError, match="not a valid coefficient"):
+            _fit(fr, lc)
+
+
+class TestOrdinalBetaConstraints:
+    def test_ordinal_bounds_hold(self):
+        rng = np.random.default_rng(2)
+        n = 900
+        x = rng.normal(size=n)
+        latent = 2.0 * x + rng.logistic(size=n)
+        lab = np.digitize(latent, [-1.0, 1.0]).astype(np.float32)
+        fr = Frame.from_dict({"x": x})
+        fr.add("y", Vec.from_numpy(lab, type=T_CAT, domain=["a", "b", "c"]))
+        bc = {"names": ["x"], "lower_bounds": [0.0], "upper_bounds": [0.5]}
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="ordinal", standardize=False,
+                              beta_constraints=bc)).train_model()
+        bx = float(np.asarray(m.beta)[0]) if hasattr(m, "beta") else \
+            list(m.coef().values())[0]
+        assert -1e-5 <= bx <= 0.5 + 1e-5, bx

@@ -390,17 +390,22 @@ def test_beta_constraints_multinomial():
         assert -0.5 - 1e-6 <= coefs["x1"] <= 0.5 + 1e-6, (klass, coefs)
 
 
-def test_beta_constraints_ordinal_rejected():
+def test_beta_constraints_ordinal_apply():
+    # round-4: the ordinal gate is gone — bounds now apply by projection
     from h2o_tpu.frame.vec import T_CAT, Vec
     rng = np.random.default_rng(0)
-    x = rng.normal(size=100).astype(np.float32)
+    x = rng.normal(size=200).astype(np.float32)
     fr = Frame.from_dict({"x": x})
     lev = np.clip((x + 1).astype(int), 0, 2).astype(np.float32)
     fr.add("y", Vec.from_numpy(lev, type=T_CAT, domain=["lo", "mid", "hi"]))
-    with pytest.raises(NotImplementedError, match="ordinal"):
-        GLM(GLMParameters(training_frame=fr, response_column="y",
-                          family="ordinal",
-                          beta_constraints={"names": ["x"]})).train_model()
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="ordinal", standardize=False,
+                          beta_constraints={"names": ["x"],
+                                            "lower_bounds": [0.0],
+                                            "upper_bounds": [0.25]})
+            ).train_model()
+    bx = float(np.asarray(m.beta).ravel()[0])
+    assert -1e-5 <= bx <= 0.25 + 1e-5
 
 
 def test_glm_interactions_pairwise():

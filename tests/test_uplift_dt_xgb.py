@@ -138,15 +138,121 @@ def test_xgboost_dart_booster():
                                pv, rtol=1e-5, atol=1e-6)
 
 
-def test_xgboost_dart_multinomial_gate():
+def test_xgboost_dart_multinomial():
+    """Round-4: the multinomial gate is gone — DART drops whole boosting
+    rounds (all K class-trees share one weight) and still learns."""
     from h2o_tpu.models.xgboost import XGBoost, XGBoostParameters
     from h2o_tpu.frame.vec import T_CAT, Vec
 
     rng = np.random.default_rng(3)
-    x = rng.normal(size=(300, 3)).astype(np.float32)
-    yc = rng.integers(0, 3, 300).astype(np.float32)
+    n = 1200
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    yc = (np.argmax(x, axis=1)).astype(np.float32)
+    noisy = rng.random(n) < 0.1
+    yc[noisy] = rng.integers(0, 3, noisy.sum())
     fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(3)})
     fr.add("y", Vec.from_numpy(yc, type=T_CAT, domain=["a", "b", "c"]))
-    with pytest.raises(NotImplementedError, match="multinomial dart"):
-        XGBoost(XGBoostParameters(training_frame=fr, response_column="y",
-                                  booster="dart", ntrees=3)).train_model()
+    m = XGBoost(XGBoostParameters(training_frame=fr, response_column="y",
+                                  booster="dart", rate_drop=0.3, ntrees=15,
+                                  max_depth=3, seed=5)).train_model()
+    tm = m.output.training_metrics
+    assert tm.logloss < 0.6, tm.logloss
+    # scoring path (baked leaves) agrees with the carried-margin metrics
+    perf = m.model_performance(fr)
+    np.testing.assert_allclose(perf.logloss, tm.logloss, rtol=1e-4)
+    # per-class trees: forest arrays carry the K axis
+    assert np.asarray(m.forest["feat"]).ndim == 3
+
+
+def test_xgboost_dart_checkpoint_continuation():
+    """Round-4: DART continues from a prior model's baked forest (prior
+    trees enter at weight 1.0 and stay droppable/rescalable)."""
+    from h2o_tpu.models.xgboost import XGBoost, XGBoostParameters
+
+    rng = np.random.default_rng(6)
+    n = 1500
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] - 0.7 * x[:, 1] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(4)} | {"y": y})
+    kw = dict(training_frame=fr, response_column="y", max_depth=3, eta=0.3,
+              seed=7, booster="dart", rate_drop=0.3)
+    m1 = XGBoost(XGBoostParameters(ntrees=8, **kw)).train_model()
+    m2 = XGBoost(XGBoostParameters(ntrees=16, checkpoint=m1,
+                                   **kw)).train_model()
+    assert m2.ntrees == 16
+    # the prior's trees ride along (first 8 feat arrays identical)
+    np.testing.assert_array_equal(np.asarray(m2.forest["feat"])[:8],
+                                  np.asarray(m1.forest["feat"]))
+    r1 = m1.model_performance(fr).mse
+    r2 = m2.model_performance(fr).mse
+    assert r2 <= r1 + 1e-9, (r1, r2)
+    # checkpoint from a plain gbtree forest also continues
+    g1 = XGBoost(XGBoostParameters(ntrees=6, training_frame=fr,
+                                   response_column="y", max_depth=3,
+                                   eta=0.3, seed=7)).train_model()
+    g2 = XGBoost(XGBoostParameters(ntrees=12, checkpoint=g1,
+                                   **kw)).train_model()
+    assert g2.ntrees == 12
+
+
+def test_xgboost_dart_export_checkpoints(tmp_path):
+    from h2o_tpu.models.xgboost import XGBoost, XGBoostParameters
+    from h2o_tpu.backend.persist import load_model
+
+    rng = np.random.default_rng(8)
+    n = 600
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x[:, 0] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(3)} | {"y": y})
+    d = str(tmp_path / "snaps")
+    m = XGBoost(XGBoostParameters(training_frame=fr, response_column="y",
+                                  booster="dart", rate_drop=0.3, ntrees=6,
+                                  score_tree_interval=2, max_depth=3,
+                                  seed=3, export_checkpoints_dir=d)
+                ).train_model()
+    import os
+
+    snaps = sorted(os.listdir(d))
+    assert len(snaps) >= 2, snaps
+    snap = load_model(os.path.join(d, snaps[0]))
+    assert snap.ntrees == 2
+    out = snap.predict(fr).vec(0).to_numpy()
+    assert np.isfinite(out).all()
+
+
+def test_xgboost_gblinear():
+    """booster='gblinear' fits the penalized LINEAR model on the GLM
+    elastic-net path: near-exact recovery of linear signal, and the l1
+    penalty actually sparsifies."""
+    from h2o_tpu.models.xgboost import XGBoost, XGBoostParameters
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (2.0 * x[:, 0] - 1.0 * x[:, 1] + 0.05 * rng.normal(size=n)
+         ).astype(np.float32)
+    fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(5)} | {"y": y})
+    m = XGBoost(XGBoostParameters(training_frame=fr, response_column="y",
+                                  booster="gblinear", reg_lambda=0.0,
+                                  reg_alpha=0.0, seed=1)).train_model()
+    assert m.booster == "gblinear"
+    c = m.coef()
+    assert abs(c["x0"] - 2.0) < 0.05 and abs(c["x1"] + 1.0) < 0.05
+    assert m.output.training_metrics.r2 > 0.99
+    # heavy l1 zeroes the noise coefficients
+    ml1 = XGBoost(XGBoostParameters(training_frame=fr, response_column="y",
+                                    booster="gblinear", reg_alpha=200.0,
+                                    reg_lambda=0.0, seed=1)).train_model()
+    cl1 = ml1.coef()
+    assert abs(cl1["x3"]) < 1e-3 and abs(cl1["x4"]) < 1e-3
+
+    # binomial response routes through the logistic elastic net
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    lab = (y > 0).astype(np.float32)
+    frb = Frame.from_dict({f"x{i}": x[:, i] for i in range(5)})
+    frb.add("y", Vec.from_numpy(lab, type=T_CAT, domain=["n", "p"]))
+    mb = XGBoost(XGBoostParameters(training_frame=frb, response_column="y",
+                                   booster="gblinear", reg_lambda=1.0,
+                                   seed=1)).train_model()
+    assert mb.output.training_metrics.auc > 0.95
