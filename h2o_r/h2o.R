@@ -19,7 +19,7 @@
 }
 
 .h2o.request <- function(method, path, body = NULL, params = NULL,
-                         upload = NULL) {
+                         upload = NULL, raw_text = FALSE) {
   url <- paste0(get("url", envir = .h2o), path)
   if (!is.null(params)) {
     qs <- paste(mapply(function(k, v) paste0(k, "=", utils::URLencode(
@@ -43,6 +43,8 @@
   auth <- mget("auth", envir = .h2o, ifnotfound = list(NULL))$auth
   if (!is.null(auth)) curl::handle_setheaders(h, "Authorization" = auth)
   resp <- curl::curl_fetch_memory(url, handle = h)
+  if (raw_text && resp$status_code < 400)
+    return(rawToChar(resp$content))
   payload <- .h2o.json(rawToChar(resp$content))
   if (resp$status_code >= 400)
     stop(sprintf("h2o error %d: %s", resp$status_code,
@@ -73,6 +75,10 @@ h2o.rm <- function(key) invisible(
   .h2o.request("DELETE", paste0("/3/Frames/", key)))
 
 .h2o.poll <- function(job) {
+  if (is.null(job$job$key)) {  # synchronous route: job came back DONE
+    stopifnot(job$job$status == "DONE")
+    return(job$job)
+  }
   key <- job$job$key$name
   repeat {
     j <- .h2o.request("GET", paste0("/3/Jobs/", key))$jobs[[1]]
@@ -158,12 +164,6 @@ h2o.predict <- function(model, newdata) {
   h2o.getFrame(res$predictions_frame$name)
 }
 
-h2o.performance <- function(model, metric = "training_metrics")
-  model$schema$output[[metric]]
-
-h2o.auc <- function(model) h2o.performance(model)$AUC
-h2o.rmse <- function(model) h2o.performance(model)$RMSE
-
 h2o.saveMojo <- function(model, path) .h2o.request(
   "GET", paste0("/3/Models/", model$model_id, "/mojo"),
   params = list(dir = path))$dir
@@ -241,5 +241,324 @@ h2o.varimp <- function(model)
 h2o.confusionMatrix <- function(model)
   h2o.performance(model)$cm$table
 
-h2o.logloss <- function(model) h2o.performance(model)$logloss
-h2o.mse <- function(model) h2o.performance(model)$MSE
+
+# ============================================================================
+# Round-4 growth: frame algebra, grids, AutoML, performance objects — the
+# verbs the reference's runit smokes lean on (`h2o-r/h2o-package/R/frame.R`,
+# `models.R`, `grid.R`, `automl.R`). Everything stays wire-level: eager
+# rapids per verb (the reference's lazy AST builder collapses to the same
+# requests at execution time).
+# ============================================================================
+
+# frame-returning rapids with a session-temp assignment, like the reference's
+# (tmp= key expr) wrapping
+.h2o.frame_op <- function(expr) {
+  res <- h2o.rapids(expr)
+  if (is.null(res$key)) stop("rapids did not return a frame: ", expr)
+  h2o.getFrame(res$key$name)
+}
+
+.h2o.col_index <- function(fr, col) {
+  if (is.numeric(col)) return(as.integer(col) - 1L)  # R is 1-based
+  which(h2o.colnames(fr) == col) - 1L
+}
+
+# -- slicing: fr[rows, cols] --------------------------------------------------
+`[.H2OFrame` <- function(fr, i, j, ...) {
+  id <- fr$frame_id
+  if (!missing(j)) {
+    jj <- if (is.character(j)) sapply(j, function(c) .h2o.col_index(fr, c))
+          else as.integer(j) - 1L
+    id <- .h2o.frame_op(sprintf("(cols %s [%s])", id,
+                                paste(jj, collapse = " ")))$frame_id
+  }
+  if (!missing(i)) {
+    if (inherits(i, "H2OFrame"))
+      stop("H2OFrame logical row masks are not supported in this client; ",
+           "materialize indices first (e.g. which(as.data.frame(mask)[[1]]))")
+    i <- as.integer(i)
+    if (any(i < 0)) {  # R drop semantics: fr[-1, ] removes row 1
+      if (any(i > 0)) stop("can't mix positive and negative row indices")
+      n <- h2o.nrow(h2o.getFrame(id))
+      i <- setdiff(seq_len(n), -i)
+    }
+    ii <- i - 1L
+    id <- .h2o.frame_op(sprintf("(rows %s [%s])", id,
+                                paste(ii, collapse = " ")))$frame_id
+  }
+  h2o.getFrame(id)
+}
+
+`$.H2OFrame` <- function(fr, name) {
+  if (name %in% c("frame_id", "class")) return(unclass(fr)[[name]])
+  .h2o.frame_op(sprintf("(cols %s '%s')", unclass(fr)$frame_id, name))
+}
+
+# -- arithmetic / comparison on frames (Ops group generic) -------------------
+.h2o.binop <- function(op, e1, e2) {
+  arg <- function(e) {
+    if (inherits(e, "H2OFrame")) return(e$frame_id)
+    if (is.character(e)) return(paste0("'", e, "'"))  # rapids string literal
+    e
+  }
+  .h2o.frame_op(sprintf("(%s %s %s)", op, arg(e1), arg(e2)))
+}
+
+Ops.H2OFrame <- function(e1, e2) {
+  op <- switch(.Generic, "%%" = "%%", .Generic)
+  if (missing(e2)) {  # unary ops: -fr, !fr
+    if (op == "-") return(.h2o.binop("*", e1, -1))
+    if (op == "!") return(.h2o.frame_op(sprintf("(not %s)", e1$frame_id)))
+    stop("unsupported unary operator on H2OFrame: ", op)
+  }
+  .h2o.binop(op, e1, e2)
+}
+
+h2o.log <- function(fr) .h2o.frame_op(sprintf("(log %s)", fr$frame_id))
+h2o.exp <- function(fr) .h2o.frame_op(sprintf("(exp %s)", fr$frame_id))
+h2o.sqrt <- function(fr) .h2o.frame_op(sprintf("(sqrt %s)", fr$frame_id))
+h2o.abs <- function(fr) .h2o.frame_op(sprintf("(abs %s)", fr$frame_id))
+
+# -- materialization ----------------------------------------------------------
+as.data.frame.H2OFrame <- function(x, ...) {
+  csv <- .h2o.request("GET", "/3/DownloadDataset",
+                      params = list(frame_id = x$frame_id), raw_text = TRUE)
+  utils::read.csv(text = csv, stringsAsFactors = FALSE)
+}
+
+h2o.asfactor <- function(fr) .h2o.frame_op(
+  sprintf("(as.factor %s)", fr$frame_id))
+h2o.asnumeric <- function(fr) .h2o.frame_op(
+  sprintf("(as.numeric %s)", fr$frame_id))
+
+h2o.levels <- function(fr) {
+  res <- h2o.rapids(sprintf("(levels %s)", fr$frame_id))
+  if (!is.null(res$key)) {
+    df <- as.data.frame(h2o.getFrame(res$key$name))
+    return(df[[1]])
+  }
+  res$values
+}
+
+h2o.nlevels <- function(fr) length(h2o.levels(fr))
+
+h2o.table <- function(fr) .h2o.frame_op(sprintf("(table %s)", fr$frame_id))
+h2o.unique <- function(fr) .h2o.frame_op(sprintf("(unique %s)", fr$frame_id))
+
+h2o.cbind <- function(...) {
+  frs <- list(...)
+  .h2o.frame_op(paste0("(cbind ", paste(sapply(frs, function(f) f$frame_id),
+                                        collapse = " "), ")"))
+}
+h2o.rbind <- function(...) {
+  frs <- list(...)
+  .h2o.frame_op(paste0("(rbind ", paste(sapply(frs, function(f) f$frame_id),
+                                        collapse = " "), ")"))
+}
+
+h2o.ifelse <- function(test, yes, no) {
+  arg <- function(a) if (inherits(a, "H2OFrame")) a$frame_id else a
+  .h2o.frame_op(sprintf("(ifelse %s %s %s)", arg(test), arg(yes), arg(no)))
+}
+
+h2o.merge <- function(x, y, all.x = FALSE, all.y = FALSE) .h2o.frame_op(
+  sprintf("(merge %s %s %s %s [] [] 'auto')", x$frame_id, y$frame_id,
+          tolower(as.character(all.x)), tolower(as.character(all.y))))
+
+h2o.arrange <- function(fr, ...) {
+  cols <- sapply(substitute(list(...))[-1], deparse)
+  idx <- sapply(cols, function(c) .h2o.col_index(fr, c))
+  .h2o.frame_op(sprintf("(sort %s [%s])", fr$frame_id,
+                        paste(idx, collapse = " ")))
+}
+
+h2o.group_by <- function(data, by, ...) {
+  # aggregates passed as name = "column" pairs, e.g. mean = "x1"
+  aggs <- list(...)
+  idx <- sapply(by, function(c) .h2o.col_index(data, c))
+  agg_str <- paste(mapply(function(fn, col) sprintf(
+    "\"%s\" %d \"all\"", fn, .h2o.col_index(data, col)),
+    names(aggs), unlist(aggs)), collapse = " ")
+  .h2o.frame_op(sprintf("(GB %s [%s] %s)", data$frame_id,
+                        paste(idx, collapse = " "), agg_str))
+}
+
+h2o.quantile <- function(fr, probs = c(0.1, 0.25, 0.5, 0.75, 0.9)) {
+  as.data.frame(.h2o.frame_op(sprintf(
+    "(quantile %s [%s] 'interpolate')", fr$frame_id,
+    paste(probs, collapse = " "))))
+}
+
+h2o.sum <- function(fr, col) .h2o.frame_expr(
+  sprintf("(sumaxis (cols %s '%s') true 0)", fr$frame_id, col))
+h2o.sd <- function(fr, col) .h2o.frame_expr(
+  sprintf("(sd (cols %s '%s') true)", fr$frame_id, col))
+h2o.var <- function(fr, col) .h2o.frame_expr(
+  sprintf("(var (cols %s '%s') true)", fr$frame_id, col))
+h2o.min <- function(fr, col) .h2o.frame_expr(
+  sprintf("(min (cols %s '%s') true)", fr$frame_id, col))
+h2o.max <- function(fr, col) .h2o.frame_expr(
+  sprintf("(max (cols %s '%s') true)", fr$frame_id, col))
+
+h2o.cut <- function(fr, breaks) .h2o.frame_op(sprintf(
+  "(cut %s [%s] [] false true 3)", fr$frame_id,
+  paste(breaks, collapse = " ")))
+
+h2o.scale <- function(fr, center = TRUE, scale = TRUE) .h2o.frame_op(
+  sprintf("(scale %s %s %s)", fr$frame_id,
+          tolower(as.character(center)), tolower(as.character(scale))))
+
+h2o.impute <- function(fr, column = 0, method = "mean") .h2o.frame_expr(
+  sprintf("(h2o.impute %s %d '%s' 'interpolate' [] _ _)", fr$frame_id,
+          if (is.character(column)) .h2o.col_index(fr, column)
+          else if (column <= 0) -1L  # 0/negative = all columns (server -1)
+          else as.integer(column) - 1L,  # R is 1-based
+          method))
+
+h2o.createFrame <- function(rows = 100, cols = 4, seed = -1,
+                            categorical_fraction = 0.2, factors = 5,
+                            missing_fraction = 0) {
+  job <- .h2o.request("POST", "/3/CreateFrame",
+                      body = list(rows = rows, cols = cols, seed = seed,
+                                  categorical_fraction = categorical_fraction,
+                                  factors = factors,
+                                  missing_fraction = missing_fraction))
+  done <- .h2o.poll(job)
+  h2o.getFrame(done$dest$name)
+}
+
+h2o.insertMissingValues <- function(fr, fraction = 0.1, seed = -1) {
+  job <- .h2o.request("POST", "/3/MissingInserter",
+                      body = list(dataset = fr$frame_id, fraction = fraction,
+                                  seed = seed))
+  .h2o.poll(job)
+  fr
+}
+
+h2o.assign <- function(fr, key) {
+  .h2o.request("POST", "/99/Rapids",
+               body = list(ast = sprintf("(assign %s %s)", key, fr$frame_id)))
+  h2o.getFrame(key)
+}
+
+# -- grid search (`h2o-r` h2o.grid / h2o.getGrid) ----------------------------
+h2o.grid <- function(algorithm, grid_id = NULL, x = NULL, y = NULL,
+                     training_frame, hyper_params = list(), ...) {
+  body <- list(...)
+  body$response_column <- y
+  body$training_frame <- training_frame$frame_id
+  if (!is.null(x)) {
+    all_cols <- h2o.colnames(training_frame)
+    body$ignored_columns <- setdiff(all_cols, c(x, y))
+  }
+  body$hyper_parameters <- hyper_params
+  if (!is.null(grid_id)) body$grid_id <- grid_id
+  job <- .h2o.request("POST", paste0("/99/Grid/", algorithm), body = body)
+  done <- .h2o.poll(job)
+  h2o.getGrid(done$dest$name)
+}
+
+h2o.getGrid <- function(grid_id) {
+  g <- .h2o.request("GET", paste0("/99/Grids/", grid_id))
+  structure(list(grid_id = grid_id,
+                 model_ids = sapply(g$model_ids, function(m) m$name),
+                 summary_table = g$summary_table),
+            class = "H2OGrid")
+}
+
+# -- AutoML (`h2o-r` h2o.automl) ---------------------------------------------
+h2o.automl <- function(x = NULL, y, training_frame, max_models = 0,
+                       max_runtime_secs = 0, nfolds = 5, seed = -1,
+                       include_algos = NULL, exclude_algos = NULL,
+                       project_name = NULL) {
+  spec <- list(training_frame = training_frame$frame_id, response_column = y)
+  if (!is.null(x)) {
+    all_cols <- h2o.colnames(training_frame)
+    spec$ignored_columns <- setdiff(all_cols, c(x, y))
+  }
+  body <- list(
+    input_spec = spec,
+    build_control = list(
+      project_name = project_name, nfolds = nfolds,
+      stopping_criteria = list(max_models = max_models,
+                               max_runtime_secs = max_runtime_secs,
+                               seed = seed)),
+    build_models = list(include_algos = include_algos,
+                        exclude_algos = exclude_algos))
+  job <- .h2o.request("POST", "/99/AutoMLBuilder", body = body)
+  .h2o.poll(job)
+  project <- job$build_control$project_name
+  lb <- .h2o.request("GET", paste0("/99/Leaderboards/", project))
+  leader_id <- lb$models[[1]]$name
+  structure(list(project_name = project, leaderboard = lb$table,
+                 leader = h2o.getModel(leader_id)), class = "H2OAutoML")
+}
+
+h2o.get_leaderboard <- function(aml) aml$leaderboard
+
+# -- performance objects (`h2o-r` h2o.performance on new data) ---------------
+h2o.performance <- function(model, newdata = NULL,
+                            metric = "training_metrics") {
+  if (is.null(newdata)) {
+    mm <- model$schema$output[[metric]]
+  } else {
+    res <- .h2o.request("POST", sprintf("/3/ModelMetrics/models/%s/frames/%s",
+                                        model$model_id, newdata$frame_id))
+    mm <- res$model_metrics[[1]]
+  }
+  structure(mm, class = "H2OModelMetrics")
+}
+
+h2o.auc <- function(obj, ...) {
+  if (inherits(obj, "H2OModelMetrics")) return(obj$AUC)
+  h2o.performance(obj, ...)$AUC
+}
+h2o.rmse <- function(obj, ...) {
+  if (inherits(obj, "H2OModelMetrics")) return(obj$RMSE)
+  h2o.performance(obj, ...)$RMSE
+}
+h2o.logloss <- function(obj, ...) {
+  if (inherits(obj, "H2OModelMetrics")) return(obj$logloss)
+  h2o.performance(obj, ...)$logloss
+}
+h2o.mse <- function(obj, ...) {
+  if (inherits(obj, "H2OModelMetrics")) return(obj$MSE)
+  h2o.performance(obj, ...)$MSE
+}
+h2o.aucpr <- function(obj, ...) {
+  if (inherits(obj, "H2OModelMetrics")) return(obj$pr_auc)
+  h2o.performance(obj, ...)$pr_auc
+}
+h2o.giniCoef <- function(obj, ...) {
+  if (inherits(obj, "H2OModelMetrics")) return(obj$Gini)
+  h2o.performance(obj, ...)$Gini
+}
+h2o.gainsLift <- function(model) h2o.performance(model)$gains_lift_table
+
+h2o.scoreHistory <- function(model) model$schema$output$scoring_history
+h2o.coef <- function(model) {
+  t <- model$schema$output$coefficients_table
+  stats::setNames(unlist(t$coefficients), unlist(t$names))
+}
+h2o.coef_norm <- function(model) {
+  t <- model$schema$output$coefficients_table
+  stats::setNames(unlist(t$standardized_coefficients), unlist(t$names))
+}
+
+h2o.cross_validation_models <- function(model) {
+  cvs <- model$schema$output$cross_validation_models
+  if (is.null(cvs)) return(NULL)
+  lapply(cvs, function(m) h2o.getModel(m$name))
+}
+
+h2o.download_mojo <- function(model, path = getwd()) .h2o.request(
+  "GET", paste0("/3/Models/", model$model_id, "/mojo"),
+  params = list(dir = file.path(path, paste0(model$model_id, ".zip"))))$dir
+
+h2o.import_mojo <- function(path) {
+  # `h2o-r` h2o.import_mojo: a Generic model over the server-side zip
+  job <- .h2o.request("POST", "/3/ModelBuilders/generic",
+                      body = list(path = path))
+  done <- .h2o.poll(job)
+  h2o.getModel(done$dest$name)
+}

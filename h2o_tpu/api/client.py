@@ -1692,6 +1692,22 @@ class H2OModelClient:
         j = connection().request("POST", "/3/PartialDependence", params=params)
         return j["partial_dependence_data"]
 
+    def fairness_metrics(self, frame: "H2OFrame", protected_columns,
+                         reference, favorable_class) -> dict:
+        """Intersectional fairness metrics (`h2o-py fairness_metrics` /
+        the `fairnessMetrics` rapids prim): dict of H2OFrames keyed
+        'overview' + per-group threshold tables."""
+        def _sl(xs):
+            return "[" + " ".join(f'"{x}"' for x in xs) + "]"
+
+        expr = (f'(fairnessMetrics "{self.model_id}" {frame.frame_id} '
+                f"{_sl(protected_columns)} "
+                f"{_sl(reference) if reference else '[]'} "
+                f'"{favorable_class}")')
+        j = rapids(expr)
+        return {name: H2OFrame._by_id(f["key"]["name"])
+                for name, f in zip(j["map_keys"]["string"], j["frames"])}
+
     def scoring_history(self, use_pandas: bool = True):
         """The model's scoring-history table (`model.scoring_history()`)."""
         sh = ((self._schema or {}).get("output") or {}).get("scoring_history")

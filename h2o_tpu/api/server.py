@@ -2412,6 +2412,16 @@ def _dest_name(path: str) -> str:
 
 def _rapids_result(result) -> dict:
     """ValFrame/ValNum/ValStr serialization (`water/rapids/val/*`)."""
+    if isinstance(result, dict) and result and all(
+            isinstance(v, Frame) for v in result.values()):
+        # ValMapFrame (`RapidsMapFrameV3`): named frames, DKV-published
+        frames = []
+        for v in result.values():
+            STORE.put_keyed(v)
+            frames.append({"key": schemas.key_schema(v.key)})
+        return {"key": None, "string": None, "scalar": None,
+                "map_keys": {"string": list(result.keys())},
+                "frames": frames}
     if isinstance(result, Frame):
         STORE.put_keyed(result)
         return {"key": schemas.key_schema(result.key),

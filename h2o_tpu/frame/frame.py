@@ -25,6 +25,11 @@ from ..parallel import mesh as meshmod
 from .vec import T_CAT, T_NUM, Vec
 
 
+@jax.jit
+def _stack_cols(*cols):
+    return jnp.stack(cols, axis=1)
+
+
 class Frame(Keyed):
     def __init__(self, names: Sequence[str] | None = None,
                  vecs: Sequence[Vec] | None = None, key: str | None = None):
@@ -176,11 +181,15 @@ class Frame(Keyed):
 
     # -- device materialization ----------------------------------------------
     def as_matrix(self, names: Sequence[str] | None = None) -> jax.Array:
-        """Stack columns into a row-sharded (plen, ncol) float32 matrix."""
+        """Stack columns into a row-sharded (plen, ncol) float32 matrix.
+
+        One jitted program per column count: the eager jnp.stack emitted
+        several chunked-concatenate XLA programs, each paying ~1 s of cold
+        compile+load through the device tunnel."""
         names = list(names) if names is not None else self._names
         cols = [self.vec(n) for n in names]
         assert all(c.data is not None for c in cols), "string cols can't go to HBM"
-        return jnp.stack([c.data for c in cols], axis=1)
+        return _stack_cols(*[c.data for c in cols])
 
     # -- host views ----------------------------------------------------------
     def to_pandas(self):

@@ -44,21 +44,105 @@ from .model_base import Model, ModelBuilder, ModelOutput, make_metrics
 # ---------------------------------------------------------------------------
 # B-spline basis (pure numpy Cox–de Boor, vectorized over rows)
 # ---------------------------------------------------------------------------
-def bspline_knots(x: np.ndarray, num_knots: int):
-    """Interior knots at quantiles + boundary from data range."""
-    x = x[~np.isnan(x)]
-    lo, hi = float(x.min()), float(x.max())
-    if hi <= lo:
-        hi = lo + 1.0
-    qs = np.linspace(0, 1, num_knots + 2)[1:-1]
-    interior = np.unique(np.quantile(x, qs))
-    return lo, hi, interior.astype(np.float64)
-
-
 def diff_penalty(n_basis: int, order: int = 2) -> np.ndarray:
     """P-spline penalty DᵀD (2nd-order differences of adjacent coefficients)."""
     D = np.diff(np.eye(n_basis), n=order, axis=0)
     return D.T @ D
+
+
+# ---------------------------------------------------------------------------
+# device-side basis evaluation — mirrors `mojo/format.py`'s numpy versions
+# (which stay as the zero-JAX standalone MOJO scorer). The numpy path pulled
+# every gam column AND the full linear design through the device tunnel and
+# pushed the concatenated design back — multiple GB per _design call at
+# benchmark scale (GAM higgs measured 227 s warm on exactly this; the basis
+# math itself is trivial).
+# ---------------------------------------------------------------------------
+def _cr_basis_dev(x, knots, F):
+    """Natural cubic regression spline, values-at-knots parameterization."""
+    knots = jnp.asarray(knots, jnp.float32)
+    K = knots.shape[0]
+    x = jnp.clip(jnp.nan_to_num(x, nan=knots[K // 2]), knots[0], knots[-1])
+    j = jnp.clip(jnp.searchsorted(knots, x, side="right") - 1, 0, K - 2)
+    kj = jnp.take(knots, j)
+    kj1 = jnp.take(knots, j + 1)
+    h = kj1 - kj
+    am = (kj1 - x) / h
+    ap = (x - kj) / h
+    cm = ((kj1 - x) ** 3 / h - h * (kj1 - x)) / 6.0
+    cp = ((x - kj) ** 3 / h - h * (x - kj)) / 6.0
+    oh_j = jax.nn.one_hot(j, K, dtype=jnp.float32)
+    oh_j1 = jax.nn.one_hot(j + 1, K, dtype=jnp.float32)
+    Fj = jnp.asarray(F, jnp.float32)
+    # row j of F per x via one-hot matmul (no per-row gathers)
+    F_j = oh_j @ Fj
+    F_j1 = oh_j1 @ Fj
+    return (oh_j * am[:, None] + oh_j1 * ap[:, None]
+            + cm[:, None] * F_j + cp[:, None] * F_j1)
+
+
+def _bspline_basis_dev(x, lo, hi, interior, degree: int = 3):
+    """Cox-de-Boor B-splines; NA/out-of-range clamp to the boundary."""
+    lo, hi = float(lo), float(hi)
+    interior = np.asarray(interior, np.float64)
+    x = jnp.clip(jnp.nan_to_num(x, nan=(lo + hi) / 2), lo, hi)
+    t = np.concatenate([[lo] * (degree + 1), interior, [hi] * (degree + 1)])
+    n_basis = len(interior) + degree + 1
+    cols = []
+    for i in range(len(t) - 1):
+        if t[i + 1] > t[i]:
+            right_closed = t[i + 1] == hi
+            c = (x >= t[i]) & ((x < t[i + 1]) | right_closed)
+            cols.append(c.astype(jnp.float32))
+        else:
+            cols.append(jnp.zeros_like(x))
+    B = jnp.stack(cols, axis=1)
+    for d in range(1, degree + 1):
+        nxt = []
+        for i in range(len(t) - 1 - d):
+            left = 0.0
+            if t[i + d] > t[i]:
+                left = (x - t[i]) / (t[i + d] - t[i]) * B[:, i]
+            right = 0.0
+            if t[i + d + 1] > t[i + 1]:
+                right = (t[i + d + 1] - x) / (t[i + d + 1] - t[i + 1]) \
+                    * B[:, i + 1]
+            # left/right may both be the scalar 0.0 (repeated knots)
+            nxt.append(jnp.zeros_like(x) + left + right)
+        B = jnp.stack(nxt, axis=1)
+    return B[:, :n_basis]
+
+
+def _gam_basis_dev(x, spec):
+    """Device twin of `mojo.format.gam_basis` (same spec dict)."""
+    bs = int(spec.get("bs", 3))
+    if bs == 0:
+        return _cr_basis_dev(x, spec["knots"], spec["F"])
+    if bs == 1:
+        knots = jnp.asarray(spec["knots"], jnp.float32)
+        scale = float(spec["tp_scale"])
+        xm = jnp.nan_to_num(x, nan=float(np.median(np.asarray(spec["knots"]))))
+        r = jnp.abs(xm[:, None] - knots[None, :]) / scale
+        Z = jnp.asarray(np.asarray(spec["Z"]), jnp.float32)
+        return jnp.concatenate([(r ** 3) @ Z, (xm / scale)[:, None]], axis=1)
+    if bs == 2:
+        B = _bspline_basis_dev(x, spec["lo"], spec["hi"], spec["interior"],
+                               spec["degree"])
+        I = jnp.cumsum(B[:, ::-1], axis=1)[:, ::-1]
+        return I[:, 1:]
+    return _bspline_basis_dev(x, spec["lo"], spec["hi"], spec["interior"],
+                              spec["degree"])
+
+
+def _device_quantiles(col_data, qs) -> np.ndarray:
+    """Per-column quantiles via the binning sketch — only (nq,) floats cross
+    to the host (np.quantile pulled the whole column)."""
+    from .tree.binning import _hist_quantile_rows, _pow2_block
+
+    X = col_data[:, None]
+    rb = _pow2_block(X.shape[0], 1024)
+    return np.asarray(_hist_quantile_rows(X, tuple(float(q) for q in qs),
+                                          rb=rb))[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -105,18 +189,23 @@ class GAMModel(Model):
         super().__init__(params, output, key=key)
 
     def _design(self, fr: Frame):
+        """Design matrix fully ON DEVICE: linear block from DataInfo.expand
+        plus the spline bases via `_gam_basis_dev`. (The earlier numpy path
+        shipped the whole design through the device tunnel twice per call —
+        the entire GAM-vs-band gap at benchmark scale.)"""
         blocks = []
         if self.dinfo is not None and self.dinfo.names:
             Xlin, _ = self.dinfo.expand(fr)
-            blocks.append(np.asarray(Xlin))
-        nref = blocks[0].shape[0] if blocks else fr.vec(0).plen
+            blocks.append(Xlin)
+        nref = int(blocks[0].shape[0]) if blocks else fr.vec(0).plen
         for spec in self.gam_specs:
-            x = fr.vec(spec["column"]).to_numpy().astype(np.float64)
-            B = gam_basis(x, spec)
-            B = B - np.asarray(spec["col_means"])[None, :]  # centering
-            pad = np.zeros((nref - B.shape[0], B.shape[1]))
-            blocks.append(np.vstack([B, pad]).astype(np.float32))
-        return jnp.asarray(np.concatenate(blocks, axis=1))
+            B = _gam_basis_dev(fr.vec(spec["column"]).data, spec)
+            B = B - jnp.asarray(np.asarray(spec["col_means"]),
+                                jnp.float32)[None, :]  # centering
+            if B.shape[0] != nref:
+                B = jnp.pad(B, ((0, nref - B.shape[0]), (0, 0)))
+            blocks.append(B.astype(jnp.float32))
+        return jnp.concatenate(blocks, axis=1)
 
     def adapt_frame(self, fr: Frame):
         return self._design(self.pre_adapt(fr))
@@ -175,23 +264,26 @@ class GAM(ModelBuilder):
                  if lin_names else None)
 
         # build spline specs (basis family per column) + per-block penalties
+        # — knot quantiles come off the device sketch (only K floats cross),
+        # basis evaluation and column means stay on device
         gam_specs, pen_sizes, pen_blocks, mono_blocks = [], [], [], []
         for j, c in enumerate(p.gam_columns):
-            x = fr.vec(c).to_numpy().astype(np.float64)
+            v = fr.vec(c)
+            r = v.rollups()
+            xmin, xmax = float(r.mins), float(r.maxs)
             bs = p.bs_for(j)
             if bs not in (0, 1, 2, 3):
                 raise ValueError(f"gam: bs={bs} unknown (0=cr, 1=thin plate, "
                                  f"2=monotone I-splines, 3=M/P-splines)")
             scale = p.scale_for(j)
+            if bs in (0, 1):
+                K = max(p.knots_for(j), 3)
+                knots = np.unique(_device_quantiles(
+                    v.data, np.linspace(0, 1, K)).astype(np.float64))
+                if len(knots) < 3:  # degenerate quantiles: span the DATA
+                    knots = np.linspace(xmin, max(xmax, xmin + 1.0), 3)
             if bs == 0:
                 # cr: knots at quantiles spanning the data; penalty DᵀB⁻¹D
-                xs = x[~np.isnan(x)]
-                K = max(p.knots_for(j), 3)
-                knots = np.unique(np.quantile(xs, np.linspace(0, 1, K)))
-                if len(knots) < 3:  # degenerate quantiles: span the DATA
-                    knots = np.linspace(float(xs.min()),
-                                        max(float(xs.max()),
-                                            float(xs.min()) + 1.0), 3)
                 F, S_blk = cr_matrices(knots)
                 spec = dict(column=c, bs=0, knots=knots, F=F, scale=scale)
             elif bs == 1:
@@ -199,9 +291,6 @@ class GAM(ModelBuilder):
                 # penalty) + unpenalized linear null space
                 from ..mojo.format import tp_constraint
 
-                xs = x[~np.isnan(x)]
-                K = max(p.knots_for(j), 3)
-                knots = np.unique(np.quantile(xs, np.linspace(0, 1, K)))
                 tp_scale = max(float(knots[-1] - knots[0]), 1e-12)
                 Z, S_rad = tp_constraint(knots, tp_scale)
                 nb = S_rad.shape[0] + 1  # projected radial + linear
@@ -210,16 +299,22 @@ class GAM(ModelBuilder):
                 spec = dict(column=c, bs=1, knots=knots, tp_scale=tp_scale,
                             Z=Z, scale=scale)
             else:
-                lo, hi, interior = bspline_knots(x, p.knots_for(j))
+                lo = xmin
+                hi = xmax if xmax > xmin else xmin + 1.0
+                qs = np.linspace(0, 1, max(p.knots_for(j), 1) + 2)[1:-1]
+                interior = np.unique(_device_quantiles(v.data, qs)
+                                     .astype(np.float64))
                 spec = dict(column=c, bs=bs, lo=lo, hi=hi, interior=interior,
                             degree=p.spline_degree, scale=scale)
                 nb = len(interior) + p.spline_degree + 1 - (1 if bs == 2
                                                             else 0)
                 S_blk = diff_penalty(nb)
-            B = gam_basis(x, spec)
-            spec["col_means"] = B.mean(axis=0)
+            B = _gam_basis_dev(v.data, spec)
+            # means over REAL rows only (padding rows clamp to mid-knot)
+            spec["col_means"] = np.asarray(
+                jnp.mean(B[: fr.nrow], axis=0), np.float64)
             gam_specs.append(spec)
-            pen_sizes.append(B.shape[1])
+            pen_sizes.append(int(B.shape[1]))
             pen_blocks.append(scale * S_blk)
             mono_blocks.append(bs == 2 and p.nonneg_for(j))
 

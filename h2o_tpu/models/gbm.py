@@ -19,6 +19,7 @@ UniformAdaptive/Random selectable (see tree/binning.py).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from dataclasses import dataclass
 
 import jax
@@ -397,16 +398,14 @@ class GBM(ModelBuilder):
 
         X = fr.as_matrix(names)
         is_cat = np.array([fr.vec(n).is_categorical() for n in names])
-        if p.weights_column:
-            w_host = np.nan_to_num(fr.vec(p.weights_column).to_numpy())
-            w = jnp.nan_to_num(Vec.from_numpy(w_host).data)  # padding -> 0
-        else:
-            # device-side ones: no 4·R-byte host→device trip; padding rows
-            # zero out through the response mask below (padding y is NaN)
-            w = jnp.ones_like(y_dev, dtype=jnp.float32)
-        y = jnp.nan_to_num(y_dev)
-        ymask = ~jnp.isnan(y_dev)
-        w = w * ymask.astype(jnp.float32)
+        w_in = (jnp.nan_to_num(
+            Vec.from_numpy(np.nan_to_num(
+                fr.vec(p.weights_column).to_numpy())).data)
+            if p.weights_column else None)
+        # ONE compiled program for the y/w/mask prep — the per-op eager
+        # version paid a fixed ~1 s compile+load per tiny program through
+        # the device tunnel on a cold process (round-3's cold-start wall)
+        y, ymask, w, ym = _jit_prep(y_dev, w_in)
 
         edges_np = compute_bin_edges(
             X, is_cat, p.nbins,
@@ -432,15 +431,9 @@ class GBM(ModelBuilder):
         edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))
         Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
 
-        # initial prediction (`hex/tree/gbm/GBM.java:265` init)
-        if self.drf_mode:
-            f0 = jnp.zeros((K,)) if K > 1 else jnp.array(0.0)
-        elif K > 1:
-            counts = jnp.array([jnp.sum(w * (y == k)) for k in range(K)])
-            pri = counts / jnp.maximum(jnp.sum(counts), 1e-10)
-            f0 = jnp.log(jnp.maximum(pri, 1e-10))
-        else:
-            f0 = jnp.nan_to_num(dist.init_f(y, w))
+        # initial prediction (`hex/tree/gbm/GBM.java:265` init) — one
+        # compiled program per (drf, K, distribution) family
+        f0 = _jit_init_f(self.drf_mode, K, dist, y, w)
 
         grad_fn = self._make_grad_fn(dist, K)
         # effective bin count follows the edge matrix: small-data exact
@@ -487,11 +480,11 @@ class GBM(ModelBuilder):
             f = jnp.broadcast_to(f0[:, None], (K, y.shape[0])).astype(jnp.float32)
         else:
             y_k = y
-            f = jnp.full_like(y, f0, dtype=jnp.float32)
+            f = _jit_full_like(y, f0)
         return _types.SimpleNamespace(
             p=p, fr=fr, names=names, category=category,
             resp_domain=resp_domain, dist=dist, K=K, X=X, is_cat=is_cat,
-            w=w, y=y, ymask=ymask, edges_np=edges_np, mesh=mesh,
+            w=w, y=y, ymask=ymask, ym=ym, edges_np=edges_np, mesh=mesh,
             edges=edges, mono=mono, imat=imat, edge_ok=edge_ok, Xb=Xb,
             f0=f0, grad_fn=grad_fn, cfg=cfg, grad_key=grad_key, y_k=y_k,
             f=f, iscat_dev=iscat_dev, nedges_dev=nedges_dev,
@@ -564,8 +557,7 @@ class GBM(ModelBuilder):
         n_prior = prior.ntrees if prior else 0
         n_new = p.ntrees - n_prior
         base_seed = p.seed if p.seed not in (-1, None) else 1234
-        all_keys = jax.random.split(jax.random.PRNGKey(base_seed),
-                                    p.ntrees)[n_prior:]
+        all_keys = _jit_keys(base_seed, p.ntrees)[n_prior:]
         # learn_rate_annealing: rate_i = annealing^i (GBM.java lr schedule);
         # indices continue across chunks and checkpoint restarts. DRF has no
         # learning rate at all — leaves are response means — so annealing is
@@ -620,7 +612,7 @@ class GBM(ModelBuilder):
                 if m is not None:
                     m.description = "Reported on OOB data"
             if m is None:
-                m = make_metrics(category, jnp.where(ymask, y, jnp.nan),
+                m = make_metrics(category, s.ym,
                                  _metrics_raw(category, dist, f,
                                               self.drf_mode, ntrees_done),
                                  None if p.weights_column is None else w)
@@ -799,6 +791,65 @@ class GBM(ModelBuilder):
         }
 
 
+@jax.jit
+def _jit_full_like(y, f0):
+    return jnp.full_like(y, f0, dtype=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _jit_keys(seed, n: int):
+    """PRNGKey + split in one program (eagerly: 2 programs + a slice)."""
+    return jax.random.split(jax.random.PRNGKey(seed), n)
+
+
+_PREP_CACHE: dict = {}
+
+
+def _jit_prep(y_dev, w_in):
+    """(y, ymask, w, ym) in ONE compiled program (eagerly these were ~6
+    tiny programs, each paying the per-program cold cost)."""
+    has_w = w_in is not None
+    fn = _PREP_CACHE.get(has_w)
+    if fn is None:
+        def prep(y_dev, w_in):
+            y = jnp.nan_to_num(y_dev)
+            ymask = ~jnp.isnan(y_dev)
+            base = w_in if has_w else jnp.ones_like(y, dtype=jnp.float32)
+            w = base * ymask.astype(jnp.float32)
+            ym = jnp.where(ymask, y, jnp.nan)  # metrics actuals, hoisted
+            return y, ymask, w, ym
+        fn = _PREP_CACHE.setdefault(has_w, jax.jit(prep))
+    return fn(y_dev, w_in)
+
+
+_INIT_F_CACHE: dict = {}
+
+
+def _jit_init_f(drf_mode, K, dist, y, w):
+    builtin = type(dist).__module__.endswith("models.distributions")
+    # the closure captures the dist OBJECT, so every parameter its init_f
+    # reads must pin the cache key (quantile's alpha; tweedie's power);
+    # custom distribution objects bypass the cache entirely
+    key = (drf_mode, K, getattr(dist, "name", None),
+           getattr(dist, "alpha", None), getattr(dist, "p", None),
+           getattr(dist, "power", None))
+    fn = _INIT_F_CACHE.get(key) if builtin else None
+    if fn is None:
+        def init(y, w):
+            if drf_mode:
+                return jnp.zeros((K,)) if K > 1 else jnp.array(0.0)
+            if K > 1:
+                counts = jnp.stack([jnp.sum(w * (y == k))
+                                    for k in range(K)])
+                pri = counts / jnp.maximum(jnp.sum(counts), 1e-10)
+                return jnp.log(jnp.maximum(pri, 1e-10))
+            return jnp.nan_to_num(dist.init_f(y, w))
+        fn = jax.jit(init)
+        if builtin:
+            fn = _INIT_F_CACHE.setdefault(key, fn)
+    return fn(y, w)
+
+
 def _heap_path(node: int) -> str:
     """Heap index → root-to-leaf L/R path string ('' for the root)."""
     return "".join("R" if b == "1" else "L" for b in bin(node + 1)[3:])
@@ -857,19 +908,43 @@ def _interaction_matrix(names, groups) -> np.ndarray:
     return M
 
 
+#: cached jitted link->score0 conversions — the eager version cost one tiny
+#: XLA program per op (exp/where/stack/...), each paying ~1 s of fixed
+#: compile+load latency through the device tunnel on a cold process
+_METRICS_RAW_CACHE: dict = {}
+
+
 def _metrics_raw(category, dist, f, drf_mode, ntrees):
-    """Convert carried link predictions to the score0 output layout."""
-    if category == "Regression":
-        # DRF carries the SUM of per-tree leaf means; the prediction is the
-        # average (prediction path divides in _raw_f — metrics must too)
-        return f / max(ntrees, 1) if drf_mode else dist.linkinv(f)
-    if category == "Binomial":
-        p1 = dist.linkinv(f) if not drf_mode else jnp.clip(f / max(ntrees, 1), 0, 1)
-        return jnp.stack([(p1 > 0.5).astype(jnp.float32), 1 - p1, p1], axis=1)
-    if drf_mode:
-        p = jnp.clip(f.T / max(ntrees, 1), 1e-9, 1.0)
-        p = p / jnp.sum(p, axis=1, keepdims=True)
-    else:
-        p = jax.nn.softmax(f, axis=0).T
-    label = jnp.argmax(p, axis=1).astype(jnp.float32)
-    return jnp.concatenate([label[:, None], p], axis=1)
+    """Convert carried link predictions to the score0 output layout —
+    ONE compiled program per (category, dist, drf) shape family; the tree
+    count rides as a traced scalar so DRF chunks never recompile."""
+    builtin = type(dist).__module__.endswith("models.distributions")
+    key = (category, getattr(dist, "name", None), drf_mode)
+    # only BUILTIN distributions cache (their behavior is pinned by name —
+    # a user's custom object has no stable identity a value-key could
+    # capture, and an id() key could alias a recycled address)
+    fn = _METRICS_RAW_CACHE.get(key) if builtin else None
+    if fn is None:
+        def raw(f, nt):
+            if category == "Regression":
+                # DRF carries the SUM of per-tree leaf means; the
+                # prediction is the average (prediction path divides in
+                # _raw_f — metrics must too)
+                return f / nt if drf_mode else dist.linkinv(f)
+            if category == "Binomial":
+                p1 = (dist.linkinv(f) if not drf_mode
+                      else jnp.clip(f / nt, 0, 1))
+                return jnp.stack([(p1 > 0.5).astype(jnp.float32),
+                                  1 - p1, p1], axis=1)
+            if drf_mode:
+                p = jnp.clip(f.T / nt, 1e-9, 1.0)
+                p = p / jnp.sum(p, axis=1, keepdims=True)
+            else:
+                p = jax.nn.softmax(f, axis=0).T
+            label = jnp.argmax(p, axis=1).astype(jnp.float32)
+            return jnp.concatenate([label[:, None], p], axis=1)
+
+        fn = jax.jit(raw)
+        if builtin:
+            fn = _METRICS_RAW_CACHE.setdefault(key, fn)
+    return fn(f, jnp.float32(max(ntrees, 1)))

@@ -14,6 +14,7 @@ and threshold-criterion maximization (F1, accuracy, MCC...) over the bins.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -163,8 +164,9 @@ class ModelMetricsMultinomial(ModelMetrics):
 # ---------------------------------------------------------------------------
 def make_regression_metrics(y, pred, weights=None) -> ModelMetricsRegression:
     """y/pred: padded sharded arrays (NaN padding); weights optional."""
-    w = _weights(y, weights)
-    r = jax.device_get(_regression_kernel(jnp.nan_to_num(y), jnp.nan_to_num(pred), w))
+    r = jax.device_get(_fused_metric_kernel(
+        y, pred, weights if weights is not None else y,
+        _regression_kernel, weights is not None))
     mse = float(r["mse"])
     ss_tot = float(r["ss_tot"])
     return ModelMetricsRegression(
@@ -177,8 +179,9 @@ def make_regression_metrics(y, pred, weights=None) -> ModelMetricsRegression:
 
 def make_binomial_metrics(y, p, weights=None) -> ModelMetricsBinomial:
     """y in {0,1} (padded NaN), p = P(class 1)."""
-    w = _weights(y, weights)
-    r = jax.device_get(_binomial_hist_kernel(jnp.nan_to_num(y), jnp.nan_to_num(p), w))
+    r = jax.device_get(_fused_metric_kernel(
+        y, p, weights if weights is not None else y,
+        _binomial_hist_kernel, weights is not None))
     pos, neg = r["pos"], r["neg"]
     npos, nneg = float(r["npos"]), float(r["nneg"])
     n = float(r["n"])
@@ -295,8 +298,9 @@ def _gains_lift(pos, neg, npos, n, groups: int = 16):
 
 
 def make_multinomial_metrics(y, probs, weights=None) -> ModelMetricsMultinomial:
-    w = _weights(y, weights)
-    r = jax.device_get(_multinomial_kernel(jnp.nan_to_num(y), probs, w))
+    r = jax.device_get(_fused_metric_kernel(
+        y, probs, weights if weights is not None else y,
+        _multinomial_kernel, weights is not None))
     n = float(r["n"])
     cm = r["cm"]
     per_class_err = 1.0 - np.diag(cm) / np.maximum(cm.sum(axis=1), 1e-10)
@@ -317,3 +321,15 @@ def _weights(y, weights):
     if weights is not None:
         base = base * jnp.nan_to_num(weights)
     return base
+
+
+@functools.partial(jax.jit, static_argnames=("kernel", "has_w"))
+def _fused_metric_kernel(y, pred, weights, kernel, has_w):
+    """NaN masking + weight prep + the metric kernel in ONE program —
+    eagerly the prelude cost 4-5 tiny XLA programs per metrics family,
+    each paying ~1 s of cold compile+load through the device tunnel."""
+    base = (~jnp.isnan(y)).astype(jnp.float32)
+    w = base * jnp.nan_to_num(weights) if has_w else base
+    return kernel(jnp.nan_to_num(y),
+                  pred if kernel is _multinomial_kernel
+                  else jnp.nan_to_num(pred), w)

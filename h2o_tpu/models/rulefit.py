@@ -123,8 +123,182 @@ def eval_rules(X, fidx, thr, is_gt, na_left, act):
     return jnp.all(cond, axis=2).astype(jnp.float32)
 
 
+# ---------------------------------------------------------------------------
+# Streaming mode — benchmark scale. At 11M rows a ~700-rule design is ~30 GB
+# (and eval_rules' (rows, rules, conds) gather intermediate ~90 GB): neither
+# fits HBM. Instead the design exists only per row BLOCK inside one scanned
+# program: rules evaluate via a condition-slot one-hot matmul (no gathers),
+# the IRLS Gram/XWz accumulate across blocks, and scoring streams the same
+# way. The (P, P) Gram is all that ever materializes.
+# ---------------------------------------------------------------------------
+def _build_design_block(xb, fidx, thr, gt, nal, act, lsel, mu_l, sg_l):
+    """(rb, F) raw block -> (rb, P) design block, all matmul/elementwise.
+
+    Rule conditions select their feature through a (R*L, F) one-hot — the
+    engine's standard no-gather idiom — then compare/AND-reduce; linear
+    terms standardize with NA -> mean imputation like RuleFitModel._design.
+    Every tensor is an ARGUMENT (not a baked closure constant): one compiled
+    program serves every fitted rule set of the same shape, so refits only
+    pay tracing once per process (a per-fit closure re-traced and re-loaded
+    several programs per call — most of RuleFit's warm benchmark wall).
+    """
+    F = xb.shape[1]
+    xz = jnp.nan_to_num(xb)
+    nanb = jnp.isnan(xb).astype(jnp.float32)
+
+    def pick(M):
+        # value selection must stay f32-exact: the MXU's default bf16
+        # multiply would round values across rule thresholds (engine.py's
+        # hi/lo trick)
+        hi = xz.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = xz - hi
+        return hi @ M.T + lo @ M.T
+
+    blocks = []
+    if fidx.shape[0]:
+        R, L = fidx.shape
+        SEL = jax.nn.one_hot(fidx.reshape(-1), F, dtype=jnp.float32)
+        v = pick(SEL)                                 # (rb, R*L)
+        isna = (nanb @ SEL.T) > 0.5
+        le = jnp.where(isna, nal.reshape(-1)[None, :],
+                       v <= thr.reshape(-1)[None, :])
+        cond = jnp.where(gt.reshape(-1)[None, :], ~le, le)
+        cond = jnp.where(act.reshape(-1)[None, :], cond, True)
+        memb = jnp.all(cond.reshape(xb.shape[0], R, L), axis=2)
+        blocks.append(memb.astype(jnp.float32))
+    if lsel.shape[0]:
+        LSEL = jax.nn.one_hot(lsel, F, dtype=jnp.float32)
+        lv = pick(LSEL)
+        lna = (nanb @ LSEL.T) > 0.5
+        lv = jnp.where(lna, mu_l[None, :], lv)
+        blocks.append((lv - mu_l[None, :]) / sg_l[None, :])
+    return jnp.concatenate(blocks, axis=1)
+
+
+#: design cells above which RuleFit streams (~2 GB of f32)
+_STREAM_CELL_BUDGET = 1 << 29
+
+
+def _stream_block(Rl: int, P: int, want: int = 65536) -> int:
+    # large blocks keep the per-block Gram matmuls MXU-sized (8k-row blocks
+    # measured scan/dispatch-bound at 11M rows); ~512 MB of transient f32
+    # block cells is comfortable in 16 GB HBM
+    from .tree.binning import _pow2_block
+
+    return _pow2_block(Rl, max(256, min(want, (1 << 27) // max(P, 1))))
+
+
+_STREAM_FN_CACHE: dict = {}
+
+
+def _stream_step(family, rb: int):
+    """Streaming GLMIterationTask, cached per (family, block size): scan row
+    blocks, build the design block on the fly, accumulate (Gram, XWz,
+    deviance, n). jax's own jit cache handles the shape axes."""
+    key = ("step", family.name, getattr(family, "link_name", None),
+           getattr(family, "p", None), getattr(family, "theta", None), rb)
+    fn = _STREAM_FN_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    @jax.jit
+    def step(Xraw, y, w, beta, offset, fidx, thr, gt, nal, act, lsel,
+             mu_l, sg_l):
+        Rl = Xraw.shape[0]
+        nblk = Rl // rb
+
+        def body(carry, blk):
+            G, b_, dev, neff = carry
+            xb, yb, wb, ob = blk
+            A = _build_design_block(xb, fidx, thr, gt, nal, act, lsel,
+                                    mu_l, sg_l)
+            Ai = jnp.concatenate([A, jnp.ones((rb, 1), jnp.float32)], axis=1)
+            eta = Ai @ beta + ob
+            mu = family.linkinv(eta)
+            d = family.dmu_deta(eta)
+            V = family.variance(mu)
+            W = wb * d * d / jnp.maximum(V, 1e-10)
+            z = eta - ob + (yb - mu) / jnp.where(jnp.abs(d) < 1e-10, 1e-10, d)
+            AW = Ai * W[:, None]
+            G = G + jnp.einsum("rp,rq->pq", AW, Ai)
+            b_ = b_ + AW.T @ z
+            dev = dev + jnp.sum(family.deviance(yb, mu, wb))
+            neff = neff + jnp.sum(wb)
+            return (G, b_, dev, neff), None
+
+        P1 = beta.shape[0]
+        init = (jnp.zeros((P1, P1), jnp.float32), jnp.zeros(P1, jnp.float32),
+                jnp.float32(0.0), jnp.float32(0.0))
+        (G, b_, dev, neff), _ = jax.lax.scan(
+            body, init,
+            (Xraw.reshape(nblk, rb, -1), y.reshape(nblk, rb),
+             w.reshape(nblk, rb), offset.reshape(nblk, rb)))
+        return G, b_, dev, neff
+
+    return _STREAM_FN_CACHE.setdefault(key, step)
+
+
+def _stream_scorer(rb: int):
+    """Streaming X@beta for scoring, cached per block size."""
+    key = ("score", rb)
+    fn = _STREAM_FN_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    @jax.jit
+    def run(Xraw, beta, fidx, thr, gt, nal, act, lsel, mu_l, sg_l):
+        Rl = Xraw.shape[0]
+        nblk = Rl // rb
+
+        def body(_, xb):
+            A = _build_design_block(xb, fidx, thr, gt, nal, act, lsel,
+                                    mu_l, sg_l)
+            return None, A @ beta[:-1] + beta[-1]
+
+        _, etas = jax.lax.scan(body, None, Xraw.reshape(nblk, rb, -1))
+        return etas.reshape(Rl)
+
+    return _STREAM_FN_CACHE.setdefault(key, run)
+
+
+def _stream_rule_support(Xraw, rule_arrays, nrow: int):
+    """Per-rule membership frequency over the real rows, streamed."""
+    R = rule_arrays[0].shape[0]
+    rb = _stream_block(int(Xraw.shape[0]), R)
+    key = ("support", rb)
+    fn = _STREAM_FN_CACHE.get(key)
+    if fn is None:
+        @jax.jit
+        def run(Xraw, valid, fidx, thr, gt, nal, act):
+            nblk = Xraw.shape[0] // rb
+            R_ = fidx.shape[0]
+            empty_sel = jnp.zeros((0,), jnp.int32)
+            empty_f = jnp.zeros((0,), jnp.float32)
+
+            def body(acc, blk):
+                xb, vb = blk
+                memb = _build_design_block(xb, fidx, thr, gt, nal, act,
+                                           empty_sel, empty_f, empty_f)
+                return acc + (memb * vb[:, None]).sum(axis=0), None
+
+            tot, _ = jax.lax.scan(
+                body, jnp.zeros(R_, jnp.float32),
+                (Xraw.reshape(nblk, rb, -1), valid.reshape(nblk, rb)))
+            return tot
+
+        fn = _STREAM_FN_CACHE.setdefault(key, run)
+    valid = (jnp.arange(Xraw.shape[0]) < nrow).astype(jnp.float32)
+    return fn(Xraw, valid, *rule_arrays) / max(nrow, 1)
+
+
 class RuleFitModel(Model):
     algo_name = "rulefit"
+
+    #: streaming mode (benchmark scale): adapt_frame returns the RAW feature
+    #: matrix and score0 builds design blocks on the fly
+    stream = False
+    beta = None      # [rules..., linear..., intercept] in streaming mode
+    family = None    # GLM family object (streaming scoring)
 
     def __init__(self, params, output, rules, rule_arrays, lin_names,
                  lin_stats, glm_model, key=None):
@@ -134,6 +308,23 @@ class RuleFitModel(Model):
         self.lin_stats = lin_stats        # (means, sigmas) for linear terms
         self.glm_model = glm_model        # fitted GLM over [rules|linear]
         super().__init__(params, output, key=key)
+
+    def _stream_args(self):
+        """The design-builder tensor arguments (rules + linear stats)."""
+        names = self.output.names
+        if self.rule_arrays is not None:
+            fidx, thr, gt, nal, act = self.rule_arrays
+        else:
+            fidx = jnp.zeros((0, 1), jnp.int32)
+            thr = jnp.zeros((0, 1), jnp.float32)
+            gt = nal = act = jnp.zeros((0, 1), bool)
+        lin_sel = ([names.index(n) for n in self.lin_names]
+                   if self.lin_names else [])
+        means, sigmas = self.lin_stats if self.lin_stats else ([], [])
+        return (fidx, thr, gt, nal, act,
+                jnp.asarray(np.asarray(lin_sel, np.int32)),
+                jnp.asarray(np.asarray(means, np.float32)),
+                jnp.asarray(np.asarray(sigmas, np.float32)))
 
     def _design(self, fr: Frame):
         blocks = []
@@ -150,9 +341,22 @@ class RuleFitModel(Model):
         return jnp.concatenate(blocks, axis=1)
 
     def adapt_frame(self, fr: Frame):
-        return self._design(self.pre_adapt(fr))
+        fr = self.pre_adapt(fr)
+        if self.stream:
+            return fr.as_matrix(self.output.names)
+        return self._design(fr)
 
     def score0(self, X):
+        if self.stream:
+            P1 = len(self.beta)
+            rb = _stream_block(int(X.shape[0]), P1)
+            eta = _stream_scorer(rb)(
+                X, jnp.asarray(self.beta, jnp.float32), *self._stream_args())
+            mu = self.family.linkinv(eta)
+            if self.output.model_category == "Binomial":
+                label = (mu >= 0.5).astype(jnp.float32)
+                return jnp.stack([label, 1 - mu, mu], axis=1)
+            return mu
         return self.glm_model.score0(X)
 
     def rule_importance(self):
@@ -223,37 +427,52 @@ class RuleFit(ModelBuilder):
 
         model = RuleFitModel(p, output, rules, rule_arrays, lin_names,
                              lin_stats, None)
-        Xd = model._design(fr)
 
-        # L1 GLM over the rule/linear design (`RuleFit.java` glmParameters:
-        # alpha=1, lambda_search)
-        design = Frame([f"c{i}" for i in range(Xd.shape[1])],
-                       [Vec.from_device(Xd[:, i], fr.nrow)
-                        for i in range(Xd.shape[1])])
-        design.add(p.response_column, fr.vec(p.response_column))
-        if p.weights_column:
-            design.add(p.weights_column, fr.vec(p.weights_column))
-        gp = GLMParameters(
-            training_frame=design, response_column=p.response_column,
-            weights_column=p.weights_column, alpha=1.0,
-            lambda_search=p.lambda_search or p.lambda_ is None,
-            lambda_=p.lambda_, nlambdas=min(p.nlambdas, 20),
-            standardize=False, family=p.family, seed=p.seed,
-            max_iterations=p.max_iterations)
-        glm_model = GLM(gp).build_impl(Job("rulefit_glm", 1.0))
-        model.glm_model = glm_model
+        P_design = (len(rules) if rules else 0) + len(lin_names)
+        plen = fr.vec(0).plen
+        model.stream = plen * max(P_design, 1) > _STREAM_CELL_BUDGET
+        if model.stream:
+            # benchmark scale: the design never materializes — the L1 GLM
+            # runs on the streaming IRLS (see _make_stream_irls)
+            beta = self._fit_streaming(job, model, fr, y_dev, category)
+        else:
+            Xd = model._design(fr)
+
+            # L1 GLM over the rule/linear design (`RuleFit.java`
+            # glmParameters: alpha=1, lambda_search)
+            design = Frame([f"c{i}" for i in range(Xd.shape[1])],
+                           [Vec.from_device(Xd[:, i], fr.nrow)
+                            for i in range(Xd.shape[1])])
+            design.add(p.response_column, fr.vec(p.response_column))
+            if p.weights_column:
+                design.add(p.weights_column, fr.vec(p.weights_column))
+            gp = GLMParameters(
+                training_frame=design, response_column=p.response_column,
+                weights_column=p.weights_column, alpha=1.0,
+                lambda_search=p.lambda_search or p.lambda_ is None,
+                lambda_=p.lambda_, nlambdas=min(p.nlambdas, 20),
+                standardize=False, family=p.family, seed=p.seed,
+                max_iterations=p.max_iterations)
+            glm_model = GLM(gp).build_impl(Job("rulefit_glm", 1.0))
+            model.glm_model = glm_model
+            beta = np.asarray(glm_model.beta)
+        model.beta = beta
 
         # pull coefficients back onto rules; support = rule frequency
-        beta = np.asarray(glm_model.beta)
         n_rules = len(rules)
         if rules:
-            memb = np.asarray(eval_rules(fr.as_matrix(names), *rule_arrays))
-            sup = memb[: fr.nrow].mean(axis=0)
+            if model.stream:
+                sup = np.asarray(_stream_rule_support(
+                    fr.as_matrix(names), rule_arrays, fr.nrow))
+            else:
+                memb = np.asarray(eval_rules(fr.as_matrix(names),
+                                             *rule_arrays))
+                sup = memb[: fr.nrow].mean(axis=0)
             for i, r in enumerate(rules):
                 r.coef = float(beta[i])
                 r.support = float(sup[i])
 
-        raw = model.score0(Xd)
+        raw = model.score0(model.adapt_frame(fr) if model.stream else Xd)
         y = jnp.nan_to_num(y_dev)
         ym = jnp.where(jnp.isnan(y_dev), jnp.nan, y)
         wm = (jnp.nan_to_num(fr.vec(p.weights_column).data)
@@ -262,3 +481,73 @@ class RuleFit(ModelBuilder):
         output.variable_importances = None
         job.update(1.0)
         return model
+
+    def _fit_streaming(self, job, model, fr, y_dev, category) -> np.ndarray:
+        """L1 lambda path over the streaming IRLS — mirrors GLM._fit's IRLSM
+        loop with the design built per block (`RuleFit.java` glmParameters:
+        alpha=1, lambda_search)."""
+        from .glm import _admm_solve
+        from .model_base import ModelBuilder as _MB  # noqa: F401
+
+        p = self.params
+        names = model.output.names
+        family = GLM._family(self, category)
+        model.family = family
+        Xraw = fr.as_matrix(names)
+        y = jnp.nan_to_num(y_dev)
+        w = (~jnp.isnan(y_dev)).astype(jnp.float32)
+        w = w * (jnp.arange(Xraw.shape[0]) < fr.nrow)
+        if p.weights_column:
+            w = w * jnp.nan_to_num(fr.vec(p.weights_column).data)
+        offset = jnp.zeros_like(y)
+
+        sargs = model._stream_args()
+        P1 = ((len(model.rules) if model.rules else 0)
+              + len(model.lin_names) + 1)
+        rb = _stream_block(int(Xraw.shape[0]), P1)
+        raw_step = _stream_step(family, rb)
+        step = lambda Xr, yy, ww, bb, oo: raw_step(Xr, yy, ww, bb, oo,
+                                                   *sargs)
+
+        beta = np.zeros(P1, np.float64)
+        beta[-1] = float(family.init_intercept(y, w))
+        free = np.zeros(P1, bool)
+        free[-1] = True
+        neff = float(jnp.sum(w))
+        G0, b0, _, _ = step(Xraw, y, w, jnp.asarray(beta, jnp.float32),
+                            offset)
+        grad0 = np.abs(np.asarray(b0) - np.asarray(G0) @ beta)[:-1]
+        lmax = float(grad0.max()) / max(neff, 1.0)
+        nl = min(p.nlambdas, 20)
+        lambdas = (np.geomspace(lmax, lmax * 1e-4, nl)
+                   if (p.lambda_search or p.lambda_ is None)
+                   else [p.lambda_])
+        mu0 = family.linkinv(jnp.full_like(y, beta[-1]))
+        nulldev = float(jnp.sum(family.deviance(y, mu0, w)))
+        iters = 0
+        dev_lambda_prev = np.inf
+        for lam in lambdas:
+            job.check_cancelled()
+            l1 = float(lam) * neff  # alpha = 1 (pure lasso, like the ref)
+            dev = np.inf
+            # warm-started IRLS converges in 2-3 steps per lambda; the cap
+            # bounds the pass count on the streamed design
+            for it in range(min(max(p.max_iterations, 1), 5)):
+                G, b, dev_t, _ = step(Xraw, y, w,
+                                      jnp.asarray(beta, jnp.float32), offset)
+                iters += 1
+                dev = float(dev_t)
+                beta_new = _admm_solve(np.asarray(G, np.float64),
+                                       np.asarray(b, np.float64), l1, 0.0,
+                                       free)
+                diff = np.max(np.abs(beta_new - beta)) if it else np.inf
+                beta = beta_new
+                if diff < p.beta_epsilon:
+                    break
+            # lambda-search early stop (`LambdaSearchScoringHistory` role):
+            # once an extra lambda stops buying deviance, the remaining path
+            # only densifies coefficients the L1 ranking does not need
+            if (dev_lambda_prev - dev) < 3e-4 * abs(nulldev):
+                break
+            dev_lambda_prev = dev
+        return beta

@@ -333,7 +333,7 @@ class XGBoost(GBM):
             weights.append(w_new)
             if (t + 1) % interval == 0 or t + 1 == p.ntrees:
                 m = make_metrics(
-                    s.category, jnp.where(s.ymask, s.y, jnp.nan),
+                    s.category, s.ym,
                     _metrics_raw(s.category, s.dist, f0b + S,
                                  False, t + 1),
                     None if p.weights_column is None else s.w)

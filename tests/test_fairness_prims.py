@@ -1,0 +1,184 @@
+"""Rapids tail prims (VERDICT r3 #9): fairnessMetrics, transform,
+scale_inplace, grouped_permute."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.rapids.exec import _PRIMS, Rapids, Session
+
+
+def _bin_frame(n=2000, seed=6):
+    rng = np.random.default_rng(seed)
+    sex = rng.integers(0, 2, n)
+    edu = rng.integers(0, 3, n)
+    x = rng.normal(size=n)
+    # group-dependent base rates: real disparate impact to measure
+    logit = x + 0.8 * sex - 0.3 * edu
+    lab = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    fr = Frame.from_dict({"x": x})
+    fr.add("SEX", Vec.from_numpy(sex.astype(np.float32), type=T_CAT,
+                                 domain=["F", "M"]))
+    fr.add("EDU", Vec.from_numpy(edu.astype(np.float32), type=T_CAT,
+                                 domain=["hs", "bsc", "msc"]))
+    fr.add("y", Vec.from_numpy(lab, type=T_CAT, domain=["no", "yes"]))
+    return fr
+
+
+class TestFairnessMetrics:
+    @pytest.fixture(scope="class")
+    def model_frame(self):
+        fr = _bin_frame()
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=8, max_depth=3, seed=1)).train_model()
+        return m, fr
+
+    def test_overview_groups_and_air(self, model_frame):
+        from h2o_tpu.rapids.fairness import fairness_metrics
+
+        m, fr = model_frame
+        res = fairness_metrics(m, fr, ["SEX"], None, "yes")
+        ov = res["overview"]
+        assert "overview" in res
+        df = {n: ov.vec(n).to_numpy() for n in ov.names}
+        assert ov.nrow == 2  # F and M, no NAs
+        # counts add up to the frame
+        assert float(df["total"].sum()) == fr.nrow
+        # the reference group's AIRs are exactly 1
+        ref_row = int(np.argmax(df["total"]))
+        for c in ov.names:
+            if c.startswith("AIR_"):
+                assert abs(df[c][ref_row] - 1.0) < 1e-6, c
+        # disparate impact is real in this data: selectedRatio differs
+        assert abs(df["selectedRatio"][0] - df["selectedRatio"][1]) > 0.05
+        # p.value present and in [0, 1]
+        assert ((df["p.value"] >= 0) & (df["p.value"] <= 1)).all()
+        # per-group threshold tables ride along
+        assert any(k.startswith("thresholds_and_metrics_") for k in res)
+
+    def test_intersectional_and_reference(self, model_frame):
+        from h2o_tpu.rapids.fairness import fairness_metrics
+
+        m, fr = model_frame
+        res = fairness_metrics(m, fr, ["SEX", "EDU"], ["F", "hs"], "yes")
+        ov = res["overview"]
+        assert ov.nrow == 6  # 2x3 non-empty groups
+        df = {n: ov.vec(n).to_numpy() for n in ov.names}
+        # reference = (F, hs): its AIR_accuracy must be 1
+        sel = (df["SEX"] == 0) & (df["EDU"] == 0)
+        assert abs(df["AIR_accuracy"][sel][0] - 1.0) < 1e-6
+
+    def test_fisher_matches_known_value(self):
+        from h2o_tpu.rapids.fairness import _fisher_exact
+
+        # R: fisher.test(matrix(c(3, 1, 1, 3), nrow=2))$p.value = 0.4857143
+        assert abs(_fisher_exact(3, 1, 1, 3) - 0.4857143) < 1e-6
+        # R: fisher.test(matrix(c(10, 2, 3, 15), nrow=2)) = 0.0005367241
+        assert abs(_fisher_exact(10, 3, 2, 15) - 0.000536724) < 1e-7
+
+    def test_rest_roundtrip(self, model_frame):
+        import h2o_tpu.api as h2o
+
+        m, fr = model_frame
+        h2o.init(port=54620)
+        try:
+            from h2o_tpu.backend.kvstore import STORE
+
+            STORE.put_keyed(m)
+            STORE.put(fr.key or "fair_fr", fr)
+            cm = h2o.get_model(m.key)
+            frc = h2o.get_frame(fr.key)
+            out = cm.fairness_metrics(frc, ["SEX"], None, "yes")
+            assert "overview" in out
+            pdf = out["overview"].as_data_frame()
+            assert "AIR_selectedRatio" in pdf.columns
+        finally:
+            h2o.shutdown()
+
+
+class TestTransformPrim:
+    def test_te_transform(self):
+        from h2o_tpu.models.target_encoder import (TargetEncoder,
+                                                   TargetEncoderParameters)
+        from h2o_tpu.backend.kvstore import STORE
+
+        rng = np.random.default_rng(2)
+        n = 500
+        c = rng.integers(0, 4, n).astype(np.float32)
+        y = (c % 2 + 0.1 * rng.normal(size=n)).astype(np.float32)
+        fr = Frame.from_dict({"y": y})
+        fr.add("c", Vec.from_numpy(c, type=T_CAT, domain=list("abcd")))
+        STORE.put_keyed(fr)
+        te = TargetEncoder(TargetEncoderParameters(
+            training_frame=fr, response_column="y")).train_model()
+        s = Session("te_prim_test")
+        try:
+            out = Rapids(s).exec(f'(transform "{te.key}" {fr.key})')
+            assert any("_te" in n or "te_" in n.lower() or "c" in n
+                       for n in out.names)
+            assert out.nrow == n
+        finally:
+            s.end()
+
+    def test_non_te_model_rejected(self):
+        from h2o_tpu.backend.kvstore import STORE
+
+        fr = _bin_frame(300)
+        STORE.put_keyed(fr)
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=2, max_depth=2, seed=1)).train_model()
+        s = Session("te_prim_test2")
+        try:
+            with pytest.raises(ValueError, match="transform"):
+                Rapids(s).exec(f'(transform "{m.key}" {fr.key})')
+        finally:
+            s.end()
+
+
+class TestScaleInplace:
+    def test_mutates_source_frame(self):
+        from h2o_tpu.backend.kvstore import STORE
+
+        rng = np.random.default_rng(1)
+        fr = Frame.from_dict({"a": rng.normal(5, 2, 400),
+                              "b": rng.normal(-1, 3, 400)})
+        STORE.put_keyed(fr)
+        s = Session("scale_inplace_test")
+        try:
+            out = Rapids(s).exec(f"(scale_inplace {fr.key} True True)")
+            assert out is fr or out.key == fr.key
+            a = fr.vec("a").to_numpy()
+            assert abs(a.mean()) < 1e-5 and abs(a.std() - 1.0) < 1e-2
+        finally:
+            s.end()
+
+
+class TestGroupedPermute:
+    def test_cross_pairs(self):
+        from h2o_tpu.rapids.mungers import grouped_permute
+
+        # group 1: D-rows {10: 5.0}, C-rows {20: 7.0, 21: 1.0}
+        # group 2: D-rows {11: 2.0 summed over two rows}, C-rows {22: 3.0}
+        fr = Frame.from_dict({
+            "grp": np.array([1, 1, 1, 2, 2, 2], np.float32),
+            "rid": np.array([10, 20, 21, 11, 11, 22], np.float32),
+            "amt": np.array([5.0, 7.0, 1.0, 1.5, 0.5, 3.0], np.float32)})
+        fr.add("dc", Vec.from_numpy(
+            np.array([0, 1, 1, 0, 0, 1], np.float32), type=T_CAT,
+            domain=["D", "C"]))
+        out = grouped_permute(fr, perm_col=1, gb_cols=[0], permute_by=3,
+                              keep_col=2)
+        assert list(out.names) == ["grp", "In", "Out", "InAmnt", "OutAmnt"]
+        rows = {tuple(out.vec(n).to_numpy()[i] for n in out.names)
+                for i in range(out.nrow)}
+        assert (1.0, 10.0, 20.0, 5.0, 7.0) in rows
+        assert (1.0, 10.0, 21.0, 5.0, 1.0) in rows
+        assert (2.0, 11.0, 22.0, 2.0, 3.0) in rows  # summed D amounts
+        assert out.nrow == 3
+
+
+def test_prim_count_reaches_195():
+    assert len(_PRIMS) >= 195, len(_PRIMS)

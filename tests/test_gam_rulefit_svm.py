@@ -202,3 +202,41 @@ class TestGamSplineFamilies:
         ours = m.predict(fr).vec("predict").to_numpy()
         theirs = mojo.predict(fr)
         np.testing.assert_allclose(theirs, ours, rtol=1e-4, atol=1e-4)
+
+
+class TestRuleFitStreaming:
+    def test_streaming_matches_materialized(self, monkeypatch):
+        """Benchmark-scale mode: the streamed (design-never-materializes)
+        fit must agree with the small-data materialized path."""
+        import h2o_tpu.models.rulefit as rf
+        from h2o_tpu.models.rulefit import RuleFit, RuleFitParameters
+
+        rng = np.random.default_rng(12)
+        n = 4000
+        x = rng.normal(size=(n, 5)).astype(np.float32)
+        y = ((x[:, 0] > 0.3) & (x[:, 1] < 0.5)).astype(np.float32) \
+            + 0.2 * x[:, 2] + 0.05 * rng.normal(size=n).astype(np.float32)
+        fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(5)} | {"y": y})
+        kw = dict(training_frame=fr, response_column="y", seed=3,
+                  min_rule_length=2, max_rule_length=2,
+                  rule_generation_ntrees=10)
+        m_small = RuleFit(RuleFitParameters(**kw)).train_model()
+        assert not m_small.stream
+
+        # force the streaming branch by shrinking the cell budget
+        monkeypatch.setattr(rf, "_STREAM_CELL_BUDGET", 1)
+        m_stream = RuleFit(RuleFitParameters(**kw)).train_model()
+        assert m_stream.stream, "streaming mode did not engage"
+
+        p1 = m_small.predict(fr).vec(0).to_numpy()
+        p2 = m_stream.predict(fr).vec(0).to_numpy()
+        # same rules, same lambda path, same solver family: predictions agree
+        # to optimizer tolerance
+        assert np.corrcoef(p1, p2)[0, 1] > 0.999
+        assert abs(p1.mean() - p2.mean()) < 0.02
+        tm1 = m_small.output.training_metrics.mse
+        tm2 = m_stream.output.training_metrics.mse
+        assert abs(tm1 - tm2) / max(tm1, 1e-9) < 0.1
+        # rule importances populated in both modes
+        ri = m_stream.rule_importance()
+        assert len(ri) > 0 and all("rule" in r for r in ri)

@@ -49,6 +49,9 @@ def _poll(job, deadline_s: float = 120.0):
     """`.h2o.poll` replay: GET /3/Jobs/{job$job$key$name} until DONE."""
     import time
 
+    if "key" not in job["job"]:  # synchronous route: job came back DONE
+        assert job["job"]["status"] == "DONE", job
+        return job["job"]
     key = job["job"]["key"]["name"]
     t0 = time.time()
     while True:
@@ -212,3 +215,181 @@ def test_frame_verbs_sequence(cloud, csv_path):
          params={"path": out, "force": "true"})
     assert os.path.exists(out)
     os.unlink(out)
+
+
+class TestRound4RSurface:
+    """Wire replays for the round-4 R growth: frame algebra, grids, AutoML,
+    performance objects (each test mirrors the literal request sequence the
+    new h2o.R functions emit)."""
+
+    @pytest.fixture(scope="class")
+    def fr(self, cloud, csv_path):
+        imp = _req("GET", "/3/ImportFiles", params={"path": csv_path})
+        setup = _req("POST", "/3/ParseSetup",
+                     body={"source_frames": imp["files"]})
+        job = _req("POST", "/3/Parse",
+                   body={"source_frames": imp["files"],
+                         "destination_frame": setup["destination_frame"]})
+        done = _poll(job)
+        return done["dest"]["name"]
+
+    def _rapids_frame(self, expr):
+        res = _req("POST", "/99/Rapids", body={"ast": expr})
+        assert res.get("key"), (expr, res)
+        return res["key"]["name"]
+
+    def _download_csv(self, frame_id):
+        # raw text route (the R client reads it with read.csv)
+        import urllib.request
+
+        base = h2o.connection()._base if hasattr(h2o.connection(), "_base")             else None
+        url = (base or f"http://127.0.0.1:54667") +             f"/3/DownloadDataset?frame_id={frame_id}"
+        with urllib.request.urlopen(url) as r:
+            return r.read().decode()
+
+    def test_slicing_ops(self, fr):
+        # `[.H2OFrame`: cols then rows
+        sub = self._rapids_frame(f"(cols {fr} [0 1])")
+        sub2 = self._rapids_frame(f"(rows {sub} [0 1 2 3 4])")
+        s = _req("GET", f"/3/Frames/{sub2}/summary")["frames"][0]
+        assert s["rows"] == 5 and s["num_columns"] == 2
+        # Ops.H2OFrame: (+ fr fr), (* fr 2)
+        a = self._rapids_frame(f"(+ (cols {fr} [0]) (cols {fr} [0]))")
+        b = self._rapids_frame(f"(* (cols {fr} [0]) 2)")
+        da = self._download_csv(a)
+        db = self._download_csv(b)
+        assert da.splitlines()[1] == db.splitlines()[1]
+
+    def test_as_data_frame_download(self, fr):
+        # as.data.frame.H2OFrame: GET /3/DownloadDataset -> CSV text
+        text = self._download_csv(fr)
+        lines = text.splitlines()
+        assert lines[0].replace('"', "").split(",") == ["x1", "x2", "y"]
+        assert len(lines) == 301
+
+    def test_factor_verbs(self, fr):
+        col = self._rapids_frame(f"(cols {fr} ['y'])")
+        lv = _req("POST", "/99/Rapids", body={"ast": f"(levels {col})"})
+        assert lv.get("key") or lv.get("values")
+        t = self._rapids_frame(f"(table {col})")
+        ts = _req("GET", f"/3/Frames/{t}/summary")["frames"][0]
+        assert ts["rows"] == 2
+        u = self._rapids_frame(f"(unique {col})")
+        us = _req("GET", f"/3/Frames/{u}/summary")["frames"][0]
+        assert us["rows"] == 2
+
+    def test_bind_merge_sort_groupby(self, fr):
+        c0 = self._rapids_frame(f"(cols {fr} [0])")
+        c1 = self._rapids_frame(f"(cols {fr} [1])")
+        cb = self._rapids_frame(f"(cbind {c0} {c1})")
+        assert _req("GET", f"/3/Frames/{cb}/summary"
+                    )["frames"][0]["num_columns"] == 2
+        rb = self._rapids_frame(f"(rbind {c0} {c0})")
+        assert _req("GET", f"/3/Frames/{rb}/summary"
+                    )["frames"][0]["rows"] == 600
+        st = self._rapids_frame(f"(sort {fr} [0])")
+        assert _req("GET", f"/3/Frames/{st}/summary"
+                    )["frames"][0]["rows"] == 300
+        gb = self._rapids_frame(f'(GB {fr} [2] "mean" 0 "all")')
+        gs = _req("GET", f"/3/Frames/{gb}/summary")["frames"][0]
+        assert gs["rows"] == 2
+
+    def test_reduce_verbs(self, fr):
+        for expr in (f"(sd (cols {fr} 'x1') true)",
+                     f"(var (cols {fr} 'x1') true)",
+                     f"(min (cols {fr} 'x1') true)",
+                     f"(max (cols {fr} 'x1') true)",
+                     f"(mean (cols {fr} 'x1') true)"):
+            res = _req("POST", "/99/Rapids", body={"ast": expr})
+            val = res.get("scalar") or res.get("values")
+            assert val is not None, expr
+        q = self._rapids_frame(f"(quantile {fr} [0.25 0.5] 'interpolate')")
+        assert _req("GET", f"/3/Frames/{q}/summary")["frames"][0]["rows"] == 2
+
+    def test_scale_cut_impute(self, fr):
+        sc = self._rapids_frame(f"(scale (cols {fr} [0 1]) true true)")
+        assert sc
+        ct = self._rapids_frame(
+            f"(cut (cols {fr} 'x1') [-10 0 10] [] false true 3)")
+        assert ct
+        res = _req("POST", "/99/Rapids", body={
+            "ast": f"(h2o.impute {fr} 0 'mean' 'interpolate' [] _ _)"})
+        assert res.get("key") or res.get("values") is not None
+
+    def test_create_frame_and_missing(self):
+        job = _req("POST", "/3/CreateFrame",
+                   body={"rows": 50, "cols": 3, "seed": 7,
+                         "categorical_fraction": 0.0,
+                         "missing_fraction": 0.0})
+        done = _poll(job)
+        fid = done["dest"]["name"]
+        job2 = _req("POST", "/3/MissingInserter",
+                    body={"dataset": fid, "fraction": 0.2, "seed": 7})
+        _poll(job2)
+        s = _req("GET", f"/3/Frames/{fid}/summary")["frames"][0]
+        assert sum(c["missing_count"] for c in s["columns"]) > 0
+
+    def test_assign(self, fr):
+        res = _req("POST", "/99/Rapids",
+                   body={"ast": f"(assign r_assigned_frame {fr})"})
+        assert res is not None
+        s = _req("GET", "/3/Frames/r_assigned_frame/summary")["frames"][0]
+        assert s["rows"] == 300
+
+    def test_grid(self, fr):
+        body = {"response_column": "y", "training_frame": fr,
+                "hyper_parameters": {"max_depth": [2, 3]},
+                "ntrees": 3, "seed": 1}
+        job = _req("POST", "/99/Grid/gbm", body=body)
+        done = _poll(job)
+        gid = done["dest"]["name"]
+        g = _req("GET", f"/99/Grids/{gid}")
+        ids = [m["name"] for m in g["model_ids"]]
+        assert len(ids) == 2
+        assert g.get("summary_table") is not None
+
+    def test_automl(self, fr):
+        body = {"input_spec": {"training_frame": fr, "response_column": "y"},
+                "build_control": {"project_name": "r_wire_aml", "nfolds": 0,
+                                  "stopping_criteria": {"max_models": 2,
+                                                        "seed": 1}},
+                "build_models": {"include_algos": ["GBM", "GLM"]}}
+        job = _req("POST", "/99/AutoMLBuilder", body=body)
+        project = job["build_control"]["project_name"]
+        _poll(job)
+        lb = _req("GET", f"/99/Leaderboards/{project}")
+        assert lb["models"], lb
+        leader = lb["models"][0]["name"]
+        m = _req("GET", f"/3/Models/{leader}")["models"][0]
+        assert m["model_id"]["name"] == leader
+
+    def test_performance_on_newdata(self, fr):
+        job = _req("POST", "/3/ModelBuilders/gbm",
+                   body={"response_column": "y", "training_frame": fr,
+                         "ntrees": 3, "seed": 1})
+        done = _poll(job)
+        mid = done["dest"]["name"]
+        res = _req("POST", f"/3/ModelMetrics/models/{mid}/frames/{fr}")
+        mm = res["model_metrics"][0]
+        assert "AUC" in mm and "logloss" in mm and "MSE" in mm
+        assert mm.get("Gini") is not None
+        assert mm.get("pr_auc") is not None
+        # scoring history + varimp ride the model schema for h2o.scoreHistory
+        schema = _req("GET", f"/3/Models/{mid}")["models"][0]
+        assert schema["output"]["scoring_history"] is not None
+        assert schema["output"]["variable_importances"] is not None
+
+    def test_mojo_roundtrip(self, fr, tmp_path):
+        job = _req("POST", "/3/ModelBuilders/gbm",
+                   body={"response_column": "y", "training_frame": fr,
+                         "ntrees": 2, "seed": 1})
+        done = _poll(job)
+        mid = done["dest"]["name"]
+        out = _req("GET", f"/3/Models/{mid}/mojo",
+                   params={"dir": str(tmp_path / "m.zip")})
+        assert out["dir"]
+        job2 = _req("POST", "/3/ModelBuilders/generic",
+                    body={"path": out["dir"]})
+        done2 = _poll(job2)
+        m = _req("GET", f"/3/Models/{done2['dest']['name']}")["models"][0]
+        assert m["model_id"]["name"] == done2["dest"]["name"]
