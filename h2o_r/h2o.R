@@ -267,8 +267,16 @@ h2o.confusionMatrix <- function(model)
 `[.H2OFrame` <- function(fr, i, j, ...) {
   id <- fr$frame_id
   if (!missing(j)) {
-    jj <- if (is.character(j)) sapply(j, function(c) .h2o.col_index(fr, c))
-          else as.integer(j) - 1L
+    if (is.character(j)) {
+      jj <- sapply(j, function(c) .h2o.col_index(fr, c))
+    } else {
+      j <- as.integer(j)
+      if (any(j < 0)) {  # R drop semantics: fr[, -1] removes column 1
+        if (any(j > 0)) stop("can't mix positive and negative column indices")
+        j <- setdiff(seq_along(h2o.colnames(fr)), -j)
+      }
+      jj <- j - 1L
+    }
     id <- .h2o.frame_op(sprintf("(cols %s [%s])", id,
                                 paste(jj, collapse = " ")))$frame_id
   }

@@ -1,198 +1,436 @@
-"""Minimal interactive Flow — the `h2o-web` notebook's working core.
+"""Flow — the notebook IDE (`h2o-web` analog, served at /flow).
 
-One static HTML page (no build step) over the JSON API: list/inspect
-frames, import a file, launch a training run with live job progress, and
-inspect the resulting model's metrics. The reference ships a full
-CoffeeScript notebook IDE (`h2o-web/README.md:1-20`); this covers the
-quickstart's browser flow end-to-end against the same REST routes.
+The reference ships h2o-flow: a CoffeeScript notebook whose cells hold Flow
+routines (`importFiles`, `buildModel`, `getFrames`, …) that expand into REST
+calls, with notebooks saved into NodePersistentStorage under the "notebook"
+category and an assist menu that inserts template cells
+(`h2o-web/README.md:1-20`).
+
+This is the same shape without a build step: one static page, vanilla JS.
+
+- **Cells**: each holds a Flow expression (`routine arg` — args are JSON) or
+  markdown (`md:` prefix). Shift+Enter runs a cell and renders its result
+  (tables for frames/models/jobs, JSON otherwise); cells insert/delete/move.
+- **Routines** (the Flow language core, same names as the reference):
+  `assist`, `importFiles ["path"]`, `setupParse {...}`, `parseFiles {...}`,
+  `getFrames`, `getFrameSummary "id"`, `getFrameData "id"`, `splitFrame
+  {...}`, `getModels`, `getModel "id"`, `buildModel "algo", {params}`,
+  `predict {model:, frame:}`, `getJobs`, `getJob "id"`, `rapids "(expr)"`,
+  `deleteFrame "id"`, `deleteModel "id"`.
+- **Notebooks**: save/load/list through `/3/NodePersistentStorage/notebook`
+  exactly like the reference's Flow persistence.
+- **Assist**: inserts ready-to-edit template cells for the common verbs.
 """
 
-FLOW_HTML = """<!doctype html><html><head><title>h2o_tpu flow</title><style>
-body{font-family:monospace;margin:1.5em;background:#fafafa;color:#222}
-h1{color:#333;margin-bottom:0}h2{color:#444;border-bottom:1px solid #ddd}
-table{border-collapse:collapse;margin:.6em 0}td,th{border:1px solid #ccc;
-padding:3px 9px;text-align:left}th{background:#eee}
-a{color:#06c;cursor:pointer;text-decoration:underline}
-input,select{font-family:monospace;margin:2px;padding:2px 4px}
-button{font-family:monospace;padding:3px 10px;cursor:pointer}
-#detail{background:#fff;border:1px solid #ccc;padding:.8em;margin:.8em 0}
-.err{color:#b00}.ok{color:#080}#jobstate{font-weight:bold}
-small{color:#777}</style></head><body>
-<h1>h2o_tpu</h1><div id=cloud><small>connecting…</small></div>
-
-<h2>Import</h2>
-<form id=importform onsubmit="return doImport(event)">
-<input id=importpath size=60 placeholder="/path/or/uri/to/data.csv">
-<button>Import &amp; parse</button> <span id=importmsg></span></form>
-
-<h2>Frames</h2><table id=frames></table>
-
-<h2>Train</h2>
-<form id=trainform onsubmit="return doTrain(event)">
-algo <select id=algo></select>
-frame <select id=trframe></select>
-response <select id=trresp></select>
-params <input id=trparams size=32 placeholder='{"ntrees": 20}'>
-<button>Train</button>
-<div>job <span id=jobkey>—</span> <span id=jobstate></span>
-<progress id=jobbar max=1 value=0></progress> <span id=jobmsg></span></div>
-</form>
-
-<h2>Models</h2><table id=models></table>
-<h2>Jobs</h2><table id=jobs></table>
-<div id=detail><small>click a frame or model key to inspect it</small></div>
-
+FLOW_HTML = r"""<!doctype html><html><head><title>h2o_tpu flow</title><style>
+body{font-family:-apple-system,'Segoe UI',sans-serif;margin:0;background:#f4f4f2;color:#222}
+#top{background:#1b1b1b;color:#eee;padding:.5em 1em;display:flex;gap:1em;align-items:center}
+#top b{color:#ffd24d}#top button,#top input{font-size:.85em}
+#cloudinfo{margin-left:auto;color:#9c9;font-size:.8em}
+#nb{max-width:1000px;margin:1em auto;padding:0 1em}
+.cell{background:#fff;border:1px solid #ddd;border-left:4px solid #ccc;margin:.6em 0;border-radius:2px}
+.cell.active{border-left-color:#ffd24d;box-shadow:0 1px 4px rgba(0,0,0,.12)}
+.cellbar{display:flex;gap:.3em;padding:2px 6px;background:#fafafa;border-bottom:1px solid #eee}
+.cellbar button{border:none;background:none;cursor:pointer;color:#888;font-size:.8em;padding:1px 5px}
+.cellbar button:hover{color:#000}
+textarea{width:100%;border:none;resize:vertical;font-family:ui-monospace,monospace;
+ font-size:.95em;padding:.5em .7em;box-sizing:border-box;min-height:2.2em;outline:none;background:#fffef8}
+.out{padding:.4em .8em;border-top:1px dashed #eee;overflow-x:auto}
+.out table{border-collapse:collapse;font-size:.85em;font-family:ui-monospace,monospace}
+.out td,.out th{border:1px solid #ddd;padding:2px 8px;text-align:left}
+.out th{background:#f0f0ea}
+.err{color:#b00020;font-family:ui-monospace,monospace;white-space:pre-wrap}
+.md{padding:.6em .9em}
+pre{margin:.3em 0;font-size:.85em;white-space:pre-wrap}
+progress{width:160px;height:10px}
+#assist{position:fixed;right:1em;top:3.2em;background:#fff;border:1px solid #ccc;
+ padding:.6em;border-radius:3px;box-shadow:0 2px 8px rgba(0,0,0,.15);display:none}
+#assist a{display:block;padding:2px 4px;color:#06c;cursor:pointer;font-size:.9em}
+a{color:#06c;cursor:pointer}
+.badge{font-size:.7em;color:#999;padding-left:.5em}
+</style></head><body>
+<div id=top>
+ <b>H2O Flow</b>
+ <button onclick="newCellBelow(-1)">+ cell</button>
+ <button onclick="runAll()">&#9654; run all</button>
+ <button onclick="toggleAssist()">assist</button>
+ <input id=nbname placeholder="notebook name" size=16>
+ <button onclick="saveNotebook()">save</button>
+ <button onclick="listNotebooks()">open&hellip;</button>
+ <span id=savemsg class=badge></span>
+ <span id=cloudinfo>connecting&hellip;</span>
+</div>
+<div id=assist></div>
+<div id=nb></div>
 <script>
-async function j(u, opts){const r = await fetch(u, opts);
- const body = await r.json();
- if(!r.ok) throw new Error(body.msg || r.statusText); return body}
-function row(cells, links){const tr = document.createElement('tr');
- cells.forEach(function(c, i){const td = document.createElement('td');
-  if(links && links[i]){const a = document.createElement('a');
-   a.textContent = c==null?'':String(c); a.onclick = links[i];
-   td.appendChild(a)}
-  else td.textContent = c==null?'':String(c);
-  tr.appendChild(td)}); return tr}
-function fill(id, head, rows){const t = document.getElementById(id);
- t.replaceChildren(); const hr = document.createElement('tr');
- head.forEach(function(h){const th = document.createElement('th');
-  th.textContent = h; hr.appendChild(th)}); t.appendChild(hr);
- rows.forEach(function(r){t.appendChild(r)})}
-function opt(sel, vals, keep){const s = document.getElementById(sel);
- const cur = s.value; s.replaceChildren();
- vals.forEach(function(v){const o = document.createElement('option');
-  o.value = o.textContent = v; s.appendChild(o)});
- if(keep && vals.indexOf(cur) >= 0) s.value = cur}
+'use strict';
+async function J(u, opts){const r = await fetch(u, opts);
+ let body; const ct = r.headers.get('content-type')||'';
+ if(ct.includes('json')) body = await r.json(); else body = await r.text();
+ if(!r.ok) throw new Error((body && body.msg) || r.statusText);
+ return body}
+function el(tag, attrs, kids){const e = document.createElement(tag);
+ Object.assign(e, attrs||{}); (kids||[]).forEach(k=>e.appendChild(k)); return e}
+function txt(s){return document.createTextNode(s)}
 
-async function inspectFrame(fid){
- const fr = (await j('/3/Frames/' + encodeURIComponent(fid)
-   + '/summary')).frames[0];
- const d = document.getElementById('detail');
- d.replaceChildren();
- d.insertAdjacentHTML('beforeend',
-  '<b></b> — ' + fr.rows + ' rows × ' + fr.num_columns + ' cols');
- d.querySelector('b').textContent = fid;
- const t = document.createElement('table');
- const hr = document.createElement('tr');
- ['column','type','min','mean','max','missing'].forEach(function(h){
-  const th = document.createElement('th'); th.textContent = h;
-  hr.appendChild(th)}); t.appendChild(hr);
- fr.columns.forEach(function(c){
-  t.appendChild(row([c.label, c.type,
-   c.mins && c.mins.length ? c.mins[0] : '',
-   c.mean == null ? '' : Number(c.mean).toFixed(4),
-   c.maxs && c.maxs.length ? c.maxs[0] : '', c.missing_count]))});
- d.appendChild(t)}
+/* ------------------------------------------------------------------ cells */
+let cells = [];   // {input, outEl, taEl, wrapEl}
+let activeIdx = -1;
+const nb = document.getElementById('nb');
 
-async function inspectModel(mid){
- const m = (await j('/3/Models/' + encodeURIComponent(mid))).models[0];
- const d = document.getElementById('detail');
- d.replaceChildren();
- d.insertAdjacentHTML('beforeend', '<b></b> — ' + m.algo + ' ('
-   + m.output.model_category + ')');
- d.querySelector('b').textContent = mid;
- const tm = m.output.training_metrics || {};
- const t = document.createElement('table');
- const hr = document.createElement('tr');
- ['metric','value'].forEach(function(h){const th =
-  document.createElement('th'); th.textContent = h; hr.appendChild(th)});
- t.appendChild(hr);
- Object.keys(tm).forEach(function(k){
-  if(typeof tm[k] === 'number')
-   t.appendChild(row([k, Number(tm[k]).toFixed(6)]))});
- d.appendChild(t)}
+function renderCells(){
+ nb.replaceChildren();
+ cells.forEach((c, i)=>{
+  const ta = el('textarea', {value: c.input, spellcheck: false});
+  ta.rows = Math.max(1, c.input.split('\n').length);
+  ta.onfocus = ()=>setActive(i);
+  ta.oninput = ()=>{c.input = ta.value;
+   ta.rows = Math.max(1, ta.value.split('\n').length)};
+  ta.onkeydown = (ev)=>{ if(ev.key === 'Enter' && ev.shiftKey){
+    ev.preventDefault(); runCell(i);} };
+  const bar = el('div', {className:'cellbar'}, [
+   el('button', {textContent:'▶ run', onclick:()=>runCell(i)}),
+   el('button', {textContent:'+ below', onclick:()=>newCellBelow(i)}),
+   el('button', {textContent:'↑', onclick:()=>moveCell(i,-1)}),
+   el('button', {textContent:'↓', onclick:()=>moveCell(i, 1)}),
+   el('button', {textContent:'✕', onclick:()=>deleteCell(i)}),
+  ]);
+  // reuse the LIVE output node (innerHTML round-trips would drop the
+  // onclick handlers on result links)
+  let out = c.outEl;
+  if(!out){out = el('div', {className:'out'}); out.style.display = 'none';}
+  const wrap = el('div', {className:'cell' + (i===activeIdx?' active':'')},
+                  [bar, ta, out]);
+  c.taEl = ta; c.outEl = out; c.wrapEl = wrap;
+  nb.appendChild(wrap);
+ });
+ if(!cells.length) newCellBelow(-1);
+}
+function setActive(i){activeIdx = i;
+ cells.forEach((c,k)=>c.wrapEl && c.wrapEl.classList.toggle('active', k===i))}
+function newCellBelow(i, input){cells.splice(i+1, 0, {input: input||''});
+ renderCells(); setActive(i+1);
+ if(cells[i+1].taEl) cells[i+1].taEl.focus()}
+function deleteCell(i){cells.splice(i,1); renderCells()}
+function moveCell(i,d){const k=i+d; if(k<0||k>=cells.length) return;
+ const t=cells[i]; cells[i]=cells[k]; cells[k]=t; renderCells()}
+async function runAll(){for(let i=0;i<cells.length;i++) await runCell(i)}
 
-async function loadRespCols(fid){
- // columns of the SELECTED frame only — the listing stays O(frames)
- const d = await j('/3/Frames/' + encodeURIComponent(fid) + '/columns');
- opt('trresp', d.frames[0].columns.map(function(c){return c.label}), true)}
+function setOut(i, node){const c = cells[i];
+ c.outEl.style.display=''; c.outEl.replaceChildren(node)}
+function setErr(i, msg){setOut(i, el('div',{className:'err',textContent:msg}))}
 
-async function refresh(){
- try{
-  const c = await j('/3/Cloud');
-  document.getElementById('cloud').textContent = 'cloud ' + c.cloud_name
-    + ' v' + c.version + ' — ' + c.nodes[0].num_cpus
-    + ' device(s), backend ' + c.nodes[0].backend;
-  const fr = await j('/3/Frames');
-  fill('frames', ['key','rows','cols'], fr.frames.map(function(f){
-   const fid = f.frame_id.name;
-   return row([fid, f.rows, f.num_columns],
-              [function(){inspectFrame(fid)}, null, null])}));
-  const hadSel = document.getElementById('trframe').value;
-  opt('trframe', fr.frames.map(function(f){return f.frame_id.name}), true);
-  const sel = document.getElementById('trframe').value;
-  if(sel && sel !== hadSel) await loadRespCols(sel);
-  const mo = await j('/3/Models');
-  fill('models', ['key','algo','category'], mo.models.map(function(m){
-   const mid = m.model_id.name;
-   return row([mid, m.algo, m.output.model_category],
-              [function(){inspectModel(mid)}, null, null])}));
-  const jb = await j('/3/Jobs');
-  fill('jobs', ['key','description','status','progress'],
-   jb.jobs.map(function(x){return row([x.key.name, x.description,
-    x.status, (100 * x.progress).toFixed(0) + '%'])}));
- }catch(e){document.getElementById('cloud').textContent =
-   'error: ' + e.message}}
+/* ------------------------------------------------------- result rendering */
+function table(heads, rows, links){
+ const t = el('table');
+ t.appendChild(el('tr', {}, heads.map(h=>el('th',{textContent:h}))));
+ rows.forEach((r, ri)=>{
+  t.appendChild(el('tr', {}, r.map((v, ci)=>{
+   const td = el('td');
+   if(links && links[ci]){const a = el('a',{textContent: v==null?'':v});
+    a.onclick = ()=>links[ci](r, ri); td.appendChild(a);}
+   else td.textContent = v==null?'':v;
+   return td})))});
+ return t}
+function jsonOut(o){return el('pre',{textContent: JSON.stringify(o,null,1)
+  .slice(0, 20000)})}
 
-async function doImport(ev){
- ev.preventDefault();
- const msg = document.getElementById('importmsg');
- try{
-  const path = document.getElementById('importpath').value;
-  const imp = await j('/3/ImportFiles?path=' + encodeURIComponent(path));
-  if(imp.fails.length) throw new Error('not found: ' + imp.fails[0]);
-  const setup = await j('/3/ParseSetup', {method:'POST',
-   headers:{'Content-Type':'application/json'},
-   body: JSON.stringify({source_frames: imp.files})});
-  const parse = await j('/3/Parse', {method:'POST',
-   headers:{'Content-Type':'application/json'},
-   body: JSON.stringify({source_frames: imp.files,
-                         destination_frame: setup.destination_frame})});
-  await pollJob(parse.job.key.name);
-  msg.className = 'ok'; msg.textContent = 'parsed → '
-    + setup.destination_frame;
-  refresh();
- }catch(e){msg.className = 'err'; msg.textContent = e.message}
- return false}
+/* ------------------------------------------------------ the Flow language */
+function parseCell(src){
+ src = src.trim();
+ if(src.startsWith('md:')) return {md: src.slice(3)};
+ const m = src.match(/^([A-Za-z_][A-Za-z0-9_]*)\s*([\s\S]*)$/);
+ if(!m) throw new Error('cannot parse cell; expected: routine [json args]');
+ let rest = m[2].trim();
+ const args = [];
+ // split top-level comma-separated JSON values: "gbm", {...} — quote-aware
+ // so commas/brackets inside string args survive
+ let depth = 0, cur = '', inq = null, prevc = '';
+ for(const ch of rest){
+  if(inq){ cur += ch; if(ch === inq && prevc !== String.fromCharCode(92))
+    inq = null; prevc = ch; continue; }
+  if(ch === String.fromCharCode(34) || ch === String.fromCharCode(39))
+   inq = ch;
+  if('[{'.includes(ch)) depth++;
+  if(']}'.includes(ch)) depth--;
+  if(ch === ',' && depth === 0){args.push(cur); cur='';} else cur += ch;
+  prevc = ch;
+ }
+ if(cur.trim()) args.push(cur);
+ return {routine: m[1], args: args.map(a=>{
+  a = a.trim();
+  try{return JSON.parse(a)}catch(e){
+   const q = a.charAt(0);
+   if((q === String.fromCharCode(34) || q === String.fromCharCode(39))
+      && a.endsWith(q)) return a.slice(1, -1);
+   return a}})};
+}
 
-async function pollJob(key){
+async function pollJob(jobjson, onTick){
+ let key = jobjson.job && jobjson.job.key ? jobjson.job.key.name : null;
+ if(!key) return jobjson.job || jobjson;
  for(;;){
-  const jj = (await j('/3/Jobs/' + encodeURIComponent(key))).jobs[0];
-  document.getElementById('jobkey').textContent = key;
-  document.getElementById('jobstate').textContent = jj.status;
-  document.getElementById('jobbar').value = jj.progress;
+  const jj = (await J('/3/Jobs/'+encodeURIComponent(key))).jobs[0];
+  if(onTick) onTick(jj);
   if(jj.status === 'DONE') return jj;
-  if(jj.status === 'FAILED') throw new Error(jj.exception || 'job failed');
-  if(jj.status === 'CANCELLED') throw new Error('job cancelled');
-  await new Promise(function(res){setTimeout(res, 300)})}}
+  if(jj.status === 'FAILED' || jj.status === 'CANCELLED')
+   throw new Error(jj.status + ': ' + (jj.exception||''));
+  await new Promise(res=>setTimeout(res, 250));
+ }
+}
 
-async function doTrain(ev){
- ev.preventDefault();
- const msg = document.getElementById('jobmsg');
- msg.textContent = ''; msg.className = '';
- try{
-  const algo = document.getElementById('algo').value;
-  const body = JSON.parse(
-    document.getElementById('trparams').value || '{}');
-  body.training_frame = document.getElementById('trframe').value;
-  body.response_column = document.getElementById('trresp').value;
-  const resp = await j('/3/ModelBuilders/' + algo, {method:'POST',
+const ROUTINES = {
+ async assist(){ return el('div', {}, Object.keys(TEMPLATES).map(k=>{
+   const a = el('a',{textContent:k});
+   a.onclick=()=>newCellBelow(activeIdx, TEMPLATES[k]); return a;})) },
+
+ async importFiles(i, paths){
+  if(typeof paths === 'string') paths = [paths];
+  const out = [];
+  for(const p of paths){
+   const imp = await J('/3/ImportFiles?path='+encodeURIComponent(p));
+   const fs = imp.files||[];
+   for(const f of fs) out.push(f);
+  }
+  return el('div', {}, [txt('imported: '+out.join(', ')),
+   el('div',{},[el('a',{textContent:'↳ setupParse',
+    onclick:()=>newCellBelow(activeIdx,
+     'setupParse {"source_frames": '+JSON.stringify(out)+'}')})])]);
+ },
+
+ async setupParse(i, spec){
+  const s = await J('/3/ParseSetup', {method:'POST',
+   headers:{'Content-Type':'application/json'}, body: JSON.stringify(spec)});
+  const tmpl = {source_frames: spec.source_frames,
+                destination_frame: s.destination_frame};
+  return el('div', {}, [
+   table(['destination','columns','parse type'],
+         [[s.destination_frame, (s.column_names||[]).length,
+           s.parse_type||'CSV']]),
+   el('a',{textContent:'↳ parseFiles', onclick:()=>newCellBelow(
+     activeIdx, 'parseFiles '+JSON.stringify(tmpl))})]);
+ },
+
+ async parseFiles(i, spec){
+  const job = await J('/3/Parse', {method:'POST',
+   headers:{'Content-Type':'application/json'}, body: JSON.stringify(spec)});
+  const done = await pollJob(job, jj=>setOut(i,
+   el('div',{},[txt('parsing '), el('progress',{max:1,value:jj.progress})])));
+  return el('div', {}, [txt('frame '),
+   el('a',{textContent: done.dest.name,
+    onclick:()=>newCellBelow(activeIdx,
+     'getFrameSummary "'+done.dest.name+'"')})]);
+ },
+
+ async getFrames(){
+  const f = await J('/3/Frames');
+  return table(['frame', 'rows', 'columns'],
+   f.frames.map(x=>[x.frame_id.name, x.rows, x.num_columns]),
+   [(r)=>newCellBelow(activeIdx, 'getFrameSummary "'+r[0]+'"')]);
+ },
+
+ async getFrameSummary(i, id){
+  const f = await J('/3/Frames/'+encodeURIComponent(id)+'/summary');
+  const fr = f.frames[0];
+  return el('div', {}, [
+   el('b',{textContent: id+' — '+fr.rows+' rows × '+
+           fr.num_columns+' cols'}),
+   table(['column','type','mean','sigma','missing'],
+    fr.columns.map(c=>[c.label, c.type, fmt(c.mean), fmt(c.sigma),
+                       c.missing_count]))]);
+ },
+
+ async getFrameData(i, id){
+  const f = await J('/3/Frames/'+encodeURIComponent(id)+'?row_count=10');
+  const fr = f.frames[0];
+  const heads = fr.columns.map(c=>c.label);
+  const n = Math.min(10, fr.rows);
+  const rows = [];
+  for(let r=0;r<n;r++) rows.push(fr.columns.map(c=>{
+   const d = c.data || c.string_data; let v = d ? d[r] : null;
+   if(v != null && c.domain && c.type==='enum') v = c.domain[v];
+   return fmt(v)}));
+  return table(heads, rows);
+ },
+
+ async splitFrame(i, spec){
+  const res = await J('/3/SplitFrame', {method:'POST',
+   headers:{'Content-Type':'application/json'}, body: JSON.stringify(spec)});
+  return jsonOut(res.destination_frames);
+ },
+
+ async buildModel(i, algo, params){
+  const job = await J('/3/ModelBuilders/'+encodeURIComponent(algo),
+   {method:'POST', headers:{'Content-Type':'application/json'},
+    body: JSON.stringify(params)});
+  const done = await pollJob(job, jj=>setOut(i,
+   el('div',{},[txt('training '+algo+' '),
+    el('progress',{max:1,value:jj.progress})])));
+  return el('div', {}, [txt('model '),
+   el('a',{textContent: done.dest.name, onclick:()=>newCellBelow(
+     activeIdx, 'getModel "'+done.dest.name+'"')})]);
+ },
+
+ async getModels(){
+  const m = await J('/3/Models');
+  return table(['model','algo','category'],
+   m.models.map(x=>[x.model_id.name, x.algo,
+    (x.output||{}).model_category]),
+   [(r)=>newCellBelow(activeIdx, 'getModel "'+r[0]+'"')]);
+ },
+
+ async getModel(i, id){
+  const res = await J('/3/Models/'+encodeURIComponent(id));
+  const m = res.models[0];
+  const out = m.output || {};
+  const kids = [el('b',{textContent: id+' ('+m.algo+', '+
+                        out.model_category+')'})];
+  const tm = out.training_metrics || {};
+  const rows = Object.entries(tm).filter(kv=>typeof kv[1] === 'number')
+    .map(kv=>[kv[0], fmt(kv[1])]);
+  if(rows.length) kids.push(table(['metric','training'], rows));
+  const vi = out.variable_importances;
+  if(vi && vi.variable){
+   const vrows = vi.variable.map((v, k)=>[v, fmt(vi.scaled_importance[k]),
+                                          fmt(vi.percentage[k])]);
+   kids.push(el('b',{textContent:'variable importances'}));
+   kids.push(table(['variable','scaled','percentage'], vrows));
+  }
+  kids.push(el('a',{textContent:'↳ predict', onclick:()=>newCellBelow(
+    activeIdx, 'predict {"model": "'+id+'", "frame": "<frame-id>"}')}));
+  return el('div', {}, kids);
+ },
+
+ async predict(i, spec){
+  const res = await J('/3/Predictions/models/'+
+   encodeURIComponent(spec.model)+'/frames/'+
+   encodeURIComponent(spec.frame), {method:'POST'});
+  const out = res.predictions_frame.name;
+  return el('div', {}, [txt('predictions '),
+   el('a',{textContent: out,
+    onclick:()=>newCellBelow(activeIdx, 'getFrameData "'+out+'"')})]);
+ },
+
+ async getJobs(){
+  const jbs = await J('/3/Jobs');
+  return table(['job','description','status','progress'],
+   jbs.jobs.map(x=>[x.key.name, x.description, x.status,
+                    fmt(x.progress)]));
+ },
+
+ async getJob(i, id){
+  return jsonOut((await J('/3/Jobs/'+encodeURIComponent(id))).jobs[0]);
+ },
+
+ async rapids(i, expr){
+  const res = await J('/99/Rapids', {method:'POST',
    headers:{'Content-Type':'application/json'},
-   body: JSON.stringify(body)});
-  const done = await pollJob(resp.job.key.name);
-  msg.className = 'ok';
-  msg.textContent = 'model → ' + done.dest.name;
-  refresh(); inspectModel(done.dest.name);
- }catch(e){msg.className = 'err'; msg.textContent = e.message}
- return false}
+   body: JSON.stringify({ast: expr})});
+  if(res.key) return el('div',{},[txt('frame '),
+   el('a',{textContent:res.key.name, onclick:()=>newCellBelow(
+     activeIdx, 'getFrameData "'+res.key.name+'"')})]);
+  return jsonOut(res.scalar !== null && res.scalar !== undefined ?
+                 res.scalar : (res.values || res.string || res));
+ },
 
-async function boot(){
- try{const mb = await j('/3/ModelBuilders');
-  opt('algo', mb.model_builders ? Object.keys(mb.model_builders)
-      : mb.algos || []);
- }catch(e){}
- document.getElementById('trframe').onchange = function(){
-  loadRespCols(document.getElementById('trframe').value)};
- refresh(); setInterval(refresh, 3000)}
-boot();
+ async deleteFrame(i, id){
+  await J('/3/Frames/'+encodeURIComponent(id), {method:'DELETE'});
+  return txt('deleted '+id);
+ },
+ async deleteModel(i, id){
+  await J('/3/Models/'+encodeURIComponent(id), {method:'DELETE'});
+  return txt('deleted '+id);
+ },
+};
+
+function fmt(v){return typeof v === 'number' && isFinite(v) ?
+ (Number.isInteger(v)? v : v.toPrecision(5)) : v}
+
+const TEMPLATES = {
+ 'import files':   'importFiles ["/path/to/data.csv"]',
+ 'list frames':    'getFrames',
+ 'inspect frame':  'getFrameSummary "<frame-id>"',
+ 'peek rows':      'getFrameData "<frame-id>"',
+ 'split frame':    'splitFrame {"dataset": "<frame-id>", "ratios": [0.75]}',
+ 'build model':    'buildModel "gbm", {"training_frame": "<frame-id>", ' +
+                   '"response_column": "<y>", "ntrees": 20}',
+ 'list models':    'getModels',
+ 'predict':        'predict {"model": "<model-id>", "frame": "<frame-id>"}',
+ 'rapids':         'rapids "(mean (cols <frame-id> 0) true)"',
+ 'jobs':           'getJobs',
+ 'markdown':       'md: ## notes',
+};
+
+async function runCell(i){
+ const c = cells[i]; setActive(i);
+ let parsed;
+ try{parsed = parseCell(c.input)}catch(e){return setErr(i, e.message)}
+ if(parsed.md !== undefined){
+  const d = el('div',{className:'md'});
+  d.innerHTML = mdLite(parsed.md); return setOut(i, d)}
+ const fn = ROUTINES[parsed.routine];
+ if(!fn) return setErr(i, 'unknown routine "'+parsed.routine+
+                          '" — try assist');
+ try{ setOut(i, txt('…'));
+  const node = await fn(i, ...parsed.args);
+  setOut(i, node || txt('ok'));
+ }catch(e){ setErr(i, String(e.message||e)) }
+}
+function mdLite(s){return s.split(/\n/).map(l=>{
+ if(l.startsWith('## ')) return '<h3>'+esc(l.slice(3))+'</h3>';
+ if(l.startsWith('# '))  return '<h2>'+esc(l.slice(2))+'</h2>';
+ return '<p>'+esc(l)+'</p>'}).join('')}
+function esc(s){return s.replace(/[&<>]/g,
+ c=>({'&':'&amp;','<':'&lt;','>':'&gt;'}[c]))}
+
+/* -------------------------------------------------- notebook save / load */
+async function saveNotebook(){
+ const name = document.getElementById('nbname').value.trim() || 'unnamed';
+ const flowObj = {version: 1, cells: cells.map(c=>({input: c.input}))};
+ await J('/3/NodePersistentStorage/notebook/'+encodeURIComponent(name),
+  {method:'POST', headers:{'Content-Type':'application/json'},
+   body: JSON.stringify({value: JSON.stringify(flowObj)})});
+ document.getElementById('savemsg').textContent =
+  'saved '+name+' @ '+new Date().toLocaleTimeString();
+}
+async function listNotebooks(){
+ const res = await J('/3/NodePersistentStorage/notebook');
+ const names = (res.entries||[]).map(e=>e.name||e);
+ const box = document.getElementById('assist');
+ box.replaceChildren(el('b',{textContent:'notebooks'}),
+  ...names.map(n=>{const a = el('a',{textContent:n});
+   a.onclick=()=>{loadNotebook(n); box.style.display='none'}; return a}),
+  names.length?txt(''):txt(' (none saved)'));
+ box.style.display='block';
+}
+async function loadNotebook(name){
+ const raw = await J('/3/NodePersistentStorage/notebook/'+
+                     encodeURIComponent(name));
+ const obj = typeof raw === 'string' ? JSON.parse(raw) : raw;
+ cells = (obj.cells||[]).map(c=>({input: c.input}));
+ document.getElementById('nbname').value = name;
+ renderCells();
+}
+function toggleAssist(){
+ const box = document.getElementById('assist');
+ if(box.style.display === 'block'){box.style.display='none'; return}
+ box.replaceChildren(el('b',{textContent:'assist'}),
+  ...Object.keys(TEMPLATES).map(k=>{const a = el('a',{textContent:k});
+   a.onclick=()=>{newCellBelow(activeIdx, TEMPLATES[k]);
+    box.style.display='none'}; return a}));
+ box.style.display='block';
+}
+
+/* ------------------------------------------------------------------ boot */
+(async function(){
+ try{
+  const c = await J('/3/Cloud');
+  document.getElementById('cloudinfo').textContent =
+   c.cloud_name+' · '+c.cloud_size+' node · '+(c.version||'tpu');
+ }catch(e){
+  document.getElementById('cloudinfo').textContent = 'cloud unreachable';
+ }
+ cells = [{input: 'assist'}, {input: 'getFrames'}];
+ renderCells();
+})();
 </script></body></html>"""

@@ -21,13 +21,20 @@ from __future__ import annotations
 
 import importlib
 import os
+import sys
 import time
 
 
 def main() -> None:
-    assisted = os.environ.get("H2O_ASSISTED_CLUSTERING", "").lower() in (
-        "1", "true") or os.environ.get(
-        "H2O_TPU_ASSISTED_CLUSTERING", "").lower() in ("1", "true")
+    # the unified flag surface (`water/H2O.OptArgs` analog): CLI > env >
+    # defaults, resolved values exported back to the environment so every
+    # runtime consumer observes them; --help prints the full flag set
+    from .utils import optargs
+
+    args = optargs.parse(sys.argv[1:])
+    optargs.ARGS = args
+    assisted = args.assisted_clustering or os.environ.get(
+        "H2O_ASSISTED_CLUSTERING", "").lower() in ("1", "true")
     if assisted:
         # the reference's H2O_ASSISTED_CLUSTERING flag: stand up the
         # port-8080 sidecar API and BLOCK until the operator's flatfile has
@@ -65,8 +72,33 @@ def main() -> None:
     if cache:
         info(f"persistent XLA compile cache at {cache}")
 
-    port = int(os.environ.get("H2O_TPU_REST_PORT", 54321))
-    server = H2OServer(port=port).start()
+    auth_check = None
+    negotiate = None
+    if args.ldap_login:
+        # ldap[s]://host[:port]/dn-template (e.g. uid={},ou=people,dc=x)
+        import urllib.parse as _up
+
+        from .utils.ldap import LdapAuth
+
+        u = _up.urlparse(args.ldap_login)
+        auth_check = LdapAuth(
+            u.hostname or args.ldap_login, port=u.port,
+            dn_template=(u.path.lstrip("/") or "uid={}"),
+            use_tls=u.scheme == "ldaps")
+    elif args.pam_login:
+        from .utils.pam import PamAuth
+
+        auth_check = PamAuth()
+    if args.kerberos_login:
+        from .utils.krb import SpnegoAuth
+
+        negotiate = SpnegoAuth()
+    server = H2OServer(
+        port=args.port, name=args.name,
+        hash_login=args.hash_login or None,
+        ssl_certfile=args.ssl_certfile or None,
+        ssl_keyfile=args.ssl_keyfile or None,
+        auth_check=auth_check, negotiate_auth=negotiate).start()
     info(f"REST serving on {server.url}")
     while True:
         time.sleep(60)

@@ -514,8 +514,8 @@ class RuleFit(ModelBuilder):
         free = np.zeros(P1, bool)
         free[-1] = True
         neff = float(jnp.sum(w))
-        G0, b0, _, _ = step(Xraw, y, w, jnp.asarray(beta, jnp.float32),
-                            offset)
+        G0, b0, dev0, _ = step(Xraw, y, w, jnp.asarray(beta, jnp.float32),
+                               offset)
         grad0 = np.abs(np.asarray(b0) - np.asarray(G0) @ beta)[:-1]
         lmax = float(grad0.max()) / max(neff, 1.0)
         nl = min(p.nlambdas, 20)
@@ -526,6 +526,10 @@ class RuleFit(ModelBuilder):
         nulldev = float(jnp.sum(family.deviance(y, mu0, w)))
         iters = 0
         dev_lambda_prev = np.inf
+        # the lambda-max pass already evaluated step() at this beta — seed
+        # the first iteration with it instead of paying a duplicate epoch
+        # over the streamed design
+        seeded = (G0, b0, float(dev0))
         for lam in lambdas:
             job.check_cancelled()
             l1 = float(lam) * neff  # alpha = 1 (pure lasso, like the ref)
@@ -533,9 +537,13 @@ class RuleFit(ModelBuilder):
             # warm-started IRLS converges in 2-3 steps per lambda; the cap
             # bounds the pass count on the streamed design
             for it in range(min(max(p.max_iterations, 1), 5)):
-                G, b, dev_t, _ = step(Xraw, y, w,
-                                      jnp.asarray(beta, jnp.float32), offset)
-                iters += 1
+                if seeded is not None:
+                    G, b, dev_t = seeded
+                    seeded = None
+                else:
+                    G, b, dev_t, _ = step(
+                        Xraw, y, w, jnp.asarray(beta, jnp.float32), offset)
+                    iters += 1
                 dev = float(dev_t)
                 beta_new = _admm_solve(np.asarray(G, np.float64),
                                        np.asarray(b, np.float64), l1, 0.0,

@@ -59,18 +59,38 @@ def _poll(srv, key):
     raise TimeoutError(key)
 
 
-def test_page_serves_interactive_flow(srv):
+def test_page_serves_notebook_flow(srv):
     with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/") as r:
         html = r.read().decode()
         assert r.headers["Content-Type"].startswith("text/html")
-    # the interactive pieces must be present (forms + JS handlers)
-    for needle in ("doImport", "doTrain", "pollJob", "inspectFrame",
-                   "inspectModel", "id=trainform", "id=importform",
-                   "/3/ModelBuilders", "/3/Parse"):
-        assert needle in html, f"Flow page lost {needle!r}"
-    # no inline event-handler XSS surface from keys: keys are set via
-    # textContent, never innerHTML interpolation
-    assert "innerHTML" not in html
+    # the notebook pieces must be present: cell machinery, the Flow
+    # routines, assist templates, notebook persistence
+    for needle in ("runCell", "newCellBelow", "ROUTINES", "assist",
+                   "importFiles", "setupParse", "parseFiles", "getFrames",
+                   "buildModel", "getModel", "predict", "rapids",
+                   "saveNotebook", "loadNotebook", "NodePersistentStorage",
+                   "/3/ModelBuilders", "/3/Parse", "TEMPLATES"):
+        assert needle in html, f"Flow notebook lost {needle!r}"
+    # server data renders through textContent only; the two innerHTML sinks
+    # hold self-generated DOM (outHtml) and escaped markdown (mdLite+esc)
+    assert "esc(" in html and "textContent" in html
+
+
+def test_notebook_save_load_roundtrip(srv):
+    """The saveNotebook/loadNotebook wire sequence: POST the flow object to
+    NPS category 'notebook', list it, GET it back intact."""
+    flow = {"version": 1, "cells": [{"input": "getFrames"},
+                                    {"input": "md: ## hello"}]}
+    _post(srv, "/3/NodePersistentStorage/notebook/my_flow",
+          {"value": json.dumps(flow)})
+    entries = _get(srv, "/3/NodePersistentStorage/notebook")["entries"]
+    names = [e["name"] if isinstance(e, dict) else e for e in entries]
+    assert "my_flow" in names
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}"
+            f"/3/NodePersistentStorage/notebook/my_flow") as r:
+        raw = r.read().decode()
+    assert json.loads(raw) == flow
 
 
 def test_browser_flow_end_to_end(srv):

@@ -1,0 +1,69 @@
+"""OptArgs — the unified flag surface (`water/H2O.OptArgs` analog)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from h2o_tpu.utils import optargs
+
+
+def test_defaults():
+    a = optargs.OptArgs()
+    assert a.port == 54321 and a.name == "h2o_tpu"
+    assert a.exact_bin_rows == 16384
+
+
+def test_cli_overrides_env(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_REST_PORT", "55555")
+    a = optargs.parse(["--port", "56000", "--name", "cloudy"])
+    assert a.port == 56000 and a.name == "cloudy"
+    # resolved values export back to the env for scattered consumers
+    import os
+
+    assert os.environ["H2O_TPU_REST_PORT"] == "56000"
+
+
+def test_env_layer(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_EXACT_BIN_ROWS", "999")
+    a = optargs.parse([])
+    assert a.exact_bin_rows == 999
+
+
+def test_bool_flags(monkeypatch):
+    monkeypatch.delenv("H2O_TPU_ALLOW_WIRE_UDF", raising=False)
+    a = optargs.parse(["--allow-wire-udf"])
+    assert a.allow_wire_udf is True
+    a2 = optargs.parse(["--allow-wire-udf", "false"])
+    assert a2.allow_wire_udf is False
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(SystemExit, match="unknown flag"):
+        optargs.parse(["--frobnicate", "1"])
+
+
+def test_bad_value_rejected():
+    with pytest.raises(SystemExit, match="bad value"):
+        optargs.parse(["--port", "not_a_port"])
+
+
+def test_help_lists_every_flag():
+    text = optargs.help_text()
+    import dataclasses
+
+    for f in dataclasses.fields(optargs.OptArgs):
+        assert f.name.replace("_", "-") in text, f.name
+    # env spellings are documented
+    assert "H2O_TPU_REST_PORT" in text
+
+
+def test_help_exits_zero_in_subprocess():
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '/root/repo'); "
+         "from h2o_tpu.utils import optargs; "
+         "optargs.parse(['--help'])"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "usage:" in out.stdout and "--port" in out.stdout
