@@ -394,6 +394,32 @@ class GLMParameters(Parameters):
                                    # inserts the cross-axis collectives
 
 
+def _shard_cols(X, y_dev, fp: int):
+    """Re-lay the design over a rows×cols mesh (feature_parallelism > 1):
+    wide one-hot designs shard the Gram accumulation over the feature axis
+    too (SURVEY §5.7). Zero-pads the feature axis to the shard count (the
+    cols-axis ESPC analog); padded columns solve to beta=0 and callers strip
+    them."""
+    if fp <= 1:
+        return X, y_dev, 0
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from ..parallel.mesh import COLS, ROWS as _R, make_mesh
+
+    ndev = len(jax.devices())
+    if ndev % fp:
+        raise ValueError(f"feature_parallelism={fp} must divide the "
+                         f"device count {ndev}")
+    pad_cols = (-X.shape[1]) % fp
+    if pad_cols:
+        X = jnp.concatenate(
+            [X, jnp.zeros((X.shape[0], pad_cols), X.dtype)], axis=1)
+    mesh2 = make_mesh(row_parallel=ndev // fp)
+    X = jax.device_put(X, NamedSharding(mesh2, _P(_R, COLS)))
+    y_dev = jax.device_put(y_dev, NamedSharding(mesh2, _P(_R)))
+    return X, y_dev, pad_cols
+
+
 def _beta_bounds(spec, di, pad_cols: int = 0):
     """(lo, hi) arrays over [expanded coefs..., intercept] on the TRAINING
     (standardized) scale, from a natural-scale constraint spec — a Frame or
@@ -911,12 +937,12 @@ class GLM(ModelBuilder):
             if p.linear_constraints is not None:
                 raise ValueError("Constrained GLM is not supported for "
                                  "multinomial and ordinal families")
-            if p.feature_parallelism > 1:
-                raise NotImplementedError(
-                    "feature_parallelism for multinomial GLM is a planned "
-                    "follow-up (per-class block IRLS needs per-block "
-                    "resharding)")
+
             if (p.family or "").lower() == "ordinal":
+                if p.feature_parallelism > 1:
+                    raise NotImplementedError(
+                        "feature_parallelism is not supported for ordinal "
+                        "GLM (the gradient path has no column-sharded Gram)")
                 return self._build_ordinal(job, names, y_dev, resp_domain)
             return self._build_multinomial(job, names, y_dev, resp_domain)
         family = self._family(category)
@@ -924,29 +950,7 @@ class GLM(ModelBuilder):
         dinfo = DataInfo.make(fr, names, standardize=p.standardize,
                               missing_values_handling=p.missing_values_handling)
         X, okrow = dinfo.expand(fr)
-        pad_cols = 0
-        if p.feature_parallelism > 1:
-            # re-lay the design over a rows×cols mesh: wide one-hot designs
-            # shard the Gram accumulation over the feature axis too
-            from jax.sharding import NamedSharding, PartitionSpec as _P
-
-            from ..parallel.mesh import COLS, ROWS as _R, make_mesh
-
-            ndev = len(jax.devices())
-            if ndev % p.feature_parallelism:
-                raise ValueError(f"feature_parallelism="
-                                 f"{p.feature_parallelism} must divide the "
-                                 f"device count {ndev}")
-            fp = p.feature_parallelism
-            # zero-pad the feature axis to the shard count (the cols-axis
-            # ESPC analog); padded columns solve to beta=0 and are stripped
-            pad_cols = (-X.shape[1]) % fp
-            if pad_cols:
-                X = jnp.concatenate(
-                    [X, jnp.zeros((X.shape[0], pad_cols), X.dtype)], axis=1)
-            mesh2 = make_mesh(row_parallel=ndev // fp)
-            X = jax.device_put(X, NamedSharding(mesh2, _P(_R, COLS)))
-            y_dev = jax.device_put(y_dev, NamedSharding(mesh2, _P(_R)))
+        X, y_dev, pad_cols = _shard_cols(X, y_dev, p.feature_parallelism)
         y = jnp.nan_to_num(y_dev)
         w = (~jnp.isnan(y_dev)).astype(jnp.float32) * okrow.astype(jnp.float32)
         if p.weights_column:
@@ -1373,6 +1377,7 @@ class GLM(ModelBuilder):
         dinfo = DataInfo.make(fr, names, standardize=p.standardize,
                               missing_values_handling=p.missing_values_handling)
         X, okrow = dinfo.expand(fr)
+        X, y_dev, pad_cols = _shard_cols(X, y_dev, p.feature_parallelism)
         ones = jnp.ones((X.shape[0], 1), jnp.float32)
         Xi = jnp.concatenate([X, ones], axis=1)
         y = jnp.nan_to_num(y_dev)
@@ -1390,7 +1395,7 @@ class GLM(ModelBuilder):
         neff = float(jnp.sum(w))
         # box constraints apply identically to every class block (the
         # reference projects each class against the shared BetaConstraint)
-        bounds = _beta_bounds(p.beta_constraints, dinfo)
+        bounds = _beta_bounds(p.beta_constraints, dinfo, pad_cols=pad_cols)
         sweeps = max(2, min(6, p.max_iterations // 5))
         for _ in range(sweeps):
             job.check_cancelled()
@@ -1413,6 +1418,10 @@ class GLM(ModelBuilder):
                     if bounds is not None:
                         bk = np.clip(bk, bounds[0], bounds[1])
                 betas[k] = bk
+        if pad_cols:  # strip padding: per-class coefs (~0) and design cols
+            betas = np.concatenate(
+                [betas[:, :dinfo.ncols_expanded], betas[:, -1:]], axis=1)
+            X = X[:, :dinfo.ncols_expanded]
         output = ModelOutput()
         output.names = names
         output.domains = {n: fr.vec(n).domain for n in names}
@@ -1421,7 +1430,8 @@ class GLM(ModelBuilder):
         model = GLMMultinomialModel(p, output, dinfo, betas, family)
         raw = model.score0(X)
         ym = jnp.where(w > 0, y, jnp.nan)
-        output.training_metrics = make_metrics("Multinomial", ym, raw, None)
+        output.training_metrics = make_metrics(
+            "Multinomial", ym, raw, w if p.weights_column else None)
         return model
 
     def _build_hglm(self, job, names, y_dev, category):

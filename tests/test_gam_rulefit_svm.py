@@ -240,3 +240,57 @@ class TestRuleFitStreaming:
         # rule importances populated in both modes
         ri = m_stream.rule_importance()
         assert len(ri) > 0 and all("rule" in r for r in ri)
+
+
+class TestPsvmNystromAccuracyBridge:
+    def test_matches_exact_kernel_svm(self):
+        """The accuracy bridge for the Nystrom divergence (the reference
+        solves the EXACT primal-dual ICF SVM): on data small enough to
+        solve the exact RBF dual QP directly (via the constrained-GLM
+        active-set solver), the Nystrom PSVM's decision function must agree
+        in sign almost everywhere and correlate strongly — pinning how far
+        the approximation sits from the exact machine."""
+        from h2o_tpu.models.glm import _constrained_qp
+        from h2o_tpu.models.psvm import PSVM, SVMParameters
+        from h2o_tpu.frame.vec import T_CAT, Vec
+
+        rng = np.random.default_rng(7)
+        n = 400
+        X = rng.normal(size=(n, 2)).astype(np.float64)
+        yy = np.where(np.hypot(X[:, 0], X[:, 1]) < 1.1, 1.0, -1.0)  # ring
+        flip = rng.random(n) < 0.03
+        yy[flip] *= -1
+
+        fr = Frame.from_dict({"x0": X[:, 0].astype(np.float32),
+                              "x1": X[:, 1].astype(np.float32)})
+        fr.add("y", Vec.from_numpy(((yy + 1) / 2).astype(np.float32),
+                                   type=T_CAT, domain=["neg", "pos"]))
+        C, gamma = 1.0, 0.5
+        m = PSVM(SVMParameters(training_frame=fr, response_column="y",
+                                hyper_param=C, gamma=gamma,
+                                seed=1)).train_model()
+        dec_nystrom = np.asarray(
+            m.decision_function(m.adapt_frame(fr)))[:n]
+
+        # exact dual: min ½αᵀQα − 1ᵀα, 0 ≤ α ≤ C, yᵀα = 0, Q = yyᵀ∘K
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-gamma * d2)
+        Q = (yy[:, None] * yy[None, :]) * K
+        Aeq = yy[None, :]
+        ceq = np.zeros(1)
+        Ain = np.vstack([np.eye(n) * -1.0, np.eye(n)])
+        cin = np.concatenate([np.zeros(n), -np.full(n, C)])
+        alpha = _constrained_qp(Q + 1e-8 * np.eye(n), np.ones(n),
+                                Aeq, ceq, Ain, cin, max_iter=2000)
+        sv = alpha > 1e-6
+        on_margin = sv & (alpha < C - 1e-6)
+        dec_exact_nob = (alpha * yy) @ K
+        b = float(np.mean(yy[on_margin] - dec_exact_nob[on_margin])) \
+            if on_margin.any() else 0.0
+        dec_exact = dec_exact_nob + b
+
+        # the bridge numbers: sign agreement and correlation
+        agree = float(np.mean(np.sign(dec_nystrom) == np.sign(dec_exact)))
+        corr = float(np.corrcoef(dec_nystrom, dec_exact)[0, 1])
+        assert agree > 0.95, f"sign agreement vs exact SVM: {agree}"
+        assert corr > 0.9, f"decision-function correlation: {corr}"

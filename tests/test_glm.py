@@ -478,3 +478,29 @@ def test_glm_interactions_guards():
         GLM(GLMParameters(training_frame=mfr, response_column="y",
                           family="multinomial",
                           interactions=["x1", "x2"])).train_model()
+
+
+def test_multinomial_feature_parallelism_matches_single():
+    """Round-4: the multinomial 2-D rows x cols mesh gate is gone — the
+    per-class block IRLS shards its Gram over the feature axis and lands
+    the same coefficients as the replicated path."""
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(11)
+    n = 1200
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    lab = np.argmax(x[:, :3] + 0.3 * rng.normal(size=(n, 3)), axis=1)
+    fr = Frame.from_dict({f"x{i}": x[:, i] for i in range(5)})
+    fr.add("y", Vec.from_numpy(lab.astype(np.float32), type=T_CAT,
+                               domain=["a", "b", "c"]))
+    base = dict(training_frame=fr, response_column="y",
+                family="multinomial", lambda_=0.0, seed=3)
+    m1 = GLM(GLMParameters(**base)).train_model()
+    m2 = GLM(GLMParameters(**base, feature_parallelism=2)).train_model()
+    b1 = np.asarray(m1.beta)
+    b2 = np.asarray(m2.beta)
+    assert b1.shape == b2.shape
+    np.testing.assert_allclose(b1, b2, rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(
+        m1.output.training_metrics.logloss,
+        m2.output.training_metrics.logloss, rtol=1e-3)

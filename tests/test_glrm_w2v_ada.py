@@ -116,3 +116,69 @@ def test_adaboost_glm_weak_learner():
                                     weak_learner="GLM", nlearners=5,
                                     seed=1)).train_model()
     assert m.output.training_metrics.auc > 0.95
+
+
+def test_word2vec_sgns_pmi_bridge():
+    """Accuracy bridge for the SGNS divergence (the reference trains
+    hierarchical softmax): SGNS with k negatives factorizes the
+    shifted PMI matrix, PMI(w,c) − log k (Levy & Goldberg 2014). On a
+    corpus with a known co-occurrence design, embedding dot products must
+    correlate strongly with empirical PMI — quantifying how the SGNS
+    embedding space relates to the corpus statistics an HS model would
+    also encode."""
+    rng = np.random.default_rng(8)
+    topics = {
+        0: ["red", "green", "blue", "cyan"],
+        1: ["dog", "cat", "fox", "wolf"],
+        2: ["one", "two", "six", "ten"],
+    }
+    vocab = [w for ws in topics.values() for w in ws]
+    words = []
+    for _ in range(1500):
+        t = int(rng.integers(0, 3))
+        ws = rng.choice(topics[t], size=6)
+        words.extend(ws.tolist())
+        words.append(None)
+    v = Vec(None, len(words), type=T_STR,
+            host_data=np.array(words, dtype=object))
+    fr = Frame(["words"], [v])
+    m = Word2Vec(Word2VecParameters(training_frame=fr, vec_size=24,
+                                    epochs=18, min_word_freq=2,
+                                    window_size=3, seed=2)).train_model()
+    # empirical window-3 co-occurrence counts -> PMI
+    idx = {w: i for i, w in enumerate(vocab)}
+    V = len(vocab)
+    C = np.zeros((V, V))
+    sent = []
+    for w in words:
+        if w is None:
+            for i, a in enumerate(sent):
+                for b in sent[max(0, i - 3): i]:
+                    C[idx[a], idx[b]] += 1
+                    C[idx[b], idx[a]] += 1
+            sent = []
+        else:
+            sent.append(w)
+    tot = C.sum()
+    pw = C.sum(axis=1) / tot
+    with np.errstate(divide="ignore"):
+        pmi = np.log(np.maximum(C / tot, 1e-12)
+                     / np.outer(pw, pw))
+    # embedding similarity per word pair
+    emb = np.stack([m.vectors[m.vocab[w]] for w in vocab])
+    emb = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    sim = emb @ emb.T
+    iu = np.triu_indices(V, k=1)
+    corr = float(np.corrcoef(sim[iu], pmi[iu])[0, 1])
+    assert corr > 0.6, f"SGNS similarity vs corpus PMI correlation: {corr}"
+    # within-topic similarity dominates cross-topic (the structure an HS
+    # model would also recover)
+    within, cross = [], []
+    for a in vocab:
+        for b in vocab:
+            if a >= b:
+                continue
+            ta = [t for t, ws in topics.items() if a in ws][0]
+            tb = [t for t, ws in topics.items() if b in ws][0]
+            (within if ta == tb else cross).append(sim[idx[a], idx[b]])
+    assert np.mean(within) > np.mean(cross) + 0.3
