@@ -42,7 +42,8 @@ from .server import H2OServer
 from . import explanation
 from .explanation import (explain, explain_row, varimp_heatmap,
                           model_correlation_heatmap, pd_multi_plot, varimp,
-                          model_correlation)
+                          model_correlation, disparate_analysis,
+                          pareto_front)
 
 explanation.register_explain_methods()
 

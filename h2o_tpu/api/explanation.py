@@ -1036,10 +1036,142 @@ def register_explain_methods():
     _c.H2OAutoML.varimp = varimp
 
 
+def _corrected_variance(accuracy, total):
+    """Literal `_explain.py:3519` formula (parity beats plausibility — the
+    reference subtracts the MEAN standard error inside the var call, which
+    collapses to a scalar shift):
+    max(0, var(accuracy - mean(accuracy*(1-accuracy)/total)))."""
+    accuracy = np.asarray(accuracy, float)
+    total = np.asarray(total, float)
+    se = np.mean(accuracy * (1 - accuracy) / total)
+    return float(max(0.0, np.var(accuracy - se)))
+
+
+def disparate_analysis(models, frame, protected_columns, reference,
+                       favorable_class, air_metric="selectedRatio",
+                       alpha=0.05):
+    """Aggregate intersectional fairness across models
+    (`_explain.py:3527`): per model, overall metrics plus AIR
+    min/mean/median/max, the coverage-weighted AIR (cair), the same
+    aggregates over SIGNIFICANT groups (p < alpha), and p-value aggregates.
+    Returns a pandas DataFrame ranked like a leaderboard."""
+    import pandas as pd
+
+    models = _get_models(models)
+    rows = []
+    for m in models:
+        fm = m.fairness_metrics(frame, protected_columns, reference,
+                                favorable_class)
+        ov = fm["overview"].as_data_frame()
+        col = "AIR_{}".format(air_metric)
+        if col not in ov.columns:
+            raise ValueError(
+                "Metric {} is not present in the result of "
+                "model.fairness_metrics. Please specify one of {}.".format(
+                    air_metric, ", ".join(
+                        c[4:] for c in ov.columns if c.startswith("AIR"))))
+        air = ov[col].to_numpy(dtype=float)
+        pv = ov["p.value"].to_numpy(dtype=float)
+        sig = air[pv < alpha]
+
+        def agg(fn, arr):
+            return float(fn(arr)) if len(arr) else float("nan")
+
+        perf = m.model_performance(frame)
+        row = {"model_id": m.model_id,
+               "auc": perf.get("AUC"), "logloss": perf.get("logloss"),
+               "num_of_features": len(_get_xy(m)[0]),
+               "var": float(np.var(ov["accuracy"])),
+               "corrected_var": _corrected_variance(ov["accuracy"],
+                                                    ov["total"]),
+               "air_min": agg(np.min, air), "air_mean": agg(np.mean, air),
+               "air_median": agg(np.median, air),
+               "air_max": agg(np.max, air),
+               "cair": float(np.sum(ov["relativeSize"].to_numpy() * air)),
+               "significant_air_min": agg(np.min, sig),
+               "significant_air_mean": agg(np.mean, sig),
+               "significant_air_median": agg(np.median, sig),
+               "significant_air_max": agg(np.max, sig),
+               "p.value_min": agg(np.min, pv),
+               "p.value_mean": agg(np.mean, pv),
+               "p.value_median": agg(np.median, pv),
+               "p.value_max": agg(np.max, pv)}
+        rows.append(row)
+    df = pd.DataFrame(rows)
+    # leaderboard contract: best model first (the reference cbinds onto
+    # make_leaderboard, ranked by the default metric)
+    if df["auc"].notna().any():
+        df = df.sort_values("auc", ascending=False)
+    elif df["logloss"].notna().any():
+        df = df.sort_values("logloss", ascending=True)
+    return df.reset_index(drop=True)
+
+
+def _calculate_pareto_front(x, y, top=True, left=True):
+    """Indices on the Pareto front of (x, y) (`_explain.py:2726`): sort by
+    the y-objective first so equal-x points keep only their best, then a
+    strictly-improving scan along x."""
+    x = np.asarray(x, float)
+    y = np.asarray(y, float)
+    yy = y if top else -y
+    # secondary sort: best y first within equal x (stable composition)
+    order = np.argsort(-yy, kind="stable")
+    order = order[np.argsort(x[order] if left else -x[order],
+                             kind="stable")]
+    best = -np.inf
+    keep = []
+    for i in order:
+        if yy[i] > best:
+            best = yy[i]
+            keep.append(i)
+    return np.asarray(keep, dtype=int)
+
+
+def pareto_front(frame, x_metric, y_metric, optimum="top left",
+                 title=None, color_col=None, figsize=(16, 9),
+                 save_plot_path=None):
+    """Scatter every row of ``frame`` (a leaderboard-like pandas DataFrame
+    or H2OFrame) in metric space and draw its Pareto front
+    (`_explain.py:2757`)."""
+    plt = _plt()
+    opt = (optimum or "").lower()
+    if opt not in ("top left", "top right", "bottom left", "bottom right"):
+        raise ValueError("optimum must be one of 'top left', 'top right', "
+                         f"'bottom left', 'bottom right' (got {optimum!r})")
+    df = frame.as_data_frame() if hasattr(frame, "as_data_frame") else frame
+    x = df[x_metric].to_numpy(dtype=float)
+    y = df[y_metric].to_numpy(dtype=float)
+    top = "top" in opt
+    left = "left" in opt
+    front = _calculate_pareto_front(x, y, top=top, left=left)
+    fig, ax = _figure(figsize)
+    if color_col is not None and color_col in df.columns:
+        cmap = plt.get_cmap("Dark2")
+        levels = sorted(map(str, set(df[color_col])))
+        cidx = {v: k for k, v in enumerate(levels)}
+        for lv in levels:
+            sel = df[color_col].astype(str) == lv
+            ax.scatter(x[sel.to_numpy()], y[sel.to_numpy()], s=18,
+                       color=cmap(cidx[lv] % 8), label=lv)
+    else:
+        ax.scatter(x, y, s=18, color="#888", label="all")
+    fo = front[np.argsort(x[front])]
+    ax.plot(x[fo], y[fo], "-o", color="#d62728", label="Pareto front")
+    ax.set_xlabel(x_metric)
+    ax.set_ylabel(y_metric)
+    ax.set_title(title or "Pareto front ({})".format(optimum))
+    ax.legend()
+    fig.tight_layout()
+    if save_plot_path is not None:
+        fig.savefig(save_plot_path)
+    return decorate_plot_result(res=df.iloc[front], figure=fig)
+
+
 __all__ = ["explain", "explain_row", "varimp_heatmap",
            "model_correlation_heatmap", "pd_multi_plot", "varimp",
            "model_correlation", "shap_summary_plot",
            "shap_explain_row_plot", "pd_plot", "ice_plot",
            "residual_analysis_plot", "learning_curve_plot",
+           "disparate_analysis", "pareto_front",
            "H2OExplanation", "decorate_plot_result",
            "register_explain_methods"]

@@ -182,3 +182,44 @@ class TestGroupedPermute:
 
 def test_prim_count_reaches_195():
     assert len(_PRIMS) >= 195, len(_PRIMS)
+
+
+class TestDisparateAnalysisAndPareto:
+    def test_disparate_analysis_frame(self):
+        import h2o_tpu.api as h2o
+        from h2o_tpu.backend.kvstore import STORE
+
+        fr = _bin_frame(1200)
+        h2o.init(port=54623)
+        try:
+            STORE.put(fr.key or "da_fr", fr)
+            frc = h2o.get_frame(fr.key)
+            ms = []
+            for nt in (4, 8):
+                est = h2o.H2OGradientBoostingEstimator(ntrees=nt,
+                                                       max_depth=3, seed=1)
+                est.train(y="y", training_frame=frc)
+                ms.append(h2o.get_model(est.model_id))
+            df = h2o.disparate_analysis(ms, frc, ["SEX"], None, "yes")
+            assert len(df) == 2
+            for col in ("model_id", "air_min", "air_max", "cair",
+                        "significant_air_min", "p.value_min",
+                        "corrected_var"):
+                assert col in df.columns, col
+            assert (df["air_min"] <= df["air_max"]).all()
+            assert df["cair"].between(0, 3).all()
+            # unknown metric gives the reference's actionable error
+            import pytest as _pt
+
+            with _pt.raises(ValueError, match="not present"):
+                h2o.disparate_analysis(ms, frc, ["SEX"], None, "yes",
+                                       air_metric="nonsense")
+            # pareto front over the analysis frame
+            res = h2o.pareto_front(df, "air_min", "auc",
+                                   optimum="top right")
+            import matplotlib.pyplot as plt
+
+            assert isinstance(res.figure(), plt.Figure)
+            assert len(res) >= 1  # the front rows ride as the result
+        finally:
+            h2o.shutdown()
