@@ -331,7 +331,8 @@ class DeepLearning(ModelBuilder):
             ymet = jnp.where(rowmask, y, jnp.nan)
             output.training_metrics = make_metrics(
                 category, ymet, raw,
-                None if p.weights_column is None else w)
+                None if p.weights_column is None else w,
+                auc_type=p.auc_type, domain=output.response_domain)
             if p.validation_frame is not None:
                 output.validation_metrics = model.model_performance(p.validation_frame)
         return model

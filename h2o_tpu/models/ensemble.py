@@ -76,7 +76,9 @@ class StackedEnsembleModel(Model):
                    constant_values=np.nan))
         if self.output.model_category == "Regression":
             raw_dev = raw_dev[:, 0]
-        return make_metrics(self.output.model_category, y, raw_dev, None)
+        return make_metrics(self.output.model_category, y, raw_dev, None,
+                            auc_type=self.params.auc_type,
+                            domain=self.output.response_domain)
 
 
 class StackedEnsemble(ModelBuilder):

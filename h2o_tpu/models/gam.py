@@ -391,7 +391,8 @@ class GAM(ModelBuilder):
         model.beta = beta
         raw = model.score0(Xi[:, :-1])
         ym = jnp.where(w > 0, y, jnp.nan)
-        m = make_metrics(category, ym, raw, w if p.weights_column else None)
+        m = make_metrics(category, ym, raw, w if p.weights_column else None,
+                         auc_type=p.auc_type, domain=output.response_domain)
         mu = family.linkinv(Xi @ jnp.asarray(beta, jnp.float32) + offset)
         m.residual_deviance = float(jnp.sum(family.deviance(y, mu, w)))
         m.null_deviance = nulldev

@@ -608,14 +608,17 @@ class GBM(ModelBuilder):
             m = None
             if self.drf_mode and p.sample_rate < 1.0 and prior is None:
                 m = self._oob_metrics(category, oob_sum, oob_cnt, y, ymask,
-                                      w if p.weights_column else None)
+                                      w if p.weights_column else None,
+                                      output.response_domain)
                 if m is not None:
                     m.description = "Reported on OOB data"
             if m is None:
                 m = make_metrics(category, s.ym,
                                  _metrics_raw(category, dist, f,
                                               self.drf_mode, ntrees_done),
-                                 None if p.weights_column is None else w)
+                                 None if p.weights_column is None else w,
+                                 auc_type=p.auc_type,
+                                 domain=output.response_domain)
             history.append({"timestamp": _t.time(), "number_of_trees": ntrees_done,
                             "training_metrics": m})
             job.update(len(keys) / max(n_new, 1))
@@ -646,7 +649,7 @@ class GBM(ModelBuilder):
             output.validation_metrics = model.model_performance(p.validation_frame)
         return model
 
-    def _oob_metrics(self, category, osum, ocnt, y, ymask, w):
+    def _oob_metrics(self, category, osum, ocnt, y, ymask, w, domain=None):
         """Metrics over out-of-bag predictions: rows never out of bag (tiny
         forests) are excluded like the reference's OOB scorer."""
         seen = ocnt > 0
@@ -665,7 +668,8 @@ class GBM(ModelBuilder):
             p = p / jnp.sum(p, axis=1, keepdims=True)
             label = jnp.argmax(p, axis=1).astype(jnp.float32)
             raw = jnp.concatenate([label[:, None], p], axis=1)
-        return make_metrics(category, ym, raw, w)
+        return make_metrics(category, ym, raw, w,
+                            auc_type=self.params.auc_type, domain=domain)
 
     def _fit_calibration(self, model, category):
         """Platt scaling on a holdout (`hex/tree/CalibrationHelper`): a 1-D

@@ -977,7 +977,8 @@ class GLM(ModelBuilder):
         model.interaction_cols = self._interaction_cols
         raw = model.score0(X)
         ym = jnp.where(w > 0, y, jnp.nan)
-        m = make_metrics(category, ym, raw, w if p.weights_column else None)
+        m = make_metrics(category, ym, raw, w if p.weights_column else None,
+                         auc_type=p.auc_type, domain=output.response_domain)
         m.residual_deviance = float(dev)
         m.null_deviance = float(nulldev)
         rank = int(np.sum(np.abs(np.asarray(beta)) > 1e-12))
@@ -1360,7 +1361,8 @@ class GLM(ModelBuilder):
         raw = model.score0(X)
         ym = jnp.where(w > 0, y, jnp.nan)
         m = make_metrics("Multinomial", ym, raw,
-                         w if p.weights_column else None)
+                         w if p.weights_column else None,
+                         auc_type=p.auc_type, domain=output.response_domain)
         output.training_metrics = m
         output.scoring_history = [{"iterations": i + 1,
                                    "negloglik": float(v)}]
@@ -1431,7 +1433,8 @@ class GLM(ModelBuilder):
         raw = model.score0(X)
         ym = jnp.where(w > 0, y, jnp.nan)
         output.training_metrics = make_metrics(
-            "Multinomial", ym, raw, w if p.weights_column else None)
+            "Multinomial", ym, raw, w if p.weights_column else None,
+            auc_type=p.auc_type, domain=output.response_domain)
         return model
 
     def _build_hglm(self, job, names, y_dev, category):

@@ -153,10 +153,34 @@ class ModelMetricsMultinomial(ModelMetrics):
     mean_per_class_error: float = np.nan
     confusion_matrix: Any = None
     hit_ratio_table: Any = None
+    # `hex/MultinomialAUC.java` surface: populated when auc_type != AUTO/NONE
+    auc: float = np.nan
+    pr_auc: float = np.nan
+    auc_type: str = "none"
+    _mauc: Any = None                      # MultinomialAUC (all aggregates)
+
+    @property
+    def multinomial_auc_table(self):       # lazy: scoring-history snapshots
+        return self._mauc.table(pr=False) if self._mauc else None
+
+    @property
+    def multinomial_aucpr_table(self):     # only ever read the scalar
+        return self._mauc.table(pr=True) if self._mauc else None
+
+    def auc_by_type(self, auc_type: str) -> float:
+        """Any aggregate on demand (`MultinomialAUC.getAucTable` accessors)."""
+        return self._mauc.get(auc_type, pr=False) if self._mauc else np.nan
+
+    def pr_auc_by_type(self, auc_type: str) -> float:
+        return self._mauc.get(auc_type, pr=True) if self._mauc else np.nan
 
     def __repr__(self):
-        return self._fmt([("LogLoss", self.logloss), ("MSE", self.mse),
-                          ("mean_per_class_error", self.mean_per_class_error)])
+        pairs = [("LogLoss", self.logloss), ("MSE", self.mse),
+                 ("mean_per_class_error", self.mean_per_class_error)]
+        if not np.isnan(self.auc):
+            pairs += [("AUC", f"{self.auc} ({self.auc_type})"),
+                      ("pr_auc", self.pr_auc)]
+        return self._fmt(pairs)
 
 
 # ---------------------------------------------------------------------------
@@ -297,7 +321,146 @@ def _gains_lift(pos, neg, npos, n, groups: int = 16):
         col_types=["long"] + ["double"] * 10, cell_values=rows)
 
 
-def make_multinomial_metrics(y, probs, weights=None) -> ModelMetricsMultinomial:
+# ---------------------------------------------------------------------------
+# Multinomial AUC (`hex/MultinomialAUC.java:1-319` + `hex/PairwiseAUC.java`)
+#
+# The reference builds per-class / per-pair AUC2 threshold histograms. Here
+# the whole family — every directed ROC-AUC numerator and every average-
+# precision value — comes from ONE jitted pass: per class k, sort prob_k once
+# and carry the (rows, K) per-true-class weight matrix through cumulative
+# sums; tie groups are resolved exactly via searchsorted edges, so the
+# result is the exact rank-statistic AUC (matches sklearn), not a binned
+# approximation.
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("K",))
+def _mauc_kernel(y, probs, w, K):
+    yi = y.astype(jnp.int32)
+    W = jax.nn.one_hot(yi, K, dtype=jnp.float32) * w[:, None]    # (n, K)
+    N = jnp.sum(W, axis=0)                                        # (K,)
+
+    def per_class(k):
+        pk = jax.lax.dynamic_index_in_dim(probs, k, axis=1, keepdims=False)
+        order = jnp.argsort(pk)
+        ps = pk[order]
+        Ws = W[order]                                             # (n, K)
+        cum = jnp.cumsum(Ws, axis=0)                              # inclusive
+        left = jnp.searchsorted(ps, ps, side="left")
+        right = jnp.searchsorted(ps, ps, side="right")
+        # per-class weight strictly below / tied-with each row's value
+        before = jnp.where((left > 0)[:, None],
+                           cum[jnp.maximum(left - 1, 0)], 0.0)
+        tied = cum[right - 1] - before
+        wpos = jax.lax.dynamic_index_in_dim(Ws, k, axis=1, keepdims=False)
+        # directed ROC numerator vs every negative class (ties count 1/2)
+        s_roc = jnp.sum(wpos[:, None] * (before + 0.5 * tied), axis=0)
+        # average precision: descending tie-group-END cumulatives are
+        # N_c - (strictly below) — one row term per distinct threshold group
+        nk = jax.lax.dynamic_index_in_dim(N, k, keepdims=False)
+        tp_end = nk - jax.lax.dynamic_index_in_dim(before, k, axis=1,
+                                                   keepdims=False)
+        fp_end = N[None, :] - before                              # (n, K)
+        contrib = wpos / jnp.maximum(nk, 1e-10)
+        ap_pair = jnp.sum(contrib[:, None] * tp_end[:, None]
+                          / jnp.maximum(tp_end[:, None] + fp_end, 1e-10),
+                          axis=0)
+        fp_ovr = jnp.sum(fp_end, axis=1) - tp_end
+        ap_ovr = jnp.sum(contrib * tp_end
+                         / jnp.maximum(tp_end + fp_ovr, 1e-10))
+        return s_roc, ap_pair, ap_ovr
+
+    s_roc, ap_pair, ap_ovr = jax.lax.map(per_class, jnp.arange(K))
+    return dict(s_roc=s_roc, ap_pair=ap_pair, ap_ovr=ap_ovr, N=N)
+
+
+_AUC_TYPES = ("macro_ovr", "weighted_ovr", "macro_ovo", "weighted_ovo")
+
+
+class MultinomialAUC:
+    """Host aggregation of the kernel stats — all `auc_type` aggregates.
+
+    OVO pairwise AUC is the average of the two directed AUCs
+    (`hex/PairwiseAUC.java` getAuc); WEIGHTED_OVO pair weights are
+    (N_i + N_j) / ((K-1)·N) (`MultinomialAUC.java` computeWeightedOVO).
+    """
+
+    def __init__(self, s_roc, ap_pair, ap_ovr, N, domain=None):
+        K = len(N)
+        self.K = K
+        self.N = N
+        self.domain = (list(domain) if domain is not None
+                       else [str(i) for i in range(K)])
+        ntot = N.sum()
+        nneg = ntot - N
+        with np.errstate(divide="ignore", invalid="ignore"):
+            self.auc_ovr = s_roc.sum(axis=1) - np.diag(s_roc)
+            self.auc_ovr = np.where(N * nneg > 0,
+                                    self.auc_ovr / np.maximum(N * nneg, 1e-30),
+                                    np.nan)
+            denom = N[:, None] * N[None, :]
+            auc_dir = np.where(denom > 0, s_roc / np.maximum(denom, 1e-30),
+                               np.nan)
+        self.auc_pair = 0.5 * (auc_dir + auc_dir.T)       # symmetric OVO
+        self.ap_ovr = ap_ovr
+        self.ap_pair_sym = 0.5 * (ap_pair + ap_pair.T)
+        prev = N / max(ntot, 1e-30)
+        iu = np.triu_indices(K, 1)
+        pair_w = (N[iu[0]] + N[iu[1]]) / max((K - 1) * ntot, 1e-30)
+        self._agg = {}
+        for pr, ovr, pair in ((False, self.auc_ovr, self.auc_pair),
+                              (True, self.ap_ovr, self.ap_pair_sym)):
+            vals = pair[iu]
+            self._agg[("macro_ovr", pr)] = float(np.nanmean(ovr))
+            self._agg[("weighted_ovr", pr)] = float(np.nansum(prev * ovr))
+            self._agg[("macro_ovo", pr)] = float(np.nanmean(vals))
+            self._agg[("weighted_ovo", pr)] = float(np.nansum(pair_w * vals))
+        self._iu = iu
+
+    def get(self, auc_type: str, pr: bool = False) -> float:
+        t = auc_type.lower()
+        if t in ("auto", "none"):
+            return np.nan
+        if t not in _AUC_TYPES:
+            raise ValueError(f"unknown auc_type '{auc_type}' "
+                             f"(one of {_AUC_TYPES})")
+        return self._agg[(t, pr)]
+
+    def table(self, pr: bool = False):
+        """One TwoDimTable with OVR rows, OVO rows and the four aggregates —
+        the `MultinomialAUC.getTable` publication."""
+        from ..utils.twodimtable import TwoDimTable
+
+        ovr = self.ap_ovr if pr else self.auc_ovr
+        pair = self.ap_pair_sym if pr else self.auc_pair
+        rows = []
+        for k in range(self.K):
+            rows.append([f"{self.domain[k]} vs Rest", float(ovr[k])])
+        for i, j in zip(*self._iu):
+            rows.append([f"{self.domain[i]} vs {self.domain[j]}",
+                         float(pair[i, j])])
+        for t in _AUC_TYPES:
+            rows.append([t, self._agg[(t, pr)]])
+        name = "PR AUC" if pr else "AUC"
+        return TwoDimTable(
+            table_header=f"Multinomial {name} values",
+            description="One-vs-Rest, One-vs-One and aggregated "
+                        f"{name} (`hex/MultinomialAUC.java`)",
+            col_header=["auc_kind", name.lower().replace(" ", "_")],
+            col_types=["string", "double"], cell_values=rows)
+
+
+def make_multinomial_auc(y, probs, weights=None, domain=None) -> MultinomialAUC:
+    K = int(probs.shape[1])
+    w = _weights(y, weights)
+    r = jax.device_get(_mauc_kernel(jnp.nan_to_num(y), jnp.nan_to_num(probs),
+                                    w, K))
+    return MultinomialAUC(np.asarray(r["s_roc"], np.float64),
+                          np.asarray(r["ap_pair"], np.float64),
+                          np.asarray(r["ap_ovr"], np.float64),
+                          np.asarray(r["N"], np.float64), domain)
+
+
+def make_multinomial_metrics(y, probs, weights=None, auc_type: str = "AUTO",
+                             domain=None) -> ModelMetricsMultinomial:
     r = jax.device_get(_fused_metric_kernel(
         y, probs, weights if weights is not None else y,
         _multinomial_kernel, weights is not None))
@@ -305,7 +468,7 @@ def make_multinomial_metrics(y, probs, weights=None) -> ModelMetricsMultinomial:
     cm = r["cm"]
     per_class_err = 1.0 - np.diag(cm) / np.maximum(cm.sum(axis=1), 1e-10)
     k = cm.shape[0]
-    return ModelMetricsMultinomial(
+    mm = ModelMetricsMultinomial(
         mse=float(r["mse"]) / max(n, 1e-10),
         rmse=float(np.sqrt(r["mse"] / max(n, 1e-10))),
         nobs=int(n),
@@ -314,6 +477,16 @@ def make_multinomial_metrics(y, probs, weights=None) -> ModelMetricsMultinomial:
         confusion_matrix=cm,
         hit_ratio_table=np.asarray(r["hits"]) / max(n, 1e-10),
     )
+    # default AUTO == NONE: multinomial AUC is opt-in, like the reference
+    # (`ModelMetricsMultinomial` only fills it when _auc_type != AUTO/NONE)
+    at = (auc_type or "AUTO").lower()
+    if at not in ("auto", "none"):
+        mauc = make_multinomial_auc(y, probs, weights, domain)
+        mm._mauc = mauc
+        mm.auc_type = at
+        mm.auc = mauc.get(at, pr=False)
+        mm.pr_auc = mauc.get(at, pr=True)
+    return mm
 
 
 def _weights(y, weights):

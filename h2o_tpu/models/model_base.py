@@ -68,6 +68,10 @@ class Parameters:
     stopping_rounds: int = 0
     stopping_metric: str = "AUTO"
     stopping_tolerance: float = 1e-3
+    auc_type: str = "AUTO"  # multinomial AUC aggregate: AUTO(=NONE)|NONE|
+                            # MACRO_OVR|WEIGHTED_OVR|MACRO_OVO|WEIGHTED_OVO
+                            # (`hex/MultinomialAUC.java`, Model.Parameters
+                            # _auc_type)
     checkpoint: Any = None          # prior model (or its key) to continue from
     export_checkpoints_dir: Optional[str] = None  # in-training snapshots
     custom_metric_func: Any = None  # callable(y, raw_pred, w) -> (name, value)
@@ -170,7 +174,9 @@ class Model(Keyed):
         raw = self.score0(X)
         y = _response_device(fr, self.params.response_column, self.output.response_domain)
         w = fr.vec(self.params.weights_column).data if self.params.weights_column else None
-        return make_metrics(self.output.model_category, y, raw, w)
+        return make_metrics(self.output.model_category, y, raw, w,
+                            auc_type=self.params.auc_type,
+                            domain=self.output.response_domain)
 
     def score_with_metrics(self, fr: Frame) -> tuple[Frame, object]:
         """One scoring pass serving both the predictions frame and the
@@ -183,10 +189,18 @@ class Model(Keyed):
         w = fr.vec(self.params.weights_column).data \
             if self.params.weights_column else None
         return (self._predictions_frame(raw, fr.nrow),
-                make_metrics(self.output.model_category, y, raw, w))
+                make_metrics(self.output.model_category, y, raw, w,
+                             auc_type=self.params.auc_type,
+                             domain=self.output.response_domain))
 
     def auc(self):
-        return getattr(self.output.training_metrics, "auc", None)
+        """None when no AUC is available (regression, or multinomial with
+        auc_type unset) — the pre-multinomial-AUC contract callers test with
+        ``is None``; NaN placeholders never escape."""
+        a = getattr(self.output.training_metrics, "auc", None)
+        if a is None or (isinstance(a, float) and np.isnan(a)):
+            return None
+        return a
 
     # -- tabular views (`water/util/TwoDimTable` publications) ----------------
     def varimp_table(self):
@@ -213,7 +227,9 @@ class Model(Keyed):
                 if k == "training_metrics":
                     for mk in ("logloss", "auc", "rmse", "mse"):
                         mv = getattr(v, mk, None)
-                        if mv is not None:
+                        # skip absent metrics AND NaN placeholders (multinomial
+                        # auc with auc_type unset) — no all-NaN columns
+                        if mv is not None and not np.isnan(mv):
                             cols.setdefault(f"training_{mk}", []).append(float(mv))
                 elif isinstance(v, (int, float, str)):
                     cols.setdefault(k, []).append(v)
@@ -265,11 +281,12 @@ class Model(Keyed):
                 f"{self.output.training_metrics!r}")
 
 
-def make_metrics(category, y, raw, weights=None):
+def make_metrics(category, y, raw, weights=None, auc_type="AUTO", domain=None):
     if category == "Binomial":
         return make_binomial_metrics(y, raw[:, 2], weights)
     if category == "Multinomial":
-        return make_multinomial_metrics(y, raw[:, 1:], weights)
+        return make_multinomial_metrics(y, raw[:, 1:], weights,
+                                        auc_type=auc_type, domain=domain)
     return make_regression_metrics(y, raw, weights)
 
 
@@ -304,6 +321,12 @@ class ModelBuilder:
         p = self.params
         if p.training_frame is None:
             raise ValueError("training_frame is required")
+        at = (getattr(p, "auc_type", "AUTO") or "AUTO").lower()
+        if at not in ("auto", "none", "macro_ovr", "weighted_ovr",
+                      "macro_ovo", "weighted_ovo"):
+            raise ValueError(
+                f"auc_type '{p.auc_type}' must be one of AUTO, NONE, "
+                "MACRO_OVR, WEIGHTED_OVR, MACRO_OVO, WEIGHTED_OVO")
         if self.supervised:
             if not p.response_column:
                 raise ValueError(f"{self.algo_name}: response_column is required")

@@ -132,7 +132,8 @@ class NaiveBayes(ModelBuilder):
             raw = model.score0(fr.as_matrix(names))
             output.training_metrics = make_metrics(
                 category, jnp.where(rowok, y_dev, jnp.nan), raw,
-                None if p.weights_column is None else w)
+                None if p.weights_column is None else w,
+                auc_type=p.auc_type, domain=output.response_domain)
             if p.validation_frame is not None:
                 output.validation_metrics = model.model_performance(p.validation_frame)
         return model

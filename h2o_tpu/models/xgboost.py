@@ -336,7 +336,8 @@ class XGBoost(GBM):
                     s.category, s.ym,
                     _metrics_raw(s.category, s.dist, f0b + S,
                                  False, t + 1),
-                    None if p.weights_column is None else s.w)
+                    None if p.weights_column is None else s.w,
+                    auc_type=p.auc_type)
                 history.append({"timestamp": _t.time(),
                                 "number_of_trees": t + 1,
                                 "training_metrics": m})

@@ -79,3 +79,125 @@ def test_perfect_separation():
     assert m.auc > 0.99
     assert m.ks > 0.99
     assert m.max_f1 > 0.99
+
+
+# ---------------------------------------------------------------------------
+# Multinomial AUC (`hex/MultinomialAUC.java` + `hex/PairwiseAUC.java`)
+# ---------------------------------------------------------------------------
+def _mc_fixture(n=400, K=3, seed=0, quantize=True):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, K, n)
+    probs = rng.dirichlet(np.ones(K), size=n).astype(np.float64)
+    if quantize:  # force ties: the exact tie handling is the hard part
+        probs = np.round(probs, 2)
+    return y, probs
+
+
+def test_multinomial_auc_matches_sklearn_ovr():
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    from h2o_tpu.models.metrics import make_multinomial_auc
+
+    y, probs = _mc_fixture()
+    K = probs.shape[1]
+    m = make_multinomial_auc(jnp.asarray(y, jnp.float32),
+                             jnp.asarray(probs, jnp.float32))
+    per_class = [roc_auc_score(y == k, probs[:, k]) for k in range(K)]
+    prev = [np.mean(y == k) for k in range(K)]
+    assert abs(m.get("macro_ovr") - np.mean(per_class)) < 1e-6
+    assert abs(m.get("weighted_ovr") - np.average(per_class, weights=prev)) < 1e-6
+    per_ap = [average_precision_score(y == k, probs[:, k]) for k in range(K)]
+    assert abs(m.get("macro_ovr", pr=True) - np.mean(per_ap)) < 1e-6
+
+
+def test_multinomial_auc_ovo_pairwise():
+    """OVO pairwise AUC = average of the two directed AUCs
+    (`hex/PairwiseAUC.java` getAuc)."""
+    from sklearn.metrics import roc_auc_score
+
+    from h2o_tpu.models.metrics import make_multinomial_auc
+
+    y, probs = _mc_fixture(K=4, seed=3)
+    K = probs.shape[1]
+    m = make_multinomial_auc(jnp.asarray(y, jnp.float32),
+                             jnp.asarray(probs, jnp.float32))
+    vals, weights = [], []
+    N = np.array([np.sum(y == k) for k in range(K)], float)
+    for i in range(K):
+        for j in range(i + 1, K):
+            mask = (y == i) | (y == j)
+            a = roc_auc_score((y == i)[mask], probs[mask, i])
+            b = roc_auc_score((y == j)[mask], probs[mask, j])
+            assert abs(m.auc_pair[i, j] - 0.5 * (a + b)) < 1e-6
+            vals.append(0.5 * (a + b))
+            weights.append(N[i] + N[j])
+    assert abs(m.get("macro_ovo") - np.mean(vals)) < 1e-6
+    # WEIGHTED_OVO pair weight = (N_i+N_j)/((K-1)·N) (MultinomialAUC.java)
+    w = np.asarray(weights) / ((K - 1) * N.sum())
+    assert abs(m.get("weighted_ovo") - np.sum(w * vals)) < 1e-6
+
+
+def test_multinomial_auc_weighted_rows():
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    from h2o_tpu.models.metrics import make_multinomial_auc
+
+    y, probs = _mc_fixture(seed=7)
+    K = probs.shape[1]
+    rng = np.random.default_rng(1)
+    w = rng.random(len(y)).astype(np.float32)
+    m = make_multinomial_auc(jnp.asarray(y, jnp.float32),
+                             jnp.asarray(probs, jnp.float32), jnp.asarray(w))
+    per = [roc_auc_score(y == k, probs[:, k], sample_weight=w)
+           for k in range(K)]
+    assert abs(m.get("macro_ovr") - np.mean(per)) < 1e-6
+    per_ap = [average_precision_score(y == k, probs[:, k], sample_weight=w)
+              for k in range(K)]
+    assert abs(m.get("macro_ovr", pr=True) - np.mean(per_ap)) < 1e-6
+
+
+def test_multinomial_metrics_auc_type():
+    """auc_type=AUTO computes nothing (opt-in, like the reference); an
+    explicit aggregate fills auc/pr_auc, the tables and the repr."""
+    from h2o_tpu.models.metrics import make_multinomial_metrics
+
+    y, probs = _mc_fixture(seed=5)
+    yd = jnp.asarray(y, jnp.float32)
+    pd = jnp.asarray(probs, jnp.float32)
+    m0 = make_multinomial_metrics(yd, pd)
+    assert np.isnan(m0.auc) and m0.multinomial_auc_table is None
+    m = make_multinomial_metrics(yd, pd, auc_type="MACRO_OVR",
+                                 domain=["a", "b", "c"])
+    assert not np.isnan(m.auc)
+    assert abs(m.auc - m._mauc.get("macro_ovr")) < 1e-12
+    assert abs(m.pr_auc - m._mauc.get("macro_ovr", pr=True)) < 1e-12
+    assert abs(m.auc_by_type("weighted_ovo")
+               - m._mauc.get("weighted_ovo")) < 1e-12
+    rows = {r[0]: r[1] for r in m.multinomial_auc_table.cell_values}
+    assert "a vs Rest" in rows and "a vs b" in rows
+    assert abs(rows["macro_ovr"] - m.auc) < 1e-12
+    assert "AUC" in repr(m)
+
+
+def test_multinomial_auc_via_model():
+    """A multiclass GLM with auc_type set reports AUC in its training
+    metrics, usable as stopping/leaderboard metric."""
+    from h2o_tpu.frame.frame import Frame
+    from h2o_tpu.frame.vec import T_CAT, Vec
+    from h2o_tpu.models.glm import GLM, GLMParameters
+
+    rng = np.random.default_rng(0)
+    n = 600
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    logits = np.stack([x1, x2, -x1 - x2], 1)
+    y = np.argmax(logits + rng.gumbel(size=(n, 3)), axis=1)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y.astype(np.float32), type=T_CAT,
+                               domain=["r", "g", "b"]))
+    p = GLMParameters(training_frame=fr, response_column="y",
+                      family="multinomial", auc_type="MACRO_OVR", seed=1)
+    model = GLM(p).train_model()
+    mm = model.output.training_metrics
+    assert not np.isnan(mm.auc) and 0.5 < mm.auc <= 1.0
+    assert mm.multinomial_auc_table is not None
