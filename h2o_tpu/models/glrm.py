@@ -12,16 +12,28 @@ updates (the reference's cyclic coordinate descent per row becomes a blocked
 gradient step, which converges to the same stationary points for the convex
 losses supported here).
 
-Supported: loss Quadratic | Absolute | Huber (numeric), Categorical one-hot
-quadratic; regularizers None | Quadratic | L1 | NonNegative for X and Y;
-init Random | SVD | PlusPlus (k-means++ on rows, the reference default).
+Loss algebra (`hex/genmodel/.../glrm/GlrmLoss.java:64-130`): numeric cells
+take Quadratic | Absolute | Huber | Poisson | Logistic | Hinge | Periodic
+(per-column overrides via ``loss_by_col``); categorical blocks take the
+multidimensional Categorical (one-vs-all hinge over the one-hot expansion)
+or Ordinal (cumulative-threshold hinge) loss. Every loss is expressed as one
+per-cell (u, t) function selected by a per-column mask, so a mixed-type frame
+still runs as a single fused elementwise+matmul program.
+
+Regularizers (`GlrmRegularizer.java:15-17,116`): None | Quadratic | L1 |
+NonNegative | OneSparse | UnitOneSparse | Simplex. The structural three are
+exact Euclidean projections (argmax keep / one-hot / sorted simplex
+projection), applied per X row and per Y column — which makes the classic
+recipes work: NNMF = NonNegative/NonNegative, k-means = Quadratic loss +
+UnitOneSparse X (X rows become cluster assignments, Y the centroids),
+archetypal soft clustering = Simplex X.
+
 Missing cells contribute zero loss (that IS GLRM's matrix-completion story).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -37,8 +49,13 @@ from .model_base import Model, ModelBuilder, ModelOutput, Parameters
 @dataclass
 class GLRMParameters(Parameters):
     k: int = 1
-    loss: str = "Quadratic"            # Quadratic | Absolute | Huber
+    loss: str = "Quadratic"            # numeric: Quadratic | Absolute | Huber
+                                       # | Poisson | Logistic | Hinge | Periodic
+    multi_loss: str = "Categorical"    # categorical blocks: Categorical | Ordinal
+    loss_by_col: dict = None           # {column name: loss kind} overrides
+    period: float = 1.0                # Periodic loss period
     regularization_x: str = "None"     # None | Quadratic | L1 | NonNegative
+                                       # | OneSparse | UnitOneSparse | Simplex
     regularization_y: str = "None"
     gamma_x: float = 0.0
     gamma_y: float = 0.0
@@ -50,17 +67,74 @@ class GLRMParameters(Parameters):
     recover_svd: bool = False
 
 
-def _loss_grad(kind: str):
-    if kind.lower() == "absolute":
-        return (lambda r: jnp.abs(r)), (lambda r: jnp.sign(r))
-    if kind.lower() == "huber":
-        return (lambda r: jnp.where(jnp.abs(r) <= 1, 0.5 * r * r,
-                                    jnp.abs(r) - 0.5),
-                lambda r: jnp.clip(r, -1.0, 1.0))
-    return (lambda r: 0.5 * r * r), (lambda r: r)
+# ---------------------------------------------------------------------------
+# per-cell losses — (u, t) -> value/grad, where t is the (transformed) target
+# (`GlrmLoss.java` loss/lgrad + mloss/mgrad flattened onto expanded columns:
+# Categorical's one-vs-all hinge uses t = one-hot cell; Ordinal's threshold
+# hinge uses t = [level > j] with the block's last column masked out)
+# ---------------------------------------------------------------------------
+_PM = lambda t: 2.0 * t - 1.0          # {0,1} targets -> ±1
 
 
-def _prox(kind: str, gamma: float):
+def _cell_losses(period: float):
+    f = 2.0 * np.pi / max(period, 1e-10)
+    return {
+        "quadratic": ((lambda u, t: 0.5 * (u - t) ** 2),
+                      (lambda u, t: u - t)),
+        "absolute": ((lambda u, t: jnp.abs(u - t)),
+                     (lambda u, t: jnp.sign(u - t))),
+        "huber": ((lambda u, t: jnp.where(jnp.abs(u - t) <= 1,
+                                          0.5 * (u - t) ** 2,
+                                          jnp.abs(u - t) - 0.5)),
+                  (lambda u, t: jnp.clip(u - t, -1.0, 1.0))),
+        "poisson": ((lambda u, t: jnp.exp(jnp.clip(u, -30, 30)) - t * u
+                     + jnp.where(t > 0, t * jnp.log(jnp.maximum(t, 1e-30)), 0.0)
+                     - t),
+                    (lambda u, t: jnp.exp(jnp.clip(u, -30, 30)) - t)),
+        "logistic": ((lambda u, t: jnp.logaddexp(0.0, -_PM(t) * u)),
+                     (lambda u, t: -_PM(t) * jax.nn.sigmoid(-_PM(t) * u))),
+        "hinge": ((lambda u, t: jnp.maximum(1.0 - _PM(t) * u, 0.0)),
+                  (lambda u, t: jnp.where(_PM(t) * u < 1.0, -_PM(t), 0.0))),
+        "periodic": ((lambda u, t: 1.0 - jnp.cos((t - u) * f)),
+                     (lambda u, t: -f * jnp.sin((t - u) * f))),
+    }
+
+
+_NUMERIC_LOSSES = ("quadratic", "absolute", "huber", "poisson", "logistic",
+                   "hinge", "periodic")
+
+
+# ---------------------------------------------------------------------------
+# regularizers (`GlrmRegularizer.java`) — prox/projection along `axis`
+# (X rows: axis=1 over the k components; Y columns: axis=0)
+# ---------------------------------------------------------------------------
+def _simplex_project(V, axis):
+    """Euclidean projection of each slice onto the probability simplex
+    (sort-based; Duchi et al. algorithm, fully vectorized)."""
+    U = jnp.sort(V, axis=axis)[::-1] if axis == 0 else \
+        jnp.sort(V, axis=axis)[:, ::-1]
+    k = V.shape[axis]
+    ar = jnp.arange(1, k + 1, dtype=V.dtype)
+    ar = ar[:, None] if axis == 0 else ar[None, :]
+    css = (jnp.cumsum(U, axis=axis) - 1.0) / ar
+    ok = (U - css) > 0
+    rho = jnp.sum(ok.astype(jnp.int32), axis=axis, keepdims=True)
+    tau = jnp.take_along_axis(css, jnp.maximum(rho - 1, 0), axis=axis)
+    return jnp.maximum(V - tau, 0.0)
+
+
+def _argmax_keep(V, axis, unit: bool):
+    """OneSparse / UnitOneSparse projection: keep only the largest component
+    per slice (set to 1 for the unit variant, clip at 0 for the plain one)."""
+    idx = jnp.argmax(V, axis=axis, keepdims=True)
+    onehot = jnp.put_along_axis(jnp.zeros_like(V), idx, 1.0, axis=axis,
+                                inplace=False)
+    if unit:
+        return onehot
+    return onehot * jnp.maximum(V, 0.0)
+
+
+def _prox(kind: str, gamma: float, axis: int):
     k = kind.lower()
     if k == "quadratic":
         return lambda M, step: M / (1.0 + 2.0 * gamma * step)
@@ -69,7 +143,15 @@ def _prox(kind: str, gamma: float):
             jnp.abs(M) - gamma * step, 0.0)
     if k == "nonnegative":
         return lambda M, step: jnp.maximum(M, 0.0)
-    return lambda M, step: M
+    if k == "onesparse":
+        return lambda M, step: _argmax_keep(M, axis, unit=False)
+    if k == "unitonesparse":
+        return lambda M, step: _argmax_keep(M, axis, unit=True)
+    if k == "simplex":
+        return lambda M, step: _simplex_project(M, axis)
+    if k == "none":
+        return lambda M, step: M
+    raise ValueError(f"unknown GLRM regularizer '{kind}'")
 
 
 def _reg_value(kind: str, gamma: float, M):
@@ -78,7 +160,7 @@ def _reg_value(kind: str, gamma: float, M):
         return gamma * jnp.sum(M * M)
     if k == "l1":
         return gamma * jnp.sum(jnp.abs(M))
-    return 0.0
+    return 0.0   # indicators are 0 on their feasible set (prox keeps us there)
 
 
 def _missing_mask(dinfo: DataInfo, fr: Frame, plen: int):
@@ -91,6 +173,62 @@ def _missing_mask(dinfo: DataInfo, fr: Frame, plen: int):
     M = jnp.concatenate(mask_cols, axis=1).astype(jnp.float32)
     inrange = (jnp.arange(plen) < fr.nrow).astype(jnp.float32)
     return M * inrange[:, None]
+
+
+def _loss_plan(p: GLRMParameters, dinfo: DataInfo, A, M):
+    """Resolve the per-expanded-column loss layout.
+
+    Returns (T, lossM, col_ids, kinds): T the per-cell target matrix (numeric
+    value / one-hot / ordinal threshold indicator), lossM the loss mask
+    (missing mask with Ordinal blocks' last threshold column removed),
+    col_ids the per-column index into `kinds` (the distinct loss kinds used).
+    """
+    by_col = {k.lower(): v.lower() for k, v in (p.loss_by_col or {}).items()}
+    unknown = set(by_col) - {n.lower() for n in dinfo.names}
+    if unknown:
+        raise ValueError(f"loss_by_col names not in the frame: {sorted(unknown)}")
+    base = p.loss.lower()
+    multi = p.multi_loss.lower()
+    if base not in _NUMERIC_LOSSES:
+        raise ValueError(f"unknown GLRM loss '{p.loss}'")
+    if multi not in ("categorical", "ordinal"):
+        raise ValueError(f"unknown GLRM multi_loss '{p.multi_loss}'")
+
+    kinds: list[str] = []
+
+    def kid(kind):
+        if kind not in _NUMERIC_LOSSES:
+            raise ValueError(f"unknown GLRM loss '{kind}'")
+        if kind not in kinds:
+            kinds.append(kind)
+        return kinds.index(kind)
+
+    col_ids = np.zeros(A.shape[1], np.int32)
+    T = A
+    lossM = M
+    j = 0
+    for name in dinfo.names:
+        if name in dinfo.domains:          # categorical block (one-hot cols)
+            d = len(dinfo.domains[name])
+            kind = by_col.get(name.lower(), multi)
+            if kind == "ordinal":
+                # t_j = [level > j]: reverse-exclusive cumsum of the one-hot;
+                # last threshold column carries no information -> masked out
+                block = A[:, j:j + d]
+                cums = jnp.cumsum(block, axis=1)
+                T = T.at[:, j:j + d].set(1.0 - cums)
+                lossM = lossM.at[:, j + d - 1].set(0.0)
+                col_ids[j:j + d] = kid("hinge")
+            elif kind == "categorical":
+                col_ids[j:j + d] = kid("hinge")   # one-vs-all hinge on the
+                                                  # one-hot targets
+            else:                                 # numeric loss on the one-hot
+                col_ids[j:j + d] = kid(kind)
+            j += d
+        else:
+            col_ids[j] = kid(by_col.get(name.lower(), base))
+            j += 1
+    return T, lossM, col_ids, kinds
 
 
 class GLRMModel(Model):
@@ -171,15 +309,34 @@ class GLRM(ModelBuilder):
         else:
             Y0 = jax.random.normal(key, (k, m)) * 0.1
         X0 = jax.random.normal(jax.random.fold_in(key, 1), (n, k)) * 0.1
+        if p.regularization_x.lower() in ("onesparse", "unitonesparse",
+                                          "simplex"):
+            X0 = _prox(p.regularization_x, p.gamma_x, axis=1)(jnp.abs(X0), 0.0)
 
-        lossf, lossg = _loss_grad(p.loss)
-        prox_x = _prox(p.regularization_x, p.gamma_x)
-        prox_y = _prox(p.regularization_y, p.gamma_y)
+        T, lossM, col_ids, kinds = _loss_plan(p, dinfo, A, M)
+        cell = _cell_losses(p.period)
+        kind_masks = [jnp.asarray((col_ids == i).astype(np.float32))
+                      for i in range(len(kinds))]
+
+        def loss_value(U):
+            out = 0.0
+            for i, kd in enumerate(kinds):
+                out = out + jnp.sum(lossM * kind_masks[i][None, :]
+                                    * cell[kd][0](U, T))
+            return out
+
+        def loss_grad(U):
+            out = jnp.zeros_like(U)
+            for i, kd in enumerate(kinds):
+                out = out + lossM * kind_masks[i][None, :] * cell[kd][1](U, T)
+            return out
+
+        prox_x = _prox(p.regularization_x, p.gamma_x, axis=1)
+        prox_y = _prox(p.regularization_y, p.gamma_y, axis=0)
 
         @jax.jit
         def objective(X, Y):
-            R = (X @ Y - A) * M
-            return (jnp.sum(lossf(R))
+            return (loss_value(X @ Y)
                     + _reg_value(p.regularization_x, p.gamma_x, X)
                     + _reg_value(p.regularization_y, p.gamma_y, Y))
 
@@ -187,9 +344,9 @@ class GLRM(ModelBuilder):
         def train(X, Y, alpha0):
             def step(carry, _):
                 X, Y, alpha, obj = carry
-                G = lossg((X @ Y - A) * M)
+                G = loss_grad(X @ Y)
                 Xn = prox_x(X - alpha * (G @ Y.T), alpha)
-                Gy = lossg((Xn @ Y - A) * M)
+                Gy = loss_grad(Xn @ Y)
                 Yn = prox_y(Y - alpha * (Xn.T @ Gy), alpha)
                 newobj = objective(Xn, Yn)
                 ok = newobj < obj

@@ -337,7 +337,7 @@ class XGBoost(GBM):
                     _metrics_raw(s.category, s.dist, f0b + S,
                                  False, t + 1),
                     None if p.weights_column is None else s.w,
-                    auc_type=p.auc_type)
+                    auc_type=p.auc_type, domain=output.response_domain)
                 history.append({"timestamp": _t.time(),
                                 "number_of_trees": t + 1,
                                 "training_metrics": m})

@@ -182,3 +182,158 @@ def test_word2vec_sgns_pmi_bridge():
             tb = [t for t, ws in topics.items() if b in ws][0]
             (within if ta == tb else cross).append(sim[idx[a], idx[b]])
     assert np.mean(within) > np.mean(cross) + 0.3
+
+
+# ---------------------------------------------------------------------------
+# Loss/regularizer algebra (`GlrmLoss.java:64-130`, `GlrmRegularizer.java`)
+# ---------------------------------------------------------------------------
+def test_glrm_kmeans_recipe():
+    """Quadratic loss + UnitOneSparse X = k-means (`GlrmRegularizer.java:15-17`
+    recipe): X rows are one-hot assignments, Y the centroids; the objective
+    should land near sklearn KMeans inertia on separated blobs."""
+    from sklearn.cluster import KMeans
+
+    rng = np.random.default_rng(5)
+    centers = np.array([[0, 0, 0], [8, 8, 0], [0, 8, 8]], np.float32)
+    A = np.concatenate([c + rng.normal(scale=0.5, size=(60, 3))
+                        for c in centers]).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(3)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=3, max_iterations=300,
+                            regularization_x="UnitOneSparse",
+                            init="PlusPlus", seed=6)).train_model()
+    X = np.asarray(m.X)[: fr.nrow]
+    # every row is a unit one-hot assignment
+    assert np.all(np.isin(X, [0.0, 1.0])) and np.all(X.sum(axis=1) == 1.0)
+    inertia = KMeans(n_clusters=3, n_init=5, random_state=0).fit(A).inertia_
+    obj = m.output.training_metrics.objective * 2  # quadratic = 0.5 r^2
+    assert obj < inertia * 1.15, (obj, inertia)
+    # archetypes recover the centers (in some order)
+    arch = m.archetypes()
+    d = np.linalg.norm(arch[:, None, :] - centers[None], axis=2)
+    assert d.min(axis=1).max() < 1.0
+
+
+def test_glrm_nnmf_recipe_simplex():
+    """Simplex-regularized X: rows are convex combinations of archetypes."""
+    rng = np.random.default_rng(7)
+    W = rng.dirichlet(np.ones(3), size=120).astype(np.float32)
+    H = np.abs(rng.normal(size=(3, 6))).astype(np.float32)
+    A = (W @ H).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(6)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=3, max_iterations=400,
+                            regularization_x="Simplex",
+                            init="PlusPlus", seed=8)).train_model()
+    X = np.asarray(m.X)[: fr.nrow]
+    assert np.all(X >= -1e-6)
+    assert np.allclose(X.sum(axis=1), 1.0, atol=1e-4)
+    rec = m.predict(fr)
+    # note: predict re-projects unconstrained; check the TRAINING recon
+    R = X @ np.asarray(m.Y)
+    rel = np.linalg.norm(R - A) / np.linalg.norm(A)
+    assert rel < 0.15, rel
+
+
+def test_glrm_onesparse_projection():
+    rng = np.random.default_rng(9)
+    A = rng.normal(size=(80, 5)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(5)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=3, max_iterations=100,
+                            regularization_x="OneSparse",
+                            init="Random", seed=10)).train_model()
+    X = np.asarray(m.X)[: fr.nrow]
+    assert np.all((X > 0).sum(axis=1) <= 1)     # at most one positive entry
+    assert np.all(X >= 0)
+
+
+def test_glrm_poisson_loss():
+    """Poisson loss on counts: gradient exp(u)-a drives exp(XY) toward A."""
+    rng = np.random.default_rng(11)
+    U = rng.normal(scale=0.5, size=(150, 2))
+    V = rng.normal(scale=0.5, size=(2, 5))
+    lam = np.exp(U @ V)
+    A = rng.poisson(lam).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(5)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=2, loss="Poisson",
+                            max_iterations=400, init="Random",
+                            seed=12)).train_model()
+    R = np.exp(np.asarray(m.X)[: fr.nrow] @ np.asarray(m.Y))
+    # recovered rates correlate strongly with the true rates
+    corr = np.corrcoef(R.ravel(), lam.ravel())[0, 1]
+    assert corr > 0.7, corr
+
+
+def test_glrm_logistic_hinge_losses():
+    """Binary matrix: logistic and hinge losses should reconstruct the signs."""
+    rng = np.random.default_rng(13)
+    U = rng.normal(size=(120, 2))
+    V = rng.normal(size=(2, 6))
+    B = ((U @ V) > 0).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": B[:, i] for i in range(6)})
+    for loss in ("Logistic", "Hinge"):
+        m = GLRM(GLRMParameters(training_frame=fr, k=2, loss=loss,
+                                max_iterations=300, init="Random",
+                                seed=14)).train_model()
+        U_ = np.asarray(m.X)[: fr.nrow] @ np.asarray(m.Y)
+        acc = np.mean((U_ > 0) == (B > 0.5))
+        assert acc > 0.85, (loss, acc)
+
+
+def test_glrm_periodic_loss():
+    """Periodic loss: values a full period apart are equivalent."""
+    rng = np.random.default_rng(15)
+    base = (rng.normal(scale=0.3, size=(100, 2))
+            @ rng.normal(scale=0.3, size=(2, 4))).astype(np.float32)
+    A = base + rng.integers(-2, 3, size=base.shape)  # shift by whole periods
+    fr = Frame.from_dict({f"c{i}": A[:, i].astype(np.float32)
+                          for i in range(4)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=2, loss="Periodic",
+                            period=1.0, max_iterations=300, init="Random",
+                            seed=16)).train_model()
+    U_ = np.asarray(m.X)[: fr.nrow] @ np.asarray(m.Y)
+    # reconstruction error modulo the period is small for most cells
+    err = np.abs(((U_ - A) + 0.5) % 1.0 - 0.5)
+    assert np.median(err) < 0.25, np.median(err)
+
+
+def test_glrm_ordinal_multiloss():
+    """Ordinal multi-loss on an ordered categorical: threshold structure
+    (`GlrmLoss.java` Ordinal mloss) — decoded level = #(u_j > 0) among the
+    d-1 thresholds; must beat random on a rank-1 ordinal pattern."""
+    rng = np.random.default_rng(17)
+    n = 200
+    score = rng.normal(size=n)
+    levels = np.digitize(score, [-0.8, 0.0, 0.8]).astype(np.float32)  # 0..3
+    noise = rng.normal(scale=0.3, size=n)
+    fr = Frame.from_dict({"x": (score + noise).astype(np.float32)})
+    fr.add("o", Vec.from_numpy(levels, type=T_CAT,
+                               domain=["lo", "mid", "hi", "top"]))
+    m = GLRM(GLRMParameters(training_frame=fr, k=2, multi_loss="Ordinal",
+                            max_iterations=300, init="Random",
+                            seed=18)).train_model()
+    U_ = np.asarray(m.X)[: fr.nrow] @ np.asarray(m.Y)
+    # ordinal block occupies the expanded columns of "o" (4 levels)
+    j0 = m.dinfo.expanded_names.index("o.lo")
+    decoded = (U_[:, j0:j0 + 3] > 0).sum(axis=1)
+    acc = np.mean(decoded == levels)
+    assert acc > 0.5, acc   # 4 classes, random = 0.25
+
+
+def test_glrm_loss_by_col():
+    rng = np.random.default_rng(19)
+    A = rng.normal(size=(80, 3)).astype(np.float32)
+    fr = Frame.from_dict({f"c{i}": A[:, i] for i in range(3)})
+    m = GLRM(GLRMParameters(training_frame=fr, k=2,
+                            loss="Quadratic", loss_by_col={"c1": "Absolute"},
+                            max_iterations=50, init="Random",
+                            seed=20)).train_model()
+    assert m.output.training_metrics.objective > 0  # ran mixed-loss program
+
+
+def test_glrm_bad_loss_rejected():
+    fr = Frame.from_dict({"a": np.arange(4, dtype=np.float32)})
+    with pytest.raises(ValueError):
+        GLRM(GLRMParameters(training_frame=fr, k=1,
+                            loss="NotALoss")).train_model()
+    with pytest.raises(ValueError):
+        GLRM(GLRMParameters(training_frame=fr, k=1,
+                            regularization_x="Weird")).train_model()

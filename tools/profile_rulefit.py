@@ -1,0 +1,99 @@
+"""Phase profiler for the RuleFit benchmark workload (VERDICT r4 weak #1).
+
+Times tree generation / rule extraction / streaming L1 GLM (with step-call
+count) / support pass / scoring separately at bench shape, warm and cold.
+Run on the real chip:  python tools/profile_rulefit.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+NROW = int(os.environ.get("H2O_TPU_BENCH_ROWS", 11_000_000))
+
+import bench  # noqa: E402
+
+bench._enable_compile_cache()
+
+from h2o_tpu.models import rulefit as rf  # noqa: E402
+
+PHASES = {}
+
+
+def timed(name, fn):
+    def wrap(*a, **k):
+        t0 = time.time()
+        out = fn(*a, **k)
+        PHASES[name] = PHASES.get(name, 0.0) + (time.time() - t0)
+        PHASES.setdefault(name + "_n", 0)
+        PHASES[name + "_n"] += 1
+        return out
+    return wrap
+
+
+# patch tree builders
+_orig_drf_build = rf.DRF.build_impl
+rf.DRF.build_impl = timed("trees", _orig_drf_build)
+_orig_gbm_build = rf.GBM.build_impl
+rf.GBM.build_impl = timed("trees", _orig_gbm_build)
+rf.extract_rules = timed("extract", rf.extract_rules)
+rf.RuleFit._fit_streaming = timed("l1_glm", rf.RuleFit._fit_streaming)
+rf._stream_rule_support = timed("support", rf._stream_rule_support)
+
+_orig_step = rf._stream_step
+
+
+def patched_stream_step(family, rb):
+    raw = _orig_step(family, rb)
+
+    def step(*a, **k):
+        import jax
+        t0 = time.time()
+        out = raw(*a, **k)
+        jax.block_until_ready(out)
+        PHASES["step"] = PHASES.get("step", 0.0) + (time.time() - t0)
+        PHASES["step_n"] = PHASES.get("step_n", 0) + 1
+        return out
+    return step
+
+
+rf._stream_step = patched_stream_step
+
+_orig_score0 = rf.RuleFitModel.score0
+rf.RuleFitModel.score0 = timed("score0", _orig_score0)
+
+
+def run():
+    global PHASES
+    p = rf.RuleFitParameters(training_frame=fr, response_column="response",
+                             model_type="rules_and_linear",
+                             min_rule_length=3, max_rule_length=3, seed=42)
+    PHASES = {}
+    t0 = time.time()
+    m = rf.RuleFit(p).train_model()
+    total = time.time() - t0
+    acct = sum(v for k, v in PHASES.items() if not k.endswith("_n")
+               and k != "step")
+    print({"total_s": round(total, 2),
+           "unaccounted_s": round(total - acct, 2),
+           **{k: (round(v, 2) if isinstance(v, float) else v)
+              for k, v in sorted(PHASES.items())}}, flush=True)
+    n_rules = len(m.rules)
+    print({"n_rules": n_rules, "P1": n_rules + len(m.lin_names) + 1},
+          flush=True)
+
+
+print(f"building frame nrow={NROW}", flush=True)
+fr = bench._higgs_frame(NROW)
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.block_until_ready([jnp.sum(v.data) for v in fr.vecs
+                       if v.data is not None])
+print("cold run:", flush=True)
+run()
+print("warm run:", flush=True)
+run()
