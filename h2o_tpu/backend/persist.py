@@ -86,6 +86,9 @@ def _to_host(obj):
         return np.asarray(obj)
     if isinstance(obj, dict):
         return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        # NamedTuple (optax optimizer states): construct positionally
+        return type(obj)(*(_to_host(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         t = type(obj)
         return t(_to_host(v) for v in obj)
@@ -167,7 +170,9 @@ _SAFE_BUILTINS = frozenset({
 class _ModelUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
         root = module.split(".", 1)[0]
-        if root in ("h2o_tpu", "numpy", "collections", "datetime"):
+        # optax: optimizer-state NamedTuples ride DL checkpoints (ADADELTA
+        # accumulators) — plain containers, no reduce-time code execution
+        if root in ("h2o_tpu", "numpy", "collections", "datetime", "optax"):
             return super().find_class(module, name)
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)

@@ -135,10 +135,15 @@ def _loss_fn(kind, out, y, w):
 class DeepLearningModel(Model):
     algo_name = "deeplearning"
 
-    def __init__(self, params, output, net, dinfo, loss_kind, key=None):
+    def __init__(self, params, output, net, dinfo, loss_kind, key=None,
+                 opt_state=None, epochs_trained=0.0):
         self.net = net
         self.dinfo = dinfo
         self.loss_kind = loss_kind
+        self.opt_state = opt_state        # optimizer slots (ADADELTA
+                                          # accumulators ride checkpoints like
+                                          # DeepLearningModelInfo's adaDelta)
+        self.epochs_trained = epochs_trained
         super().__init__(params, output, key=key)
 
     def adapt_frame(self, fr: Frame):
@@ -209,12 +214,54 @@ class DeepLearning(ModelBuilder):
             self.supervised = False
         super()._validate()
 
+    #: parameters a checkpoint continuation may NOT change — the reference
+    #: validates these via the non-modifiable list in
+    #: `hex/deeplearning/DeepLearning.java:261-348`
+    _CP_FROZEN = ("hidden", "activation", "autoencoder", "standardize",
+                  "use_all_factor_levels", "adaptive_rate", "loss",
+                  "distribution", "response_column")
+
+    def _resolve_checkpoint(self, cp) -> DeepLearningModel:
+        from ..backend.kvstore import STORE
+
+        prior = STORE.get(cp) if isinstance(cp, str) else cp
+        if prior is None:
+            raise ValueError(f"checkpoint model '{cp}' not found")
+        if not isinstance(prior, DeepLearningModel):
+            raise ValueError("checkpoint must be a DeepLearning model")
+        pp = prior.params
+        for name in self._CP_FROZEN:
+            if getattr(pp, name) != getattr(self.params, name):
+                raise ValueError(
+                    f"checkpoint continuation cannot change '{name}' "
+                    f"({getattr(pp, name)!r} -> {getattr(self.params, name)!r})")
+        if self.params.epochs <= prior.epochs_trained:
+            raise ValueError(
+                f"epochs must exceed the checkpoint's trained epochs "
+                f"({prior.epochs_trained}) to continue training")
+        return prior
+
     def build_impl(self, job: Job) -> DeepLearningModel:
         p: DeepLearningParameters = self.params
         fr = p.training_frame
-        names = self.feature_names()
-        dinfo = DataInfo.make(fr, names, standardize=p.standardize,
-                              use_all_factor_levels=p.use_all_factor_levels)
+        prior = (self._resolve_checkpoint(p.checkpoint)
+                 if p.checkpoint is not None else None)
+        if prior is not None:
+            # keep the key, not the model object, on the stored params
+            # (binary export must not drag the prior model along)
+            import dataclasses
+
+            p = self.params = dataclasses.replace(p, checkpoint=prior.key)
+            # the prior's DataInfo carries the standardization moments and
+            # expanded domains — reusing it keeps the restored weights' input
+            # space identical (`DeepLearning.java` trainModel(cp) reuses the
+            # checkpoint's model_info)
+            names = list(prior.output.names)
+            dinfo = prior.dinfo
+        else:
+            names = self.feature_names()
+            dinfo = DataInfo.make(fr, names, standardize=p.standardize,
+                                  use_all_factor_levels=p.use_all_factor_levels)
         X, okrow = dinfo.expand(fr)
         nrow = fr.nrow
         rowmask = (jnp.arange(X.shape[0]) < nrow) & okrow
@@ -240,15 +287,21 @@ class DeepLearning(ModelBuilder):
         seed = p.seed if p.seed not in (-1, None) else 1234
         key = jax.random.PRNGKey(seed)
         maxout = p.activation.lower().startswith("maxout")
-        net = _init_params(key, sizes, p.initial_weight_distribution,
-                           p.initial_weight_scale, maxout)
+        if prior is not None:
+            net = jax.tree.map(jnp.asarray, prior.net)
+        else:
+            net = _init_params(key, sizes, p.initial_weight_distribution,
+                               p.initial_weight_scale, maxout)
 
         import optax
         if p.adaptive_rate:
             opt = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
         else:
             opt = optax.sgd(p.rate, momentum=p.momentum_stable or None)
-        opt_state = opt.init(net)
+        if prior is not None and prior.opt_state is not None:
+            opt_state = prior.opt_state   # resume the ADADELTA accumulators
+        else:
+            opt_state = opt.init(net)
 
         batch = max(int(p.mini_batch_size), 32)
         plen = X.shape[0]
@@ -276,20 +329,28 @@ class DeepLearning(ModelBuilder):
             return jax.tree.map(lambda a, b: a + b, net, upd), opt_state
 
         steps_per_epoch = max(plen // batch, 1)
-        total_steps = max(int(p.epochs * steps_per_epoch), 1)
-        perm_key = jax.random.fold_in(key, 1)
+        prior_epochs = prior.epochs_trained if prior is not None else 0.0
+        total_steps = max(int((p.epochs - prior_epochs) * steps_per_epoch), 1)
+        # checkpoint continuations CONTINUE the RNG stream (shuffles and
+        # dropout keys are indexed by the GLOBAL step/epoch, so the resumed
+        # run never replays the minibatch sequence the prior run consumed —
+        # the reference resumes from the checkpointed iteration count)
+        step_offset = int(round(prior_epochs * steps_per_epoch))
+        perm_base = jax.random.fold_in(key, 1)
         for s in range(total_steps):
+            gs = step_offset + s
             if s % steps_per_epoch == 0:
                 job.check_cancelled()
-                perm_key, pk = jax.random.split(perm_key)
-                perm = jax.random.permutation(pk, plen)
+                perm = jax.random.permutation(
+                    jax.random.fold_in(perm_base, gs // steps_per_epoch),
+                    plen)
             lo = (s % steps_per_epoch) * batch
             idx = jax.lax.dynamic_slice(perm, (lo,), (batch,))
             Xb = X[idx]
             yb = None if y is None else y[idx]
             wb = w[idx]
             net, opt_state = step(net, opt_state, Xb, yb, wb,
-                                  jax.random.fold_in(key, 2 + s))
+                                  jax.random.fold_in(key, 2 + gs))
             if s % steps_per_epoch == steps_per_epoch - 1:
                 job.update(steps_per_epoch / total_steps)
 
@@ -299,7 +360,9 @@ class DeepLearning(ModelBuilder):
         output.model_category = category
         if not p.autoencoder:
             output.response_domain = list(resp_domain) if resp_domain else None
-        model = DeepLearningModel(p, output, net, dinfo, loss_kind)
+        model = DeepLearningModel(
+            p, output, net, dinfo, loss_kind, opt_state=opt_state,
+            epochs_trained=prior_epochs + total_steps / steps_per_epoch)
         if p.export_weights_and_biases:
             # publish per-layer weight/bias frames under DKV keys, the
             # reference's layout: weight frames are (units_out, units_in)

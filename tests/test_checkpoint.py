@@ -135,3 +135,79 @@ def test_grid_auto_recovery(tmp_path):
     assert g2.model_count == 6  # 2 recovered + 4 newly trained
     # recovered models are scoreable
     assert gs2._recovered_models[0].predict(fr).nrow == fr.nrow
+
+
+# ---------------------------------------------------------------------------
+# DeepLearning checkpoint continuation (`DeepLearning.java:261-348`)
+# ---------------------------------------------------------------------------
+def test_dl_checkpoint_continues_training():
+    from h2o_tpu.models.deeplearning import (DeepLearning,
+                                             DeepLearningParameters)
+
+    fr = _frame(600, seed=3)
+    base = DeepLearningParameters(training_frame=fr, response_column="y",
+                                  hidden=[16, 16], epochs=4, seed=7)
+    m1 = DeepLearning(base).train_model()
+    ll1 = m1.output.training_metrics.logloss
+    assert m1.epochs_trained == pytest.approx(4.0)
+
+    cont = base.clone(checkpoint=m1, epochs=12)
+    m2 = DeepLearning(cont).train_model()
+    ll2 = m2.output.training_metrics.logloss
+    assert m2.epochs_trained == pytest.approx(12.0)
+    # loss continues from the restored state: more epochs fit better
+    assert ll2 < ll1, (ll1, ll2)
+    # and the continuation beats (or matches) a fresh 8-epoch run: it had
+    # 4 warm epochs of head start
+    fresh = DeepLearning(base.clone(epochs=8)).train_model()
+    assert ll2 < fresh.output.training_metrics.logloss * 1.05
+
+
+def test_dl_checkpoint_by_key_and_opt_state():
+    from h2o_tpu.models.deeplearning import (DeepLearning,
+                                             DeepLearningParameters)
+
+    fr = _frame(400, seed=4)
+    base = DeepLearningParameters(training_frame=fr, response_column="y",
+                                  hidden=[8], epochs=2, seed=9)
+    m1 = DeepLearning(base).train_model()
+    assert m1.opt_state is not None     # ADADELTA accumulators stored
+    m2 = DeepLearning(base.clone(checkpoint=m1.key,
+                                 epochs=4)).train_model()   # resolve via DKV
+    assert m2.epochs_trained == pytest.approx(4.0)
+
+
+def test_dl_checkpoint_rejects_incompatible():
+    from h2o_tpu.models.deeplearning import (DeepLearning,
+                                             DeepLearningParameters)
+
+    fr = _frame(300, seed=5)
+    base = DeepLearningParameters(training_frame=fr, response_column="y",
+                                  hidden=[8], epochs=2, seed=11)
+    m1 = DeepLearning(base).train_model()
+    with pytest.raises(ValueError, match="hidden"):
+        DeepLearning(base.clone(checkpoint=m1, epochs=4,
+                                hidden=[16])).train_model()
+    with pytest.raises(ValueError, match="activation"):
+        DeepLearning(base.clone(checkpoint=m1, epochs=4,
+                                activation="Tanh")).train_model()
+    with pytest.raises(ValueError, match="epochs"):
+        DeepLearning(base.clone(checkpoint=m1, epochs=2)).train_model()
+
+
+def test_dl_checkpoint_model_saves_and_loads(tmp_path):
+    from h2o_tpu.models.deeplearning import (DeepLearning,
+                                             DeepLearningParameters)
+
+    fr = _frame(200, seed=6)
+    base = DeepLearningParameters(training_frame=fr, response_column="y",
+                                  hidden=[8], epochs=2, seed=13)
+    m1 = DeepLearning(base).train_model()
+    m2 = DeepLearning(base.clone(checkpoint=m1, epochs=4)).train_model()
+    assert m2.params.checkpoint == m1.key  # key, not the model object
+    path = m2.save(str(tmp_path / "dl.bin"))
+    m3 = load_model(path)
+    assert m3.epochs_trained == pytest.approx(4.0)
+    p1 = m2.predict(fr).vec(2).to_numpy()
+    p2 = m3.predict(fr).vec(2).to_numpy()
+    assert np.allclose(p1, p2, atol=1e-6)
