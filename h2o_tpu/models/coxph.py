@@ -47,6 +47,9 @@ class CoxPHParameters(Parameters):
     max_iterations: int = 20
     lre: float = 9.0     # -log10 relative tolerance (reference default)
     use_all_factor_levels: bool = False
+    interactions: list = None        # pairwise interactions among columns
+    interaction_pairs: list = None   # explicit (a, b) pairs — both expand
+                                     # like GLM's (`hex/DataInfo.java:133`)
 
 
 @jax.jit
@@ -123,7 +126,14 @@ class CoxPHModel(Model):
     baseline = None  # {stratum_code: (event_times, cumulative_hazard)}
     strata_cols = None
 
+    interaction_spec = None  # frozen interaction pairs (GLM-shared)
+
     def predict(self, fr: Frame) -> Frame:
+        if self.interaction_spec:
+            from .glm import _apply_interactions
+
+            fr, _ = _apply_interactions(fr, self.interaction_spec,
+                                           skip_existing=True)
         X, _ = self.dinfo.expand(fr)
         lp = (X - self.mean_x) @ self.beta
         return Frame(["lp"], [Vec.from_device(lp, fr.nrow)])
@@ -195,6 +205,17 @@ class CoxPH(ModelBuilder):
         skip = {p.stop_column, p.start_column, p.response_column}
         skip |= set(p.stratify_by or [])
         names = [n for n in self.feature_names() if n not in skip]
+        inter_spec = None
+        if p.interactions or p.interaction_pairs:
+            from .glm import _apply_interactions, _freeze_interaction_pairs
+
+            reserved = {p.response_column, p.weights_column, p.offset_column,
+                        p.start_column, p.stop_column} | set(p.stratify_by
+                                                             or [])
+            inter_spec = _freeze_interaction_pairs(
+                fr, p.interactions, p.interaction_pairs, reserved)
+            fr, extra = _apply_interactions(fr, inter_spec)
+            names = names + extra
 
         dinfo = DataInfo.make(fr, names, standardize=False,
                               use_all_factor_levels=p.use_all_factor_levels)
@@ -295,6 +316,7 @@ class CoxPH(ModelBuilder):
         })()
         model = CoxPHModel(p, output, jnp.asarray(beta_np.astype(np.float32)),
                            dinfo, jnp.asarray(mu.astype(np.float32)))
+        model.interaction_spec = inter_spec
         model.coefficients = dict(zip(dinfo.expanded_names, beta_np))
 
         # Breslow cumulative baseline hazard per stratum (basehaz role):

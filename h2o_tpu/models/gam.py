@@ -184,6 +184,7 @@ class GAMModel(Model):
                  key=None):
         self.dinfo = dinfo          # DataInfo over non-gam features (or None)
         self.gam_specs = gam_specs  # list of dicts per gam column
+        self.interaction_spec = None  # frozen cat/num interaction pairs
         self.beta = beta            # (P_total+1,), intercept last
         self.family = family
         super().__init__(params, output, key=key)
@@ -194,6 +195,11 @@ class GAMModel(Model):
         shipped the whole design through the device tunnel twice per call —
         the entire GAM-vs-band gap at benchmark scale.)"""
         blocks = []
+        if self.interaction_spec:
+            from .glm import _apply_interactions
+
+            fr, _ = _apply_interactions(fr, self.interaction_spec,
+                                           skip_existing=True)
         if self.dinfo is not None and self.dinfo.names:
             Xlin, _ = self.dinfo.expand(fr)
             blocks.append(Xlin)
@@ -259,6 +265,15 @@ class GAM(ModelBuilder):
         family = GLM._family(self, category)
 
         lin_names = self.feature_names()
+        inter_spec = None
+        if p.interactions or p.interaction_pairs:
+            from .glm import _apply_interactions, _freeze_interaction_pairs
+
+            reserved = {p.response_column, p.weights_column, p.offset_column}
+            inter_spec = _freeze_interaction_pairs(
+                fr, p.interactions, p.interaction_pairs, reserved)
+            fr, extra = _apply_interactions(fr, inter_spec)
+            lin_names = lin_names + extra
         dinfo = (DataInfo.make(fr, lin_names, standardize=p.standardize,
                                missing_values_handling=p.missing_values_handling)
                  if lin_names else None)
@@ -324,6 +339,7 @@ class GAM(ModelBuilder):
         output.response_domain = list(resp_domain) if resp_domain else None
         output.model_category = category
         model = GAMModel(p, output, dinfo, gam_specs, None, family)
+        model.interaction_spec = inter_spec
 
         X = model._design(fr)
         P_lin = X.shape[1] - sum(pen_sizes)

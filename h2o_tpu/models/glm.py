@@ -354,10 +354,15 @@ class GLMParameters(Parameters):
                                      # random column, gaussian rand_family)
     random_columns: list = None      # [column name or index]
     rand_family: list = None         # ["gaussian"] (only member supported)
-    interactions: list = None        # columns whose pairwise products enter
-                                     # the design (`GLMModel.java:515`);
-                                     # numeric×numeric pairs (cat interactions
-                                     # via `h2o.interaction` + train)
+    interactions: list = None        # columns whose pairwise interactions
+                                     # enter the design (`GLMModel.java:515`):
+                                     # num×num products, cat×num gated
+                                     # columns, cat×cat product-domain
+                                     # categoricals (`hex/DataInfo.java:133`)
+    interaction_pairs: list = None   # explicit (a, b) tuples instead of the
+                                     # all-pairs expansion of `interactions`
+                                     # (`Model.InteractionPair` / h2o-py
+                                     # interaction_pairs)
     beta_constraints: object = None  # Frame or {names, lower_bounds,
                                      # upper_bounds} — box constraints per
                                      # coefficient on the natural scale
@@ -723,41 +728,150 @@ def _estimate_dispersion_pearson(family, y, mu, w, df) -> float:
     return float(np.nansum(resid2) / df)
 
 
-def _resolve_interaction_cols(fr: Frame, interactions: list,
-                              reserved: set) -> list:
-    """Interaction spec (names or train-frame indices) → frozen column names,
-    validated: numeric only, and never the response/weights/offset columns
-    (the reference rejects special columns in `_interactions`)."""
-    cols = [fr.names[int(c)] if not isinstance(c, str) else c
-            for c in interactions]
-    for c in cols:
+#: cap on a cat×cat product domain — the EnumLimited analog for interaction
+#: columns (`hex/DataInfo.java:133` InteractionPair domains; the reference's
+#: `Interaction.java` max_factors defaults to 100)
+_INTERACTION_MAX_LEVELS = 100
+
+
+def _freeze_interaction_pairs(fr: Frame, interactions, interaction_pairs,
+                              reserved: set,
+                              max_levels: int = _INTERACTION_MAX_LEVELS):
+    """Resolve `interactions` (all pairwise combos among the columns) and/or
+    `interaction_pairs` (explicit (a, b) tuples) into frozen per-pair specs
+    (`hex/DataInfo.java:133,223` Model.InteractionPair):
+
+    - num×num → one product column "a_b"
+    - cat×num → one gated numeric column "a_b.lvl" per non-reference level
+      (first level dropped: the full gated set sums to the numeric column)
+    - cat×cat → one categorical column "a_b" whose domain is the OBSERVED
+      level combos "la_lb", most-frequent first, capped at ``max_levels``
+      (EnumLimited semantics); rarer combos score as NA → mode
+
+    Everything needed to replay at score time (levels, combo labels) is
+    frozen here from the TRAINING frame.
+    """
+    def resolve(c):
+        return fr.names[int(c)] if not isinstance(c, str) else c
+
+    pairs = []
+    listed = []
+    if interactions:
+        cols = [resolve(c) for c in interactions]
+        listed += cols
+        if len(cols) < 2:
+            raise ValueError(
+                "interactions needs at least two columns to form pairs "
+                f"(got {cols}) — use interaction_pairs for explicit tuples")
+        pairs += [(a, b) for i, a in enumerate(cols) for b in cols[i + 1:]]
+    for a, b in (interaction_pairs or []):
+        pairs.append((resolve(a), resolve(b)))
+        listed += [resolve(a), resolve(b)]
+    for c in listed:
         if c in reserved:
             raise ValueError(f"interactions may not include the special "
                              f"column '{c}' (response/weights/offset)")
-        if fr.vec(c).is_categorical() or fr.vec(c).is_string():
-            raise NotImplementedError(
-                f"interactions: column '{c}' is not numeric — expand "
-                f"categorical interactions with h2o.interaction first")
-    return cols
+        if fr.vec(c).is_string():
+            raise ValueError(f"interactions: column '{c}' is a string "
+                             "column")
+    specs = []
+    for a, b in pairs:
+        # canonical order: categorical first (stable generated names)
+        if fr.vec(b).is_categorical() and not fr.vec(a).is_categorical():
+            a, b = b, a
+        acat, bcat = fr.vec(a).is_categorical(), fr.vec(b).is_categorical()
+        if not acat:
+            specs.append({"kind": "numnum", "a": a, "b": b})
+        elif not bcat:
+            specs.append({"kind": "catnum", "a": a, "b": b,
+                          "levels": list(fr.vec(a).domain)})
+        else:
+            ca = fr.vec(a).to_numpy()
+            cb = fr.vec(b).to_numpy()
+            ok = ~(np.isnan(ca) | np.isnan(cb))
+            da, db = fr.vec(a).domain, fr.vec(b).domain
+            combo = ca[ok].astype(np.int64) * len(db) + cb[ok].astype(np.int64)
+            codes, counts = np.unique(combo, return_counts=True)
+            order = np.argsort(-counts, kind="stable")[:max_levels]
+            # combos are keyed by the LEVEL-NAME PAIR (labels are display
+            # only: "New_York"-style underscores must not merge combos)
+            combos = [(da[c // len(db)], db[c % len(db)])
+                      for c in codes[order]]
+            labels, seen = [], set()
+            for la, lb in combos:
+                lab = f"{la}_{lb}"
+                while lab in seen:
+                    lab += "."
+                seen.add(lab)
+                labels.append(lab)
+            specs.append({"kind": "catcat", "a": a, "b": b,
+                          "combos": combos, "labels": labels})
+    return specs
 
 
-def _expand_interactions(fr: Frame, names: list, cols: list):
-    """Append pairwise product columns for the resolved numeric features
-    (`hex/DataInfo` interactions; `GLMModel.java:515` _interactions). The
-    same expansion replays at score time via GLMModel.adapt_frame, AFTER
-    categorical-encoding replay so train and score see the same values."""
+def _primary_interaction_name(s: dict) -> str:
+    if s["kind"] == "catnum":
+        return f"{s['a']}_{s['b']}.{s['levels'][1]}" if len(s["levels"]) > 1 \
+            else f"{s['a']}_{s['b']}"
+    return f"{s['a']}_{s['b']}"
+
+
+def _apply_interactions(fr: Frame, specs: list, skip_existing: bool = False):
+    """Append the frozen interaction columns to (a shallow copy of) ``fr`` —
+    runs identically at train and score time; score-frame domains are matched
+    BY LABEL so unseen levels/combos become NA (→ DataInfo imputation).
+    ``skip_existing`` makes replay idempotent (model-side scoring on a frame
+    that already carries the expansion, e.g. the training frame itself)."""
+    from ..frame.vec import T_CAT
+
     out = Frame(list(fr.names), list(fr.vecs))
-    new_names = list(names)
-    for i, a in enumerate(cols):
-        for b in cols[i + 1:]:
-            nm = f"{a}_{b}"
-            if nm in out.names:
-                raise ValueError(
-                    f"interactions: generated column name '{nm}' collides "
-                    f"with an existing column — rename it")
-            out.add(nm, Vec.from_device(fr.vec(a).data * fr.vec(b).data,
-                                        fr.nrow))
-            new_names.append(nm)
+    new_names = []
+
+    def add(nm, vec):
+        if nm in out.names:
+            raise ValueError(
+                f"interactions: generated column name '{nm}' collides "
+                f"with an existing column — rename it")
+        out.add(nm, vec)
+        new_names.append(nm)
+
+    if skip_existing:
+        specs = [s for s in specs
+                 if _primary_interaction_name(s) not in fr.names]
+    for s in specs:
+        va, vb = fr.vec(s["a"]), fr.vec(s["b"])
+        if s["kind"] == "numnum":
+            add(f"{s['a']}_{s['b']}",
+                Vec.from_device(va.data * vb.data, fr.nrow))
+        elif s["kind"] == "catnum":
+            dom = va.domain or []
+            for lvl in s["levels"][1:]:   # reference level dropped
+                code = dom.index(lvl) if lvl in dom else -1
+                gate = (va.data == code).astype(jnp.float32)
+                col = jnp.where(jnp.isnan(va.data), jnp.nan, gate) * vb.data
+                add(f"{s['a']}_{s['b']}.{lvl}",
+                    Vec.from_device(col, fr.nrow))
+        else:  # catcat
+            da, db = va.domain or [], vb.domain or []
+            # legacy specs (pre-fix exports) stored labels only
+            combos = s.get("combos") or [tuple(lab.rsplit("_", 1))
+                                         for lab in s["labels"]]
+            combo_idx = {c: i for i, c in enumerate(combos)}
+            table = np.full(max(len(da), 1) * max(len(db), 1), np.nan,
+                            np.float32)
+            for i, la in enumerate(da):
+                for j, lb in enumerate(db):
+                    k = combo_idx.get((la, lb))
+                    if k is not None:
+                        table[i * len(db) + j] = k
+            combo = va.data * len(db) + vb.data   # NaN propagates
+            codes = jnp.where(jnp.isnan(combo), 0,
+                              combo).astype(jnp.int32)
+            mapped = jnp.asarray(table)[jnp.clip(codes, 0, len(table) - 1)]
+            mapped = jnp.where(jnp.isnan(combo), jnp.nan, mapped)
+            add(f"{s['a']}_{s['b']}",
+                Vec.from_device(mapped, fr.nrow, type=T_CAT,
+                                domain=list(s["labels"])))
     return out, new_names
 
 
@@ -806,13 +920,19 @@ class GLMModel(Model):
         names = self.dinfo.expanded_names + ["Intercept"]
         return dict(zip(names, np.asarray(self.beta)))
 
-    interaction_cols = None  # frozen at train time (names, never indices)
+    interaction_spec = None   # frozen pair specs (levels/labels by name)
+    interaction_cols = None   # legacy (pre-round-5 binary exports): numeric
+                              # pairwise column names
 
     def adapt_frame(self, fr: Frame):
         fr = self.pre_adapt(fr)  # categorical-encoding replay FIRST, so the
-        if self.interaction_cols:  # products see the same values as training
-            fr, _ = _expand_interactions(fr, list(fr.names),
-                                         self.interaction_cols)
+        spec = self.interaction_spec  # products see the training-time values
+        if spec is None and self.interaction_cols:
+            cols = self.interaction_cols
+            spec = [{"kind": "numnum", "a": a, "b": b}
+                    for i, a in enumerate(cols) for b in cols[i + 1:]]
+        if spec:
+            fr, _ = _apply_interactions(fr, spec, skip_existing=True)
         X, ok = self.dinfo.expand(fr)
         return X
 
@@ -884,17 +1004,19 @@ class GLM(ModelBuilder):
         fr = p.training_frame
         names = self.feature_names()
         y_dev, category, resp_domain = self.response_info()
-        self._interaction_cols = None
-        if getattr(p, "interactions", None):
+        self._interaction_spec = None
+        if getattr(p, "interactions", None) \
+                or getattr(p, "interaction_pairs", None):
             if category == "Multinomial" or getattr(p, "HGLM", False):
                 raise NotImplementedError(
                     "interactions are supported for single-block GLM "
                     "families (not multinomial/ordinal/HGLM)")
             reserved = {p.response_column, p.weights_column, p.offset_column}
-            self._interaction_cols = _resolve_interaction_cols(
-                fr, p.interactions, reserved)
-            fr, names = _expand_interactions(fr, names,
-                                             self._interaction_cols)
+            self._interaction_spec = _freeze_interaction_pairs(
+                fr, p.interactions, getattr(p, "interaction_pairs", None),
+                reserved)
+            fr, extra = _apply_interactions(fr, self._interaction_spec)
+            names = names + extra
         if getattr(p, "HGLM", False):
             return self._build_hglm(job, names, y_dev, category)
         return self._build_single(job, p, fr, names, y_dev, category,
@@ -974,7 +1096,7 @@ class GLM(ModelBuilder):
         output.response_domain = list(resp_domain) if resp_domain else None
         output.model_category = category
         model = GLMModel(p, output, dinfo, beta, family)
-        model.interaction_cols = self._interaction_cols
+        model.interaction_spec = self._interaction_spec
         raw = model.score0(X)
         ym = jnp.where(w > 0, y, jnp.nan)
         m = make_metrics(category, ym, raw, w if p.weights_column else None,

@@ -433,15 +433,72 @@ def test_glm_interactions_pairwise():
                           "x2": np.array([2.0], np.float32)})
     pred = inter.predict(f2).vec(0).to_numpy()[0]
     assert abs(pred - (1.0 * 1 + 2.0 * 1 * 2)) < 0.2
-    with pytest.raises(NotImplementedError, match="numeric"):
-        import pandas as pd
-        frc = Frame.from_pandas(pd.DataFrame(
-            {"g": pd.Categorical(["a", "b"] * 50),
-             "x": np.arange(100, dtype=np.float32),
-             "y": np.arange(100, dtype=np.float32)}))
-        GLM(GLMParameters(training_frame=frc, response_column="y",
-                          family="gaussian",
-                          interactions=["g", "x"])).train_model()
+
+
+def test_glm_interactions_cat_num():
+    """cat×num interaction: per-level gated columns recover per-level slopes
+    (`hex/DataInfo.java:133` InteractionPair, cat×num expansion)."""
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(21)
+    n = 4000
+    g = rng.integers(0, 3, n)
+    x = rng.normal(size=n).astype(np.float32)
+    slopes = np.array([1.0, -2.0, 3.0])
+    y = (slopes[g] * x + 0.05 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "y": y})
+    fr.add("g", Vec.from_numpy(g.astype(np.float32), type=T_CAT,
+                               domain=["a", "b", "c"]))
+    m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                          family="gaussian", lambda_=0.0, standardize=False,
+                          interaction_pairs=[("g", "x")])).train_model()
+    coef = m.coef()
+    # base slope = level-a slope; gated columns add the per-level deltas
+    assert coef["x"] == pytest.approx(1.0, abs=0.05)
+    assert coef["g_x.b"] == pytest.approx(-3.0, abs=0.08)
+    assert coef["g_x.c"] == pytest.approx(2.0, abs=0.08)
+    # scoring replays the gating on a fresh frame (level c, x=2 -> y≈6)
+    sf = Frame.from_dict({"x": np.array([2.0], np.float32)})
+    sf.add("g", Vec.from_numpy(np.array([0.0], np.float32), type=T_CAT,
+                               domain=["c"]))
+    assert abs(m.predict(sf).vec(0).to_numpy()[0] - 6.0) < 0.3
+
+
+def test_glm_interactions_cat_cat():
+    """cat×cat interaction: product-domain categorical recovers per-combo
+    effects beyond the additive mains."""
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(22)
+    n = 4000
+    a = rng.integers(0, 2, n)
+    b = rng.integers(0, 2, n)
+    # pure interaction pattern (XOR): additive mains cannot fit it
+    y = ((a ^ b) + 0.05 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_dict({"y": y})
+    fr.add("u", Vec.from_numpy(a.astype(np.float32), type=T_CAT,
+                               domain=["a0", "a1"]))
+    fr.add("v", Vec.from_numpy(b.astype(np.float32), type=T_CAT,
+                               domain=["b0", "b1"]))
+    plain = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              standardize=False)).train_model()
+    inter = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family="gaussian", lambda_=0.0,
+                              standardize=False,
+                              interaction_pairs=[("u", "v")])).train_model()
+    assert plain.output.training_metrics.r2 < 0.05       # XOR: mains useless
+    assert inter.output.training_metrics.r2 > 0.95
+    # domain is the observed combos, most frequent first, labeled la_lb
+    combos = {nm for nm in inter.coef() if nm.startswith("u_v.")}
+    assert combos <= {"u_v.a0_b0", "u_v.a0_b1", "u_v.a1_b0", "u_v.a1_b1"}
+    # scoring: (a1, b0) -> 1
+    sf = Frame.from_dict({"dummy": np.array([0.0], np.float32)})
+    sf.add("u", Vec.from_numpy(np.array([0.0], np.float32), type=T_CAT,
+                               domain=["a1"]))
+    sf.add("v", Vec.from_numpy(np.array([0.0], np.float32), type=T_CAT,
+                               domain=["b0"]))
+    assert abs(inter.predict(sf).vec(0).to_numpy()[0] - 1.0) < 0.1
 
 
 def test_glm_interactions_guards():
@@ -504,3 +561,48 @@ def test_multinomial_feature_parallelism_matches_single():
     np.testing.assert_allclose(
         m1.output.training_metrics.logloss,
         m2.output.training_metrics.logloss, rtol=1e-3)
+
+
+def test_gam_coxph_interactions():
+    """interactions / interaction_pairs on GAM and CoxPH ride the same
+    frozen-spec expansion as GLM (`hex/DataInfo.java:133`)."""
+    from h2o_tpu.frame.vec import T_CAT, Vec
+    from h2o_tpu.models.coxph import CoxPH, CoxPHParameters
+    from h2o_tpu.models.gam import GAM, GAMParameters
+
+    rng = np.random.default_rng(23)
+    n = 1500
+    g = rng.integers(0, 2, n)
+    x = rng.normal(size=n).astype(np.float32)
+    z = rng.normal(size=n).astype(np.float32)
+    logit = np.where(g == 1, 2.0 * x, -2.0 * x) + 0.3 * z
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    fr = Frame.from_dict({"x": x, "z": z})
+    fr.add("g", Vec.from_numpy(g.astype(np.float32), type=T_CAT,
+                               domain=["u", "v"]))
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["n", "p"]))
+    base = GAMParameters(training_frame=fr, response_column="y",
+                         family="binomial", gam_columns=["z"], seed=1)
+    m0 = GAM(base).train_model()
+    m1 = GAM(base.clone(interaction_pairs=[("g", "x")])).train_model()
+    assert "g_x.v" in m1.coef()
+    assert (m1.output.training_metrics.auc
+            > m0.output.training_metrics.auc + 0.05)
+    # predict replays the expansion
+    assert m1.predict(fr).nrow == n
+
+    # CoxPH: sign-flipped hazard effect per group
+    t = rng.exponential(scale=np.exp(-np.where(g == 1, 1.0, -1.0) * x), size=n)
+    cox_fr = Frame.from_dict({"x": x.astype(np.float32),
+                              "stop": t.astype(np.float32),
+                              "event": np.ones(n, np.float32)})
+    cox_fr.add("g", Vec.from_numpy(g.astype(np.float32), type=T_CAT,
+                                   domain=["u", "v"]))
+    cm = CoxPH(CoxPHParameters(training_frame=cox_fr,
+                               response_column="event", stop_column="stop",
+                               interaction_pairs=[("g", "x")])).train_model()
+    co = cm.coefficients
+    assert "g_x.v" in co
+    # group u slope ≈ -1, group v ≈ +1 → gated delta ≈ +2
+    assert co["g_x.v"] == pytest.approx(2.0, abs=0.4)
+    assert cm.predict(cox_fr).nrow == n
