@@ -158,6 +158,71 @@ h2o.kmeans <- function(training_frame, ...) {
   structure(list(model_id = done$dest$name), class = "H2OModel")
 }
 
+h2o.xgboost <- function(x = NULL, y, training_frame, ...)
+  .h2o.train("xgboost", x, y, training_frame, ...)
+h2o.naiveBayes <- function(x = NULL, y, training_frame, ...)
+  .h2o.train("naivebayes", x, y, training_frame, ...)
+h2o.coxph <- function(x = NULL, event_column, training_frame, ...)
+  .h2o.train("coxph", x, event_column, training_frame, ...)
+
+.h2o.train_unsupervised <- function(algo, training_frame, ...) {
+  job <- .h2o.request("POST", paste0("/3/ModelBuilders/", algo),
+                      body = c(list(training_frame = training_frame$frame_id),
+                               list(...)))
+  done <- .h2o.poll(job)
+  structure(list(model_id = done$dest$name,
+                 schema = .h2o.request("GET", paste0(
+                   "/3/Models/", done$dest$name))$models[[1]]),
+            class = "H2OModel")
+}
+
+h2o.isolationForest <- function(training_frame, ...)
+  .h2o.train_unsupervised("isolationforest", training_frame, ...)
+h2o.prcomp <- function(training_frame, k = 2, ...)
+  .h2o.train_unsupervised("pca", training_frame, k = k, ...)
+
+# -- explanation data endpoints (`h2o-r` explain.R plot verbs; headless R
+#    gets the PLOT DATA — varimp bars, per-row SHAP contributions, PDP
+#    curves — and draws with base graphics when a device is available) ------
+h2o.varimp_plot <- function(model, num_of_features = 10) {
+  vi <- h2o.varimp(model)     # column-oriented: $variable, $scaled_importance
+  vars <- unlist(vi$variable)
+  scaled <- as.numeric(unlist(vi$scaled_importance))
+  n <- min(num_of_features, length(vars))
+  data <- list(variable = vars[seq_len(n)], scaled_importance = scaled[seq_len(n)])
+  if (capabilities("X11") || nzchar(Sys.getenv("DISPLAY")))
+    try(barplot(rev(data$scaled_importance), names.arg = rev(data$variable),
+                horiz = TRUE, main = "Variable Importance"), silent = TRUE)
+  invisible(data)
+}
+
+h2o.shap_summary_plot <- function(model, newdata, top_n = 10) {
+  # one scoring pass with predict_contributions=TRUE -> contributions frame
+  res <- .h2o.request("POST",
+                      sprintf("/3/Predictions/models/%s/frames/%s",
+                              model$model_id, newdata$frame_id),
+                      params = list(predict_contributions = "true"))
+  contrib <- h2o.getFrame(res$predictions_frame$name)
+  cols <- h2o.colnames(contrib)
+  mean_abs <- sapply(setdiff(cols, "BiasTerm"), function(cn)
+    h2o.mean(.h2o.frame_op(sprintf("(abs (cols %s '%s'))",
+                                   contrib$frame_id, cn)), cn))
+  ord <- order(unlist(mean_abs), decreasing = TRUE)
+  invisible(list(contributions_frame = contrib$frame_id,
+                 feature = names(mean_abs)[ord][seq_len(min(top_n, length(ord)))],
+                 mean_abs_contribution = unlist(mean_abs)[ord][seq_len(
+                   min(top_n, length(ord)))]))
+}
+
+h2o.partialPlot <- function(model, newdata, cols, nbins = 20) {
+  res <- .h2o.request("POST", "/3/PartialDependence",
+                      body = list(model_id = model$model_id,
+                                  frame_id = newdata$frame_id,
+                                  cols = paste(cols, collapse = ","),
+                                  nbins = nbins))
+  res$partial_dependence_data
+}
+
 h2o.predict <- function(model, newdata) {
   res <- .h2o.request("POST", sprintf("/3/Predictions/models/%s/frames/%s",
                                       model$model_id, newdata$frame_id))

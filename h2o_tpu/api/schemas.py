@@ -199,8 +199,9 @@ def model_schema(model) -> dict:
     o = model.output
     out = {
         "model_id": key_schema(model.key, "Key<Model>"),
-        "algo": model.algo_name,
-        "algo_full_name": model.algo_name,
+        "algo": getattr(model, "algo_override", None) or model.algo_name,
+        "algo_full_name": getattr(model, "algo_override", None)
+        or model.algo_name,
         "response_column_name": getattr(model.params, "response_column", None),
         "output": {
             "model_category": o.model_category,

@@ -3,13 +3,14 @@
 Analog of `hex/tree/dt/` (1,999 LoC; `hex/tree/dt/DT.java` builds one binary
 classification tree with exact binomial splits). TPU-native structure: one tree
 grown by the shared histogram engine (one jitted scan level pass, psum over the
-rows mesh axis). Split thresholds are therefore QUANTILE-BINNED, not the
-reference's exact per-value search — for a numeric feature with more than
-``nbins`` distinct values the chosen cut is the best bin edge, a documented
-divergence (identical split choice whenever distinct values ≤ nbins). Leaf
-values fit as class probabilities. The reference limits DT to binomial
-classification; we additionally allow regression (leaf = mean) since the
-engine gives it for free.
+rows mesh axis) in EXACT binning mode: split cuts are the midpoints between a
+feature's distinct values (`binning.compute_bin_edges` histogram_type=Exact),
+matching the reference's per-value threshold search at any row count. Columns
+with more than ``nbins_top_level`` (default 2048) distinct values fall back to
+global-quantile cuts — the one remaining (documented) divergence for
+high-cardinality continuous features. Leaf values fit as class probabilities.
+The reference limits DT to binomial classification; we additionally allow
+regression (leaf = mean) since the engine gives it for free.
 """
 
 from __future__ import annotations
@@ -28,12 +29,15 @@ class DTParameters(GBMParameters):
     reference's DTV3 simply has no such fields. mtries=-2 means all columns
     (H2O's mtries=-2 convention)."""
 
+    nbins_top_level: int = 2048   # exact-split distinct-value cap
+
     def __post_init__(self):
         self.ntrees = 1
         self.sample_rate = 1.0
         self.col_sample_rate = 1.0
         self.col_sample_rate_per_tree = 1.0
         self.mtries = -2
+        self.histogram_type = "Exact"
 
 
 class DT(DRF):

@@ -411,6 +411,7 @@ class GBM(ModelBuilder):
             X, is_cat, p.nbins,
             seed=p.seed if p.seed not in (-1, None) else 1234,
             histogram_type=p.histogram_type,
+            nbins_top_level=int(getattr(p, "nbins_top_level", 1024) or 1024),
             nbins_cats=int(getattr(p, "nbins_cats", 1024) or 1024))
         mesh = default_mesh()
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf), replicated(mesh))

@@ -156,10 +156,11 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
     distinct cut points.
     """
     ht = (histogram_type or "AUTO").lower()
-    if ht not in ("auto", "quantilesglobal", "uniformadaptive", "random"):
+    if ht not in ("auto", "quantilesglobal", "uniformadaptive", "random",
+                  "exact"):
         raise ValueError(
             f"unsupported histogram_type '{histogram_type}' — supported: "
-            f"AUTO, QuantilesGlobal, UniformAdaptive, Random")
+            f"AUTO, QuantilesGlobal, UniformAdaptive, Random, Exact")
     Xj = jnp.asarray(X)
     R, F = Xj.shape
     # Small-data exact binning — the `nbins_top_level` role: the reference's
@@ -172,14 +173,18 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
     # scales with the bin-axis length, and 20 global quantile bins is the
     # measured-fast design there.
     exact = None
-    if (R <= _exact_bin_row_limit() and nbins_top_level > nbins
-            and ht in ("auto", "quantilesglobal", "uniformadaptive")):
+    if (ht == "exact"
+            or (R <= _exact_bin_row_limit() and nbins_top_level > nbins
+                and ht in ("auto", "quantilesglobal", "uniformadaptive"))):
+        # "Exact" (the single-DT mode, `hex/tree/dt/DT.java`'s per-value
+        # search): exact midpoints at ANY row count; columns above the
+        # nbins_top_level distinct-value cap fall back to global quantiles
         vals, counts = _distinct_values(Xj, int(nbins_top_level))
         exact = (np.asarray(vals), np.asarray(counts))
     qs = np.linspace(0, 1, nbins + 1)[1:-1]
     col_min, col_max = (np.asarray(v) for v in _col_minmax(Xj))
     qrows = None
-    if ht in ("auto", "quantilesglobal"):
+    if ht in ("auto", "quantilesglobal", "exact"):
         rb = _pow2_block(R, 1024)
         qrows = np.asarray(_hist_quantile_rows(Xj, tuple(qs), rb=rb))
     all_cuts: list = []

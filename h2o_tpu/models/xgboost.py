@@ -106,10 +106,15 @@ class XGBoost(GBM):
     def build_impl(self, job):
         booster = (self.params.booster or "gbtree").lower()
         if booster == "dart":
-            return self._build_dart(job)
-        if booster == "gblinear":
-            return self._build_gblinear(job)
-        return super().build_impl(job)
+            model = self._build_dart(job)
+        elif booster == "gblinear":
+            model = self._build_gblinear(job)
+        else:
+            model = super().build_impl(job)
+        # the model object is engine-native (GBM/GLM class), but the wire
+        # reports the builder's algo like the reference (`XGBoostV3` schema)
+        model.algo_override = "xgboost"
+        return model
 
     def _build_gblinear(self, job):
         """booster='gblinear' (`XGBoostModel.java:56,150`): xgboost's

@@ -73,6 +73,72 @@ def main():
 
     print(f"WORKER_{pid}_OK", flush=True)
 
+    # 3) a REAL model train across the process boundary: the tiny GBM from
+    # __graft_entry__._dryrun_body runs on the 2-process global mesh, and the
+    # compressed trees must match a single-device (process-local) train
+    # bit-exactly on structure — the cross-"DCN" analog of the dryrun's
+    # multi-vs-single-device equivalence pin.
+    from h2o_tpu.models.tree.engine import TreeConfig, make_train_fn
+
+    cfg = TreeConfig(ntrees=2, max_depth=2, nbins=4, min_rows=1.0,
+                     learn_rate=0.3, block_rows=8)
+    F = 4
+    R = ndev * 8
+    rng = np.random.default_rng(7)
+    Xb = rng.integers(0, cfg.nbins, size=(R, F)).astype(np.int32)
+    yv = rng.normal(size=(R,)).astype(np.float32)
+    wv = np.ones(R, dtype=np.float32)
+    f0 = np.zeros(R, dtype=np.float32)
+    edges = np.tile(np.arange(1, cfg.nbins, dtype=np.float32), (F, 1))
+    edge_ok = np.ones_like(edges, dtype=bool)
+
+    def train_forest(m, row_shard):
+        """row_shard: place row arrays on m's rows axis (global arrays from
+        process-local slices on the cloud mesh; plain device arrays on the
+        local single-device mesh)."""
+        with meshmod.use_mesh(m):
+            keys = jax.random.split(jax.random.PRNGKey(0), cfg.ntrees)
+            train = make_train_fn(cfg, lambda y, f, w: (w * (f - y), w), m)
+            args = (row_shard(Xb), row_shard(yv), row_shard(wv),
+                    row_shard(f0))
+            rep = lambda a: jax.device_put(
+                jnp.asarray(a), NamedSharding(m, P()))
+            f, osum, ocnt, trees = train(
+                *args, rep(edges), rep(edge_ok), rep(keys),
+                rep(np.ones(cfg.ntrees, np.float32)),
+                rep(np.zeros(F, np.float32)),
+                rep(np.ones((F, F), bool)),
+                rep(np.zeros(F, bool)),
+                rep(np.full(F, cfg.nbins - 1, np.int32)))
+            jax.block_until_ready(trees)
+            return {k: np.asarray(jax.device_get(v))
+                    for k, v in (trees.items() if isinstance(trees, dict)
+                                 else enumerate(trees))}
+
+    per_proc = R // nproc
+
+    def global_rows(a):
+        local = a[pid * per_proc:(pid + 1) * per_proc]
+        spec = P(meshmod.ROWS) if a.ndim == 1 else P(meshmod.ROWS, None)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), local, a.shape)
+
+    trees_cloud = train_forest(mesh, global_rows)
+
+    local_mesh = meshmod.make_mesh(jax.local_devices()[:1])
+    trees_local = train_forest(local_mesh, lambda a: jnp.asarray(a))
+
+    for k in trees_cloud:
+        a, b = trees_cloud[k], trees_local[k]
+        if a.dtype.kind in "ib":
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"2-process tree component {k} diverged")
+        else:
+            np.testing.assert_allclose(
+                a, b, rtol=1e-6, atol=1e-7,
+                err_msg=f"2-process tree component {k} diverged")
+    print(f"WORKER_{pid}_GBM_OK", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -393,3 +393,68 @@ class TestRound4RSurface:
         done2 = _poll(job2)
         m = _req("GET", f"/3/Models/{done2['dest']['name']}")["models"][0]
         assert m["model_id"]["name"] == done2["dest"]["name"]
+
+
+def test_algo_verbs_wire(cloud, csv_path):
+    """h2o.xgboost / h2o.naiveBayes / h2o.isolationForest / h2o.prcomp
+    request sequences (each is one ModelBuilders POST + poll + Models GET)."""
+    imp = _req("GET", "/3/ImportFiles", params={"path": csv_path})
+    job = _req("POST", "/3/Parse",
+               body={"source_frames": imp["files"],
+                     "destination_frame": "r_wire_algos"})
+    _poll(job)
+    for algo, body in [
+            ("xgboost", {"response_column": "y", "ntrees": 3}),
+            ("naivebayes", {"response_column": "y"}),
+            ("isolationforest", {"ntrees": 5}),
+            ("pca", {"k": 2})]:
+        job = _req("POST", f"/3/ModelBuilders/{algo}",
+                   body={"training_frame": "r_wire_algos", "seed": 1, **body})
+        done = _poll(job)
+        schema = _req("GET", f"/3/Models/{done['dest']['name']}")["models"][0]
+        assert schema["algo"] == algo
+    _req("DELETE", "/3/Frames/r_wire_algos")
+
+
+def test_explain_data_verbs_wire(cloud, csv_path):
+    """h2o.varimp_plot / h2o.shap_summary_plot / h2o.partialPlot sequences:
+    varimp table fields, contributions scoring pass (BiasTerm column, rapids
+    abs/mean the R code runs per feature), PDP POST/GET."""
+    imp = _req("GET", "/3/ImportFiles", params={"path": csv_path})
+    job = _req("POST", "/3/Parse",
+               body={"source_frames": imp["files"],
+                     "destination_frame": "r_wire_explain"})
+    _poll(job)
+    job = _req("POST", "/3/ModelBuilders/gbm",
+               body={"response_column": "y", "training_frame": "r_wire_explain",
+                     "ntrees": 5, "max_depth": 3, "seed": 1})
+    model_id = _poll(job)["dest"]["name"]
+
+    # h2o.varimp_plot reads the column-oriented varimp dict
+    schema = _req("GET", f"/3/Models/{model_id}")["models"][0]
+    vi = schema["output"]["variable_importances"]
+    assert set(vi["variable"]) == {"x1", "x2"}
+    assert len(vi["scaled_importance"]) == 2
+
+    # h2o.shap_summary_plot: contributions pass + per-column abs/mean rapids
+    res = _req("POST",
+               f"/3/Predictions/models/{model_id}/frames/r_wire_explain",
+               params={"predict_contributions": "true"})
+    cid = res["predictions_frame"]["name"]
+    csum = _req("GET", f"/3/Frames/{cid}/summary")["frames"][0]
+    cols = [c["label"] for c in csum["columns"]]
+    assert "BiasTerm" in cols and "x1" in cols
+    r = _req("POST", "/99/Rapids",
+             body={"ast": f"(mean (abs (cols {cid} 'x1')) true)"})
+    assert ("scalar" in r and r["scalar"] >= 0) or r.get("key"), r
+
+    # h2o.partialPlot: POST /3/PartialDependence (+ GET by key)
+    pdp = _req("POST", "/3/PartialDependence",
+               body={"model_id": model_id, "frame_id": "r_wire_explain",
+                     "cols": "x1", "nbins": 5})
+    tables = pdp["partial_dependence_data"]
+    assert tables and tables[0]["data"]
+    again = _req("GET",
+                 f"/3/PartialDependence/{pdp['destination_key']['name']}")
+    assert again["partial_dependence_data"]
+    _req("DELETE", "/3/Frames/r_wire_explain")

@@ -256,3 +256,36 @@ def test_xgboost_gblinear():
                                    booster="gblinear", reg_lambda=1.0,
                                    seed=1)).train_model()
     assert mb.output.training_metrics.auc > 0.95
+
+
+def test_dt_exact_splits_match_sklearn():
+    """Exact-mode DT reproduces sklearn's exact-threshold tree on data whose
+    values quantile binning would merge (`hex/tree/dt/DT.java` per-value
+    search; VERDICT r4 missing #8)."""
+    from sklearn.tree import DecisionTreeClassifier
+
+    from h2o_tpu.frame.frame import Frame
+    from h2o_tpu.frame.vec import T_CAT, Vec
+    from h2o_tpu.models.dt import DT, DTParameters
+
+    rng = np.random.default_rng(31)
+    n = 800
+    # 60 distinct values >> nbins default 20: binned splits would round the
+    # thresholds; exact mode must find the true cut between 2.0 and 2.1
+    x1 = rng.integers(0, 60, n).astype(np.float32) / 10.0
+    x2 = rng.normal(size=n).astype(np.float32)     # uninformative
+    y = (x1 > 2.05).astype(np.float32)
+    fr = Frame.from_dict({"x1": x1, "x2": x2})
+    fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["0", "1"]))
+    m = DT(DTParameters(training_frame=fr, response_column="y",
+                        max_depth=1, min_rows=1, seed=1)).train_model()
+    pred = m.predict(fr).vec(0).to_numpy()
+    sk = DecisionTreeClassifier(max_depth=1, random_state=0).fit(
+        np.stack([x1, x2], 1), y)
+    assert np.mean(pred == y) == 1.0          # exact cut → perfect stump
+    # the root split is the same exact threshold sklearn finds: the midpoint
+    # between the adjacent distinct values 2.0 and 2.1
+    thr = float(np.asarray(m.forest["thr"])[0, 0])
+    assert 2.0 < thr < 2.1, thr
+    sk_thr = float(sk.tree_.threshold[0])
+    assert abs(thr - sk_thr) < 1e-6, (thr, sk_thr)
