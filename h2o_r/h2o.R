@@ -205,8 +205,8 @@ h2o.shap_summary_plot <- function(model, newdata, top_n = 10) {
   contrib <- h2o.getFrame(res$predictions_frame$name)
   cols <- h2o.colnames(contrib)
   mean_abs <- sapply(setdiff(cols, "BiasTerm"), function(cn)
-    h2o.mean(.h2o.frame_op(sprintf("(abs (cols %s '%s'))",
-                                   contrib$frame_id, cn)), cn))
+    .h2o.frame_expr(sprintf("(mean (abs (cols %s '%s')) true)",
+                            contrib$frame_id, cn)))
   ord <- order(unlist(mean_abs), decreasing = TRUE)
   invisible(list(contributions_frame = contrib$frame_id,
                  feature = names(mean_abs)[ord][seq_len(min(top_n, length(ord)))],

@@ -191,6 +191,34 @@ class Frame(Keyed):
         assert all(c.data is not None for c in cols), "string cols can't go to HBM"
         return _stack_cols(*[c.data for c in cols])
 
+    def ensure_rollups(self, names: Sequence[str] | None = None) -> None:
+        """Compute every missing column rollup in batched fused programs —
+        ONE device round-trip per ~2^28-cell block instead of one per column
+        (29 serial per-column rollups measured 38 s of an 11M-row cold train
+        through the device tunnel; this is the builders' pre-pass)."""
+        from .vec import _rollup_kernel_cols, _rollups_from_scalars
+
+        todo = [self.vec(n) for n in (names if names is not None
+                                      else self._names)]
+        todo = [v for v in todo if v._rollups is None and v.data is not None]
+        if len(todo) <= 1:
+            return
+        by_plen: dict[int, list] = {}
+        for v in todo:
+            by_plen.setdefault(v.plen, []).append(v)
+        for plen, group in by_plen.items():
+            block = max(1, (1 << 28) // max(plen, 1))
+            for s0 in range(0, len(group), block):
+                sub = group[s0:s0 + block]
+                import jax
+                import jax.numpy as jnp
+
+                r = jax.device_get(_rollup_kernel_cols(
+                    jnp.stack([v.data for v in sub], axis=1)))
+                for i, v in enumerate(sub):
+                    v._rollups = _rollups_from_scalars(
+                        v.nrow, {k: r[k][i] for k in r})
+
     # -- host views ----------------------------------------------------------
     def to_pandas(self):
         import pandas as pd

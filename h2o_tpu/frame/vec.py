@@ -83,6 +83,44 @@ def _rollup_kernel(data: jax.Array):
     )
 
 
+@jax.jit
+def _rollup_kernel_cols(X: jax.Array):
+    """Batched rollups over a (plen, C) column stack — identical math to
+    `_rollup_kernel`, one program + ONE host transfer for C columns. The
+    per-column eager path measured ~1.3 s of tunnel round-trip PER COLUMN on
+    an 11M-row frame (29 columns = 38 s of a cold train); this is the fix."""
+    ok = ~jnp.isnan(X)
+    x = jnp.where(ok, X, 0.0)
+    n = jnp.sum(ok, axis=0)
+    mean = jnp.sum(x, axis=0) / jnp.maximum(n, 1)
+    d = jnp.where(ok, X - mean[None, :], 0.0)
+    var = jnp.sum(d * d, axis=0) / jnp.maximum(n, 1)
+    return dict(
+        mins=jnp.min(jnp.where(ok, X, jnp.inf), axis=0),
+        maxs=jnp.max(jnp.where(ok, X, -jnp.inf), axis=0),
+        mean=mean,
+        var=jnp.maximum(var, 0.0),
+        n=n,
+        zerocnt=jnp.sum(ok & (X == 0.0), axis=0),
+        isint=jnp.all(jnp.where(ok, X == jnp.floor(X), True), axis=0),
+    )
+
+
+def _rollups_from_scalars(nrow: int, r: dict) -> "Rollups":
+    n = int(r["n"])
+    var = float(r["var"]) * (n / max(n - 1, 1))  # sample variance
+    return Rollups(
+        mins=float(r["mins"]) if n else np.nan,
+        maxs=float(r["maxs"]) if n else np.nan,
+        mean=float(r["mean"]) if n else np.nan,
+        sigma=float(np.sqrt(var)) if n else np.nan,
+        nacnt=nrow - n,
+        zerocnt=int(r["zerocnt"]),
+        nrow=nrow,
+        is_int=bool(r["isint"]),
+    )
+
+
 class Vec(Keyed):
     def __init__(
         self,
@@ -253,18 +291,7 @@ class Vec(Keyed):
                                         nacnt, 0, self.nrow, False)
             else:
                 r = jax.device_get(_rollup_kernel(self.data))
-                n = int(r["n"])
-                var = float(r["var"]) * (n / max(n - 1, 1))  # sample variance
-                self._rollups = Rollups(
-                    mins=float(r["mins"]) if n else np.nan,
-                    maxs=float(r["maxs"]) if n else np.nan,
-                    mean=float(r["mean"]) if n else np.nan,
-                    sigma=float(np.sqrt(var)) if n else np.nan,
-                    nacnt=self.nrow - n,
-                    zerocnt=int(r["zerocnt"]),
-                    nrow=self.nrow,
-                    is_int=bool(r["isint"]),
-                )
+                self._rollups = _rollups_from_scalars(self.nrow, r)
         return self._rollups
 
     def mean(self) -> float:

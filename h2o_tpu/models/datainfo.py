@@ -55,6 +55,7 @@ class DataInfo:
     def make(fr: Frame, names, standardize=True, use_all_factor_levels=False,
              missing_values_handling="MeanImputation") -> "DataInfo":
         # categoricals first, then numerics — mirrors DataInfo column ordering
+        fr.ensure_rollups(names)   # one fused pass, not one per column
         cats = [n for n in names if fr.vec(n).is_categorical()]
         nums = [n for n in names if not fr.vec(n).is_categorical()]
         ordered = cats + nums

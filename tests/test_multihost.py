@@ -52,6 +52,8 @@ _CLOUD_RESULT: dict = {}
 def _cloud_outputs():
     """Form the 2-process cloud once per test session; both tests read it."""
     if _CLOUD_RESULT:
+        if _CLOUD_RESULT.get("error"):
+            raise AssertionError(_CLOUD_RESULT["error"])
         return _CLOUD_RESULT["procs"], _CLOUD_RESULT["outs"]
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     env = {k: v for k, v in os.environ.items()
@@ -64,10 +66,12 @@ def _cloud_outputs():
             break
     if timed_out:
         # a hung coordinator usually means the OTHER worker died early —
-        # surface every worker's output so the real cause is visible
-        raise AssertionError(
+        # surface every worker's output (and fail the OTHER cloud test
+        # instantly instead of re-forming a doomed cloud)
+        _CLOUD_RESULT["error"] = (
             "cloud formation timed out; worker outputs:\n" +
             "\n---\n".join(o[-2000:] for o in outs))
+        raise AssertionError(_CLOUD_RESULT["error"])
     _CLOUD_RESULT.update(procs=procs, outs=outs)
     return procs, outs
 
