@@ -71,6 +71,99 @@ def _higgs_frame(nrow: int):
     return fr
 
 
+def _airlines_frame(nrow: int):
+    """Airlines-116M-shaped frame: the north-star's second leg
+    (`BASELINE.json` "Airlines-116M train-to-AUC"; reference CI config
+    `compareBenchmarksStage.groovy:165-177`). Mixed types with REAL
+    categorical cardinalities — hub-skewed Origin/Dest (300 airports),
+    22 carriers, calendar columns — and a response wired through per-level
+    categorical effects so SET splits are what earns the AUC."""
+    from h2o_tpu.frame.frame import Frame
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(116)
+    n_air, n_car = 300, 22
+    # hub concentration: a few airports carry most flights (Zipf-ish)
+    p_air = 1.0 / (np.arange(n_air) + 5.0)
+    p_air /= p_air.sum()
+    origin = rng.choice(n_air, size=nrow, p=p_air).astype(np.int16)
+    dest = rng.choice(n_air, size=nrow, p=p_air).astype(np.int16)
+    carrier = rng.integers(0, n_car, nrow).astype(np.int8)
+    year = rng.integers(0, 22, nrow).astype(np.int8)
+    month = rng.integers(0, 12, nrow).astype(np.int8)
+    dom = rng.integers(0, 31, nrow).astype(np.int8)
+    dow = rng.integers(0, 7, nrow).astype(np.int8)
+    deptime = (rng.integers(5, 24, nrow) * 100
+               + rng.integers(0, 60, nrow)).astype(np.float32)
+    dist = np.exp(rng.normal(6.5, 0.8, nrow)).astype(np.float32)
+
+    air_eff = rng.normal(0, 0.6, n_air)
+    car_eff = rng.normal(0, 0.4, n_car)
+    mon_eff = rng.normal(0, 0.3, 12)
+    logit = (air_eff[origin] + 0.7 * air_eff[dest] + car_eff[carrier]
+             + mon_eff[month] + 0.6 * np.sin(deptime / 2400 * 2 * np.pi)
+             + 0.2 * (dist / 1000.0) - 0.4)
+    y = (rng.random(nrow) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+
+    def cat(codes, domain):
+        return Vec.from_numpy(codes.astype(np.float32), type=T_CAT,
+                              domain=list(domain))
+
+    fr = Frame(
+        ["Year", "Month", "DayofMonth", "DayOfWeek", "UniqueCarrier",
+         "Origin", "Dest", "CRSDepTime", "Distance"],
+        [cat(year, [str(1987 + i) for i in range(22)]),
+         cat(month, [str(i + 1) for i in range(12)]),
+         cat(dom, [str(i + 1) for i in range(31)]),
+         cat(dow, [str(i + 1) for i in range(7)]),
+         cat(carrier, [f"C{i:02d}" for i in range(n_car)]),
+         cat(origin, [f"A{i:03d}" for i in range(n_air)]),
+         cat(dest, [f"A{i:03d}" for i in range(n_air)]),
+         Vec.from_numpy(deptime), Vec.from_numpy(dist)])
+    fr.add("IsDepDelayed", cat(y, ["NO", "YES"]))
+    return fr
+
+
+def bench_airlines(nrow: int, ntrees: int) -> dict:
+    """GBM train-to-AUC at Airlines scale: 100 trees over 7 categorical
+    (SET splits, nbins_cats) + 2 numeric columns. The raw frame spills
+    through the Cleaner once the binned matrix is resident (116M rows of
+    frame + binned + working columns exceed one chip's HBM)."""
+    import gc as _gc
+
+    import jax
+
+    from h2o_tpu.backend.memory import CLEANER, hbm_stats
+    from h2o_tpu.models.gbm import GBM, GBMParameters
+
+    t0 = time.time()
+    fr = _airlines_frame(nrow)
+    gen_s = round(time.time() - t0, 2)
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    jax.device_get([jnp.sum(v.data) for v in fr.vecs if v.data is not None])
+    h2d_s = round(time.time() - t0, 2)
+
+    p = GBMParameters(training_frame=fr, response_column="IsDepDelayed",
+                      ntrees=ntrees, max_depth=5, nbins=20, seed=42,
+                      learn_rate=0.1, score_tree_interval=ntrees)
+    t0 = time.time()
+    model = GBM(p).train_model()
+    wall = time.time() - t0
+    auc = model.output.training_metrics.auc
+    stats = hbm_stats() or {}
+    out = {"wall_s": round(wall, 3), "train_auc": round(float(auc), 4),
+           "rows": nrow, "gen_s": gen_s, "h2d_s": h2d_s,
+           "cleaner_spills": CLEANER.spills,
+           "hbm_peak_bytes": stats.get("peak_bytes_in_use"),
+           "note": ("train-to-AUC north-star leg; no reference band at "
+                    "116M — airlines-10m CPU band is 54-78 s (x11.6 rows)")}
+    del model, fr
+    _gc.collect()
+    return out
+
+
 def bench_gbm(fr, ntrees: int, skip_cadence: bool) -> dict:
     from h2o_tpu.models.gbm import GBM, GBMParameters
 
@@ -273,7 +366,7 @@ def main():
     sort_rows = int(os.environ.get("H2O_TPU_BENCH_SORT_ROWS", 100_000_000))
     wanted = [w.strip() for w in
               os.environ.get("H2O_TPU_BENCH_WORKLOADS",
-                             "gbm,glm,cod,gam,rulefit,sort,merge"
+                             "gbm,glm,cod,gam,rulefit,sort,merge,airlines"
                              ).split(",")]
     skip_cadence = bool(os.environ.get("H2O_TPU_BENCH_SKIP_CADENCE"))
 
@@ -321,6 +414,10 @@ def main():
         workloads["sort"] = bench_sort(sort_rows)
     if "merge" in wanted:
         workloads["merge"] = bench_merge(sort_rows)
+    if "airlines" in wanted:
+        air_rows = int(os.environ.get("H2O_TPU_BENCH_AIRLINES_ROWS",
+                                      116_000_000))
+        workloads["airlines116m"] = bench_airlines(air_rows, ntrees)
 
     t_once = gbm["score_once_s"] if gbm else None
     print(json.dumps({

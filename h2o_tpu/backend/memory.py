@@ -73,9 +73,18 @@ class Cleaner:
             return int(env)
         if self._stats_limit is _UNRESOLVED:
             stats = hbm_stats()
-            self._stats_limit = (int(stats["bytes_limit"] * 0.85)
-                                 if stats and stats.get("bytes_limit")
-                                 else None)
+            limit = (int(stats["bytes_limit"] * 0.85)
+                     if stats and stats.get("bytes_limit") else None)
+            if limit is None:
+                import jax
+
+                if jax.default_backend() == "tpu":
+                    # some transports (remote device tunnels) hide
+                    # memory_stats; arm the Cleaner with the smallest
+                    # current-generation chip budget (v5e: 16 GiB) rather
+                    # than running unbounded — env overrides for bigger HBM
+                    limit = int(16 * (1 << 30) * 0.85)
+            self._stats_limit = limit
         return self._stats_limit
 
     # -- tracking -------------------------------------------------------------

@@ -452,10 +452,27 @@ class GBM(ModelBuilder):
         # shrink the histogram row block so the per-block (rows, F, B)
         # one-hot keeps a bounded footprint
         B_hist = cfg.nbins + 1
+        # width-bucketed histogram groups: with mixed bin spaces (300-level
+        # airports next to 20-bin numerics) the flat accumulate pays
+        # F·B_max cells/row; bucketing by next-pow2 width pays Σ F_g·B_g.
+        # Engage only when that saves ≥ 40% of the cells.
+        widths = nedges_np + 2                  # data bins + NA slot
+        by_w: dict[int, list[int]] = {}
+        for f, wd in enumerate(widths):
+            p2 = 1 << int(np.ceil(np.log2(max(int(wd), 2))))
+            by_w.setdefault(min(p2, B_hist), []).append(f)
+        grouped_cells = sum(len(fs) * wd for wd, fs in by_w.items())
+        hist_groups = None
+        if len(by_w) > 1 and grouped_cells < 0.6 * len(widths) * B_hist:
+            hist_groups = tuple(sorted(
+                (tuple(fs), int(wd)) for wd, fs in by_w.items()))
+        eff_B = max(grouped_cells // max(len(widths), 1), 1) \
+            if hist_groups else B_hist
         blk = cfg.block_rows
-        while blk > 512 and blk * B_hist > 8192 * 128:
+        while blk > 512 and blk * eff_B > 8192 * 128:
             blk //= 2
-        cfg = dataclasses.replace(cfg, use_sets=use_sets, block_rows=blk)
+        cfg = dataclasses.replace(cfg, use_sets=use_sets, block_rows=blk,
+                                  hist_groups=hist_groups)
         if not self.drf_mode and K == 1 and dist.name in ("laplace",
                                                           "quantile"):
             # exact gamma leaves: median (laplace) / alpha-quantile of the
@@ -496,7 +513,15 @@ class GBM(ModelBuilder):
         p, fr, names = s.p, s.fr, s.names
         category, resp_domain, dist, K = (s.category, s.resp_domain,
                                           s.dist, s.K)
-        X, is_cat, w, y, ymask = s.X, s.is_cat, s.w, s.y, s.ymask
+        is_cat, w, y, ymask = s.is_cat, s.w, s.y, s.ymask
+        # the RAW stacked matrix is binning input only — training runs on the
+        # binned Xb — EXCEPT a checkpoint restart, which replays the prior
+        # forest over raw thresholds. Otherwise drop it now: at
+        # airlines-116M scale it is ~4 GB of HBM the whole train would
+        # otherwise hold. (XGBoost's DART driver keeps its own s.X.)
+        X = s.X
+        if p.checkpoint is None:
+            X = s.X = None
         edges, mono, imat, edge_ok, Xb = (s.edges, s.mono, s.imat,
                                           s.edge_ok, s.Xb)
         mesh, f0, grad_fn, cfg, grad_key = (s.mesh, s.f0, s.grad_fn,

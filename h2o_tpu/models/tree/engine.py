@@ -60,8 +60,10 @@ class TreeConfig:
     drf_mode: bool = False       # trees fit at f=0, averaged at predict
     nclass: int = 1              # trees per iteration (multinomial K)
     block_rows: int = 8192       # row-block size for the histogram scan
-    use_pallas: bool | None = None  # fused VMEM histogram kernel; None = auto
-                                    # (on for TPU backend, XLA path elsewhere)
+    hist_groups: tuple | None = None  # width-bucketed feature partition
+                                 # ((idx_tuple, width), ...) for mixed
+                                 # narrow/wide bin spaces (see
+                                 # _build_level_hist); None = flat
     use_monotone: bool = False   # monotone_constraints active (static flag;
                                  # the per-feature directions ride as an array)
     use_interaction: bool = False  # interaction_constraints active (the
@@ -132,18 +134,23 @@ def _onehot_pick(oh: jax.Array, v: jax.Array) -> jax.Array:
 # Histogram build (the ScoreBuildHistogram2 analog) — runs inside shard_map.
 # ---------------------------------------------------------------------------
 def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
-                      use_pallas: bool = False):
+                      groups=None):
     """Accumulate hist (F, n_lv, nbins_tot, V) for nodes [offset, offset+n_lv).
 
     Xb: (Rl, F) int32 bins; node: (Rl,) int32 global node ids; vals: (Rl, V)
     accumulated channels ([w, g, h] for GBM; [wt, wty, wc, wcy] for uplift),
     already zeroed for inactive rows.
-    """
-    if use_pallas:
-        from ...ops.histogram import build_level_hist_pallas
 
-        hist = build_level_hist_pallas(Xb, node, vals, offset, n_lv, nbins_tot)
-        return jax.lax.psum(hist, ROWS)
+    ``groups`` (static): width-bucketed feature partition
+    ``((feature_idx_tuple, group_width), ...)`` — with mixed bin widths
+    (airlines-style 300-level categoricals next to 20-bin numerics) the flat
+    (rb, F, B) one-hot pads EVERY feature to the widest feature's bins, so
+    the accumulate burns F·B_max cells/row; grouped, each bucket pays only
+    its own width (Σ F_g·B_g) and the per-group histograms scatter back into
+    the global (F, n_lv, B, V) layout once per level. Split finding is
+    untouched. The group NA bucket is its last slot; global NA stays at
+    ``nbins_tot - 1``.
+    """
     Rl, F = Xb.shape
     V = vals.shape[1]
     rb = _block_rows(Rl, block)
@@ -158,17 +165,42 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
     lc_r = lc.reshape(nblk, rb)
     v_r = v.reshape(nblk, rb, V)
 
-    def body(acc, blk):
-        xb, l, vv = blk
-        n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)          # (rb, n_lv)
-        a = jnp.einsum("rn,rv->rnv", n_oh, vv)                      # (rb, n_lv, V)
-        b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)     # (rb, F, B)
-        acc = acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh)
-        return acc, None
+    if groups is None:
+        def body(acc, blk):
+            xb, l, vv = blk
+            n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)      # (rb, n_lv)
+            a = jnp.einsum("rn,rv->rnv", n_oh, vv)                 # (rb, n_lv, V)
+            b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)  # (rb,F,B)
+            acc = acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh)
+            return acc, None
 
-    init = jnp.zeros((F, n_lv, nbins_tot, V), dtype=jnp.float32)
-    hist, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
-    return jax.lax.psum(hist, ROWS)
+        init = jnp.zeros((F, n_lv, nbins_tot, V), dtype=jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
+        return jax.lax.psum(hist, ROWS)
+
+    na_global = nbins_tot - 1
+
+    def body(accs, blk):
+        xb, l, vv = blk
+        n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)
+        a = jnp.einsum("rn,rv->rnv", n_oh, vv)
+        out = []
+        for (idxs, Bg), acc in zip(groups, accs):
+            xg = xb[:, list(idxs)]
+            xg = jnp.where(xg == na_global, Bg - 1, xg)
+            b_oh = jax.nn.one_hot(xg, Bg, dtype=jnp.float32)
+            out.append(acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh))
+        return tuple(out), None
+
+    init = tuple(jnp.zeros((len(idxs), n_lv, Bg, V), jnp.float32)
+                 for idxs, Bg in groups)
+    hists, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
+    full = jnp.zeros((F, n_lv, nbins_tot, V), jnp.float32)
+    for (idxs, Bg), hg in zip(groups, hists):
+        ia = jnp.asarray(idxs)
+        full = full.at[ia, :, :Bg - 1, :].set(hg[:, :, :Bg - 1, :])
+        full = full.at[ia, :, na_global, :].set(hg[:, :, Bg - 1, :])
+    return jax.lax.psum(full, ROWS)
 
 
 def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
@@ -448,16 +480,11 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
                  < cfg.col_sample_rate_per_tree)
     tree_cols = jnp.where(jnp.any(tree_cols), tree_cols, True)
 
-    use_pallas = cfg.use_pallas
-    if use_pallas is None:
-        from ...ops.histogram import use_pallas_default
-
-        use_pallas = use_pallas_default()
     for level in range(cfg.max_depth):
         n_lv = 2 ** level
         offset = n_lv - 1
         hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B,
-                                 cfg.block_rows, use_pallas)
+                                 cfg.block_rows, groups=cfg.hist_groups)
 
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
                                 cfg, tree_cols, level)
