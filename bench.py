@@ -20,9 +20,15 @@ full-length chunked train in a process measured ~4 s slower than every
 later one (allocator/tunnel warm-up — the reference bands are warm-JVM
 numbers, but the cold number is on the record).
 
+Each workload's record is ALSO appended to a JSONL sidecar
+(`BENCH_partial.jsonl`, H2O_TPU_BENCH_SIDECAR overrides) the moment it
+completes, so a crash/OOM mid-run leaves every finished workload's numbers
+on disk.
+
 Env overrides: H2O_TPU_BENCH_ROWS, H2O_TPU_BENCH_TREES,
-H2O_TPU_BENCH_SORT_ROWS, H2O_TPU_BENCH_WORKLOADS (comma list, default all),
-H2O_TPU_BENCH_SKIP_CADENCE=1.
+H2O_TPU_BENCH_SORT_ROWS, H2O_TPU_BENCH_AIRLINES_ROWS,
+H2O_TPU_BENCH_WORKLOADS (comma list, default all),
+H2O_TPU_BENCH_SKIP_CADENCE=1, H2O_TPU_BENCH_SIDECAR.
 """
 
 from __future__ import annotations
@@ -360,6 +366,34 @@ class _CompileCounter:
             lg.addHandler(H())
 
 
+def _sidecar_path() -> str:
+    """Per-workload crash-proof record file (H2O_TPU_BENCH_SIDECAR
+    overrides): one JSON line per completed workload, flushed+fsynced the
+    moment it finishes, so an OOM in the LAST workload can never erase the
+    earlier ones' numbers (the round-5 BENCH crash). The file is
+    APPEND-ONLY — each run opens with a ``bench_run`` header line, so a
+    retry after a crash delimits a new run instead of wiping the crashed
+    run's surviving records. The final stdout summary line is unchanged
+    when every workload survives."""
+    return os.environ.get("H2O_TPU_BENCH_SIDECAR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.jsonl")
+
+
+def _sidecar_start(header: dict) -> None:
+    with open(_sidecar_path(), "a") as f:
+        f.write(json.dumps({"bench_run": header}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _emit_workload(workloads: dict, name: str, rec: dict) -> None:
+    workloads[name] = rec
+    with open(_sidecar_path(), "a") as f:
+        f.write(json.dumps({"workload": name, "record": rec}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def main():
     nrow = int(os.environ.get("H2O_TPU_BENCH_ROWS", 11_000_000))
     ntrees = int(os.environ.get("H2O_TPU_BENCH_TREES", 100))
@@ -374,6 +408,9 @@ def main():
 
     _enable_compile_cache()
     compiles = _CompileCounter()
+    _sidecar_start({"rows": nrow, "ntrees": ntrees, "sort_rows": sort_rows,
+                    "workloads": wanted,
+                    "backend": jax.default_backend()})
     workloads: dict = {}
     gbm = None
     h2d_s = None
@@ -398,26 +435,28 @@ def main():
         h2d_s = round(time.time() - t0, 3)
         if "gbm" in wanted:
             gbm = bench_gbm(fr, ntrees, skip_cadence)
-            workloads["gbm"] = gbm
+            _emit_workload(workloads, "gbm", gbm)
         if "glm" in wanted:
-            workloads["glm_irlsm"] = bench_glm(fr, "IRLSM", GLM_BAND)
+            _emit_workload(workloads, "glm_irlsm",
+                           bench_glm(fr, "IRLSM", GLM_BAND))
         if "cod" in wanted:
-            workloads["glm_cod"] = bench_glm(fr, "COORDINATE_DESCENT",
-                                             COD_BAND)
+            _emit_workload(workloads, "glm_cod",
+                           bench_glm(fr, "COORDINATE_DESCENT", COD_BAND))
         if "gam" in wanted:
-            workloads["gam_irlsm"] = bench_gam(fr)
+            _emit_workload(workloads, "gam_irlsm", bench_gam(fr))
         if "rulefit" in wanted:
-            workloads["rulefit"] = bench_rulefit(fr)
+            _emit_workload(workloads, "rulefit", bench_rulefit(fr))
         del fr
         gc.collect()
     if "sort" in wanted:
-        workloads["sort"] = bench_sort(sort_rows)
+        _emit_workload(workloads, "sort", bench_sort(sort_rows))
     if "merge" in wanted:
-        workloads["merge"] = bench_merge(sort_rows)
+        _emit_workload(workloads, "merge", bench_merge(sort_rows))
     if "airlines" in wanted:
         air_rows = int(os.environ.get("H2O_TPU_BENCH_AIRLINES_ROWS",
                                       116_000_000))
-        workloads["airlines116m"] = bench_airlines(air_rows, ntrees)
+        _emit_workload(workloads, "airlines116m",
+                       bench_airlines(air_rows, ntrees))
 
     t_once = gbm["score_once_s"] if gbm else None
     print(json.dumps({

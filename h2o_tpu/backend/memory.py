@@ -13,7 +13,14 @@ Budget resolution order:
 - ``jax.local_devices()[0].memory_stats()['bytes_limit']`` × 0.85 when the
   backend reports it (real TPUs do; the CPU test backend does not) — resolved
   once and cached,
+- on a TPU backend whose transport hides memory_stats: the chip's physical
+  HBM from a ``device_kind`` lookup table × 0.85 (``device_hbm_bytes``),
 - otherwise unlimited (the Cleaner only observes).
+
+``hbm_budget_bytes()`` exposes the same resolution (sans the TPU-only
+last-resort) to compute planners — the tree engine's histogram row blocks,
+the binning sketch's column blocks, and the frame rollup batcher all size
+their intermediates from it instead of hardcoded constants.
 
 Accounting is a running counter (track/spill/rehydrate/GC adjust it), not a
 per-call scan; spill files are removed on rehydrate, on overwrite, and by a
@@ -41,6 +48,55 @@ def hbm_stats() -> dict | None:
     except Exception:
         return None
     return dict(stats) if stats else None
+
+
+#: per-device HBM by device_kind substring (GiB), most specific first — the
+#: fallback when the transport hides memory_stats. v2/v3 devices are cores
+#: (8/16 GiB each); v4+ are chips.
+_KIND_HBM_GIB = (("v6 lite", 32), ("v6e", 32), ("v5 lite", 16), ("v5e", 16),
+                 ("v5p", 95), ("v5", 95), ("v4", 32), ("v3", 16), ("v2", 8))
+
+_HW_BYTES = _UNRESOLVED  # cached device_hbm_bytes result
+
+
+def device_hbm_bytes() -> int | None:
+    """Physical per-device HBM: ``memory_stats()['bytes_limit']`` when the
+    backend reports it, else a ``device_kind`` table lookup (remote device
+    tunnels hide memory_stats but still name the chip), else None (CPU and
+    unknown accelerators)."""
+    global _HW_BYTES
+    if _HW_BYTES is not _UNRESOLVED:
+        return _HW_BYTES
+    stats = hbm_stats()
+    if stats and stats.get("bytes_limit"):
+        _HW_BYTES = int(stats["bytes_limit"])
+        return _HW_BYTES
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        kind = ""
+    _HW_BYTES = next((gib << 30 for tag, gib in _KIND_HBM_GIB if tag in kind),
+                     None)
+    return _HW_BYTES
+
+
+def hbm_budget_bytes() -> int | None:
+    """LIVE HBM planning budget for compute intermediates: 85% of physical
+    (the Cleaner's headroom) minus the bytes the Cleaner currently tracks as
+    device-resident, floored at 1/16 of physical so planners always get a
+    workable (if small) budget under pressure. ``H2O_TPU_HBM_LIMIT_BYTES``
+    pins the value EXACTLY (no residency adjustment — tests mock budgets
+    with it); None when no accelerator budget is resolvable (planners fall
+    back to their own conservative defaults)."""
+    env = os.environ.get("H2O_TPU_HBM_LIMIT_BYTES")
+    if env:
+        return int(env)
+    hw = device_hbm_bytes()
+    if not hw:
+        return None
+    return max(int(hw * 0.85) - CLEANER.tracked_bytes(), hw >> 4)
 
 
 def _vec_nbytes(arr) -> int:
@@ -80,10 +136,12 @@ class Cleaner:
 
                 if jax.default_backend() == "tpu":
                     # some transports (remote device tunnels) hide
-                    # memory_stats; arm the Cleaner with the smallest
-                    # current-generation chip budget (v5e: 16 GiB) rather
-                    # than running unbounded — env overrides for bigger HBM
-                    limit = int(16 * (1 << 30) * 0.85)
+                    # memory_stats; derive the budget from the chip's
+                    # device_kind (a v5p must not spill at a v5e budget),
+                    # keeping the smallest current-generation chip (v5e:
+                    # 16 GiB) only as the last resort for unknown kinds
+                    hw = device_hbm_bytes() or 16 * (1 << 30)
+                    limit = int(hw * 0.85)
             self._stats_limit = limit
         return self._stats_limit
 

@@ -170,10 +170,22 @@ _SAFE_BUILTINS = frozenset({
 class _ModelUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
         root = module.split(".", 1)[0]
-        # optax: optimizer-state NamedTuples ride DL checkpoints (ADADELTA
-        # accumulators) — plain containers, no reduce-time code execution
-        if root in ("h2o_tpu", "numpy", "collections", "datetime", "optax"):
+        if root in ("h2o_tpu", "numpy", "collections", "datetime"):
             return super().find_class(module, name)
+        if root == "optax":
+            # optimizer-state NamedTuples ride DL checkpoints (ADADELTA
+            # accumulators) — plain tuple containers whose construction
+            # executes no code. Everything else in optax (transform
+            # factories, partials, tree utilities) is callable machinery a
+            # crafted REDUCE could invoke, so the resolved object must BE a
+            # NamedTuple class, not merely live in the package.
+            cls = super().find_class(module, name)
+            if (isinstance(cls, type) and issubclass(cls, tuple)
+                    and hasattr(cls, "_fields")):
+                return cls
+            raise pickle.UnpicklingError(
+                f"model file references optax object {module}.{name}, which "
+                "is not an optimizer-state NamedTuple — refusing to load")
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
         raise pickle.UnpicklingError(

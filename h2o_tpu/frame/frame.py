@@ -203,11 +203,18 @@ class Frame(Keyed):
         todo = [v for v in todo if v._rollups is None and v.data is not None]
         if len(todo) <= 1:
             return
+        from ..backend.memory import hbm_budget_bytes
+
+        # the (plen, C) stack is a fresh device copy: cap it at 1/8 of the
+        # live HBM budget (f32 cells), keeping the historical 2^28-cell
+        # (1 GiB) block when no accelerator budget is resolvable
+        budget = hbm_budget_bytes()
+        cell_cap = (budget // 32) if budget else (1 << 28)
         by_plen: dict[int, list] = {}
         for v in todo:
             by_plen.setdefault(v.plen, []).append(v)
         for plen, group in by_plen.items():
-            block = max(1, (1 << 28) // max(plen, 1))
+            block = max(1, cell_cap // max(plen, 1))
             for s0 in range(0, len(group), block):
                 sub = group[s0:s0 + block]
                 import jax

@@ -137,12 +137,10 @@ def _gam_basis_dev(x, spec):
 def _device_quantiles(col_data, qs) -> np.ndarray:
     """Per-column quantiles via the binning sketch — only (nq,) floats cross
     to the host (np.quantile pulled the whole column)."""
-    from .tree.binning import _hist_quantile_rows, _pow2_block
+    from .tree.binning import hist_quantile_sketch
 
-    X = col_data[:, None]
-    rb = _pow2_block(X.shape[0], 1024)
-    return np.asarray(_hist_quantile_rows(X, tuple(float(q) for q in qs),
-                                          rb=rb))[:, 0]
+    return hist_quantile_sketch(col_data[:, None],
+                                tuple(float(q) for q in qs))[:, 0]
 
 
 # ---------------------------------------------------------------------------

@@ -27,13 +27,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..backend.jobs import Job
+from ..backend.memory import hbm_budget_bytes
 from ..frame.frame import Frame
 from ..frame.vec import T_CAT, Vec
 from ..parallel.mesh import default_mesh, replicated
 from .distributions import Bernoulli, Gaussian, get_distribution
 from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
 from .tree.binning import bin_matrix, compute_bin_edges
-from .tree.engine import TreeConfig, make_train_fn, predict_forest
+from .tree.engine import (TreeConfig, make_train_fn, plan_hist_groups,
+                          predict_forest)
 
 
 @dataclass
@@ -448,29 +450,16 @@ class GBM(ModelBuilder):
         nedges_np = (~np.isnan(edges_np)).sum(axis=1).astype(np.int32)
         iscat_dev = jax.device_put(is_cat, replicated(mesh))
         nedges_dev = jax.device_put(nedges_np, replicated(mesh))
-        # wide bin spaces (high-cardinality categoricals / exact binning)
-        # shrink the histogram row block so the per-block (rows, F, B)
-        # one-hot keeps a bounded footprint
+        # histogram accumulation plan: width-bucketed hist_groups (auto-tuned
+        # from the per-column bin counts) plus a row block fitted to the live
+        # HBM budget, so wide bin spaces (high-cardinality categoricals /
+        # exact binning) bound the per-block one-hot footprint by
+        # construction — see engine.plan_hist_groups
         B_hist = cfg.nbins + 1
-        # width-bucketed histogram groups: with mixed bin spaces (300-level
-        # airports next to 20-bin numerics) the flat accumulate pays
-        # F·B_max cells/row; bucketing by next-pow2 width pays Σ F_g·B_g.
-        # Engage only when that saves ≥ 40% of the cells.
-        widths = nedges_np + 2                  # data bins + NA slot
-        by_w: dict[int, list[int]] = {}
-        for f, wd in enumerate(widths):
-            p2 = 1 << int(np.ceil(np.log2(max(int(wd), 2))))
-            by_w.setdefault(min(p2, B_hist), []).append(f)
-        grouped_cells = sum(len(fs) * wd for wd, fs in by_w.items())
-        hist_groups = None
-        if len(by_w) > 1 and grouped_cells < 0.6 * len(widths) * B_hist:
-            hist_groups = tuple(sorted(
-                (tuple(fs), int(wd)) for wd, fs in by_w.items()))
-        eff_B = max(grouped_cells // max(len(widths), 1), 1) \
-            if hist_groups else B_hist
-        blk = cfg.block_rows
-        while blk > 512 and blk * eff_B > 8192 * 128:
-            blk //= 2
+        hist_groups, blk = plan_hist_groups(
+            nedges_np, B_hist, cfg.block_rows,
+            budget_bytes=hbm_budget_bytes(),
+            n_lv_max=2 ** max(cfg.max_depth - 1, 0), nvals=3)
         cfg = dataclasses.replace(cfg, use_sets=use_sets, block_rows=blk,
                                   hist_groups=hist_groups)
         if not self.drf_mode and K == 1 and dist.name in ("laplace",

@@ -853,10 +853,31 @@ def _apply_interactions(fr: Frame, specs: list, skip_existing: bool = False):
                     Vec.from_device(col, fr.nrow))
         else:  # catcat
             da, db = va.domain or [], vb.domain or []
-            # legacy specs (pre-fix exports) stored labels only
-            combos = s.get("combos") or [tuple(lab.rsplit("_", 1))
-                                         for lab in s["labels"]]
-            combo_idx = {c: i for i, c in enumerate(combos)}
+            combos = s.get("combos")
+            if combos is None:
+                # legacy specs (pre-fix exports) stored display labels only.
+                # Reconstruct each (level_a, level_b) pair by exact match
+                # against the domains — a blind rsplit("_", 1) mis-parses
+                # levels that themselves contain underscores ("New_York")
+                # and would silently score those combos as NA. Any label
+                # that does not match exactly one pair fails the load loudly.
+                # O(|labels|·|da|) prefix match — never materializes the
+                # |da|×|db| cross product (5k×5k domains would be ~25M keys)
+                db_set = set(db)
+                combos = []
+                for lab in s["labels"]:
+                    hits = [(la, lab[len(la) + 1:]) for la in da
+                            if lab.startswith(la + "_")
+                            and lab[len(la) + 1:] in db_set]
+                    if len(hits) != 1:
+                        raise ValueError(
+                            f"interaction '{s['a']}_{s['b']}': legacy level "
+                            f"label '{lab}' matches {len(hits)} "
+                            f"(level_a, level_b) pairs — cannot recover the "
+                            f"combo mapping; re-export the model with "
+                            f"'combos' in its interaction spec")
+                    combos.append(hits[0])
+            combo_idx = {tuple(c): i for i, c in enumerate(combos)}
             table = np.full(max(len(da), 1) * max(len(db), 1), np.nan,
                             np.float32)
             for i, la in enumerate(da):

@@ -24,6 +24,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_UNSET = object()  # "resolve the budget live" sentinel; an explicit None
+                   # means "no accelerator budget" and plans at the
+                   # conservative _DEFAULT_SKETCH_BUDGET, not unbounded
+
 
 def _pow2_block(R: int, want: int) -> int:
     """Largest power-of-two divisor of R up to `want` (>= 1 always)."""
@@ -31,6 +35,36 @@ def _pow2_block(R: int, want: int) -> int:
     while b * 2 <= want and R % (b * 2) == 0:
         b *= 2
     return b
+
+
+#: planning fallback when no accelerator budget is resolvable (CPU dev
+#: boxes): size the sketch as if on a small chip so the code path that ships
+#: is the code path that is tested
+_DEFAULT_SKETCH_BUDGET = 4 << 30
+
+
+def _sketch_plan(R: int, F: int, nb: int,
+                 budget_bytes: int | None) -> tuple[int, int]:
+    """Pick (rb, Fb) — row-block and feature-block sizes — for the quantile
+    sketch from a live HBM budget, so the sketch scales to any (R, F) by
+    construction.
+
+    Peak f32 footprint the sketch ADDS on top of the caller's (R, F) matrix:
+    the (R, Fb) column block it slices out (≤ budget/4), the per-scan-step
+    (rb, Fb, nb) one-hot (≤ budget/8), and the (F, nb)-sized accumulators /
+    quantile read-out (noise). At the airlines-116M×31 shape under a v5e
+    budget this yields Fb≈7, rb=1024 — ~3.3 GB of intermediates where the
+    unblocked sketch wanted the full 14 GB matrix reshaped at once."""
+    budget = budget_bytes or _DEFAULT_SKETCH_BUDGET
+    col_cap = max(budget // 4, 1 << 20)
+    onehot_cap = max(budget // 8, 1 << 20)
+    Fb = int(min(F, max(1, col_cap // (4 * max(R, 1)))))
+    rb = 1024
+    while rb > 64 and rb * Fb * nb * 4 > onehot_cap:
+        rb //= 2
+    while Fb > 1 and rb * Fb * nb * 4 > onehot_cap:
+        Fb = max(1, Fb // 2)
+    return rb, Fb
 
 
 @functools.partial(jax.jit, static_argnames=("qs", "nb", "rb"))
@@ -46,9 +80,19 @@ def _hist_quantile_rows(X, qs, nb: int = 1024, rb: int = 1024):
     bracket (outliers clip into edge bins but keep their cumulative mass,
     the `_leaf_quantile_vals` trick), so each quantile is read at
     (robust span)/nb resolution — far finer than the 20-bin edges it feeds.
+
+    Row counts that don't divide ``rb`` are NaN-padded up to the next block
+    boundary (NaN rows drop out of every count), so ``rb`` is a free memory
+    knob, not a divisibility constraint. Callers stream column blocks
+    through this via `hist_quantile_sketch`, which also donates each block's
+    buffer so XLA reuses it for the scan intermediates.
     """
     R, F = X.shape
-    nblk = R // rb
+    pad = (-R) % rb
+    if pad:
+        X = jnp.concatenate(
+            [X, jnp.full((pad, F), jnp.nan, X.dtype)], axis=0)
+    nblk = (R + pad) // rb
     ok = ~jnp.isnan(X)
     nval = jnp.sum(ok, axis=0).astype(jnp.float32)
     cmin = jnp.nanmin(X, axis=0)
@@ -98,6 +142,41 @@ def _hist_quantile_rows(X, qs, nb: int = 1024, rb: int = 1024):
     out = (lo2[None, :] + (bidx.astype(jnp.float32) + frac)
            * span2[None, :] / nb)
     return jnp.where(nval[None, :] > 0, out, jnp.nan)
+
+
+#: donated-buffer variant for streamed column blocks: the (R, Fb) slice is a
+#: sketch-owned temporary, so its HBM is handed to XLA for reuse (accelerator
+#: backends only — CPU jax has no donation and would warn on every call)
+_hist_quantile_rows_donated = functools.partial(
+    jax.jit, static_argnames=("qs", "nb", "rb"), donate_argnums=0)(
+        _hist_quantile_rows.__wrapped__)
+
+
+def hist_quantile_sketch(X, qs, nb: int = 1024,
+                         budget_bytes=_UNSET) -> np.ndarray:
+    """Memory-bounded streaming driver for `_hist_quantile_rows`: columns go
+    through the two-pass sketch in blocks of Fb, with (rb, Fb) planned from
+    the live HBM budget (`_sketch_plan`), so the per-step (rb, Fb, nb)
+    one-hot and the (nblk, rb, Fb) reshape never exceed memory at any
+    (R, F) — 116M×31 included. Each column's quantiles depend only on that
+    column, so blocking is exact, not an approximation. Returns the host
+    (nq, F) array (the only thing that crosses back)."""
+    if budget_bytes is _UNSET:
+        from ...backend.memory import hbm_budget_bytes
+
+        budget_bytes = hbm_budget_bytes()
+    R, F = X.shape
+    rb, Fb = _sketch_plan(R, F, nb, budget_bytes)
+    if Fb >= F:
+        # caller's matrix — never donated
+        return np.asarray(_hist_quantile_rows(X, qs, nb=nb, rb=rb))
+    donate = jax.default_backend() in ("tpu", "gpu")
+    core = _hist_quantile_rows_donated if donate else _hist_quantile_rows
+    out = np.empty((len(qs), F), np.float32)
+    for f0 in range(0, F, Fb):
+        blk = jnp.asarray(X[:, f0:f0 + Fb])  # fresh (R, Fb) buffer
+        out[:, f0:f0 + Fb] = np.asarray(core(blk, qs, nb=nb, rb=rb))
+    return out
 
 
 @jax.jit
@@ -185,8 +264,7 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
     col_min, col_max = (np.asarray(v) for v in _col_minmax(Xj))
     qrows = None
     if ht in ("auto", "quantilesglobal", "exact"):
-        rb = _pow2_block(R, 1024)
-        qrows = np.asarray(_hist_quantile_rows(Xj, tuple(qs), rb=rb))
+        qrows = hist_quantile_sketch(Xj, tuple(qs))
     all_cuts: list = []
     for f in range(F):
         if not np.isfinite(col_max[f]):  # all-NaN column

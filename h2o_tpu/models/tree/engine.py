@@ -37,10 +37,9 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ...parallel.mesh import ROWS, default_mesh
+from ...parallel.mesh import ROWS, default_mesh, shard_map
 
 
 @dataclass(frozen=True)
@@ -61,9 +60,10 @@ class TreeConfig:
     nclass: int = 1              # trees per iteration (multinomial K)
     block_rows: int = 8192       # row-block size for the histogram scan
     hist_groups: tuple | None = None  # width-bucketed feature partition
-                                 # ((idx_tuple, width), ...) for mixed
+                                 # ((idx_tuple, width, mode), ...) for mixed
                                  # narrow/wide bin spaces (see
-                                 # _build_level_hist); None = flat
+                                 # _build_level_hist / plan_hist_groups);
+                                 # None = flat
     use_monotone: bool = False   # monotone_constraints active (static flag;
                                  # the per-feature directions ride as an array)
     use_interaction: bool = False  # interaction_constraints active (the
@@ -130,6 +130,64 @@ def _onehot_pick(oh: jax.Array, v: jax.Array) -> jax.Array:
             + jnp.dot(oh, lo, preferred_element_type=jnp.float32))
 
 
+def _norm_groups(groups):
+    """Normalize hist_groups entries to (idxs, width, mode): legacy 2-tuples
+    (pre-mode persisted models) accumulate via the one-hot matmul."""
+    return tuple((g[0], g[1], g[2] if len(g) > 2 else "onehot")
+                 for g in groups)
+
+
+#: widths at/below this accumulate via segment-sum (env override
+#: H2O_TPU_HIST_SEG_WIDTH; 0 disables the path) — see the narrow-bin branch
+#: in _build_level_hist
+_SEG_WIDTH_DEFAULT = 8
+
+
+def plan_hist_groups(nedges, B_hist: int, block_rows: int,
+                     budget_bytes: int | None = None,
+                     n_lv_max: int = 32, nvals: int = 3):
+    """Auto-tuned histogram accumulation plan: (hist_groups | None, block).
+
+    ``nedges`` (F,) per-column real-cut counts. Group width thresholds come
+    from the per-column bin counts themselves: each column buckets at the
+    next power of two above its width (data bins + NA slot + 1 for the
+    cut<=bin offset), capped at the flat ``B_hist``. With mixed bin spaces
+    (airlines-style 300-level categoricals next to 20-bin numerics) the flat
+    (rb, F, B) one-hot pads EVERY feature to B_hist cells/row; grouped, each
+    bucket pays only its own width. Grouping engages when it saves ≥ 40% of
+    the accumulated cells (below that the extra scan bodies and scatter-back
+    cost more than the padding — measured crossover). Buckets at/below the
+    segment-sum width threshold accumulate via scatter-add instead of a
+    degenerate-shape one-hot matmul.
+
+    ``block`` is the histogram row-block size fitted to the HBM budget: the
+    per-scan-step one-hot footprint rb·(Σ F_g·B_g)·4 B plus the rb·n_lv·V
+    channel outer product stays under budget/12 (defaults to a 4 GiB
+    planning budget when no accelerator budget is resolvable)."""
+    import os
+
+    widths = np.asarray(nedges, np.int64) + 2  # data bins + NA slot
+    F = int(widths.shape[0])
+    by_w: dict[int, list[int]] = {}
+    for f, wd in enumerate(widths):
+        p2 = 1 << int(np.ceil(np.log2(max(int(wd), 2))))
+        by_w.setdefault(min(p2, B_hist), []).append(f)
+    grouped_cells = sum(len(fs) * wd for wd, fs in by_w.items())
+    seg_w = int(os.environ.get("H2O_TPU_HIST_SEG_WIDTH", _SEG_WIDTH_DEFAULT))
+    groups = None
+    if len(by_w) > 1 and grouped_cells < 0.6 * F * B_hist:
+        groups = tuple(sorted(
+            (tuple(fs), int(wd), "segsum" if wd <= seg_w else "onehot")
+            for wd, fs in by_w.items()))
+    cells_per_row = grouped_cells if groups else F * B_hist
+    budget = budget_bytes or (4 << 30)
+    step_cap = max(budget // 12, 1 << 20)
+    blk = block_rows
+    while blk > 512 and blk * (cells_per_row + n_lv_max * nvals) * 4 > step_cap:
+        blk //= 2
+    return groups, blk
+
+
 # ---------------------------------------------------------------------------
 # Histogram build (the ScoreBuildHistogram2 analog) — runs inside shard_map.
 # ---------------------------------------------------------------------------
@@ -142,14 +200,17 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
     already zeroed for inactive rows.
 
     ``groups`` (static): width-bucketed feature partition
-    ``((feature_idx_tuple, group_width), ...)`` — with mixed bin widths
-    (airlines-style 300-level categoricals next to 20-bin numerics) the flat
-    (rb, F, B) one-hot pads EVERY feature to the widest feature's bins, so
-    the accumulate burns F·B_max cells/row; grouped, each bucket pays only
-    its own width (Σ F_g·B_g) and the per-group histograms scatter back into
-    the global (F, n_lv, B, V) layout once per level. Split finding is
-    untouched. The group NA bucket is its last slot; global NA stays at
-    ``nbins_tot - 1``.
+    ``((feature_idx_tuple, group_width, mode), ...)`` (legacy 2-tuples mean
+    mode="onehot") — with mixed bin widths (airlines-style 300-level
+    categoricals next to 20-bin numerics) the flat (rb, F, B) one-hot pads
+    EVERY feature to the widest feature's bins, so the accumulate burns
+    F·B_max cells/row; grouped, each bucket pays only its own width
+    (Σ F_g·B_g), each group's accumulator psums per group, and the
+    histograms scatter back into the global (F, n_lv, B, V) layout once per
+    level. mode="segsum" groups (narrow widths, degenerate MXU shapes)
+    accumulate via a flat segment-sum instead of the one-hot matmul. Split
+    finding is untouched. The group NA bucket is its last slot; global NA
+    stays at ``nbins_tot - 1``. `plan_hist_groups` builds the partition.
     """
     Rl, F = Xb.shape
     V = vals.shape[1]
@@ -179,28 +240,48 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
         return jax.lax.psum(hist, ROWS)
 
     na_global = nbins_tot - 1
+    groups = _norm_groups(groups)
 
     def body(accs, blk):
         xb, l, vv = blk
         n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)
-        a = jnp.einsum("rn,rv->rnv", n_oh, vv)
+        a = jnp.einsum("rn,rv->rnv", n_oh, vv)  # outer product — exact
         out = []
-        for (idxs, Bg), acc in zip(groups, accs):
+        for (idxs, Bg, mode), acc in zip(groups, accs):
+            Fg = len(idxs)
             xg = xb[:, list(idxs)]
             xg = jnp.where(xg == na_global, Bg - 1, xg)
-            b_oh = jax.nn.one_hot(xg, Bg, dtype=jnp.float32)
-            out.append(acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh))
+            if mode == "segsum":
+                # narrow-bin path: at Bg ≪ the 128-lane MXU tile the one-hot
+                # matmul is degenerate (mostly-padding tiles); a flat
+                # segment-sum over (feature, node, bin) keys accumulates the
+                # same cells with no one-hot at all (and in pure f32 adds —
+                # the matmul path rounds each contribution through bf16 on
+                # TPU, so this path is the *more* exact of the two)
+                seg = ((jnp.arange(Fg, dtype=jnp.int32)[None, :] * n_lv
+                        + l[:, None]) * Bg + xg)             # (rb, Fg)
+                data = jnp.broadcast_to(vv[:, None, :], (xg.shape[0], Fg, V))
+                h = jax.ops.segment_sum(
+                    data.reshape(-1, V), seg.reshape(-1),
+                    num_segments=Fg * n_lv * Bg)
+                out.append(acc + h.reshape(Fg, n_lv, Bg, V))
+            else:
+                b_oh = jax.nn.one_hot(xg, Bg, dtype=jnp.float32)
+                out.append(acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh))
         return tuple(out), None
 
     init = tuple(jnp.zeros((len(idxs), n_lv, Bg, V), jnp.float32)
-                 for idxs, Bg in groups)
+                 for idxs, Bg, _mode in groups)
     hists, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
+    # psum per group BEFORE the scatter-back: the wire carries Σ F_g·B_g
+    # cells instead of the padded F·B_max the flat path reduces
     full = jnp.zeros((F, n_lv, nbins_tot, V), jnp.float32)
-    for (idxs, Bg), hg in zip(groups, hists):
+    for (idxs, Bg, _mode), hg in zip(groups, hists):
+        hg = jax.lax.psum(hg, ROWS)
         ia = jnp.asarray(idxs)
         full = full.at[ia, :, :Bg - 1, :].set(hg[:, :, :Bg - 1, :])
         full = full.at[ia, :, na_global, :].set(hg[:, :, Bg - 1, :])
-    return jax.lax.psum(full, ROWS)
+    return full
 
 
 def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):

@@ -30,13 +30,12 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..backend.jobs import Job
 from ..frame.frame import Frame
 from ..frame.vec import Vec
-from ..parallel.mesh import ROWS, default_mesh, replicated
+from ..parallel.mesh import ROWS, default_mesh, replicated, shard_map
 from .drf import DRFParameters
 from .metrics import ModelMetrics
 from .model_base import Model, ModelBuilder, ModelOutput

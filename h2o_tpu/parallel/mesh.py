@@ -24,6 +24,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6: top-level export, replication check spelled check_vma
+    from jax import shard_map as _shard_map
+    _REP_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, spelled check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _REP_KW = "check_rep"
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=True):
+    """Version-portable ``shard_map`` — the single import point for the repo.
+
+    Callers use the modern (jax >= 0.6) spelling; on older jax the call is
+    forwarded to ``jax.experimental.shard_map`` with ``check_vma`` mapped to
+    its earlier name ``check_rep``."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_REP_KW: check_vma})
+
+
 ROWS = "rows"
 COLS = "cols"
 

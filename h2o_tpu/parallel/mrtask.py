@@ -29,10 +29,9 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import ROWS, default_mesh, row_sharding
+from .mesh import ROWS, default_mesh, row_sharding, shard_map
 
 _REDUCERS = {
     "sum": jax.lax.psum,
@@ -104,7 +103,10 @@ def _build_driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
         return {k: jax.tree.map(lambda x: _REDUCERS[reduce[k]](x, ROWS), v)
                 for k, v in out.items()}
 
-    in_specs = tuple(P(ROWS) + P(*([None] * (len(shape) - 1)))
+    # build each spec in ONE constructor call: on jax 0.4.x PartitionSpec is
+    # a tuple subclass whose __add__ returns a plain tuple, which shard_map
+    # rejects
+    in_specs = tuple(P(ROWS, *([None] * (len(shape) - 1)))
                      for shape, _ in avt)
     out_specs = P(ROWS) if out_rows else P()
     return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,

@@ -233,7 +233,20 @@ def _merge_device(left: Frame, right: Frame, key: str, all_x: bool) -> Frame:
     r_cols_s, lo, counts, cum = _merge_ranges(lk, rk, r_payload, all_x)
     total = int(cum[-1])  # the one host sync
     l_cols = tuple(left.vec(n).data[:ln] for n in left.names)
-    out_l, out_r = _merge_expand(l_cols, r_cols_s, lo, counts, cum, total)
+    # Phase 2 runs REPLICATED: its Δ-scatter + cumsum fills are exact only
+    # over the whole array, and the jax-0.4.x GSPMD partitioner computes
+    # them per-shard on row-sharded operands (outputs diverge at the first
+    # shard boundary — caught by __graft_entry__'s multichip dry run). A
+    # no-op on single-device meshes; multi-chip merges trade replicated
+    # HBM for correctness until the partition-aware fill lands.
+    from ..parallel.mesh import default_mesh, replicated
+
+    rep = replicated(default_mesh())
+    put = lambda t: tuple(jax.device_put(c, rep) for c in t)
+    out_l, out_r = _merge_expand(put(l_cols), put(r_cols_s),
+                                 jax.device_put(lo, rep),
+                                 jax.device_put(counts, rep),
+                                 jax.device_put(cum, rep), total)
 
     names, vecs = [], []
     for n, col in zip(left.names, out_l):

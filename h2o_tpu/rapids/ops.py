@@ -297,8 +297,10 @@ def cumulative(op: str, v: Vec) -> Vec:
               "cummax": np.maximum.accumulate}[op]
         out = hf(x)  # NaN poisons every later prefix naturally
         return Vec.from_numpy(out)
+    # lax.cummin/cummax rather than jnp.minimum.accumulate: the ufunc
+    # .accumulate methods only exist on jax >= 0.6
     fns = {"cumsum": jnp.cumsum, "cumprod": jnp.cumprod,
-           "cummin": jnp.minimum.accumulate, "cummax": jnp.maximum.accumulate}
+           "cummin": jax.lax.cummin, "cummax": jax.lax.cummax}
     neutral = {"cumsum": 0.0, "cumprod": 1.0, "cummin": jnp.inf,
                "cummax": -jnp.inf}[op]
     ok = _valid(v) & _mask(v)

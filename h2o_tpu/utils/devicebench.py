@@ -62,7 +62,8 @@ def collective_bandwidth_gbs(mb: int = 64) -> dict:
         return jax.lax.psum(x, axis)
 
     fn = jax.jit(
-        jax.shard_map(allreduce, mesh=mesh, in_specs=P(axis), out_specs=P()))
+        meshmod.shard_map(allreduce, mesh=mesh, in_specs=P(axis),
+                          out_specs=P()))
     x = jnp.ones((max(n // max(ndev, 1), 1) * ndev,), jnp.float32)
     sec = _timeit(lambda: fn(x))
     # ring all-reduce moves 2(k-1)/k of the payload per device

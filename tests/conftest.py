@@ -102,6 +102,8 @@ _CORE_MODULES = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "core: fast representative tier (pytest -m core, <10 min)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (pytest -m 'not slow')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -19,12 +19,24 @@ _RTOL = {"auc": 0.02, "accuracy": 0.02, "r2": 0.02,
          "rmse": 0.08, "logloss": 0.08, "tot_withinss": 0.05}
 
 
+def _expected_value(exp: dict) -> float:
+    """Pick the pin for the running jax: DL's SGD trajectory (dropout/RNG
+    partitioning) shifted between jax 0.4.x and >= 0.6, so version-skewed
+    cases carry a 'value_jax04' alongside the original calibration."""
+    import jax
+
+    if jax.__version__.startswith("0.4.") and "value_jax04" in exp:
+        return exp["value_jax04"]
+    return exp["value"]
+
+
 @pytest.mark.parametrize("case", CASES)
 def test_accuracy_band(case):
     metric, value = run_case(case)
     exp = _EXPECT[case]
     assert metric == exp["metric"]
-    tol = _RTOL[metric] * max(abs(exp["value"]), 1e-6)
-    assert abs(value - exp["value"]) <= tol, (
+    expected = _expected_value(exp)
+    tol = _RTOL[metric] * max(abs(expected), 1e-6)
+    assert abs(value - expected) <= tol, (
         f"{case}: {metric}={value:.6f} drifted from expected "
-        f"{exp['value']:.6f} (±{tol:.6f})")
+        f"{expected:.6f} (±{tol:.6f})")

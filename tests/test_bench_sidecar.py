@@ -1,0 +1,82 @@
+"""bench.py crash-proof per-workload records: every workload's JSON line is
+flushed to the sidecar the moment it completes, so a mid-run crash (the
+round-5 airlines OOM that erased BENCH_r05.json's perf record) leaves the
+earlier workloads' numbers on disk."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+def _load_bench():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+    spec = importlib.util.spec_from_file_location("h2o_tpu_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_sidecar(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_mid_run_crash_keeps_earlier_records(tmp_path, monkeypatch, capsys):
+    bench = _load_bench()
+    sidecar = tmp_path / "partial.jsonl"
+    monkeypatch.setenv("H2O_TPU_BENCH_SIDECAR", str(sidecar))
+    monkeypatch.setenv("H2O_TPU_BENCH_WORKLOADS", "sort,merge")
+    monkeypatch.setattr(bench, "_enable_compile_cache", lambda: None)
+    monkeypatch.setattr(bench, "bench_sort",
+                        lambda nrow: {"wall_s": 0.1, "rows": nrow})
+    monkeypatch.setattr(
+        bench, "bench_merge",
+        lambda nrow, nkeys=1_000_000: (_ for _ in ()).throw(
+            MemoryError("simulated mid-run OOM")))
+    with pytest.raises(MemoryError):
+        bench.main()
+    lines = _read_sidecar(sidecar)
+    assert "bench_run" in lines[0]
+    assert lines[1]["workload"] == "sort"
+    assert lines[1]["record"]["wall_s"] == 0.1
+    assert len(lines) == 2  # merge crashed before emitting
+    # nothing reached stdout: the one-line driver contract is all-or-nothing
+    assert "metric" not in capsys.readouterr().out
+
+
+def test_full_run_emits_sidecar_and_summary(tmp_path, monkeypatch, capsys):
+    bench = _load_bench()
+    sidecar = tmp_path / "partial.jsonl"
+    monkeypatch.setenv("H2O_TPU_BENCH_SIDECAR", str(sidecar))
+    monkeypatch.setenv("H2O_TPU_BENCH_WORKLOADS", "sort,merge")
+    monkeypatch.setattr(bench, "_enable_compile_cache", lambda: None)
+    monkeypatch.setattr(bench, "bench_sort",
+                        lambda nrow: {"wall_s": 0.1, "rows": nrow})
+    monkeypatch.setattr(bench, "bench_merge",
+                        lambda nrow, nkeys=1_000_000: {"wall_s": 0.2})
+    bench.main()
+    lines = _read_sidecar(sidecar)
+    assert [ln.get("workload") for ln in lines[1:]] == ["sort", "merge"]
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["detail"]["workloads"]["sort"]["wall_s"] == 0.1
+    assert out["detail"]["workloads"]["merge"]["wall_s"] == 0.2
+
+
+@pytest.mark.slow
+def test_airlines_workload_cpu_smoke(tmp_path, monkeypatch):
+    """The airlines leg end-to-end on CPU smoke rows — the leg that OOM'd in
+    round 5 must run to a recorded AUC without rc=1."""
+    bench = _load_bench()
+    sidecar = tmp_path / "partial.jsonl"
+    monkeypatch.setenv("H2O_TPU_BENCH_SIDECAR", str(sidecar))
+    monkeypatch.setenv("H2O_TPU_BENCH_WORKLOADS", "airlines")
+    monkeypatch.setenv("H2O_TPU_BENCH_AIRLINES_ROWS", "20000")
+    monkeypatch.setenv("H2O_TPU_BENCH_TREES", "3")
+    monkeypatch.setattr(bench, "_enable_compile_cache", lambda: None)
+    bench.main()
+    lines = _read_sidecar(sidecar)
+    rec = next(ln["record"] for ln in lines if ln.get("workload") == "airlines116m")
+    assert rec["rows"] == 20000
+    assert 0.5 < rec["train_auc"] <= 1.0

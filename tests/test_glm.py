@@ -606,3 +606,36 @@ def test_gam_coxph_interactions():
     # group u slope ≈ -1, group v ≈ +1 → gated delta ≈ +2
     assert co["g_x.v"] == pytest.approx(2.0, abs=0.4)
     assert cm.predict(cox_fr).nrow == n
+
+
+def test_glm_legacy_interaction_labels_underscore_safe():
+    """Legacy cat×cat specs stored display labels only. Reconstruction must
+    match labels against the real (level_a, level_b) domains — the old
+    rsplit('_', 1) guess mis-parsed levels containing underscores and
+    silently scored those combos as NA — and fail loudly on ambiguity."""
+    from h2o_tpu.frame.vec import T_CAT, Vec
+    from h2o_tpu.models.glm import _apply_interactions
+
+    fr = Frame.from_dict({"d": np.zeros(4, np.float32)})
+    fr.add("u", Vec.from_numpy(np.array([0, 0, 1, 1], np.float32),
+                               type=T_CAT, domain=["New_York", "LA"]))
+    fr.add("v", Vec.from_numpy(np.array([0, 1, 0, 1], np.float32),
+                               type=T_CAT, domain=["x", "Y_z"]))
+    legacy = {"kind": "catcat", "a": "u", "b": "v",
+              "labels": ["New_York_x", "New_York_Y_z", "LA_x", "LA_Y_z"]}
+    out, names = _apply_interactions(fr, [legacy])
+    assert names == ["u_v"]
+    codes = out.vec("u_v").to_numpy()
+    # rsplit('_', 1) would have parsed "LA_Y_z" as ("LA_Y", "z") — neither
+    # a level of u nor of v — and silently mapped those rows to NA
+    np.testing.assert_array_equal(codes, [0.0, 1.0, 2.0, 3.0])
+
+    fr2 = Frame.from_dict({"d": np.zeros(2, np.float32)})
+    fr2.add("u", Vec.from_numpy(np.array([0, 1], np.float32), type=T_CAT,
+                                domain=["New", "New_York"]))
+    fr2.add("v", Vec.from_numpy(np.array([0, 1], np.float32), type=T_CAT,
+                                domain=["York_b", "b"]))
+    ambiguous = {"kind": "catcat", "a": "u", "b": "v",
+                 "labels": ["New_York_b"]}
+    with pytest.raises(ValueError, match="matches 2"):
+        _apply_interactions(fr2, [ambiguous])

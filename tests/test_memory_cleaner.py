@@ -54,3 +54,80 @@ def test_touch_order_is_lru_not_creation_order(tight_budget):
     CLEANER.maybe_sweep()
     assert vecs[1]._data is None, "LRU must evict the coldest, not the oldest"
     assert vecs[0]._data is not None
+
+
+class _FakeDev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+@pytest.fixture()
+def _unresolved_hw(monkeypatch):
+    """Blind the memory_stats route and clear the cached hardware lookup so
+    each test resolves the device_kind table fresh."""
+    import jax
+
+    from h2o_tpu.backend import memory
+
+    monkeypatch.delenv("H2O_TPU_HBM_LIMIT_BYTES", raising=False)
+    monkeypatch.setattr(memory, "hbm_stats", lambda: None)
+    monkeypatch.setattr(memory, "_HW_BYTES", memory._UNRESOLVED)
+    # fresh Cleaner: hbm_budget_bytes subtracts tracked resident bytes, and
+    # vecs from other tests must not bleed into the budget assertions
+    monkeypatch.setattr(memory, "CLEANER", memory.Cleaner())
+    yield memory, monkeypatch, jax
+
+
+@pytest.mark.parametrize("kind,gib", [
+    ("TPU v5p", 95), ("TPU v5 lite", 16), ("TPU v6 lite", 32),
+    ("TPU v4", 32), ("TPU v3", 16)])
+def test_device_kind_hbm_table(_unresolved_hw, kind, gib):
+    memory, monkeypatch, jax = _unresolved_hw
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev(kind)])
+    assert memory.device_hbm_bytes() == gib << 30
+    assert memory.hbm_budget_bytes() == int((gib << 30) * 0.85)
+
+
+def test_cleaner_budget_derives_from_device_kind(_unresolved_hw):
+    """A v5p-class chip must not spill at the old hardcoded v5e budget when
+    the transport hides memory_stats (ADVICE r5)."""
+    memory, monkeypatch, jax = _unresolved_hw
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev("TPU v5p")])
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    c = memory.Cleaner()
+    assert c.limit_bytes() == int((95 << 30) * 0.85)
+
+
+def test_cleaner_budget_unknown_tpu_kind_keeps_16gib_last_resort(
+        _unresolved_hw):
+    memory, monkeypatch, jax = _unresolved_hw
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev("TPU v99")])
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    c = memory.Cleaner()
+    assert c.limit_bytes() == int(16 * (1 << 30) * 0.85)
+
+
+def test_hbm_budget_env_pin_and_cpu_none(_unresolved_hw):
+    memory, monkeypatch, jax = _unresolved_hw
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev("cpu")])
+    assert memory.hbm_budget_bytes() is None  # planners fall back
+    monkeypatch.setenv("H2O_TPU_HBM_LIMIT_BYTES", "123456")
+    assert memory.hbm_budget_bytes() == 123456
+
+
+def test_hbm_budget_is_live_minus_resident(_unresolved_hw):
+    """Planners must see physical headroom MINUS what already sits in HBM —
+    a 14 GB resident frame on a v5e leaves ~nothing for intermediates."""
+    memory, monkeypatch, jax = _unresolved_hw
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev("TPU v5 lite")])
+
+    class _Obj:  # weakref-able stand-in for a device-resident Vec
+        pass
+
+    full = int((16 << 30) * 0.85)
+    assert memory.hbm_budget_bytes() == full
+    v = _Obj()
+    memory.CLEANER.track(v, 4 << 30)
+    assert memory.hbm_budget_bytes() == full - (4 << 30)
+    memory.CLEANER.track(v, 20 << 30)  # over-committed: floor at 1/16 HBM
+    assert memory.hbm_budget_bytes() == (16 << 30) >> 4

@@ -228,3 +228,22 @@ def test_load_model_in_fresh_process(conn, tmp_path):
             if ln.startswith("PREDS::")][0]
     got = np.asarray(json.loads(line[len("PREDS::"):]))
     np.testing.assert_allclose(got, preds, rtol=1e-5, atol=1e-7)
+
+
+def test_model_unpickler_optax_namedtuples_only():
+    """The optax allowlist admits optimizer-state NamedTuples (what DL
+    checkpoints actually carry) and nothing else from the package — a
+    REDUCE resolving an optax callable is a code-execution gadget."""
+    import io
+    import pickle
+
+    import optax
+    from h2o_tpu.backend.persist import _ModelUnpickler
+
+    state = optax.ScaleByAdamState(count=np.int32(3), mu=None, nu=None)
+    out = _ModelUnpickler(io.BytesIO(pickle.dumps(state))).load()
+    assert out == state
+
+    for gadget in (optax.adam, optax.apply_updates):
+        with pytest.raises(pickle.UnpicklingError, match="optax"):
+            _ModelUnpickler(io.BytesIO(pickle.dumps(gadget))).load()
