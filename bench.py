@@ -25,10 +25,15 @@ Each workload's record is ALSO appended to a JSONL sidecar
 completes, so a crash/OOM mid-run leaves every finished workload's numbers
 on disk.
 
+The ``binned_store`` leg trains the same airlines-width GBM from the f32
+stacked matrix and from the chunk store's int8/int16 binned view
+(`frame/chunks.py`) and records the peak training-matrix bytes of each —
+the >= 3x reduction acceptance number lives in the sidecar, not in prose.
+
 Env overrides: H2O_TPU_BENCH_ROWS, H2O_TPU_BENCH_TREES,
 H2O_TPU_BENCH_SORT_ROWS, H2O_TPU_BENCH_AIRLINES_ROWS,
-H2O_TPU_BENCH_WORKLOADS (comma list, default all),
-H2O_TPU_BENCH_SKIP_CADENCE=1, H2O_TPU_BENCH_SIDECAR.
+H2O_TPU_BENCH_BINNED_ROWS, H2O_TPU_BENCH_WORKLOADS (comma list, default
+all), H2O_TPU_BENCH_SKIP_CADENCE=1, H2O_TPU_BENCH_SIDECAR.
 """
 
 from __future__ import annotations
@@ -168,6 +173,66 @@ def bench_airlines(nrow: int, ntrees: int) -> dict:
     del model, fr
     _gc.collect()
     return out
+
+
+def bench_binned_store(nrow: int, ntrees: int) -> dict:
+    """Airlines-width binned-storage leg: the SAME GBM trained from the f32
+    stacked matrix and from the chunk store's int8/int16 binned view
+    (`frame/chunks.py`), recording each mode's training-matrix bytes and
+    wall. The acceptance bar is a >= 3x peak-matrix-bytes reduction vs the
+    stacked path (raw f32 + int32 binned codes) — measured, not derived:
+    the byte counts come from `gbm.LAST_TRAIN_MATRIX_BYTES`, which the
+    builder fills from the live device arrays."""
+    import gc as _gc
+
+    from h2o_tpu.backend.memory import hbm_stats
+    from h2o_tpu.models import gbm as gbm_mod
+    from h2o_tpu.models.gbm import GBM, GBMParameters
+
+    fr = _airlines_frame(nrow)
+    prev = os.environ.get("H2O_TPU_BINNED_STORE")
+    modes: dict = {}
+    try:
+        for mode, env in (("stacked_f32", "0"), ("binned", "1")):
+            os.environ["H2O_TPU_BINNED_STORE"] = env
+            p = GBMParameters(training_frame=fr,
+                              response_column="IsDepDelayed",
+                              ntrees=ntrees, max_depth=5, nbins=20, seed=42,
+                              learn_rate=0.1, score_tree_interval=ntrees)
+            t0 = time.time()
+            model = GBM(p).train_model()
+            stats = hbm_stats() or {}
+            modes[mode] = {
+                "wall_s": round(time.time() - t0, 3),
+                "train_auc": round(float(model.output.training_metrics.auc),
+                                   4),
+                "matrix": dict(gbm_mod.LAST_TRAIN_MATRIX_BYTES),
+                "hbm_peak_bytes": stats.get("peak_bytes_in_use"),
+            }
+            del model
+            _gc.collect()
+    finally:
+        if prev is None:
+            os.environ.pop("H2O_TPU_BINNED_STORE", None)
+        else:
+            os.environ["H2O_TPU_BINNED_STORE"] = prev
+    stacked = modes["stacked_f32"]["matrix"]
+    binned = modes["binned"]["matrix"]
+    peak_stacked = stacked["raw_bytes"] + stacked["binned_bytes"]
+    peak_binned = binned["raw_bytes"] + binned["binned_bytes"]
+    del fr
+    _gc.collect()
+    return {"rows": nrow, "ntrees": ntrees,
+            "peak_matrix_bytes_stacked": peak_stacked,
+            "peak_matrix_bytes_binned": peak_binned,
+            "reduction_x": round(peak_stacked / max(peak_binned, 1), 2),
+            "binned_dtype": binned["binned_dtype"],
+            "auc_delta": round(modes["binned"]["train_auc"]
+                               - modes["stacked_f32"]["train_auc"], 6),
+            "modes": modes,
+            "note": ("airlines-width chunk-store leg; acceptance: "
+                     "reduction_x >= 3 and auc_delta == 0 (bit-equal "
+                     "forests)")}
 
 
 def bench_gbm(fr, ntrees: int, skip_cadence: bool) -> dict:
@@ -400,8 +465,8 @@ def main():
     sort_rows = int(os.environ.get("H2O_TPU_BENCH_SORT_ROWS", 100_000_000))
     wanted = [w.strip() for w in
               os.environ.get("H2O_TPU_BENCH_WORKLOADS",
-                             "gbm,glm,cod,gam,rulefit,sort,merge,airlines"
-                             ).split(",")]
+                             "gbm,glm,cod,gam,rulefit,sort,merge,binned,"
+                             "airlines").split(",")]
     skip_cadence = bool(os.environ.get("H2O_TPU_BENCH_SKIP_CADENCE"))
 
     import jax
@@ -452,6 +517,12 @@ def main():
         _emit_workload(workloads, "sort", bench_sort(sort_rows))
     if "merge" in wanted:
         _emit_workload(workloads, "merge", bench_merge(sort_rows))
+    if "binned" in wanted:
+        binned_rows = int(os.environ.get("H2O_TPU_BENCH_BINNED_ROWS",
+                                         8_000_000))
+        _emit_workload(workloads, "binned_store",
+                       bench_binned_store(binned_rows,
+                                          min(ntrees, 20)))
     if "airlines" in wanted:
         air_rows = int(os.environ.get("H2O_TPU_BENCH_AIRLINES_ROWS",
                                       116_000_000))

@@ -25,6 +25,14 @@ their intermediates from it instead of hardcoded constants.
 Accounting is a running counter (track/spill/rehydrate/GC adjust it), not a
 per-call scan; spill files are removed on rehydrate, on overwrite, and by a
 weakref finalizer when a spilled Vec is garbage-collected.
+
+The tracked protocol is duck-typed on Vec's fields (``_data``, ``_lock``,
+``_spill_path``, ``_last_access``, ``key``), so the chunk store's coded
+columns and binned views (`frame/chunks.py` CodedVec/BinnedView — Vec
+subclasses whose ``_data`` holds CODES, not f32) ride the same ledger:
+their coded bytes debit ``hbm_budget_bytes()`` while alive, and they
+spill/rehydrate by LRU like raw columns (reload sharding comes from
+``Vec._put_sharding`` — const/sparse payloads replicate).
 """
 
 from __future__ import annotations
@@ -244,6 +252,10 @@ class Cleaner:
             vecs = sorted((v for v in self._vecs.values()
                            if getattr(v, "_data", None) is not None
                            and getattr(v, "_cleaner_token", None) != exclude
+                           # pinned views (a BinnedView mid-train) stay: the
+                           # trainer holds the buffer anyway, so spilling
+                           # would debit the ledger while freeing no HBM
+                           and not getattr(v, "_pinned", False)
                            # spilling an aliased buffer frees no HBM
                            and aliases.get(id(v._data), 1) == 1),
                           key=lambda v: getattr(v, "_last_access", 0))

@@ -1,0 +1,9 @@
+"""Distributed frame layer: Vec (sharded column), Frame (named columns),
+and the compressed columnar chunk store (coded columns + binned views)."""
+
+from .chunks import BinnedView, ChunkMeta, CodedVec, compress_frame
+from .frame import Frame
+from .vec import Vec
+
+__all__ = ["Frame", "Vec", "BinnedView", "ChunkMeta", "CodedVec",
+           "compress_frame"]

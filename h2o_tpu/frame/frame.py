@@ -200,7 +200,19 @@ class Frame(Keyed):
 
         todo = [self.vec(n) for n in (names if names is not None
                                       else self._names)]
-        todo = [v for v in todo if v._rollups is None and v.data is not None]
+        todo = [v for v in todo if v._rollups is None
+                and (v._data is not None or v._spill_path is not None)]
+        coded = [v for v in todo if hasattr(v, "rollups_from_codes")]
+        if coded:
+            # coded columns batch in code space — one program per
+            # (plen, dtype) stack, never decoding (`chunks.py`); sparse/raw
+            # codecs come back and ride the decode-path batch below
+            from .chunks import batch_code_rollups
+
+            rest = set(map(id, batch_code_rollups(coded)))
+            todo = [v for v in todo
+                    if not hasattr(v, "rollups_from_codes")
+                    or id(v) in rest]
         if len(todo) <= 1:
             return
         from ..backend.memory import hbm_budget_bytes
@@ -225,6 +237,15 @@ class Frame(Keyed):
                 for i, v in enumerate(sub):
                     v._rollups = _rollups_from_scalars(
                         v.nrow, {k: r[k][i] for k in r})
+
+    def compress(self) -> "Frame":
+        """Compressed-chunk copy of this frame: every column re-encoded with
+        the narrowest bit-exact codec (`frame/chunks.py`) — the C1/C2-style
+        coded storage the reference parses straight into. Columns no codec
+        reproduces exactly stay raw f32 (shared, not copied)."""
+        from .chunks import compress_frame
+
+        return compress_frame(self)
 
     # -- host views ----------------------------------------------------------
     def to_pandas(self):

@@ -165,11 +165,8 @@ class Vec(Keyed):
             if self._data is None and self._spill_path is not None:
                 import jax
 
-                from ..parallel.mesh import default_mesh, row_sharding
-
                 host = np.load(self._spill_path)
-                self._data = jax.device_put(
-                    host, row_sharding(default_mesh()))
+                self._data = jax.device_put(host, self._put_sharding())
                 CLEANER._remove_ice(self._spill_path)
                 self._spill_path = None
                 self._last_access = CLEANER.touch(self)
@@ -195,6 +192,14 @@ class Vec(Keyed):
             if value is not None:
                 self._last_access = CLEANER.touch(self)
                 CLEANER.track(self, value.size * value.dtype.itemsize)
+
+    def _put_sharding(self):
+        """Sharding for (re)hydrating this Vec's device payload. Row-sharded
+        by default; coded chunk payloads whose leading axis is not the row
+        axis (const/sparse codecs, `frame/chunks.py`) override this."""
+        from ..parallel.mesh import default_mesh, row_sharding
+
+        return row_sharding(default_mesh())
 
     # -- construction --------------------------------------------------------
     @staticmethod

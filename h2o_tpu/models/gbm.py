@@ -33,9 +33,15 @@ from ..frame.vec import T_CAT, Vec
 from ..parallel.mesh import default_mesh, replicated
 from .distributions import Bernoulli, Gaussian, get_distribution
 from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
-from .tree.binning import bin_matrix, compute_bin_edges
+from .tree.binning import (bin_matrix, compute_bin_edges,
+                           compute_bin_edges_cols)
 from .tree.engine import (TreeConfig, make_train_fn, plan_hist_groups,
                           predict_forest)
+
+#: last build's training-matrix accounting (mode, per-matrix bytes) — the
+#: bench binned-storage leg and the chunk-store tests read this to put the
+#: measured peak-bytes reduction on the record
+LAST_TRAIN_MATRIX_BYTES: dict = {}
 
 
 @dataclass
@@ -385,10 +391,18 @@ class GBM(ModelBuilder):
                                 quantile_alpha=p.quantile_alpha,
                                 huber_alpha=p.huber_alpha)
 
-    def _setup_build(self):
+    def _setup_build(self, need_raw: bool = False):
         """Shared pre-training setup: design matrix, weights/mask, bin
         edges, constraints, init prediction, grad fn, tree config, initial
-        margin — used by the standard boosting loop and the DART driver."""
+        margin — used by the standard boosting loop and the DART driver.
+
+        By default the training matrix is the chunk store's int8/int16
+        BINNED VIEW, built column-by-column from the frame's Vecs — the raw
+        f32 matrix is never stacked (`frame/chunks.py`; disable with
+        ``H2O_TPU_BINNED_STORE=0``). ``need_raw`` forces the legacy stacked
+        path for drivers that replay prior forests over raw thresholds
+        (checkpoint restarts, DART's dropped-tree evaluation)."""
+        import os
         import types as _types
 
         p = self.params
@@ -398,7 +412,9 @@ class GBM(ModelBuilder):
         dist = self._distribution(category)
         K = len(resp_domain) if category == "Multinomial" else 1
 
-        X = fr.as_matrix(names)
+        use_binned = (not need_raw and p.checkpoint is None
+                      and os.environ.get("H2O_TPU_BINNED_STORE", "1")
+                      .lower() not in ("0", "false", "off"))
         is_cat = np.array([fr.vec(n).is_categorical() for n in names])
         w_in = (jnp.nan_to_num(
             Vec.from_numpy(np.nan_to_num(
@@ -409,12 +425,19 @@ class GBM(ModelBuilder):
         # the device tunnel on a cold process (round-3's cold-start wall)
         y, ymask, w, ym = _jit_prep(y_dev, w_in)
 
-        edges_np = compute_bin_edges(
-            X, is_cat, p.nbins,
+        bin_kw = dict(
             seed=p.seed if p.seed not in (-1, None) else 1234,
             histogram_type=p.histogram_type,
             nbins_top_level=int(getattr(p, "nbins_top_level", 1024) or 1024),
             nbins_cats=int(getattr(p, "nbins_cats", 1024) or 1024))
+        if use_binned:
+            X = None
+            feat_vecs = [fr.vec(n) for n in names]
+            edges_np = compute_bin_edges_cols(feat_vecs, is_cat, p.nbins,
+                                              **bin_kw)
+        else:
+            X = fr.as_matrix(names)
+            edges_np = compute_bin_edges(X, is_cat, p.nbins, **bin_kw)
         mesh = default_mesh()
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf), replicated(mesh))
         mono_np = np.zeros(len(names), dtype=np.float32)
@@ -432,7 +455,25 @@ class GBM(ModelBuilder):
                                               None))
         imat = jax.device_put(imat_np, replicated(mesh))
         edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))
-        Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
+        binned_view = None
+        if use_binned:
+            # device-resident coded training matrix, packed column-by-column
+            # (Cleaner-tracked; the engine upcasts blocks in-scan)
+            from ..frame.chunks import BinnedView
+
+            binned_view = BinnedView.build(feat_vecs, edges_np, names=names)
+            Xb = binned_view.matrix
+        else:
+            Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
+        plen = Xb.shape[0]
+        global LAST_TRAIN_MATRIX_BYTES
+        LAST_TRAIN_MATRIX_BYTES = {
+            "mode": "binned" if use_binned else "stacked_f32",
+            "raw_bytes": 0 if X is None else int(X.size * X.dtype.itemsize),
+            "binned_bytes": int(Xb.size * Xb.dtype.itemsize),
+            "binned_dtype": str(Xb.dtype),
+            "cells": int(plen * len(names)),
+        }
 
         # initial prediction (`hex/tree/gbm/GBM.java:265` init) — one
         # compiled program per (drf, K, distribution) family
@@ -495,10 +536,13 @@ class GBM(ModelBuilder):
             edges=edges, mono=mono, imat=imat, edge_ok=edge_ok, Xb=Xb,
             f0=f0, grad_fn=grad_fn, cfg=cfg, grad_key=grad_key, y_k=y_k,
             f=f, iscat_dev=iscat_dev, nedges_dev=nedges_dev,
-            nedges_np=nedges_np)
+            nedges_np=nedges_np, binned_view=binned_view)
 
     def build_impl(self, job: Job) -> GBMModel:
-        s = self._setup_build()
+        # checkpoint restarts replay the prior forest over RAW thresholds —
+        # only they force the stacked f32 matrix; everything else trains
+        # straight off the chunk store's binned view
+        s = self._setup_build(need_raw=self.params.checkpoint is not None)
         p, fr, names = s.p, s.fr, s.names
         category, resp_domain, dist, K = (s.category, s.resp_domain,
                                           s.dist, s.K)

@@ -179,6 +179,42 @@ def hist_quantile_sketch(X, qs, nb: int = 1024,
     return out
 
 
+def _coldata(c):
+    """Column handle -> device array: Vecs (coded ones decode on access)
+    or plain arrays both work, so callers can stream straight off a Frame."""
+    return c.data if hasattr(c, "data") else jnp.asarray(c)
+
+
+def _col_plen(c) -> int:
+    return int(c.plen) if hasattr(c, "plen") else int(jnp.asarray(c).shape[0])
+
+
+def hist_quantile_sketch_cols(cols, qs, nb: int = 1024,
+                              budget_bytes=_UNSET) -> np.ndarray:
+    """`hist_quantile_sketch` fed from PER-COLUMN Vecs/arrays — the raw
+    (R, F) matrix is never stacked. The (rb, Fb) plan is the one the stacked
+    driver would pick for the same (R, F, budget) and columns stream through
+    the two-pass sketch in the same Fb-sized blocks, so the output is
+    bit-identical to the stacked path (histogram cells are exact integer
+    counts in f32 — accumulation order can't perturb them)."""
+    if budget_bytes is _UNSET:
+        from ...backend.memory import hbm_budget_bytes
+
+        budget_bytes = hbm_budget_bytes()
+    cols = list(cols)
+    F = len(cols)
+    R = _col_plen(cols[0])
+    rb, Fb = _sketch_plan(R, F, nb, budget_bytes)
+    # each block is a fresh sketch-owned buffer -> donate on accelerators
+    donate = jax.default_backend() in ("tpu", "gpu")
+    core = _hist_quantile_rows_donated if donate else _hist_quantile_rows
+    out = np.empty((len(qs), F), np.float32)
+    for f0 in range(0, F, Fb):
+        blk = jnp.stack([_coldata(c) for c in cols[f0:f0 + Fb]], axis=1)
+        out[:, f0:f0 + Fb] = np.asarray(core(blk, tuple(qs), nb=nb, rb=rb))
+    return out
+
+
 @jax.jit
 def _col_minmax(X):
     return jnp.nanmin(X, axis=0), jnp.nanmax(X, axis=0)
@@ -209,62 +245,29 @@ def _exact_bin_row_limit() -> int:
     return int(os.environ.get("H2O_TPU_EXACT_BIN_ROWS", 16384))
 
 
-def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
-                      sample: int = 200_000, seed: int = 1234,
-                      histogram_type: str = "QuantilesGlobal",
-                      nbins_top_level: int = 1024,
-                      nbins_cats: int = 1024) -> np.ndarray:
-    """Global bin edges per feature.
-
-    ``histogram_type`` mirrors `hex/tree/SharedTreeModel.HistogramType`:
-    AUTO/QuantilesGlobal → sampled global quantiles (this engine's default —
-    bins adapt to the data distribution); UniformAdaptive → equal-width
-    between per-feature min/max; Random → uniform random cut points (the
-    extremely-randomized-trees flavor). Categorical features always bin on
-    their category codes, one bin per level up to ``nbins_cats`` bins
-    (`hex/tree/SharedTreeModel.java:57` nbins_cats — the categorical
-    histogram width; levels at/above the cap share the top bin).
-
-    X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles come from
-    the two-pass device histogram sketch over ALL rows (see
-    `_hist_quantile_rows` — the reference's QuantilesGlobal samples; we can
-    afford exhaustive because the sketch is one-hot matmuls) — only the
-    (F, nbins-1) result crosses to the host. ``sample``/``seed`` are kept
-    for API compatibility (the sketch is deterministic and sample-free).
-    Returns (F, nbins-1) float32 edges, NaN-padded where a feature has fewer
-    distinct cut points.
-    """
+def _validate_ht(histogram_type: str) -> str:
     ht = (histogram_type or "AUTO").lower()
     if ht not in ("auto", "quantilesglobal", "uniformadaptive", "random",
                   "exact"):
         raise ValueError(
             f"unsupported histogram_type '{histogram_type}' — supported: "
             f"AUTO, QuantilesGlobal, UniformAdaptive, Random, Exact")
-    Xj = jnp.asarray(X)
-    R, F = Xj.shape
-    # Small-data exact binning — the `nbins_top_level` role: the reference's
-    # DHistogram re-bins each node at up to 1024 cuts, so on small data its
-    # splits are effectively exact. Matching that with static shapes: when
-    # the dataset is small and a column's distinct count fits under
-    # nbins_top_level, its cuts are the exact midpoints BETWEEN distinct
-    # values; high-cardinality columns keep the sampled-quantile cuts. Big
-    # data (above H2O_TPU_EXACT_BIN_ROWS) is untouched — histogram cost
-    # scales with the bin-axis length, and 20 global quantile bins is the
-    # measured-fast design there.
-    exact = None
-    if (ht == "exact"
+    return ht
+
+
+def _wants_exact(ht: str, R: int, nbins: int, nbins_top_level: int) -> bool:
+    """Small-data exact binning engagement rule (see compute_bin_edges)."""
+    return (ht == "exact"
             or (R <= _exact_bin_row_limit() and nbins_top_level > nbins
-                and ht in ("auto", "quantilesglobal", "uniformadaptive"))):
-        # "Exact" (the single-DT mode, `hex/tree/dt/DT.java`'s per-value
-        # search): exact midpoints at ANY row count; columns above the
-        # nbins_top_level distinct-value cap fall back to global quantiles
-        vals, counts = _distinct_values(Xj, int(nbins_top_level))
-        exact = (np.asarray(vals), np.asarray(counts))
-    qs = np.linspace(0, 1, nbins + 1)[1:-1]
-    col_min, col_max = (np.asarray(v) for v in _col_minmax(Xj))
-    qrows = None
-    if ht in ("auto", "quantilesglobal", "exact"):
-        qrows = hist_quantile_sketch(Xj, tuple(qs))
+                and ht in ("auto", "quantilesglobal", "uniformadaptive")))
+
+
+def _edges_from_stats(F, is_cat, col_min, col_max, qrows, exact, ht,
+                      nbins, nbins_top_level, nbins_cats,
+                      seed) -> np.ndarray:
+    """Per-feature cut assembly from host-side column stats — the shared
+    tail of `compute_bin_edges` (stacked matrix) and
+    `compute_bin_edges_cols` (per-column streaming)."""
     all_cuts: list = []
     for f in range(F):
         if not np.isfinite(col_max[f]):  # all-NaN column
@@ -303,6 +306,107 @@ def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
     return edges
 
 
+def compute_bin_edges(X: jax.Array, is_cat: np.ndarray, nbins: int,
+                      sample: int = 200_000, seed: int = 1234,
+                      histogram_type: str = "QuantilesGlobal",
+                      nbins_top_level: int = 1024,
+                      nbins_cats: int = 1024) -> np.ndarray:
+    """Global bin edges per feature.
+
+    ``histogram_type`` mirrors `hex/tree/SharedTreeModel.HistogramType`:
+    AUTO/QuantilesGlobal → sampled global quantiles (this engine's default —
+    bins adapt to the data distribution); UniformAdaptive → equal-width
+    between per-feature min/max; Random → uniform random cut points (the
+    extremely-randomized-trees flavor). Categorical features always bin on
+    their category codes, one bin per level up to ``nbins_cats`` bins
+    (`hex/tree/SharedTreeModel.java:57` nbins_cats — the categorical
+    histogram width; levels at/above the cap share the top bin).
+
+    X: (R, F) padded feature matrix (NaN = NA/padding). Quantiles come from
+    the two-pass device histogram sketch over ALL rows (see
+    `_hist_quantile_rows` — the reference's QuantilesGlobal samples; we can
+    afford exhaustive because the sketch is one-hot matmuls) — only the
+    (F, nbins-1) result crosses to the host. ``sample``/``seed`` are kept
+    for API compatibility (the sketch is deterministic and sample-free).
+    Returns (F, nbins-1) float32 edges, NaN-padded where a feature has fewer
+    distinct cut points.
+    """
+    ht = _validate_ht(histogram_type)
+    Xj = jnp.asarray(X)
+    R, F = Xj.shape
+    # Small-data exact binning — the `nbins_top_level` role: the reference's
+    # DHistogram re-bins each node at up to 1024 cuts, so on small data its
+    # splits are effectively exact. Matching that with static shapes: when
+    # the dataset is small and a column's distinct count fits under
+    # nbins_top_level, its cuts are the exact midpoints BETWEEN distinct
+    # values; high-cardinality columns keep the sampled-quantile cuts. Big
+    # data (above H2O_TPU_EXACT_BIN_ROWS) is untouched — histogram cost
+    # scales with the bin-axis length, and 20 global quantile bins is the
+    # measured-fast design there.
+    exact = None
+    if _wants_exact(ht, R, nbins, nbins_top_level):
+        # "Exact" (the single-DT mode, `hex/tree/dt/DT.java`'s per-value
+        # search): exact midpoints at ANY row count; columns above the
+        # nbins_top_level distinct-value cap fall back to global quantiles
+        vals, counts = _distinct_values(Xj, int(nbins_top_level))
+        exact = (np.asarray(vals), np.asarray(counts))
+    qs = np.linspace(0, 1, nbins + 1)[1:-1]
+    col_min, col_max = (np.asarray(v) for v in _col_minmax(Xj))
+    qrows = None
+    if ht in ("auto", "quantilesglobal", "exact"):
+        qrows = hist_quantile_sketch(Xj, tuple(qs))
+    return _edges_from_stats(F, is_cat, col_min, col_max, qrows, exact, ht,
+                             nbins, nbins_top_level, nbins_cats, seed)
+
+
+def compute_bin_edges_cols(cols, is_cat: np.ndarray, nbins: int,
+                           sample: int = 200_000, seed: int = 1234,
+                           histogram_type: str = "QuantilesGlobal",
+                           nbins_top_level: int = 1024,
+                           nbins_cats: int = 1024,
+                           budget_bytes=_UNSET) -> np.ndarray:
+    """`compute_bin_edges` fed from per-column Vecs/arrays — the chunk-store
+    ingest path: the raw (R, F) f32 matrix is NEVER stacked. Column stats
+    (min/max, small-data distinct values, quantile sketch) stream through
+    device programs in Fb-sized column blocks planned from the live HBM
+    budget; each column's cuts depend only on that column and on exact
+    integer histogram counts, so the result is bit-identical to the stacked
+    path on the same data."""
+    ht = _validate_ht(histogram_type)
+    if budget_bytes is _UNSET:
+        from ...backend.memory import hbm_budget_bytes
+
+        budget_bytes = hbm_budget_bytes()
+    cols = list(cols)
+    F = len(cols)
+    if F == 0:
+        return np.zeros((0, max(nbins - 1, 0)), np.float32)
+    R = _col_plen(cols[0])
+    _, Fb = _sketch_plan(R, F, 1024, budget_bytes)
+    col_min = np.empty(F, np.float32)
+    col_max = np.empty(F, np.float32)
+    exact = None
+    if _wants_exact(ht, R, nbins, nbins_top_level):
+        exact = (np.empty((int(nbins_top_level), F), np.float32),
+                 np.empty(F, np.int64))
+    for f0 in range(0, F, Fb):
+        blk = jnp.stack([_coldata(c) for c in cols[f0:f0 + Fb]], axis=1)
+        mn, mx = _col_minmax(blk)
+        col_min[f0:f0 + Fb] = np.asarray(mn)
+        col_max[f0:f0 + Fb] = np.asarray(mx)
+        if exact is not None:
+            vals, counts = _distinct_values(blk, int(nbins_top_level))
+            exact[0][:, f0:f0 + Fb] = np.asarray(vals)
+            exact[1][f0:f0 + Fb] = np.asarray(counts)
+    qs = np.linspace(0, 1, nbins + 1)[1:-1]
+    qrows = None
+    if ht in ("auto", "quantilesglobal", "exact"):
+        qrows = hist_quantile_sketch_cols(cols, tuple(qs),
+                                          budget_bytes=budget_bytes)
+    return _edges_from_stats(F, is_cat, col_min, col_max, qrows, exact, ht,
+                             nbins, nbins_top_level, nbins_cats, seed)
+
+
 @jax.jit
 def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     """Map raw values to bin indices: bin = #edges < x; NA -> nbins (NA bucket).
@@ -313,6 +417,21 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     cmp = X[:, :, None] > edges[None, :, :]  # NaN compares false
     b = jnp.sum(cmp, axis=2, dtype=jnp.int32)
     # int32 deliberately: an int8 variant (C1Chunk-style packing) measured 5x
-    # SLOWER end-to-end on v5e — sub-word (32,128) tiling forces relayouts in
-    # every one-hot; HBM savings never materialize.
+    # SLOWER end-to-end on v5e when the one-hots consumed int8 DIRECTLY —
+    # sub-word (32,128) tiling forces relayouts in every one-hot. The
+    # chunk-store binned view (frame/chunks.py BinnedView) gets the HBM
+    # savings anyway by storing int8 and upcasting per row-block inside the
+    # engine's histogram scan (engine._build_level_hist), where the convert
+    # is VMEM-granular and fuses.
     return jnp.where(jnp.isnan(X), nbins, b).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def bin_column(x: jax.Array, erow: jax.Array, dtype=jnp.int32) -> jax.Array:
+    """One column of `bin_matrix`: (plen,) raw values + that feature's
+    NaN-padded edge row -> bin codes in ``dtype`` (the BinnedView packer).
+    Identical values to the stacked kernel — same compare-and-sum, NA (and
+    padding) to the ``nbins`` bucket — just never materializing (R, F)."""
+    nbins = erow.shape[0] + 1
+    b = jnp.sum(x[:, None] > erow[None, :], axis=1, dtype=jnp.int32)
+    return jnp.where(jnp.isnan(x), nbins, b).astype(dtype)

@@ -229,6 +229,12 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
     if groups is None:
         def body(acc, blk):
             xb, l, vv = blk
+            # int8/int16 binned views (frame/chunks.py) upcast HERE, one
+            # (rb, F) block at a time in VMEM: the one-hot below always sees
+            # int32, so HBM stores 1-2 B/cell without the sub-word-tiling
+            # relayouts that made a whole-matrix int8 one-hot 5x slower.
+            # (For int32 input this convert is a no-op in the jaxpr.)
+            xb = xb.astype(jnp.int32)
             n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)      # (rb, n_lv)
             a = jnp.einsum("rn,rv->rnv", n_oh, vv)                 # (rb, n_lv, V)
             b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)  # (rb,F,B)
@@ -244,6 +250,7 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
 
     def body(accs, blk):
         xb, l, vv = blk
+        xb = xb.astype(jnp.int32)  # per-block upcast (see the flat body)
         n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)
         a = jnp.einsum("rn,rv->rnv", n_oh, vv)  # outer product — exact
         out = []
@@ -656,9 +663,12 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
                              2 * node_blk + 1 + go_right.astype(jnp.int32),
                              node_blk)
 
-        if use_sets:
+        if use_sets or Xb.dtype.itemsize < 4:
             # blocked: the (rows, nbins) bin one-hot lives per block, never
-            # materializing an (Rl, nbins) intermediate at wide nbins_cats
+            # materializing an (Rl, nbins) intermediate at wide nbins_cats —
+            # and for int8/int16 binned views the f32 cast feeding the
+            # routing matmul stays block-sized instead of re-materializing a
+            # raw-matrix-sized (Rl, F) f32 intermediate
             rb_ = _block_rows(Rl, cfg.block_rows)
             _, node_b = jax.lax.scan(
                 lambda c, blk: (c, _route(*blk)), None,

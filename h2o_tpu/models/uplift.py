@@ -41,7 +41,7 @@ from .metrics import ModelMetrics
 from .model_base import Model, ModelBuilder, ModelOutput
 from .tree.binning import bin_matrix, compute_bin_edges
 from .tree.engine import (TreeConfig, _build_level_hist, _level_col_mask,
-                          _node_totals, predict_forest)
+                          _node_totals, plan_hist_groups, predict_forest)
 
 
 @dataclass
@@ -145,7 +145,7 @@ def _grow_uplift_tree(Xb, y, treat, w, edges, edge_ok, colkey, div,
         n_lv = 2 ** level
         offset = n_lv - 1
         hist = _build_level_hist(Xb, node, vals4, offset, n_lv, B,
-                                 cfg.block_rows)
+                                 cfg.block_rows, groups=cfg.hist_groups)
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
                                 cfg, tree_cols)
 
@@ -349,6 +349,19 @@ class UpliftDRF(ModelBuilder):
             min_split_improvement=max(p.min_split_improvement, 1e-9),
             col_sample_rate_per_tree=p.col_sample_rate_per_tree,
             drf_mode=True)
+        # width-bucketed histogram accumulation (ROADMAP open item: the
+        # uplift trees ran the flat path) — same auto-tuned plan as GBM but
+        # over the 4-channel {wt, wty, wc, wcy} accumulator, with the row
+        # block fitted to the live HBM budget
+        from ..backend.memory import hbm_budget_bytes
+
+        nedges_np = (~np.isnan(edges_np)).sum(axis=1).astype(np.int32)
+        hist_groups, blk = plan_hist_groups(
+            nedges_np, cfg.nbins + 1, cfg.block_rows,
+            budget_bytes=hbm_budget_bytes(),
+            n_lv_max=2 ** max(cfg.max_depth - 1, 0), nvals=4)
+        cfg = dataclasses.replace(cfg, hist_groups=hist_groups,
+                                  block_rows=blk)
 
         edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf),
                                replicated(mesh))

@@ -187,7 +187,9 @@ class XGBoost(GBM):
         from .model_base import ModelOutput, make_metrics
         from .tree.engine import make_train_fn, predict_forest
 
-        s = self._setup_build()
+        # DART re-evaluates dropped trees over raw thresholds every
+        # iteration (dropped_sum below) — it keeps the stacked f32 matrix
+        s = self._setup_build(need_raw=True)
         p = s.p
         K = s.K
         rng = np.random.default_rng(

@@ -104,6 +104,9 @@ def pytest_configure(config):
         "markers", "core: fast representative tier (pytest -m core, <10 min)")
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (pytest -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chunks: compressed columnar chunk store / binned views "
+                   "(pytest -m chunks)")
 
 
 def pytest_collection_modifyitems(config, items):
