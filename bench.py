@@ -160,7 +160,7 @@ def bench_airlines(nrow: int, ntrees: int) -> dict:
                       ntrees=ntrees, max_depth=5, nbins=20, seed=42,
                       learn_rate=0.1, score_tree_interval=ntrees)
     t0 = time.time()
-    model = GBM(p).train_model()
+    model = GBM(p).train_model()  # drains device arrays before returning
     wall = time.time() - t0
     auc = model.output.training_metrics.auc
     stats = hbm_stats() or {}
@@ -189,8 +189,10 @@ def bench_binned_store(nrow: int, ntrees: int) -> dict:
     from h2o_tpu.models import gbm as gbm_mod
     from h2o_tpu.models.gbm import GBM, GBMParameters
 
+    from h2o_tpu.utils import knobs
+
     fr = _airlines_frame(nrow)
-    prev = os.environ.get("H2O_TPU_BINNED_STORE")
+    prev = knobs.raw("H2O_TPU_BINNED_STORE")
     modes: dict = {}
     try:
         for mode, env in (("stacked_f32", "0"), ("binned", "1")):
@@ -241,7 +243,9 @@ def bench_gbm(fr, ntrees: int, skip_cadence: bool) -> dict:
     def run(interval: int):
         """Cold = first full-length train at this chunk length (compile +
         allocator warm-up); warm = the immediately following identical
-        train (the steady state the reference's warm-JVM bands measure)."""
+        train (the steady state the reference's warm-JVM bands measure).
+        train_model drains the model's device arrays before returning
+        (model_base.py), so the deltas measure compute, not dispatch."""
         params = GBMParameters(training_frame=fr, response_column="response",
                                ntrees=ntrees, max_depth=5, nbins=20,
                                learn_rate=0.1, seed=42,
@@ -440,7 +444,9 @@ def _sidecar_path() -> str:
     retry after a crash delimits a new run instead of wiping the crashed
     run's surviving records. The final stdout summary line is unchanged
     when every workload survives."""
-    return os.environ.get("H2O_TPU_BENCH_SIDECAR") or os.path.join(
+    from h2o_tpu.utils import knobs
+
+    return knobs.raw("H2O_TPU_BENCH_SIDECAR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.jsonl")
 
 
@@ -460,14 +466,14 @@ def _emit_workload(workloads: dict, name: str, rec: dict) -> None:
 
 
 def main():
-    nrow = int(os.environ.get("H2O_TPU_BENCH_ROWS", 11_000_000))
-    ntrees = int(os.environ.get("H2O_TPU_BENCH_TREES", 100))
-    sort_rows = int(os.environ.get("H2O_TPU_BENCH_SORT_ROWS", 100_000_000))
-    wanted = [w.strip() for w in
-              os.environ.get("H2O_TPU_BENCH_WORKLOADS",
-                             "gbm,glm,cod,gam,rulefit,sort,merge,binned,"
-                             "airlines").split(",")]
-    skip_cadence = bool(os.environ.get("H2O_TPU_BENCH_SKIP_CADENCE"))
+    from h2o_tpu.utils import knobs
+
+    nrow = knobs.get_int("H2O_TPU_BENCH_ROWS")
+    ntrees = knobs.get_int("H2O_TPU_BENCH_TREES")
+    sort_rows = knobs.get_int("H2O_TPU_BENCH_SORT_ROWS")
+    wanted = [w.strip()
+              for w in knobs.get_str("H2O_TPU_BENCH_WORKLOADS").split(",")]
+    skip_cadence = knobs.get_bool("H2O_TPU_BENCH_SKIP_CADENCE")
 
     import jax
 
@@ -518,14 +524,12 @@ def main():
     if "merge" in wanted:
         _emit_workload(workloads, "merge", bench_merge(sort_rows))
     if "binned" in wanted:
-        binned_rows = int(os.environ.get("H2O_TPU_BENCH_BINNED_ROWS",
-                                         8_000_000))
+        binned_rows = knobs.get_int("H2O_TPU_BENCH_BINNED_ROWS")
         _emit_workload(workloads, "binned_store",
                        bench_binned_store(binned_rows,
                                           min(ntrees, 20)))
     if "airlines" in wanted:
-        air_rows = int(os.environ.get("H2O_TPU_BENCH_AIRLINES_ROWS",
-                                      116_000_000))
+        air_rows = knobs.get_int("H2O_TPU_BENCH_AIRLINES_ROWS")
         _emit_workload(workloads, "airlines116m",
                        bench_airlines(air_rows, ntrees))
 

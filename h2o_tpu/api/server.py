@@ -1735,7 +1735,9 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         # `water/hive/HiveTableImporter` — needs a live Hive metastore;
         # gate unless one is configured (the reference fails identically
         # without a Hive cluster on the classpath)
-        if not os.environ.get("H2O_TPU_HIVE_JDBC"):
+        from ..utils.knobs import raw as _knob_raw
+
+        if not _knob_raw("H2O_TPU_HIVE_JDBC"):
             return _err(501, f"{head}: no Hive metastore configured "
                              "(set H2O_TPU_HIVE_JDBC to a reachable "
                              "HiveServer2 JDBC url)")

@@ -34,9 +34,11 @@ _jobs_lock = threading.Lock()
 
 def _note_job_finished() -> None:
     global _jobs_finished
-    import os
+    from ..utils.knobs import raw
 
-    every = int(os.environ.get("H2O_TPU_CLEAR_CACHES_EVERY", 64) or 0)
+    # set-but-empty means DISABLED (int("" or 0) == 0 historically) — raw
+    # keeps the unset/empty distinction get_int deliberately collapses
+    every = int(raw("H2O_TPU_CLEAR_CACHES_EVERY", 64) or 0)
     if every <= 0:
         return
     with _jobs_lock:

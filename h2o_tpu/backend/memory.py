@@ -44,6 +44,8 @@ import weakref
 
 import numpy as np
 
+from ..utils import knobs
+
 _UNRESOLVED = object()
 
 
@@ -98,8 +100,8 @@ def hbm_budget_bytes() -> int | None:
     pins the value EXACTLY (no residency adjustment — tests mock budgets
     with it); None when no accelerator budget is resolvable (planners fall
     back to their own conservative defaults)."""
-    env = os.environ.get("H2O_TPU_HBM_LIMIT_BYTES")
-    if env:
+    env = knobs.raw("H2O_TPU_HBM_LIMIT_BYTES")
+    if env and int(env) > 0:  # 0 = backend resolution (optargs contract)
         return int(env)
     hw = device_hbm_bytes()
     if not hw:
@@ -132,8 +134,8 @@ class Cleaner:
 
     # -- budget ---------------------------------------------------------------
     def limit_bytes(self) -> int | None:
-        env = os.environ.get("H2O_TPU_HBM_LIMIT_BYTES")
-        if env:
+        env = knobs.raw("H2O_TPU_HBM_LIMIT_BYTES")
+        if env and int(env) > 0:  # 0 = backend resolution (optargs contract)
             return int(env)
         if self._stats_limit is _UNRESOLVED:
             stats = hbm_stats()

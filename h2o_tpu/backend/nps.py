@@ -16,9 +16,10 @@ _SAFE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 
 class NodePersistentStorage:
     def __init__(self, root: str | None = None):
-        self.root = root or os.environ.get("H2O_TPU_NPS_DIR") or \
-            os.path.join(os.environ.get("H2O_TPU_ICE_DIR", "/tmp/h2o_tpu"),
-                         "nps")
+        from ..utils.knobs import raw
+
+        self.root = root or raw("H2O_TPU_NPS_DIR") or \
+            os.path.join(raw("H2O_TPU_ICE_DIR", "/tmp/h2o_tpu"), "nps")
 
     def configured(self) -> bool:
         return True  # always rooted (the reference is unconfigured only

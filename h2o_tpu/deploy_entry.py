@@ -48,7 +48,9 @@ def main() -> None:
              "POST /clustering/flatfile")
         api.wait_until_clustered()
         info("assisted clustering: cloud formed")
-    driver = os.environ.get("H2O_TPU_DRIVER")
+    from .utils.knobs import raw
+
+    driver = raw("H2O_TPU_DRIVER")
     if driver:
         from .parallel.cluster import init_cluster
         from .utils.log import info

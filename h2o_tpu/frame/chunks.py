@@ -343,8 +343,13 @@ class CodedVec(Vec):
     @data.setter
     def data(self, value):
         # overwriting with a plain device column degrades the codec to raw
-        # passthrough — the coded ledger entry is swapped for the new bytes
-        self.meta = replace(self.meta, kind="raw")
+        # passthrough — the coded ledger entry is swapped for the new bytes,
+        # and plen must track the NEW buffer (Vec's contract is the live
+        # device shape; a stale meta.plen would mis-group this vec in
+        # ensure_rollups' same-plen stacks)
+        self.meta = replace(self.meta, kind="raw",
+                            plen=(self.meta.plen if value is None
+                                  else int(value.shape[0])))
         Vec.data.fset(self, value)
 
     def _put_sharding(self):

@@ -40,11 +40,13 @@ _OPENER = urllib.request.build_opener(_NoRedirect)
 
 
 def _base_url(authority: str) -> str:
-    env = os.environ.get("H2O_TPU_WEBHDFS_URL")
+    from ..utils import knobs
+
+    env = knobs.raw("H2O_TPU_WEBHDFS_URL")
     if env:
         return env.rstrip("/")
     host = authority.split(":")[0] or "localhost"
-    port = os.environ.get("H2O_TPU_WEBHDFS_PORT", "9870")
+    port = knobs.get_int("H2O_TPU_WEBHDFS_PORT")
     return f"http://{host}:{port}"
 
 
@@ -58,7 +60,9 @@ def _split(uri: str) -> tuple[str, str]:
 def _url(uri: str, op: str, **params) -> str:
     authority, path = _split(uri)
     q = {"op": op, **params}
-    user = os.environ.get("H2O_TPU_HDFS_USER") or os.environ.get("USER")
+    from ..utils import knobs
+
+    user = knobs.raw("H2O_TPU_HDFS_USER") or os.environ.get("USER")
     if user:
         q["user.name"] = user
     return (f"{_base_url(authority)}/webhdfs/v1"

@@ -26,6 +26,7 @@ import numpy as np
 from ..backend.kvstore import STORE
 from ..frame.frame import Frame
 from ..frame.vec import T_CAT, T_INT, T_NUM, T_STR, T_TIME, Vec
+from ..utils import knobs
 
 #: NA token vocabulary — mirrors `water/parser/ParseSetup` NA string handling.
 DEFAULT_NA_STRINGS = ["", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "?", "None"]
@@ -108,8 +109,7 @@ def _is_number(tok: str) -> bool:
 #: device-memory guard — `water/FrameSizeMonitor.java:14-23` kills parses that
 #: would OOM the heap; here the budget is HBM per chip (v5e: 16 GB, default
 #: cap leaves headroom for training workspaces). Override via env.
-MAX_FRAME_BYTES = int(os.environ.get("H2O_TPU_MAX_FRAME_BYTES",
-                                     12 * 1024**3))
+MAX_FRAME_BYTES = knobs.get_int("H2O_TPU_MAX_FRAME_BYTES")
 
 
 def _check_frame_size(n_rows: int, n_cols: int) -> None:

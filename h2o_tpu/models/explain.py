@@ -92,10 +92,9 @@ def partial_dependence(model, fr: Frame, cols=None, nbins: int = 20,
             # (grid-block-major) — the per-point rescore loop paid one full
             # REST+device round trip per bin (measured ~1 s/bin through the
             # axon tunnel); batching turns a 20-bin PDP into 1-2 predicts
-            import os as _os
+            from ..utils.knobs import get_int
 
-            budget = int(_os.environ.get("H2O_TPU_PDP_BATCH_ROWS",
-                                         2_000_000))
+            budget = get_int("H2O_TPU_PDP_BATCH_ROWS")
             per_batch = max(1, budget // max(fr.nrow, 1))
             host_cols = {n: fr.vec(n).to_numpy() for n in pd_names
                          if n != col}

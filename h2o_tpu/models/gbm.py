@@ -402,7 +402,6 @@ class GBM(ModelBuilder):
         ``H2O_TPU_BINNED_STORE=0``). ``need_raw`` forces the legacy stacked
         path for drivers that replay prior forests over raw thresholds
         (checkpoint restarts, DART's dropped-tree evaluation)."""
-        import os
         import types as _types
 
         p = self.params
@@ -412,9 +411,10 @@ class GBM(ModelBuilder):
         dist = self._distribution(category)
         K = len(resp_domain) if category == "Multinomial" else 1
 
+        from ..utils.knobs import get_bool
+
         use_binned = (not need_raw and p.checkpoint is None
-                      and os.environ.get("H2O_TPU_BINNED_STORE", "1")
-                      .lower() not in ("0", "false", "off"))
+                      and get_bool("H2O_TPU_BINNED_STORE"))
         is_cat = np.array([fr.vec(n).is_categorical() for n in names])
         w_in = (jnp.nan_to_num(
             Vec.from_numpy(np.nan_to_num(

@@ -421,6 +421,16 @@ class ModelBuilder:
                 for cv in model.output.cv_models:
                     cv.output.encoding_state = enc_state
             self._apply_custom_metric(model)
+            # drain the device stream before reading the clock: dispatch is
+            # async, and run_time_ms is the number /3/Models reports. This
+            # is also the CONTRACT every caller times against — graftlint's
+            # timing-without-sync rule treats train_model as self-syncing
+            # because of this block (bench.py legs rely on it)
+            import jax
+
+            from ..utils.blocking import device_arrays
+
+            jax.block_until_ready(device_arrays(model))
             model.output.run_time_ms = int((time.time() - t0) * 1000)
             self.job.dest_key = model.key
             return model

@@ -240,9 +240,9 @@ def _distinct_values(X, cap: int):
 
 #: rows at or below which small-data exact binning may engage (env override)
 def _exact_bin_row_limit() -> int:
-    import os
+    from ...utils.knobs import get_int
 
-    return int(os.environ.get("H2O_TPU_EXACT_BIN_ROWS", 16384))
+    return get_int("H2O_TPU_EXACT_BIN_ROWS")
 
 
 def _validate_ht(histogram_type: str) -> str:

@@ -137,10 +137,9 @@ def _norm_groups(groups):
                  for g in groups)
 
 
-#: widths at/below this accumulate via segment-sum (env override
-#: H2O_TPU_HIST_SEG_WIDTH; 0 disables the path) — see the narrow-bin branch
-#: in _build_level_hist
-_SEG_WIDTH_DEFAULT = 8
+# widths at/below the H2O_TPU_HIST_SEG_WIDTH knob accumulate via segment-sum
+# (0 disables the path) — see the narrow-bin branch in _build_level_hist.
+# The default (8) lives in the knob registry, h2o_tpu/utils/knobs.py.
 
 
 def plan_hist_groups(nedges, B_hist: int, block_rows: int,
@@ -164,7 +163,7 @@ def plan_hist_groups(nedges, B_hist: int, block_rows: int,
     per-scan-step one-hot footprint rb·(Σ F_g·B_g)·4 B plus the rb·n_lv·V
     channel outer product stays under budget/12 (defaults to a 4 GiB
     planning budget when no accelerator budget is resolvable)."""
-    import os
+    from ...utils.knobs import get_int
 
     widths = np.asarray(nedges, np.int64) + 2  # data bins + NA slot
     F = int(widths.shape[0])
@@ -173,7 +172,7 @@ def plan_hist_groups(nedges, B_hist: int, block_rows: int,
         p2 = 1 << int(np.ceil(np.log2(max(int(wd), 2))))
         by_w.setdefault(min(p2, B_hist), []).append(f)
     grouped_cells = sum(len(fs) * wd for wd, fs in by_w.items())
-    seg_w = int(os.environ.get("H2O_TPU_HIST_SEG_WIDTH", _SEG_WIDTH_DEFAULT))
+    seg_w = get_int("H2O_TPU_HIST_SEG_WIDTH")
     groups = None
     if len(by_w) > 1 and grouped_cells < 0.6 * F * B_hist:
         groups = tuple(sorted(

@@ -104,7 +104,9 @@ class AssistedClusteringApi:
                            else f"{first}:1234")
         except ValueError:
             coordinator = first  # host:port form, pass through
-        pid = int(os.environ.get("H2O_TPU_PROCESS_ID", 0))
+        from ..utils.knobs import get_int
+
+        pid = get_int("H2O_TPU_PROCESS_ID")
         info(f"assisted clustering: joining cloud of {len(nodes)} via "
              f"{coordinator} as process {pid}")
         init_cluster(coordinator_address=coordinator,

@@ -1,0 +1,47 @@
+"""Explicit device-sync points for wall-clock measurement.
+
+jax dispatch is asynchronous: `model = GBM(p).train_model()` returns as soon
+as the programs are ENQUEUED, so `time.time() - t0` around it measures
+dispatch, not compute — the exact hazard graftlint's `timing-without-sync`
+rule pins. The honest sync is to block on the arrays the work actually
+produced; `device_arrays` collects every `jax.Array` reachable from an
+object (dicts/lists/tuples and h2o_tpu-owned instances, bounded depth, cycle
+safe) so timed legs can write
+
+    jax.block_until_ready(device_arrays(model))
+
+before reading the clock. Collection reads ``__dict__`` directly — it never
+calls properties, so it cannot trigger a Cleaner rehydrate of a spilled Vec
+(a spilled column is host-side by definition: nothing to wait on).
+"""
+
+from __future__ import annotations
+
+
+def device_arrays(obj, max_depth: int = 5) -> list:
+    """Every jax.Array reachable from ``obj`` through containers and
+    h2o_tpu-owned instances (depth-bounded, cycle-safe)."""
+    import jax
+
+    out: list = []
+    seen: set[int] = set()
+
+    def walk(o, depth: int) -> None:
+        if depth < 0 or id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, jax.Array):
+            out.append(o)
+        elif isinstance(o, dict):
+            for v in o.values():
+                walk(v, depth - 1)
+        elif isinstance(o, (list, tuple)):
+            for v in o:
+                walk(v, depth - 1)
+        elif (getattr(type(o), "__module__", "").startswith("h2o_tpu")
+              and hasattr(o, "__dict__")):
+            for v in vars(o).values():
+                walk(v, depth - 1)
+
+    walk(obj, max_depth)
+    return out

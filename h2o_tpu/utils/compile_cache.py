@@ -16,7 +16,9 @@ import os
 def enable(default_dir: str | None = None) -> str | None:
     import jax
 
-    loc = os.environ.get("H2O_TPU_COMPILE_CACHE")
+    from .knobs import raw
+
+    loc = raw("H2O_TPU_COMPILE_CACHE")
     if loc == "0":
         return None
     if not loc:  # unset OR empty (a bare env entry must not makedirs(''))

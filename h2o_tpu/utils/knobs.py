@@ -1,0 +1,201 @@
+"""Central registry of every ``H2O_TPU_*`` environment knob.
+
+The reference keeps its expert properties behind one reflective surface
+(`water/H2O.OptArgs` + `sys.ai.h2o.*` system properties); this repo grew the
+same knobs ad hoc — `os.environ.get("H2O_TPU_...")` scattered through the
+runtime, each with its own inline default and no single place a reader (or a
+linter) can ask "what knobs exist and what do they do". This module is that
+place: every knob is declared HERE with its name, type, default, and one-line
+docstring, and graftlint's ``unregistered-knob`` rule fails the build on any
+literal ``H2O_TPU_*`` environment read whose name is not declared below
+(`tools/graftlint/rules.py` parses this file's AST — no import needed).
+
+Reads stay dynamic: accessors consult ``os.environ`` at call time, so tests
+that monkeypatch the environment keep working, and `utils/optargs.py`'s
+"CLI > env > default, exported back to env" contract is untouched — this
+registry documents and types the env surface, it does not cache it.
+
+Accessors:
+
+- ``raw(name, default=None)``  — exact ``os.environ.get`` semantics (string
+  or the given default), plus the registration check. The graftlint
+  ``--fix`` rewrite targets this: ``os.environ.get("H2O_TPU_X", d)`` →
+  ``knobs.raw("H2O_TPU_X", d)`` is behavior-preserving.
+- ``get_str/get_int/get_bool(name, default=...)`` — typed reads falling back
+  to the REGISTERED default when the variable is unset/empty (an explicit
+  ``default=`` overrides the registered one).
+
+Every accessor raises ``KeyError`` for an undeclared name, so a new knob
+cannot ship without a registry line (the same invariant the linter enforces
+statically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+_MISSING = object()
+
+#: strings that read as False for bool knobs (superset of the historic
+#: per-site spellings: BINNED_STORE used {0,false,off}, ALLOW_WIRE_UDF
+#: {0,false}). Set-but-EMPTY is handled as UNSET, not falsy — a stale
+#: `export VAR=` line must not silently flip BINNED_STORE/ALLOW_WIRE_UDF
+#: off (their pre-registry reads defaulted "" to on).
+_FALSY = ("0", "false", "off", "no")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str           # "str" | "int" | "bool"
+    default: object
+    doc: str
+
+
+KNOBS: dict[str, Knob] = {}
+
+
+def _knob(name: str, kind: str, default, doc: str) -> None:
+    KNOBS[name] = Knob(name, kind, default, doc)
+
+
+# -- launcher / runtime (mirrors utils/optargs.py, the CLI surface) ---------
+_knob("H2O_TPU_REST_PORT", "int", 54321,
+      "REST API port (optargs --port)")
+_knob("H2O_TPU_DRIVER", "str", "",
+      "python module run as the multi-host SPMD driver instead of REST")
+_knob("H2O_TPU_ASSISTED_CLUSTERING", "bool", False,
+      "start the clustering sidecar API before touching any JAX backend")
+_knob("H2O_TPU_ASSISTED_CLUSTERING_API_PORT", "int", 8080,
+      "port for the assisted-clustering sidecar API")
+_knob("H2O_TPU_PROCESS_ID", "int", 0,
+      "this process's rank when joining an assisted-clustering cloud")
+_knob("H2O_TPU_ICE_DIR", "str", "",
+      "spill directory for the HBM Cleaner and NodePersistentStorage")
+_knob("H2O_TPU_NPS_DIR", "str", "",
+      "NodePersistentStorage root (default: <ice>/nps)")
+
+# -- memory / frames --------------------------------------------------------
+_knob("H2O_TPU_HBM_LIMIT_BYTES", "int", 0,
+      "pin the Cleaner/planner HBM budget exactly (0/unset = backend "
+      "resolution: memory_stats -> device_kind table -> unlimited)")
+_knob("H2O_TPU_MAX_FRAME_BYTES", "int", 12 * 1024 ** 3,
+      "refuse parses whose f32 frame would exceed this (FrameSizeMonitor)")
+_knob("H2O_TPU_BINNED_STORE", "bool", True,
+      "train trees from the chunk store's int8/int16 binned view instead "
+      "of the stacked f32 matrix (frame/chunks.py); 0 reverts")
+
+# -- engine knobs -----------------------------------------------------------
+_knob("H2O_TPU_EXACT_BIN_ROWS", "int", 16384,
+      "rows at or below which tree binning may use exact small-data cuts")
+_knob("H2O_TPU_HIST_SEG_WIDTH", "int", 8,
+      "bin widths at/below this accumulate via segment-sum instead of the "
+      "one-hot matmul in the histogram scan (0 disables the path)")
+_knob("H2O_TPU_CLEAR_CACHES_EVERY", "int", 64,
+      "drop live XLA executables every N models (long-server hygiene; "
+      "0 = never)")
+_knob("H2O_TPU_PDP_BATCH_ROWS", "int", 2_000_000,
+      "row budget per batched partial-dependence predict")
+_knob("H2O_TPU_COMPILE_CACHE", "str", "",
+      "persistent XLA compile cache dir ('0' disables; empty = backend "
+      "default: on for accelerators, off for CPU)")
+
+# -- security ---------------------------------------------------------------
+_knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
+      "allow python: UDF references uploaded over the wire to execute")
+
+# -- external systems -------------------------------------------------------
+_knob("H2O_TPU_WEBHDFS_URL", "str", "",
+      "explicit WebHDFS endpoint for hdfs:// persist")
+_knob("H2O_TPU_WEBHDFS_PORT", "int", 9870,
+      "WebHDFS port when hdfs:// URIs carry none")
+_knob("H2O_TPU_HDFS_USER", "str", "",
+      "user.name forwarded to WebHDFS (default: $USER)")
+_knob("H2O_TPU_HIVE_JDBC", "str", "",
+      "Hive JDBC endpoint for ImportHiveTable")
+
+# -- bench.py ---------------------------------------------------------------
+_knob("H2O_TPU_BENCH_ROWS", "int", 11_000_000,
+      "rows for the HIGGS-shaped bench frame")
+_knob("H2O_TPU_BENCH_TREES", "int", 100,
+      "trees for the bench GBM legs")
+_knob("H2O_TPU_BENCH_SORT_ROWS", "int", 100_000_000,
+      "rows for the sort/merge bench legs")
+_knob("H2O_TPU_BENCH_AIRLINES_ROWS", "int", 116_000_000,
+      "rows for the airlines train-to-AUC leg")
+_knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
+      "rows for the binned-store stacked-vs-binned leg")
+_knob("H2O_TPU_BENCH_WORKLOADS", "str",
+      "gbm,glm,cod,gam,rulefit,sort,merge,binned,airlines",
+      "comma list of bench workloads to run")
+_knob("H2O_TPU_BENCH_SKIP_CADENCE", "bool", False,
+      "skip the score_tree_interval=10 GBM cadence leg")
+_knob("H2O_TPU_BENCH_SIDECAR", "str", "",
+      "path of the crash-proof per-workload JSONL sidecar "
+      "(default: BENCH_partial.jsonl next to bench.py)")
+
+# -- test harness -----------------------------------------------------------
+_knob("H2O_TPU_TEST_CACHE", "str", "",
+      "opt-in persistent XLA compile cache dir for the test suite")
+_knob("H2O_TPU_KEY_STRICT", "bool", False,
+      "fail tests on leaked KVStore keys instead of reaping them")
+
+
+def _lookup(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"unregistered knob {name!r} — declare it in "
+            f"h2o_tpu/utils/knobs.py (graftlint rule unregistered-knob "
+            f"enforces the same statically)") from None
+
+
+def raw(name: str, default=None):
+    """``os.environ.get`` semantics with the registration check: the raw
+    string when set, else ``default`` untouched (NOT the registered
+    default — this is the drop-in target for graftlint --fix rewrites)."""
+    _lookup(name)
+    return os.environ.get(name, default)
+
+
+def get_str(name: str, default=_MISSING) -> str:
+    """SET wins, even when set to the empty string — an exported-but-empty
+    string knob means "nothing" (e.g. H2O_TPU_BENCH_WORKLOADS= runs no
+    legs), not "give me the default"."""
+    k = _lookup(name)
+    v = os.environ.get(name)
+    if v is not None:
+        return v
+    return k.default if default is _MISSING else default
+
+
+def get_int(name: str, default=_MISSING) -> int:
+    """Unset OR empty falls back to the default (there is no useful int
+    reading of ""); sites that need set-but-empty to mean 0/disabled read
+    through ``raw`` and keep their own coercion."""
+    k = _lookup(name)
+    v = os.environ.get(name)
+    if v not in (None, ""):
+        return int(v)
+    d = k.default if default is _MISSING else default
+    return d if d is None else int(d)
+
+
+def get_bool(name: str, default=_MISSING) -> bool:
+    k = _lookup(name)
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        d = k.default if default is _MISSING else default
+        return bool(d)
+    return v.strip().lower() not in _FALSY
+
+
+def describe() -> str:
+    """Human-readable registry dump (the `printHelp` analog for env knobs)."""
+    lines = []
+    for k in sorted(KNOBS.values(), key=lambda k: k.name):
+        lines.append(f"{k.name}  [{k.kind}, default {k.default!r}]")
+        lines.append(f"    {k.doc}")
+    return "\n".join(lines)

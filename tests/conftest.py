@@ -27,7 +27,11 @@ jax.config.update("jax_platforms", "cpu")
 # cache on — for iterating on a few files it is a big win, for the full
 # suite determinism beats speed.
 #   H2O_TPU_TEST_CACHE=tests/.xla_cache python -m pytest tests/test_gbm.py
-_cache_dir = os.environ.get("H2O_TPU_TEST_CACHE")
+# (knobs import deliberately AFTER the jax platform pinning above — the
+# package import chain must see the CPU-mesh config)
+from h2o_tpu.utils import knobs  # noqa: E402
+
+_cache_dir = knobs.raw("H2O_TPU_TEST_CACHE")
 if _cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -70,14 +74,13 @@ def key_leak_rule(request):
     H2O_TPU_KEY_STRICT=1 to FAIL on leaks instead of reaping them (the
     reference rule's strict mode, for hunting untracked temporaries).
     """
-    import os
-
     from h2o_tpu.backend.kvstore import STORE
+    from h2o_tpu.utils.knobs import get_bool
 
     before = STORE.snapshot()
     yield
     leaked = STORE.snapshot() - before
-    if leaked and os.environ.get("H2O_TPU_KEY_STRICT", "0") not in ("", "0"):
+    if leaked and get_bool("H2O_TPU_KEY_STRICT"):
         for k in leaked:
             STORE.remove(k, cascade=False)
         pytest.fail(f"leaked keys: {sorted(leaked)} "
@@ -107,6 +110,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chunks: compressed columnar chunk store / binned views "
                    "(pytest -m chunks)")
+    config.addinivalue_line(
+        "markers", "graftlint: repo-native static-analysis gate and rule "
+                   "fixtures (pytest -m graftlint, tools/graftlint/)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -90,6 +90,19 @@ def test_categorical_codec_labelled_and_domain_kept():
     assert _bits_eq(np.asarray(cv.data), np.asarray(v.data))
 
 
+def test_coded_vec_setter_degrade_updates_plen():
+    """Overwriting a CodedVec's data degrades the codec to raw — and plen
+    must track the NEW buffer, or ensure_rollups' same-plen stacking groups
+    the vec with columns of the stale length."""
+    v = Vec.from_numpy(np.arange(64, dtype=np.float32))
+    cv = CodedVec.from_vec(v)
+    old_plen = cv.plen
+    new = jnp.zeros(old_plen * 2, jnp.float32)
+    cv.data = new
+    assert cv.meta.kind == "raw"
+    assert cv.plen == old_plen * 2 == cv.data.shape[0]
+
+
 def test_encode_column_padding_rows_stay_nan():
     col = np.arange(64, dtype=np.float32)
     buf = np.full(96, np.nan, np.float32)  # 32 padding rows

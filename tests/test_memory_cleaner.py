@@ -113,6 +113,11 @@ def test_hbm_budget_env_pin_and_cpu_none(_unresolved_hw):
     assert memory.hbm_budget_bytes() is None  # planners fall back
     monkeypatch.setenv("H2O_TPU_HBM_LIMIT_BYTES", "123456")
     assert memory.hbm_budget_bytes() == 123456
+    # the documented optargs contract: 0 means "backend resolution", never
+    # a 0-byte budget that would spill every vec on sight
+    monkeypatch.setenv("H2O_TPU_HBM_LIMIT_BYTES", "0")
+    assert memory.hbm_budget_bytes() is None
+    assert memory.Cleaner().limit_bytes() is None
 
 
 def test_hbm_budget_is_live_minus_resident(_unresolved_hw):

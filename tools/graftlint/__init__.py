@@ -1,0 +1,17 @@
+"""graftlint — repo-native static analysis for h2o_tpu's JAX hazard classes.
+
+CLI:    python -m tools.graftlint [paths ...] [--fix] [--baseline-update]
+Gate:   tests/test_graftlint.py (tier-1, marker `graftlint`)
+Rules:  tools/graftlint/rules.py (catalog + incident history)
+"""
+
+from .core import (BASELINE_PATH, DEFAULT_PATHS, REPO_ROOT, FileContext,
+                   Rule, Violation, apply_baseline, lint_paths, lint_source,
+                   load_baseline, main, write_baseline)
+from .rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES", "BASELINE_PATH", "DEFAULT_PATHS", "REPO_ROOT",
+    "FileContext", "Rule", "Violation", "apply_baseline", "lint_paths",
+    "lint_source", "load_baseline", "main", "write_baseline",
+]

@@ -1,0 +1,169 @@
+"""graftlint --fix — conservative auto-rewrites for the mechanical rules.
+
+Two fixers, both AST-located and text-applied (edits sorted bottom-up so
+offsets stay valid):
+
+- direct-shard-map: `from jax.experimental.shard_map import shard_map` /
+  `from jax import shard_map` becomes
+  `from h2o_tpu.parallel.mesh import shard_map`, and dotted call sites
+  (`jax.experimental.shard_map.shard_map(...)`) collapse to the imported
+  name. Only the plain spellings are rewritten — anything aliased or
+  star-imported is left for a human (the lint still flags it).
+- knob reads: `os.environ.get("H2O_TPU_X", d)` / `os.getenv("H2O_TPU_X")`
+  of a REGISTERED knob becomes `knobs.raw("H2O_TPU_X", d)` — behavior-
+  identical (raw string or the given default), with
+  `from h2o_tpu.utils import knobs` inserted after the last top-level
+  import if missing. Unregistered knobs are NOT fixable mechanically (the
+  fix is a registry declaration); they keep failing the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import REPO_ROOT, collect_aliases, dotted_name, iter_py_files, \
+    normalize
+from .rules import KNOBS_PATH, MESH_PATH, registered_knobs
+
+#: (start_line, start_col, end_line, end_col, replacement) — 1-based lines
+Edit = tuple[int, int, int, int, str]
+
+MESH_IMPORT = "from h2o_tpu.parallel.mesh import shard_map"
+KNOBS_IMPORT = "from h2o_tpu.utils import knobs"
+
+
+def _node_span(node: ast.AST) -> tuple[int, int, int, int]:
+    return (node.lineno, node.col_offset, node.end_lineno,
+            node.end_col_offset)
+
+
+def _apply_edits(source: str, edits: list[Edit]) -> str:
+    lines = source.splitlines(keepends=True)
+    for sl, sc, el, ec, rep in sorted(edits, reverse=True):
+        head = lines[sl - 1][:sc]
+        tail = lines[el - 1][ec:]
+        lines[sl - 1:el] = [head + rep + tail]
+    return "".join(lines)
+
+
+def _insert_import(source: str, tree: ast.Module, import_line: str) -> str:
+    """Insert ``import_line`` after the LEADING prelude — docstring,
+    __future__ and the contiguous top import block — never later: a module
+    may execute rewritten code between import groups (tests/conftest.py
+    reads env knobs mid-prelude), so inserting after the last import in the
+    file could place the import below its first use."""
+    if any(isinstance(n, (ast.Import, ast.ImportFrom))
+           and source.splitlines()[n.lineno - 1].strip() == import_line
+           for n in tree.body):
+        return source
+    prelude_end = 0
+    for n in tree.body:
+        is_doc = (n is tree.body[0] and isinstance(n, ast.Expr)
+                  and isinstance(n.value, ast.Constant)
+                  and isinstance(n.value.value, str))
+        if not (is_doc or isinstance(n, (ast.Import, ast.ImportFrom))):
+            break
+        prelude_end = n.end_lineno or n.lineno
+    lines = source.splitlines(keepends=True)
+    nl = "\n"
+    insert = import_line + nl
+    if prelude_end == 0:
+        # no docstring/imports — still respect a shebang (line 1) and a
+        # PEP 263 coding cookie (lines 1-2): both are position-sensitive
+        while (prelude_end < min(len(lines), 2)
+               and (lines[prelude_end].startswith("#!")
+                    or re.match(r"#.*coding[:=]", lines[prelude_end]))):
+            prelude_end += 1
+        if prelude_end == 0:
+            return insert + nl + source
+    return "".join(lines[:prelude_end] + [nl, insert]
+                   + lines[prelude_end:])
+
+
+def fix_shard_map_imports(source: str, relpath: str) -> str:
+    if relpath.replace(os.sep, "/") == MESH_PATH:
+        return source
+    tree = ast.parse(source)
+    edits: list[Edit] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            plain = [a for a in node.names
+                     if a.name == "shard_map" and a.asname is None]
+            # NOT the `from jax.experimental import shard_map` module form:
+            # its call sites spell `shard_map.shard_map(...)`, which a
+            # function import would break — the lint flags it for a human
+            if (mod in ("jax.experimental.shard_map", "jax") and plain
+                    and len(node.names) == 1):
+                edits.append((*_node_span(node), MESH_IMPORT))
+        elif isinstance(node, ast.Attribute):
+            dn = dotted_name(node)
+            if dn in ("jax.experimental.shard_map.shard_map",
+                      "jax.shard_map"):
+                edits.append((*_node_span(node), "shard_map"))
+    if not edits:
+        return source
+    fixed = _apply_edits(source, edits)
+    # an attribute rewrite needs the shim import in scope
+    if any(rep == "shard_map" for *_, rep in edits):
+        fixed = _insert_import(fixed, ast.parse(fixed), MESH_IMPORT)
+    return fixed
+
+
+def fix_knob_reads(source: str, relpath: str,
+                   registry: set[str] | None = None) -> str:
+    rel = relpath.replace(os.sep, "/")
+    if rel == KNOBS_PATH or rel.startswith("h2o_tpu/utils/"):
+        # knobs.py itself and its neighbors (optargs reads env generically)
+        return source
+    registry = registered_knobs() if registry is None else registry
+    tree = ast.parse(source)
+    aliases = collect_aliases(tree)
+    edits: list[Edit] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = normalize(dotted_name(node.func), aliases)
+        if fn not in ("os.environ.get", "os.getenv", "environ.get"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        if not name.startswith("H2O_TPU_") or name not in registry:
+            continue
+        if node.keywords:        # os.environ.get(key, default=...) — rare
+            continue
+        edits.append((*_node_span(node.func), "knobs.raw"))
+    if not edits:
+        return source
+    fixed = _apply_edits(source, edits)
+    return _insert_import(fixed, ast.parse(fixed), KNOBS_IMPORT)
+
+
+def fix_source(source: str, relpath: str,
+               registry: set[str] | None = None) -> str:
+    source = fix_shard_map_imports(source, relpath)
+    source = fix_knob_reads(source, relpath, registry=registry)
+    return source
+
+
+def fix_paths(paths, root: str = REPO_ROOT) -> list[str]:
+    """Apply all fixers in place; returns repo-relative paths changed."""
+    registry = registered_knobs(root)
+    changed = []
+    for ap in iter_py_files(paths, root):
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        with open(ap, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            fixed = fix_source(src, rel, registry=registry)
+        except SyntaxError:
+            continue
+        if fixed != src:
+            with open(ap, "w", encoding="utf-8") as f:
+                f.write(fixed)
+            changed.append(rel)
+    return changed

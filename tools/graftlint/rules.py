@@ -1,0 +1,531 @@
+"""graftlint rule catalog — 8 JAX hazard classes this repo has actually hit.
+
+Each rule cites the incident that motivated it (PR numbers refer to
+CHANGES.md entries):
+
+1. direct-shard-map      — PR 1: the seed suite was 100% import-broken on
+   jax 0.4.x because `shard_map` moved between jax versions; the
+   version-bridged shim in `h2o_tpu/parallel/mesh.py` is the ONLY sanctioned
+   import point (ROADMAP "jax version skew" item).
+2. pspec-concat          — PR 1: on jax 0.4.x `PartitionSpec.__add__`
+   returns a plain tuple, which shard_map rejects; specs must be built in
+   one constructor call (`parallel/mrtask.py` carries the war story).
+3. narrow-int-accumulate — PR 2: the binned histogram scan summed int8
+   codes; reductions over sub-int32 operands overflow silently on device.
+4. untracked-resident    — device arrays parked on objects bypass the HBM
+   Cleaner ledger (`backend/memory.py`) and silently distort every
+   budget-driven planner; residency must be Cleaner-tracked.
+5. timing-without-sync   — jax dispatch is async: a wall-clock delta over
+   un-synced device work measures dispatch, not compute (the bench JSONL
+   sidecar numbers exist to be trusted).
+6. host-sync-in-trace    — `.item()`/`float()`/`np.asarray` on traced
+   values fail under jit, or worse: silently bake a trace-time constant in.
+7. nondeterminism-in-trace — `np.random`/`time.time()` inside traced code
+   executes ONCE at trace time; every later call replays the frozen value.
+8. unregistered-knob     — literal `H2O_TPU_*` env reads must be declared
+   in `h2o_tpu/utils/knobs.py` so the knob surface stays documented and
+   greppable (the OptArgs discipline, enforced).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import (REPO_ROOT, FileContext, Rule, Violation, dotted_name,
+                   function_scopes, normalize, scope_statements)
+
+#: the one sanctioned shard_map definition site
+MESH_PATH = "h2o_tpu/parallel/mesh.py"
+KNOBS_PATH = "h2o_tpu/utils/knobs.py"
+
+_NARROW_INTS = {"int8", "int16", "uint8", "uint16"}
+_WIDE_TYPES = {"int32", "int64", "uint32", "uint64",
+               "float32", "float64", "bfloat16", "float16"}
+
+
+def _norm_func(node: ast.Call, ctx: FileContext) -> str | None:
+    return normalize(dotted_name(node.func), ctx.aliases)
+
+
+class DirectShardMap(Rule):
+    id = "direct-shard-map"
+    doc = ("shard_map imported/used outside h2o_tpu/parallel/mesh.py — "
+           "route through the version-bridged shim (jax 0.4.x skew)")
+
+    def check(self, tree, ctx):
+        if ctx.relpath == MESH_PATH:
+            return []
+        out = []
+        spans: list[tuple] = []  # flagged attribute-chain spans
+        msg = ("direct jax shard_map use — import it from "
+               "h2o_tpu.parallel.mesh (the version-bridged shim; "
+               "ROADMAP 'jax version skew')")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = {a.name for a in node.names}
+                if (mod == "jax.experimental.shard_map"
+                        or (mod in ("jax", "jax.experimental")
+                            and "shard_map" in names)):
+                    out.append(self.violation(ctx, node, msg))
+            elif isinstance(node, ast.Import):
+                if any(a.name.startswith("jax.experimental.shard_map")
+                       for a in node.names):
+                    out.append(self.violation(ctx, node, msg))
+            elif isinstance(node, ast.Attribute):
+                dn = normalize(dotted_name(node), ctx.aliases)
+                if dn and (dn == "jax.shard_map"
+                           or "experimental.shard_map" in dn):
+                    # outermost matching attribute only: ast.walk visits
+                    # outer before inner, so skip a chain whose span is
+                    # CONTAINED in an already-flagged one (two disjoint
+                    # uses on one line both report)
+                    lo = (node.lineno, node.col_offset)
+                    hi = (node.end_lineno, node.end_col_offset)
+                    if not any(s0 <= lo and hi <= s1 for s0, s1 in spans):
+                        spans.append((lo, hi))
+                        out.append(self.violation(ctx, node, msg))
+        return out
+
+
+class PSpecConcat(Rule):
+    id = "pspec-concat"
+    doc = ("PartitionSpec combined via '+' — jax 0.4.x __add__ returns a "
+           "raw tuple; build the spec in one constructor call")
+
+    _CTORS = {"PartitionSpec", "P"}
+
+    def _is_spec(self, node, spec_vars) -> bool:
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            return bool(dn) and dn.split(".")[-1] in self._CTORS
+        if isinstance(node, ast.Name):
+            return node.id in spec_vars
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            # nested concat chains: (P(a) + P(b)) + P(c)
+            return (self._is_spec(node.left, spec_vars)
+                    or self._is_spec(node.right, spec_vars))
+        return False
+
+    def check(self, tree, ctx):
+        out = []
+        for scope in function_scopes(tree):
+            spec_vars: set[str] = set()
+            spans: list[tuple] = []  # flagged BinOp spans (outermost wins)
+            stmts = sorted(scope_statements(scope),
+                           key=lambda n: (getattr(n, "lineno", 0),
+                                          getattr(n, "col_offset", 0)))
+            for node in stmts:
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and self._is_spec(node.value, spec_vars)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            spec_vars.add(t.id)
+                if (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Add)
+                        and (self._is_spec(node.left, spec_vars)
+                             or self._is_spec(node.right, spec_vars))):
+                    # one violation per chain: the sorted order visits the
+                    # OUTERMOST BinOp of `(P(a)+P(b))+P(c)` first, and the
+                    # inner adds live inside its span
+                    lo = (node.lineno, node.col_offset)
+                    hi = (node.end_lineno, node.end_col_offset)
+                    if any(s0 <= lo and hi <= s1 for s0, s1 in spans):
+                        continue
+                    spans.append((lo, hi))
+                    out.append(self.violation(
+                        ctx, node,
+                        "PartitionSpec '+' concatenation — on jax 0.4.x "
+                        "P.__add__ returns a plain tuple (shard_map rejects "
+                        "it); pass all axes to one PartitionSpec(...) call"))
+        return out
+
+
+class NarrowIntAccumulate(Rule):
+    id = "narrow-int-accumulate"
+    doc = ("jnp.sum/segment_sum/psum over int8/int16 operands without an "
+           "explicit int32 upcast — silent on-device overflow")
+
+    _ACCUM = {"jnp.sum", "lax.psum", "jnp.cumsum", "lax.psum_scatter"}
+    _ACCUM_SUFFIX = ("segment_sum",)
+
+    def _dtype_of(self, node) -> str | None:
+        """Name of the dtype an expression mentions ('int8', 'float32'...),
+        for the handful of spellings the repo uses."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        dn = dotted_name(node)
+        if dn:
+            return dn.split(".")[-1]
+        return None
+
+    def _is_narrow_expr(self, node, narrow_vars) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in narrow_vars
+        if isinstance(node, ast.Call):
+            # x.astype(jnp.int8) / x.astype("int16")
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("astype", "view") and node.args):
+                return self._dtype_of(node.args[0]) in _NARROW_INTS
+            # jnp.zeros(shape, jnp.int8) / jnp.asarray(x, dtype=jnp.int8)
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return self._dtype_of(kw.value) in _NARROW_INTS
+            if len(node.args) >= 2:
+                if self._dtype_of(node.args[-1]) in _NARROW_INTS:
+                    return True
+        if isinstance(node, ast.BinOp):
+            return (self._is_narrow_expr(node.left, narrow_vars)
+                    or self._is_narrow_expr(node.right, narrow_vars))
+        return False
+
+    def _has_upcast(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return self._dtype_of(kw.value) in _WIDE_TYPES
+        if call.args:
+            a = call.args[0]
+            if (isinstance(a, ast.Call)
+                    and isinstance(a.func, ast.Attribute)
+                    and a.func.attr == "astype" and a.args
+                    and self._dtype_of(a.args[0]) in _WIDE_TYPES):
+                return True
+        return False
+
+    def check(self, tree, ctx):
+        out = []
+        for scope in function_scopes(tree):
+            narrow_vars: set[str] = set()
+            stmts = sorted(scope_statements(scope),
+                           key=lambda n: (getattr(n, "lineno", 0),
+                                          getattr(n, "col_offset", 0)))
+            # pass 1: variables bound to narrow-int expressions
+            for node in stmts:
+                if isinstance(node, ast.Assign):
+                    if self._is_narrow_expr(node.value, narrow_vars):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                narrow_vars.add(t.id)
+                    else:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                narrow_vars.discard(t.id)
+            # pass 2: accumulations over narrow operands
+            for node in stmts:
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = _norm_func(node, ctx)
+                is_accum = (fn in self._ACCUM
+                            or (fn or "").endswith(self._ACCUM_SUFFIX))
+                # narrow_arr.sum() method form
+                if (not is_accum and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("sum", "cumsum")
+                        and self._is_narrow_expr(node.func.value,
+                                                 narrow_vars)):
+                    is_accum = True
+                    arg = node.func.value
+                else:
+                    arg = node.args[0] if node.args else None
+                if not is_accum or arg is None:
+                    continue
+                if (self._is_narrow_expr(arg, narrow_vars)
+                        and not self._has_upcast(node)):
+                    out.append(self.violation(
+                        ctx, node,
+                        "accumulation over a sub-int32 operand — pass "
+                        "dtype=jnp.int32 or .astype(jnp.int32) first "
+                        "(PR 2 binned-histogram overflow class)"))
+        return out
+
+
+class UntrackedResident(Rule):
+    id = "untracked-resident"
+    doc = ("device array assigned to self.* in frame/ or models/ classes "
+           "with no Cleaner.track/_put_sharding registration — silent HBM "
+           "ledger leak vs backend/memory.py")
+
+    _SCOPES = ("h2o_tpu/frame/", "h2o_tpu/models/")
+    _TRACKED_BASES = {"Vec", "CodedVec", "BinnedView", "Keyed", "Frame"}
+
+    def _device_expr(self, node, ctx) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = _norm_func(node, ctx)
+        if fn is None:
+            return False
+        return (fn.startswith("jnp.")
+                or fn in ("jax.device_put", "jax.make_array_from_callback"))
+
+    def check(self, tree, ctx):
+        if not ctx.relpath.startswith(self._SCOPES):
+            return []
+        out = []
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            base_names = {dn.split(".")[-1] for dn in
+                          (dotted_name(b) for b in cls.bases) if dn}
+            if base_names & self._TRACKED_BASES:
+                continue  # Vec/Keyed subclasses register via __init__
+            registered = False
+            for node in ast.walk(cls):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr in ("track", "_put_sharding")):
+                    registered = True
+                    break
+            if registered:
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not self._device_expr(node.value, ctx):
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.append(self.violation(
+                            ctx, node,
+                            f"device array parked on self.{t.attr} with no "
+                            f"Cleaner.track/_put_sharding registration — "
+                            f"invisible to the HBM ledger "
+                            f"(backend/memory.py)"))
+        return out
+
+
+class TimingWithoutSync(Rule):
+    id = "timing-without-sync"
+    doc = ("wall-clock delta spans jax dispatch with no block_until_ready/"
+           "device_get — measures dispatch, not compute")
+
+    _CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+               "perf_counter", "monotonic"}
+    #: repo entry points that dispatch device work behind a host call.
+    #: train_model is NOT here: ModelBuilder.train drains the model's
+    #: device arrays before returning (model_base.py), so timing around it
+    #: is honest by contract — and that contract is itself lint-protected,
+    #: because model_base.run's own timed window classifies build_impl as
+    #: dispatch and needs the block_until_ready to stay clean.
+    _DISPATCH_METHODS = {"build_impl"}
+    _SYNC_NAMES = {"block_until_ready", "device_get", "to_numpy", "item"}
+    _SYNC_FULL = {"np.asarray", "np.array"}
+    _BENIGN_JAX = {"jax.devices", "jax.local_devices", "jax.device_count",
+                   "jax.default_backend", "jax.process_index",
+                   "jax.process_count", "jax.clear_caches",
+                   "jax.config.update", "jax.debug.print"}
+
+    def _is_clock(self, node, ctx) -> bool:
+        return (isinstance(node, ast.Call)
+                and _norm_func(node, ctx) in self._CLOCKS)
+
+    def _classify(self, node: ast.Call, ctx) -> str | None:
+        """'sync' | 'dispatch' | None for a call node."""
+        fn = _norm_func(node, ctx)
+        last = (fn or (node.func.attr if isinstance(node.func, ast.Attribute)
+                       else "")).split(".")[-1]
+        if fn in self._SYNC_FULL or last in self._SYNC_NAMES:
+            return "sync"
+        if last in self._DISPATCH_METHODS:
+            return "dispatch"
+        if fn is None:
+            return None
+        if fn in self._BENIGN_JAX or fn in self._CLOCKS:
+            return None
+        if (fn.startswith(("jnp.", "lax.", "jax."))
+                or fn in ("jnp", "lax")):
+            return "dispatch"
+        return None
+
+    def check(self, tree, ctx):
+        out = []
+        for scope in function_scopes(tree):
+            starts: dict[str, list[int]] = {}   # timer var -> assign lines
+            deltas: list[tuple[int, ast.BinOp, str]] = []
+            calls: list[tuple[int, str]] = []
+            for node in scope_statements(scope):
+                if (isinstance(node, ast.Assign)
+                        and self._is_clock(node.value, ctx)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            starts.setdefault(t.id, []).append(node.lineno)
+                elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                                ast.Sub):
+                    if (self._is_clock(node.left, ctx)
+                            and isinstance(node.right, ast.Name)):
+                        deltas.append((node.lineno, node, node.right.id))
+                elif isinstance(node, ast.Call):
+                    kind = self._classify(node, ctx)
+                    if kind:
+                        calls.append((node.lineno, kind))
+            for dline, dnode, tvar in deltas:
+                cands = [ln for ln in starts.get(tvar, []) if ln < dline]
+                if not cands:
+                    continue
+                t0 = max(cands)  # the LATEST restart before this read
+                window = [(ln, k) for ln, k in calls if t0 < ln <= dline]
+                if (any(k == "dispatch" for _, k in window)
+                        and not any(k == "sync" for _, k in window)):
+                    out.append(self.violation(
+                        ctx, dnode,
+                        f"timed window (line {t0}..{dline}) spans jax "
+                        f"dispatch with no block_until_ready/device_get — "
+                        f"the delta measures dispatch, not compute"))
+        return out
+
+
+class HostSyncInTrace(Rule):
+    id = "host-sync-in-trace"
+    doc = (".item()/float()/bool()/np.asarray on traced values inside "
+           "jit/scan/shard_map bodies — fails under jit or bakes in a "
+           "trace-time constant")
+
+    _CASTS = {"float", "bool"}
+    _FULL = {"np.asarray", "np.array", "jax.device_get"}
+
+    @staticmethod
+    def _static_arg(node) -> bool:
+        """Arguments that are trace-static: literals, or anything derived
+        from .shape/.ndim/.size/.dtype/len() (python ints at trace time)."""
+        if isinstance(node, ast.Constant):
+            return True
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                    "shape", "ndim", "size", "dtype", "itemsize"):
+                return True
+            if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "len"):
+                return True
+        return False
+
+    def check(self, tree, ctx):
+        out = []
+        seen: set[int] = set()
+        for fn in ctx.traced:
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    seen.add(id(node))
+                    msg = None
+                    if (isinstance(node.func, ast.Name)
+                            and node.func.id in self._CASTS
+                            and node.args
+                            and not self._static_arg(node.args[0])):
+                        msg = (f"{node.func.id}() on a traced value inside "
+                               f"a jit/scan/shard_map body")
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "item"):
+                        msg = ".item() on a traced value inside a traced body"
+                    elif _norm_func(node, ctx) in self._FULL:
+                        msg = (f"{_norm_func(node, ctx)} inside a traced "
+                               f"body forces a host sync at trace time")
+                    if msg:
+                        out.append(self.violation(
+                            ctx, node, msg + " — fails under jit or "
+                            "freezes a trace-time constant"))
+        return out
+
+
+class NondeterminismInTrace(Rule):
+    id = "nondeterminism-in-trace"
+    doc = ("np.random/time.time reachable from traced code — the value "
+           "freezes at trace time and silently replays")
+
+    _PREFIXES = ("np.random.", "random.")
+    _FULL = {"time.time", "time.perf_counter", "time.monotonic",
+             "time.time_ns", "uuid.uuid4", "np.random"}
+
+    def check(self, tree, ctx):
+        out = []
+        seen: set[int] = set()
+        for fn in ctx.traced:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                f = _norm_func(node, ctx)
+                if f and (f in self._FULL
+                          or f.startswith(self._PREFIXES)):
+                    out.append(self.violation(
+                        ctx, node,
+                        f"{f}() inside a traced body executes ONCE at "
+                        f"trace time — use jax.random with a threaded key "
+                        f"(or hoist the host value out of the trace)"))
+        return out
+
+
+def registered_knobs(root: str = REPO_ROOT) -> set[str]:
+    """Knob names declared in h2o_tpu/utils/knobs.py — read via AST so the
+    linter never imports the (jax-heavy) package it lints."""
+    path = os.path.join(root, KNOBS_PATH)
+    names: set[str] = set()
+    if not os.path.exists(path):
+        return names
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("H2O_TPU_")
+                and dotted_name(node.func) in ("_knob", "Knob")):
+            names.add(node.args[0].value)
+    return names
+
+
+class UnregisteredKnob(Rule):
+    id = "unregistered-knob"
+    doc = ("literal H2O_TPU_* env read not declared in the "
+           "h2o_tpu/utils/knobs.py registry")
+
+    _GETTERS = {"os.environ.get", "os.getenv", "environ.get"}
+
+    def __init__(self, registry: set[str] | None = None):
+        self._registry = registry
+
+    @property
+    def registry(self) -> set[str]:
+        if self._registry is None:
+            self._registry = registered_knobs()
+        return self._registry
+
+    def _flag(self, ctx, node, name):
+        return self.violation(
+            ctx, node,
+            f"env knob {name!r} is not declared in h2o_tpu/utils/knobs.py "
+            f"— register it (name, default, docstring) so the knob surface "
+            f"stays documented")
+
+    def check(self, tree, ctx):
+        if ctx.relpath == KNOBS_PATH:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = normalize(dotted_name(node.func), ctx.aliases)
+                if (fn in self._GETTERS and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    name = node.args[0].value
+                    if (name.startswith("H2O_TPU_")
+                            and name not in self.registry):
+                        out.append(self._flag(ctx, node, name))
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)):
+                base = normalize(dotted_name(node.value), ctx.aliases)
+                if base in ("os.environ", "environ"):
+                    sl = node.slice
+                    if (isinstance(sl, ast.Constant)
+                            and isinstance(sl.value, str)
+                            and sl.value.startswith("H2O_TPU_")
+                            and sl.value not in self.registry):
+                        out.append(self._flag(ctx, node, sl.value))
+        return out
+
+
+ALL_RULES = (DirectShardMap, PSpecConcat, NarrowIntAccumulate,
+             UntrackedResident, TimingWithoutSync, HostSyncInTrace,
+             NondeterminismInTrace, UnregisteredKnob)
