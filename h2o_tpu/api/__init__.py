@@ -35,6 +35,9 @@ from .client import (H2OAdaBoostEstimator, H2OANOVAGLMEstimator,
                      H2OTargetEncoderEstimator,
                      H2OUpliftRandomForestEstimator, H2OWord2vecEstimator,
                      H2OXGBoostEstimator)
+from .client import (H2OServingOverloadError, H2OServingTimeoutError,
+                     register_serving, score_rows, serving_stats,
+                     unregister_serving)
 from .client import H2OAutoML, H2OGridSearch, load_grid, save_grid
 from .client import (create_frame, download_csv, insert_missing_values,
                      log_and_echo, remove_all, split_frame_rest)

@@ -28,7 +28,24 @@ _conn = None
 
 
 class H2OConnectionError(Exception):
-    pass
+    """REST-level failure. Carries ``status`` (HTTP code), ``headers`` and
+    the parsed error ``payload`` when the server replied at all — typed
+    helpers (serving's 429/408 mapping) key off those."""
+
+    status: int | None = None
+    headers: dict | None = None
+    payload: dict | None = None
+
+
+class H2OServingOverloadError(H2OConnectionError):
+    """`POST /3/Serving/score` hit a full queue (HTTP 429): back off for
+    ``retry_after_s`` (the server's Retry-After drain estimate)."""
+
+    retry_after_s: float = 0.0
+
+
+class H2OServingTimeoutError(H2OConnectionError):
+    """`POST /3/Serving/score` missed its deadline while queued (408)."""
 
 
 class H2OConnection:
@@ -106,11 +123,20 @@ class H2OConnection:
                 text = resp.read().decode()
                 return text if raw else json.loads(text)
         except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            payload = None
+            msg = str(e)
             try:
-                payload = json.loads(e.read().decode())
-                raise H2OConnectionError(payload.get("msg", str(e)))
-            except (ValueError, KeyError):
-                raise H2OConnectionError(str(e))
+                payload = json.loads(body)
+                if isinstance(payload, dict):
+                    msg = payload.get("msg", str(e))
+            except ValueError:
+                pass
+            err = H2OConnectionError(msg)
+            err.status = e.code
+            err.headers = dict(e.headers or {})
+            err.payload = payload if isinstance(payload, dict) else None
+            raise err
         except urllib.error.URLError as e:
             err = H2OConnectionError(f"no H2O server at {self.url}: {e}")
             err.no_server = True  # distinguishes "nothing listening" from
@@ -568,6 +594,77 @@ def rapids(expr: str) -> dict:
     c = connection()
     return c.request("POST", "/99/Rapids",
                      data={"ast": expr, "session_id": c.session()})
+
+
+# ---------------------------------------------------------------------------
+# online scoring (`/3/Serving/...` — the h2o_tpu/serving/ runtime)
+# ---------------------------------------------------------------------------
+def register_serving(model=None, serving_id: str | None = None,
+                     mojo_file: str | None = None, **options) -> dict:
+    """Register a model for online scoring and warm up its bucket scorers
+    (`POST /3/Serving/models/{id}`). ``model`` is an in-STORE model (client
+    handle, estimator, or key string); alternatively ``mojo_file`` names a
+    MOJO zip on the server's filesystem (or a PostFile upload key).
+    ``options`` forwards the serving overrides (buckets, max_batch,
+    max_wait_us, queue_depth, deadline_ms, stats_window, strict_levels).
+    Returns the registration info (buckets, warmup_compiles, ...)."""
+    data = dict(options)
+    if mojo_file is not None:
+        data["mojo_file"] = mojo_file
+        sid = serving_id or os.path.basename(mojo_file).rsplit(".", 1)[0]
+    else:
+        if model is None:
+            raise ValueError("register_serving needs a model or a mojo_file")
+        data["model_id"] = _model_id_of(model)
+        sid = serving_id or data["model_id"]
+    return connection().request(
+        "POST", f"/3/Serving/models/{urllib.parse.quote(sid)}", data=data)
+
+
+def score_rows(serving_id: str, rows, deadline_ms=None) -> list:
+    """Score one row dict or a list of them through the micro-batched
+    runtime (`POST /3/Serving/score`); returns one typed prediction dict
+    per row. Raises `H2OServingOverloadError` (queue full, carries
+    ``retry_after_s``) and `H2OServingTimeoutError` (deadline expired) so
+    callers can back off / retry instead of parsing status codes."""
+    if isinstance(rows, dict):
+        rows = [rows]
+    data: dict = {"model_id": serving_id, "rows": list(rows)}
+    if deadline_ms is not None:
+        data["deadline_ms"] = deadline_ms
+    try:
+        resp = connection().request("POST", "/3/Serving/score", data=data)
+    except H2OConnectionError as e:
+        if e.status == 429:
+            err = H2OServingOverloadError(str(e))
+            err.status, err.headers, err.payload = (e.status, e.headers,
+                                                    e.payload)
+            err.retry_after_s = float(
+                (e.payload or {}).get("retry_after_s")
+                or (e.headers or {}).get("Retry-After") or 0.0)
+            raise err from None
+        if e.status == 408:
+            err = H2OServingTimeoutError(str(e))
+            err.status, err.headers, err.payload = (e.status, e.headers,
+                                                    e.payload)
+            raise err from None
+        raise
+    return resp["predictions"]
+
+
+def serving_stats(serving_id: str | None = None) -> dict:
+    """`GET /3/Serving/stats[/{id}]` → {model_id: snapshot} (p50/p95/p99
+    latency, rows/s, mean batch occupancy, queue depth, counters)."""
+    path = "/3/Serving/stats"
+    if serving_id is not None:
+        path += f"/{urllib.parse.quote(serving_id)}"
+    return connection().request("GET", path)["models"]
+
+
+def unregister_serving(serving_id: str) -> dict:
+    """`DELETE /3/Serving/models/{id}` — stop the model's batcher."""
+    return connection().request(
+        "DELETE", f"/3/Serving/models/{urllib.parse.quote(serving_id)}")
 
 
 # ---------------------------------------------------------------------------

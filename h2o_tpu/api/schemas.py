@@ -194,6 +194,21 @@ def metrics_schema(m) -> dict | None:
     return out
 
 
+def serving_model_schema(info: dict) -> dict:
+    """Wire shape of a serving registration (`POST /3/Serving/models/{id}`):
+    the ServedModel.info() dict plus a key ref, JSON-cleaned."""
+    out = _clean(dict(info))
+    out["serving_model_id"] = key_schema(info["model_id"],
+                                         "Key<ServingModel>")
+    return out
+
+
+def serving_stats_schema(stats: dict) -> dict:
+    """Wire shape of `GET /3/Serving/stats`: {model_id: snapshot} from
+    `serving/stats.py`, JSON-cleaned (NaN-free percentiles)."""
+    return {"models": _clean(stats)}
+
+
 def model_schema(model) -> dict:
     """`water/api/schemas3/ModelSchemaV3` (summary form)."""
     o = model.output

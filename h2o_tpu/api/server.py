@@ -244,6 +244,7 @@ def _make_handler(server: H2OServer):
         # -- plumbing --------------------------------------------------------
         def _reply(self, status: int, payload: dict):
             filename = None
+            extra_headers = payload.pop("__headers__", None)
             if "__html__" in payload:
                 data = payload["__html__"].encode()
                 ctype = "text/html; charset=utf-8"
@@ -259,6 +260,10 @@ def _make_handler(server: H2OServer):
                 ctype = "application/json"
             self.send_response(status)
             self.send_header("Content-Type", ctype)
+            for hk, hv in (extra_headers or {}).items():
+                # route-supplied headers (Serving's Retry-After); values are
+                # server-generated numbers/tokens, never client echoes
+                self.send_header(hk, str(hv))
             if filename:
                 # frame keys are client-controlled; anything outside a safe
                 # charset could malform the header or inject CR/LF
@@ -518,6 +523,128 @@ def _maybe_decrypt(path: str, name: str, p: dict) -> tuple[str, str, str | None]
     tf.write(plain)
     tf.close()
     return tf.name, name, tf.name
+
+
+def _serving_route(method: str, rest: list[str], p: dict) -> tuple[int, dict]:
+    """`/3/Serving/...` — the online scoring runtime (`h2o_tpu/serving/`).
+
+    - ``POST /3/Serving/models/{id}``: register + warm up (in-STORE model
+      key via ``model_id``, or a MOJO zip/dir via ``mojo_file`` — a local
+      path or a PostFile upload key).
+    - ``DELETE /3/Serving/models/{id}``: unregister, stop its batcher.
+    - ``POST /3/Serving/score``: row-dict scoring (``row`` or ``rows``);
+      queue-full → 429 + Retry-After, deadline expiry → 408 — typed,
+      never hanging.
+    - ``GET /3/Serving/stats[/{id}]``: latency percentiles, throughput,
+      batch occupancy, queue depth, recompile/rejection counters.
+    """
+    from .. import serving
+    from ..serving.errors import (DeadlineExceededError,
+                                  ModelNotRegisteredError, QueueFullError,
+                                  ServingShutdownError,
+                                  UnsupportedModelError)
+
+    rt = serving.get_runtime()
+    sub = rest[1] if len(rest) > 1 else ""
+
+    if sub == "models" and len(rest) >= 3:
+        sid = urllib.parse.unquote(rest[2])
+        if method == "GET":
+            try:
+                return 200, schemas.serving_model_schema(rt.model(sid).info())
+            except ModelNotRegisteredError as e:
+                return _err(404, str(e))
+        if method == "DELETE":
+            try:
+                rt.unregister(sid)
+            except ModelNotRegisteredError as e:
+                return _err(404, str(e))
+            return 200, {"model_id": sid, "unregistered": True}
+        if method == "POST":
+            overrides = {k: p[k] for k in
+                         ("buckets", "max_batch", "max_wait_us",
+                          "queue_depth", "deadline_ms", "stats_window")
+                         if p.get(k) not in (None, "")}
+            if isinstance(overrides.get("buckets"), str):
+                overrides["buckets"] = [
+                    int(t) for t in overrides["buckets"].split(",")
+                    if t.strip()]
+            strict = _truthy(p.get("strict_levels"))
+            try:
+                if p.get("mojo_file"):
+                    path, _name = _resolve_upload(str(p["mojo_file"]))
+                    if not os.path.exists(path):
+                        return _err(404, f"no MOJO at '{p['mojo_file']}'")
+                    info = rt.register_mojo(path, sid, overrides=overrides,
+                                            strict_levels=strict)
+                else:
+                    mid = p.get("model_id") or sid
+                    model = STORE.get(mid)
+                    if model is None:
+                        return _err(404, f"model {mid} not found")
+                    info = rt.register_model(model, sid,
+                                             overrides=overrides,
+                                             strict_levels=strict)
+            except (UnsupportedModelError, NotImplementedError) as e:
+                # NotImplementedError: a model's score_raw declares the
+                # matrix path unsupported at trace time (GLM interactions)
+                # — a client-input problem, not a server fault
+                return _err(400, str(e), error_type="unsupported_model")
+            return 200, schemas.serving_model_schema(info)
+
+    if sub == "score" and method == "POST":
+        sid = p.get("model_id", "")
+        rows = p.get("rows")
+        if rows is None:
+            row = p.get("row")
+            rows = [row] if row is not None else None
+        if not rows or not all(isinstance(r, dict) for r in rows):
+            return _err(400, "score needs 'row' (dict) or 'rows' "
+                             "(list of dicts)")
+        deadline_ms = p.get("deadline_ms")
+        try:
+            preds = rt.score(sid, rows,
+                             deadline_ms=None if deadline_ms in (None, "")
+                             else float(deadline_ms))
+        except ModelNotRegisteredError as e:
+            return _err(404, str(e))
+        except ServingShutdownError as e:
+            # raced a DELETE / re-registration: the looked-up lane died
+            # under the request — retryable conflict, not a server fault
+            return _err(409, str(e), error_type="model_shutdown")
+        except QueueFullError as e:
+            status, payload = _err(
+                429, str(e), error_type="queue_full",
+                retry_after_s=round(e.retry_after_s, 3))
+            payload["__headers__"] = {
+                "Retry-After": max(1, int(np.ceil(e.retry_after_s)))}
+            return status, payload
+        except DeadlineExceededError as e:
+            return _err(408, str(e), error_type="deadline_exceeded")
+        return 200, {"model_id": sid, "predictions": preds,
+                     "count": len(preds)}
+
+    if sub == "stats" and method == "GET":
+        if len(rest) > 2:
+            sid = urllib.parse.unquote(rest[2])
+            try:
+                snap = rt.stats(sid)
+            except ModelNotRegisteredError as e:
+                return _err(404, str(e))
+            return 200, schemas.serving_stats_schema({sid: snap})
+        return 200, schemas.serving_stats_schema(rt.stats())
+
+    if sub == "models" and method == "GET":
+        infos = []
+        for mid in rt.model_ids():
+            try:
+                infos.append(rt.model(mid).info())
+            except ModelNotRegisteredError:
+                pass  # unregistered between the listing and the lookup
+        return 200, {"models": infos}
+
+    return _err(404, f"no serving route for {method} "
+                     f"/{'/'.join(['3'] + rest)}")
 
 
 def route(server: H2OServer, method: str, parts: list[str], query: dict,
@@ -1002,6 +1129,10 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         m = persist.load_model(uf.path)
         STORE.remove(src)
         return 200, {"models": [schemas.model_schema(m)]}
+
+    # -- online scoring (`h2o_tpu/serving/` runtime) -------------------------
+    if head == "Serving":
+        return _serving_route(method, rest, p)
 
     # -- predictions ---------------------------------------------------------
     if head == "Predictions" and method == "POST":
@@ -2238,6 +2369,13 @@ _ROUTES_DOC = [
         ("POST", "/3/ModelBuilders/{algo}/model_id", "fresh unique model id"),
         ("DELETE", "/3/Models/{id}", "remove a model"),
         ("DELETE", "/3/Models", "remove all models"),
+        ("POST", "/3/Serving/models/{id}",
+         "register + warm up a model (or MOJO) for online scoring"),
+        ("DELETE", "/3/Serving/models/{id}", "unregister a served model"),
+        ("POST", "/3/Serving/score",
+         "micro-batched row-dict scoring (429/408 on overload/deadline)"),
+        ("GET", "/3/Serving/stats",
+         "serving latency/throughput/occupancy/queue stats"),
         ("POST", "/3/Predictions/models/{m}/frames/{f}", "score a frame"),
         ("POST", "/4/Predictions/models/{m}/frames/{f}",
          "score a frame asynchronously (job)"),

@@ -118,6 +118,44 @@ class DataInfo:
         bad = valid if valid is not None else jnp.zeros(X.shape[0], jnp.bool_)
         return X, ~bad
 
+    def expand_matrix(self, X):
+        """Raw (N, len(names)) matrix → expanded (N, P) design, columns in
+        ``self.names`` order with categoricals as training-domain codes.
+
+        The traceable twin of ``expand()`` for callers that hold a matrix
+        instead of a Frame (the serving runtime's compiled scorers): same
+        per-column treatment — NA/out-of-domain categoricals impute to the
+        mode before one-hot, numerics impute to the mean then center/scale
+        — so a row expanded here is bit-identical to the same row expanded
+        through a Frame. No valid-row mask: serving always imputes
+        (MeanImputation semantics), it never drops rows.
+        """
+        blocks = []
+        for j, n in enumerate(self.names):
+            col = X[:, j]
+            if n in self.domains:
+                card = len(self.domains[n])
+                # (col < 0) has no twin in expand(): frame codes can never
+                # be negative, but a serving client CAN send a negative
+                # pre-encoded level index — treat it like any other
+                # invalid level (mode imputation), not as the one_hot
+                # all-zeros row that aliases the dropped baseline level
+                isna = jnp.isnan(col) | (col < 0) | (col >= card)
+                codes = jnp.where(isna, self.cat_modes[n],
+                                  col).astype(jnp.int32)
+                oh = jax.nn.one_hot(codes, card, dtype=jnp.float32)
+                lo = 0 if self.use_all_factor_levels else 1
+                blocks.append(oh[:, lo:])
+            else:
+                isna = jnp.isnan(col)
+                x = jnp.where(isna, self.num_means[n], col)
+                if self.effective_center:
+                    x = x - self.num_means[n]
+                if self.standardize:
+                    x = x / self.num_sigmas[n]
+                blocks.append(x[:, None])
+        return jnp.concatenate(blocks, axis=1)
+
 
 def _remap_codes(v, train_dom):
     remap = {lvl: i for i, lvl in enumerate(train_dom)}

@@ -957,6 +957,39 @@ class GLMModel(Model):
         X, ok = self.dinfo.expand(fr)
         return X
 
+    def score_raw(self, X):
+        """Serving-path scoring straight from the raw (B, F) feature matrix
+        (columns in output.names order): reorder into the DataInfo's
+        cats-first layout, expand to the design matrix, then score — the
+        traceable twin of ``adapt_frame``+``score0``.
+
+        The linear predictor is an elementwise-mul + row-sum rather than
+        score0's ``X @ beta``: XLA CPU's dot picks shape-dependent
+        accumulation strategies, so the SAME row matmul'd in a (1, P) and
+        an (8, P) batch can differ in the last ulp — which breaks the
+        serving contract that padded-batch outputs are BIT-identical to
+        single-row outputs across bucket sizes. A per-row reduction is
+        batch-size-invariant (measured: matmul maxdiff 1 ulp, mul+sum 0).
+        """
+        if self.interaction_spec or self.interaction_cols or \
+                getattr(self.output, "encoding_state", None) is not None:
+            raise NotImplementedError(
+                "raw-matrix serving of GLMs with interactions or a frozen "
+                "categorical encoding: their adapt path needs a Frame")
+        idx = [self.output.names.index(n) for n in self.dinfo.names]
+        Xe = self.dinfo.expand_matrix(X[:, jnp.asarray(idx)])
+        beta = jnp.asarray(self.beta)
+        if beta.ndim != 1 or type(self).score0 is not GLMModel.score0:
+            # multinomial/ordinal subclasses own their score0 — delegate
+            return self.score0(Xe)
+        eta = jnp.sum(Xe * beta[:-1], axis=1) + beta[-1]
+        mu = self.family.linkinv(eta)
+        if self.output.model_category == "Binomial":
+            thr = float(getattr(self, "default_threshold", 0.5))
+            label = (mu >= thr).astype(jnp.float32)
+            return jnp.stack([label, 1 - mu, mu], axis=1)
+        return mu
+
     def score0(self, X: jax.Array) -> jax.Array:
         beta = jnp.asarray(self.beta)
         eta = X @ beta[:-1] + beta[-1]

@@ -109,6 +109,23 @@ class KMeansModel(Model):
                                       type=T_CAT,
                                       domain=[str(i) for i in range(self.k)])])
 
+    def score_raw(self, X):
+        """Serving-path cluster assignment from the raw (B, F) feature
+        matrix (columns in output.names order): reorder into the DataInfo
+        cats-first layout, expand/standardize, nearest center.
+
+        Distances are an explicit per-row ``sum((x-c)^2)`` reduction, NOT
+        `_pairwise_d2`'s ``X @ centers.T`` expansion: XLA CPU's dot picks
+        shape-dependent accumulation strategies (see GLMModel.score_raw),
+        so a near-tie row could flip its argmin between bucket sizes —
+        the per-row reduction keeps batched assignments bit-identical to
+        single-row ones across every bucket."""
+        idx = [self.output.names.index(n) for n in self.dinfo.names]
+        Xe = self.dinfo.expand_matrix(X[:, jnp.asarray(idx)])
+        diff = Xe[:, None, :] - self.centers_std[None, :, :]
+        d2 = jnp.sum(diff * diff, axis=2)
+        return jnp.argmin(d2, axis=1).astype(jnp.float32)
+
     def model_performance(self, fr: Frame | None = None):
         if fr is None:
             return self.output.training_metrics

@@ -117,6 +117,17 @@ class Model(Keyed):
         regression, (n, 1+K) [label, p0..pK-1] for classification."""
         raise NotImplementedError
 
+    def score_raw(self, X: jax.Array) -> jax.Array:
+        """Traceable raw-matrix scoring for the serving runtime: X is a
+        (B, F) float32 matrix with columns in ``output.names`` order and
+        categoricals as training-domain codes (unseen levels NaN) — the
+        exact matrix the base ``adapt_frame`` would build. Models whose
+        ``adapt_frame`` does more than column selection (design expansion,
+        spline bases, ...) must override this with their matrix-level
+        transform; `serving/scorer.py` refuses models that override
+        ``adapt_frame`` without also overriding ``score_raw``."""
+        return self.score0(X)
+
     def pre_adapt(self, fr: Frame) -> Frame:
         """Replay the frozen categorical_encoding (if any) — every
         adapt_frame override must route incoming frames through this."""

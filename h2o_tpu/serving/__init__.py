@@ -1,0 +1,22 @@
+"""Online scoring runtime — micro-batched, shape-bucketed, backpressured.
+
+The inference-stack counterpart of the batched training driver: per-model
+shape-bucketed AOT-compiled scorers (zero steady-state XLA compiles),
+a bounded micro-batching scheduler with deadlines and backpressure, and a
+stats surface — wired to REST as ``POST /3/Serving/models/{id}``,
+``POST /3/Serving/score`` and ``GET /3/Serving/stats`` (`api/server.py`).
+
+See `runtime.py` for the architecture overview; README "Online scoring"
+for the operator-facing contract and knobs.
+"""
+
+from .errors import (DeadlineExceededError, ModelNotRegisteredError,
+                     QueueFullError, ServingError, ServingShutdownError,
+                     UnsupportedModelError)
+from .runtime import ServedModel, ServingRuntime, get_runtime
+
+__all__ = [
+    "ServingRuntime", "ServedModel", "get_runtime",
+    "ServingError", "ModelNotRegisteredError", "UnsupportedModelError",
+    "QueueFullError", "DeadlineExceededError", "ServingShutdownError",
+]

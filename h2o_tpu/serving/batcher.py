@@ -1,0 +1,233 @@
+"""Micro-batching scheduler — one device call for many concurrent requests.
+
+The serving hot path is many small independent requests against one model;
+dispatching each alone wastes the accelerator (a 1-row call costs the same
+fixed overhead as a 64-row call). The `MicroBatcher` is the classic
+dynamic-batching loop (TF-Serving's BatchingSession shape): requests enter
+a BOUNDED queue; a single worker thread drains it, coalescing up to
+``max_batch`` rows — holding the first request open at most ``max_wait_us``
+to let concurrent arrivals join — concatenates them into one matrix, makes
+ONE scorer call, and fans the rows back out.
+
+The three failure modes are explicit, typed, and never hang:
+
+- **backpressure**: a submit against a full queue raises `QueueFullError`
+  immediately (the REST layer maps it to 429 + Retry-After from the drain
+  estimate). Load sheds at the door, not by stacking latency.
+- **deadlines**: every request carries one; if it expires while still
+  queued, the submitter (or the worker, whichever looks first) flips it to
+  TIMED_OUT under the lock and `DeadlineExceededError` is raised. A
+  request already RUNNING is past the point of no return — its result is
+  returned even if slightly late, because the compute is spent either way.
+- **shutdown**: stop() fails all queued requests with
+  `ServingShutdownError` rather than stranding their waiters.
+
+State transitions (WAITING → RUNNING | TIMED_OUT, RUNNING → DONE) happen
+only under the queue lock, so the worker and a timing-out submitter can
+never both claim a request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .errors import (DeadlineExceededError, QueueFullError,
+                     ServingShutdownError)
+
+_WAITING, _RUNNING, _DONE, _TIMED_OUT, _FAILED = range(5)
+
+
+class _Pending:
+    __slots__ = ("rows", "n", "deadline", "state", "event", "result", "error")
+
+    def __init__(self, rows: np.ndarray, deadline: float | None):
+        self.rows = rows
+        self.n = rows.shape[0]
+        self.deadline = deadline        # absolute time.monotonic() stamp
+        self.state = _WAITING
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class MicroBatcher:
+    def __init__(self, model_id: str, score_fn, stats, *,
+                 max_batch: int, max_wait_us: int, queue_depth: int,
+                 recompile_probe=None):
+        self.model_id = model_id
+        self._score = score_fn          # (N, F) np -> (N, ...) np
+        self._stats = stats
+        #: cumulative steady-state-compile count owned by the SCORER
+        #: (bucket-miss fallbacks) — a process-global compile-counter
+        #: delta here would blame concurrent training/registration
+        #: compiles on this model
+        self._recompiles = recompile_probe or (lambda: 0)
+        self.max_batch = max(int(max_batch), 1)
+        self.max_wait_s = max(int(max_wait_us), 0) / 1e6
+        self.queue_depth = max(int(queue_depth), 1)
+        self._q: deque[_Pending] = deque()
+        self._cv = threading.Condition()
+        self._paused = False
+        self._stopped = False
+        self._worker = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"h2o-serving-batch[{model_id}]")
+        self._worker.start()
+
+    # -- request side --------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def submit(self, rows: np.ndarray, deadline_s: float | None):
+        """Block until the batch worker scores these rows; returns the
+        (n, ...) result slice. Raises the typed errors documented above."""
+        now = time.monotonic()
+        req = _Pending(rows, None if deadline_s is None else now + deadline_s)
+        with self._cv:
+            if self._stopped:
+                raise ServingShutdownError(
+                    f"serving model '{self.model_id}' is shut down")
+            if len(self._q) >= self.queue_depth:
+                self._stats.observe_rejected()
+                raise QueueFullError(self.model_id, len(self._q),
+                                     self._retry_after_locked())
+            self._q.append(req)
+            self._cv.notify_all()
+        timeout = None if req.deadline is None else req.deadline - now
+        if not req.event.wait(timeout):
+            with self._cv:
+                if req.state == _WAITING:       # still queued: reclaim it
+                    req.state = _TIMED_OUT
+                    try:
+                        self._q.remove(req)
+                    except ValueError:
+                        pass
+                    self._stats.observe_timeout()
+                    raise DeadlineExceededError(self.model_id,
+                                                (timeout or 0.0) * 1000.0)
+            # RUNNING: the batch is on the device — wait it out
+            req.event.wait()
+        if req.state == _TIMED_OUT:
+            raise DeadlineExceededError(self.model_id, (timeout or 0) * 1e3)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _retry_after_locked(self) -> float:
+        queued_rows = sum(r.n for r in self._q)
+        rate = self._stats.recent_rows_per_s()
+        if rate <= 0:
+            return 0.1
+        return min(max(queued_rows / rate, 0.05), 30.0)
+
+    # -- control -------------------------------------------------------------
+    def pause(self) -> None:
+        """Hold the worker before its next batch (tests use this to build
+        deterministic queue states; requests keep queueing)."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._paused = False
+            dangling = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+        err = ServingShutdownError(
+            f"serving model '{self.model_id}' is shut down")
+        for req in dangling:
+            req.state = _FAILED
+            req.error = err
+            req.event.set()
+        self._worker.join(timeout=5.0)
+
+    # -- worker --------------------------------------------------------------
+    def _take_batch(self) -> list[_Pending]:
+        """Block until work is available (and not paused), coalesce up to
+        max_batch rows, claim the survivors as RUNNING."""
+        with self._cv:
+            while True:
+                while not self._stopped and (self._paused or not self._q):
+                    self._cv.wait()
+                if self._stopped:
+                    return []
+                if self.max_wait_s:
+                    # hold the door open for concurrent arrivals — but
+                    # never past the first queued request's deadline
+                    end = time.monotonic() + self.max_wait_s
+                    while not self._stopped and not self._paused and \
+                            sum(r.n for r in self._q) < self.max_batch:
+                        left = end - time.monotonic()
+                        dl = min((r.deadline for r in self._q
+                                  if r.deadline is not None),
+                                 default=None)
+                        if dl is not None:
+                            left = min(left, dl - time.monotonic())
+                        if left <= 0:
+                            break
+                        self._cv.wait(left)
+                if not self._paused or self._stopped:
+                    break
+                # pause() landed mid-hold: keep holding — "before its
+                # next batch" means nothing dispatches while paused
+            batch: list[_Pending] = []
+            rows = 0
+            now = time.monotonic()
+            while self._q:
+                req = self._q[0]
+                if req.state != _WAITING:       # timed out and reclaimed
+                    self._q.popleft()
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    self._q.popleft()           # expired while queued: the
+                    req.state = _TIMED_OUT      # submitter's own wait() has
+                    req.event.set()             # fired or is about to
+                    self._stats.observe_timeout()
+                    continue
+                if batch and rows + req.n > self.max_batch:
+                    break
+                self._q.popleft()
+                req.state = _RUNNING
+                batch.append(req)
+                rows += req.n
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._stopped:
+                    return
+                continue
+            X = (batch[0].rows if len(batch) == 1
+                 else np.concatenate([r.rows for r in batch], axis=0))
+            recompiles_before = self._recompiles()
+            try:
+                out = self._score(X)
+            except Exception as e:  # noqa: BLE001 — fan the failure out
+                for req in batch:
+                    req.state = _FAILED
+                    req.error = e
+                    req.event.set()
+                continue
+            self._stats.observe_batch(
+                len(batch), X.shape[0],
+                recompiles=self._recompiles() - recompiles_before)
+            i = 0
+            for req in batch:
+                req.result = out[i:i + req.n]
+                i += req.n
+                req.state = _DONE
+                req.event.set()

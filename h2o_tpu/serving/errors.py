@@ -1,0 +1,60 @@
+"""Typed serving-runtime errors — the wire contract's failure surface.
+
+Every failure mode a caller can act on gets its own type, because the REST
+layer maps types to status codes (`api/server.py` Serving routes): an
+overloaded queue is retryable-later (429 + Retry-After), an expired
+deadline is a per-request timeout (408), an unknown model is a 404. A
+generic exception would collapse all three into a 500 and the client could
+only guess.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(Exception):
+    """Base of every serving-runtime error."""
+
+
+class ModelNotRegisteredError(ServingError, KeyError):
+    def __init__(self, model_id: str):
+        super().__init__(f"no serving model '{model_id}' — register it via "
+                         f"POST /3/Serving/models/{model_id} first")
+        self.model_id = model_id
+
+    def __str__(self):  # KeyError would repr() the message
+        return self.args[0]
+
+
+class UnsupportedModelError(ServingError, TypeError):
+    """The model has no raw-matrix scoring path (`Model.score_raw`)."""
+
+
+class QueueFullError(ServingError):
+    """Bounded request queue at capacity — backpressure, not failure.
+
+    ``retry_after_s`` is the runtime's drain estimate (queued rows over the
+    recent scoring throughput); the REST layer ships it as the standard
+    Retry-After header so well-behaved clients back off instead of
+    retry-storming."""
+
+    def __init__(self, model_id: str, depth: int, retry_after_s: float):
+        super().__init__(
+            f"serving queue for '{model_id}' is full ({depth} pending "
+            f"requests); retry in ~{retry_after_s:.2f}s")
+        self.model_id = model_id
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(ServingError, TimeoutError):
+    """The request's deadline expired before its batch was scored."""
+
+    def __init__(self, model_id: str, deadline_ms: float):
+        super().__init__(
+            f"serving request to '{model_id}' missed its {deadline_ms:.0f}ms "
+            f"deadline while queued")
+        self.model_id = model_id
+        self.deadline_ms = deadline_ms
+
+
+class ServingShutdownError(ServingError):
+    """Submitted to a batcher that has been stopped/unregistered."""

@@ -1,0 +1,235 @@
+"""The serving runtime: model registry + request path + stats surface.
+
+`ServingRuntime` owns one `ServedModel` per registered id. Registration
+(`register_model` / `register_mojo`) builds the three cooperating parts:
+
+- a shared `RowEncoder` (`mojo/easy.py`) for dict→row conversion — engine
+  models encode against ``output.names``/``output.domains``, MOJOs against
+  the wrapper's feature metadata, so BOTH surfaces speak the same row-dict
+  dialect as EasyPredictModelWrapper;
+- a shape-bucketed scorer (`scorer.py`) — AOT-compiled jit buckets for
+  engine models, the numpy MOJO scorer for MOJO files — warmed up HERE, so
+  a registration that returns has already paid every compile;
+- a `MicroBatcher` (`batcher.py`) + `ServingStats` (`stats.py`).
+
+The request path (`score`) is: encode rows (caller's thread — encoding is
+host work and parallelizes across request threads), submit to the batcher,
+format the scored rows into the typed per-category prediction dicts the
+REST layer returns verbatim.
+
+A module-level singleton (`get_runtime`) backs the REST routes; tests
+build private instances.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..utils import knobs
+from .batcher import MicroBatcher
+from .errors import ModelNotRegisteredError
+from .scorer import CompiledScorer, HostScorer, bucket_sizes
+from .stats import ServingStats
+
+
+def _cfg(overrides: dict | None) -> dict:
+    """Effective serving config: knobs, then per-registration overrides."""
+    o = overrides or {}
+    return {
+        "buckets": bucket_sizes(o.get("buckets")
+                                if o.get("buckets") else None),
+        "max_batch": int(o.get("max_batch")
+                         or knobs.get_int("H2O_TPU_SERVING_MAX_BATCH")),
+        "max_wait_us": int(o["max_wait_us"] if o.get("max_wait_us")
+                           is not None
+                           else knobs.get_int("H2O_TPU_SERVING_MAX_WAIT_US")),
+        "queue_depth": int(o.get("queue_depth")
+                           or knobs.get_int("H2O_TPU_SERVING_QUEUE_DEPTH")),
+        "deadline_ms": int(o["deadline_ms"] if o.get("deadline_ms")
+                           is not None
+                           else knobs.get_int("H2O_TPU_SERVING_DEADLINE_MS")),
+        "stats_window": int(o.get("stats_window")
+                            or knobs.get_int("H2O_TPU_SERVING_STATS_WINDOW")),
+    }
+
+
+class ServedModel:
+    def __init__(self, model_id: str, scorer, encoder, category: str,
+                 response_domain, cfg: dict, source: str):
+        self.model_id = model_id
+        self.scorer = scorer
+        self.encoder = encoder
+        self.category = category
+        self.response_domain = response_domain
+        self.cfg = cfg
+        self.source = source
+        self.registered_at = time.time()
+        self.stats = ServingStats(window=cfg["stats_window"])
+        self.batcher = MicroBatcher(
+            model_id, scorer.score, self.stats,
+            max_batch=min(cfg["max_batch"], max(scorer.buckets)),
+            max_wait_us=cfg["max_wait_us"],
+            queue_depth=cfg["queue_depth"],
+            recompile_probe=lambda: scorer.fallback_compiles)
+
+    # -- request path --------------------------------------------------------
+    def score_rows(self, rows: list, deadline_ms=None) -> list:
+        if not rows:
+            return []
+        t0 = time.perf_counter()
+        X = self.encoder.encode(rows)
+        if deadline_ms is None:
+            deadline_ms = self.cfg["deadline_ms"]
+        deadline_s = None if not deadline_ms else float(deadline_ms) / 1e3
+        out = self.batcher.submit(X, deadline_s)
+        preds = self._format(np.asarray(out))
+        self.stats.observe_request(time.perf_counter() - t0, len(rows))
+        return preds
+
+    def _format(self, out: np.ndarray) -> list:
+        """(n,)/(n, 1+K) raw scores → typed per-row prediction dicts (the
+        EasyPredictModelWrapper prediction classes' wire shape)."""
+        cat = (self.category or "").lower()
+        if out.ndim == 2 and cat in ("binomial", "multinomial", "ordinal"):
+            dom = (self.response_domain
+                   or [str(i) for i in range(out.shape[1] - 1)])
+            return [{"label": dom[int(r[0])], "labelIndex": int(r[0]),
+                     "classProbabilities": [float(p) for p in r[1:]]}
+                    for r in out]
+        if cat == "clustering":
+            flat = out[:, 0] if out.ndim == 2 else out
+            return [{"cluster": int(v)} for v in flat]
+        if cat == "regression" or out.ndim == 1:
+            flat = out[:, -1] if out.ndim == 2 else out
+            return [{"value": float(v)} for v in flat]
+        return [{"values": [float(v) for v in r]} for r in np.atleast_2d(out)]
+
+    def info(self) -> dict:
+        return {
+            "model_id": self.model_id,
+            "source": self.source,
+            "category": self.category,
+            "features": list(self.encoder.features),
+            "n_features": len(self.encoder.features),
+            "buckets": list(self.scorer.buckets),
+            "max_batch": self.batcher.max_batch,
+            "max_wait_us": int(self.batcher.max_wait_s * 1e6),
+            "queue_depth": self.batcher.queue_depth,
+            "deadline_ms": self.cfg["deadline_ms"],
+            "warmup_compiles": self.scorer.warmup_compiles,
+        }
+
+    def shutdown(self) -> None:
+        self.batcher.stop()
+
+
+class ServingRuntime:
+    def __init__(self):
+        self._models: dict[str, ServedModel] = {}
+        self._lock = threading.Lock()
+
+    # -- registration --------------------------------------------------------
+    def register_model(self, model, model_id: str | None = None,
+                       overrides: dict | None = None,
+                       strict_levels: bool = False) -> dict:
+        """Register an in-STORE engine model: jit bucket scorers over its
+        ``score_raw`` matrix path, warmed up before this returns."""
+        from ..mojo.easy import RowEncoder
+
+        model_id = model_id or model.key
+        cfg = _cfg(overrides)
+        scorer = CompiledScorer(model, buckets=cfg["buckets"])
+        scorer.warmup()
+        encoder = RowEncoder(
+            model.output.names,
+            [model.output.domains.get(n) for n in model.output.names],
+            convert_unknown=not strict_levels, dtype=np.float32)
+        return self._install(ServedModel(
+            model_id, scorer, encoder, model.output.model_category,
+            model.output.response_domain, cfg, source=f"model:{model.key}"))
+
+    def register_mojo(self, path_or_model, model_id: str | None = None,
+                      overrides: dict | None = None,
+                      strict_levels: bool = False) -> dict:
+        """Register a standalone MOJO (zip path, exploded dir, or loaded
+        `MojoModel`) through its numpy scorer."""
+        from ..mojo.easy import EasyPredictModelWrapper
+
+        wrapper = EasyPredictModelWrapper(
+            path_or_model,
+            convert_unknown_categorical_levels_to_na=not strict_levels)
+        m = wrapper.model
+        model_id = model_id or f"mojo_{m.algo}_{id(m) & 0xffff:04x}"
+        cfg = _cfg(overrides)
+        scorer = HostScorer(m, len(wrapper._features), buckets=cfg["buckets"])
+        scorer.warmup()
+        return self._install(ServedModel(
+            model_id, scorer, wrapper.encoder, m.category,
+            wrapper._resp_domain, cfg,
+            source=(path_or_model if isinstance(path_or_model, str)
+                    else f"mojo:{m.algo}")))
+
+    def _install(self, served: ServedModel) -> dict:
+        with self._lock:
+            old = self._models.get(served.model_id)
+            self._models[served.model_id] = served
+        if old is not None:  # re-registration replaces atomically
+            old.shutdown()
+        return served.info()
+
+    def unregister(self, model_id: str) -> None:
+        with self._lock:
+            served = self._models.pop(model_id, None)
+        if served is None:
+            raise ModelNotRegisteredError(model_id)
+        served.shutdown()
+
+    # -- lookup / request path ----------------------------------------------
+    def model(self, model_id: str) -> ServedModel:
+        with self._lock:
+            served = self._models.get(model_id)
+        if served is None:
+            raise ModelNotRegisteredError(model_id)
+        return served
+
+    def model_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def score(self, model_id: str, rows: list, deadline_ms=None) -> list:
+        return self.model(model_id).score_rows(rows, deadline_ms=deadline_ms)
+
+    def stats(self, model_id: str | None = None) -> dict:
+        if model_id is not None:
+            served = self.model(model_id)
+            return served.stats.snapshot(queue_depth=served.batcher.depth)
+        with self._lock:
+            models = dict(self._models)
+        return {mid: s.stats.snapshot(queue_depth=s.batcher.depth)
+                for mid, s in models.items()}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            models = list(self._models.values())
+            self._models.clear()
+        for served in models:
+            served.shutdown()
+
+
+_RUNTIME: ServingRuntime | None = None
+_RUNTIME_LOCK = threading.Lock()
+
+
+def get_runtime() -> ServingRuntime:
+    """The process singleton behind the REST Serving routes."""
+    global _RUNTIME
+    with _RUNTIME_LOCK:
+        if _RUNTIME is None:
+            _RUNTIME = ServingRuntime()
+        return _RUNTIME
+
+
+__all__ = ["ServingRuntime", "ServedModel", "get_runtime"]

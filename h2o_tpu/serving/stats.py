@@ -1,0 +1,109 @@
+"""Per-model serving observability — the numbers behind GET /3/Serving/stats.
+
+One `ServingStats` per registered model, fed from two sides:
+
+- the request path records end-to-end latency per request (encode + queue
+  wait + scoring), via ``observe_request``;
+- the batch worker records each device call's occupancy and any XLA
+  compiles it observed (`utils/compilemeter.py` delta — steady state must
+  record zero), via ``observe_batch``.
+
+Latencies and batch completions live in bounded ring buffers
+(``H2O_TPU_SERVING_STATS_WINDOW``), so the percentiles and the rows/s
+throughput describe *recent* traffic and the memory footprint is fixed no
+matter how long the server lives. Counters (requests, rows, rejected,
+timeouts, recompiles) are monotone totals.
+
+Everything is guarded by one lock: request threads and the batch worker
+mutate concurrently, and a torn snapshot would misreport the very tail
+latencies the endpoint exists to expose.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+
+class ServingStats:
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self.window = max(int(window), 16)
+        self._lat_s: deque = deque(maxlen=self.window)
+        #: (completion wall-stamp, rows) per scored batch — throughput window
+        self._batches: deque = deque(maxlen=self.window)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.rejected = 0
+        self.timeouts = 0
+        self.recompiles = 0
+        self.started_at = time.time()
+
+    # -- request path --------------------------------------------------------
+    def observe_request(self, latency_s: float, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self._lat_s.append(latency_s)
+
+    def observe_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def observe_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    # -- batch worker --------------------------------------------------------
+    def observe_batch(self, n_requests: int, n_rows: int,
+                      recompiles: int = 0) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += n_rows
+            self.recompiles += recompiles
+            self._batches.append((time.time(), n_rows))
+
+    def recent_rows_per_s(self) -> float:
+        """Scoring throughput over the batch window (0.0 when idle)."""
+        with self._lock:
+            return self._rows_per_s_locked()
+
+    def _rows_per_s_locked(self) -> float:
+        if len(self._batches) < 2:
+            return 0.0
+        t0 = self._batches[0][0]
+        span = self._batches[-1][0] - t0
+        if span <= 0:
+            return 0.0
+        return sum(r for _, r in self._batches) / span
+
+    # -- snapshot ------------------------------------------------------------
+    def snapshot(self, queue_depth: int = 0) -> dict:
+        with self._lock:
+            lat = np.asarray(self._lat_s, dtype=np.float64)
+            p50 = p95 = p99 = None
+            if lat.size:
+                p50, p95, p99 = (round(float(v) * 1000.0, 3) for v in
+                                 np.percentile(lat, (50, 95, 99)))
+            occupancy = (self.batch_rows / self.batches
+                         if self.batches else None)
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "rejected": self.rejected,
+                "timeouts": self.timeouts,
+                "recompiles": self.recompiles,
+                "queue_depth": queue_depth,
+                "mean_batch_occupancy": (None if occupancy is None
+                                         else round(occupancy, 3)),
+                "latency_ms": {"p50": p50, "p95": p95, "p99": p99,
+                               "window": int(lat.size)},
+                "rows_per_s": round(self._rows_per_s_locked(), 1),
+                "uptime_s": round(time.time() - self.started_at, 1),
+            }

@@ -1,0 +1,66 @@
+"""Process-global XLA compile counter — the serving warmup contract's meter.
+
+The serving runtime promises "zero recompiles in steady state": every bucket
+executable is AOT-compiled at model registration (`serving/scorer.py`), so a
+compile observed during request serving is a bug (a shape that escaped the
+buckets, a donated-buffer retrace, ...). That promise is only assertable if
+compiles are *countable*, which jax exposes through `jax.monitoring`: the
+dispatch layer records one `/jax/core/compile/backend_compile_duration`
+event per program that reaches the backend compiler (cache hits do NOT
+fire it — a replay from the persistent compile cache is not a compile).
+
+`count()` returns the monotone process-wide total; callers measure deltas
+around the region they care about::
+
+    before = compilemeter.count()
+    ...serve traffic...
+    assert compilemeter.count() - before == 0
+
+The listener is registered once per process (jax.monitoring offers no
+unregister, so install() is idempotent by module flag) and costs one dict
+lookup per monitoring event — nothing on the request path.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+def _listener(name: str, secs: float, **kw) -> None:
+    global _count
+    if name == _COMPILE_EVENT:
+        with _lock:
+            _count += 1
+
+
+def install() -> None:
+    """Register the monitoring listener (idempotent, lazy jax import)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    import jax
+
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+def count() -> int:
+    """Total XLA backend compiles observed in this process so far.
+
+    Process-global by nature: steady-state serving accounting must NOT
+    diff this around individual device calls (a concurrent registration
+    or training job would be blamed on the serving path) — the stats
+    ``recompiles`` gauge counts the scorer's own bucket-miss fallbacks
+    instead (`serving/scorer.py`). This counter is for delta assertions
+    in controlled regions: warmup cost reporting and the zero-recompile
+    tests."""
+    install()
+    with _lock:
+        return _count

@@ -101,6 +101,32 @@ _knob("H2O_TPU_COMPILE_CACHE", "str", "",
       "persistent XLA compile cache dir ('0' disables; empty = backend "
       "default: on for accelerators, off for CPU)")
 
+# -- serving (h2o_tpu/serving/ online scoring runtime) ----------------------
+_knob("H2O_TPU_SERVING_BUCKETS", "str", "1,4,16,64,128,256,512",
+      "comma list of padded-batch bucket sizes the serving scorer "
+      "AOT-compiles at registration; requests pad up to the smallest "
+      "bucket that fits, larger batches chunk through the biggest. "
+      "Dense x2 steps at the top where scoring is linear in rows and "
+      "padding waste costs real milliseconds; x4 at the bottom where "
+      "dispatch overhead dominates (measured: a 119-row batch scores "
+      "3.6 ms at bucket 128 vs 20 ms padded to 512)")
+_knob("H2O_TPU_SERVING_MAX_BATCH", "int", 512,
+      "most rows the micro-batcher coalesces into one device call "
+      "(effectively clamped to the largest compiled bucket)")
+_knob("H2O_TPU_SERVING_MAX_WAIT_US", "int", 2000,
+      "how long the micro-batch worker holds the first queued request "
+      "open for coalescing before dispatching a partial batch (0 = "
+      "dispatch immediately, no coalescing window)")
+_knob("H2O_TPU_SERVING_QUEUE_DEPTH", "int", 1024,
+      "bounded request-queue depth per served model; submits beyond it "
+      "are rejected with QueueFullError (REST: 429 + Retry-After)")
+_knob("H2O_TPU_SERVING_DEADLINE_MS", "int", 1000,
+      "default per-request deadline; a request still queued past it "
+      "raises DeadlineExceededError (REST: 408); 0 = no deadline")
+_knob("H2O_TPU_SERVING_STATS_WINDOW", "int", 2048,
+      "ring-buffer length of the per-model latency/throughput window "
+      "behind GET /3/Serving/stats")
+
 # -- security ---------------------------------------------------------------
 _knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
       "allow python: UDF references uploaded over the wire to execute")
@@ -127,8 +153,12 @@ _knob("H2O_TPU_BENCH_AIRLINES_ROWS", "int", 116_000_000,
 _knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
       "rows for the binned-store stacked-vs-binned leg")
 _knob("H2O_TPU_BENCH_WORKLOADS", "str",
-      "gbm,glm,cod,gam,rulefit,sort,merge,binned,airlines",
+      "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,airlines",
       "comma list of bench workloads to run")
+_knob("H2O_TPU_BENCH_SERVING_REQS", "int", 4000,
+      "single-row requests issued by the concurrent serving bench leg")
+_knob("H2O_TPU_BENCH_SERVING_THREADS", "int", 16,
+      "concurrent client threads for the serving bench leg")
 _knob("H2O_TPU_BENCH_SKIP_CADENCE", "bool", False,
       "skip the score_tree_interval=10 GBM cadence leg")
 _knob("H2O_TPU_BENCH_SIDECAR", "str", "",

@@ -113,6 +113,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "graftlint: repo-native static-analysis gate and rule "
                    "fixtures (pytest -m graftlint, tools/graftlint/)")
+    config.addinivalue_line(
+        "markers", "serving: online scoring runtime — bucketed scorers, "
+                   "micro-batcher, REST surface (pytest -m serving, "
+                   "h2o_tpu/serving/)")
 
 
 def pytest_collection_modifyitems(config, items):
