@@ -24,6 +24,8 @@ import uuid
 import urllib.parse
 import urllib.request
 
+from ..utils.retry import RetryBudgetExceeded, retry_after_verdict
+
 _conn = None
 
 
@@ -46,6 +48,23 @@ class H2OServingOverloadError(H2OConnectionError):
 
 class H2OServingTimeoutError(H2OConnectionError):
     """`POST /3/Serving/score` missed its deadline while queued (408)."""
+
+
+class H2ORetriesExhaustedError(H2OConnectionError, RetryBudgetExceeded):
+    """The client's automatic transient retry gave up. Dual-typed on
+    purpose: still an ``H2OConnectionError`` (every existing handler —
+    e.g. ``remove()``'s frames-vs-models fallback — keeps working) AND a
+    ``RetryBudgetExceeded`` (attempts/elapsed/cause attached). The
+    transport fields mirror the FINAL underlying error."""
+
+    def __init__(self, description: str, attempts: int, elapsed_s: float,
+                 last: BaseException):
+        RetryBudgetExceeded.__init__(self, description, attempts,
+                                     elapsed_s, last)
+        self.status = getattr(last, "status", None)
+        self.headers = getattr(last, "headers", None)
+        self.payload = getattr(last, "payload", None)
+        self.no_server = getattr(last, "no_server", False)
 
 
 class H2OConnection:
@@ -77,38 +96,70 @@ class H2OConnection:
     def request(self, method: str, path: str, data: dict | None = None,
                 params: dict | None = None, raw: bool = False,
                 filename: str | None = None,
-                save_to: str | None = None) -> dict | str:
+                save_to: str | None = None,
+                retry: bool | None = None) -> dict | str:
         """``raw=True`` returns the response body as text (non-JSON
         endpoints like DownloadDataset) through the same auth/SSL path.
         ``filename`` streams that local file as the request body (the h2o-py
         connection's file-upload mode — http.client reads file objects in
         8KB blocks, so large pushes never materialize in memory).
         ``save_to`` streams a binary response body to that local path and
-        returns the path (the h2o-py save_to download mode)."""
+        returns the path (the h2o-py save_to download mode).
+
+        Transient-failure policy (`utils/retry.py`): idempotent methods
+        (GET/HEAD/DELETE, no upload body) retry connection-level failures
+        and 429/503 — honoring a server Retry-After — with jittered
+        backoff, giving up with the typed ``RetryBudgetExceeded``.
+        Non-idempotent requests never retry automatically (a replayed POST
+        could double-train a model); ``retry`` overrides either way."""
         url = f"{self.url}{path}"
         if params:
             url += "?" + urllib.parse.urlencode(params)
-        body = None
         headers = {}
         if self._auth:
             headers["Authorization"] = self._auth
         if filename is not None:
-            body = open(filename, "rb")  # closed in the finally below
             headers["Content-Type"] = "application/octet-stream"
-            headers["Content-Length"] = str(os.path.getsize(filename))
         elif data is not None:
-            body = json.dumps(data).encode()
             headers["Content-Type"] = "application/json"
-        req = urllib.request.Request(url, data=body, headers=headers,
-                                     method=method)
+
+        def _attempt():
+            # the body is built PER ATTEMPT: a retried upload must stream a
+            # fresh file handle, never re-send the consumed one (which
+            # http.client would transmit as an empty body)
+            body = None
+            if filename is not None:
+                body = open(filename, "rb")
+                headers["Content-Length"] = str(os.path.getsize(filename))
+            elif data is not None:
+                body = json.dumps(data).encode()
+            req = urllib.request.Request(url, data=body, headers=headers,
+                                         method=method)
+            try:
+                return self._send(req, raw, save_to)
+            finally:
+                if filename is not None and body is not None:
+                    body.close()
+
         self.requests_count += 1
+        if retry is None:
+            retry = method in ("GET", "HEAD", "DELETE") and filename is None
+        if not retry:
+            return _attempt()
+        from ..utils.retry import retry_call
+
         try:
-            return self._send(req, raw, save_to)
-        finally:
-            if filename is not None and body is not None:
-                body.close()
+            return retry_call(_attempt, retryable=_transient_rest,
+                              description=f"{method} {path}")
+        except RetryBudgetExceeded as e:
+            raise H2ORetriesExhaustedError(
+                f"{method} {path}", e.attempts, e.elapsed_s,
+                e.last) from e.last
 
     def _send(self, req, raw: bool, save_to: str | None):
+        from ..utils import failpoints
+
+        failpoints.hit("client.request")
         try:
             with urllib.request.urlopen(req, timeout=600,
                                         context=self._ssl_ctx) as resp:
@@ -149,6 +200,21 @@ class H2OConnection:
         return self.session_id
 
 
+def _transient_rest(e: BaseException):
+    """Retry classifier for the REST transport: connection-level failures
+    back off exponentially; 429/503 honor the server's Retry-After when it
+    sent one (returning the float delegates the delay to retry_call)."""
+    if isinstance(e, ConnectionError):
+        return True  # failpoint-injected / OS-level resets before the wire
+    if not isinstance(e, H2OConnectionError):
+        return False
+    if getattr(e, "no_server", False):
+        return True
+    if e.status in (429, 503):
+        return retry_after_verdict((e.headers or {}).get("Retry-After"))
+    return False
+
+
 def connection() -> H2OConnection:
     if _conn is None:
         raise H2OConnectionError("not connected; call h2o.init() first")
@@ -173,7 +239,9 @@ def init(url: str | None = None, port: int = 54321, name: str = "h2o_tpu",
     try:
         _conn = H2OConnection(url, username, password,
                               verify_ssl_certificates, cacert)
-        _conn.request("GET", "/3/Cloud")
+        # probe without retry: "nothing listening" here means "boot one
+        # in-process", and that fallback must stay instant
+        _conn.request("GET", "/3/Cloud", retry=False)
         return _conn
     except H2OConnectionError as e:
         if not getattr(e, "no_server", False):
@@ -621,12 +689,32 @@ def register_serving(model=None, serving_id: str | None = None,
         "POST", f"/3/Serving/models/{urllib.parse.quote(sid)}", data=data)
 
 
-def score_rows(serving_id: str, rows, deadline_ms=None) -> list:
+def score_rows(serving_id: str, rows, deadline_ms=None,
+               retries: int = 0) -> list:
     """Score one row dict or a list of them through the micro-batched
     runtime (`POST /3/Serving/score`); returns one typed prediction dict
     per row. Raises `H2OServingOverloadError` (queue full, carries
     ``retry_after_s``) and `H2OServingTimeoutError` (deadline expired) so
-    callers can back off / retry instead of parsing status codes."""
+    callers can back off / retry instead of parsing status codes.
+
+    ``retries > 0`` does the backing off for you (`utils/retry.py`):
+    overloads sleep exactly the server's Retry-After drain estimate and
+    re-submit, up to ``retries`` extra attempts / the retry wall-clock
+    budget — scoring is read-only, so replaying the POST is safe. The
+    typed give-up is ``RetryBudgetExceeded`` with the final overload as
+    ``__cause__``. Default 0 keeps the raw backpressure signal."""
+    if retries > 0:
+        from ..utils.retry import retry_call
+
+        def _overloaded(e):
+            if isinstance(e, H2OServingOverloadError):
+                return max(float(e.retry_after_s), 0.001)
+            return False
+
+        return retry_call(
+            lambda: score_rows(serving_id, rows, deadline_ms=deadline_ms),
+            retryable=_overloaded, attempts=retries + 1,
+            description=f"score_rows({serving_id})")
     if isinstance(rows, dict):
         rows = [rows]
     data: dict = {"model_id": serving_id, "rows": list(rows)}

@@ -321,9 +321,20 @@ def _make_handler(server: H2OServer):
                 self._reply(status, payload)
                 return
             try:
+                from ..utils import failpoints
+
+                failpoints.hit("rest.route")
                 status, payload = route(server, method, parts, query,
                                         self._body() if method in ("POST", "PUT")
                                         else {})
+            except failpoints.InjectedHTTPError as e:
+                # deterministic flaky-server injection: reply the injected
+                # status; 429/503 carry Retry-After so client retry paths
+                # can be driven end-to-end over a real socket
+                status, payload = _err(e.status, str(e))
+                if e.status in (429, 503):
+                    payload["__headers__"] = {
+                        "Retry-After": f"{e.retry_after_s:g}"}
             except KeyError as e:
                 status, payload = _err(404, str(e))
             except (ValueError, TypeError) as e:

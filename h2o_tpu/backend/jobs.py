@@ -21,6 +21,20 @@ class JobCancelled(Exception):
     pass
 
 
+class JobTimeoutError(Exception):
+    """Typed wall-clock expiry: raised by ``Job.join(timeout=...)`` when the
+    wait runs out, and by ``Job.check_max_runtime()`` when the
+    ``max_runtime_secs`` budget expires before a builder has any partial
+    result worth keeping. Carries the numbers callers used to have to parse
+    out of message text: ``elapsed_s`` spent vs ``budget_s`` allowed."""
+
+    def __init__(self, what: str, elapsed_s: float, budget_s: float):
+        self.elapsed_s = elapsed_s
+        self.budget_s = budget_s
+        super().__init__(
+            f"{what}: {elapsed_s:.1f}s elapsed of a {budget_s:.1f}s budget")
+
+
 #: long-lived server hygiene: XLA's compiler accumulates per-program state
 #: across hundreds of distinct trainings and the CPU backend has been
 #: observed to destabilize under it (the test suite resets per module —
@@ -107,8 +121,17 @@ class Job(Keyed):
         return self
 
     def join(self, timeout: float | None = None) -> Any:
+        """Wait for the job. A bounded wait that runs out raises the typed
+        ``JobTimeoutError`` (the job keeps running — this is the WAIT's
+        budget) instead of the old behavior of silently returning None with
+        the job still live."""
         if self._thread is not None:
             self._thread.join(timeout)
+            if timeout is not None and self._thread.is_alive():
+                raise JobTimeoutError(
+                    f"join on {self.key} ({self.description!r}) timed out "
+                    f"with the job still {self.status}",
+                    elapsed_s=self.run_time, budget_s=timeout)
         if self.status == Job.FAILED and self.exception is not None:
             raise self.exception
         return self.result
@@ -129,18 +152,32 @@ class Job(Keyed):
         """Request cooperative cancellation (`Job.stop_requested` contract)."""
         self._stop_requested = True
 
-    deadline: float | None = None  # wall-clock budget (max_runtime_secs)
+    deadline: float | None = None     # wall-clock expiry (max_runtime_secs)
+    max_runtime_s: float | None = None  # the armed budget, for typed errors
 
     def set_max_runtime(self, secs: float) -> None:
         """Arm the per-model time budget (`Model.Parameters.max_runtime_secs`
         — the reference stops training and keeps the partial model)."""
         if secs and secs > 0:
+            self.max_runtime_s = float(secs)
             self.deadline = time.time() + secs
 
     def time_exceeded(self) -> bool:
         """Iterative builders poll this between iterations and BREAK (keeping
         the partial model), unlike check_cancelled which unwinds."""
         return self.deadline is not None and time.time() > self.deadline
+
+    def check_max_runtime(self) -> None:
+        """The typed sibling of ``time_exceeded`` for call sites with NO
+        partial result to keep: an expired budget raises ``JobTimeoutError``
+        (elapsed vs budget attached) instead of letting the build run
+        arbitrarily past its contract before the first keepable iteration."""
+        if self.time_exceeded():
+            raise JobTimeoutError(
+                f"job {self.key} ({self.description!r}) exceeded "
+                f"max_runtime_secs before producing a keepable result",
+                elapsed_s=self.run_time,
+                budget_s=self.max_runtime_s or 0.0)
 
     @property
     def stop_requested(self) -> bool:

@@ -241,8 +241,15 @@ class Cleaner:
             return total, seen
 
     # -- the sweep (Cleaner.run's store_clean pass) ---------------------------
-    def maybe_sweep(self, exclude: int | None = None) -> int:
-        limit = self.limit_bytes()
+    def emergency_sweep(self, exclude: int | None = None) -> int:
+        """Spill EVERYTHING spillable except ``exclude`` — the rehydrate
+        path's response to a device OOM (`frame/vec.py`): free the maximum
+        HBM regardless of budget, so the failed device_put can retry."""
+        return self.maybe_sweep(exclude=exclude, target_bytes=0)
+
+    def maybe_sweep(self, exclude: int | None = None,
+                    target_bytes: int | None = None) -> int:
+        limit = self.limit_bytes() if target_bytes is None else target_bytes
         if limit is None:
             return 0
         if self.tracked_bytes() <= limit:
@@ -280,6 +287,9 @@ class Cleaner:
             vec._lock.release()
 
     def _spill_locked(self, vec) -> int:
+        from ..utils import failpoints
+
+        failpoints.hit("cleaner.spill")
         arr = vec._data
         if arr is None:
             return 0

@@ -163,10 +163,8 @@ class Vec(Keyed):
 
         with self._lock:
             if self._data is None and self._spill_path is not None:
-                import jax
-
                 host = np.load(self._spill_path)
-                self._data = jax.device_put(host, self._put_sharding())
+                self._data = self._rehydrate_put(host)
                 CLEANER._remove_ice(self._spill_path)
                 self._spill_path = None
                 self._last_access = CLEANER.touch(self)
@@ -175,6 +173,34 @@ class Vec(Keyed):
             elif self._data is not None:
                 self._last_access = CLEANER.touch(self)
             return self._data
+
+    def _rehydrate_put(self, host: np.ndarray):
+        """Spilled payload -> device, surviving a device OOM: when HBM is
+        so contended the reload itself RESOURCE_EXHAUSTs, emergency-spill
+        every other unpinned resident and retry once — losing one LRU round
+        beats killing the job mid-scoring (the failure mode the
+        ``cleaner.rehydrate`` failpoint injects on demand)."""
+        import jax
+
+        from ..backend.memory import CLEANER
+        from ..utils import failpoints
+
+        def put():
+            failpoints.hit("cleaner.rehydrate")
+            return jax.device_put(host, self._put_sharding())
+
+        try:
+            return put()
+        except Exception as e:  # noqa: BLE001 — OOM-classified right below
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            from ..utils.log import warn
+
+            freed = CLEANER.emergency_sweep(
+                exclude=getattr(self, "_cleaner_token", None))
+            warn(f"device OOM rehydrating {self.key}: emergency-spilled "
+                 f"{freed} bytes, retrying")
+            return put()  # a still-armed injection fails this too — typed
 
     @data.setter
     def data(self, value):

@@ -155,14 +155,27 @@ def _s3_request(method: str, bucket: str, key: str, query: str = "",
         headers = sigv4_headers(method, url, region, dict(extra), payload_sha,
                                 access, secret, token)
     headers.update(extra)
-    data = open(body_path, "rb") if body_path is not None else None
-    try:
-        req = urllib.request.Request(url, data=data, headers=headers,
-                                     method=method)
-        return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
-    finally:
-        if data is not None:
+    from ..utils import failpoints, retry
+
+    if body_path is not None:
+        # upload bodies stream from disk — replaying would need a re-seek
+        # protocol; the persist SPI callers re-drive whole puts instead
+        data = open(body_path, "rb")
+        try:
+            failpoints.hit("io.remote")
+            req = urllib.request.Request(url, data=data, headers=headers,
+                                         method=method)
+            return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
+        finally:
             data.close()
+
+    def once():
+        failpoints.hit("io.remote")
+        req = urllib.request.Request(url, headers=headers, method=method)
+        return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
+
+    return retry.retry_call(once, retryable=retry.transient_http,
+                            description=f"s3 {method} {bucket}/{key}")
 
 
 def s3_get(uri: str) -> str:
@@ -224,12 +237,22 @@ def _gcs_headers() -> dict:
 
 
 def gcs_get(uri: str) -> str:
-    """Download ``gs://bucket/object`` to a temp file (PersistGcs role)."""
+    """Download ``gs://bucket/object`` to a temp file (PersistGcs role).
+    Transient failures (connection loss, 429/5xx) retry with backoff
+    through the shared typed policy (`utils/retry.py`)."""
+    from ..utils import failpoints, retry
+
     bucket, obj = _split_uri(uri)
     url = (f"{_gcs_base()}/storage/v1/b/{bucket}/o/"
            f"{urllib.parse.quote(obj, safe='')}?alt=media")
-    req = urllib.request.Request(url, headers=_gcs_headers())
-    with urllib.request.urlopen(req, timeout=600) as resp:  # noqa: S310
+
+    def once():
+        failpoints.hit("io.remote")
+        req = urllib.request.Request(url, headers=_gcs_headers())
+        return urllib.request.urlopen(req, timeout=600)  # noqa: S310
+
+    with retry.retry_call(once, retryable=retry.transient_http,
+                          description=f"gcs GET {bucket}/{obj}") as resp:
         return _stream_to_tmp(resp, obj, "h2o_tpu_gs_")
 
 

@@ -71,19 +71,29 @@ def _url(uri: str, op: str, **params) -> str:
 
 def _request(url: str, method: str = "GET", data=None,
              follow: bool = True):
-    req = urllib.request.Request(url, data=data, method=method)
-    try:
-        return _OPENER.open(req, timeout=120)
-    except urllib.error.HTTPError as e:
-        if follow and e.code in (301, 302, 307):
-            loc = e.headers.get("Location")
-            if not loc:
-                raise
-            e.close()
-            return _OPENER.open(
-                urllib.request.Request(loc, data=data, method=method),
-                timeout=600)
-        raise
+    from ..utils import failpoints, retry
+
+    def once():
+        failpoints.hit("io.remote")
+        req = urllib.request.Request(url, data=data, method=method)
+        try:
+            return _OPENER.open(req, timeout=120)
+        except urllib.error.HTTPError as e:
+            if follow and e.code in (301, 302, 307):
+                loc = e.headers.get("Location")
+                if not loc:
+                    raise
+                e.close()
+                return _OPENER.open(
+                    urllib.request.Request(loc, data=data, method=method),
+                    timeout=600)
+            raise
+
+    if data is not None:
+        # a consumed body stream cannot be replayed — single shot
+        return once()
+    return retry.retry_call(once, retryable=retry.transient_http,
+                            description=f"webhdfs {method} {url}")
 
 
 def hdfs_get(uri: str) -> str:

@@ -128,6 +128,9 @@ def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
     URI schemes (s3://, gs://, http(s)://) localize through the Persist SPI."""
     import pyarrow as pa
 
+    from ..utils import failpoints
+
+    failpoints.hit("parser.parse")
     if "://" in path:
         from .persist import localize
 

@@ -244,6 +244,7 @@ class DeepLearning(ModelBuilder):
     def build_impl(self, job: Job) -> DeepLearningModel:
         p: DeepLearningParameters = self.params
         fr = p.training_frame
+        rs = self._take_resume_state()
         prior = (self._resolve_checkpoint(p.checkpoint)
                  if p.checkpoint is not None else None)
         if prior is not None:
@@ -287,7 +288,13 @@ class DeepLearning(ModelBuilder):
         seed = p.seed if p.seed not in (-1, None) else 1234
         key = jax.random.PRNGKey(seed)
         maxout = p.activation.lower().startswith("maxout")
-        if prior is not None:
+        if rs is not None:
+            # auto-recovery resume: restore the exact weights as of the
+            # last checkpoint; shuffles/dropout keys are indexed by GLOBAL
+            # step below, so replaying the remaining steps is bit-equal to
+            # the uninterrupted run
+            net = jax.tree.map(jnp.asarray, rs["net"])
+        elif prior is not None:
             net = jax.tree.map(jnp.asarray, prior.net)
         else:
             net = _init_params(key, sizes, p.initial_weight_distribution,
@@ -298,7 +305,9 @@ class DeepLearning(ModelBuilder):
             opt = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
         else:
             opt = optax.sgd(p.rate, momentum=p.momentum_stable or None)
-        if prior is not None and prior.opt_state is not None:
+        if rs is not None and rs.get("opt_state") is not None:
+            opt_state = jax.tree.map(jnp.asarray, rs["opt_state"])
+        elif prior is not None and prior.opt_state is not None:
             opt_state = prior.opt_state   # resume the ADADELTA accumulators
         else:
             opt_state = opt.init(net)
@@ -337,10 +346,24 @@ class DeepLearning(ModelBuilder):
         # the reference resumes from the checkpointed iteration count)
         step_offset = int(round(prior_epochs * steps_per_epoch))
         perm_base = jax.random.fold_in(key, 1)
-        for s in range(total_steps):
+        from ..utils import failpoints
+
+        start_s = 0
+        if rs is not None and rs.get("steps_done"):
+            start_s = int(rs["steps_done"])  # always an epoch boundary
+        for s in range(start_s, total_steps):
             gs = step_offset + s
             if s % steps_per_epoch == 0:
+                failpoints.hit("train.dl.epoch")
                 job.check_cancelled()
+                if s:
+                    if job.time_exceeded():  # keep the completed epochs —
+                        total_steps = s      # epochs_trained stays honest
+                        break
+                else:
+                    # no epoch finished yet: nothing partial to keep, so an
+                    # expired budget is the TYPED JobTimeoutError path
+                    job.check_max_runtime()
                 perm = jax.random.permutation(
                     jax.random.fold_in(perm_base, gs // steps_per_epoch),
                     plen)
@@ -353,6 +376,13 @@ class DeepLearning(ModelBuilder):
                                   jax.random.fold_in(key, 2 + gs))
             if s % steps_per_epoch == steps_per_epoch - 1:
                 job.update(steps_per_epoch / total_steps)
+                # auto-recovery checkpoint at the epoch boundary (resume
+                # restarts at an exact epoch, where the shuffle re-derives)
+                self._recovery_tick(
+                    lambda s=s: {"algo": self.algo_name, "steps_done": s + 1,
+                                 "net": net, "opt_state": opt_state},
+                    progress={"steps_done": s + 1,
+                              "steps_total": int(total_steps)})
 
         output = ModelOutput()
         output.names = names

@@ -172,6 +172,41 @@ class GBMModel(Model):
             return self.f0 + s / jnp.maximum(n, 1)
         return self.f0 + s
 
+    #: row budget for one code-space replay block — bounds the transient
+    #: f32 upcast of the binned codes, NOT a whole (R, F) matrix
+    _CODE_SCORE_CELLS = 1 << 26
+
+    def _raw_f_codes(self, Xb, thr_codes, na_code: int):
+        """Prior-forest replay over the chunk store's BINNED view, in
+        bin-code space — the checkpoint-restart path that never stacks the
+        raw f32 matrix (the PR 2 residual).
+
+        Exactness: codes are ``#edges < x`` (`tree/binning.bin_column`), so
+        for any threshold that IS an edge value — and GBM splits only at
+        edges — ``x > thr  <=>  code(x) > #edges < thr``, duplicates and
+        all. Per row-block the codes upcast to f32 with the NA bucket
+        restored to NaN, and `predict_forest` runs with the code-space
+        thresholds: every routing decision matches the raw-value traversal,
+        the same leaf values accumulate in the same scan order, and the
+        result is bit-equal to ``_raw_f`` on the stacked matrix (rows are
+        independent in the traversal, so blocking is exact)."""
+        catd, iscat, nedges = self._set_args()
+        fo = self.forest
+        thr = jnp.asarray(thr_codes)
+        step = min(self._score_chunk_rows(Xb, catd),
+                   max(8192, self._CODE_SCORE_CELLS // max(Xb.shape[1], 1)))
+        parts = []
+        for s0 in range(0, Xb.shape[0], step):
+            xf = _codes_to_f32(Xb[s0:s0 + step], na_code)
+            parts.append(predict_forest(
+                xf, fo["feat"], thr, fo["nanL"], fo["val"],
+                self.cfg.max_depth, catd=catd, iscat=iscat, nedges=nedges))
+        s = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        if self.cfg.drf_mode:
+            n = self.ntrees
+            return self.f0 + s / jnp.maximum(n, 1)
+        return self.f0 + s
+
     # -- TreeSHAP contributions (`Model.scoreContributions`,
     #    `hex/genmodel/algos/tree/TreeSHAP.java`) ---------------------------
     def predict_contributions(self, fr: Frame) -> Frame:
@@ -413,8 +448,7 @@ class GBM(ModelBuilder):
 
         from ..utils.knobs import get_bool
 
-        use_binned = (not need_raw and p.checkpoint is None
-                      and get_bool("H2O_TPU_BINNED_STORE"))
+        use_binned = not need_raw and get_bool("H2O_TPU_BINNED_STORE")
         is_cat = np.array([fr.vec(n).is_categorical() for n in names])
         w_in = (jnp.nan_to_num(
             Vec.from_numpy(np.nan_to_num(
@@ -539,21 +573,38 @@ class GBM(ModelBuilder):
             nedges_np=nedges_np, binned_view=binned_view)
 
     def build_impl(self, job: Job) -> GBMModel:
-        # checkpoint restarts replay the prior forest over RAW thresholds —
-        # only they force the stacked f32 matrix; everything else trains
-        # straight off the chunk store's binned view
-        s = self._setup_build(need_raw=self.params.checkpoint is not None)
+        rs = self._take_resume_state()
+        # checkpoint restarts replay the prior forest in BIN-CODE space over
+        # the chunk store's binned view (_raw_f_codes — exact, because GBM
+        # splits sit on bin edges), so even they no longer stack the raw f32
+        # matrix; only a prior whose thresholds are off the current grid
+        # (continuation on different data/binning) forces the stacked path.
+        # An auto-recovery resume carries f in its state — no replay at all.
+        prior = None
+        if self.params.checkpoint is not None and rs is None:
+            prior = self._resolve_checkpoint(self.params.checkpoint)
+        s = self._setup_build(need_raw=False)
+        prior_thr_codes = None
+        if prior is not None and s.X is None:
+            prior_thr_codes = _prior_thr_codes(prior, s.edges_np)
+            if prior_thr_codes is None:
+                from ..utils.log import info
+
+                info("checkpoint restart: prior split thresholds are not on "
+                     "the current bin grid — replaying over the stacked raw "
+                     "matrix instead")
+                s = self._setup_build(need_raw=True)
         p, fr, names = s.p, s.fr, s.names
         category, resp_domain, dist, K = (s.category, s.resp_domain,
                                           s.dist, s.K)
         is_cat, w, y, ymask = s.is_cat, s.w, s.y, s.ymask
-        # the RAW stacked matrix is binning input only — training runs on the
-        # binned Xb — EXCEPT a checkpoint restart, which replays the prior
-        # forest over raw thresholds. Otherwise drop it now: at
-        # airlines-116M scale it is ~4 GB of HBM the whole train would
-        # otherwise hold. (XGBoost's DART driver keeps its own s.X.)
+        # the RAW stacked matrix (present only with BINNED_STORE=0 or the
+        # off-grid fallback above) is binning input / replay input only —
+        # drop it the moment nothing needs it: at airlines-116M scale it is
+        # ~4 GB of HBM the whole train would otherwise hold. (XGBoost's
+        # DART driver keeps its own s.X.)
         X = s.X
-        if p.checkpoint is None:
+        if prior is None:
             X = s.X = None
         edges, mono, imat, edge_ok, Xb = (s.edges, s.mono, s.imat,
                                           s.edge_ok, s.Xb)
@@ -563,10 +614,8 @@ class GBM(ModelBuilder):
 
         # checkpoint restart (`hex/tree/SharedTree.java:146,243,470`): resume
         # the boosting sequence from a prior model's carried link predictions.
-        prior = None
         prior_parts = []
-        if p.checkpoint is not None:
-            prior = self._resolve_checkpoint(p.checkpoint)
+        if prior is not None:
             if p.ntrees <= prior.ntrees:
                 raise ValueError(
                     f"checkpoint model already has {prior.ntrees} trees; "
@@ -602,7 +651,12 @@ class GBM(ModelBuilder):
             if cfg.use_sets != prior_sets:
                 cfg = dataclasses.replace(cfg, use_sets=prior_sets)
             f0 = prior.f0
-            fprev = prior._raw_f(X)  # includes f0, link scale
+            if prior_thr_codes is not None:  # binned replay — X never stacked
+                fprev = prior._raw_f_codes(Xb, prior_thr_codes,
+                                           s.edges_np.shape[1] + 1)
+            else:
+                fprev = prior._raw_f(X)  # includes f0, link scale
+            X = s.X = None  # replay done — release the raw matrix (if any)
             f = fprev.T.astype(jnp.float32) if K > 1 else fprev.astype(jnp.float32)
             if self.drf_mode:
                 # _raw_f averages DRF trees; the carried f is the raw sum
@@ -614,6 +668,14 @@ class GBM(ModelBuilder):
                 for k in ("feat", "thr", "nanL", "val", "gain", "catd"))]
 
         n_prior = prior.ntrees if prior else 0
+        if rs is not None:
+            # auto-recovery resume: the state carries everything the prior
+            # block would have derived (n_prior/f0/use_sets), so a resumed
+            # continuation never needs the prior model object back
+            n_prior = int(rs["n_prior"])
+            f0 = jnp.asarray(np.asarray(rs["f0"]))
+            if bool(rs["use_sets"]) != cfg.use_sets:
+                cfg = dataclasses.replace(cfg, use_sets=bool(rs["use_sets"]))
         n_new = p.ntrees - n_prior
         base_seed = p.seed if p.seed not in (-1, None) else 1234
         all_keys = _jit_keys(base_seed, p.ntrees)[n_prior:]
@@ -648,12 +710,38 @@ class GBM(ModelBuilder):
         history = []
         import time as _t
 
+        from ..utils import failpoints
+
         stop_metric_series = []
         oob_sum = oob_cnt = None
-        for ci, (keys, rates) in enumerate(chunks):
+        start_ci = 0
+        if rs is not None and rs.get("chunks_done"):
+            # restore the EXACT carried state: the remaining chunks then see
+            # bit-identical inputs (keys/rates are indexed by global tree
+            # number; Xb/edges rebuild deterministically from the frame), so
+            # the resumed forest is bit-equal to the uninterrupted one
+            start_ci = int(rs["chunks_done"])
+            parts = [tuple(jnp.asarray(np.asarray(a)) for a in t)
+                     for t in rs["parts"]]
+            # UNCOMMITTED restore: the compiled train step re-places it to
+            # match Xb's row sharding (values, not placement, carry parity)
+            f = jnp.asarray(np.asarray(rs["f"]))
+            oob_sum = (None if rs.get("oob_sum") is None
+                       else jnp.asarray(np.asarray(rs["oob_sum"])))
+            oob_cnt = (None if rs.get("oob_cnt") is None
+                       else jnp.asarray(np.asarray(rs["oob_cnt"])))
+            history = list(rs["history"])
+            stop_metric_series = list(rs["stop_series"])
+        for ci in range(start_ci, len(chunks)):
+            keys, rates = chunks[ci]
+            failpoints.hit("train.gbm.chunk")
             job.check_cancelled()
-            if history and job.time_exceeded():  # keep the partial forest
-                break
+            if history and job.time_exceeded():  # keep the partial forest —
+                break   # the first chunk ALWAYS trains (a budget that
+                        # expires instantly still yields a usable 1-chunk
+                        # model, the reference's max_runtime contract);
+                        # callers with nothing partial to keep get the typed
+                        # path via Job.check_max_runtime/join(timeout)
             f, osum, ocnt, trees = train_fn(Xb, y_k, w, f, edges, edge_ok,
                                             keys, rates, mono, imat,
                                             s.iscat_dev, s.nedges_dev)
@@ -665,7 +753,7 @@ class GBM(ModelBuilder):
             # stopping signal is honest, not in-bag memorization; OOB spans
             # only this build's trees, hence the checkpoint gate below
             m = None
-            if self.drf_mode and p.sample_rate < 1.0 and prior is None:
+            if self.drf_mode and p.sample_rate < 1.0 and n_prior == 0:
                 m = self._oob_metrics(category, oob_sum, oob_cnt, y, ymask,
                                       w if p.weights_column else None,
                                       output.response_domain)
@@ -685,6 +773,20 @@ class GBM(ModelBuilder):
                 self._export_snapshot(p, output, parts, f0, dist, cfg, is_cat,
                                       ntrees_done, m,
                                       cat_nedges=s.nedges_np)
+            # preemption-proof auto-checkpoint: capture the exact carried
+            # state at this resumable boundary (written only when the
+            # wall-clock interval knob says it's due)
+            self._recovery_tick(
+                lambda ci=ci: {
+                    "algo": self.algo_name, "chunks_done": ci + 1,
+                    "n_prior": n_prior, "f0": f0,
+                    "use_sets": bool(cfg.use_sets),
+                    "parts": [tuple(t) for t in parts], "f": f,
+                    "oob_sum": oob_sum, "oob_cnt": oob_cnt,
+                    "history": list(history),
+                    "stop_series": list(stop_metric_series)},
+                progress={"ntrees_done": int(ntrees_done),
+                          "ntrees_total": int(p.ntrees)})
             if self._should_stop(m, stop_metric_series):
                 break
         output.scoring_history = history
@@ -911,6 +1013,41 @@ def _jit_init_f(drf_mode, K, dist, y, w):
         if builtin:
             fn = _INIT_F_CACHE.setdefault(key, fn)
     return fn(y, w)
+
+
+@jax.jit
+def _codes_to_f32(blk, na_code):
+    """One replay block: int8/int16 bin codes -> f32 with the NA bucket
+    restored to NaN (codes upcast to int32 first — the NA code can exceed
+    the narrow dtype's range check otherwise)."""
+    bi = blk.astype(jnp.int32)
+    return jnp.where(bi == na_code, jnp.nan, bi.astype(jnp.float32))
+
+
+def _prior_thr_codes(prior: "GBMModel", edges_np: np.ndarray):
+    """Map a prior forest's split thresholds onto the CURRENT bin grid for
+    code-space replay (`GBMModel._raw_f_codes`). Returns the code-space
+    threshold array (forest thr shape, f32), or None when some numeric
+    split threshold is not an edge value of the new grid — a continuation
+    on different data or binning, where code-space routing would diverge;
+    the caller then falls back to the stacked raw replay."""
+    feat = np.asarray(prior.forest["feat"])
+    thr = np.asarray(prior.forest["thr"], dtype=np.float32)
+    internal = feat >= 0
+    f_idx = np.clip(feat, 0, None)
+    e = edges_np[f_idx]  # (..., E) per-node edge rows (NaN-padded)
+    with np.errstate(invalid="ignore"):
+        codes = np.sum(e < thr[..., None], axis=-1).astype(np.float32)
+        on_grid = np.any(e == thr[..., None], axis=-1)
+    needs_grid = internal
+    if getattr(prior.cfg, "use_sets", False) and "catd" in prior.forest \
+            and prior.is_cat is not None:
+        # set-split nodes route through catd bitsets; their thr is never
+        # compared, so an off-grid value there is irrelevant
+        needs_grid = internal & ~np.asarray(prior.is_cat)[f_idx]
+    if not bool(np.all(on_grid[needs_grid])):
+        return None
+    return codes
 
 
 def _heap_path(node: int) -> str:

@@ -23,6 +23,7 @@ Semantics preserved from the reference:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -74,6 +75,14 @@ class Parameters:
                             # _auc_type)
     checkpoint: Any = None          # prior model (or its key) to continue from
     export_checkpoints_dir: Optional[str] = None  # in-training snapshots
+    auto_recovery_dir: Optional[str] = None  # preemption-proof training:
+                                    # periodic atomic checkpoints of the
+                                    # RUNNING job land here; resume_training
+                                    # restarts a killed job BIT-EQUAL to the
+                                    # uninterrupted run (backend/persist.py
+                                    # TrainingRecovery; interval knob
+                                    # H2O_TPU_CHECKPOINT_SECS, default dir
+                                    # knob H2O_TPU_AUTO_RECOVERY_DIR)
     custom_metric_func: Any = None  # callable(y, raw_pred, w) -> (name, value)
                                     # — the CFuncRef/CMetricFunc UDF analog
                                     # (`water/udf/`, `hex/CMetricScoringTask`);
@@ -421,6 +430,10 @@ class ModelBuilder:
 
         def run():
             t0 = time.time()
+            # arm auto-recovery BEFORE the encoding swap: the persisted
+            # params/frames must be the ORIGINAL inputs so a resumed process
+            # replays the (deterministic) encoding itself
+            self._arm_auto_recovery()
             enc_state = self._apply_categorical_encoding()
             if self.supports_cv and (self.params.nfolds >= 2
                                      or self.params.fold_column):
@@ -444,6 +457,8 @@ class ModelBuilder:
             jax.block_until_ready(device_arrays(model))
             model.output.run_time_ms = int((time.time() - t0) * 1000)
             self.job.dest_key = model.key
+            if self._recovery is not None:
+                self._recovery.mark_completed(model.key)
             return model
 
         self.job.start(run, background=background)
@@ -451,6 +466,94 @@ class ModelBuilder:
 
     def train_model(self) -> Model:
         return self.train(background=False).join()
+
+    # -- preemption-proof training (auto-recovery checkpoints) ----------------
+    _recovery = None       # TrainingRecovery while an armed build runs
+    _resume_state = None   # iteration state injected by resume_training
+
+    def _arm_auto_recovery(self) -> None:
+        """Arm periodic atomic checkpointing when the params (or the
+        H2O_TPU_AUTO_RECOVERY_DIR knob) name a recovery dir. CV builds are
+        excluded: fold sub-builds are cheap relative to orchestration and
+        the manifest would need a per-fold protocol (grid.py's recovery
+        already covers the expensive multi-model case)."""
+        from ..utils import knobs
+
+        self._recovery = None
+        p = self.params
+        rdir = getattr(p, "auto_recovery_dir", None)
+        if not rdir:
+            rdir = knobs.get_str("H2O_TPU_AUTO_RECOVERY_DIR")
+            if rdir:
+                # the knob arms EVERY job with one base dir — each job gets
+                # its own subdir, or concurrent jobs would interleave their
+                # manifests/state. (resume_training pins the exact subdir
+                # back into params, so resumed jobs never re-derive one.)
+                rdir = os.path.join(
+                    rdir, f"{self.algo_name}_{os.getpid()}_{self.job.key}")
+        if not rdir:
+            return
+        if self.supports_cv and (p.nfolds >= 2 or p.fold_column):
+            from ..utils.log import warn
+
+            warn("auto-recovery checkpoints are not supported for CV "
+                 "builds — training without them")
+            return
+        from ..backend.persist import TrainingRecovery
+
+        try:
+            rec = TrainingRecovery(rdir)
+            if self._resume_state is None:
+                if not rec.init_for(self):
+                    return
+            else:
+                import time as _t
+
+                # resumed: interval restarts now
+                rec._last_write = _t.monotonic()
+        except OSError as e:
+            # a training job must never die for its checkpoint insurance —
+            # unwritable/invalid dir degrades to training without it
+            from ..utils.log import warn
+
+            warn(f"auto-recovery disabled: recovery dir {rdir!r} "
+                 f"unusable ({e!r})")
+            return
+        self._recovery = rec
+
+    def _recovery_tick(self, state_fn, progress: dict | None = None) -> None:
+        """Builders call this at every iteration boundary they can resume
+        from; the state is captured (and the write paid) only when the
+        wall-clock interval has elapsed. ``state_fn`` returns the EXACT
+        iteration state — device arrays welcome, they are pulled to host by
+        the writer — such that restoring it and replaying the remaining
+        iterations is bit-equal to never having stopped."""
+        rec = self._recovery
+        if rec is None or not rec.due():
+            return
+        try:
+            rec.save_state(state_fn(), progress)
+        except OSError as e:
+            # disk yanked mid-train (full / remount): lose the insurance,
+            # keep the job. Injected faults are RuntimeErrors — they still
+            # propagate, so kill-resume tests are unaffected.
+            from ..utils.log import warn
+
+            warn(f"auto-recovery disabled mid-train: checkpoint write to "
+                 f"{rec.dir!r} failed ({e!r})")
+            self._recovery = None
+
+    def _take_resume_state(self):
+        """The iteration state `resume_training` injected (None on a fresh
+        build), guarded once for every builder: a recovery dir written by
+        another algorithm must refuse loudly, never resume into the wrong
+        build_impl."""
+        rs = self._resume_state
+        if rs is not None and rs.get("algo") != self.algo_name:
+            raise ValueError(
+                f"recovery state is for algo {rs.get('algo')!r}, "
+                f"this builder is {self.algo_name!r}")
+        return rs
 
     def _apply_categorical_encoding(self):
         """Eigen/OneHotExplicit/Binary/LabelEncoder/EnumLimited/SortByResponse
@@ -588,6 +691,35 @@ class ModelBuilder:
                 out[idx] = rng.permutation(len(idx)) % p.nfolds
             return out
         return rng.integers(0, p.nfolds, size=n)
+
+
+def resume_training(recovery_dir: str) -> Model:
+    """Restart a killed training job from its auto-recovery directory and
+    train it to completion — the preemption-recovery entry point.
+
+    Loads the builder class, original params (frames rehydrated from the
+    recovery dir) and the latest checkpointed iteration state, then replays
+    the remaining iterations. Because every RNG stream is indexed by global
+    iteration (not process history) and the checkpoint captured the exact
+    carried device state, the produced model is **bit-equal** to the one
+    the uninterrupted run would have built — pinned by the
+    kill-at-every-interval tests in tests/test_recovery.py.
+
+    Raises ``ValueError`` when the dir holds no training manifest or the
+    recorded job already completed (the manifest then names ``model_key``)."""
+    import dataclasses as _dc
+
+    from ..backend.persist import TrainingRecovery
+
+    builder_cls, params, state, manifest = TrainingRecovery.load(recovery_dir)
+    if manifest.get("completed"):
+        raise ValueError(
+            f"training in {recovery_dir} already completed "
+            f"(model {manifest.get('model_key')!r}) — nothing to resume")
+    params = _dc.replace(params, auto_recovery_dir=recovery_dir)
+    builder = builder_cls(params)
+    builder._resume_state = state  # None -> replays from the start
+    return builder.train_model()
 
 
 def _subset_frame(fr: Frame, idx: np.ndarray) -> Frame:

@@ -47,12 +47,57 @@ def cloud_size() -> int:
     return jax.process_count()
 
 
+class CloudsizeTimeoutError(RuntimeError):
+    """Typed cloud-formation failure: the barrier gave up with ``seen`` of
+    ``expected`` processes after ``waited_s`` — the numbers an operator
+    needs to tell a mis-sized deployment from a slow-joining straggler,
+    without parsing message text."""
+
+    def __init__(self, seen: int, expected: int, waited_s: float):
+        self.seen = seen
+        self.expected = expected
+        self.waited_s = waited_s
+        super().__init__(
+            f"cloud has {seen} of {expected} expected processes after "
+            f"{waited_s:.1f}s — jax.distributed.initialize must be called "
+            f"on every host (check the coordinator address and that all "
+            f"{expected} pods are scheduled)")
+
+
+def _process_count_is_static() -> bool:
+    """True when jax.process_count() can no longer change, so polling for
+    more processes would only burn the caller's timeout: either the
+    distributed client is up (membership fixed at initialize() time), or
+    backends initialized WITHOUT one (initialize() refuses to run after
+    backend init, pinning the count at 1 forever — and reading the count
+    is itself a backend init, so this is the common single-process case)."""
+    try:
+        from jax._src import distributed, xla_bridge
+
+        if distributed.global_state.client is not None:
+            return True
+        return bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001 — private API moved: fall back to poll
+        return False
+
+
 def stall_till_cloudsize(n: int, timeout_s: float = 300.0) -> None:
     """Barrier until the cloud reaches ``n`` processes — the test-harness
     primitive from the reference (`TestUtil.stall_till_cloudsize`,
     `water/TestUtil.java:87-117`). Under `jax.distributed`, initialize()
-    already blocks until every process joins, so this only validates."""
-    if jax.process_count() < n:
-        raise RuntimeError(
-            f"cloud has {jax.process_count()} processes, need {n} — "
-            f"jax.distributed.initialize must be called on every host")
+    blocks until every process joins, so membership is usually settled on
+    entry; the poll covers runtimes where process_count converges late, but
+    a mis-sized cloud whose count is already FIXED (distributed client up)
+    fails immediately instead of sleeping out the timeout. The give-up is
+    TYPED (seen-vs-expected attached), not a bare string."""
+    import time
+
+    t0 = time.monotonic()
+    while True:
+        seen = jax.process_count()
+        if seen >= n:
+            return
+        waited = time.monotonic() - t0
+        if waited >= timeout_s or _process_count_is_static():
+            raise CloudsizeTimeoutError(seen, n, waited)
+        time.sleep(min(1.0, max(timeout_s - waited, 0.01)))

@@ -135,6 +135,9 @@ def mr_reduce(
     ``jax.jit``, values ``map_fn`` closes over are baked in at trace time:
     pass varying data through ``arrays``, not through captured mutable state.
     """
+    from ..utils import failpoints
+
+    failpoints.hit("mrtask.dispatch")
     mesh = mesh or default_mesh()
     arrays = tuple(arrays)
     reduce_key = reduce if isinstance(reduce, str) \
@@ -155,6 +158,9 @@ def mr_map(
     (same leading dim as the shard); outputs stay sharded on the rows axis.
     Programs are cached like ``mr_reduce``'s.
     """
+    from ..utils import failpoints
+
+    failpoints.hit("mrtask.dispatch")
     mesh = mesh or default_mesh()
     arrays = tuple(arrays)
     fn = _driver_program(map_fn, mesh, nrow, None, _avt(arrays), True)

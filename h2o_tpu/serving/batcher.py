@@ -215,6 +215,9 @@ class MicroBatcher:
                  else np.concatenate([r.rows for r in batch], axis=0))
             recompiles_before = self._recompiles()
             try:
+                from ..utils import failpoints
+
+                failpoints.hit("serving.batch")
                 out = self._score(X)
             except Exception as e:  # noqa: BLE001 — fan the failure out
                 for req in batch:

@@ -127,6 +127,36 @@ _knob("H2O_TPU_SERVING_STATS_WINDOW", "int", 2048,
       "ring-buffer length of the per-model latency/throughput window "
       "behind GET /3/Serving/stats")
 
+# -- fault tolerance (failpoints / auto-checkpoints / retry) ----------------
+_knob("H2O_TPU_FAILPOINTS", "str", "",
+      "comma list of site:spec deterministic fault injections "
+      "(utils/failpoints.py — spec grammar: action[(arg)][*N|@K], action "
+      "in raise|sleep|http); empty = nothing armed")
+_knob("H2O_TPU_CHECKPOINT_SECS", "int", 600,
+      "wall-clock seconds between auto-recovery checkpoints while a "
+      "training job with auto_recovery_dir runs; 0 = checkpoint at every "
+      "iteration boundary (the kill-resume parity tests pin this)")
+_knob("H2O_TPU_AUTO_RECOVERY_DIR", "str", "",
+      "base auto-recovery directory armed for every training job whose "
+      "params leave auto_recovery_dir unset (preemption-proof by default "
+      "on preemptible pools); each job checkpoints into its own "
+      "<algo>_<pid>_<jobkey> subdirectory so overlapping jobs never "
+      "clobber each other — resume_training takes that subdir; empty = off")
+_knob("H2O_TPU_RETRY_ATTEMPTS", "int", 4,
+      "total tries (first call + retries) utils/retry.py allows before "
+      "raising the typed RetryBudgetExceeded")
+_knob("H2O_TPU_RETRY_BASE_MS", "int", 100,
+      "first-retry backoff in ms; doubles per retry (full jitter unless "
+      "H2O_TPU_RETRY_JITTER=0)")
+_knob("H2O_TPU_RETRY_MAX_MS", "int", 5000,
+      "backoff cap in ms — also caps server-directed Retry-After sleeps")
+_knob("H2O_TPU_RETRY_BUDGET_MS", "int", 20000,
+      "wall-clock retry budget in ms; exceeded -> RetryBudgetExceeded "
+      "even with attempts left")
+_knob("H2O_TPU_RETRY_JITTER", "bool", True,
+      "0 pins backoff to the deterministic cap sequence (tests); default "
+      "full jitter so a fleet never thunders back in lockstep")
+
 # -- security ---------------------------------------------------------------
 _knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
       "allow python: UDF references uploaded over the wire to execute")
@@ -153,8 +183,10 @@ _knob("H2O_TPU_BENCH_AIRLINES_ROWS", "int", 116_000_000,
 _knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
       "rows for the binned-store stacked-vs-binned leg")
 _knob("H2O_TPU_BENCH_WORKLOADS", "str",
-      "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,airlines",
+      "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,recovery,airlines",
       "comma list of bench workloads to run")
+_knob("H2O_TPU_BENCH_RECOVERY_ROWS", "int", 500_000,
+      "rows for the recovery leg (checkpoint overhead + resume-to-parity)")
 _knob("H2O_TPU_BENCH_SERVING_REQS", "int", 4000,
       "single-row requests issued by the concurrent serving bench leg")
 _knob("H2O_TPU_BENCH_SERVING_THREADS", "int", 16,

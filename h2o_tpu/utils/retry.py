@@ -1,0 +1,150 @@
+"""Typed transient-failure handling — shared retry/backoff policy.
+
+One policy object serves every caller that talks to something flaky: the
+Python client (connection errors and 429 Retry-After from the serving
+surface, `api/client.py`), the remote readers (`io/hdfs.py`, `io/cloud.py`),
+and anything a failpoint makes flaky on purpose. The shape mirrors the
+reference's retry discipline (S3 persist retries, client connection
+re-attempts) but with the semantics pinned:
+
+- **jittered exponential backoff** — delay_i = base * 2^i capped at max;
+  with jitter on (default), the actual sleep is uniform in (0, delay_i]
+  ("full jitter" — the fleet must not thunder back in lockstep). Tests pin
+  determinism by turning jitter off (`H2O_TPU_RETRY_JITTER=0`).
+- **server-directed delays win** — a retryable error carrying an explicit
+  delay (Retry-After from a 429) sleeps exactly that, not the backoff.
+- **bounded by attempts AND wall-clock budget** — whichever is hit first
+  raises :class:`RetryBudgetExceeded`, a TYPED give-up carrying the attempt
+  count, elapsed seconds, and the last underlying error as ``__cause__`` —
+  callers branch on the type, not on message text.
+- **non-retryable errors re-raise immediately**, untouched.
+
+Defaults come from the knob registry (`H2O_TPU_RETRY_*`); every call site
+may override per-call.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable
+
+from . import knobs
+
+
+class RetryBudgetExceeded(Exception):
+    """Typed give-up: the operation stayed transiently broken past the
+    retry budget. ``attempts`` tried, ``elapsed_s`` spent, ``last`` (also
+    ``__cause__``) is the final underlying error."""
+
+    def __init__(self, description: str, attempts: int, elapsed_s: float,
+                 last: BaseException):
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.last = last
+        super().__init__(
+            f"{description or 'operation'} still failing after "
+            f"{attempts} attempts over {elapsed_s:.2f}s: {last!r}")
+
+
+def backoff_s(attempt: int, base_s: float, max_s: float,
+              jitter: bool, rng: random.Random | None = None) -> float:
+    """Delay before retry number ``attempt`` (0-based): full-jitter
+    exponential, deterministic cap sequence with jitter off."""
+    d = min(base_s * (2.0 ** attempt), max_s)
+    if not jitter:
+        return d
+    return (rng or random).uniform(0.0, d) or d * 0.01
+
+
+def retry_after_verdict(value) -> "bool | float":
+    """Turn a raw Retry-After header value into a `retry_call` verdict:
+    the parsed float delegates the exact delay to the server; a missing or
+    malformed header falls back to our own backoff (True). The ONE copy of
+    this policy — both HTTP classifiers (`transient_http` here, the REST
+    client's `_transient_rest`) route through it."""
+    if value is None:
+        return True
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return True
+
+
+def transient_http(e: BaseException):
+    """Shared classifier for the stdlib-urllib remote readers (`io/hdfs.py`,
+    `io/cloud.py`): connection-level failures and throttling/5xx statuses
+    are transient; everything else (403, 404, parse errors) re-raises
+    untouched. HTTPError is checked first — it subclasses URLError, and a
+    404 must not be retried just because of its ancestry. A Retry-After
+    header on a 429/503 delegates the exact delay."""
+    import urllib.error
+
+    if isinstance(e, urllib.error.HTTPError):
+        if e.code not in (429, 500, 502, 503, 504):
+            return False
+        return retry_after_verdict(
+            e.headers.get("Retry-After") if e.headers else None)
+    return isinstance(e, (urllib.error.URLError, ConnectionError,
+                          TimeoutError))
+
+
+def retry_call(fn: Callable, *, retryable, description: str = "",
+               attempts: int | None = None, budget_s: float | None = None,
+               base_s: float | None = None, max_s: float | None = None,
+               jitter: bool | None = None,
+               on_retry: Callable | None = None,
+               sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` retrying transient failures; returns its result.
+
+    ``retryable`` is either a tuple of exception types, or a predicate
+    ``exc -> bool | float`` — False means not transient (re-raise), True
+    means back off, a float means "the server told us when" (sleep exactly
+    that many seconds, e.g. Retry-After). ``on_retry(exc, attempt, delay)``
+    observes each scheduled retry (logging / stats).
+    """
+    attempts = knobs.get_int("H2O_TPU_RETRY_ATTEMPTS") \
+        if attempts is None else int(attempts)
+    budget_s = knobs.get_int("H2O_TPU_RETRY_BUDGET_MS") / 1000.0 \
+        if budget_s is None else float(budget_s)
+    base_s = knobs.get_int("H2O_TPU_RETRY_BASE_MS") / 1000.0 \
+        if base_s is None else float(base_s)
+    max_s = knobs.get_int("H2O_TPU_RETRY_MAX_MS") / 1000.0 \
+        if max_s is None else float(max_s)
+    jitter = knobs.get_bool("H2O_TPU_RETRY_JITTER") \
+        if jitter is None else bool(jitter)
+
+    if isinstance(retryable, (tuple, type)):
+        types = retryable if isinstance(retryable, tuple) else (retryable,)
+
+        def _classify(exc):  # noqa: ANN001
+            return isinstance(exc, types)
+    else:
+        _classify = retryable
+
+    t0 = time.monotonic()
+    tried = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified right below
+            verdict = _classify(e)
+            if verdict is False or verdict is None:
+                raise
+            tried += 1
+            elapsed = time.monotonic() - t0
+            if tried >= attempts or elapsed >= budget_s:
+                raise RetryBudgetExceeded(description, tried, elapsed,
+                                          e) from e
+            if isinstance(verdict, bool):
+                delay = backoff_s(tried - 1, base_s, max_s, jitter)
+            else:
+                # server-directed delay wins EXACTLY (max_s caps only our
+                # own backoff) — the budget clip below still bounds it
+                delay = float(verdict)
+            # never sleep past the budget — give up ON TIME, typed
+            delay = min(delay, max(budget_s - elapsed, 0.0))
+            if on_retry is not None:
+                on_retry(e, tried, delay)
+            if delay > 0:
+                sleep(delay)

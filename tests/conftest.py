@@ -117,6 +117,10 @@ def pytest_configure(config):
         "markers", "serving: online scoring runtime — bucketed scorers, "
                    "micro-batcher, REST surface (pytest -m serving, "
                    "h2o_tpu/serving/)")
+    config.addinivalue_line(
+        "markers", "faults: fault-tolerance layer — failpoints, "
+                   "auto-checkpoint kill-resume parity, typed retry "
+                   "(pytest -m faults, utils/failpoints.py + retry.py)")
 
 
 def pytest_collection_modifyitems(config, items):

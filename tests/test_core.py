@@ -199,6 +199,26 @@ class TestMaxRuntime:
         assert m.ntrees == 1  # partial forest, still a usable model
         assert m.predict(fr).nrow == n
 
+    def test_dl_expired_budget_raises_typed_before_first_epoch(self):
+        # no epoch completed -> nothing partial to keep: the typed
+        # JobTimeoutError path (Job.check_max_runtime), not a silent overrun
+        import numpy as np
+
+        from h2o_tpu.backend.jobs import JobTimeoutError
+        from h2o_tpu.models.deeplearning import (DeepLearning,
+                                                 DeepLearningParameters)
+
+        rng = np.random.default_rng(2)
+        n = 200
+        fr = Frame.from_dict({"x": rng.normal(size=n).astype(np.float32),
+                              "y": rng.normal(size=n).astype(np.float32)})
+        with pytest.raises(JobTimeoutError) as ei:
+            DeepLearning(DeepLearningParameters(
+                training_frame=fr, response_column="y", hidden=[4],
+                epochs=1.0, seed=1,
+                max_runtime_secs=1e-9)).train_model()
+        assert ei.value.budget_s > 0
+
     def test_glm_budget_returns_model(self):
         import numpy as np
 

@@ -1,0 +1,280 @@
+"""Failpoint registry — spec grammar, determinism, env activation, and the
+instrumented sites that make the fault-tolerance layer exercisable on the
+CPU mesh (utils/failpoints.py).
+
+No jax-heavy work here: the registry is a pure-python leaf; site tests that
+need the runtime live in test_recovery.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from h2o_tpu.utils import failpoints as fp
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    monkeypatch.delenv("H2O_TPU_FAILPOINTS", raising=False)
+    fp.reset()
+    yield
+    fp.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+def test_bad_specs_raise_valueerror_at_arm_time():
+    for spec in ("explode", "raise(nuclear)", "sleep", "sleep(abc)",
+                 "http", "http(9999)", "raise*x", ""):
+        with pytest.raises(ValueError):
+            fp.arm("parser.parse", spec)
+
+
+def test_unregistered_site_raises_keyerror_everywhere():
+    with pytest.raises(KeyError):
+        fp.arm("no.such.site", "raise")  # graftlint: disable=unregistered-failpoint
+    with pytest.raises(KeyError):
+        fp.hit("no.such.site")  # graftlint: disable=unregistered-failpoint
+    with pytest.raises(KeyError):
+        fp.is_armed("no.such.site")  # graftlint: disable=unregistered-failpoint
+
+
+def test_registry_entries_have_docs():
+    for site, decl in fp.FAILPOINTS.items():
+        assert decl.doc, f"failpoint {site} has no docstring"
+
+
+# ---------------------------------------------------------------------------
+# determinism of *N and @K
+# ---------------------------------------------------------------------------
+def test_raise_every_hit():
+    fp.arm("parser.parse", "raise")
+    for _ in range(3):
+        with pytest.raises(fp.InjectedFault):
+            fp.hit("parser.parse")
+    assert fp.hits("parser.parse") == 3
+
+
+def test_raise_first_n_hits_only():
+    fp.arm("parser.parse", "raise*2")
+    for i in (1, 2):
+        with pytest.raises(fp.InjectedFault) as ei:
+            fp.hit("parser.parse")
+        assert ei.value.hit_no == i
+    fp.hit("parser.parse")  # third hit passes clean
+    fp.hit("parser.parse")
+    assert fp.hits("parser.parse") == 4
+
+
+def test_raise_at_exactly_kth_hit():
+    fp.arm("parser.parse", "raise@3")
+    fp.hit("parser.parse")
+    fp.hit("parser.parse")
+    with pytest.raises(fp.InjectedFault) as ei:
+        fp.hit("parser.parse")
+    assert ei.value.hit_no == 3
+    fp.hit("parser.parse")  # 4th is clean again
+
+
+def test_kinds_map_to_typed_exceptions():
+    fp.arm("cleaner.rehydrate", "raise(oom)")
+    with pytest.raises(fp.InjectedOOM) as ei:
+        fp.hit("cleaner.rehydrate")
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    fp.arm("train.gbm.chunk", "raise(preempt)")
+    with pytest.raises(fp.InjectedPreemption):
+        fp.hit("train.gbm.chunk")
+    fp.arm("io.remote", "raise(conn)")
+    with pytest.raises(ConnectionResetError):
+        fp.hit("io.remote")
+    fp.arm("rest.route", "http(429)")
+    with pytest.raises(fp.InjectedHTTPError) as ei:
+        fp.hit("rest.route")
+    assert ei.value.status == 429 and ei.value.retry_after_s > 0
+
+
+def test_sleep_injects_latency():
+    fp.arm("serving.batch", "sleep(40)")
+    t0 = time.monotonic()
+    fp.hit("serving.batch")
+    assert time.monotonic() - t0 >= 0.03
+
+
+# ---------------------------------------------------------------------------
+# env activation (the H2O_TPU_FAILPOINTS surface)
+# ---------------------------------------------------------------------------
+def test_env_arms_and_rearms_dynamically(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_FAILPOINTS", "parser.parse:raise*1")
+    with pytest.raises(fp.InjectedFault):
+        fp.hit("parser.parse")
+    fp.hit("parser.parse")  # *1 exhausted
+    # changing the env mid-process re-parses; the unchanged pair keeps its
+    # counter (appending a site must not reset determinism elsewhere)
+    monkeypatch.setenv("H2O_TPU_FAILPOINTS",
+                       "parser.parse:raise*1,mrtask.dispatch:raise@1")
+    fp.hit("parser.parse")  # counter survived: still exhausted
+    with pytest.raises(fp.InjectedFault):
+        fp.hit("mrtask.dispatch")
+    assert fp.hits("parser.parse") == 3
+    monkeypatch.setenv("H2O_TPU_FAILPOINTS", "")
+    fp.hit("parser.parse")
+    assert not fp.active()
+
+
+def test_env_bad_site_raises_keyerror(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_FAILPOINTS", "bogus.site:raise")
+    with pytest.raises(KeyError):
+        fp.hit("parser.parse")
+
+
+def test_programmatic_arm_overrides_env(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_FAILPOINTS", "parser.parse:raise")
+    fp.arm("parser.parse", "sleep(1)")
+    fp.hit("parser.parse")  # no raise: programmatic spec won
+    assert fp.active()["parser.parse"] == "sleep(1)"
+
+
+# ---------------------------------------------------------------------------
+# instrumented sites (cheap ones — no training)
+# ---------------------------------------------------------------------------
+def test_parser_site_fires():
+    import tempfile
+
+    from h2o_tpu.io.parser import parse_file
+
+    fp.arm("parser.parse", "raise@1")
+    with tempfile.NamedTemporaryFile(suffix=".csv", mode="w",
+                                     delete=False) as f:
+        f.write("a,b\n1,2\n")
+        path = f.name
+    with pytest.raises(fp.InjectedFault):
+        parse_file(path)
+    fr = parse_file(path)  # second attempt clean
+    assert fr.nrow == 1
+    from h2o_tpu.backend.kvstore import STORE
+
+    STORE.remove(fr.key)
+
+
+def test_mrtask_site_fires():
+    import jax.numpy as jnp
+
+    from h2o_tpu.parallel.mrtask import mr_reduce
+
+    fp.arm("mrtask.dispatch", "raise@1")
+    arr = jnp.ones(16)
+    with pytest.raises(fp.InjectedFault):
+        mr_reduce(lambda cols, rows: jnp.sum(cols[0] * rows.maskf()),
+                  [arr], 16)
+    out = mr_reduce(lambda cols, rows: jnp.sum(cols[0] * rows.maskf()),
+                    [arr], 16)
+    assert float(out) == 16.0
+
+
+def test_retry_module_backoff_and_typed_giveup(monkeypatch):
+    from h2o_tpu.utils.retry import RetryBudgetExceeded, backoff_s, retry_call
+
+    # deterministic cap sequence with jitter off
+    assert [backoff_s(i, 0.1, 0.5, jitter=False) for i in range(4)] \
+        == [0.1, 0.2, 0.4, 0.5]
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("flaky")
+        return "ok"
+
+    out = retry_call(flaky, retryable=(ConnectionResetError,),
+                     attempts=5, budget_s=30, base_s=0.01, max_s=0.05,
+                     jitter=False, sleep=sleeps.append)
+    assert out == "ok" and len(calls) == 3 and sleeps == [0.01, 0.02]
+
+    calls.clear()
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        retry_call(flaky, retryable=(ConnectionResetError,), attempts=2,
+                   budget_s=30, base_s=0.001, max_s=0.01, jitter=False,
+                   sleep=lambda s: None, description="flaky op")
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last, ConnectionResetError)
+    assert ei.value.__cause__ is ei.value.last
+
+    # non-retryable errors re-raise untouched
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("no")),
+                   retryable=(ConnectionResetError,))
+
+    # a float verdict (Retry-After) dictates the exact delay
+    seen = []
+
+    def overloaded():
+        if not seen:
+            raise RuntimeError("429")
+        return "ok"
+
+    out = retry_call(overloaded,
+                     retryable=lambda e: 0.123 if not seen else False,
+                     attempts=3, budget_s=30, base_s=9, max_s=9,
+                     jitter=False,
+                     sleep=lambda s: seen.append(s))
+    assert out == "ok" and seen == [0.123]
+
+
+def test_transient_http_classifier():
+    import urllib.error
+    from email.message import Message
+
+    from h2o_tpu.utils.retry import transient_http
+
+    h = Message()
+    h["Retry-After"] = "1.5"
+    e429 = urllib.error.HTTPError("u", 429, "too many", h, None)
+    assert transient_http(e429) == 1.5
+    e404 = urllib.error.HTTPError("u", 404, "nf", Message(), None)
+    assert transient_http(e404) is False
+    e503 = urllib.error.HTTPError("u", 503, "busy", Message(), None)
+    assert transient_http(e503) is True
+    assert transient_http(urllib.error.URLError("down")) is True
+    assert transient_http(ConnectionResetError()) is True
+    assert transient_http(ValueError()) is False
+
+
+def test_job_timeout_error_is_typed():
+    import h2o_tpu
+    from h2o_tpu.backend.jobs import Job, JobTimeoutError
+
+    assert h2o_tpu.JobTimeoutError is JobTimeoutError
+    j = Job("sleepy")
+    j.start(lambda: time.sleep(2.0), background=True)
+    with pytest.raises(JobTimeoutError) as ei:
+        j.join(timeout=0.05)
+    assert ei.value.budget_s == 0.05 and ei.value.elapsed_s >= 0.0
+    j.stop()
+
+    j2 = Job("expired")
+    j2.set_max_runtime(0.01)
+    j2.start_time = time.time() - 1.0
+    time.sleep(0.02)
+    assert j2.time_exceeded()
+    with pytest.raises(JobTimeoutError) as ei:
+        j2.check_max_runtime()
+    assert ei.value.budget_s > 0
+
+
+def test_stall_till_cloudsize_typed_timeout():
+    from h2o_tpu.parallel.cluster import (CloudsizeTimeoutError,
+                                          stall_till_cloudsize)
+
+    stall_till_cloudsize(1, timeout_s=1.0)  # single-process cloud: instant
+    with pytest.raises(CloudsizeTimeoutError) as ei:
+        # count is static here (backend up, no distributed client), so the
+        # mis-sized cloud fails FAST instead of sleeping out the timeout
+        stall_till_cloudsize(4, timeout_s=30.0)
+    assert ei.value.seen == 1 and ei.value.expected == 4
+    assert ei.value.waited_s < 30.0
+    assert "1 of 4" in str(ei.value)

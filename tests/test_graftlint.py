@@ -162,6 +162,42 @@ import os
 v = os.environ.get("H2O_TPU_BINNED_STORE", "1")
 """,
     ),
+    "unregistered-failpoint": (
+        """
+from h2o_tpu.utils import failpoints
+
+failpoints.hit("totally.new.site")
+""",
+        """
+from h2o_tpu.utils import failpoints
+
+failpoints.hit("parser.parse")
+""",
+    ),
+    "swallowed-retryable": (
+        """
+from h2o_tpu.utils import failpoints
+
+
+def read():
+    try:
+        failpoints.hit("io.remote")
+        return 1
+    except Exception:
+        pass
+""",
+        """
+from h2o_tpu.utils import failpoints
+
+
+def read():
+    try:
+        failpoints.hit("io.remote")
+        return 1
+    except Exception as e:
+        raise RuntimeError("read failed") from e
+""",
+    ),
 }
 
 
@@ -190,6 +226,21 @@ def test_rule_suppressed_inline(rule_id):
     for ln in flagged:
         lines[ln - 1] += f"  # graftlint: disable={rule_id}"
     assert rule_id not in _rules_hit("\n".join(lines))
+
+
+def test_swallowed_retryable_catches_tuple_and_dotted_forms():
+    # `except (ValueError, Exception):` and `except builtins.Exception:`
+    # swallow exactly as much as the bare spelling
+    violating = FIXTURES["swallowed-retryable"][0]
+    tupled = violating.replace("except Exception:",
+                               "except (ValueError, Exception):")
+    assert "swallowed-retryable" in _rules_hit(tupled)
+    dotted = violating.replace("except Exception:",
+                               "except builtins.Exception:")
+    assert "swallowed-retryable" in _rules_hit(dotted)
+    narrow = violating.replace("except Exception:",
+                               "except (ValueError, KeyError):")
+    assert "swallowed-retryable" not in _rules_hit(narrow)
 
 
 def test_suppression_works_on_continuation_lines():
@@ -543,7 +594,17 @@ def test_scan_set_includes_the_advertised_tree():
 
 def test_every_rule_registered_exactly_once():
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 8
+    assert len(ids) == len(set(ids)) == 10
+
+
+def test_failpoint_registry_covers_every_site_the_tree_hits():
+    """Dynamic twin of unregistered-failpoint: every literal site name in
+    the shipped tree resolves against the registry module itself."""
+    from h2o_tpu.utils import failpoints as fp
+    from tools.graftlint.rules import registered_failpoints
+
+    assert registered_failpoints() == set(fp.FAILPOINTS)
+    assert set(fp.FAILPOINTS)  # the registry is not empty
 
 
 def test_repo_gate_zero_nonbaselined_violations():

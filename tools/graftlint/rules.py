@@ -25,6 +25,14 @@ CHANGES.md entries):
 8. unregistered-knob     — literal `H2O_TPU_*` env reads must be declared
    in `h2o_tpu/utils/knobs.py` so the knob surface stays documented and
    greppable (the OptArgs discipline, enforced).
+9. unregistered-failpoint — PR 5: literal failpoint site names must be
+   declared in `h2o_tpu/utils/failpoints.py`; an undeclared site is a
+   fault drill nobody can arm (the knobs discipline, applied to fault
+   injection).
+10. swallowed-retryable  — PR 5: `except Exception: pass` around an
+   instrumented (failpoint) site swallows injected faults — and with them
+   the real transient failures the drill stands in for; transient errors
+   route through `utils/retry.py` or unwind typed.
 """
 
 from __future__ import annotations
@@ -38,6 +46,7 @@ from .core import (REPO_ROOT, FileContext, Rule, Violation, dotted_name,
 #: the one sanctioned shard_map definition site
 MESH_PATH = "h2o_tpu/parallel/mesh.py"
 KNOBS_PATH = "h2o_tpu/utils/knobs.py"
+FAILPOINTS_PATH = "h2o_tpu/utils/failpoints.py"
 
 _NARROW_INTS = {"int8", "int16", "uint8", "uint16"}
 _WIDE_TYPES = {"int32", "int64", "uint32", "uint64",
@@ -526,6 +535,127 @@ class UnregisteredKnob(Rule):
         return out
 
 
+def registered_failpoints(root: str = REPO_ROOT) -> set[str]:
+    """Failpoint sites declared in h2o_tpu/utils/failpoints.py — AST-parsed
+    like the knob registry, so the linter never imports the package."""
+    path = os.path.join(root, FAILPOINTS_PATH)
+    names: set[str] = set()
+    if not os.path.exists(path):
+        return names
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and dotted_name(node.func) in ("_failpoint", "Failpoint")):
+            names.add(node.args[0].value)
+    return names
+
+
+class UnregisteredFailpoint(Rule):
+    id = "unregistered-failpoint"
+    doc = ("literal failpoint site name not declared in the "
+           "h2o_tpu/utils/failpoints.py registry")
+
+    #: accessor attributes whose literal first argument is a site name
+    _ACCESSORS = ("hit", "arm", "disarm", "is_armed", "hits")
+
+    def __init__(self, registry: set[str] | None = None):
+        self._registry = registry
+
+    @property
+    def registry(self) -> set[str]:
+        if self._registry is None:
+            self._registry = registered_failpoints()
+        return self._registry
+
+    def check(self, tree, ctx):
+        if ctx.relpath == FAILPOINTS_PATH:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            fn = _norm_func(node, ctx)
+            if fn is None or not any(
+                    fn.endswith(f"failpoints.{acc}")
+                    for acc in self._ACCESSORS):
+                continue
+            name = node.args[0].value
+            if name not in self.registry:
+                out.append(self.violation(
+                    ctx, node,
+                    f"failpoint {name!r} is not declared in "
+                    f"h2o_tpu/utils/failpoints.py — register it (name, "
+                    f"docstring) so every fault drill stays armable and "
+                    f"documented"))
+        return out
+
+
+def _contains_failpoint_hit(stmts, ctx) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                fn = _norm_func(node, ctx)
+                if fn is not None and fn.endswith("failpoints.hit"):
+                    return True
+    return False
+
+
+class SwallowedRetryable(Rule):
+    id = "swallowed-retryable"
+    doc = ("broad except-and-ignore around an instrumented (failpoint) "
+           "site — injected faults, and the real transient failures they "
+           "stand in for, must not vanish silently")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def _is_broad_expr(self, t) -> bool:
+        """Exception/BaseException as a bare Name, dotted builtins.*, or any
+        member of a tuple handler — `except (Exception,):` swallows exactly
+        as much as `except Exception:`."""
+        if isinstance(t, ast.Name):
+            return t.id in self._BROAD
+        if isinstance(t, ast.Attribute):
+            return (t.attr in self._BROAD
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "builtins")
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad_expr(el) for el in t.elts)
+        return False
+
+    def check(self, tree, ctx):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not _contains_failpoint_hit(node.body, ctx):
+                continue
+            for handler in node.handlers:
+                t = handler.type
+                broad = t is None or self._is_broad_expr(t)
+                if not broad:
+                    continue
+                body = [s for s in handler.body
+                        if not (isinstance(s, ast.Expr)
+                                and isinstance(s.value, ast.Constant))]
+                ignores = all(isinstance(s, (ast.Pass, ast.Continue))
+                              for s in body)
+                if ignores:
+                    out.append(self.violation(
+                        ctx, handler,
+                        "broad except silently ignores failures from an "
+                        "instrumented site — a failpoint drill (and the "
+                        "real transient fault it models) would vanish "
+                        "here; retry through utils/retry.py or let the "
+                        "typed error unwind"))
+        return out
+
+
 ALL_RULES = (DirectShardMap, PSpecConcat, NarrowIntAccumulate,
              UntrackedResident, TimingWithoutSync, HostSyncInTrace,
-             NondeterminismInTrace, UnregisteredKnob)
+             NondeterminismInTrace, UnregisteredKnob, UnregisteredFailpoint,
+             SwallowedRetryable)
