@@ -710,6 +710,20 @@ def _emit_workload(workloads: dict, name: str, rec: dict) -> None:
         os.fsync(f.fileno())
 
 
+def _leg(workloads: dict, name: str, fn) -> dict:
+    """Run one workload with a telemetry snapshot taken around it and embed
+    the registry DELTA in the fsync'd sidecar record — every leg's numbers
+    now carry compile counts, MRTask dispatch/payload totals, spill bytes
+    and the HBM watermark next to its wall times (utils/telemetry.py)."""
+    from h2o_tpu.utils import telemetry
+
+    before = telemetry.snapshot()
+    rec = dict(fn())
+    rec["telemetry"] = telemetry.snapshot_delta(before)
+    _emit_workload(workloads, name, rec)
+    return rec
+
+
 def main():
     from h2o_tpu.utils import knobs
 
@@ -724,6 +738,11 @@ def main():
 
     _enable_compile_cache()
     compiles = _CompileCounter()
+    # backend-compile events feed the telemetry registry from the first
+    # leg, so every sidecar record's delta carries its compile count
+    from h2o_tpu.utils import compilemeter
+
+    compilemeter.install()
     _sidecar_start({"rows": nrow, "ntrees": ntrees, "sort_rows": sort_rows,
                     "workloads": wanted,
                     "backend": jax.default_backend()})
@@ -750,41 +769,40 @@ def main():
         jax.device_get(sums)
         h2d_s = round(time.time() - t0, 3)
         if "gbm" in wanted:
-            gbm = bench_gbm(fr, ntrees, skip_cadence)
-            _emit_workload(workloads, "gbm", gbm)
+            gbm = _leg(workloads, "gbm",
+                       lambda: bench_gbm(fr, ntrees, skip_cadence))
         if "glm" in wanted:
-            _emit_workload(workloads, "glm_irlsm",
-                           bench_glm(fr, "IRLSM", GLM_BAND))
+            _leg(workloads, "glm_irlsm",
+                 lambda: bench_glm(fr, "IRLSM", GLM_BAND))
         if "cod" in wanted:
-            _emit_workload(workloads, "glm_cod",
-                           bench_glm(fr, "COORDINATE_DESCENT", COD_BAND))
+            _leg(workloads, "glm_cod",
+                 lambda: bench_glm(fr, "COORDINATE_DESCENT", COD_BAND))
         if "gam" in wanted:
-            _emit_workload(workloads, "gam_irlsm", bench_gam(fr))
+            _leg(workloads, "gam_irlsm", lambda: bench_gam(fr))
         if "rulefit" in wanted:
-            _emit_workload(workloads, "rulefit", bench_rulefit(fr))
+            _leg(workloads, "rulefit", lambda: bench_rulefit(fr))
         del fr
         gc.collect()
     if "sort" in wanted:
-        _emit_workload(workloads, "sort", bench_sort(sort_rows))
+        _leg(workloads, "sort", lambda: bench_sort(sort_rows))
     if "merge" in wanted:
-        _emit_workload(workloads, "merge", bench_merge(sort_rows))
+        _leg(workloads, "merge", lambda: bench_merge(sort_rows))
     if "serving" in wanted:
-        _emit_workload(workloads, "serving", bench_serving(
+        _leg(workloads, "serving", lambda: bench_serving(
             knobs.get_int("H2O_TPU_BENCH_SERVING_REQS"),
             knobs.get_int("H2O_TPU_BENCH_SERVING_THREADS")))
     if "binned" in wanted:
-        binned_rows = knobs.get_int("H2O_TPU_BENCH_BINNED_ROWS")
-        _emit_workload(workloads, "binned_store",
-                       bench_binned_store(binned_rows,
-                                          min(ntrees, 20)))
+        _leg(workloads, "binned_store",
+             lambda: bench_binned_store(
+                 knobs.get_int("H2O_TPU_BENCH_BINNED_ROWS"),
+                 min(ntrees, 20)))
     if "recovery" in wanted:
-        _emit_workload(workloads, "recovery", bench_recovery(
+        _leg(workloads, "recovery", lambda: bench_recovery(
             knobs.get_int("H2O_TPU_BENCH_RECOVERY_ROWS"),
             min(ntrees, 20)))
     if "airlines" in wanted:
-        air_rows = knobs.get_int("H2O_TPU_BENCH_AIRLINES_ROWS")
-        _emit_workload(workloads, "airlines116m",
-                       bench_airlines(air_rows, ntrees))
+        _leg(workloads, "airlines116m", lambda: bench_airlines(
+            knobs.get_int("H2O_TPU_BENCH_AIRLINES_ROWS"), ntrees))
 
     t_once = gbm["score_once_s"] if gbm else None
     print(json.dumps({

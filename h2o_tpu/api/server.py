@@ -307,8 +307,9 @@ def _make_handler(server: H2OServer):
             # monitoring polls don't count as activity for SteamMetrics'
             # idle clock (`water/api/SteamMetricsHandler` semantics)
             head = parts[1] if len(parts) > 1 else (parts[0] if parts else "")
-            if head not in ("Cloud", "Ping", "Jobs", "SteamMetrics",
-                            "Sample"):
+            is_monitor_poll = head in ("Cloud", "Ping", "Jobs",
+                                       "SteamMetrics", "Sample")
+            if not is_monitor_poll:
                 server.last_activity = time.time()
             if method == "POST" and parts and \
                     parts[-1] in ("PostFile", "PostFile.bin"):
@@ -320,6 +321,9 @@ def _make_handler(server: H2OServer):
                                            stacktrace=traceback.format_exc())
                 self._reply(status, payload)
                 return
+            from ..utils import telemetry
+
+            t_route = time.perf_counter()
             try:
                 from ..utils import failpoints
 
@@ -342,6 +346,27 @@ def _make_handler(server: H2OServer):
             except Exception as e:  # noqa: BLE001 — surface as H2OError
                 status, payload = _err(500, repr(e),
                                        stacktrace=traceback.format_exc())
+                from ..utils.log import err as _log_err
+
+                # a 500 that only ever reached the wire was invisible to
+                # /3/Logs — now the ring keeps it
+                _log_err(f"{method} {parsed.path} -> 500: {e!r}")
+            # every routed request lands in the registry (the reference
+            # TimeLine records every RPC packet; the REST control plane is
+            # this repo's packet stream) — but monitoring polls stay OUT of
+            # the ring: a client polling /3/Jobs at 50ms would cycle the
+            # 4096-event ring and evict the very training spans the
+            # endpoint exists to show
+            telemetry.inc("rest.request.count")
+            if status >= 500:
+                telemetry.inc("rest.error.count")
+            telemetry.observe("rest.request.seconds",
+                              time.perf_counter() - t_route)
+            if not is_monitor_poll:
+                from ..utils import timeline as _timeline
+
+                _timeline.record("rest", f"{method} {parsed.path}",
+                                 status=status)
             self._reply(status, payload)
 
         def do_GET(self):
@@ -2181,27 +2206,48 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                 "stack": "".join(tb.format_stack(frame))})
         return 200, {"traces": traces}
     if head == "Logs":
-        from ..utils.log import get_buffer
+        from ..utils.log import get_buffer, get_records
 
+        limit = int(p.get("limit", 2000) or 0) or None
         if rest[1:] and rest[1] == "nodes" and len(rest) >= 5:
             # `GET /3/Logs/nodes/{nodeidx}/files/{name}`
             # (`water/api/LogsHandler`) — one controller, so every nodeidx
-            # serves the same ring; `name` filters by level prefix
-            name = rest[4].lower()
-            lines = get_buffer()
-            level_names = {"trace": "DEBUG", "debug": "DEBUG",
-                           "info": "INFO", "warn": "WARN",
-                           "error": "ERRR", "fatal": "FATAL"}
-            want = level_names.get(name)
-            if want:  # ring lines lead with "MM-DD HH:MM:SS LEVEL"
-                lines = [ln for ln in lines if want in ln[:30]]
+            # serves the same ring; `name` picks the per-level file, a
+            # STRUCTURED level filter on the typed ring (friendly
+            # spellings resolve via log._LEVEL_ALIASES); unknown names
+            # serve the unfiltered ring, the old behavior
+            from ..utils.log import _LEVEL_ALIASES
+
+            want = (rest[4] if rest[4].upper() in _LEVEL_ALIASES else None)
+            lines = get_buffer(limit=limit, level=want)
             return 200, {"log": "\n".join(lines),
                          "name": rest[4], "nodeidx": int(rest[2])}
-        return 200, {"log": "\n".join(get_buffer())}
+        return 200, {"log": "\n".join(get_buffer(limit=limit)),
+                     "records": get_records(limit=limit,
+                                            level=p.get("level") or None)}
     if head == "Timeline":
-        from ..utils.timeline import snapshot
+        from ..utils import timeline as tl
 
-        return 200, {"events": snapshot()}
+        # full typed events (seq/ns/ms/kind/what + kind-specific detail),
+        # newest-biased cap so a full 4096-event ring doesn't make every
+        # poll serialize megabytes (`?limit=N`, `?kind=span` filter)
+        limit = int(p.get("limit", 1000) or 0)
+        events = tl.snapshot(limit=limit or None,
+                             kind=p.get("kind") or None)
+        return 200, {"events": events,
+                     "total_recorded": tl.total_recorded(),
+                     "capacity": tl.capacity()}
+    if head == "Metrics":
+        # the unified telemetry registry — JSON by default, Prometheus
+        # text exposition via ?format=prometheus (scrape-ready)
+        from ..utils import telemetry
+
+        if (p.get("format") or "").lower() in ("prometheus", "text"):
+            return 200, {"__raw__": telemetry.prometheus(),
+                         "__ctype__": "text/plain; version=0.0.4"}
+        return 200, {"metrics": telemetry.snapshot(),
+                     "trace_path": telemetry.trace_path(),
+                     "ts_ms": int(time.time() * 1000)}
     if head == "Profiler":
         # `water/api/ProfilerHandler`: cluster stack-sample aggregation; here
         # the controller process is sampled for `depth` rounds
@@ -2224,7 +2270,12 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         nodes = [{"node_name": server.name,
                   "entries": [{"stacktrace": s, "count": c}
                               for s, c in counts.most_common(50)]}]
-        return 200, {"nodes": nodes}
+        # per-task phase aggregation (`MRTask.profile()` records rolled up
+        # process-wide) — the view that says WHERE task time went, next to
+        # the stack samples that say where threads are right now
+        from ..utils.profile import aggregate_snapshot
+
+        return 200, {"nodes": nodes, "task_profiles": aggregate_snapshot()}
     if head == "WaterMeterCpuTicks":
         # `water/api/WaterMeterCpuTicksHandler` — /proc/stat per-core ticks
         ticks = []
@@ -2299,7 +2350,7 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                    "ModelsV3", "ModelSchemaV3", "ModelBuildersV3",
                    "RapidsSchemaV3", "ImportFilesV3", "ParseV3",
                    "ParseSetupV3", "InitIDV3", "ShutdownV3", "LogsV3",
-                   "TimelineV3", "ProfilerV3", "NetworkTestV3",
+                   "TimelineV3", "MetricsV3", "ProfilerV3", "NetworkTestV3",
                    "PartialDependenceV3", "PermutationVarImpV3",
                    "TwoDimTableV3", "KeyV3", "H2OErrorV3"})
             if rest[2:]:
@@ -2407,8 +2458,10 @@ _ROUTES_DOC = [
         ("GET", "/3/Logs", "node log ring"),
         ("GET", "/3/Logs/nodes/{nodeidx}/files/{name}",
          "one node's log file, filtered by level"),
-        ("GET", "/3/Timeline", "event timeline ring"),
-        ("GET", "/3/Profiler", "stack-sample profile"),
+        ("GET", "/3/Timeline", "typed event timeline ring (limit/kind)"),
+        ("GET", "/3/Metrics",
+         "unified telemetry registry (JSON; ?format=prometheus)"),
+        ("GET", "/3/Profiler", "stack samples + task phase aggregation"),
         ("GET", "/3/WaterMeterCpuTicks/{node}", "cpu tick counters"),
         ("GET", "/3/WaterMeterIo", "io counters"),
         ("GET", "/3/WaterMeterIo/{nodeidx}", "one node's io counters"),

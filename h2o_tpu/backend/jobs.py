@@ -98,8 +98,14 @@ class Job(Keyed):
     # -- lifecycle -----------------------------------------------------------
     def start(self, fn: Callable[[], Any], background: bool = True) -> "Job":
         def _run():
+            # job transitions are timeline events, like the reference's
+            # TimeLine records of task start/finish packets
+            from ..utils import timeline
+
             self.status = Job.RUNNING
             self.start_time = time.time()
+            timeline.record("job", "start", job=str(self.key),
+                            desc=self.description)
             try:
                 self.result = fn()
                 self.status = Job.CANCELLED if self._stop_requested else Job.DONE
@@ -111,6 +117,9 @@ class Job(Keyed):
                 self.status = Job.FAILED
             finally:
                 self.end_time = time.time()
+                timeline.record("job", self.status, job=str(self.key),
+                                run_s=round(self.end_time
+                                            - self.start_time, 3))
                 _note_job_finished()
 
         if background:

@@ -44,7 +44,7 @@ import weakref
 
 import numpy as np
 
-from ..utils import knobs
+from ..utils import knobs, telemetry
 
 _UNRESOLVED = object()
 
@@ -136,6 +136,7 @@ class Cleaner:
     def limit_bytes(self) -> int | None:
         env = knobs.raw("H2O_TPU_HBM_LIMIT_BYTES")
         if env and int(env) > 0:  # 0 = backend resolution (optargs contract)
+            telemetry.set_gauge("cleaner.hbm.limit.bytes", int(env))
             return int(env)
         if self._stats_limit is _UNRESOLVED:
             stats = hbm_stats()
@@ -153,6 +154,7 @@ class Cleaner:
                     hw = device_hbm_bytes() or 16 * (1 << 30)
                     limit = int(hw * 0.85)
             self._stats_limit = limit
+            telemetry.set_gauge("cleaner.hbm.limit.bytes", limit or 0)
         return self._stats_limit
 
     # -- tracking -------------------------------------------------------------
@@ -178,6 +180,8 @@ class Cleaner:
                                  getattr(vec, "key", None))
             self._resident_bytes += nbytes
             self._sizes[tok] = self._sizes.get(tok, 0) + nbytes
+            telemetry.set_gauge("cleaner.hbm.live.bytes",
+                                max(self._resident_bytes, 0))
         self.maybe_sweep(exclude=tok)
 
     def note_freed(self, vec, nbytes: int,
@@ -193,6 +197,8 @@ class Cleaner:
             tok = getattr(vec, "_cleaner_token", None)
             if tok in self._sizes:
                 self._sizes[tok] = max(self._sizes[tok] - nbytes, 0)
+            telemetry.set_gauge("cleaner.hbm.live.bytes",
+                                max(self._resident_bytes, 0))
 
     def _on_dead(self, tok, key):
         # a spilled vec's ice file dies with it, and whatever bytes it still
@@ -200,6 +206,8 @@ class Cleaner:
         # drift the counter upward and every construction pays a recount
         with self._lock:
             self._resident_bytes -= self._sizes.pop(tok, 0)
+            telemetry.set_gauge("cleaner.hbm.live.bytes",
+                                max(self._resident_bytes, 0))
         if key and self.spill_dir:
             self._remove_ice(os.path.join(self.spill_dir, f"{key}.npy"))
 
@@ -245,7 +253,12 @@ class Cleaner:
         """Spill EVERYTHING spillable except ``exclude`` — the rehydrate
         path's response to a device OOM (`frame/vec.py`): free the maximum
         HBM regardless of budget, so the failed device_put can retry."""
-        return self.maybe_sweep(exclude=exclude, target_bytes=0)
+        from ..utils import timeline
+
+        telemetry.inc("cleaner.emergency_sweep.count")
+        freed = self.maybe_sweep(exclude=exclude, target_bytes=0)
+        timeline.record("cleaner", "emergency_sweep", freed_bytes=freed)
+        return freed
 
     def maybe_sweep(self, exclude: int | None = None,
                     target_bytes: int | None = None) -> int:
@@ -303,6 +316,8 @@ class Cleaner:
         self._debit(vec, nbytes)
         with self._lock:
             self.spills += 1
+        telemetry.inc("cleaner.spill.count")
+        telemetry.inc("cleaner.spill.bytes", nbytes)
         return nbytes
 
 

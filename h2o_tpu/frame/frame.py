@@ -195,8 +195,13 @@ class Frame(Keyed):
         """Compute every missing column rollup in batched fused programs —
         ONE device round-trip per ~2^28-cell block instead of one per column
         (29 serial per-column rollups measured 38 s of an 11M-row cold train
-        through the device tunnel; this is the builders' pre-pass)."""
-        from .vec import _rollup_kernel_cols, _rollups_from_scalars
+        through the device tunnel; this is the builders' pre-pass).
+
+        The batch dispatches through the MRTask driver (`mr_reduce`), so
+        every frame's first rollup touch shows up in /3/Metrics and the
+        timeline as a real map/reduce with payload bytes and phase walls —
+        the RollupStats MRTask, accounted like one."""
+        from .vec import _ROLLUP_REDUCE, _rollup_mr_map, _rollups_from_scalars
 
         todo = [self.vec(n) for n in (names if names is not None
                                       else self._names)]
@@ -230,13 +235,25 @@ class Frame(Keyed):
             for s0 in range(0, len(group), block):
                 sub = group[s0:s0 + block]
                 import jax
-                import jax.numpy as jnp
+                import numpy as np
 
-                r = jax.device_get(_rollup_kernel_cols(
-                    jnp.stack([v.data for v in sub], axis=1)))
+                from ..parallel.mrtask import mr_reduce
+
+                r = jax.device_get(mr_reduce(
+                    _rollup_mr_map, [v.data for v in sub],
+                    nrow=max(v.nrow for v in sub),
+                    reduce=_ROLLUP_REDUCE))
                 for i, v in enumerate(sub):
-                    v._rollups = _rollups_from_scalars(
-                        v.nrow, {k: r[k][i] for k in r})
+                    n = int(r["n"][i])
+                    scalars = {
+                        "n": n,
+                        "mean": r["sum"][i] / max(n, 1),
+                        "var": max(float(r["varsum"][i]) / max(n, 1), 0.0),
+                        "mins": r["mins"][i], "maxs": r["maxs"][i],
+                        "zerocnt": r["zerocnt"][i],
+                        "isint": bool(np.asarray(r["isint"][i])),
+                    }
+                    v._rollups = _rollups_from_scalars(v.nrow, scalars)
 
     def compress(self) -> "Frame":
         """Compressed-chunk copy of this frame: every column re-encoded with

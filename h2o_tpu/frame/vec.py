@@ -86,9 +86,12 @@ def _rollup_kernel(data: jax.Array):
 @jax.jit
 def _rollup_kernel_cols(X: jax.Array):
     """Batched rollups over a (plen, C) column stack — identical math to
-    `_rollup_kernel`, one program + ONE host transfer for C columns. The
-    per-column eager path measured ~1.3 s of tunnel round-trip PER COLUMN on
-    an 11M-row frame (29 columns = 38 s of a cold train); this is the fix."""
+    `_rollup_kernel`, one program + ONE host transfer for C columns (the
+    fix for ~1.3 s of tunnel round-trip PER COLUMN on an 11M-row frame).
+    Production now dispatches `_rollup_mr_map` through the MRTask driver;
+    this fused kernel stays as the bit-level parity ORACLE the telemetry
+    tests pin the mr path against (tests/test_telemetry.py) — change the
+    rollup math in both places or that test fails."""
     ok = ~jnp.isnan(X)
     x = jnp.where(ok, X, 0.0)
     n = jnp.sum(ok, axis=0)
@@ -104,6 +107,40 @@ def _rollup_kernel_cols(X: jax.Array):
         zerocnt=jnp.sum(ok & (X == 0.0), axis=0),
         isint=jnp.all(jnp.where(ok, X == jnp.floor(X), True), axis=0),
     )
+
+
+def _rollup_mr_map(cols, rows):
+    """Per-shard rollup partials for the MRTask driver — the batched rollup
+    pass as an actual map/reduce: the same centered-variance math as
+    ``_rollup_kernel_cols`` (global mean via an INTERNAL ``psum`` — DrJAX's
+    map-with-collectives shape), partial sums/mins/maxs combined by the
+    driver's named monoids. NaN rows (NA + mesh padding) drop out of every
+    reduction, exactly like the fused kernel. Module-level so the driver's
+    per-map_fn program cache engages across frames."""
+    from ..parallel.mesh import ROWS
+
+    X = jnp.stack(cols, axis=1)  # (shard_rows, C)
+    ok = ~jnp.isnan(X)
+    x = jnp.where(ok, X, 0.0)
+    n_part = jnp.sum(ok, axis=0)
+    n = jax.lax.psum(n_part, ROWS)
+    mean = jax.lax.psum(jnp.sum(x, axis=0), ROWS) / jnp.maximum(n, 1)
+    d = jnp.where(ok, X - mean[None, :], 0.0)
+    return {
+        "n": n_part,
+        "sum": jnp.sum(x, axis=0),
+        "varsum": jnp.sum(d * d, axis=0),
+        "mins": jnp.min(jnp.where(ok, X, jnp.inf), axis=0),
+        "maxs": jnp.max(jnp.where(ok, X, -jnp.inf), axis=0),
+        "zerocnt": jnp.sum(ok & (X == 0.0), axis=0),
+        "isint": jnp.min(jnp.where(ok, X == jnp.floor(X),
+                                   True).astype(jnp.int32), axis=0),
+    }
+
+
+#: the per-output monoids `_rollup_mr_map` reduces under
+_ROLLUP_REDUCE = {"n": "sum", "sum": "sum", "varsum": "sum", "mins": "min",
+                  "maxs": "max", "zerocnt": "sum", "isint": "min"}
 
 
 def _rollups_from_scalars(nrow: int, r: dict) -> "Rollups":
@@ -163,13 +200,17 @@ class Vec(Keyed):
 
         with self._lock:
             if self._data is None and self._spill_path is not None:
+                from ..utils import telemetry
+
                 host = np.load(self._spill_path)
                 self._data = self._rehydrate_put(host)
                 CLEANER._remove_ice(self._spill_path)
                 self._spill_path = None
                 self._last_access = CLEANER.touch(self)
-                CLEANER.track(self,
-                              self._data.size * self._data.dtype.itemsize)
+                nbytes = self._data.size * self._data.dtype.itemsize
+                CLEANER.track(self, nbytes)
+                telemetry.inc("cleaner.rehydrate.count")
+                telemetry.inc("cleaner.rehydrate.bytes", nbytes)
             elif self._data is not None:
                 self._last_access = CLEANER.touch(self)
             return self._data

@@ -125,7 +125,23 @@ def _check_frame_size(n_rows: int, n_cols: int) -> None:
 def parse_file(path: str, setup: ParseSetup | None = None, mesh=None,
                dest_key: str | None = None) -> Frame:
     """Parse one file into a sharded Frame (the ParseDataset.parse analog).
-    URI schemes (s3://, gs://, http(s)://) localize through the Persist SPI."""
+    URI schemes (s3://, gs://, http(s)://) localize through the Persist SPI.
+
+    Every parse is telemetered: a ``parser.parse`` span (timeline +
+    `/3/Metrics` histogram) and ingested-row counters."""
+    from ..utils import telemetry
+
+    with telemetry.span("parser.parse", metric="parser.parse.seconds",
+                        file=os.path.basename(path)):
+        fr = _parse_file_impl(path, setup=setup, mesh=mesh,
+                              dest_key=dest_key)
+    telemetry.inc("parser.parse.count")
+    telemetry.inc("parser.rows.count", fr.nrow)
+    return fr
+
+
+def _parse_file_impl(path: str, setup: ParseSetup | None = None, mesh=None,
+                     dest_key: str | None = None) -> Frame:
     import pyarrow as pa
 
     from ..utils import failpoints

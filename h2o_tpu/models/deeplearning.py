@@ -346,11 +346,17 @@ class DeepLearning(ModelBuilder):
         # the reference resumes from the checkpointed iteration count)
         step_offset = int(round(prior_epochs * steps_per_epoch))
         perm_base = jax.random.fold_in(key, 1)
-        from ..utils import failpoints
+        from ..utils import failpoints, telemetry
 
+        # epoch boundary-to-boundary wall (async dispatch wall — steps
+        # dispatch without a sync until the final drain in train());
+        # the clock math lives in telemetry.Lap, one audited site
+        epoch_lap = telemetry.lap(metric="train.epoch.seconds",
+                                  what="train.dl.epoch")
         start_s = 0
         if rs is not None and rs.get("steps_done"):
             start_s = int(rs["steps_done"])  # always an epoch boundary
+        epoch_lap.tick()  # start the clock so epoch 1 is measured too
         for s in range(start_s, total_steps):
             gs = step_offset + s
             if s % steps_per_epoch == 0:
@@ -375,6 +381,8 @@ class DeepLearning(ModelBuilder):
             net, opt_state = step(net, opt_state, Xb, yb, wb,
                                   jax.random.fold_in(key, 2 + gs))
             if s % steps_per_epoch == steps_per_epoch - 1:
+                telemetry.inc("train.epoch.count")
+                epoch_lap.tick(epoch=gs // steps_per_epoch)
                 job.update(steps_per_epoch / total_steps)
                 # auto-recovery checkpoint at the epoch boundary (resume
                 # restarts at an exact epoch, where the shuffle re-derives)

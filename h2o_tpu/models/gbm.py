@@ -732,6 +732,8 @@ class GBM(ModelBuilder):
                        else jnp.asarray(np.asarray(rs["oob_cnt"])))
             history = list(rs["history"])
             stop_metric_series = list(rs["stop_series"])
+        from ..utils import telemetry
+
         for ci in range(start_ci, len(chunks)):
             keys, rates = chunks[ci]
             failpoints.hit("train.gbm.chunk")
@@ -742,51 +744,64 @@ class GBM(ModelBuilder):
                         # model, the reference's max_runtime contract);
                         # callers with nothing partial to keep get the typed
                         # path via Job.check_max_runtime/join(timeout)
-            f, osum, ocnt, trees = train_fn(Xb, y_k, w, f, edges, edge_ok,
-                                            keys, rates, mono, imat,
-                                            s.iscat_dev, s.nedges_dev)
-            oob_sum = osum if oob_sum is None else oob_sum + osum
-            oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
-            parts.append(trees)
-            ntrees_done = sum(t[0].shape[0] for t in parts)
-            # DRF scores OOB throughout (history + early stopping), so the
-            # stopping signal is honest, not in-bag memorization; OOB spans
-            # only this build's trees, hence the checkpoint gate below
-            m = None
-            if self.drf_mode and p.sample_rate < 1.0 and n_prior == 0:
-                m = self._oob_metrics(category, oob_sum, oob_cnt, y, ymask,
-                                      w if p.weights_column else None,
-                                      output.response_domain)
-                if m is not None:
-                    m.description = "Reported on OOB data"
-            if m is None:
-                m = make_metrics(category, s.ym,
-                                 _metrics_raw(category, dist, f,
-                                              self.drf_mode, ntrees_done),
-                                 None if p.weights_column is None else w,
-                                 auc_type=p.auc_type,
-                                 domain=output.response_domain)
-            history.append({"timestamp": _t.time(), "number_of_trees": ntrees_done,
-                            "training_metrics": m})
-            job.update(len(keys) / max(n_new, 1))
-            if p.export_checkpoints_dir:
-                self._export_snapshot(p, output, parts, f0, dist, cfg, is_cat,
-                                      ntrees_done, m,
-                                      cat_nedges=s.nedges_np)
-            # preemption-proof auto-checkpoint: capture the exact carried
-            # state at this resumable boundary (written only when the
-            # wall-clock interval knob says it's due)
-            self._recovery_tick(
-                lambda ci=ci: {
-                    "algo": self.algo_name, "chunks_done": ci + 1,
-                    "n_prior": n_prior, "f0": f0,
-                    "use_sets": bool(cfg.use_sets),
-                    "parts": [tuple(t) for t in parts], "f": f,
-                    "oob_sum": oob_sum, "oob_cnt": oob_cnt,
-                    "history": list(history),
-                    "stop_series": list(stop_metric_series)},
-                progress={"ntrees_done": int(ntrees_done),
-                          "ntrees_total": int(p.ntrees)})
+            # one span per score_tree_interval boundary: the chunk wall
+            # (train_fn dispatch + metrics + checkpoint) is the number the
+            # kernel-tuning ROADMAP items steer by; scoring below reads
+            # metric values to host, so the wall is near-drained
+            with telemetry.span("train.gbm.chunk",
+                                metric="train.chunk.seconds",
+                                chunk=ci, trees=int(len(keys))):
+                f, osum, ocnt, trees = train_fn(Xb, y_k, w, f, edges,
+                                                edge_ok, keys, rates, mono,
+                                                imat, s.iscat_dev,
+                                                s.nedges_dev)
+                oob_sum = osum if oob_sum is None else oob_sum + osum
+                oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
+                parts.append(trees)
+                ntrees_done = sum(t[0].shape[0] for t in parts)
+                # DRF scores OOB throughout (history + early stopping), so
+                # the stopping signal is honest, not in-bag memorization;
+                # OOB spans only this build's trees, hence the checkpoint
+                # gate below
+                m = None
+                if self.drf_mode and p.sample_rate < 1.0 and n_prior == 0:
+                    m = self._oob_metrics(category, oob_sum, oob_cnt, y,
+                                          ymask,
+                                          w if p.weights_column else None,
+                                          output.response_domain)
+                    if m is not None:
+                        m.description = "Reported on OOB data"
+                if m is None:
+                    m = make_metrics(category, s.ym,
+                                     _metrics_raw(category, dist, f,
+                                                  self.drf_mode,
+                                                  ntrees_done),
+                                     None if p.weights_column is None else w,
+                                     auc_type=p.auc_type,
+                                     domain=output.response_domain)
+                history.append({"timestamp": _t.time(),
+                                "number_of_trees": ntrees_done,
+                                "training_metrics": m})
+                job.update(len(keys) / max(n_new, 1))
+                if p.export_checkpoints_dir:
+                    self._export_snapshot(p, output, parts, f0, dist, cfg,
+                                          is_cat, ntrees_done, m,
+                                          cat_nedges=s.nedges_np)
+                # preemption-proof auto-checkpoint: capture the exact
+                # carried state at this resumable boundary (written only
+                # when the wall-clock interval knob says it's due)
+                self._recovery_tick(
+                    lambda ci=ci: {
+                        "algo": self.algo_name, "chunks_done": ci + 1,
+                        "n_prior": n_prior, "f0": f0,
+                        "use_sets": bool(cfg.use_sets),
+                        "parts": [tuple(t) for t in parts], "f": f,
+                        "oob_sum": oob_sum, "oob_cnt": oob_cnt,
+                        "history": list(history),
+                        "stop_series": list(stop_metric_series)},
+                    progress={"ntrees_done": int(ntrees_done),
+                              "ntrees_total": int(p.ntrees)})
+            telemetry.inc("train.chunk.count")
             if self._should_stop(m, stop_metric_series):
                 break
         output.scoring_history = history

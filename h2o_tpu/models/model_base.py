@@ -429,33 +429,51 @@ class ModelBuilder:
         self.job.set_max_runtime(self.params.max_runtime_secs)
 
         def run():
+            from ..utils import compilemeter, telemetry
+
             t0 = time.time()
-            # arm auto-recovery BEFORE the encoding swap: the persisted
-            # params/frames must be the ORIGINAL inputs so a resumed process
-            # replays the (deterministic) encoding itself
-            self._arm_auto_recovery()
-            enc_state = self._apply_categorical_encoding()
-            if self.supports_cv and (self.params.nfolds >= 2
-                                     or self.params.fold_column):
-                model = self._train_with_cv(self.job)
-            else:
-                model = self.build_impl(self.job)
-            if enc_state is not None:
-                model.output.encoding_state = enc_state
-                for cv in model.output.cv_models:
-                    cv.output.encoding_state = enc_state
-            self._apply_custom_metric(model)
-            # drain the device stream before reading the clock: dispatch is
-            # async, and run_time_ms is the number /3/Models reports. This
-            # is also the CONTRACT every caller times against — graftlint's
-            # timing-without-sync rule treats train_model as self-syncing
-            # because of this block (bench.py legs rely on it)
-            import jax
+            # one root span per training job: everything recorded under it
+            # (chunk/epoch spans, MRTask dispatches, checkpoints) shares
+            # its trace id, so /3/Timeline and the chrome-trace export can
+            # reassemble the whole job. Background jobs run on a fresh
+            # thread (fresh contextvars) so the trace starts here; a
+            # foreground train inside a REST handler nests under the
+            # request's span instead — deliberately.
+            compilemeter.install()  # compiles are countable from now on
+            with telemetry.span(f"train.{self.algo_name}",
+                                algo=self.algo_name,
+                                job=str(self.job.key)):
+                # arm auto-recovery BEFORE the encoding swap: the persisted
+                # params/frames must be the ORIGINAL inputs so a resumed
+                # process replays the (deterministic) encoding itself
+                self._arm_auto_recovery()
+                enc_state = self._apply_categorical_encoding()
+                if self.supports_cv and (self.params.nfolds >= 2
+                                         or self.params.fold_column):
+                    model = self._train_with_cv(self.job)
+                else:
+                    model = self.build_impl(self.job)
+                if enc_state is not None:
+                    model.output.encoding_state = enc_state
+                    for cv in model.output.cv_models:
+                        cv.output.encoding_state = enc_state
+                self._apply_custom_metric(model)
+                # drain the device stream before reading the clock:
+                # dispatch is async, and run_time_ms is the number
+                # /3/Models reports. This is also the CONTRACT every
+                # caller times against — graftlint's timing-without-sync
+                # rule treats train_model as self-syncing because of this
+                # block (bench.py legs rely on it)
+                import jax
 
-            from ..utils.blocking import device_arrays
+                from ..utils.blocking import device_arrays
 
-            jax.block_until_ready(device_arrays(model))
-            model.output.run_time_ms = int((time.time() - t0) * 1000)
+                jax.block_until_ready(device_arrays(model))
+                model.output.run_time_ms = int((time.time() - t0) * 1000)
+            telemetry.inc("train.count")
+            # drained above, so this histogram is honest compute wall
+            telemetry.observe("train.seconds",
+                              model.output.run_time_ms / 1000.0)
             self.job.dest_key = model.key
             if self._recovery is not None:
                 self._recovery.mark_completed(model.key)
@@ -532,7 +550,15 @@ class ModelBuilder:
         if rec is None or not rec.due():
             return
         try:
+            from ..utils import telemetry
+
+            t0 = time.perf_counter()
             rec.save_state(state_fn(), progress)
+            # the insurance premium, measured: checkpoint overhead rides
+            # /3/Metrics next to the chunk/epoch walls it taxes
+            telemetry.observe("train.checkpoint.seconds",
+                              time.perf_counter() - t0)
+            telemetry.inc("train.checkpoint.count")
         except OSError as e:
             # disk yanked mid-train (full / remount): lose the insurance,
             # keep the job. Injected faults are RuntimeErrors — they still

@@ -89,6 +89,9 @@ def _driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
 
 def _build_driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
                           out_rows: bool):
+    from ..utils import telemetry
+
+    telemetry.inc("mrtask.program.build.count")
     reduce = reduce_key if isinstance(reduce_key, (str, type(None))) \
         else dict(reduce_key)
     shard_rows = avt[0][0][0] // mesh.shape[ROWS]
@@ -142,8 +145,33 @@ def mr_reduce(
     arrays = tuple(arrays)
     reduce_key = reduce if isinstance(reduce, str) \
         else tuple(sorted(reduce.items()))
-    fn = _driver_program(map_fn, mesh, nrow, reduce_key, _avt(arrays), False)
-    return fn(*arrays)
+    return _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows=False)
+
+
+def _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows: bool):
+    """Shared instrumented dispatch — DrJAX-style per-stage accounting for
+    the driver: the ``build`` phase is the host-side program resolution
+    (trace + compile on a cache miss), ``dispatch`` the async device launch
+    (the map/reduce/psum itself runs inside the one compiled program; its
+    device wall drains at the caller's sync point). Payload bytes in/out
+    come from array metadata, so the accounting costs no transfers."""
+    from ..utils import telemetry
+
+    in_bytes = sum(getattr(a, "nbytes", 0) for a in arrays)
+    with telemetry.span("mrtask.dispatch", metric="mrtask.dispatch.seconds",
+                        fn=getattr(map_fn, "__name__", "map_fn"),
+                        rows=nrow, in_bytes=in_bytes) as sp:
+        with sp.phase("build"):
+            fn = _driver_program(map_fn, mesh, nrow, reduce_key,
+                                 _avt(arrays), out_rows)
+        with sp.phase("dispatch"):
+            out = fn(*arrays)
+    telemetry.inc("mrtask.dispatch.count")
+    telemetry.inc("mrtask.payload.in.bytes", in_bytes)
+    telemetry.inc("mrtask.payload.out.bytes",
+                  sum(getattr(x, "nbytes", 0)
+                      for x in jax.tree.leaves(out)))
+    return out
 
 
 def mr_map(
@@ -163,5 +191,4 @@ def mr_map(
     failpoints.hit("mrtask.dispatch")
     mesh = mesh or default_mesh()
     arrays = tuple(arrays)
-    fn = _driver_program(map_fn, mesh, nrow, None, _avt(arrays), True)
-    return fn(*arrays)
+    return _dispatch(map_fn, mesh, nrow, None, arrays, out_rows=True)

@@ -17,6 +17,12 @@ timeouts, recompiles) are monotone totals.
 Everything is guarded by one lock: request threads and the batch worker
 mutate concurrently, and a torn snapshot would misreport the very tail
 latencies the endpoint exists to expose.
+
+Every per-model observation ALSO feeds the process-global telemetry
+registry (`utils/telemetry.py` ``serving.*`` counters/histogram), so
+`GET /3/Metrics` and the Prometheus exposition carry fleet-wide serving
+totals without a second instrumentation layer — the per-model snapshots
+here remain the `GET /3/Serving/stats` payload.
 """
 
 from __future__ import annotations
@@ -26,6 +32,8 @@ import time
 from collections import deque
 
 import numpy as np
+
+from ..utils import telemetry
 
 
 class ServingStats:
@@ -50,14 +58,19 @@ class ServingStats:
             self.requests += 1
             self.rows += rows
             self._lat_s.append(latency_s)
+        telemetry.inc("serving.request.count")
+        telemetry.inc("serving.request.rows", rows)
+        telemetry.observe("serving.request.seconds", latency_s)
 
     def observe_rejected(self) -> None:
         with self._lock:
             self.rejected += 1
+        telemetry.inc("serving.rejected.count")
 
     def observe_timeout(self) -> None:
         with self._lock:
             self.timeouts += 1
+        telemetry.inc("serving.timeout.count")
 
     # -- batch worker --------------------------------------------------------
     def observe_batch(self, n_requests: int, n_rows: int,
@@ -67,6 +80,10 @@ class ServingStats:
             self.batch_rows += n_rows
             self.recompiles += recompiles
             self._batches.append((time.time(), n_rows))
+        telemetry.inc("serving.batch.count")
+        telemetry.inc("serving.batch.rows", n_rows)
+        if recompiles:
+            telemetry.inc("serving.recompile.count", recompiles)
 
     def recent_rows_per_s(self) -> float:
         """Scoring throughput over the batch window (0.0 when idle)."""

@@ -23,6 +23,7 @@ lookup per monitoring event — nothing on the request path.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -37,6 +38,15 @@ def _listener(name: str, secs: float, **kw) -> None:
     if name == _COMPILE_EVENT:
         with _lock:
             _count += 1
+        # every backend compile is also a registry counter and a timeline
+        # event, so /3/Metrics and the bench sidecar deltas carry compile
+        # counts per leg and cold-start cost is visible in /3/Timeline
+        # (compiles are rare by contract — recording one is not hot-path)
+        from . import telemetry, timeline
+
+        telemetry.inc("xla.compile.count")
+        timeline.record("compile", "backend_compile",
+                        secs=round(float(secs), 4))
 
 
 def install() -> None:
@@ -64,3 +74,34 @@ def count() -> int:
     install()
     with _lock:
         return _count
+
+
+class CompileScope:
+    """Compile-count delta over one region — the context-LOCAL reading the
+    global counter's docstring warns against misusing: a scope pins its own
+    start, so two concurrent scopes each see every compile in their window
+    (attribution of a shared backend is inherently shared; per-cause
+    blame stays with `serving/scorer.py`'s own bucket-miss gauge)."""
+
+    __slots__ = ("start", "_end")
+
+    def __init__(self, start: int):
+        self.start = start
+        self._end: int | None = None
+
+    @property
+    def compiles(self) -> int:
+        """Compiles observed since the scope opened (frozen at exit)."""
+        return (count() if self._end is None else self._end) - self.start
+
+
+@contextlib.contextmanager
+def scoped():
+    """``with compilemeter.scoped() as sc: ... ; sc.compiles`` — the delta
+    pattern made first-class (bench legs, per-train cold-start metering),
+    mirroring PR 4's bucket-miss fix: read a scope, not the global."""
+    sc = CompileScope(count())
+    try:
+        yield sc
+    finally:
+        sc._end = count()

@@ -319,6 +319,11 @@ def hit(name: str) -> None:
         fire = armed.should_fire(n)
     if not fire:
         return
+    from . import telemetry, timeline
+
+    telemetry.inc("failpoint.fired.count")
+    timeline.record("failpoint", name, hit=n, action=armed.action,
+                    spec=armed.spec)
     if armed.action == "sleep":
         time.sleep(int(armed.arg) / 1000.0)
         return

@@ -157,6 +157,23 @@ _knob("H2O_TPU_RETRY_JITTER", "bool", True,
       "0 pins backoff to the deterministic cap sequence (tests); default "
       "full jitter so a fleet never thunders back in lockstep")
 
+# -- observability (utils/telemetry.py + timeline.py) ------------------------
+_knob("H2O_TPU_METRICS_ENABLED", "bool", True,
+      "master switch for the telemetry registry + span/timeline/trace "
+      "recording, including every direct timeline.record site (always-on "
+      "by default, like the reference's TimeLine ring; 0 skips the writes "
+      "but keeps name validation)")
+_knob("H2O_TPU_METRICS_HIST_WINDOW", "int", 1024,
+      "observations kept per histogram metric (read at import) — "
+      "percentiles in /3/Metrics describe this recent window, memory "
+      "stays bounded")
+_knob("H2O_TPU_TIMELINE_EVENTS", "int", 4096,
+      "capacity of the /3/Timeline event ring (read at import; the "
+      "reference's TimeLine keeps 2048)")
+_knob("H2O_TPU_TRACE_DIR", "str", "",
+      "directory for per-process chrome-tracing span exports "
+      "(trace_<pid>.trace.json, loadable in Perfetto); empty = off")
+
 # -- security ---------------------------------------------------------------
 _knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
       "allow python: UDF references uploaded over the wire to execute")

@@ -2,28 +2,76 @@
 
 The reference buffers log lines until the log directory is known, writes
 per-level files, and serves the buffer cluster-wide via `/3/Logs`
-(`water/api/LogsHandler.java`). Here: a ring buffer (most recent N lines) on
-top of the stdlib logging module; `/3/Logs` reads the buffer.
+(`water/api/LogsHandler.java`). Here: one BOUNDED in-process ring of typed
+records (seq, wall stamp, level, message) behind the stdlib logging module.
+
+Routing is unified through the ``h2o_tpu`` logger: the facade functions
+(``info``/``warn``/...) emit stdlib records, and a ``RingHandler`` attached
+once at import captures them — so BARE ``logging.getLogger("h2o_tpu.x")``
+calls from any module land in the same ring the facade feeds, and `/3/Logs`
+serves both. A WARNING-level stderr handler preserves the old console
+visibility for warnings and errors (``set_level`` tunes it); the ring
+itself always records every level, like the reference's always-on buffer.
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
+import sys
 import time
 from collections import deque
 
 _LOGGER = logging.getLogger("h2o_tpu")
-_BUFFER: deque[str] = deque(maxlen=10_000)
 
 _LEVELS = {"TRACE": 5, "DEBUG": logging.DEBUG, "INFO": logging.INFO,
            "WARN": logging.WARNING, "ERRR": logging.ERROR,
            "FATAL": logging.CRITICAL}
+_NAMES = {v: k for k, v in _LEVELS.items()}
+
+#: (seq, epoch seconds, 5-char level, message) — deque append is atomic,
+#: the ring is bounded, and /3/Logs serializes at most `limit` entries
+_BUFFER: deque = deque(maxlen=10_000)
+_SEQ = itertools.count(1)
+
+
+def _level_name(levelno: int) -> str:
+    """Nearest declared level at or below the record's (stdlib loggers can
+    emit any integer)."""
+    best = "TRACE"
+    for name, no in _LEVELS.items():
+        if levelno >= no:
+            best = name
+    return best
+
+
+class RingHandler(logging.Handler):
+    """Captures every record under the ``h2o_tpu`` logger namespace into
+    the ring — the seam that routes bare stdlib ``logging`` calls through
+    this facade instead of losing them to the root logger."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — a broken format must not recurse
+            msg = str(record.msg)
+        _BUFFER.append((next(_SEQ), record.created,
+                        _level_name(record.levelno), msg))
+
+
+_RING_HANDLER = RingHandler(level=0)
+_STDERR_HANDLER = logging.StreamHandler(sys.stderr)
+_STDERR_HANDLER.setLevel(logging.WARNING)
+_STDERR_HANDLER.setFormatter(logging.Formatter("h2o_tpu %(levelname)s: "
+                                               "%(message)s"))
+if not any(isinstance(h, RingHandler) for h in _LOGGER.handlers):
+    _LOGGER.addHandler(_RING_HANDLER)
+    _LOGGER.addHandler(_STDERR_HANDLER)
+_LOGGER.setLevel(5)       # the ring records everything, always
+_LOGGER.propagate = False  # our handlers own delivery — no double lines
 
 
 def _emit(level: str, msg: str):
-    line = (f"{time.strftime('%m-%d %H:%M:%S')} {level.ljust(5)} "
-            f"h2o_tpu: {msg}")
-    _BUFFER.append(line)
     _LOGGER.log(_LEVELS.get(level, logging.INFO), msg)
 
 
@@ -47,10 +95,51 @@ def err(msg: str):
     _emit("ERRR", msg)
 
 
-def get_buffer() -> list[str]:
-    """Most recent log lines — the `/3/Logs` payload."""
-    return list(_BUFFER)
+def _format(rec: tuple) -> str:
+    seq, created, level, msg = rec
+    stamp = time.strftime("%m-%d %H:%M:%S", time.localtime(created))
+    return f"{stamp} {level.ljust(5)} h2o_tpu: {msg}"
+
+
+#: friendly spellings accepted anywhere a level filter is taken (`?level=
+#: error` must not silently return nothing because the internal code is
+#: the 5-char ERRR)
+_LEVEL_ALIASES = {"TRACE": "TRACE", "DEBUG": "DEBUG", "INFO": "INFO",
+                  "WARN": "WARN", "WARNING": "WARN", "ERR": "ERRR",
+                  "ERRR": "ERRR", "ERROR": "ERRR", "FATAL": "FATAL",
+                  "CRITICAL": "FATAL"}
+
+
+def _select(limit: int | None, level: str | None) -> list[tuple]:
+    """The ONE copy of the ring's filter semantics — both the formatted
+    and the typed `/3/Logs` views read through it, so they cannot
+    diverge."""
+    recs = list(_BUFFER)
+    if level is not None:
+        want = _LEVEL_ALIASES.get(level.upper(), level.upper())
+        recs = [r for r in recs if r[2] == want]
+    if limit is not None and limit > 0:
+        recs = recs[-limit:]
+    return recs
+
+
+def get_records(limit: int | None = None,
+                level: str | None = None) -> list[dict]:
+    """Typed recent records (newest last). ``level`` filters to exactly
+    that declared level (the reference's per-level log files; friendly
+    spellings like "error" accepted); ``limit`` keeps the most recent N
+    after filtering."""
+    return [{"seq": r[0], "ms": int(r[1] * 1000), "level": r[2],
+             "msg": r[3]} for r in _select(limit, level)]
+
+
+def get_buffer(limit: int | None = None,
+               level: str | None = None) -> list[str]:
+    """Most recent log lines, formatted — the `/3/Logs` payload."""
+    return [_format(r) for r in _select(limit, level)]
 
 
 def set_level(level: str):
-    _LOGGER.setLevel(_LEVELS.get(level.upper(), logging.INFO))
+    """Console verbosity (stderr handler) — the ring records every level
+    regardless, like the reference's always-on buffer."""
+    _STDERR_HANDLER.setLevel(_LEVELS.get(level.upper(), logging.INFO))

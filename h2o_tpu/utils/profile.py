@@ -17,9 +17,44 @@ internals while this covers the host-side task anatomy."""
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 from . import timeline
+
+#: process-lifetime aggregation by task name — the `/3/Profiler` payload's
+#: task view (`water/api/ProfilerHandler` aggregates stack samples; the
+#: TPU-native equivalent aggregates per-task phase wall)
+_AGG_LOCK = threading.Lock()
+_AGG: dict[str, dict] = {}
+
+
+def _aggregate(prof: "TaskProfile") -> None:
+    with _AGG_LOCK:
+        rec = _AGG.setdefault(prof.name,
+                              {"count": 0, "total_s": 0.0, "phases": {}})
+        rec["count"] += 1
+        rec["total_s"] += prof.t_total
+        for k, v in prof.phases.items():
+            rec["phases"][k] = rec["phases"].get(k, 0.0) + v
+
+
+def aggregate_snapshot() -> list[dict]:
+    """Per-task totals, heaviest first — what `/3/Profiler` serves next to
+    the stack samples."""
+    with _AGG_LOCK:
+        out = [{"task": name, "count": rec["count"],
+                "total_s": round(rec["total_s"], 6),
+                "mean_s": round(rec["total_s"] / rec["count"], 6),
+                "phases": {k: round(v, 6)
+                           for k, v in sorted(rec["phases"].items())}}
+               for name, rec in _AGG.items()]
+    return sorted(out, key=lambda r: -r["total_s"])
+
+
+def clear_aggregate() -> None:
+    with _AGG_LOCK:
+        _AGG.clear()
 
 
 class TaskProfile:
@@ -54,3 +89,4 @@ def task_profile(name: str):
                         **{f"{k}_s": round(v, 6)
                            for k, v in prof.phases.items()},
                         total_s=round(prof.t_total, 6))
+        _aggregate(prof)

@@ -144,6 +144,9 @@ def retry_call(fn: Callable, *, retryable, description: str = "",
                 delay = float(verdict)
             # never sleep past the budget — give up ON TIME, typed
             delay = min(delay, max(budget_s - elapsed, 0.0))
+            from . import telemetry
+
+            telemetry.inc("retry.attempt.count")
             if on_retry is not None:
                 on_retry(e, tried, delay)
             if delay > 0:

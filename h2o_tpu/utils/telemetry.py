@@ -1,0 +1,565 @@
+"""Unified telemetry — process-global metrics registry + span tracing.
+
+The reference treats observability as a first-class surface: `water/
+TimeLine.java`'s always-on event ring, `WaterMeter` CPU/I-O counters and
+MRTask's per-phase `.profile()`. This module is the one registry those
+analogs report into, with the `utils/knobs.py` discipline applied to
+metrics: every metric is DECLARED here with a kind and a one-line doc,
+accessors raise ``KeyError`` on undeclared names, and graftlint's
+``unregistered-metric`` rule fails the build on any literal metric-name
+emit missing from this registry (AST-parsed — the linter never imports
+jax).
+
+Three metric kinds:
+
+- **counter** — monotone total (``inc``). The fast path is lock-free:
+  each thread accumulates into its own shard of a per-metric dict (a
+  thread only ever writes its own key, so there is no cross-thread
+  read-modify-write to lose), and readers sum an atomic ``dict()`` copy.
+- **gauge** — last-set value (``set_gauge``), optionally tracking the
+  process-lifetime peak (the HBM watermark).
+- **histogram** — bounded ring of observations (``observe``) plus
+  sharded count/sum totals; snapshots report p50/p95/p99/max over the
+  ring window (``H2O_TPU_METRICS_HIST_WINDOW``) and exact count/sum.
+
+Span tracing (``span("mrtask.dispatch", ...)``): context managers that
+nest, carry one trace id through a job (contextvars — a REST request's
+span and the training spans under it share the id), time themselves and
+optional sub-``phase``s, and land in the timeline ring (`/3/Timeline`) as
+typed ``span`` events. When ``H2O_TPU_TRACE_DIR`` is set every span is
+ALSO appended to a per-process chrome-tracing file
+(``trace_<pid>.trace.json``) loadable in Perfetto / chrome://tracing, so
+a whole training run can be opened in a trace viewer.
+
+Recording is always-on (the reference's ring never turns off) and cheap:
+a disabled registry (``H2O_TPU_METRICS_ENABLED=0``) still validates names
+but skips the writes. Span durations measure HOST wall between enter and
+exit — jax dispatch is async, so a span around an un-synced device call
+measures dispatch, not compute (the drained-compute bench contract is
+unaffected: `model_base.train` blocks before its timer reads).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from . import knobs, timeline
+
+_MISSING = object()
+
+
+# ---------------------------------------------------------------------------
+# registry (the knobs.py discipline, applied to metrics)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Metric:
+    name: str
+    kind: str           # "counter" | "gauge" | "histogram"
+    doc: str
+
+
+METRICS: dict[str, Metric] = {}
+
+
+class _Counter:
+    __slots__ = ("shards",)
+
+    def __init__(self):
+        #: thread-id -> that thread's accumulated total. A thread only
+        #: writes ITS OWN key, so `d[tid] = d.get(tid, 0) + n` races with
+        #: nobody; `dict(d)` (one C-level call) gives readers an atomic
+        #: copy to sum.
+        self.shards: dict[int, float] = {}
+
+    def value(self) -> float:
+        return sum(dict(self.shards).values())
+
+
+class _Gauge:
+    __slots__ = ("value", "peak", "track_peak")
+
+    def __init__(self, track_peak: bool = False):
+        self.value = 0.0
+        self.peak = 0.0
+        self.track_peak = track_peak
+
+
+class _Hist:
+    __slots__ = ("ring", "count", "sum")
+
+    def __init__(self, window: int):
+        self.ring: deque = deque(maxlen=window)  # deque append is atomic
+        self.count = _Counter()
+        self.sum = _Counter()
+
+
+_COUNTERS: dict[str, _Counter] = {}
+_GAUGES: dict[str, _Gauge] = {}
+_HISTS: dict[str, _Hist] = {}
+
+
+def _hist_window() -> int:
+    return max(knobs.get_int("H2O_TPU_METRICS_HIST_WINDOW"), 16)
+
+
+def _counter(name: str, doc: str) -> None:
+    METRICS[name] = Metric(name, "counter", doc)
+    _COUNTERS[name] = _Counter()
+
+
+def _gauge(name: str, doc: str, track_peak: bool = False) -> None:
+    METRICS[name] = Metric(name, "gauge", doc)
+    _GAUGES[name] = _Gauge(track_peak=track_peak)
+
+
+def _histogram(name: str, doc: str) -> None:
+    METRICS[name] = Metric(name, "histogram", doc)
+    _HISTS[name] = _Hist(_hist_window())
+
+
+# -- MRTask driver (parallel/mrtask.py — DrJAX-style per-stage accounting) --
+_counter("mrtask.dispatch.count",
+         "mr_reduce/mr_map driver dispatches")
+_counter("mrtask.program.build.count",
+         "driver-program cache misses (a fresh shard_map trace + compile)")
+_counter("mrtask.payload.in.bytes",
+         "bytes of input operands handed to MRTask dispatches")
+_counter("mrtask.payload.out.bytes",
+         "bytes of result leaves returned by MRTask dispatches")
+_histogram("mrtask.dispatch.seconds",
+           "host wall per driver dispatch (build + async dispatch; device "
+           "compute drains at the caller's sync point)")
+
+# -- training loops ----------------------------------------------------------
+_counter("train.count", "completed training jobs")
+_histogram("train.seconds",
+           "drained train wall per job (the run_time_ms source — "
+           "block_until_ready runs before the clock is read)")
+_counter("train.chunk.count", "GBM/DRF boosting-chunk iterations")
+_histogram("train.chunk.seconds",
+           "wall per boosting chunk (train_fn dispatch + scoring + "
+           "history, the score_tree_interval boundary)")
+_counter("train.epoch.count", "DeepLearning epochs completed")
+_histogram("train.epoch.seconds",
+           "wall between DL epoch boundaries (async dispatch wall)")
+_counter("train.checkpoint.count",
+         "auto-recovery checkpoint writes (backend/persist.py)")
+_histogram("train.checkpoint.seconds",
+           "wall per auto-recovery checkpoint write (the preemption "
+           "insurance premium, measured)")
+
+# -- HBM Cleaner (backend/memory.py) -----------------------------------------
+_gauge("cleaner.hbm.live.bytes",
+       "device-resident bytes the Cleaner ledger currently tracks",
+       track_peak=True)
+_gauge("cleaner.hbm.limit.bytes",
+       "resolved Cleaner HBM budget (0 while unlimited/unresolved)")
+_counter("cleaner.spill.count", "Vec device buffers spilled to ice")
+_counter("cleaner.spill.bytes", "bytes spilled to ice")
+_counter("cleaner.rehydrate.count", "spilled Vecs reloaded to device")
+_counter("cleaner.rehydrate.bytes", "bytes reloaded from ice")
+_counter("cleaner.emergency_sweep.count",
+         "spill-everything sweeps triggered by device OOM")
+
+# -- parser ------------------------------------------------------------------
+_counter("parser.parse.count", "frames parsed (io/parser.py parse_file)")
+_counter("parser.rows.count", "rows ingested by the parser")
+_histogram("parser.parse.seconds", "wall per parse_file call")
+
+# -- fault tolerance ---------------------------------------------------------
+_counter("failpoint.fired.count",
+         "armed failpoint injections that actually fired")
+_counter("retry.attempt.count",
+         "retries scheduled by utils/retry.py (transient failures seen)")
+
+# -- serving (h2o_tpu/serving/ — the global face of per-model stats.py) ------
+_counter("serving.request.count", "scoring requests across all models")
+_counter("serving.request.rows", "rows scored across all models")
+_histogram("serving.request.seconds",
+           "end-to-end request latency (encode + queue + score)")
+_counter("serving.batch.count", "micro-batcher device calls")
+_counter("serving.batch.rows", "rows through micro-batched device calls")
+_counter("serving.rejected.count", "requests rejected by backpressure (429)")
+_counter("serving.timeout.count", "requests expired while queued (408)")
+_counter("serving.recompile.count",
+         "steady-state scorer bucket-miss recompiles (contract: 0)")
+
+# -- REST control plane ------------------------------------------------------
+_counter("rest.request.count", "REST requests routed")
+_counter("rest.error.count", "REST requests answered with a 5xx")
+_histogram("rest.request.seconds", "wall per routed REST request")
+
+# -- XLA ---------------------------------------------------------------------
+_counter("xla.compile.count",
+         "XLA backend compiles observed since utils/compilemeter.py "
+         "installed its jax.monitoring listener")
+
+
+def _lookup(name: str) -> Metric:
+    try:
+        return METRICS[name]
+    except KeyError:
+        raise KeyError(
+            f"unregistered metric {name!r} — declare it in "
+            f"h2o_tpu/utils/telemetry.py (graftlint rule "
+            f"unregistered-metric enforces the same statically)") from None
+
+
+def _enabled() -> bool:
+    return knobs.get_bool("H2O_TPU_METRICS_ENABLED")
+
+
+# ---------------------------------------------------------------------------
+# emit accessors — the lint-checked surface
+# ---------------------------------------------------------------------------
+def inc(name: str, n: float = 1) -> None:
+    """Add ``n`` to a declared counter (lock-free per-thread shard)."""
+    c = _COUNTERS.get(name)
+    if c is None:
+        _lookup(name)
+        raise KeyError(f"metric {name!r} is a {METRICS[name].kind}, not a "
+                       f"counter — use the matching accessor")
+    if not _enabled():
+        return
+    tid = threading.get_ident()
+    c.shards[tid] = c.shards.get(tid, 0) + n
+
+
+def set_gauge(name: str, value: float) -> None:
+    g = _GAUGES.get(name)
+    if g is None:
+        _lookup(name)
+        raise KeyError(f"metric {name!r} is a {METRICS[name].kind}, not a "
+                       f"gauge — use the matching accessor")
+    if not _enabled():
+        return
+    g.value = value
+    if g.track_peak and value > g.peak:
+        g.peak = value
+
+
+def observe(name: str, value: float) -> None:
+    h = _HISTS.get(name)
+    if h is None:
+        _lookup(name)
+        raise KeyError(f"metric {name!r} is a {METRICS[name].kind}, not a "
+                       f"histogram — use the matching accessor")
+    if not _enabled():
+        return
+    h.ring.append(value)
+    tid = threading.get_ident()
+    h.count.shards[tid] = h.count.shards.get(tid, 0) + 1
+    h.sum.shards[tid] = h.sum.shards.get(tid, 0) + value
+
+
+def value(name: str) -> float:
+    """Current counter total or gauge value (histograms: use snapshot)."""
+    m = _lookup(name)
+    if m.kind == "counter":
+        return _COUNTERS[name].value()
+    if m.kind == "gauge":
+        return _GAUGES[name].value
+    return _HISTS[name].count.value()
+
+
+# ---------------------------------------------------------------------------
+# snapshots — the /3/Metrics payload and the bench sidecar delta
+# ---------------------------------------------------------------------------
+def _percentiles(vals: list) -> dict:
+    if not vals:
+        return {"p50": None, "p95": None, "p99": None, "max": None}
+    s = sorted(vals)
+    n = len(s)
+
+    def pct(q):
+        return s[min(int(q * (n - 1) + 0.5), n - 1)]
+
+    return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+            "max": s[-1]}
+
+
+def snapshot() -> dict:
+    """Full typed registry state: {name: {kind, value|...}}. Installs the
+    compile-count listener opportunistically (jax may not be up yet in a
+    bare control-plane process — then compiles simply read 0)."""
+    try:
+        from . import compilemeter
+
+        compilemeter.install()
+    except Exception:  # pragma: no cover - no jax in a stripped process
+        pass
+    out: dict[str, dict] = {}
+    for name, m in METRICS.items():
+        if m.kind == "counter":
+            out[name] = {"kind": "counter", "value": _COUNTERS[name].value()}
+        elif m.kind == "gauge":
+            g = _GAUGES[name]
+            rec = {"kind": "gauge", "value": g.value}
+            if g.track_peak:
+                rec["peak"] = g.peak
+            out[name] = rec
+        else:
+            h = _HISTS[name]
+            vals = list(h.ring)
+            out[name] = {"kind": "histogram", "count": h.count.value(),
+                         "sum": round(h.sum.value(), 6),
+                         "window": len(vals), **{
+                             k: (None if v is None else round(v, 6))
+                             for k, v in _percentiles(vals).items()}}
+    return out
+
+
+def snapshot_delta(before: dict, after: dict | None = None) -> dict:
+    """What happened between two snapshots, compact: counters report the
+    delta (zero deltas dropped), gauges the after-value (+ peak when
+    tracked), histograms the count/sum delta. This is the per-leg record
+    bench.py embeds in its fsync'd JSONL sidecar."""
+    after = snapshot() if after is None else after
+    out: dict[str, dict] = {}
+    for name, rec in after.items():
+        prev = before.get(name, {})
+        if rec["kind"] == "counter":
+            d = rec["value"] - prev.get("value", 0)
+            if d:
+                out[name] = {"delta": d}
+        elif rec["kind"] == "gauge":
+            g = {"value": rec["value"]}
+            if "peak" in rec:
+                g["peak"] = rec["peak"]
+            out[name] = g
+        else:
+            dc = rec["count"] - prev.get("count", 0)
+            if dc:
+                out[name] = {"count": dc,
+                             "sum_s": round(rec["sum"]
+                                            - prev.get("sum", 0.0), 6),
+                             "p99": rec["p99"]}
+    return out
+
+
+def prometheus() -> str:
+    """Prometheus text exposition (format 0.0.4) of the whole registry —
+    dots become underscores, everything is prefixed ``h2o_tpu_``."""
+    lines = []
+    for name, m in sorted(METRICS.items()):
+        pname = "h2o_tpu_" + name.replace(".", "_").replace("-", "_")
+        lines.append(f"# HELP {pname} {m.doc}")
+        if m.kind == "counter":
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {_COUNTERS[name].value():g}")
+        elif m.kind == "gauge":
+            g = _GAUGES[name]
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {g.value:g}")
+            if g.track_peak:
+                lines.append(f"# HELP {pname}_peak process-lifetime peak "
+                             f"of {pname}")
+                lines.append(f"# TYPE {pname}_peak gauge")
+                lines.append(f"{pname}_peak {g.peak:g}")
+        else:
+            h = _HISTS[name]
+            vals = list(h.ring)
+            pc = _percentiles(vals)
+            lines.append(f"# TYPE {pname} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                if pc[key] is not None:
+                    lines.append(f'{pname}{{quantile="{q}"}} {pc[key]:g}')
+            lines.append(f"{pname}_sum {h.sum.value():g}")
+            lines.append(f"{pname}_count {h.count.value():g}")
+    return "\n".join(lines) + "\n"
+
+
+def describe() -> str:
+    """Human-readable registry dump (the knobs.describe analog)."""
+    out = []
+    for m in sorted(METRICS.values(), key=lambda m: m.name):
+        out.append(f"{m.name}  [{m.kind}]")
+        out.append(f"    {m.doc}")
+    return "\n".join(out)
+
+
+def reset() -> None:
+    """Zero every metric (test isolation — production never calls this)."""
+    for c in _COUNTERS.values():
+        c.shards.clear()
+    for g in _GAUGES.values():
+        g.value = 0.0
+        g.peak = 0.0
+    for name in _HISTS:
+        _HISTS[name] = _Hist(_hist_window())
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------------
+#: (trace_id, span_id) of the innermost open span in this context
+_CTX: contextvars.ContextVar = contextvars.ContextVar("h2o_tpu_trace",
+                                                      default=None)
+_IDS = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("name", "metric", "attrs", "trace_id", "span_id",
+                 "parent_id", "phases", "t0_ns")
+
+    def __init__(self, name, metric, attrs, trace_id, span_id, parent_id):
+        self.name = name
+        self.metric = metric
+        self.attrs = attrs
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.phases: dict[str, float] = {}
+        self.t0_ns = 0
+
+    @contextlib.contextmanager
+    def phase(self, phase_name: str):
+        """Sub-phase accounting inside the span (MRProfile's setup/map/
+        reduce split) — totals land on the span's timeline event."""
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            dt = (time.perf_counter_ns() - t0) / 1e9
+            self.phases[phase_name] = self.phases.get(phase_name, 0.0) + dt
+
+
+@contextlib.contextmanager
+def span(name: str, metric: str | None = None, **attrs):
+    """Open a traced span: nests (contextvars), shares the enclosing trace
+    id or mints one, records a typed ``span`` timeline event on exit (plus
+    the chrome-trace line when ``H2O_TPU_TRACE_DIR`` is set), and observes
+    ``metric`` (a declared histogram) with its duration. ``attrs`` are
+    small JSON-able labels; keep them cheap — this runs on hot-path
+    boundaries."""
+    if metric is not None and metric not in _HISTS:
+        _lookup(metric)  # typed KeyError for undeclared / non-histogram
+        raise KeyError(f"span metric {metric!r} must be a histogram")
+    parent = _CTX.get()
+    span_id = next(_IDS)
+    trace_id = parent[0] if parent else f"{os.getpid()}-{span_id}"
+    sp = Span(name, metric, attrs, trace_id, span_id,
+              parent[1] if parent else None)
+    token = _CTX.set((trace_id, span_id))
+    sp.t0_ns = time.perf_counter_ns()
+    try:
+        yield sp
+    finally:
+        dur_ns = time.perf_counter_ns() - sp.t0_ns
+        _CTX.reset(token)
+        if _enabled():
+            detail = dict(sp.attrs)
+            detail["trace"] = sp.trace_id
+            detail["span"] = sp.span_id
+            if sp.parent_id is not None:
+                detail["parent"] = sp.parent_id
+            for k, v in sp.phases.items():
+                detail[f"{k}_s"] = round(v, 6)
+            timeline.record("span", name, dur_us=dur_ns // 1000, **detail)
+            if sp.metric is not None:
+                observe(sp.metric, dur_ns / 1e9)
+            _trace_emit(sp, dur_ns)
+
+
+def trace_id() -> str | None:
+    """Trace id of the innermost open span (None outside any span)."""
+    cur = _CTX.get()
+    return cur[0] if cur else None
+
+
+class Lap:
+    """Boundary-to-boundary timer whose clock math lives HERE (one audited
+    site) instead of inside a training loop: ``tick()`` observes the wall
+    since the previous tick into a declared histogram + timeline event.
+    First tick only starts the clock. Durations are async-dispatch wall
+    unless the loop syncs — same caveat as spans."""
+
+    __slots__ = ("metric", "what", "_t0")
+
+    def __init__(self, metric: str | None = None, what: str | None = None):
+        if metric is not None and metric not in _HISTS:
+            _lookup(metric)
+            raise KeyError(f"lap metric {metric!r} must be a histogram")
+        self.metric = metric
+        self.what = what
+        self._t0: float | None = None
+
+    def tick(self, **detail) -> float | None:
+        now = time.perf_counter()
+        dt = None
+        if self._t0 is not None:
+            dt = now - self._t0
+            if _enabled():
+                if self.metric is not None:
+                    observe(self.metric, dt)
+                if self.what is not None:
+                    timeline.record("lap", self.what,
+                                    dur_us=int(dt * 1e6), **detail)
+        self._t0 = now
+        return dt
+
+
+def lap(metric: str | None = None, what: str | None = None) -> Lap:
+    return Lap(metric=metric, what=what)
+
+
+# ---------------------------------------------------------------------------
+# chrome-tracing / Perfetto export
+# ---------------------------------------------------------------------------
+_TRACE_LOCK = threading.Lock()
+_TRACE_FILE = None        # open handle once the dir knob resolves
+_TRACE_DIR_SEEN = None    # knob value the handle was opened for
+
+
+def trace_path() -> str | None:
+    """Path of this process's chrome-trace file (None when export is off)."""
+    d = knobs.get_str("H2O_TPU_TRACE_DIR")
+    if not d:
+        return None
+    return os.path.join(d, f"trace_{os.getpid()}.trace.json")
+
+
+def _trace_emit(sp: Span, dur_ns: int) -> None:
+    global _TRACE_FILE, _TRACE_DIR_SEEN
+    d = knobs.get_str("H2O_TPU_TRACE_DIR")
+    if not d:
+        return
+    ev = {"name": sp.name, "ph": "X", "ts": sp.t0_ns // 1000,
+          "dur": max(dur_ns // 1000, 1), "pid": os.getpid(),
+          "tid": threading.get_ident(),
+          "args": {**{k: v for k, v in sp.attrs.items()},
+                   "trace": sp.trace_id,
+                   **{f"{k}_s": round(v, 6) for k, v in sp.phases.items()}}}
+    line = json.dumps(ev)
+    with _TRACE_LOCK:
+        if _TRACE_FILE is None or _TRACE_DIR_SEEN != d:
+            os.makedirs(d, exist_ok=True)
+            _TRACE_FILE = open(trace_path(), "a")
+            _TRACE_DIR_SEEN = d
+        # chrome's JSON Array Format: "[" then comma-separated events; the
+        # closing "]" is explicitly optional, so an append-only stream
+        # stays loadable after a crash (read_trace normalizes for tests)
+        if _TRACE_FILE.tell() == 0:
+            _TRACE_FILE.write("[\n")
+        else:
+            _TRACE_FILE.write(",\n")
+        _TRACE_FILE.write(line)
+        _TRACE_FILE.flush()
+
+
+def read_trace(path: str) -> list[dict]:
+    """Load a chrome-trace export back as a list of event dicts (appends
+    the optional closing bracket the streaming writer omits)."""
+    with open(path) as f:
+        text = f.read().rstrip().rstrip(",")
+    if not text.endswith("]"):
+        text += "\n]"
+    return json.loads(text)

@@ -1,36 +1,84 @@
 """Timeline — per-process event ring buffer. Analog of `water/TimeLine.java`
-(:12-40): a lock-free ring of the last 2048 events served at `/3/Timeline`.
+(:12-40): a lock-guarded ring of the most recent events served at
+`/3/Timeline`.
 
 The reference records every RPC packet send/recv. The TPU-native equivalents
-are control-plane events: mr_task dispatches, job transitions, REST requests,
-device transfers. Recording is cheap (deque append) and always on, like the
-reference's always-on ring.
+are control-plane events: mr_task dispatch spans, job transitions, REST
+requests, Cleaner spills/sweeps, failpoint fires. Recording is cheap (deque
+append) and always on, like the reference's always-on ring.
+
+Every event is TYPED: ``seq`` (monotone insertion order — the sort key;
+``perf_counter_ns`` ties are possible on coarse clocks and wall ``ms`` can
+step backwards under NTP), ``ns``/``ms`` stamps, ``kind``, ``what``, plus
+kind-specific detail keys flat on the event (a span's ``dur_us``/``trace``,
+a spill's ``bytes``, ...). ``snapshot(limit=...)`` caps how much the REST
+path serializes; ``total_recorded()`` tells a poller how many events ever
+happened so it can report drops. Ring capacity comes from the
+``H2O_TPU_TIMELINE_EVENTS`` knob (read once at import).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
 
-_RING: deque[dict] = deque(maxlen=2048)
+from . import knobs
+
+
+def _capacity() -> int:
+    return max(knobs.get_int("H2O_TPU_TIMELINE_EVENTS"), 64)
+
+
+_RING: deque = deque(maxlen=_capacity())
 _LOCK = threading.Lock()
+_SEQ = itertools.count(1)
+_LAST_SEQ = 0
 
 
 def record(kind: str, what: str, **detail):
-    """Append one event; ns timestamps mirror TimeLine's nanotime entries."""
-    ev = {"ns": time.perf_counter_ns(), "ms": int(time.time() * 1000),
-          "kind": kind, "what": what}
+    """Append one typed event; ns timestamps mirror TimeLine's nanotime
+    entries, seq pins the order even across clock ties. Honors the
+    ``H2O_TPU_METRICS_ENABLED`` master switch (one gate for every direct
+    call site — jobs, REST, Cleaner, failpoints, compiles — matching the
+    telemetry registry's contract)."""
+    if not knobs.get_bool("H2O_TPU_METRICS_ENABLED"):
+        return
+    global _LAST_SEQ
+    ev = {"seq": 0, "ns": time.perf_counter_ns(),
+          "ms": int(time.time() * 1000), "kind": kind, "what": what}
     if detail:
         ev.update(detail)
     with _LOCK:
+        ev["seq"] = _LAST_SEQ = next(_SEQ)
         _RING.append(ev)
 
 
-def snapshot() -> list[dict]:
-    """Ordered copy of the ring — the TimelineSnapshot/`/3/Timeline` payload."""
+def snapshot(limit: int | None = None, kind: str | None = None) -> list[dict]:
+    """Ordered copy of the ring — the `/3/Timeline` payload. ``limit`` keeps
+    only the most recent N events (serialization cost cap for the REST
+    path); ``kind`` filters by event kind first."""
     with _LOCK:
-        return sorted(_RING, key=lambda e: e["ns"])
+        # seq assignment and append share the lock above, so the deque is
+        # already seq-ordered — no sort needed
+        evs = list(_RING)
+    if kind is not None:
+        evs = [e for e in evs if e["kind"] == kind]
+    if limit is not None and limit > 0:
+        evs = evs[-limit:]
+    return evs
+
+
+def total_recorded() -> int:
+    """Events ever recorded (ring evictions included) — lets a /3/Timeline
+    poller compute how many events it missed between polls."""
+    with _LOCK:
+        return _LAST_SEQ
+
+
+def capacity() -> int:
+    return _RING.maxlen or 0
 
 
 def clear():

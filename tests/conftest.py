@@ -121,6 +121,10 @@ def pytest_configure(config):
         "markers", "faults: fault-tolerance layer — failpoints, "
                    "auto-checkpoint kill-resume parity, typed retry "
                    "(pytest -m faults, utils/failpoints.py + retry.py)")
+    config.addinivalue_line(
+        "markers", "telemetry: unified telemetry — metrics registry, span "
+                   "tracing, /3/Metrics + /3/Timeline surface (pytest -m "
+                   "telemetry, utils/telemetry.py)")
 
 
 def pytest_collection_modifyitems(config, items):

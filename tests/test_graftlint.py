@@ -198,6 +198,18 @@ def read():
         raise RuntimeError("read failed") from e
 """,
     ),
+    "unregistered-metric": (
+        """
+from h2o_tpu.utils import telemetry
+
+telemetry.inc("totally.new.metric")
+""",
+        """
+from h2o_tpu.utils import telemetry
+
+telemetry.inc("mrtask.dispatch.count")
+""",
+    ),
 }
 
 
@@ -594,7 +606,7 @@ def test_scan_set_includes_the_advertised_tree():
 
 def test_every_rule_registered_exactly_once():
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 10
+    assert len(ids) == len(set(ids)) == 11
 
 
 def test_failpoint_registry_covers_every_site_the_tree_hits():
@@ -605,6 +617,30 @@ def test_failpoint_registry_covers_every_site_the_tree_hits():
 
     assert registered_failpoints() == set(fp.FAILPOINTS)
     assert set(fp.FAILPOINTS)  # the registry is not empty
+
+
+def test_metric_registry_and_module_agree():
+    """Dynamic twin of unregistered-metric: the AST parse of telemetry.py
+    sees exactly the metrics the module declares at import."""
+    from h2o_tpu.utils import telemetry
+    from tools.graftlint.rules import registered_metrics
+
+    assert registered_metrics() == set(telemetry.METRICS)
+    assert set(telemetry.METRICS)  # the registry is not empty
+
+
+def test_unregistered_metric_span_kwarg():
+    """The span/lap `metric=` keyword is checked too, not just the
+    positional accessor surface."""
+    src = """
+from h2o_tpu.utils import telemetry
+
+with telemetry.span("anything", metric="not.a.metric"):
+    pass
+"""
+    assert "unregistered-metric" in _rules_hit(src)
+    ok = src.replace("not.a.metric", "mrtask.dispatch.seconds")
+    assert "unregistered-metric" not in _rules_hit(ok)
 
 
 def test_repo_gate_zero_nonbaselined_violations():

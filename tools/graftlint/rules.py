@@ -33,6 +33,10 @@ CHANGES.md entries):
    instrumented (failpoint) site swallows injected faults — and with them
    the real transient failures the drill stands in for; transient errors
    route through `utils/retry.py` or unwind typed.
+11. unregistered-metric  — PR 6: literal metric names emitted through
+   `utils/telemetry.py` accessors must be declared in its registry; an
+   undeclared name raises at runtime (KeyError, the knobs contract) — this
+   rule catches it before a hot path does.
 """
 
 from __future__ import annotations
@@ -47,6 +51,7 @@ from .core import (REPO_ROOT, FileContext, Rule, Violation, dotted_name,
 MESH_PATH = "h2o_tpu/parallel/mesh.py"
 KNOBS_PATH = "h2o_tpu/utils/knobs.py"
 FAILPOINTS_PATH = "h2o_tpu/utils/failpoints.py"
+TELEMETRY_PATH = "h2o_tpu/utils/telemetry.py"
 
 _NARROW_INTS = {"int8", "int16", "uint8", "uint16"}
 _WIDE_TYPES = {"int32", "int64", "uint32", "uint64",
@@ -655,7 +660,83 @@ class SwallowedRetryable(Rule):
         return out
 
 
+def registered_metrics(root: str = REPO_ROOT) -> set[str]:
+    """Metric names declared in h2o_tpu/utils/telemetry.py — AST-parsed
+    like the knob/failpoint registries, so the linter never imports the
+    (jax-adjacent) package."""
+    path = os.path.join(root, TELEMETRY_PATH)
+    names: set[str] = set()
+    if not os.path.exists(path):
+        return names
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and dotted_name(node.func) in ("_counter", "_gauge",
+                                               "_histogram", "Metric")):
+            names.add(node.args[0].value)
+    return names
+
+
+class UnregisteredMetric(Rule):
+    id = "unregistered-metric"
+    doc = ("literal metric name emitted through utils/telemetry.py "
+           "accessors but not declared in its registry")
+
+    #: accessors whose literal FIRST argument is a metric name
+    _ACCESSORS = ("inc", "observe", "set_gauge", "value")
+    #: span/lap constructors carry the metric as a `metric=` keyword
+    _METRIC_KW = ("span", "lap", "Lap")
+
+    def __init__(self, registry: set[str] | None = None):
+        self._registry = registry
+
+    @property
+    def registry(self) -> set[str]:
+        if self._registry is None:
+            self._registry = registered_metrics()
+        return self._registry
+
+    def _flag(self, ctx, node, name):
+        return self.violation(
+            ctx, node,
+            f"metric {name!r} is not declared in "
+            f"h2o_tpu/utils/telemetry.py — register it (name, kind, "
+            f"docstring) so /3/Metrics stays documented and the emit "
+            f"cannot KeyError a hot path at runtime")
+
+    def check(self, tree, ctx):
+        if ctx.relpath == TELEMETRY_PATH:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _norm_func(node, ctx)
+            if fn is None:
+                continue
+            if (any(fn.endswith(f"telemetry.{acc}")
+                    for acc in self._ACCESSORS)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                name = node.args[0].value
+                if name not in self.registry:
+                    out.append(self._flag(ctx, node, name))
+            elif any(fn.endswith(f"telemetry.{c}")
+                     for c in self._METRIC_KW):
+                for kw in node.keywords:
+                    if (kw.arg == "metric"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)
+                            and kw.value.value not in self.registry):
+                        out.append(self._flag(ctx, node, kw.value.value))
+        return out
+
+
 ALL_RULES = (DirectShardMap, PSpecConcat, NarrowIntAccumulate,
              UntrackedResident, TimingWithoutSync, HostSyncInTrace,
              NondeterminismInTrace, UnregisteredKnob, UnregisteredFailpoint,
-             SwallowedRetryable)
+             SwallowedRetryable, UnregisteredMetric)
