@@ -641,6 +641,141 @@ def bench_serving(n_reqs: int, n_threads: int) -> dict:
     }
 
 
+_WIRE_CLIENT = '''\
+import sys, threading, time
+
+sys.path.insert(0, {repo!r})
+import h2o_tpu.api.client as c
+
+row = {{"x1": 0.5}}
+n_per, n_threads = int(sys.argv[1]), int(sys.argv[2])
+conn = c.H2OConnection("http://127.0.0.1:{port}")
+for _ in range(10):  # connection + scorer warm-up, untimed
+    conn.request("POST", "/3/Serving/score",
+                 data={{"model_id": "wire", "rows": [row]}})
+
+done = [0] * n_threads
+errors = []
+
+
+def worker(k):
+    try:
+        for _ in range(n_per):
+            conn.request("POST", "/3/Serving/score",
+                         data={{"model_id": "wire", "rows": [row]}})
+            done[k] += 1
+    except Exception as e:  # a dead worker must FAIL the leg, not
+        errors.append(repr(e))  # silently inflate req/s
+
+
+threads = [threading.Thread(target=worker, args=(k,))
+           for k in range(n_threads)]
+t0 = time.time()
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+elapsed = time.time() - t0
+if errors or sum(done) != n_per * n_threads:
+    print("wire client workers failed: completed %d/%d: %s"
+          % (sum(done), n_per * n_threads, errors[:3]), file=sys.stderr)
+    sys.exit(1)
+print(sum(done) / elapsed)
+'''
+
+
+def bench_serving_wire(n_reqs: int) -> dict:
+    """Keep-alive wire leg: sequential AND concurrent single-row HTTP
+    scoring from a SUBPROCESS client (its own interpreter — an in-process
+    client competes with the server for the GIL and measures contention,
+    not the wire), pooled persistent connections vs one connection per
+    request (``H2O_TPU_CLIENT_KEEPALIVE=0``, the pre-pool transport shape).
+
+    The model is a tiny GLM registered with ``max_wait_us=0`` so the
+    coalescing window and tree-scoring cost don't mask the wire: what's
+    left per request is HTTP parse + routing + one sub-ms scorer call.
+    The headline is the CONCURRENT ratio — under fleet-shaped load,
+    per-request connections collapse (TCP dial + a fresh server handler
+    thread per connection + TIME_WAIT churn serialize on the accept path)
+    while pooled lanes ride persistent handler threads and the batcher
+    coalesces across them. Acceptance: pooled >= 3x per-request req/s
+    concurrent, recompiles == 0 through the whole leg."""
+    import subprocess
+    import sys as _sys
+
+    import h2o_tpu.api as h2o
+    from h2o_tpu.frame.frame import Frame
+    from h2o_tpu.frame.vec import Vec
+    from h2o_tpu.models.glm import GLM, GLMParameters
+
+    port = 54732
+    conn = h2o.init(port=port)
+    if getattr(conn, "_server", None) is None:
+        raise RuntimeError("serving_wire bench needs its own in-process "
+                           "server; port 54732 is already serving another "
+                           "process")
+    rng = np.random.default_rng(11)
+    n = 2000
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = (2.0 * x1 + rng.normal(scale=0.1, size=n)).astype(np.float32)
+    fr = Frame(["x1", "y"], [Vec.from_numpy(x1), Vec.from_numpy(y)])
+    glm = GLM(GLMParameters(training_frame=fr, response_column="y",
+                            family="gaussian", seed=1)).train_model()
+    h2o.register_serving(glm.key, serving_id="wire", buckets=[1, 8, 64],
+                         max_wait_us=0)
+
+    import tempfile
+
+    script = _WIRE_CLIENT.format(
+        repo=os.path.dirname(os.path.abspath(__file__)), port=port)
+    fd, script_path = tempfile.mkstemp(suffix="_wire_client.py")
+    with os.fdopen(fd, "w") as f:
+        f.write(script)
+
+    def run(keepalive: str, n_per: int, n_threads: int) -> float:
+        env = dict(os.environ)
+        env["H2O_TPU_CLIENT_KEEPALIVE"] = keepalive
+        out = subprocess.run(
+            [_sys.executable, script_path, str(n_per), str(n_threads)],
+            capture_output=True, text=True, timeout=600, env=env)
+        if out.returncode != 0:
+            raise RuntimeError(f"wire client failed:\n{out.stderr[-2000:]}")
+        return float(out.stdout.strip().splitlines()[-1])
+
+    threads = 32
+    seq_n = max(n_reqs // 2, 100)
+    conc_per = max(n_reqs // threads, 20)
+    try:
+        pooled_seq = run("1", seq_n, 1)
+        perreq_seq = run("0", seq_n, 1)
+        pooled_conc = run("1", conc_per, threads)
+        perreq_conc = run("0", conc_per, threads)
+    finally:
+        os.unlink(script_path)
+    snap = h2o.serving_stats("wire")["wire"]
+    h2o.unregister_serving("wire")
+    h2o.shutdown()
+    del fr
+    gc.collect()
+    return {
+        "sequential": {
+            "pooled_req_s": round(pooled_seq, 1),
+            "per_request_req_s": round(perreq_seq, 1),
+            "pooled_x": round(pooled_seq / perreq_seq, 2),
+        },
+        "concurrent": {
+            "threads": threads,
+            "pooled_req_s": round(pooled_conc, 1),
+            "per_request_req_s": round(perreq_conc, 1),
+            "pooled_x": round(pooled_conc / perreq_conc, 2),
+        },
+        "recompiles": snap["recompiles"],
+        "note": ("subprocess client (own GIL), GLM @ max_wait_us=0 so the "
+                 "wire dominates; acceptance: concurrent pooled_x >= 3 "
+                 "and recompiles == 0"),
+    }
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache for accelerator backends — the
     standard TPU deployment practice (and the fix for the cold-start gap:
@@ -791,6 +926,9 @@ def main():
         _leg(workloads, "serving", lambda: bench_serving(
             knobs.get_int("H2O_TPU_BENCH_SERVING_REQS"),
             knobs.get_int("H2O_TPU_BENCH_SERVING_THREADS")))
+    if "serving_wire" in wanted:
+        _leg(workloads, "serving_wire", lambda: bench_serving_wire(
+            knobs.get_int("H2O_TPU_BENCH_WIRE_REQS")))
     if "binned" in wanted:
         _leg(workloads, "binned_store",
              lambda: bench_binned_store(

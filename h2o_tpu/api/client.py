@@ -16,13 +16,13 @@ of h2o.init() spawning a local JVM (`h2o-py/h2o/h2o.py:287`).
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
+import threading
 import time
-import urllib.error
-import uuid
 import urllib.parse
-import urllib.request
+import uuid
 
 from ..utils.retry import RetryBudgetExceeded, retry_after_verdict
 
@@ -68,7 +68,17 @@ class H2ORetriesExhaustedError(H2OConnectionError, RetryBudgetExceeded):
 
 
 class H2OConnection:
-    """REST transport — `h2o-py/h2o/backend/connection.py` analog."""
+    """REST transport — `h2o-py/h2o/backend/connection.py` analog.
+
+    The wire is POOLED: one persistent `http.client.HTTPConnection` per
+    CLIENT THREAD (the server is HTTP/1.1 keep-alive; re-dialing TCP +
+    rebuilding a urllib opener per request capped the wire at ~450 req/s
+    while the batcher behind it does 37×). A stale pooled socket — server
+    restarted, idle timeout, half-closed keep-alive — redials ONCE
+    transparently when the request body is replayable; everything else
+    keeps the pre-pool semantics: typed errors, Retry-After retries,
+    streamed uploads/downloads. ``H2O_TPU_CLIENT_KEEPALIVE=0`` reverts to
+    one connection per request (the serving_wire bench baseline)."""
 
     def __init__(self, url: str, username: str | None = None,
                  password: str | None = None,
@@ -78,6 +88,7 @@ class H2OConnection:
         self.session_id: str | None = None
         self.requests_count = 0  # h2o-py connection counter (lazy-op tests)
         self.connected = True
+        self._pool = threading.local()  # .conn: this thread's keep-alive
         self._auth = None
         self._ssl_ctx = None
         if url.startswith("https"):
@@ -112,9 +123,9 @@ class H2OConnection:
         backoff, giving up with the typed ``RetryBudgetExceeded``.
         Non-idempotent requests never retry automatically (a replayed POST
         could double-train a model); ``retry`` overrides either way."""
-        url = f"{self.url}{path}"
+        pathq = path
         if params:
-            url += "?" + urllib.parse.urlencode(params)
+            pathq += "?" + urllib.parse.urlencode(params)
         headers = {}
         if self._auth:
             headers["Authorization"] = self._auth
@@ -133,10 +144,9 @@ class H2OConnection:
                 headers["Content-Length"] = str(os.path.getsize(filename))
             elif data is not None:
                 body = json.dumps(data).encode()
-            req = urllib.request.Request(url, data=body, headers=headers,
-                                         method=method)
             try:
-                return self._send(req, raw, save_to)
+                return self._send(method, pathq, body, headers, raw,
+                                  save_to)
             finally:
                 if filename is not None and body is not None:
                     body.close()
@@ -156,42 +166,106 @@ class H2OConnection:
                 f"{method} {path}", e.attempts, e.elapsed_s,
                 e.last) from e.last
 
-    def _send(self, req, raw: bool, save_to: str | None):
-        from ..utils import failpoints
+    # -- pooled transport ---------------------------------------------------
+    def _new_conn(self) -> http.client.HTTPConnection:
+        u = urllib.parse.urlsplit(self.url)
+        if u.scheme == "https":
+            return http.client.HTTPSConnection(
+                u.hostname, u.port, timeout=600, context=self._ssl_ctx)
+        return http.client.HTTPConnection(u.hostname, u.port, timeout=600)
+
+    @staticmethod
+    def _rewind(body) -> bool:
+        """True when ``body`` can be re-sent on a redial (None/bytes
+        always; a file only if it seeks back to 0)."""
+        if body is None or isinstance(body, (bytes, bytearray)):
+            return True
+        try:
+            body.seek(0)
+            return True
+        except (AttributeError, OSError):
+            return False
+
+    def _send(self, method: str, pathq: str, body, headers: dict,
+              raw: bool, save_to: str | None):
+        from ..utils import failpoints, knobs
 
         failpoints.hit("client.request")
+        keepalive = knobs.get_bool("H2O_TPU_CLIENT_KEEPALIVE")
+        conn = getattr(self._pool, "conn", None) if keepalive else None
+        pooled = conn is not None
+        hdrs = dict(headers)
+        if not keepalive:
+            hdrs["Connection"] = "close"
         try:
-            with urllib.request.urlopen(req, timeout=600,
-                                        context=self._ssl_ctx) as resp:
-                if save_to is not None:
-                    with open(save_to, "wb") as out:
-                        while True:
-                            chunk = resp.read(1 << 20)
-                            if not chunk:
-                                break
-                            out.write(chunk)
-                    return save_to
-                text = resp.read().decode()
-                return text if raw else json.loads(text)
-        except urllib.error.HTTPError as e:
-            body = e.read().decode(errors="replace")
-            payload = None
-            msg = str(e)
+            if conn is None:
+                conn = self._new_conn()
             try:
-                payload = json.loads(body)
-                if isinstance(payload, dict):
-                    msg = payload.get("msg", str(e))
-            except ValueError:
+                conn.request(method, pathq, body=body, headers=hdrs)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError):
+                # a POOLED socket gone stale (server restart, keep-alive
+                # timeout, half-close — RemoteDisconnected, resets, EBADF)
+                # redials ONCE on a fresh connection — transparent
+                # reconnect, not a retry-policy attempt; a FRESH
+                # connection failing is a real transport error
+                conn.close()
+                if keepalive:
+                    self._pool.conn = None
+                if not pooled or not self._rewind(body):
+                    raise
+                pooled = False
+                conn = self._new_conn()
+                conn.request(method, pathq, body=body, headers=hdrs)
+                resp = conn.getresponse()
+        except (http.client.HTTPException, OSError) as e:
+            try:
+                conn.close()
+            except Exception:   # noqa: BLE001 — already broken
                 pass
-            err = H2OConnectionError(msg)
-            err.status = e.code
-            err.headers = dict(e.headers or {})
-            err.payload = payload if isinstance(payload, dict) else None
-            raise err
-        except urllib.error.URLError as e:
+            if keepalive:
+                self._pool.conn = None
             err = H2OConnectionError(f"no H2O server at {self.url}: {e}")
             err.no_server = True  # distinguishes "nothing listening" from
             raise err             # HTTP-level failures like 401
+        try:
+            return self._read_response(resp, raw, save_to)
+        finally:
+            # the body was fully read either way — the socket is clean for
+            # the next request on this thread
+            if keepalive:
+                self._pool.conn = conn
+            else:
+                conn.close()
+
+    def _read_response(self, resp, raw: bool, save_to: str | None):
+        status = resp.status
+        rheaders = {k: v for k, v in resp.getheaders()}
+        if status >= 400:
+            body = resp.read().decode(errors="replace")
+            payload = None
+            msg = f"HTTP Error {status}: {resp.reason}"
+            try:
+                payload = json.loads(body)
+                if isinstance(payload, dict):
+                    msg = payload.get("msg", msg)
+            except ValueError:
+                pass
+            err = H2OConnectionError(msg)
+            err.status = status
+            err.headers = rheaders
+            err.payload = payload if isinstance(payload, dict) else None
+            raise err
+        if save_to is not None:
+            with open(save_to, "wb") as out:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    out.write(chunk)
+            return save_to
+        text = resp.read().decode()
+        return text if raw else json.loads(text)
 
     # session for rapids temp management
     def session(self) -> str:
@@ -753,6 +827,89 @@ def unregister_serving(serving_id: str) -> dict:
     """`DELETE /3/Serving/models/{id}` — stop the model's batcher."""
     return connection().request(
         "DELETE", f"/3/Serving/models/{urllib.parse.quote(serving_id)}")
+
+
+def create_route(endpoint: str, variants, seed: int | None = None) -> dict:
+    """Map a logical serving endpoint onto weighted model variants
+    (`POST /3/Serving/routes/{endpoint}`). ``variants`` is a list of
+    ``{"model_id": ..., "weight": ..., "shadow": bool}`` dicts — or the
+    ``{model_id: weight}`` shorthand. The split is deterministic in the
+    route ``seed`` (fixed seed = replayable variant sequence); shadow
+    variants score every request off the response path and feed the
+    divergence stats in `route_stats`."""
+    if isinstance(variants, dict):
+        variants = [{"model_id": k, "weight": v}
+                    for k, v in variants.items()]
+    data: dict = {"variants": list(variants)}
+    if seed is not None:
+        data["seed"] = int(seed)
+    return connection().request(
+        "POST", f"/3/Serving/routes/{urllib.parse.quote(endpoint)}",
+        data=data)
+
+
+def route_score(endpoint: str, rows, deadline_ms=None,
+                retries: int = 0) -> list:
+    """Score through a routed endpoint (`POST /3/Serving/score` with
+    ``endpoint``): the router picks the serving variant per request —
+    champion/canary split — and shadow variants see the same rows without
+    touching the response. Same typed 429/408 surface (and ``retries``
+    semantics) as `score_rows`."""
+    if retries > 0:
+        from ..utils.retry import retry_call
+
+        def _overloaded(e):
+            if isinstance(e, H2OServingOverloadError):
+                return max(float(e.retry_after_s), 0.001)
+            return False
+
+        return retry_call(
+            lambda: route_score(endpoint, rows, deadline_ms=deadline_ms),
+            retryable=_overloaded, attempts=retries + 1,
+            description=f"route_score({endpoint})")
+    if isinstance(rows, dict):
+        rows = [rows]
+    data: dict = {"endpoint": endpoint, "rows": list(rows)}
+    if deadline_ms is not None:
+        data["deadline_ms"] = deadline_ms
+    try:
+        resp = connection().request("POST", "/3/Serving/score", data=data)
+    except H2OConnectionError as e:
+        if e.status == 429:
+            err = H2OServingOverloadError(str(e))
+            err.status, err.headers, err.payload = (e.status, e.headers,
+                                                    e.payload)
+            err.retry_after_s = float(
+                (e.payload or {}).get("retry_after_s")
+                or (e.headers or {}).get("Retry-After") or 0.0)
+            raise err from None
+        if e.status == 408:
+            err = H2OServingTimeoutError(str(e))
+            err.status, err.headers, err.payload = (e.status, e.headers,
+                                                    e.payload)
+            raise err from None
+        raise
+    return resp["predictions"]
+
+
+def route_stats(endpoint: str | None = None) -> dict:
+    """`GET /3/Serving/routes[/{endpoint}]` — request counts, per-variant
+    weights/serve counts, shadow rows and prediction-delta divergence."""
+    if endpoint is not None:
+        return connection().request(
+            "GET", f"/3/Serving/routes/{urllib.parse.quote(endpoint)}")
+    return connection().request("GET", "/3/Serving/routes")
+
+
+def delete_route(endpoint: str) -> dict:
+    """`DELETE /3/Serving/routes/{endpoint}`."""
+    return connection().request(
+        "DELETE", f"/3/Serving/routes/{urllib.parse.quote(endpoint)}")
+
+
+def serving_control() -> dict:
+    """`GET /3/Serving/control` — fleet quota, placements, routes."""
+    return connection().request("GET", "/3/Serving/control")
 
 
 # ---------------------------------------------------------------------------

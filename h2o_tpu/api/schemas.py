@@ -209,6 +209,13 @@ def serving_stats_schema(stats: dict) -> dict:
     return {"models": _clean(stats)}
 
 
+def serving_route_schema(stats: dict) -> dict:
+    """Wire shape of a serving route (`/3/Serving/routes/{endpoint}`):
+    the Route.stats() dict — endpoint, seed, request count, per-variant
+    weights/counters/divergence — JSON-cleaned."""
+    return _clean(dict(stats))
+
+
 def model_schema(model) -> dict:
     """`water/api/schemas3/ModelSchemaV3` (summary form)."""
     o = model.output

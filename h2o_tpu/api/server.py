@@ -293,6 +293,12 @@ def _make_handler(server: H2OServer):
             self._head_only = False
             self._suppress_body = head_only
             if not server.check_auth(self.headers.get("Authorization")):
+                # drain any request body first — a keep-alive client will
+                # reuse this socket for the re-authed retry, and leftover
+                # body bytes would corrupt its next request line
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
                 self.send_response(401)
                 challenge = ("Negotiate" if server.negotiate_auth is not None
                              else 'Basic realm="h2o_tpu"')
@@ -327,10 +333,14 @@ def _make_handler(server: H2OServer):
             try:
                 from ..utils import failpoints
 
+                # read the body BEFORE the failpoint (or any other early
+                # reply) can short-circuit routing: on a keep-alive
+                # connection, unread body bytes would be parsed as the
+                # NEXT request's start line — a wire-protocol desync the
+                # pooled client turns from latent to immediate
+                body = (self._body() if method in ("POST", "PUT") else {})
                 failpoints.hit("rest.route")
-                status, payload = route(server, method, parts, query,
-                                        self._body() if method in ("POST", "PUT")
-                                        else {})
+                status, payload = route(server, method, parts, query, body)
             except failpoints.InjectedHTTPError as e:
                 # deterministic flaky-server injection: reply the injected
                 # status; 429/503 carry Retry-After so client retry paths
@@ -573,11 +583,16 @@ def _serving_route(method: str, rest: list[str], p: dict) -> tuple[int, dict]:
       never hanging.
     - ``GET /3/Serving/stats[/{id}]``: latency percentiles, throughput,
       batch occupancy, queue depth, recompile/rejection counters.
+    - ``POST /3/Serving/routes/{endpoint}``: map a logical endpoint onto
+      weighted model variants (canary split + shadow traffic);
+      ``GET /3/Serving/routes[/{endpoint}]`` surfaces per-variant
+      divergence stats, ``DELETE`` drops the route.
+    - ``GET /3/Serving/control``: fleet quota, placements, routes.
     """
     from .. import serving
-    from ..serving.errors import (DeadlineExceededError,
+    from ..serving.errors import (AdmissionError, DeadlineExceededError,
                                   ModelNotRegisteredError, QueueFullError,
-                                  ServingShutdownError,
+                                  RouteNotFoundError, ServingShutdownError,
                                   UnsupportedModelError)
 
     rt = serving.get_runtime()
@@ -599,7 +614,8 @@ def _serving_route(method: str, rest: list[str], p: dict) -> tuple[int, dict]:
         if method == "POST":
             overrides = {k: p[k] for k in
                          ("buckets", "max_batch", "max_wait_us",
-                          "queue_depth", "deadline_ms", "stats_window")
+                          "queue_depth", "deadline_ms", "stats_window",
+                          "priority", "replicas")
                          if p.get(k) not in (None, "")}
             if isinstance(overrides.get("buckets"), str):
                 overrides["buckets"] = [
@@ -626,10 +642,24 @@ def _serving_route(method: str, rest: list[str], p: dict) -> tuple[int, dict]:
                 # matrix path unsupported at trace time (GLM interactions)
                 # — a client-input problem, not a server fault
                 return _err(400, str(e), error_type="unsupported_model")
+            except ValueError as e:
+                return _err(400, str(e))
+            except AdmissionError as e:
+                # over-quota (or placement-OOM) — retryable-later, and
+                # co-registered models are untouched by construction
+                status, payload = _err(
+                    429, str(e), error_type="admission_rejected",
+                    retry_after_s=round(e.retry_after_s, 3),
+                    cost_bytes=e.cost_bytes,
+                    budget_bytes=e.budget_bytes)
+                payload["__headers__"] = {
+                    "Retry-After": max(1, int(np.ceil(e.retry_after_s)))}
+                return status, payload
             return 200, schemas.serving_model_schema(info)
 
     if sub == "score" and method == "POST":
         sid = p.get("model_id", "")
+        endpoint = p.get("endpoint", "")
         rows = p.get("rows")
         if rows is None:
             row = p.get("row")
@@ -638,27 +668,41 @@ def _serving_route(method: str, rest: list[str], p: dict) -> tuple[int, dict]:
             return _err(400, "score needs 'row' (dict) or 'rows' "
                              "(list of dicts)")
         deadline_ms = p.get("deadline_ms")
+        deadline_ms = (None if deadline_ms in (None, "")
+                       else float(deadline_ms))
+        served_by = sid
         try:
-            preds = rt.score(sid, rows,
-                             deadline_ms=None if deadline_ms in (None, "")
-                             else float(deadline_ms))
-        except ModelNotRegisteredError as e:
+            if endpoint:
+                # routed scoring: the router picks the serving variant
+                # (weighted deterministic split) and feeds shadow traffic
+                preds, served_by = rt.router.score(endpoint, rows,
+                                                   deadline_ms=deadline_ms)
+            else:
+                preds = rt.score(sid, rows, deadline_ms=deadline_ms)
+        except (ModelNotRegisteredError, RouteNotFoundError) as e:
             return _err(404, str(e))
         except ServingShutdownError as e:
             # raced a DELETE / re-registration: the looked-up lane died
             # under the request — retryable conflict, not a server fault
             return _err(409, str(e), error_type="model_shutdown")
-        except QueueFullError as e:
+        except (QueueFullError, AdmissionError) as e:
+            # AdmissionError here: a cold model's lazy re-placement lost
+            # the quota race — same retryable-later shape as queue-full
             status, payload = _err(
-                429, str(e), error_type="queue_full",
+                429, str(e),
+                error_type=("queue_full" if isinstance(e, QueueFullError)
+                            else "admission_rejected"),
                 retry_after_s=round(e.retry_after_s, 3))
             payload["__headers__"] = {
                 "Retry-After": max(1, int(np.ceil(e.retry_after_s)))}
             return status, payload
         except DeadlineExceededError as e:
             return _err(408, str(e), error_type="deadline_exceeded")
-        return 200, {"model_id": sid, "predictions": preds,
-                     "count": len(preds)}
+        out = {"model_id": served_by, "predictions": preds,
+               "count": len(preds)}
+        if endpoint:
+            out["endpoint"] = endpoint
+        return 200, out
 
     if sub == "stats" and method == "GET":
         if len(rest) > 2:
@@ -678,6 +722,48 @@ def _serving_route(method: str, rest: list[str], p: dict) -> tuple[int, dict]:
             except ModelNotRegisteredError:
                 pass  # unregistered between the listing and the lookup
         return 200, {"models": infos}
+
+    if sub == "routes":
+        if len(rest) > 2:
+            endpoint = urllib.parse.unquote(rest[2])
+            if method == "POST":
+                variants = p.get("variants")
+                if isinstance(variants, dict):
+                    # {model_id: weight} shorthand
+                    variants = [{"model_id": k, "weight": v}
+                                for k, v in variants.items()]
+                if not isinstance(variants, list):
+                    return _err(400, "route needs 'variants': a list of "
+                                     "{model_id, weight[, shadow]} dicts")
+                seed = p.get("seed")
+                try:
+                    st = rt.router.create_route(
+                        endpoint, variants,
+                        seed=None if seed in (None, "") else int(seed))
+                except ModelNotRegisteredError as e:
+                    return _err(404, str(e))
+                except ValueError as e:
+                    return _err(400, str(e))
+                return 200, schemas.serving_route_schema(st)
+            if method == "DELETE":
+                try:
+                    rt.router.delete_route(endpoint)
+                except RouteNotFoundError as e:
+                    return _err(404, str(e))
+                return 200, {"endpoint": endpoint, "deleted": True}
+            if method == "GET":
+                try:
+                    return 200, schemas.serving_route_schema(
+                        rt.router.stats(endpoint))
+                except RouteNotFoundError as e:
+                    return _err(404, str(e))
+        if method == "GET":
+            st = rt.router.stats()
+            return 200, {"routes": [schemas.serving_route_schema(r)
+                                    for r in st["routes"]]}
+
+    if sub == "control" and method == "GET":
+        return 200, schemas._clean(rt.control_snapshot())
 
     return _err(404, f"no serving route for {method} "
                      f"/{'/'.join(['3'] + rest)}")
@@ -2438,6 +2524,13 @@ _ROUTES_DOC = [
          "micro-batched row-dict scoring (429/408 on overload/deadline)"),
         ("GET", "/3/Serving/stats",
          "serving latency/throughput/occupancy/queue stats"),
+        ("POST", "/3/Serving/routes/{endpoint}",
+         "map an endpoint onto weighted variants (canary + shadow)"),
+        ("GET", "/3/Serving/routes",
+         "route table with per-variant divergence stats"),
+        ("DELETE", "/3/Serving/routes/{endpoint}", "drop a route"),
+        ("GET", "/3/Serving/control",
+         "fleet placement/quota snapshot (admission control plane)"),
         ("POST", "/3/Predictions/models/{m}/frames/{f}", "score a frame"),
         ("POST", "/4/Predictions/models/{m}/frames/{f}",
          "score a frame asynchronously (job)"),

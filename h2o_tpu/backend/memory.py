@@ -95,18 +95,52 @@ def device_hbm_bytes() -> int | None:
 def hbm_budget_bytes() -> int | None:
     """LIVE HBM planning budget for compute intermediates: 85% of physical
     (the Cleaner's headroom) minus the bytes the Cleaner currently tracks as
-    device-resident, floored at 1/16 of physical so planners always get a
-    workable (if small) budget under pressure. ``H2O_TPU_HBM_LIMIT_BYTES``
-    pins the value EXACTLY (no residency adjustment — tests mock budgets
-    with it); None when no accelerator budget is resolvable (planners fall
-    back to their own conservative defaults)."""
+    device-resident and minus outstanding serving reservations
+    (:func:`reserve_bytes`), floored at 1/16 of physical so planners always
+    get a workable (if small) budget under pressure.
+    ``H2O_TPU_HBM_LIMIT_BYTES`` pins the PRE-reservation value exactly (no
+    residency adjustment — tests mock budgets with it); None when no
+    accelerator budget is resolvable (planners fall back to their own
+    conservative defaults)."""
     env = knobs.raw("H2O_TPU_HBM_LIMIT_BYTES")
     if env and int(env) > 0:  # 0 = backend resolution (optargs contract)
         return int(env)
     hw = device_hbm_bytes()
     if not hw:
         return None
-    return max(int(hw * 0.85) - CLEANER.tracked_bytes(), hw >> 4)
+    return max(int(hw * 0.85) - CLEANER.tracked_bytes() - reserved_bytes(),
+               hw >> 4)
+
+
+# ---------------------------------------------------------------------------
+# reservation ledger — the serving control plane's quota hook
+# ---------------------------------------------------------------------------
+#: owner -> bytes reserved out of the shared HBM pool. The serving control
+#: plane (serving/control.py) reserves each PLACED model's estimated
+#: residency here, so training planners (hbm_budget_bytes) and the Cleaner's
+#: sweep threshold (Cleaner.limit_bytes) both see serving occupancy through
+#: the ONE existing accounting instead of a parallel serving-only ledger.
+_RESERVATIONS: dict[str, int] = {}
+_RES_LOCK = threading.Lock()
+
+
+def reserve_bytes(owner: str, nbytes: int) -> None:
+    """Reserve ``nbytes`` of the shared HBM pool under ``owner`` (replacing
+    any prior reservation for the same owner)."""
+    with _RES_LOCK:
+        _RESERVATIONS[owner] = max(int(nbytes), 0)
+
+
+def release_bytes(owner: str) -> int:
+    """Drop ``owner``'s reservation; returns the bytes freed (0 if none)."""
+    with _RES_LOCK:
+        return _RESERVATIONS.pop(owner, 0)
+
+
+def reserved_bytes() -> int:
+    """Total outstanding reservations (0 when serving placed nothing)."""
+    with _RES_LOCK:
+        return sum(_RESERVATIONS.values())
 
 
 def _vec_nbytes(arr) -> int:
@@ -134,6 +168,18 @@ class Cleaner:
 
     # -- budget ---------------------------------------------------------------
     def limit_bytes(self) -> int | None:
+        """Sweep threshold for tracked Vec residency: the resolved HBM
+        budget minus outstanding serving reservations (floored at 1/16 of
+        the base so a quota-heavy fleet can't drive the Cleaner into a
+        spill storm) — frames yield HBM to placed serving models through
+        the same ledger planners read."""
+        base = self._base_limit_bytes()
+        if base is None:
+            return None
+        res = reserved_bytes()
+        return max(base - res, base >> 4) if res else base
+
+    def _base_limit_bytes(self) -> int | None:
         env = knobs.raw("H2O_TPU_HBM_LIMIT_BYTES")
         if env and int(env) > 0:  # 0 = backend resolution (optargs contract)
             telemetry.set_gauge("cleaner.hbm.limit.bytes", int(env))
@@ -323,3 +369,11 @@ class Cleaner:
 
 #: process-global Cleaner (the `H2O.CLEANER` role)
 CLEANER = Cleaner()
+
+
+def base_hbm_limit_bytes() -> int | None:
+    """The resolved HBM budget BEFORE reservation subtraction — the number
+    the serving control plane takes its quota fraction of (taking it from
+    the post-reservation limit would shrink serving's own quota as serving
+    places models: a feedback loop, not an accounting)."""
+    return CLEANER._base_limit_bytes()

@@ -1,4 +1,4 @@
-"""Online scoring runtime — micro-batched, shape-bucketed, backpressured.
+"""Online scoring runtime + serving control plane.
 
 The inference-stack counterpart of the batched training driver: per-model
 shape-bucketed AOT-compiled scorers (zero steady-state XLA compiles),
@@ -6,17 +6,28 @@ a bounded micro-batching scheduler with deadlines and backpressure, and a
 stats surface — wired to REST as ``POST /3/Serving/models/{id}``,
 ``POST /3/Serving/score`` and ``GET /3/Serving/stats`` (`api/server.py`).
 
-See `runtime.py` for the architecture overview; README "Online scoring"
-for the operator-facing contract and knobs.
+Since PR 8 the runtime is fleet-operable: `control.py` adds HBM placement
+with admission quotas and hot/cold priority classes, replica scorers
+across mesh devices with least-loaded dispatch, and `router.py` adds
+weighted + canary endpoint routing with shadow traffic and divergence
+stats (``/3/Serving/routes``).
+
+See `runtime.py` for the architecture overview; README "Online scoring" /
+"Serving control plane" for the operator-facing contract and knobs.
 """
 
-from .errors import (DeadlineExceededError, ModelNotRegisteredError,
-                     QueueFullError, ServingError, ServingShutdownError,
+from .control import ControlPlane, ReplicaSet, estimate_model_bytes
+from .errors import (AdmissionError, DeadlineExceededError,
+                     ModelNotRegisteredError, QueueFullError,
+                     RouteNotFoundError, ServingError, ServingShutdownError,
                      UnsupportedModelError)
+from .router import Router
 from .runtime import ServedModel, ServingRuntime, get_runtime
 
 __all__ = [
     "ServingRuntime", "ServedModel", "get_runtime",
+    "ControlPlane", "ReplicaSet", "Router", "estimate_model_bytes",
     "ServingError", "ModelNotRegisteredError", "UnsupportedModelError",
     "QueueFullError", "DeadlineExceededError", "ServingShutdownError",
+    "AdmissionError", "RouteNotFoundError",
 ]

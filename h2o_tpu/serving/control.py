@@ -1,0 +1,335 @@
+"""Serving control plane — placement, admission quotas, replica dispatch.
+
+PR 4's runtime made ONE model fast; this module makes a FLEET operable.
+Three cooperating pieces, the classic model-server control plane (Clipper's
+adaptive model selection, TF-Serving's version manager) rebuilt on this
+repo's substrate:
+
+- **placement + admission** (`ControlPlane`): every registration carries an
+  HBM cost estimate (`estimate_model_bytes` — the model's device-resident
+  parameter arrays plus each compiled bucket's padded input/output working
+  set, × replicas). The fleet may reserve at most
+  ``H2O_TPU_SERVING_QUOTA_FRACTION`` of the resolved Cleaner HBM budget
+  (`backend/memory.py` — the SAME accounting training planners read;
+  placed bytes are reserved there, so frames yield residency to serving and
+  vice versa, no second ledger). Two priority classes: ``hot`` pins
+  residency for the life of the registration; ``cold`` placements are
+  evicted (compiled executables dropped, reservation released) under quota
+  pressure and lazily re-placed — paying their bucket compiles again — on
+  first hit. A registration that cannot fit even after evicting every cold
+  placement is refused with the typed :class:`AdmissionError` (REST: 429 +
+  Retry-After), and co-registered models keep scoring untouched.
+
+- **replica scorers** (`Replica`/`ReplicaSet`): a model may place N
+  replicas, each a `CompiledScorer` pinned to its own mesh device with its
+  own `MicroBatcher` lane. Dispatch is least-loaded by LIVE batcher queue
+  depth (the occupancy state the batcher already keeps). A replica whose
+  score path faults (the `serving.replica` failpoint stands in for a real
+  device loss) is marked dead at the point of failure: no new request is
+  routed to it, its queued work drains, and the requests in the poisoned
+  batch are transparently re-dispatched to a healthy replica (scoring is
+  read-only — replay is safe).
+
+Failpoints: ``serving.place`` fires before placement compiles (arm
+``raise(oom)`` to drill the placement-OOM admission path), and
+``serving.replica`` fires per replica device call (arm ``raise@K`` to kill
+the replica executing the K-th call).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import failpoints, knobs, telemetry
+from .batcher import MicroBatcher
+from .errors import (AdmissionError, DeadlineExceededError, QueueFullError,
+                     ServingShutdownError)
+
+
+def quota_fraction() -> float:
+    """The serving fleet's share of the resolved HBM budget (knob)."""
+    try:
+        frac = float(knobs.get_str("H2O_TPU_SERVING_QUOTA_FRACTION"))
+    except ValueError:
+        frac = 0.35
+    return min(max(frac, 0.0), 1.0)
+
+
+def estimate_model_bytes(model, buckets, n_features: int,
+                         replicas: int = 1) -> int:
+    """Per-model HBM cost estimate at registration: the model's
+    device-resident parameter arrays (walked exactly like the bench sync
+    contract — `utils/blocking.device_arrays`) plus each compiled bucket's
+    padded f32 input + output working set, everything × replicas (each
+    replica holds its own executables and runs its own padded batches)."""
+    from ..utils.blocking import device_arrays
+
+    params = sum(a.size * a.dtype.itemsize for a in device_arrays(model))
+    # per bucket: one (b, F) f32 input + a same-order output/scratch bound
+    working = sum(2 * b * max(int(n_features), 1) * 4 for b in buckets)
+    return (params + working) * max(int(replicas), 1)
+
+
+# ---------------------------------------------------------------------------
+# placement + admission
+# ---------------------------------------------------------------------------
+class Placement:
+    __slots__ = ("model_id", "priority", "replicas", "cost_bytes",
+                 "placed", "last_hit", "evictions")
+
+    def __init__(self, model_id: str, priority: str, replicas: int,
+                 cost_bytes: int):
+        self.model_id = model_id
+        self.priority = priority        # "hot" | "cold"
+        self.replicas = replicas
+        self.cost_bytes = int(cost_bytes)
+        self.placed = True
+        self.last_hit = time.monotonic()
+        self.evictions = 0              # observability: times deplaced
+
+    def info(self) -> dict:
+        return {"priority": self.priority, "replicas": self.replicas,
+                "cost_bytes": self.cost_bytes, "placed": self.placed,
+                "evictions": self.evictions}
+
+
+class ControlPlane:
+    """Fleet-wide placement ledger + admission gate.
+
+    ``deplacer`` (set by the runtime) is called OUTSIDE the ledger lock
+    with a model_id whose cold placement lost its residency — it drops the
+    model's compiled executables so the freed estimate is real."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._placements: dict[str, Placement] = {}
+        self.deplacer = None            # callable(model_id) -> None
+
+    # -- budget ---------------------------------------------------------------
+    def budget_bytes(self) -> int | None:
+        """Fleet quota: fraction × the PRE-reservation Cleaner budget
+        (`memory.base_hbm_limit_bytes`); None = no resolvable budget
+        (CPU without ``H2O_TPU_HBM_LIMIT_BYTES``) = admission is open."""
+        from ..backend import memory
+
+        base = memory.base_hbm_limit_bytes()
+        if base is None:
+            return None
+        return int(base * quota_fraction())
+
+    def placed_bytes(self) -> int:
+        with self._lock:
+            return sum(p.cost_bytes for p in self._placements.values()
+                       if p.placed)
+
+    # -- admission ------------------------------------------------------------
+    def admit(self, model_id: str, cost_bytes: int, priority: str,
+              replicas: int = 1) -> Placement:
+        """Place (or re-place) ``model_id`` under the fleet quota, evicting
+        cold placements LRU-by-last-hit if that makes it fit; raises the
+        typed :class:`AdmissionError` when it cannot."""
+        failpoints.hit("serving.place")
+        if priority not in ("hot", "cold"):
+            raise ValueError(f"unknown serving priority {priority!r} — "
+                             f"'hot' or 'cold'")
+        budget = self.budget_bytes()
+        from ..backend import memory
+
+        evict: list[str] = []
+        with self._lock:
+            prior = self._placements.get(model_id)
+            used = sum(p.cost_bytes for p in self._placements.values()
+                       if p.placed and p.model_id != model_id)
+            if budget is not None and used + cost_bytes > budget:
+                # cold placements yield, coldest hit first — never the
+                # model being admitted, never a hot pin
+                colds = sorted(
+                    (p for p in self._placements.values()
+                     if p.placed and p.priority == "cold"
+                     and p.model_id != model_id),
+                    key=lambda p: p.last_hit)
+                for p in colds:
+                    if used + cost_bytes <= budget:
+                        break
+                    p.placed = False
+                    p.evictions += 1
+                    used -= p.cost_bytes
+                    evict.append(p.model_id)
+                if used + cost_bytes > budget:
+                    for mid in evict:    # roll back: admission failed, the
+                        pl = self._placements[mid]   # colds keep serving
+                        pl.placed = True
+                        pl.evictions -= 1
+                    telemetry.inc("serving.admission.rejected.count")
+                    raise AdmissionError(model_id, cost_bytes, budget, used)
+            pl = Placement(model_id, priority, replicas, cost_bytes)
+            if prior is not None:
+                pl.evictions = prior.evictions
+            self._placements[model_id] = pl
+        for mid in evict:
+            telemetry.inc("serving.placement.evicted.count")
+            memory.release_bytes(f"serving:{mid}")
+            if self.deplacer is not None:
+                self.deplacer(mid)
+        memory.reserve_bytes(f"serving:{model_id}", cost_bytes)
+        return pl
+
+    def release(self, model_id: str) -> None:
+        from ..backend import memory
+
+        with self._lock:
+            self._placements.pop(model_id, None)
+        memory.release_bytes(f"serving:{model_id}")
+
+    def restore(self, placement: Placement) -> None:
+        """Reinstate a placement after a failed REPLACEMENT attempt: the
+        prior registration is still installed and serving, so its ledger
+        entry and reservation must survive the failed admit/warmup of its
+        would-be successor."""
+        from ..backend import memory
+
+        with self._lock:
+            self._placements[placement.model_id] = placement
+        memory.reserve_bytes(f"serving:{placement.model_id}",
+                             placement.cost_bytes)
+
+    def note_hit(self, model_id: str) -> None:
+        with self._lock:
+            p = self._placements.get(model_id)
+            if p is not None:
+                p.last_hit = time.monotonic()
+
+    def placement(self, model_id: str) -> Placement | None:
+        with self._lock:
+            return self._placements.get(model_id)
+
+    def snapshot(self) -> dict:
+        budget = self.budget_bytes()
+        with self._lock:
+            placements = {mid: p.info()
+                          for mid, p in sorted(self._placements.items())}
+            used = sum(p.cost_bytes for p in self._placements.values()
+                       if p.placed)
+        return {"budget_bytes": budget, "placed_bytes": used,
+                "quota_fraction": quota_fraction(),
+                "placements": placements}
+
+
+# ---------------------------------------------------------------------------
+# replica scorers — least-loaded dispatch with death detection
+# ---------------------------------------------------------------------------
+class Replica:
+    """One scorer lane: a (possibly device-pinned) scorer + its batcher.
+
+    The score wrapper is the death detector: ANY exception on the batch
+    score path (a real device loss, or the ``serving.replica`` failpoint
+    standing in for one) marks the replica dead at the point of failure —
+    shape/encoding errors can't reach here, the encoder and bucket pads
+    fix the matrix shape before submit."""
+
+    def __init__(self, idx: int, device, scorer, stats, cfg: dict,
+                 model_id: str):
+        self.idx = idx
+        self.device = device
+        self.scorer = scorer
+        self.dead = False
+        self.batcher = MicroBatcher(
+            f"{model_id}#r{idx}", self._score, stats,
+            max_batch=min(cfg["max_batch"], max(scorer.buckets)),
+            max_wait_us=cfg["max_wait_us"],
+            queue_depth=cfg["queue_depth"],
+            recompile_probe=lambda: scorer.fallback_compiles)
+
+    def _score(self, X):
+        try:
+            failpoints.hit("serving.replica")
+            return self.scorer.score(X)
+        except Exception:
+            if not self.dead:
+                self.dead = True
+                telemetry.inc("serving.replica.dead.count")
+            raise
+
+    def info(self) -> dict:
+        return {"replica": self.idx,
+                "device": str(self.device) if self.device is not None
+                else "default",
+                "queue_depth": self.batcher.depth,
+                "dead": self.dead}
+
+
+class ReplicaSet:
+    """N replica lanes behind one submit surface.
+
+    Dispatch picks the healthy replica with the smallest live queue depth
+    (ties break toward the lowest index — deterministic). A submit that
+    fails because its replica died mid-batch is re-dispatched once per
+    remaining healthy replica; typed backpressure (queue full, deadline)
+    propagates untouched — those are the CALLER's signals, not faults."""
+
+    def __init__(self, replicas: list[Replica]):
+        self.replicas = replicas
+
+    @property
+    def depth(self) -> int:
+        return sum(r.batcher.depth for r in self.replicas if not r.dead)
+
+    def healthy(self) -> list[Replica]:
+        return [r for r in self.replicas if not r.dead]
+
+    def pick(self) -> Replica | None:
+        alive = self.healthy()
+        if not alive:
+            return None
+        return min(alive, key=lambda r: (r.batcher.depth, r.idx))
+
+    def submit(self, X, deadline_s):
+        tried: set[int] = set()
+        last: Exception | None = None
+        while True:
+            alive = [r for r in self.healthy() if r.idx not in tried]
+            if not alive:
+                if last is not None:
+                    raise last
+                raise ServingShutdownError(
+                    "every replica of this serving model is dead")
+            rep = min(alive, key=lambda r: (r.batcher.depth, r.idx))
+            try:
+                return rep.batcher.submit(X, deadline_s)
+            except (QueueFullError, DeadlineExceededError):
+                raise                     # backpressure, not replica death
+            except Exception as e:        # noqa: BLE001 — reroute decision
+                if not rep.dead:
+                    raise                 # a non-death fault: surface it
+                tried.add(rep.idx)
+                last = e
+                telemetry.inc("serving.replica.reroute.count")
+
+    def pause(self) -> None:
+        for r in self.replicas:
+            r.batcher.pause()
+
+    def resume(self) -> None:
+        for r in self.replicas:
+            r.batcher.resume()
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.batcher.stop()
+
+    def info(self) -> list[dict]:
+        return [r.info() for r in self.replicas]
+
+
+def replica_devices(n: int) -> list:
+    """Round-robin device placement for ``n`` replicas. A single replica
+    keeps the backend-default placement (None) — today's single-model path
+    byte-for-byte; multi-replica sets pin one device each so two replicas
+    never contend for the same chip."""
+    if n <= 1:
+        return [None]
+    import jax
+
+    devs = jax.devices()
+    return [devs[i % len(devs)] for i in range(n)]

@@ -45,6 +45,39 @@ class QueueFullError(ServingError):
         self.retry_after_s = retry_after_s
 
 
+class AdmissionError(ServingError):
+    """The fleet HBM quota cannot place this model, even after evicting
+    every cold placement — retryable-later, not a server fault.
+
+    ``retry_after_s`` is a crude drain suggestion: quota pressure clears
+    when a registration is dropped or a cold model ages out, both
+    operator-timescale events, so the estimate is deliberately coarse."""
+
+    def __init__(self, model_id: str, cost_bytes: int, budget_bytes: int,
+                 used_bytes: int, retry_after_s: float = 5.0):
+        super().__init__(
+            f"serving quota cannot place '{model_id}': needs "
+            f"{cost_bytes} B but only {max(budget_bytes - used_bytes, 0)} "
+            f"of {budget_bytes} B remain (H2O_TPU_SERVING_QUOTA_FRACTION) "
+            f"— unregister or demote a model to 'cold', or raise the "
+            f"quota")
+        self.model_id = model_id
+        self.cost_bytes = cost_bytes
+        self.budget_bytes = budget_bytes
+        self.used_bytes = used_bytes
+        self.retry_after_s = retry_after_s
+
+
+class RouteNotFoundError(ServingError, KeyError):
+    def __init__(self, endpoint: str):
+        super().__init__(f"no serving route '{endpoint}' — create it via "
+                         f"POST /3/Serving/routes/{endpoint} first")
+        self.endpoint = endpoint
+
+    def __str__(self):  # KeyError would repr() the message
+        return self.args[0]
+
+
 class DeadlineExceededError(ServingError, TimeoutError):
     """The request's deadline expired before its batch was scored."""
 

@@ -1,0 +1,335 @@
+"""Weighted + canary routing — one logical endpoint, many model variants.
+
+The last piece of the control plane: clients score against a ROUTE
+(`POST /3/Serving/routes/{endpoint}` maps an endpoint name onto weighted
+variants — e.g. champion 0.99 / canary 0.01), and the router picks the
+serving variant per request with a DETERMINISTIC seeded split: variant
+choice is a pure function of ``(route seed, request ordinal)`` via a
+splitmix64 hash, so a fixed seed replays the exact same variant sequence
+(the split-count tests pin this) while the long-run fractions converge to
+the weights. No RNG state, no lock on the choice itself.
+
+**Shadow traffic**: a variant marked ``shadow`` scores the SAME rows as
+the serving variant, off the response path — a single bounded background
+worker drains shadow jobs, the response is returned before (and entirely
+independent of) shadow scoring, and the shadow queue sheds load by
+dropping jobs (counted) rather than ever blocking a caller. Shadow
+results feed per-variant DIVERGENCE stats: per-row |prediction delta|
+against the primary's predictions (histogram through the PR 6 registry +
+a per-variant window surfaced in ``GET /3/Serving/routes``), plus a
+disagreement counter for categorical label flips — the canary drift
+monitor.
+
+The router holds model IDs, not model objects: a variant resolves through
+the runtime at request time, so re-registration (or a canary's cold
+re-placement) is picked up with no route edit, and deleting a model makes
+its routes fail loudly with the model-not-registered 404.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..utils import knobs, telemetry
+from .errors import RouteNotFoundError
+
+
+def _splitmix64(x: int) -> int:
+    """splitmix64 finalizer — the standard 64-bit avalanche (same family
+    the mesh RNG folding uses); pure integer math, no numpy RNG object."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return z ^ (z >> 31)
+
+
+def _unit(seed: int, ordinal: int) -> float:
+    """Deterministic uniform in [0, 1) for request ``ordinal`` under
+    ``seed`` — the whole split policy, auditable in four lines."""
+    h = _splitmix64((seed & 0xFFFFFFFFFFFFFFFF) ^
+                    _splitmix64(ordinal & 0xFFFFFFFFFFFFFFFF))
+    return (h >> 11) / float(1 << 53)
+
+
+def _pred_scalar(pred: dict) -> float:
+    """One comparable number per prediction dict: P(class 0) for
+    classifiers (delta in probability space, not label space), the value
+    for regression, the cluster index for clustering."""
+    probs = pred.get("classProbabilities")
+    if probs:
+        return float(probs[0])
+    if "value" in pred:
+        return float(pred["value"])
+    if "cluster" in pred:
+        return float(pred["cluster"])
+    vals = pred.get("values")
+    return float(vals[0]) if vals else float("nan")
+
+
+def _pred_label(pred: dict):
+    return pred.get("label", pred.get("cluster"))
+
+
+class Variant:
+    __slots__ = ("model_id", "weight", "shadow", "requests", "rows",
+                 "shadow_rows", "disagreements", "_deltas", "_lock")
+
+    def __init__(self, model_id: str, weight: float, shadow: bool,
+                 window: int = 1024):
+        self.model_id = model_id
+        self.weight = float(weight)
+        self.shadow = bool(shadow)
+        self.requests = 0               # times picked as the serving variant
+        self.rows = 0
+        self.shadow_rows = 0            # rows this variant shadow-scored
+        self.disagreements = 0          # label flips vs the primary
+        self._deltas: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def note_served(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+
+    def note_shadow(self, deltas: list[float], disagreements: int) -> None:
+        with self._lock:
+            self.shadow_rows += len(deltas)
+            self.disagreements += disagreements
+            self._deltas.extend(deltas)
+
+    def stats(self) -> dict:
+        with self._lock:
+            deltas = np.asarray(self._deltas, dtype=np.float64)
+            out = {
+                "model_id": self.model_id, "weight": self.weight,
+                "shadow": self.shadow, "requests": self.requests,
+                "rows": self.rows, "shadow_rows": self.shadow_rows,
+                "disagreements": self.disagreements,
+            }
+        if deltas.size:
+            p50, p95, p99 = (float(v) for v in
+                             np.percentile(deltas, (50, 95, 99)))
+            out["divergence"] = {
+                "window": int(deltas.size),
+                "mean": round(float(deltas.mean()), 9),
+                "p50": round(p50, 9), "p95": round(p95, 9),
+                "p99": round(p99, 9), "max": round(float(deltas.max()), 9),
+            }
+        else:
+            out["divergence"] = None
+        return out
+
+
+class Route:
+    def __init__(self, endpoint: str, variants: list[Variant], seed: int):
+        import math
+
+        for v in variants:
+            # a negative weight would push a cumulative edge past 1 and
+            # starve a variant silently; a NaN poisons every comparison —
+            # both must be a loud 400, not a quietly-wrong split
+            if not math.isfinite(v.weight) or v.weight < 0:
+                raise ValueError(
+                    f"route '{endpoint}': variant '{v.model_id}' has "
+                    f"invalid weight {v.weight!r} (must be finite and "
+                    f">= 0)")
+        if not any(v.weight > 0 for v in variants if not v.shadow):
+            raise ValueError(
+                f"route '{endpoint}' needs at least one serving variant "
+                f"with weight > 0 (shadow variants never serve)")
+        self.endpoint = endpoint
+        self.variants = variants
+        self.seed = int(seed)
+        self.created_at = time.time()
+        self._ordinal = 0
+        self._lock = threading.Lock()
+        total = sum(v.weight for v in variants if not v.shadow)
+        #: cumulative weight edges over the serving (non-shadow) variants
+        self._serving = [v for v in variants if not v.shadow]
+        acc, self._edges = 0.0, []
+        for v in self._serving:
+            acc += v.weight / total
+            self._edges.append(acc)
+        self._edges[-1] = 1.0 + 1e-12   # float-sum slack: u<1 always lands
+
+    def pick(self) -> tuple[Variant, int]:
+        """The serving variant for the next request (deterministic in
+        arrival order) and the request's ordinal."""
+        with self._lock:
+            ordinal = self._ordinal
+            self._ordinal += 1
+        u = _unit(self.seed, ordinal)
+        for v, edge in zip(self._serving, self._edges):
+            if u < edge:
+                return v, ordinal
+        return self._serving[-1], ordinal        # unreachable (slack edge)
+
+    def shadows_for(self, primary: Variant) -> list[Variant]:
+        """Every variant that shadow-scores this request: explicit shadow
+        variants, never the one that just served (self-divergence is 0)."""
+        return [v for v in self.variants
+                if v.shadow and v.model_id != primary.model_id]
+
+    def stats(self) -> dict:
+        with self._lock:
+            ordinal = self._ordinal
+        return {"endpoint": self.endpoint, "seed": self.seed,
+                "requests": ordinal,
+                "variants": [v.stats() for v in self.variants]}
+
+
+class Router:
+    """Route table + the shadow worker, owned by one ServingRuntime."""
+
+    #: bounded shadow backlog — beyond it, shadow jobs DROP (counted);
+    #: shadow is observability, it must never become backpressure
+    SHADOW_QUEUE = 256
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+        self._routes: dict[str, Route] = {}
+        self._lock = threading.Lock()
+        self._shadow_q: deque = deque()
+        self._shadow_cv = threading.Condition()
+        self._shadow_stop = False
+        self._shadow_busy = False
+        self._shadow_worker: threading.Thread | None = None
+
+    # -- route table ---------------------------------------------------------
+    def create_route(self, endpoint: str, variants: list[dict],
+                     seed: int | None = None) -> dict:
+        """Create/replace a route. ``variants`` are dicts with ``model_id``,
+        ``weight`` (serving share; ignored for shadows) and optional
+        ``shadow``. Every named model must already be registered."""
+        if not variants:
+            raise ValueError("a route needs at least one variant")
+        built = []
+        for v in variants:
+            mid = v.get("model_id")
+            if not mid:
+                raise ValueError("every route variant needs a model_id")
+            self._runtime.model(mid)        # 404s on unknown models NOW
+            built.append(Variant(mid, float(v.get("weight", 0.0)),
+                                 bool(v.get("shadow", False))))
+        if seed is None:
+            seed = knobs.get_int("H2O_TPU_SERVING_ROUTE_SEED")
+        route = Route(endpoint, built, seed)
+        with self._lock:
+            self._routes[endpoint] = route
+        return route.stats()
+
+    def delete_route(self, endpoint: str) -> None:
+        with self._lock:
+            if self._routes.pop(endpoint, None) is None:
+                raise RouteNotFoundError(endpoint)
+
+    def route(self, endpoint: str) -> Route:
+        with self._lock:
+            r = self._routes.get(endpoint)
+        if r is None:
+            raise RouteNotFoundError(endpoint)
+        return r
+
+    def endpoints(self) -> list[str]:
+        with self._lock:
+            return list(self._routes)
+
+    def stats(self, endpoint: str | None = None) -> dict:
+        if endpoint is not None:
+            return self.route(endpoint).stats()
+        with self._lock:
+            routes = list(self._routes.values())
+        return {"routes": [r.stats() for r in routes]}
+
+    # -- request path --------------------------------------------------------
+    def score(self, endpoint: str, rows: list, deadline_ms=None) -> tuple:
+        """Score ``rows`` through the endpoint's picked variant; returns
+        ``(predictions, variant_model_id)``. Shadow scoring of the same
+        rows is enqueued AFTER the primary result exists and cannot touch
+        it — the bit-parity contract is structural, not best-effort."""
+        route = self.route(endpoint)
+        variant, _ = route.pick()
+        preds = self._runtime.score(variant.model_id, rows,
+                                    deadline_ms=deadline_ms)
+        variant.note_served(len(rows))
+        telemetry.inc("serving.route.count")
+        shadows = route.shadows_for(variant)
+        if shadows and knobs.get_bool("H2O_TPU_SERVING_SHADOW"):
+            self._enqueue_shadow(route, variant, shadows, rows, preds)
+        return preds, variant.model_id
+
+    # -- shadow path ---------------------------------------------------------
+    def _enqueue_shadow(self, route, primary, shadows, rows, preds) -> None:
+        with self._shadow_cv:
+            if self._shadow_stop:
+                return
+            if len(self._shadow_q) >= self.SHADOW_QUEUE:
+                telemetry.inc("serving.route.shadow.dropped.count")
+                return
+            # primary predictions ride along BY VALUE: the shadow compare
+            # can never reach back into the response
+            self._shadow_q.append((route, primary, shadows,
+                                   list(rows), list(preds)))
+            if self._shadow_worker is None:
+                self._shadow_worker = threading.Thread(
+                    target=self._shadow_run, daemon=True,
+                    name="h2o-serving-shadow")
+                self._shadow_worker.start()
+            self._shadow_cv.notify()
+
+    def _shadow_run(self) -> None:
+        while True:
+            with self._shadow_cv:
+                self._shadow_busy = False
+                self._shadow_cv.notify_all()     # drain_shadow waiters
+                while not self._shadow_q and not self._shadow_stop:
+                    self._shadow_cv.wait()
+                if self._shadow_stop and not self._shadow_q:
+                    return
+                route, primary, shadows, rows, preds = \
+                    self._shadow_q.popleft()
+                self._shadow_busy = True
+            base = [_pred_scalar(p) for p in preds]
+            base_labels = [_pred_label(p) for p in preds]
+            for v in shadows:
+                try:
+                    sh = self._runtime.score(v.model_id, rows)
+                except Exception:   # model gone / overloaded: shadow work
+                    continue        # is droppable by definition
+                deltas = [abs(_pred_scalar(p) - b)
+                          for p, b in zip(sh, base)]
+                dis = sum(1 for p, lb in zip(sh, base_labels)
+                          if _pred_label(p) != lb)
+                v.note_shadow(deltas, dis)
+                telemetry.inc("serving.route.shadow.rows", len(deltas))
+                for d in deltas:
+                    telemetry.observe("serving.route.divergence", d)
+
+    def drain_shadow(self, timeout_s: float = 10.0) -> bool:
+        """Block until the shadow queue is empty AND the worker is idle
+        (tests pin divergence stats after this; True on drained)."""
+        deadline = time.monotonic() + timeout_s
+        with self._shadow_cv:
+            while self._shadow_q or self._shadow_busy:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._shadow_cv.wait(left)
+            return True
+
+    def shutdown(self) -> None:
+        with self._shadow_cv:
+            self._shadow_stop = True
+            self._shadow_q.clear()
+            self._shadow_cv.notify_all()
+        w = self._shadow_worker
+        if w is not None:
+            w.join(timeout=5.0)
+
+
+__all__ = ["Router", "Route", "Variant"]

@@ -17,6 +17,14 @@ host work and parallelizes across request threads), submit to the batcher,
 format the scored rows into the typed per-category prediction dicts the
 REST layer returns verbatim.
 
+Since the control plane (PR 8), registration rides admission: the
+`ControlPlane` (`control.py`) estimates the model's HBM cost, checks it
+against the fleet quota (evicting cold placements if that makes it fit),
+and reserves the bytes in `backend/memory.py`'s ledger; a model may place
+N replica scorers across mesh devices (`ReplicaSet` — least-loaded
+dispatch, dead replicas routed around), and `cold`-priority models that
+lost their placement lazily re-place on first hit.
+
 A module-level singleton (`get_runtime`) backs the REST routes; tests
 build private instances.
 """
@@ -28,9 +36,10 @@ import time
 
 import numpy as np
 
-from ..utils import knobs
-from .batcher import MicroBatcher
-from .errors import ModelNotRegisteredError
+from ..utils import knobs, telemetry
+from .control import (ControlPlane, Replica, ReplicaSet,
+                      estimate_model_bytes, replica_devices)
+from .errors import AdmissionError, ModelNotRegisteredError
 from .scorer import CompiledScorer, HostScorer, bucket_sizes
 from .stats import ServingStats
 
@@ -53,14 +62,20 @@ def _cfg(overrides: dict | None) -> dict:
                            else knobs.get_int("H2O_TPU_SERVING_DEADLINE_MS")),
         "stats_window": int(o.get("stats_window")
                             or knobs.get_int("H2O_TPU_SERVING_STATS_WINDOW")),
+        "priority": str(o.get("priority")
+                        or knobs.get_str("H2O_TPU_SERVING_PRIORITY")),
+        "replicas": int(o.get("replicas")
+                        or knobs.get_int("H2O_TPU_SERVING_REPLICAS")),
     }
 
 
 class ServedModel:
-    def __init__(self, model_id: str, scorer, encoder, category: str,
+    def __init__(self, model_id: str, make_scorer, encoder, category: str,
                  response_domain, cfg: dict, source: str):
         self.model_id = model_id
-        self.scorer = scorer
+        #: ``device -> scorer`` factory — kept so a cold model that lost
+        #: its placement can rebuild and re-warm its scorers on first hit
+        self.make_scorer = make_scorer
         self.encoder = encoder
         self.category = category
         self.response_domain = response_domain
@@ -68,23 +83,69 @@ class ServedModel:
         self.source = source
         self.registered_at = time.time()
         self.stats = ServingStats(window=cfg["stats_window"])
-        self.batcher = MicroBatcher(
-            model_id, scorer.score, self.stats,
-            max_batch=min(cfg["max_batch"], max(scorer.buckets)),
-            max_wait_us=cfg["max_wait_us"],
-            queue_depth=cfg["queue_depth"],
-            recompile_probe=lambda: scorer.fallback_compiles)
+        self._place_lock = threading.Lock()
+        self._control: ControlPlane | None = None  # set by _install
+        devices = replica_devices(cfg["replicas"])
+        self.replicas = ReplicaSet([
+            Replica(i, dev, make_scorer(dev), self.stats, cfg, model_id)
+            for i, dev in enumerate(devices)])
+
+    @property
+    def scorer(self):
+        """Replica 0's scorer (the single-replica surface tests/info use)."""
+        return self.replicas.replicas[0].scorer
+
+    @property
+    def batcher(self):
+        """Replica 0's batcher — the pre-replica surface (pause/depth in
+        the PR 4 tests); multi-replica control goes through `replicas`."""
+        return self.replicas.replicas[0].batcher
+
+    @property
+    def depth(self) -> int:
+        return self.replicas.depth
+
+    def warmup(self) -> int:
+        return sum(r.scorer.warmup() for r in self.replicas.replicas)
+
+    # -- placement (control-plane hooks) -------------------------------------
+    def deplace(self) -> None:
+        """Drop every replica's compiled executables (cold eviction)."""
+        for r in self.replicas.replicas:
+            r.scorer.evict()
+
+    def ensure_placed(self) -> None:
+        """Lazy re-placement of an evicted cold model on first hit: re-admit
+        under the quota (429 if the fleet is hot-crowded) and re-pay the
+        bucket compiles. No-op (one placed-flag read) while placed."""
+        control = self._control
+        if control is None:
+            return
+        pl = control.placement(self.model_id)
+        if pl is None or pl.placed:
+            # None: unregistered under a stale handle — nothing to place
+            return
+        with self._place_lock:
+            pl = control.placement(self.model_id)
+            if pl is None or pl.placed:
+                return                     # raced another request's re-place
+            control.admit(self.model_id, pl.cost_bytes,
+                          self.cfg["priority"], self.cfg["replicas"])
+            self.warmup()
 
     # -- request path --------------------------------------------------------
     def score_rows(self, rows: list, deadline_ms=None) -> list:
         if not rows:
             return []
         t0 = time.perf_counter()
+        self.ensure_placed()
+        if self._control is not None:
+            self._control.note_hit(self.model_id)
         X = self.encoder.encode(rows)
         if deadline_ms is None:
             deadline_ms = self.cfg["deadline_ms"]
         deadline_s = None if not deadline_ms else float(deadline_ms) / 1e3
-        out = self.batcher.submit(X, deadline_s)
+        out = self.replicas.submit(X, deadline_s)
         preds = self._format(np.asarray(out))
         self.stats.observe_request(time.perf_counter() - t0, len(rows))
         return preds
@@ -108,7 +169,7 @@ class ServedModel:
         return [{"values": [float(v) for v in r]} for r in np.atleast_2d(out)]
 
     def info(self) -> dict:
-        return {
+        out = {
             "model_id": self.model_id,
             "source": self.source,
             "category": self.category,
@@ -120,36 +181,58 @@ class ServedModel:
             "queue_depth": self.batcher.queue_depth,
             "deadline_ms": self.cfg["deadline_ms"],
             "warmup_compiles": self.scorer.warmup_compiles,
+            "replicas": self.replicas.info(),
         }
+        if self._control is not None:
+            pl = self._control.placement(self.model_id)
+            if pl is not None:
+                out["placement"] = pl.info()
+        return out
 
     def shutdown(self) -> None:
-        self.batcher.stop()
+        self.replicas.stop()
 
 
 class ServingRuntime:
     def __init__(self):
+        from .router import Router
+
         self._models: dict[str, ServedModel] = {}
         self._lock = threading.Lock()
+        self.control = ControlPlane()
+        self.control.deplacer = self._deplace
+        self.router = Router(self)
 
     # -- registration --------------------------------------------------------
     def register_model(self, model, model_id: str | None = None,
                        overrides: dict | None = None,
                        strict_levels: bool = False) -> dict:
         """Register an in-STORE engine model: jit bucket scorers over its
-        ``score_raw`` matrix path, warmed up before this returns."""
+        ``score_raw`` matrix path — admitted under the fleet quota, placed
+        (one replica per configured device), warmed up before this
+        returns."""
         from ..mojo.easy import RowEncoder
 
         model_id = model_id or model.key
         cfg = _cfg(overrides)
-        scorer = CompiledScorer(model, buckets=cfg["buckets"])
-        scorer.warmup()
+        # validate the scorer contract BEFORE admission reserves anything
+        # (CompiledScorer's constructor refuses adapt_frame-overriders and
+        # frozen encodings) — build replica 0 eagerly, the rest on install
+        probe = CompiledScorer(model, buckets=cfg["buckets"])
+        del probe
         encoder = RowEncoder(
             model.output.names,
             [model.output.domains.get(n) for n in model.output.names],
             convert_unknown=not strict_levels, dtype=np.float32)
-        return self._install(ServedModel(
-            model_id, scorer, encoder, model.output.model_category,
-            model.output.response_domain, cfg, source=f"model:{model.key}"))
+        cost = estimate_model_bytes(model, cfg["buckets"],
+                                    len(model.output.names),
+                                    replicas=cfg["replicas"])
+        return self._admit_and_install(
+            model_id, cost, cfg,
+            lambda dev: CompiledScorer(model, buckets=cfg["buckets"],
+                                       device=dev),
+            encoder, model.output.model_category,
+            model.output.response_domain, source=f"model:{model.key}")
 
     def register_mojo(self, path_or_model, model_id: str | None = None,
                       overrides: dict | None = None,
@@ -164,13 +247,57 @@ class ServingRuntime:
         m = wrapper.model
         model_id = model_id or f"mojo_{m.algo}_{id(m) & 0xffff:04x}"
         cfg = _cfg(overrides)
-        scorer = HostScorer(m, len(wrapper._features), buckets=cfg["buckets"])
-        scorer.warmup()
-        return self._install(ServedModel(
-            model_id, scorer, wrapper.encoder, m.category,
-            wrapper._resp_domain, cfg,
+        nf = len(wrapper._features)
+        cost = estimate_model_bytes(m, cfg["buckets"], nf,
+                                    replicas=cfg["replicas"])
+        return self._admit_and_install(
+            model_id, cost, cfg,
+            lambda dev: HostScorer(m, nf, buckets=cfg["buckets"]),
+            wrapper.encoder, m.category, wrapper._resp_domain,
             source=(path_or_model if isinstance(path_or_model, str)
-                    else f"mojo:{m.algo}")))
+                    else f"mojo:{m.algo}"))
+
+    def _admit_and_install(self, model_id, cost, cfg, make_scorer, encoder,
+                           category, response_domain, source) -> dict:
+        """Admission → placement → warmup → install, with the OOM seam:
+        a device OOM during placement (real, or the `serving.place`
+        failpoint's ``raise(oom)``) unwinds the reservation and surfaces
+        as the SAME typed 429 an over-quota registration gets — the
+        co-registered fleet never notices."""
+        served = None
+        prior = self.control.placement(model_id)
+        try:
+            self.control.admit(model_id, cost, cfg["priority"],
+                               cfg["replicas"])
+            served = ServedModel(model_id, make_scorer, encoder, category,
+                                 response_domain, cfg, source=source)
+            served._control = self.control
+            served.warmup()
+        except Exception as e:
+            if served is not None:
+                served.shutdown()          # no leaked batcher threads
+            if prior is not None:
+                # a failed RE-registration must not strip the still-
+                # installed prior registration of its placement/reservation
+                self.control.restore(prior)
+            else:
+                self.control.release(model_id)
+            if isinstance(e, AdmissionError):
+                raise
+            if "RESOURCE_EXHAUSTED" in str(e):
+                telemetry.inc("serving.admission.rejected.count")
+                budget = self.control.budget_bytes()
+                raise AdmissionError(model_id, cost, budget or 0,
+                                     self.control.placed_bytes()) from e
+            raise
+        return self._install(served)
+
+    def _deplace(self, model_id: str) -> None:
+        """ControlPlane eviction hook: drop a cold model's executables."""
+        with self._lock:
+            served = self._models.get(model_id)
+        if served is not None:
+            served.deplace()
 
     def _install(self, served: ServedModel) -> dict:
         with self._lock:
@@ -185,6 +312,7 @@ class ServingRuntime:
             served = self._models.pop(model_id, None)
         if served is None:
             raise ModelNotRegisteredError(model_id)
+        self.control.release(model_id)
         served.shutdown()
 
     # -- lookup / request path ----------------------------------------------
@@ -205,17 +333,26 @@ class ServingRuntime:
     def stats(self, model_id: str | None = None) -> dict:
         if model_id is not None:
             served = self.model(model_id)
-            return served.stats.snapshot(queue_depth=served.batcher.depth)
+            return served.stats.snapshot(queue_depth=served.depth)
         with self._lock:
             models = dict(self._models)
-        return {mid: s.stats.snapshot(queue_depth=s.batcher.depth)
+        return {mid: s.stats.snapshot(queue_depth=s.depth)
                 for mid, s in models.items()}
 
+    def control_snapshot(self) -> dict:
+        """`GET /3/Serving/control` payload: quota, placements, routes."""
+        snap = self.control.snapshot()
+        snap["models"] = self.model_ids()
+        snap["routes"] = sorted(self.router.endpoints())
+        return snap
+
     def shutdown(self) -> None:
+        self.router.shutdown()
         with self._lock:
             models = list(self._models.values())
             self._models.clear()
         for served in models:
+            self.control.release(served.model_id)
             served.shutdown()
 
 
@@ -230,6 +367,71 @@ def get_runtime() -> ServingRuntime:
         if _RUNTIME is None:
             _RUNTIME = ServingRuntime()
         return _RUNTIME
+
+
+def _prometheus_model_lines() -> list[str]:
+    """Per-model label dimension for the Prometheus exposition: the
+    fleet-total ``h2o_tpu_serving_*`` series stay in the registry (one
+    accounting); these ``{model="..."}``-labelled families are generated
+    straight from the per-model stats windows of the SINGLETON runtime (a
+    test's private runtime must not leak into the process scrape)."""
+    rt = _RUNTIME
+    if rt is None:
+        return []
+    lines: list[str] = []
+
+    def esc(mid: str) -> str:
+        """Prometheus label-value escaping (backslash, quote, newline) —
+        serving ids are client-chosen, and one bad id must not make the
+        whole scrape unparseable."""
+        return (str(mid).replace("\\", r"\\").replace('"', r'\"')
+                .replace("\n", r"\n"))
+
+    snaps = sorted((esc(mid), s) for mid, s in rt.stats().items())
+    if not snaps:
+        return []
+
+    def fam(metric: str, kind: str, doc: str):
+        lines.append(f"# HELP h2o_tpu_serving_model_{metric} {doc}")
+        lines.append(f"# TYPE h2o_tpu_serving_model_{metric} {kind}")
+
+    fam("requests", "counter", "per-model scoring requests")
+    for mid, s in snaps:
+        lines.append(f'h2o_tpu_serving_model_requests{{model="{mid}"}} '
+                     f'{s["requests"]:g}')
+    fam("rows", "counter", "per-model rows scored")
+    for mid, s in snaps:
+        lines.append(f'h2o_tpu_serving_model_rows{{model="{mid}"}} '
+                     f'{s["rows"]:g}')
+    fam("rejected", "counter", "per-model backpressure rejections (429)")
+    for mid, s in snaps:
+        lines.append(f'h2o_tpu_serving_model_rejected{{model="{mid}"}} '
+                     f'{s["rejected"]:g}')
+    fam("timeouts", "counter", "per-model queued-deadline expiries (408)")
+    for mid, s in snaps:
+        lines.append(f'h2o_tpu_serving_model_timeouts{{model="{mid}"}} '
+                     f'{s["timeouts"]:g}')
+    fam("queue_depth", "gauge", "per-model live batcher queue depth")
+    for mid, s in snaps:
+        lines.append(f'h2o_tpu_serving_model_queue_depth{{model="{mid}"}} '
+                     f'{s["queue_depth"]:g}')
+    fam("latency_ms", "summary",
+        "per-model recent-window request latency percentiles")
+    for mid, s in snaps:
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            v = s["latency_ms"][key]
+            if v is not None:
+                lines.append(f'h2o_tpu_serving_model_latency_ms'
+                             f'{{model="{mid}",quantile="{q}"}} {v:g}')
+    fam("rows_per_s", "gauge",
+        "per-model recent scoring throughput (batch window)")
+    for mid, s in snaps:
+        lines.append(f'h2o_tpu_serving_model_rows_per_s{{model="{mid}"}} '
+                     f'{s["rows_per_s"]:g}')
+    return lines
+
+
+telemetry.add_prometheus_provider(_prometheus_model_lines)
 
 
 __all__ = ["ServingRuntime", "ServedModel", "get_runtime"]

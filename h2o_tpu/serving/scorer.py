@@ -47,10 +47,13 @@ def bucket_sizes(override=None) -> tuple[int, ...]:
 class _BucketedScorer:
     """Shared pad/chunk/mask logic over a per-bucket batch scorer."""
 
-    def __init__(self, n_features: int, buckets, dtype):
+    def __init__(self, n_features: int, buckets, dtype, device=None):
         self.n_features = int(n_features)
         self.buckets = bucket_sizes(buckets)
         self.dtype = dtype
+        #: optional pinned placement (replica scorers compile one
+        #: executable set per mesh device; None = backend default)
+        self.device = device
         self.warmup_compiles = 0
         #: cumulative bucket-miss fallbacks — each one IS a steady-state
         #: compile. This, not a global-counter delta, feeds the stats
@@ -65,6 +68,15 @@ class _BucketedScorer:
 
     def warmup(self) -> int:
         return 0
+
+    def evict(self) -> int:
+        """Drop placed executables (cold-priority eviction); returns the
+        compiles a re-placement will cost (0 for host scorers)."""
+        return 0
+
+    @property
+    def placed(self) -> bool:
+        return True
 
     def _bucket_of(self, n: int) -> int:
         for b in self.buckets:
@@ -96,9 +108,14 @@ class _BucketedScorer:
 
 
 class CompiledScorer(_BucketedScorer):
-    """Engine models: jit of ``model.score_raw`` AOT-compiled per bucket."""
+    """Engine models: jit of ``model.score_raw`` AOT-compiled per bucket.
 
-    def __init__(self, model, buckets=None):
+    ``device`` pins every executable (and every padded input) to one mesh
+    device — the replica-placement lever: N replicas of a model are N
+    CompiledScorers on N devices, and the executables CANNOT silently
+    migrate (a compiled object rejects mismatched shardings loudly)."""
+
+    def __init__(self, model, buckets=None, device=None):
         import jax
 
         from ..models.model_base import Model
@@ -120,7 +137,8 @@ class CompiledScorer(_BucketedScorer):
                 f"{type(model).__name__} was trained with a frozen "
                 f"categorical_encoding — its raw-matrix path needs the "
                 f"Frame-side encoding replay; register its MOJO instead")
-        super().__init__(len(model.output.names), buckets, np.float32)
+        super().__init__(len(model.output.names), buckets, np.float32,
+                         device=device)
         self._jit = jax.jit(model.score_raw)
         self._compiled: dict[int, object] = {}
 
@@ -129,27 +147,49 @@ class CompiledScorer(_BucketedScorer):
         zeros; returns (and records) the XLA compiles that cost. After
         this, `_score_bucket` never compiles — the executables are frozen.
         """
+        import contextlib
+
         import jax
         import jax.numpy as jnp
 
         before = compilemeter.count()
-        for b in self.buckets:
-            spec = jax.ShapeDtypeStruct((b, self.n_features), jnp.float32)
-            self._compiled[b] = self._jit.lower(spec).compile()
-            # one real execution per bucket: surfaces runtime-only errors
-            # (bad gather bounds, NaN traps) at registration, not under load
-            self._score_bucket(np.zeros((b, self.n_features), np.float32), b)
+        pin = (jax.default_device(self.device) if self.device is not None
+               else contextlib.nullcontext())
+        with pin:
+            for b in self.buckets:
+                spec = jax.ShapeDtypeStruct((b, self.n_features),
+                                            jnp.float32)
+                self._compiled[b] = self._jit.lower(spec).compile()
+                # one real execution per bucket: surfaces runtime-only
+                # errors (bad gather bounds, NaN traps) at registration,
+                # not under load
+                self._score_bucket(
+                    np.zeros((b, self.n_features), np.float32), b)
         self.warmup_compiles = compilemeter.count() - before
         return self.warmup_compiles
 
+    def evict(self) -> int:
+        """Drop the compiled executables (the cold-priority eviction hook):
+        the next warmup() re-pays the bucket compiles. Returns how many."""
+        n = len(self._compiled)
+        self._compiled.clear()
+        return n
+
+    @property
+    def placed(self) -> bool:
+        return bool(self._compiled)
+
     def _score_bucket(self, Xp: np.ndarray, b: int) -> np.ndarray:
+        import jax
         import jax.numpy as jnp
 
         fn = self._compiled.get(b)
         if fn is None:  # unreachable after warmup(); kept non-fatal so a
             fn = self._jit  # mis-sized bucket degrades to a counted compile
             self.fallback_compiles += 1
-        return np.asarray(fn(jnp.asarray(Xp)))
+        X = (jax.device_put(Xp, self.device) if self.device is not None
+             else jnp.asarray(Xp))
+        return np.asarray(fn(X))
 
 
 class HostScorer(_BucketedScorer):

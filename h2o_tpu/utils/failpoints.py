@@ -128,6 +128,14 @@ _failpoint("mrtask.dispatch",
 _failpoint("serving.batch",
            "serving/batcher.py worker, before the compiled scorer runs — "
            "a device fault fanned out to every coalesced request")
+_failpoint("serving.place",
+           "serving/control.py placement, before bucket scorers compile — "
+           "raise(oom) drills the placement-OOM admission path (typed "
+           "429 + Retry-After, co-registered models unaffected)")
+_failpoint("serving.replica",
+           "serving/control.py replica score path, per device call — "
+           "raise@K kills the replica executing the K-th call: it is "
+           "marked dead, drained, and dispatch routes around it")
 _failpoint("rest.route",
            "api/server.py request routing — http(code) specs make the "
            "server reply that status (429/503 with Retry-After), raise "

@@ -127,6 +127,34 @@ _knob("H2O_TPU_SERVING_STATS_WINDOW", "int", 2048,
       "ring-buffer length of the per-model latency/throughput window "
       "behind GET /3/Serving/stats")
 
+# -- serving control plane (h2o_tpu/serving/control.py + router.py) ----------
+_knob("H2O_TPU_SERVING_QUOTA_FRACTION", "str", "0.35",
+      "fraction of the resolved Cleaner HBM budget the serving fleet may "
+      "reserve for placed models (admission rejects registrations beyond "
+      "it with 429 + Retry-After); models place unlimited when no budget "
+      "resolves (CPU without H2O_TPU_HBM_LIMIT_BYTES)")
+_knob("H2O_TPU_SERVING_PRIORITY", "str", "hot",
+      "default placement priority class for registrations that don't pass "
+      "one: 'hot' pins residency (never evicted while registered), 'cold' "
+      "is evictable under quota pressure and lazily re-placed — paying "
+      "its bucket compiles again — on first hit")
+_knob("H2O_TPU_SERVING_REPLICAS", "int", 1,
+      "default replica scorers per registration; replicas are placed "
+      "round-robin across mesh devices and dispatched least-loaded by "
+      "live batcher queue depth")
+_knob("H2O_TPU_SERVING_ROUTE_SEED", "int", 42,
+      "default seed for a route's deterministic weighted split when the "
+      "route doesn't carry its own (same seed + same request order = "
+      "exactly the same variant sequence)")
+_knob("H2O_TPU_SERVING_SHADOW", "bool", True,
+      "master switch for shadow traffic: 0 skips off-response-path "
+      "shadow scoring (and divergence stats) even on routes that "
+      "configure shadow variants")
+_knob("H2O_TPU_CLIENT_KEEPALIVE", "bool", True,
+      "pool one persistent HTTP connection per client thread "
+      "(api/client.py), auto-reconnecting on a stale socket; 0 reverts "
+      "to one connection per request (the serving_wire bench baseline)")
+
 # -- fault tolerance (failpoints / auto-checkpoints / retry) ----------------
 _knob("H2O_TPU_FAILPOINTS", "str", "",
       "comma list of site:spec deterministic fault injections "
@@ -200,7 +228,8 @@ _knob("H2O_TPU_BENCH_AIRLINES_ROWS", "int", 116_000_000,
 _knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
       "rows for the binned-store stacked-vs-binned leg")
 _knob("H2O_TPU_BENCH_WORKLOADS", "str",
-      "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,recovery,airlines",
+      "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,serving_wire,"
+      "recovery,airlines",
       "comma list of bench workloads to run")
 _knob("H2O_TPU_BENCH_RECOVERY_ROWS", "int", 500_000,
       "rows for the recovery leg (checkpoint overhead + resume-to-parity)")
@@ -208,6 +237,9 @@ _knob("H2O_TPU_BENCH_SERVING_REQS", "int", 4000,
       "single-row requests issued by the concurrent serving bench leg")
 _knob("H2O_TPU_BENCH_SERVING_THREADS", "int", 16,
       "concurrent client threads for the serving bench leg")
+_knob("H2O_TPU_BENCH_WIRE_REQS", "int", 600,
+      "sequential single-row HTTP requests per wire mode (pooled / "
+      "per-request) in the serving_wire bench leg")
 _knob("H2O_TPU_BENCH_SKIP_CADENCE", "bool", False,
       "skip the score_tree_interval=10 GBM cadence leg")
 _knob("H2O_TPU_BENCH_SIDECAR", "str", "",

@@ -192,6 +192,28 @@ _counter("serving.timeout.count", "requests expired while queued (408)")
 _counter("serving.recompile.count",
          "steady-state scorer bucket-miss recompiles (contract: 0)")
 
+# -- serving control plane (serving/control.py + router.py) ------------------
+_counter("serving.admission.rejected.count",
+         "registrations/re-placements refused by the fleet HBM quota "
+         "(REST: 429 + Retry-After)")
+_counter("serving.placement.evicted.count",
+         "cold placements evicted under quota pressure (lazily re-placed "
+         "on first hit)")
+_counter("serving.replica.dead.count",
+         "replica scorers marked dead after a score-path fault")
+_counter("serving.replica.reroute.count",
+         "requests re-dispatched around a dying replica (contract: no "
+         "request fails because its replica died under it)")
+_counter("serving.route.count", "requests scored through a routed endpoint")
+_counter("serving.route.shadow.rows",
+         "rows shadow-scored off the response path")
+_counter("serving.route.shadow.dropped.count",
+         "shadow jobs dropped because the shadow queue was full (the "
+         "response path never blocks on shadow work)")
+_histogram("serving.route.divergence",
+           "per-row |prediction delta| between the serving variant and a "
+           "shadow variant over identical rows (canary drift monitor)")
+
 # -- REST control plane ------------------------------------------------------
 _counter("rest.request.count", "REST requests routed")
 _counter("rest.error.count", "REST requests answered with a 5xx")
@@ -345,6 +367,20 @@ def snapshot_delta(before: dict, after: dict | None = None) -> dict:
     return out
 
 
+#: extra exposition sources: callables returning pre-formatted Prometheus
+#: text lines. The registry itself stays label-free (fleet totals); a
+#: subsystem with a natural label dimension (serving's per-model stats
+#: windows) registers a provider instead of a second metrics registry.
+_PROM_PROVIDERS: list = []
+
+
+def add_prometheus_provider(fn) -> None:
+    """Register a ``() -> list[str]`` of exposition lines appended to
+    :func:`prometheus` output. Idempotent per callable."""
+    if fn not in _PROM_PROVIDERS:
+        _PROM_PROVIDERS.append(fn)
+
+
 def prometheus() -> str:
     """Prometheus text exposition (format 0.0.4) of the whole registry —
     dots become underscores, everything is prefixed ``h2o_tpu_``."""
@@ -374,6 +410,11 @@ def prometheus() -> str:
                     lines.append(f'{pname}{{quantile="{q}"}} {pc[key]:g}')
             lines.append(f"{pname}_sum {h.sum.value():g}")
             lines.append(f"{pname}_count {h.count.value():g}")
+    for provider in list(_PROM_PROVIDERS):
+        try:
+            lines.extend(provider())
+        except Exception:  # pragma: no cover — a sick provider must not
+            pass           # take down the whole scrape
     return "\n".join(lines) + "\n"
 
 
