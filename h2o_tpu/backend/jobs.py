@@ -63,6 +63,17 @@ def _note_job_finished() -> None:
 
         import jax
 
+        # the AOT train-step executables are held DIRECTLY (not through a
+        # jit cache), so jax.clear_caches() alone cannot release them —
+        # drop the dict first or the per-program XLA state this bound
+        # exists for re-accumulates through the AOT path. sys.modules
+        # lookup, not an import: a process that never trained trees has
+        # nothing to clear and must not pull the models stack in here.
+        import sys as _sys
+
+        gbm_mod = _sys.modules.get("h2o_tpu.models.gbm")
+        if gbm_mod is not None:
+            gbm_mod._AOT_STEP_CACHE.clear()
         gc.collect()
         jax.clear_caches()
         from ..utils.log import info

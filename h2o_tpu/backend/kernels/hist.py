@@ -1,0 +1,239 @@
+"""Fused level-histogram kernel — the ScoreBuildHistogram2 inner loop.
+
+One call accumulates, per shard, the (F, n_lv, B, V) channel histograms of
+one tree level from the chunk store's int8/int16 bin codes. Two backends
+share the per-block math (see the package docstring for the parity
+contract):
+
+- ``xla``: ``lax.scan`` over row blocks — the pre-kernels production path.
+- ``pallas``: ONE ``pl.pallas_call`` with the grid over row blocks; each
+  step DMAs a (rb, F) code block + (rb,) node ids + (rb, V) channel values
+  into VMEM, upcasts the sub-int32 codes there (the PR 2 discipline — the
+  narrow dtype exists only as an HBM storage format), and adds the block's
+  contribution into the VMEM-resident accumulator. The GPU tree-boosting
+  kernels (Booster / XGBoost gpu_hist) do exactly this with shared-memory
+  atomics; TPUs have no scatter unit, so the in-VMEM accumulate is
+  expressed as compare-mask contractions riding the MXU — the engine's
+  standard no-gather idiom, now fused into a single kernel instead of a
+  chain of HLO ops with HBM-visible intermediates.
+
+Width-bucketed ``groups`` (engine.plan_hist_groups) are first-class: the
+per-group column gather is hoisted out of the block loop (Pallas kernels
+cannot close over constant index arrays, and the narrow coded gather is
+cheap), each group accumulates at its own width, and ``mode="segsum"``
+groups keep their segment-sum formulation on the xla path while the kernel
+uses the same op under interpret — parity pinned either way. The caller
+(engine._build_level_hist) owns the psum and the grouped scatter-back;
+nothing in here touches a mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import hist_backend, interpret_mode, pow2_block_rows
+
+
+# ---------------------------------------------------------------------------
+# shared per-block contributions — the ONE definition both backends execute
+# ---------------------------------------------------------------------------
+def _node_outer(l, vv, n_lv: int):
+    """(rb, n_lv, V) per-row channel values routed to the row's node slot —
+    an outer product against the node one-hot (exact: one 1.0 per row)."""
+    n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)
+    return jnp.einsum("rn,rv->rnv", n_oh, vv)
+
+
+def _flat_contrib(xb, l, vv, n_lv: int, nbins_tot: int):
+    """One row block's (F, n_lv, B, V) contribution, flat bin space."""
+    # int8/int16 binned views upcast HERE, one block at a time in VMEM /
+    # in-scan: the accumulate below always sees int32 (graftlint
+    # narrow-int-accumulate pins the hazard), while HBM keeps 1-2 B/cell.
+    xb = xb.astype(jnp.int32)
+    a = _node_outer(l, vv, n_lv)
+    b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)   # (rb, F, B)
+    return jnp.einsum("rnv,rfb->fnbv", a, b_oh)
+
+
+def _one_group_contrib(xg, a, l, vv, Bg: int, mode: str, n_lv: int,
+                       na_global: int, segsum_ok: bool = True):
+    """One width bucket's (Fg, n_lv, Bg, V) block contribution. ``xg`` is
+    the group's already-gathered code block; the group NA bucket is its
+    last slot (global NA remaps here, scatter-back restores it).
+
+    ``segsum_ok`` gates the segment-sum formulation: the xla path and the
+    INTERPRETED pallas path use it (and stay bit-equal to each other), but
+    a Mosaic-COMPILED kernel body must not — segment_sum is a scatter, and
+    the TPU has no scatter unit to lower it onto, so on-chip the narrow
+    groups fall back to the compare-mask contraction (value-equivalent;
+    on-chip parity vs the on-chip xla path is the ROADMAP's real-v5e
+    measurement)."""
+    xg = xg.astype(jnp.int32)
+    Fg = xg.shape[1]
+    xg = jnp.where(xg == na_global, Bg - 1, xg)
+    if mode == "segsum" and segsum_ok:
+        # narrow-bin path: at Bg ≪ the 128-lane MXU tile the one-hot
+        # matmul is degenerate (mostly-padding tiles); a flat segment-sum
+        # over (feature, node, bin) keys accumulates the same cells with
+        # no one-hot at all (and in pure f32 adds — the matmul path rounds
+        # each contribution through bf16 on TPU, so this path is the
+        # *more* exact of the two). broadcasted_iota, not arange: a Pallas
+        # kernel body may not close over constant arrays.
+        fi = jax.lax.broadcasted_iota(jnp.int32, (1, Fg), 1)
+        seg = (fi * n_lv + l[:, None]) * Bg + xg              # (rb, Fg)
+        data = jnp.broadcast_to(vv[:, None, :],
+                                (xg.shape[0], Fg, vv.shape[1]))
+        h = jax.ops.segment_sum(
+            data.reshape(-1, vv.shape[1]), seg.reshape(-1),
+            num_segments=Fg * n_lv * Bg)
+        return h.reshape(Fg, n_lv, Bg, vv.shape[1])
+    b_oh = jax.nn.one_hot(xg, Bg, dtype=jnp.float32)
+    return jnp.einsum("rnv,rfb->fnbv", a, b_oh)
+
+
+def _group_contrib(xgs, l, vv, groups, n_lv: int, na_global: int,
+                   segsum_ok: bool = True):
+    a = _node_outer(l, vv, n_lv)   # shared across onehot groups — exact
+    return tuple(
+        _one_group_contrib(xg, a, l, vv, Bg, mode, n_lv, na_global,
+                           segsum_ok=segsum_ok)
+        for xg, (_idxs, Bg, mode) in zip(xgs, groups))
+
+
+# ---------------------------------------------------------------------------
+# xla backend — blocked lax.scan (the oracle)
+# ---------------------------------------------------------------------------
+def _xla_flat(Xb, lc, vv, n_lv, nbins_tot, rb):
+    Rl, F = Xb.shape
+    V = vv.shape[1]
+    nblk = Rl // rb
+
+    def body(acc, blk):
+        xb, l, v = blk
+        return acc + _flat_contrib(xb, l, v, n_lv, nbins_tot), None
+
+    init = jnp.zeros((F, n_lv, nbins_tot, V), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (Xb.reshape(nblk, rb, F),
+                                        lc.reshape(nblk, rb),
+                                        vv.reshape(nblk, rb, V)))
+    return hist
+
+
+def _xla_grouped(xgs, lc, vv, groups, n_lv, na_global, rb):
+    Rl = lc.shape[0]
+    V = vv.shape[1]
+    nblk = Rl // rb
+    xgs_r = [xg.reshape(nblk, rb, xg.shape[1]) for xg in xgs]
+
+    def body(accs, blk):
+        l, v, *xg = blk
+        cs = _group_contrib(xg, l, v, groups, n_lv, na_global)
+        return tuple(a + c for a, c in zip(accs, cs)), None
+
+    init = tuple(jnp.zeros((len(idxs), n_lv, Bg, V), jnp.float32)
+                 for idxs, Bg, _mode in groups)
+    hists, _ = jax.lax.scan(body, init, (lc.reshape(nblk, rb),
+                                         vv.reshape(nblk, rb, V), *xgs_r))
+    return hists
+
+
+# ---------------------------------------------------------------------------
+# pallas backend — one fused kernel, grid over row blocks
+# ---------------------------------------------------------------------------
+def _accum_out(out_ref, contrib):
+    """Zero-on-first-step accumulate into a grid-revisited VMEM output."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = contrib
+
+    @pl.when(i != 0)
+    def _():
+        out_ref[...] = out_ref[...] + contrib
+
+
+def _pallas_flat(Xb, lc, vv, n_lv, nbins_tot, rb):
+    Rl, F = Xb.shape
+    V = vv.shape[1]
+    nblk = Rl // rb
+
+    def kernel(xb_ref, l_ref, v_ref, out_ref):
+        _accum_out(out_ref, _flat_contrib(xb_ref[...], l_ref[..., 0],
+                                          v_ref[...], n_lv, nbins_tot))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((rb, F), lambda i: (i, 0)),
+                  pl.BlockSpec((rb, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((rb, V), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((F, n_lv, nbins_tot, V),
+                               lambda i: (0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, n_lv, nbins_tot, V),
+                                       jnp.float32),
+        interpret=interpret_mode(),
+    )(Xb, lc[:, None], vv)
+
+
+def _pallas_grouped(xgs, lc, vv, groups, n_lv, na_global, rb):
+    Rl = lc.shape[0]
+    V = vv.shape[1]
+    nblk = Rl // rb
+    ng = len(groups)
+    interp = interpret_mode()
+    shapes = tuple(jax.ShapeDtypeStruct((len(idxs), n_lv, Bg, V),
+                                        jnp.float32)
+                   for idxs, Bg, _mode in groups)
+
+    def kernel(l_ref, v_ref, *refs):
+        xg_refs, out_refs = refs[:ng], refs[ng:]
+        cs = _group_contrib([x[...] for x in xg_refs], l_ref[..., 0],
+                            v_ref[...], groups, n_lv, na_global,
+                            segsum_ok=interp)  # no scatter through Mosaic
+        for o, c in zip(out_refs, cs):
+            _accum_out(o, c)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((rb, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((rb, V), lambda i: (i, 0))]
+                 + [pl.BlockSpec((rb, xg.shape[1]), lambda i: (i, 0))
+                    for xg in xgs],
+        out_specs=tuple(pl.BlockSpec(s.shape, lambda i: (0, 0, 0, 0))
+                        for s in shapes),
+        out_shape=shapes,
+        interpret=interpret_mode(),
+    )(lc[:, None], vv, *xgs)
+
+
+# ---------------------------------------------------------------------------
+# public entry — what engine._build_level_hist calls
+# ---------------------------------------------------------------------------
+def level_hist_blocks(Xb, lc, vv, *, n_lv: int, nbins_tot: int, block: int,
+                      groups=None, backend: str | None = None):
+    """Per-shard level-histogram accumulation over row blocks.
+
+    ``Xb`` (Rl, F) int8/int16/int32 bin codes; ``lc`` (Rl,) int32 LOCAL
+    node ids already clipped to [0, n_lv); ``vv`` (Rl, V) f32 channel
+    values already zeroed for inactive rows. Flat (``groups=None``)
+    returns the (F, n_lv, nbins_tot, V) accumulator; grouped returns one
+    (Fg, n_lv, Bg, V) accumulator per normalized group, each group's NA
+    bucket in its LAST slot. No collectives — the caller psums.
+    """
+    rb = pow2_block_rows(Xb.shape[0], block)
+    bk = backend or hist_backend()
+    if groups is None:
+        fn = _pallas_flat if bk == "pallas" else _xla_flat
+        return fn(Xb, lc, vv, n_lv, nbins_tot, rb)
+    na_global = nbins_tot - 1
+    # the per-group column gather hoists out of the block loop: values are
+    # identical either way (int codes gather exactly), the Pallas kernel
+    # cannot close over the constant index arrays, and the gathered narrow
+    # views total exactly Xb's bytes
+    xgs = [Xb[:, list(idxs)] for idxs, _Bg, _mode in groups]
+    fn = _pallas_grouped if bk == "pallas" else _xla_grouped
+    return fn(xgs, lc, vv, groups, n_lv, na_global, rb)

@@ -70,9 +70,7 @@ def main() -> None:
     from .utils import compile_cache
     from .utils.log import info
 
-    cache = compile_cache.enable()
-    if cache:
-        info(f"persistent XLA compile cache at {cache}")
+    compile_cache.ensure()  # logs the cache dir itself when armed
 
     auth_check = None
     negotiate = None

@@ -36,12 +36,63 @@ from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metri
 from .tree.binning import (bin_matrix, compute_bin_edges,
                            compute_bin_edges_cols)
 from .tree.engine import (TreeConfig, make_train_fn, plan_hist_groups,
-                          predict_forest)
+                          predict_forest, sample_tree_phases)
 
 #: last build's training-matrix accounting (mode, per-matrix bytes) — the
 #: bench binned-storage leg and the chunk-store tests read this to put the
 #: measured peak-bytes reduction on the record
 LAST_TRAIN_MATRIX_BYTES: dict = {}
+
+#: AOT-compiled chunked train steps, keyed by (program identity, arg
+#: signature) — reused across builder instances like engine's
+#: _TRAIN_FN_CACHE, so only the FIRST build of a shape family pays the
+#: lower+compile (and with a warmed persistent compile cache that cost is
+#: a disk replay)
+_AOT_STEP_CACHE: dict = {}
+
+
+#: kernels backends whose phase profile this process already sampled —
+#: tests clear it to force a fresh sample
+_PHASE_SAMPLED: set = set()
+
+
+def _phase_sample_due() -> bool:
+    from ..backend.kernels import hist_backend
+
+    bk = hist_backend()
+    if bk in _PHASE_SAMPLED:
+        return False
+    _PHASE_SAMPLED.add(bk)
+    return True
+
+
+def _aot_train_step(train_fn, args, key_base):
+    """AOT lower+compile of the chunked train step at build setup — the
+    serving-scorer discipline (`serving/scorer.py` compiles every bucket at
+    registration) applied to training: the chunk loop dispatches a
+    prebuilt executable, the compile wall is measured where it happens
+    (``train.gbm.compile`` span + ``train.compile.seconds`` histogram,
+    compile count on the span detail), and a process with a warmed
+    ``H2O_TPU_COMPILE_CACHE`` replays it from disk instead of compiling.
+    Returns None when the builder has no stable program identity (custom
+    distribution UDFs bypass every cache)."""
+    if key_base is None:
+        return None
+    sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+    key = (key_base, sig)
+    hit = _AOT_STEP_CACHE.get(key)
+    if hit is not None:
+        return hit
+    from ..utils import compilemeter, telemetry
+
+    with telemetry.span("train.gbm.compile",
+                        metric="train.compile.seconds") as sp:
+        with compilemeter.scoped() as sc:
+            compiled = train_fn.lower(*args).compile()
+        sp.attrs["compiles"] = sc.compiles
+        sp.attrs["uncached"] = sc.uncached
+    _AOT_STEP_CACHE[key] = compiled
+    return compiled
 
 
 @dataclass
@@ -699,6 +750,25 @@ class GBM(ModelBuilder):
         # a 1000-tree run at the same scoring cadence.
         train_fn = make_train_fn(dataclasses.replace(cfg, ntrees=interval),
                                  grad_fn, mesh, cache_key=grad_key)
+        # AOT lower+compile the uniform-chunk step NOW (build setup), so the
+        # chunk loop dispatches a prebuilt executable and the compile wall /
+        # persistent-cache replay is measured at one attributable site
+        train_step = None
+        if chunks and grad_key is not None:
+            from ..backend.kernels import hist_backend
+
+            aot_key = (dataclasses.replace(cfg, ntrees=interval), grad_key,
+                       id(mesh), hist_backend())
+            try:
+                train_step = _aot_train_step(
+                    train_fn, (Xb, y_k, w, f, edges, edge_ok, chunks[0][0],
+                               chunks[0][1], mono, imat, s.iscat_dev,
+                               s.nedges_dev), aot_key)
+            except Exception as e:  # AOT is an optimization, never a gate
+                from ..utils.log import warn
+
+                warn(f"AOT train-step compile failed ({e!r}) — using the "
+                     f"jitted path for this build")
 
         output = ModelOutput()
         output.names = names
@@ -751,10 +821,45 @@ class GBM(ModelBuilder):
             with telemetry.span("train.gbm.chunk",
                                 metric="train.chunk.seconds",
                                 chunk=ci, trees=int(len(keys))):
-                f, osum, ocnt, trees = train_fn(Xb, y_k, w, f, edges,
-                                                edge_ok, keys, rates, mono,
-                                                imat, s.iscat_dev,
-                                                s.nedges_dev)
+                if (ci == start_ci and K == 1 and telemetry.enabled()
+                        and _phase_sample_due()):
+                    # sampled in-boundary phase profile (hist/split/route/
+                    # leaf + the train.hist.kernel backend-tagged wall):
+                    # nested under this chunk span — the fused program
+                    # exposes no phases of its own. Once per process per
+                    # kernels backend (the sample dispatches real device
+                    # work; paying it per job would tax every small train)
+                    try:
+                        g_s, h_s = grad_fn(y_k, f, w)
+                        sample_tree_phases(
+                            Xb, jnp.stack([w, g_s, h_s], axis=1),
+                            edge_ok, cfg,
+                            iscat=s.iscat_dev if cfg.use_sets else None,
+                            nedges=s.nedges_dev if cfg.use_sets else None)
+                    except Exception as e:  # instrumentation must never
+                        from ..utils.log import warn  # kill a training job
+
+                        warn(f"tree phase sample skipped ({e!r})")
+                step_args = (Xb, y_k, w, f, edges, edge_ok, keys, rates,
+                             mono, imat, s.iscat_dev, s.nedges_dev)
+                use_aot = (train_step is not None
+                           and keys.shape[0] == len(chunks[0][0]))
+                try:
+                    f, osum, ocnt, trees = (train_step if use_aot
+                                            else train_fn)(*step_args)
+                except (TypeError, ValueError) as e:
+                    if not use_aot:
+                        raise
+                    # the AOT executable is stricter than jit (it refuses
+                    # argument shardings/layouts it was not lowered for —
+                    # e.g. a resume-restored f placed differently); the
+                    # jitted twin re-places and proceeds
+                    from ..utils.log import warn
+
+                    warn(f"AOT train step rejected its arguments ({e!r}) "
+                         f"— jitted fallback for this job")
+                    train_step = None
+                    f, osum, ocnt, trees = train_fn(*step_args)
                 oob_sum = osum if oob_sum is None else oob_sum + osum
                 oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
                 parts.append(trees)

@@ -429,8 +429,13 @@ class ModelBuilder:
         self.job.set_max_runtime(self.params.max_runtime_secs)
 
         def run():
-            from ..utils import compilemeter, telemetry
+            from ..utils import compile_cache, compilemeter, telemetry
 
+            # knob-gated persistent XLA compile cache, armed before the
+            # job's first dispatch: ANY process that trains gets warm-start
+            # compiles when H2O_TPU_COMPILE_CACHE is set (idempotent — the
+            # server/cluster entry points arm it earlier when they ran)
+            compile_cache.ensure()
             t0 = time.time()
             # one root span per training job: everything recorded under it
             # (chunk/epoch spans, MRTask dispatches, checkpoints) shares

@@ -58,10 +58,28 @@ def _transform_info(transform: str):
     return demean, descale
 
 
-@jax.jit
+_GRAM_KERNEL_CACHE: dict = {}
+
+
 def _gram_kernel(X, wmask):
-    Xm = X * wmask[:, None]
-    return Xm.T @ Xm, jnp.sum(wmask)
+    """Masked Gram through the fused kernels layer: ``wmask`` is a 0/1 row
+    mask (w² == w), so the single-application weighted Gram Xᵀdiag(w)X
+    equals the historic (X·w)ᵀ(X·w) — accumulated in one blocked pass
+    (backend/kernels/gram.py) with the (R, P) masked copy never
+    materialized. The jit cache is keyed on the resolved kernels backend
+    (gram_accumulate reads the H2O_TPU_HIST_KERNEL knob at trace time — a
+    module-level @jax.jit would freeze whichever backend traced first)."""
+    from ..backend.kernels import gram as gram_kernels, hist_backend
+
+    bk = hist_backend()
+    fn = _GRAM_KERNEL_CACHE.get(bk)
+    if fn is None:
+        def kernel(X, wmask, _bk=bk):
+            G, _ = gram_kernels.gram_accumulate(X, wmask, backend=_bk)
+            return G, jnp.sum(wmask)
+
+        fn = _GRAM_KERNEL_CACHE.setdefault(bk, jax.jit(kernel))
+    return fn(X, wmask)
 
 
 def _gram_svd(X, wmask, k):

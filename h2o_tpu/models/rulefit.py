@@ -40,18 +40,40 @@ class RuleFitParameters(GLMParameters):
     max_num_rules: int = -1        # -1 = no cap (reference default)
     model_type: str = "rules_and_linear"  # rules_and_linear | rules | linear
     rule_generation_ntrees: int = 50
+    beta_epsilon: float = 1e-4     # the reference GLM IRLSM default — the
+                                   # repo-wide GLMParameters pins 1e-5, but
+                                   # at RuleFit's lasso-path scale the
+                                   # tighter epsilon only buys "confirm"
+                                   # Gram passes (post-solve beta moves
+                                   # ~1e-4 between warm-started lambdas;
+                                   # with the deviance probe this measured
+                                   # 62 → 51 IRLS epochs over the
+                                   # 20-lambda bench path)
+    objective_epsilon: float = 1e-4  # the reference's lambda_search auto
+                                   # default (GLM objective_epsilon docs:
+                                   # 1e-4 when lambda_search is on, 1e-6
+                                   # only at lambda=0) — tail-path lambdas
+                                   # whose deviance no longer moves then
+                                   # converge after ONE Gram pass
 
 
 class Rule:
     """A conjunction of (feature, op, threshold[, na_goes]) conditions."""
 
-    __slots__ = ("conds", "support", "coef", "rule_id")
+    __slots__ = ("conds", "support", "coef", "rule_id", "origin",
+                 "model_idx")
 
-    def __init__(self, conds, rule_id):
+    def __init__(self, conds, rule_id, origin=None):
         self.conds = conds          # list of (fidx, '<='|'>', thr, na_left)
         self.support = 0.0
         self.coef = 0.0
         self.rule_id = rule_id
+        #: (flat tree index, heap node) the rule's path ends at in its
+        #: generating forest — rows satisfying the conds are EXACTLY the
+        #: rows that visit that node, so `forest_covers` reads the rule's
+        #: support without re-evaluating conditions over the matrix
+        self.origin = origin
+        self.model_idx = 0          # which depth-ensemble produced it
 
     def describe(self, names):
         parts = []
@@ -81,7 +103,8 @@ def extract_rules(forest: dict, max_depth: int, min_len: int, max_len: int):
                 key = tuple(conds)
                 if key not in seen:
                     seen.add(key)
-                    rules.append(Rule(list(conds), len(rules)))
+                    rules.append(Rule(list(conds), len(rules),
+                                      origin=(t, node)))
             f = feat[t, node]
             if f < 0 or len(conds) >= max_len:
                 continue
@@ -223,6 +246,8 @@ def _stream_step(family, rb: int):
     @jax.jit
     def step(Xraw, y, w, beta, offset, fidx, thr, gt, nal, act, lsel,
              mu_l, sg_l):
+        from ..backend.kernels import gram as gram_kernels
+
         Rl = Xraw.shape[0]
         nblk = Rl // rb
 
@@ -238,9 +263,12 @@ def _stream_step(family, rb: int):
             V = family.variance(mu)
             W = wb * d * d / jnp.maximum(V, 1e-10)
             z = eta - ob + (yb - mu) / jnp.where(jnp.abs(d) < 1e-10, 1e-10, d)
-            AW = Ai * W[:, None]
-            G = G + jnp.einsum("rp,rq->pq", AW, Ai)
-            b_ = b_ + AW.T @ z
+            # the shared kernels-layer block math (backend/kernels/gram.py):
+            # here the design block is BUILT in the same scan step, so the
+            # whole design→Gram pipeline is one fused pass per block
+            dG, db = gram_kernels.block_contrib(Ai, W, z)
+            G = G + dG
+            b_ = b_ + db
             dev = dev + jnp.sum(family.deviance(yb, mu, wb))
             neff = neff + jnp.sum(wb)
             return (G, b_, dev, neff), None
@@ -280,8 +308,49 @@ def _stream_scorer(rb: int):
     return _STREAM_FN_CACHE.setdefault(key, run)
 
 
+def _covers_support(submodels, rules, Xraw, nrow: int) -> np.ndarray:
+    """Per-rule support read off the generating forests' node covers.
+
+    A rule IS a root→node path, so the rows satisfying its conditions are
+    exactly the rows that visit its origin node — `engine.forest_covers`
+    counts those in one routing pass per sub-forest (the same one-hot
+    traversal scoring uses), instead of re-evaluating every rule's
+    condition conjunction over the full matrix (the old
+    `_stream_rule_support` pass: a (rows × rules × conds) design rebuild
+    that existed only to recover numbers the forests already knew).
+    ``Xraw`` is the already-present raw feature matrix (the GLM phase
+    holds it either way) — nothing re-stacks. Row-chunked so the (rows,
+    n_nodes) traversal one-hots stay bounded; counts sum across chunks."""
+    from .tree.engine import forest_covers
+
+    valid = (jnp.arange(Xraw.shape[0]) < nrow).astype(jnp.float32)
+    sup = np.zeros(len(rules), np.float32)
+    by_model: dict[int, list[int]] = {}
+    for i, r in enumerate(rules):
+        by_model.setdefault(r.model_idx, []).append(i)
+    for mi, idxs in sorted(by_model.items()):
+        fo = submodels[mi].forest
+        depth = submodels[mi].cfg.max_depth
+        n_nodes = fo["feat"].shape[-1]
+        step = max(8192, (1 << 26) // max(n_nodes, 1))
+        cov = None
+        for s0 in range(0, Xraw.shape[0], step):
+            c = forest_covers(Xraw[s0:s0 + step], valid[s0:s0 + step],
+                              fo["feat"], fo["thr"], fo["nanL"], depth)
+            cov = c if cov is None else cov + c
+        cov = np.asarray(cov)
+        if cov.ndim == 3:  # multinomial (T, K, N): extract_rules flattened
+            cov = cov.reshape(-1, cov.shape[-1])
+        for i in idxs:
+            t, node = rules[i].origin
+            sup[i] = cov[t, node] / max(nrow, 1)
+    return sup
+
+
 def _stream_rule_support(Xraw, rule_arrays, nrow: int):
-    """Per-rule membership frequency over the real rows, streamed."""
+    """Per-rule membership frequency over the real rows, streamed — the
+    pre-covers evaluation pass, kept as the independent parity oracle for
+    `_covers_support` (tests pin covers == membership counts)."""
     R = rule_arrays[0].shape[0]
     rb = _stream_block(int(Xraw.shape[0]), R)
     key = ("support", rb)
@@ -376,7 +445,19 @@ class RuleFitModel(Model):
                 label = (mu >= 0.5).astype(jnp.float32)
                 return jnp.stack([label, 1 - mu, mu], axis=1)
             return mu
-        return self.glm_model.score0(X)
+        if self.glm_model is not None:
+            # multinomial fits and pre-kernels persisted models carry the
+            # full sub-GLM — delegate
+            return self.glm_model.score0(X)
+        # direct-fit path: X is the [rules | linear] design, beta its
+        # coefficients with the intercept last (the GLMModel.score0 math
+        # without the sub-model object)
+        beta = jnp.asarray(self.beta, jnp.float32)
+        mu = self.family.linkinv(X @ beta[:-1] + beta[-1])
+        if self.output.model_category == "Binomial":
+            label = (mu >= 0.5).astype(jnp.float32)
+            return jnp.stack([label, 1 - mu, mu], axis=1)
+        return mu
 
     def rule_importance(self):
         """Rules the L1 fit kept, ranked by |coef| (`Rule.java` importance)."""
@@ -400,7 +481,7 @@ class RuleFit(ModelBuilder):
         y_dev, category, resp_domain = self.response_info()
         model_type = p.model_type.lower()
 
-        rules, rule_arrays = [], None
+        rules, rule_arrays, submodels = [], None, []
         if "rules" in model_type:
             # depth-varying ensembles (`RuleFit.java` treeParameters loop)
             depths = range(p.min_rule_length, p.max_rule_length + 1)
@@ -421,8 +502,13 @@ class RuleFit(ModelBuilder):
                 # ordinal categorical splits so every path stays expressible
                 sub._use_set_splits = False
                 m = sub.build_impl(Job(f"rulefit_trees_d{depth}", 1.0))
-                rules += extract_rules(m.forest, m.cfg.max_depth,
-                                       p.min_rule_length, p.max_rule_length)
+                new_rules = extract_rules(m.forest, m.cfg.max_depth,
+                                          p.min_rule_length,
+                                          p.max_rule_length)
+                for r in new_rules:
+                    r.model_idx = len(submodels)
+                submodels.append(m)
+                rules += new_rules
             if p.max_num_rules > 0:
                 rules = rules[: p.max_num_rules]
             for i, r in enumerate(rules):
@@ -456,37 +542,17 @@ class RuleFit(ModelBuilder):
             beta = self._fit_streaming(job, model, fr, y_dev, category)
         else:
             Xd = model._design(fr)
-
-            # L1 GLM over the rule/linear design (`RuleFit.java`
-            # glmParameters: alpha=1, lambda_search)
-            design = Frame([f"c{i}" for i in range(Xd.shape[1])],
-                           [Vec.from_device(Xd[:, i], fr.nrow)
-                            for i in range(Xd.shape[1])])
-            design.add(p.response_column, fr.vec(p.response_column))
-            if p.weights_column:
-                design.add(p.weights_column, fr.vec(p.weights_column))
-            gp = GLMParameters(
-                training_frame=design, response_column=p.response_column,
-                weights_column=p.weights_column, alpha=1.0,
-                lambda_search=p.lambda_search or p.lambda_ is None,
-                lambda_=p.lambda_, nlambdas=min(p.nlambdas, 20),
-                standardize=False, family=p.family, seed=p.seed,
-                max_iterations=p.max_iterations)
-            glm_model = GLM(gp).build_impl(Job("rulefit_glm", 1.0))
-            model.glm_model = glm_model
-            beta = np.asarray(glm_model.beta)
+            beta = self._fit_design(job, model, Xd, y_dev, fr, category)
         model.beta = beta
 
-        # pull coefficients back onto rules; support = rule frequency
+        # pull coefficients back onto rules; support = rule frequency, read
+        # off the generating forests' node covers (one routing pass per
+        # sub-forest over the already-present raw matrix — no (rows ×
+        # rules × conds) design rebuild; see _covers_support)
         n_rules = len(rules)
         if rules:
-            if model.stream:
-                sup = np.asarray(_stream_rule_support(
-                    fr.as_matrix(names), rule_arrays, fr.nrow))
-            else:
-                memb = np.asarray(eval_rules(fr.as_matrix(names),
-                                             *rule_arrays))
-                sup = memb[: fr.nrow].mean(axis=0)
+            sup = _covers_support(submodels, rules, fr.as_matrix(names),
+                                  fr.nrow)
             for i, r in enumerate(rules):
                 r.coef = float(beta[i])
                 r.support = float(sup[i])
@@ -502,6 +568,54 @@ class RuleFit(ModelBuilder):
         output.variable_importances = None
         job.update(1.0)
         return model
+
+    def _fit_design(self, job, model, Xd, y_dev, fr, category) -> np.ndarray:
+        """L1 lambda path directly over the materialized rule/linear design
+        (`RuleFit.java` glmParameters: alpha=1, lambda_search) — the GLM
+        IRLS driver (`GLM._fit`, kernels-layer fused Gram) invoked on the
+        matrix RuleFit already holds. The historic path round-tripped Xd
+        through a per-column design Frame + DataInfo expansion purely to
+        satisfy the builder API: ~430 Vec.from_device slices, a second
+        (R, P) stack, and a full set of sub-model metrics nothing read —
+        ~1 s of the CPU bench leg. Multinomial responses keep the Frame
+        path (per-class block IRLS needs the full builder)."""
+        p = self.params
+        if category == "Multinomial":
+            design = Frame([f"c{i}" for i in range(Xd.shape[1])],
+                           [Vec.from_device(Xd[:, i], fr.nrow)
+                            for i in range(Xd.shape[1])])
+            design.add(p.response_column, fr.vec(p.response_column))
+            if p.weights_column:
+                design.add(p.weights_column, fr.vec(p.weights_column))
+            gp = GLMParameters(
+                training_frame=design, response_column=p.response_column,
+                weights_column=p.weights_column, alpha=1.0,
+                lambda_search=p.lambda_search or p.lambda_ is None,
+                lambda_=p.lambda_, nlambdas=min(p.nlambdas, 20),
+                standardize=False, family=p.family, seed=p.seed,
+                max_iterations=p.max_iterations,
+                beta_epsilon=p.beta_epsilon,
+                objective_epsilon=p.objective_epsilon)
+            glm_model = GLM(gp).build_impl(Job("rulefit_glm", 1.0))
+            model.glm_model = glm_model
+            return np.asarray(glm_model.beta)
+        family = GLM._family(self, category)
+        model.family = family
+        gb = GLM(GLMParameters(
+            training_frame=fr, response_column=p.response_column,
+            weights_column=p.weights_column, alpha=1.0,
+            lambda_search=p.lambda_search or p.lambda_ is None,
+            lambda_=p.lambda_, nlambdas=min(p.nlambdas, 20),
+            standardize=False, family=p.family, seed=p.seed,
+            max_iterations=p.max_iterations, beta_epsilon=p.beta_epsilon,
+            objective_epsilon=p.objective_epsilon))
+        wcol = (jnp.nan_to_num(fr.vec(p.weights_column).data)
+                if p.weights_column else jnp.ones((), jnp.float32))
+        y, w, offset, _neff, _b0 = _stream_prelude(family)(
+            y_dev, wcol, fr.nrow)
+        beta, _lam, _dev, _nulldev, _neff2, _iters = gb._fit(
+            Xd, y, w, offset, family, job)
+        return np.asarray(beta, np.float64)
 
     def _fit_streaming(self, job, model, fr, y_dev, category) -> np.ndarray:
         """L1 lambda path over the streaming IRLS — mirrors GLM._fit's IRLSM

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ...backend import kernels
+from ...backend.kernels import hist as hist_kernels
 from ...parallel.mesh import ROWS, default_mesh, shard_map
 
 
@@ -105,14 +107,10 @@ class TreeConfig:
         return 2 ** (self.max_depth + 1) - 1
 
 
-def _block_rows(rl: int, want: int) -> int:
-    if rl % want == 0:
-        return want
-    # largest power-of-two divisor of rl up to `want`
-    b = 1
-    while b * 2 <= want and rl % (b * 2) == 0:
-        b *= 2
-    return b if rl % b == 0 else rl
+#: the row-block sizer now lives with the kernels layer (both backends of
+#: every blocked accumulation share it); this alias keeps the engine's
+#: historic call sites
+_block_rows = kernels.pow2_block_rows
 
 
 def _onehot_pick(oh: jax.Array, v: jax.Array) -> jax.Array:
@@ -210,78 +208,33 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
     accumulate via a flat segment-sum instead of the one-hot matmul. Split
     finding is untouched. The group NA bucket is its last slot; global NA
     stays at ``nbins_tot - 1``. `plan_hist_groups` builds the partition.
+
+    The blocked accumulation itself lives in `backend/kernels/hist.py`
+    (one shared per-block math, executed either as the historic lax.scan
+    or as a fused Pallas kernel per ``H2O_TPU_HIST_KERNEL``); this
+    function keeps the mesh concerns — node localization, the per-group
+    psum, and the scatter-back into the global bin layout.
     """
-    Rl, F = Xb.shape
-    V = vals.shape[1]
-    rb = _block_rows(Rl, block)
-    nblk = Rl // rb
+    F = Xb.shape[1]
 
     local = node - offset
     active = (local >= 0) & (local < n_lv)
     lc = jnp.clip(local, 0, n_lv - 1)
     v = jnp.where(active[:, None], vals, 0.0)
 
-    Xb_r = Xb.reshape(nblk, rb, F)
-    lc_r = lc.reshape(nblk, rb)
-    v_r = v.reshape(nblk, rb, V)
-
     if groups is None:
-        def body(acc, blk):
-            xb, l, vv = blk
-            # int8/int16 binned views (frame/chunks.py) upcast HERE, one
-            # (rb, F) block at a time in VMEM: the one-hot below always sees
-            # int32, so HBM stores 1-2 B/cell without the sub-word-tiling
-            # relayouts that made a whole-matrix int8 one-hot 5x slower.
-            # (For int32 input this convert is a no-op in the jaxpr.)
-            xb = xb.astype(jnp.int32)
-            n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)      # (rb, n_lv)
-            a = jnp.einsum("rn,rv->rnv", n_oh, vv)                 # (rb, n_lv, V)
-            b_oh = jax.nn.one_hot(xb, nbins_tot, dtype=jnp.float32)  # (rb,F,B)
-            acc = acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh)
-            return acc, None
-
-        init = jnp.zeros((F, n_lv, nbins_tot, V), dtype=jnp.float32)
-        hist, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
+        hist = hist_kernels.level_hist_blocks(
+            Xb, lc, v, n_lv=n_lv, nbins_tot=nbins_tot, block=block)
         return jax.lax.psum(hist, ROWS)
 
     na_global = nbins_tot - 1
     groups = _norm_groups(groups)
-
-    def body(accs, blk):
-        xb, l, vv = blk
-        xb = xb.astype(jnp.int32)  # per-block upcast (see the flat body)
-        n_oh = jax.nn.one_hot(l, n_lv, dtype=jnp.float32)
-        a = jnp.einsum("rn,rv->rnv", n_oh, vv)  # outer product — exact
-        out = []
-        for (idxs, Bg, mode), acc in zip(groups, accs):
-            Fg = len(idxs)
-            xg = xb[:, list(idxs)]
-            xg = jnp.where(xg == na_global, Bg - 1, xg)
-            if mode == "segsum":
-                # narrow-bin path: at Bg ≪ the 128-lane MXU tile the one-hot
-                # matmul is degenerate (mostly-padding tiles); a flat
-                # segment-sum over (feature, node, bin) keys accumulates the
-                # same cells with no one-hot at all (and in pure f32 adds —
-                # the matmul path rounds each contribution through bf16 on
-                # TPU, so this path is the *more* exact of the two)
-                seg = ((jnp.arange(Fg, dtype=jnp.int32)[None, :] * n_lv
-                        + l[:, None]) * Bg + xg)             # (rb, Fg)
-                data = jnp.broadcast_to(vv[:, None, :], (xg.shape[0], Fg, V))
-                h = jax.ops.segment_sum(
-                    data.reshape(-1, V), seg.reshape(-1),
-                    num_segments=Fg * n_lv * Bg)
-                out.append(acc + h.reshape(Fg, n_lv, Bg, V))
-            else:
-                b_oh = jax.nn.one_hot(xg, Bg, dtype=jnp.float32)
-                out.append(acc + jnp.einsum("rnv,rfb->fnbv", a, b_oh))
-        return tuple(out), None
-
-    init = tuple(jnp.zeros((len(idxs), n_lv, Bg, V), jnp.float32)
-                 for idxs, Bg, _mode in groups)
-    hists, _ = jax.lax.scan(body, init, (Xb_r, lc_r, v_r))
+    hists = hist_kernels.level_hist_blocks(
+        Xb, lc, v, n_lv=n_lv, nbins_tot=nbins_tot, block=block,
+        groups=groups)
     # psum per group BEFORE the scatter-back: the wire carries Σ F_g·B_g
     # cells instead of the padded F·B_max the flat path reduces
-    full = jnp.zeros((F, n_lv, nbins_tot, V), jnp.float32)
+    full = jnp.zeros((F, n_lv, nbins_tot, vals.shape[1]), jnp.float32)
     for (idxs, Bg, _mode), hg in zip(groups, hists):
         hg = jax.lax.psum(hg, ROWS)
         ia = jnp.asarray(idxs)
@@ -748,8 +701,12 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     read under cfg.use_sets — pass zeros otherwise).
     """
     mesh = mesh or default_mesh()
+    # the kernels backend is resolved at TRACE time (kernels.hist_backend
+    # reads the H2O_TPU_HIST_KERNEL knob), so a cached program compiled
+    # under one backend must never serve a process that flipped the knob
+    full_key = None
     if cache_key is not None:
-        full_key = (cfg, cache_key, id(mesh))
+        full_key = (cfg, cache_key, id(mesh), kernels.hist_backend())
         hit = _TRAIN_FN_CACHE.get(full_key)
         if hit is not None:
             return hit
@@ -831,9 +788,93 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
         check_vma=False,
     )
     jitted = jax.jit(fn)
-    if cache_key is not None:
-        _TRAIN_FN_CACHE[(cfg, cache_key, id(mesh))] = jitted
+    if full_key is not None:
+        _TRAIN_FN_CACHE[full_key] = jitted
     return jitted
+
+
+# ---------------------------------------------------------------------------
+# Sampled in-boundary phase profile (the PR 6 telemetry residual).
+# ---------------------------------------------------------------------------
+def sample_tree_phases(Xb, vals3, edge_ok, cfg: TreeConfig,
+                       iscat=None, nedges=None):
+    """Measure one representative hist → split → route → leaf sequence and
+    land it inside the GBM tree boundary's telemetry.
+
+    The production loop is ONE fused XLA program (jit(shard_map(scan over
+    trees))) — per-phase walls inside it are not host-observable, so this
+    replays the first level's work as four standalone drained dispatches
+    and records them as a ``train.gbm.phases`` span (phases ``hist`` /
+    ``split`` / ``route`` / ``leaf``) nested under the chunk span, with
+    the histogram wall observed into the ``train.hist.kernel`` histogram
+    and the kernels backend (pallas/xla) on the span detail. One sample
+    per training job (gbm.py gates on the first chunk); collectives are
+    excluded — the accumulations run shard-local exactly as the kernels
+    layer executes them, which is the wall the ROADMAP item steers by.
+    Also aggregated as a ``gbm.tree.level`` task profile so `/3/Profiler`
+    serves the phase split next to the MRTask anatomy."""
+    from ...utils import telemetry
+    from ...utils.profile import task_profile
+
+    Rl, F = Xb.shape
+    B = cfg.nbins + 1
+    groups = _norm_groups(cfg.hist_groups) if cfg.hist_groups else None
+    backend = kernels.hist_backend()
+    node = jnp.zeros((Rl,), jnp.int32)
+    na_global = B - 1
+
+    with telemetry.span("train.gbm.phases", backend=backend,
+                        sampled=True) as sp, \
+            task_profile("gbm.tree.level") as prof:
+        with sp.phase("hist"), prof.phase("hist"):
+            if groups is None:
+                hist = hist_kernels.level_hist_blocks(
+                    Xb, node, vals3, n_lv=1, nbins_tot=B,
+                    block=cfg.block_rows)
+            else:
+                hgs = hist_kernels.level_hist_blocks(
+                    Xb, node, vals3, n_lv=1, nbins_tot=B,
+                    block=cfg.block_rows, groups=groups)
+                # shard-local scatter-back (the psum is a mesh concern the
+                # sample deliberately excludes)
+                hist = jnp.zeros((F, 1, B, vals3.shape[1]), jnp.float32)
+                for (idxs, Bg, _mode), hg in zip(groups, hgs):
+                    ia = jnp.asarray(idxs)
+                    hist = hist.at[ia, :, :Bg - 1, :].set(hg[:, :, :Bg - 1, :])
+                    hist = hist.at[ia, :, na_global, :].set(hg[:, :, Bg - 1, :])
+            jax.block_until_ready(hist)
+        telemetry.observe("train.hist.kernel", sp.phases["hist"])
+
+        use_sets = cfg.use_sets and iscat is not None
+        with sp.phase("split"), prof.phase("split"):
+            colmask = jnp.ones((F, 1), dtype=jnp.bool_)
+            out = _find_splits(hist[..., :3], colmask, edge_ok, cfg,
+                               iscat=iscat if use_sets else None,
+                               nedges=nedges if use_sets else None)
+            jax.block_until_ready([o for o in out if o is not None])
+        _gain, bf, bb, bnal, _Wt, _vL, _vR, _catd, _isset = out
+
+        with sp.phase("route"), prof.phase("route"):
+            # one block of the level-0 routing matmuls (the per-block work
+            # the scan repeats; cfg.nbins >= 255 forces f32 like _grow_tree)
+            rb = _block_rows(Rl, cfg.block_rows)
+            prec = (jax.lax.Precision.HIGHEST if cfg.nbins >= 255
+                    else jax.lax.Precision.DEFAULT)
+            S = jax.nn.one_hot(bf, F, dtype=jnp.float32)
+            xbs = jnp.dot(Xb[:rb].astype(jnp.float32), S.T, precision=prec,
+                          preferred_element_type=jnp.float32)
+            rb_val = xbs[:, 0]
+            go_right = jnp.where(rb_val == cfg.nbins, ~bnal[0],
+                                 rb_val > bb[0].astype(jnp.float32))
+            routed = 1 + go_right.astype(jnp.int32)
+            jax.block_until_ready(routed)
+
+        with sp.phase("leaf"), prof.phase("leaf"):
+            # shard-local per-node totals (the _node_totals body sans psum)
+            n_oh = jax.nn.one_hot(node[:rb], cfg.n_nodes, dtype=jnp.float32)
+            tot = jnp.einsum("rn,rv->nv", n_oh, vals3[:rb])
+            jax.block_until_ready(tot)
+    return sp.phases
 
 
 # ---------------------------------------------------------------------------

@@ -594,11 +594,6 @@ def _write_rulefit_mojo(model, path: str):
     """RuleFit MOJO — `hex/genmodel/algos/rulefit/RuleFitMojoWriter` role:
     the packed rule tensors + linear-term standardization + the (raw-scale)
     GLM coefficients over the [rules | linear] design."""
-    if getattr(model, "glm_model", None) is None:
-        raise NotImplementedError(
-            "MOJO export for a streaming-mode RuleFit model (fitted at "
-            "benchmark scale without a materialized GLM): re-train below "
-            "the streaming threshold to export, or use binary save_model")
     import json
 
     from ..models.glm import _destandardize
@@ -611,12 +606,27 @@ def _write_rulefit_mojo(model, path: str):
         category, len(out.response_domain or []))
     info = _common_info(model, "rulefit", "RuleFit", category, n_classes,
                         columns, domains, mojo_version=1.00)
-    g = model.glm_model
-    beta = _destandardize(np.asarray(g.beta, dtype=np.float64), g.dinfo)
+    g = getattr(model, "glm_model", None)
+    if g is not None:  # multinomial / legacy persisted fits carry a sub-GLM
+        beta = _destandardize(np.asarray(g.beta, dtype=np.float64), g.dinfo)
+        family_name, link_name = g.family.name, g.family.link_name
+    elif getattr(model, "beta", None) is not None \
+            and getattr(model, "family", None) is not None:
+        # direct-fit AND streaming models: beta is already on the raw
+        # design scale (standardize=False; linear-term standardization is
+        # baked into the spec's lin_means/lin_sigmas) — the streaming-mode
+        # export refusal this replaces predates the shared layout
+        beta = np.asarray(model.beta, dtype=np.float64)
+        family_name, link_name = model.family.name, model.family.link_name
+    else:
+        raise NotImplementedError(
+            "MOJO export needs the model's fitted coefficients (beta + "
+            "family) — this RuleFit model carries neither a sub-GLM nor a "
+            "direct fit")
     info.update({
         "beta": list(beta.ravel()),
-        "family": g.family.name,
-        "link": g.family.link_name,
+        "family": family_name,
+        "link": link_name,
         "n_rules": 0 if model.rule_arrays is None
         else int(np.asarray(model.rule_arrays[0]).shape[0]),
     })

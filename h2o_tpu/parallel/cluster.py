@@ -32,11 +32,17 @@ def init_cluster(coordinator_address: str | None = None,
     mesh over ALL devices in the cloud; pass it to `use_mesh` or rely on it
     being installed as the default.
     """
+    from ..utils import compile_cache
+
     if num_processes is None or num_processes > 1 or coordinator_address:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+    # every SPMD worker arms the knob-gated persistent compile cache at
+    # cloud formation — a preempted-and-restarted pod replays its programs
+    # from disk instead of re-paying the cold-start compile wall
+    compile_cache.ensure()
     m = meshmod.make_mesh()  # all devices across all processes
     meshmod.set_mesh(m)
     return m

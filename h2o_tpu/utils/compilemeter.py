@@ -6,18 +6,30 @@ compile observed during request serving is a bug (a shape that escaped the
 buckets, a donated-buffer retrace, ...). That promise is only assertable if
 compiles are *countable*, which jax exposes through `jax.monitoring`: the
 dispatch layer records one `/jax/core/compile/backend_compile_duration`
-event per program that reaches the backend compiler (cache hits do NOT
-fire it — a replay from the persistent compile cache is not a compile).
+event per program that reaches the compile path.
 
-`count()` returns the monotone process-wide total; callers measure deltas
-around the region they care about::
+One version-measured caveat (jax 0.4.x): that event wraps
+``compiler.compile_or_get_cached``, so it fires for PERSISTENT-CACHE HITS
+too — a program replayed from the `utils/compile_cache.py` disk cache
+counts as a "compile" even though no XLA compilation ran. The cache layer
+emits its own `/jax/compilation_cache/cache_hits` event per replay, so
+this module tracks both and exposes the number that actually costs wall:
+
+- ``count()``       — programs through the compile path (builds + replays);
+- ``cache_hits()``  — persistent-cache replays among them;
+- ``uncached_count()`` — real XLA compilations (count − cache_hits), the
+  cold-start acceptance metric of the bench ``cold_start`` leg.
+
+`count()` deltas remain the right meter where NO compile activity at all
+is the contract (serving steady state: both numbers are zero). Callers
+measure deltas around the region they care about::
 
     before = compilemeter.count()
     ...serve traffic...
     assert compilemeter.count() - before == 0
 
-The listener is registered once per process (jax.monitoring offers no
-unregister, so install() is idempotent by module flag) and costs one dict
+The listeners are registered once per process (jax.monitoring offers no
+unregister, so install() is idempotent by module flag) and cost one dict
 lookup per monitoring event — nothing on the request path.
 """
 
@@ -27,10 +39,12 @@ import contextlib
 import threading
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 _lock = threading.Lock()
 _installed = False
 _count = 0
+_cache_hits = 0
 
 
 def _listener(name: str, secs: float, **kw) -> None:
@@ -49,8 +63,15 @@ def _listener(name: str, secs: float, **kw) -> None:
                         secs=round(float(secs), 4))
 
 
+def _event_listener(name: str, **kw) -> None:
+    global _cache_hits
+    if name == _CACHE_HIT_EVENT:
+        with _lock:
+            _cache_hits += 1
+
+
 def install() -> None:
-    """Register the monitoring listener (idempotent, lazy jax import)."""
+    """Register the monitoring listeners (idempotent, lazy jax import)."""
     global _installed
     with _lock:
         if _installed:
@@ -59,10 +80,12 @@ def install() -> None:
     import jax
 
     jax.monitoring.register_event_duration_secs_listener(_listener)
+    jax.monitoring.register_event_listener(_event_listener)
 
 
 def count() -> int:
-    """Total XLA backend compiles observed in this process so far.
+    """Total programs through the XLA compile path in this process so far
+    (real compilations AND persistent-cache replays — see module doc).
 
     Process-global by nature: steady-state serving accounting must NOT
     diff this around individual device calls (a concurrent registration
@@ -76,6 +99,22 @@ def count() -> int:
         return _count
 
 
+def cache_hits() -> int:
+    """Persistent compile-cache replays observed so far."""
+    install()
+    with _lock:
+        return _cache_hits
+
+
+def uncached_count() -> int:
+    """Real XLA compilations so far: ``count() − cache_hits()``, floored
+    at 0 (the floor defends against event-ordering races; the two events
+    fire from the same dispatch stack, so in practice it never engages)."""
+    install()
+    with _lock:
+        return max(_count - _cache_hits, 0)
+
+
 class CompileScope:
     """Compile-count delta over one region — the context-LOCAL reading the
     global counter's docstring warns against misusing: a scope pins its own
@@ -83,16 +122,30 @@ class CompileScope:
     (attribution of a shared backend is inherently shared; per-cause
     blame stays with `serving/scorer.py`'s own bucket-miss gauge)."""
 
-    __slots__ = ("start", "_end")
+    __slots__ = ("start", "start_hits", "_end", "_end_hits")
 
-    def __init__(self, start: int):
+    def __init__(self, start: int, start_hits: int):
         self.start = start
+        self.start_hits = start_hits
         self._end: int | None = None
+        self._end_hits: int | None = None
 
     @property
     def compiles(self) -> int:
-        """Compiles observed since the scope opened (frozen at exit)."""
+        """Programs through the compile path since the scope opened
+        (frozen at exit) — builds plus persistent-cache replays."""
         return (count() if self._end is None else self._end) - self.start
+
+    @property
+    def hits(self) -> int:
+        """Persistent-cache replays in the window."""
+        return ((cache_hits() if self._end_hits is None else self._end_hits)
+                - self.start_hits)
+
+    @property
+    def uncached(self) -> int:
+        """Real XLA compilations in the window (compiles − hits, ≥ 0)."""
+        return max(self.compiles - self.hits, 0)
 
 
 @contextlib.contextmanager
@@ -100,8 +153,9 @@ def scoped():
     """``with compilemeter.scoped() as sc: ... ; sc.compiles`` — the delta
     pattern made first-class (bench legs, per-train cold-start metering),
     mirroring PR 4's bucket-miss fix: read a scope, not the global."""
-    sc = CompileScope(count())
+    sc = CompileScope(count(), cache_hits())
     try:
         yield sc
     finally:
         sc._end = count()
+        sc._end_hits = cache_hits()

@@ -91,7 +91,14 @@ _knob("H2O_TPU_EXACT_BIN_ROWS", "int", 16384,
       "rows at or below which tree binning may use exact small-data cuts")
 _knob("H2O_TPU_HIST_SEG_WIDTH", "int", 8,
       "bin widths at/below this accumulate via segment-sum instead of the "
-      "one-hot matmul in the histogram scan (0 disables the path)")
+      "one-hot matmul in the histogram scan (0 disables the path); also "
+      "bounds the widest VMEM accumulator slab a narrow group hands the "
+      "pallas hist kernel (backend/kernels/hist.py)")
+_knob("H2O_TPU_HIST_KERNEL", "str", "auto",
+      "kernels-layer backend for the level-histogram and Gram "
+      "accumulations (backend/kernels/): 'pallas' = fused pl.pallas_call "
+      "(interpreted off-TPU), 'xla' = the blocked lax.scan oracle, "
+      "'auto' = pallas on real TPU backends, xla elsewhere")
 _knob("H2O_TPU_CLEAR_CACHES_EVERY", "int", 64,
       "drop live XLA executables every N models (long-server hygiene; "
       "0 = never)")
@@ -229,10 +236,14 @@ _knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
       "rows for the binned-store stacked-vs-binned leg")
 _knob("H2O_TPU_BENCH_WORKLOADS", "str",
       "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,serving_wire,"
-      "recovery,airlines",
+      "recovery,cold_start,airlines",
       "comma list of bench workloads to run")
 _knob("H2O_TPU_BENCH_RECOVERY_ROWS", "int", 500_000,
       "rows for the recovery leg (checkpoint overhead + resume-to-parity)")
+_knob("H2O_TPU_BENCH_COLDSTART_ROWS", "int", 60_000,
+      "rows for the cold_start leg's subprocess GBM train+score (first "
+      "process cold vs second process on a warmed persistent compile "
+      "cache)")
 _knob("H2O_TPU_BENCH_SERVING_REQS", "int", 4000,
       "single-row requests issued by the concurrent serving bench leg")
 _knob("H2O_TPU_BENCH_SERVING_THREADS", "int", 16,

@@ -155,6 +155,15 @@ _counter("train.checkpoint.count",
 _histogram("train.checkpoint.seconds",
            "wall per auto-recovery checkpoint write (the preemption "
            "insurance premium, measured)")
+_histogram("train.hist.kernel",
+           "drained wall of one sampled level-histogram accumulation "
+           "(backend/kernels/hist.py), observed once per GBM/DRF training "
+           "job from the in-boundary phase sample; the backend "
+           "(pallas/xla) rides the train.gbm.phases span/timeline detail")
+_histogram("train.compile.seconds",
+           "drained wall of the AOT lower+compile of the tree train step "
+           "at build setup (near-zero when the persistent compile cache "
+           "replays it — the cold-start meter)")
 
 # -- HBM Cleaner (backend/memory.py) -----------------------------------------
 _gauge("cleaner.hbm.live.bytes",
@@ -237,6 +246,13 @@ def _lookup(name: str) -> Metric:
 
 def _enabled() -> bool:
     return knobs.get_bool("H2O_TPU_METRICS_ENABLED")
+
+
+def enabled() -> bool:
+    """Public master-switch read — gates optional instrumentation work
+    whose COST exists even when the emits are skipped (e.g. the GBM
+    sampled phase profile dispatches real device work)."""
+    return _enabled()
 
 
 # ---------------------------------------------------------------------------

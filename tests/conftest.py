@@ -125,6 +125,10 @@ def pytest_configure(config):
         "markers", "telemetry: unified telemetry — metrics registry, span "
                    "tracing, /3/Metrics + /3/Timeline surface (pytest -m "
                    "telemetry, utils/telemetry.py)")
+    config.addinivalue_line(
+        "markers", "kernels: Pallas histogram/Gram kernels vs the XLA "
+                   "oracle — bit-parity suite + cold-start compile cache "
+                   "(pytest -m kernels, h2o_tpu/backend/kernels/)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -212,6 +212,16 @@ def run_script(name: str) -> None:
     """Exec one verbatim pyunit script; its module-level ``else`` branch
     invokes the test function (``__name__`` is not ``__main__`` here)."""
     install_aliases()
+    # replays must be deterministic: several upstream pyunits build
+    # UNSEEDED comparison models against numpy's legacy global RNG
+    # (bernoulli_gbm's sklearn GBC draws split candidates from it) and
+    # then assert marginal >= comparisons against our deterministic
+    # output — with OS-entropy seeding that is a per-process coin flip
+    # (measured: auc_sci lands 0.7606 or 0.7734 around our fixed 0.7733).
+    # Pin the global RNG so every replay reproduces the same verdict.
+    import numpy as np
+
+    np.random.seed(0)
     path = os.path.join(SCRIPTS_DIR, name)
     with open(path) as fh:
         src = fh.read()

@@ -210,6 +210,18 @@ from h2o_tpu.utils import telemetry
 telemetry.inc("mrtask.dispatch.count")
 """,
     ),
+    "direct-pallas-call": (
+        """
+from jax.experimental import pallas as pl
+
+out = pl.pallas_call(lambda r, o: None, out_shape=None)(1)
+""",
+        """
+from h2o_tpu.backend.kernels import hist
+
+out = hist.level_hist_blocks
+""",
+    ),
 }
 
 
@@ -606,7 +618,67 @@ def test_scan_set_includes_the_advertised_tree():
 
 def test_every_rule_registered_exactly_once():
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 11
+    assert len(ids) == len(set(ids)) == 12
+
+
+def test_direct_pallas_call_forms():
+    """Rule 12 catches every pallas spelling outside the kernels layer —
+    and the kernels layer itself is exempt."""
+    bare = """
+from jax.experimental.pallas import pallas_call
+
+out = pallas_call(lambda r, o: None, out_shape=None)(1)
+"""
+    assert "direct-pallas-call" in _rules_hit(bare)
+    module = """
+import jax.experimental.pallas as pl
+
+out = pl.pallas_call(lambda r, o: None, out_shape=None)(1)
+"""
+    assert "direct-pallas-call" in _rules_hit(module)
+    tpu_mod = """
+from jax.experimental.pallas import tpu as pltpu
+
+space = pltpu.VMEM
+"""
+    assert "direct-pallas-call" in _rules_hit(tpu_mod)
+    # the kernels layer is the sanctioned site
+    inside = _rules_hit(bare, relpath="h2o_tpu/backend/kernels/hist.py")
+    assert "direct-pallas-call" not in inside
+    # a local function that merely shares the name is not pallas
+    local = """
+def pallas_call(fn):
+    return fn
+
+out = pallas_call(lambda: 1)
+"""
+    assert "direct-pallas-call" not in _rules_hit(local)
+
+
+def test_kernels_layer_is_the_only_pallas_site():
+    """Dynamic twin of rule 12: grep-level sweep of the shipped tree —
+    every file that imports pallas lives under h2o_tpu/backend/kernels/."""
+    import ast as _ast
+
+    offenders = []
+    for path in iter_py_files(("h2o_tpu", "tests", "bench.py", "tools")):
+        rel = path.replace("\\", "/")
+        rel = rel[rel.find("h2o_tpu"):] if "h2o_tpu/" in rel else rel
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = _ast.parse(f.read())
+            except SyntaxError:
+                continue
+        for node in _ast.walk(tree):
+            mods = []
+            if isinstance(node, _ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, _ast.ImportFrom):
+                mods = [node.module or ""]
+            if any(m.startswith("jax.experimental.pallas") for m in mods) \
+                    and "backend/kernels/" not in path.replace("\\", "/"):
+                offenders.append(path)
+    assert not offenders, offenders
 
 
 def test_failpoint_registry_covers_every_site_the_tree_hits():
