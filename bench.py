@@ -776,6 +776,133 @@ def bench_serving_wire(n_reqs: int) -> dict:
     }
 
 
+_SHARDED_SCRIPT = '''\
+import json, sys, time
+
+sys.path.insert(0, {repo!r})
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import hashlib
+
+import numpy as np
+
+import bench
+
+nrow = int(sys.argv[1])
+ntrees = int(sys.argv[2])
+fr = bench._higgs_frame(nrow)
+import jax.numpy as jnp
+
+from h2o_tpu.backend.memory import CLEANER
+from h2o_tpu.models import gbm as gbm_mod
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.parallel import mesh as meshmod
+
+jax.device_get([jnp.sum(v.data) for v in fr.vecs if v.data is not None])
+t0 = time.time()
+model = GBM(GBMParameters(training_frame=fr, response_column="response",
+                          ntrees=ntrees, max_depth=5, nbins=20, seed=42,
+                          learn_rate=0.1,
+                          score_tree_interval=ntrees)).train_model()
+train_wall = time.time() - t0
+# forest STRUCTURE digest (split features + NA directions): must be
+# BIT-equal across shard counts — the SPMD histograms must not change a
+# single split decision
+struct = hashlib.sha256()
+for k in ("feat", "nanL"):
+    struct.update(np.ascontiguousarray(np.asarray(model.forest[k])).tobytes())
+# margin probe on a fixed row block: floats accumulate through psum, whose
+# reduction order differs across mesh widths — the parent pins closeness
+probe_rows = min(nrow, 512)
+Xp = np.stack([np.nan_to_num(fr.vec(n).to_numpy()[:probe_rows])
+               for n in model.output.names], axis=1).astype(np.float32)
+margins = np.asarray(model._raw_f(jnp.asarray(Xp)), np.float64)
+peaks = CLEANER.device_peak_bytes()
+auc = model.output.training_metrics.auc
+print(json.dumps({{
+    "n_row_shards": int(meshmod.n_row_shards()),
+    "train_wall_s": round(train_wall, 3),
+    "auc": round(float(auc), 6),
+    "matrix_bytes": gbm_mod.LAST_TRAIN_MATRIX_BYTES["binned_bytes"],
+    "per_shard_matrix_bytes":
+        gbm_mod.LAST_TRAIN_MATRIX_BYTES["per_shard_bytes"],
+    "psum_bytes_per_tree":
+        gbm_mod.LAST_TRAIN_MATRIX_BYTES["psum_bytes_per_tree"],
+    "per_device_peak_bytes": max(peaks.values()) if peaks else 0,
+    "forest_struct_sha": struct.hexdigest(),
+    "probe_margins": [round(v, 10) for v in margins.tolist()],
+}}))
+'''
+
+
+def bench_sharded(nrow: int, ntrees: int, n_shards: int = 8) -> dict:
+    """Sharded leg: the SAME GBM workload at 1 vs ``n_shards`` row shards,
+    each in a FRESH subprocess on an ``n_shards``-wide virtual CPU mesh
+    (H2O_TPU_ROW_SHARDS is read once at mesh construction, so shard counts
+    can't flip mid-process). On the record per leg: per-shard peak
+    training-matrix bytes (the per-chip HBM number), the per-tree ICI psum
+    payload, wall, and a forest-structure digest. Acceptance: the sharded
+    leg's per-shard matrix bytes <= single-shard/n_shards + a fixed
+    overhead, forest STRUCTURE bit-equal across shard counts, margins
+    within reduction-order tolerance."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    script = _SHARDED_SCRIPT.format(
+        repo=os.path.dirname(os.path.abspath(__file__)))
+    fd, script_path = tempfile.mkstemp(suffix="_sharded.py")
+    with os.fdopen(fd, "w") as f:
+        f.write(script)
+
+    def run_leg(shards: int) -> dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [fl for fl in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in fl]
+        flags.append(f"--xla_force_host_platform_device_count={n_shards}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["H2O_TPU_ROW_SHARDS"] = str(shards)
+        out = subprocess.run(
+            [_sys.executable, script_path, str(nrow), str(ntrees)],
+            capture_output=True, text=True, timeout=1800, env=env)
+        if out.returncode != 0:
+            raise RuntimeError(f"sharded subprocess (shards={shards}) "
+                               f"failed:\n{out.stderr[-2000:]}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    try:
+        single = run_leg(1)
+        sharded = run_leg(n_shards)
+    finally:
+        os.unlink(script_path)
+    m1 = np.asarray(single.pop("probe_margins"))
+    mn = np.asarray(sharded.pop("probe_margins"))
+    delta = float(np.max(np.abs(m1 - mn))) if m1.size else 0.0
+    scale = float(np.max(np.abs(m1))) if m1.size else 1.0
+    per_1 = single["per_shard_matrix_bytes"]
+    per_n = sharded["per_shard_matrix_bytes"]
+    overhead = 64 * 1024  # fixed allowance over the ideal 1/n split
+    return {
+        "rows": nrow,
+        "ntrees": ntrees,
+        "n_shards": n_shards,
+        "single": single,
+        "sharded": sharded,
+        "per_shard_reduction_x": round(per_1 / max(per_n, 1), 2),
+        "per_shard_bytes_ok": per_n <= per_1 // n_shards + overhead,
+        "forest_struct_equal": (single["forest_struct_sha"]
+                                == sharded["forest_struct_sha"]),
+        "probe_margin_max_abs_delta": delta,
+        "probe_margin_rel_delta": delta / max(scale, 1e-12),
+        "note": ("same GBM at 1 vs N row shards, fresh subprocesses; "
+                 "acceptance: per-shard matrix bytes <= single/N + 64KiB, "
+                 "forest structure bit-equal, margins within reduction-"
+                 "order ulps"),
+    }
+
+
 _COLDSTART_SCRIPT = '''\
 import json, sys, time
 
@@ -1039,6 +1166,9 @@ def main():
     if "cold_start" in wanted:
         _leg(workloads, "cold_start", lambda: bench_cold_start(
             knobs.get_int("H2O_TPU_BENCH_COLDSTART_ROWS")))
+    if "sharded" in wanted:
+        _leg(workloads, "sharded", lambda: bench_sharded(
+            knobs.get_int("H2O_TPU_BENCH_SHARDED_ROWS"), min(ntrees, 20)))
     if "airlines" in wanted:
         _leg(workloads, "airlines116m", lambda: bench_airlines(
             knobs.get_int("H2O_TPU_BENCH_AIRLINES_ROWS"), ntrees))

@@ -74,6 +74,12 @@ def _note_job_finished() -> None:
         gbm_mod = _sys.modules.get("h2o_tpu.models.gbm")
         if gbm_mod is not None:
             gbm_mod._AOT_STEP_CACHE.clear()
+        # the sharded merge-expand programs are likewise held directly,
+        # keyed by data-dependent output sizes — a long server joining
+        # ever-different frames would otherwise accumulate executables
+        merge_mod = _sys.modules.get("h2o_tpu.rapids.merge")
+        if merge_mod is not None:
+            merge_mod._EXPAND_PROGS.clear()
         gc.collect()
         jax.clear_caches()
         from ..utils.log import info

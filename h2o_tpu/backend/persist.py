@@ -239,13 +239,15 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def atomic_write_bytes(path: str, data: bytes) -> None:
+def atomic_write_bytes(path: str, data: bytes,
+                       failpoint: str = "persist.checkpoint") -> None:
     """write-temp + fsync + rename: a reader (or a resuming process) sees
     either the complete previous content or the complete new content,
     never a torn write — the invariant every kill-at-any-instant resume
-    test leans on. The ``persist.checkpoint`` failpoint sits between the
-    durable temp write and the rename, the exact window a preemption
-    would hit."""
+    test leans on. The ``persist.checkpoint`` failpoint (``persist.shard``
+    for per-device shard-state writes, so the two kill windows count
+    independently) sits between the durable temp write and the rename,
+    the exact window a preemption would hit."""
     from ..utils import failpoints
 
     tmp = path + ".tmp"
@@ -253,7 +255,7 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
-    failpoints.hit("persist.checkpoint")
+    failpoints.hit(failpoint)
     os.replace(tmp, path)
     _fsync_dir(os.path.dirname(os.path.abspath(path)))
 
@@ -305,6 +307,109 @@ class Recovery:
 
     def model_path(self, i: int) -> str:
         return os.path.join(self.dir, f"model_{i}.bin")
+
+
+# ---------------------------------------------------------------------------
+# shard-aware state splitting (multi-chip checkpoints)
+# ---------------------------------------------------------------------------
+def _is_partitioned(arr) -> bool:
+    """True for a jax array actually SPLIT across >1 device (replicated
+    multi-device arrays reassemble from any one copy and pickle whole)."""
+    if not isinstance(arr, jax.Array):
+        return False
+    try:
+        shards = arr.addressable_shards
+    except Exception:  # noqa: BLE001 — host-only arrays
+        return False
+    if len(shards) <= 1:
+        return False
+    idx = {tuple((sl.start, sl.stop) for sl in s.index) for s in shards}
+    return len(idx) > 1
+
+
+def _split_state_shards(state):
+    """Walk an iteration-state pytree and pull every PARTITIONED device
+    array out into per-device payloads: the state that remains pickles
+    small (markers + replicated arrays), and each device's row shard is
+    written by "its" shard file — the multi-host protocol shape (every
+    worker writes its shard, the coordinator commits the manifest) run
+    single-process over the addressable mesh.
+
+    Returns (state-with-markers, [per-shard payload dict, ...]); payloads
+    are empty when nothing is partitioned (single-device meshes — the
+    historic one-pickle layout, bit-identical)."""
+    payloads: list[dict] = []
+    dev_slot: dict = {}
+    counter = [0]
+
+    def slot(dev) -> int:
+        if dev not in dev_slot:
+            dev_slot[dev] = len(dev_slot)
+            payloads.append({})
+        return dev_slot[dev]
+
+    def walk(obj):
+        if _is_partitioned(obj):
+            aid = counter[0]
+            counter[0] += 1
+            seen_idx = set()
+            for s in sorted(obj.addressable_shards,
+                            key=lambda s: str(s.device)):
+                idx = tuple((sl.start, sl.stop) for sl in s.index)
+                if idx in seen_idx:
+                    continue  # replica copies of the same piece
+                seen_idx.add(idx)
+                payloads[slot(s.device)].setdefault(aid, []).append(
+                    (idx, np.asarray(s.data)))
+            return {"__h2o_sharded__": aid, "shape": tuple(obj.shape),
+                    "dtype": str(obj.dtype)}
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            return type(obj)(*(walk(v) for v in obj))
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(walk(v) for v in obj)
+        return obj
+
+    out = walk(state)
+    return out, payloads
+
+
+def _join_state_shards(state, shard_payloads: list):
+    """Inverse of :func:`_split_state_shards`: markers -> reassembled numpy
+    arrays (each piece written back at its recorded index — bit-equal to
+    the original device array's host pull)."""
+    merged: dict = {}
+    for payload in shard_payloads:
+        for aid, pieces in payload.items():
+            merged.setdefault(aid, []).extend(pieces)
+
+    def walk(obj):
+        if isinstance(obj, dict) and "__h2o_sharded__" in obj:
+            aid = obj["__h2o_sharded__"]
+            out = np.empty(tuple(obj["shape"]), dtype=np.dtype(obj["dtype"]))
+            covered = 0
+            for idx, piece in merged.get(aid, []):
+                out[tuple(slice(a, b) for a, b in idx)] = piece
+                covered += piece.size
+            # pieces are disjoint by construction (replica-deduped at
+            # split), so full coverage ⇔ their sizes sum to the array's —
+            # anything less would hand training uninitialized memory
+            if covered != out.size:
+                raise ValueError(
+                    f"checkpoint shard payloads cover {covered} of "
+                    f"{out.size} elements for state array {aid} — the "
+                    f"recovery dir is missing or torn shard files")
+            return out
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            return type(obj)(*(walk(v) for v in obj))
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(walk(v) for v in obj)
+        return obj
+
+    return walk(state)
 
 
 # ---------------------------------------------------------------------------
@@ -436,24 +541,89 @@ class TrainingRecovery:
     def save_state(self, state: dict, progress: dict | None = None) -> None:
         """Atomically persist the iteration state, then the manifest (state
         first: a kill between the two leaves the previous manifest pointing
-        at the previous complete state — never a dangling reference)."""
+        at the previous complete state — never a dangling reference).
+
+        SHARD-AWARE: state arrays that are actually partitioned across the
+        mesh (the carried ``f``/OOB vectors of a multi-chip train) are
+        pulled per DEVICE and written as generation-numbered per-shard
+        files (``train_state.g<G>.shard<i>.pkl``) BEFORE the main state —
+        each device's shard write is its own atomic rename, the
+        ``persist.shard`` failpoint fires before each one, and the
+        manifest (recording the generation + shard count) commits LAST, so
+        a kill anywhere inside the fan-out leaves the previous generation
+        fully referenced and the half-written one invisible. Previous-
+        generation shard files are reaped only after the commit."""
         import time
 
         from ..utils import failpoints
 
         t0 = time.monotonic()
-        atomic_write_bytes(os.path.join(self.dir, self.STATE),
-                           pickle.dumps(_to_host(state)))
+        split, payloads = _split_state_shards(state)
         manifest = self.rec.read() or {}
+        committed = int(manifest.get("checkpoints", 0))
+        # the new generation must exceed every generation ON DISK, not just
+        # the committed count: after a kill during the manifest write the
+        # state file already references an uncommitted generation whose
+        # files a recycled number would overwrite mid-fanout
+        gen = max([committed] + self._shard_gens_on_disk()) + 1
+        for i, payload in enumerate(payloads):
+            atomic_write_bytes(
+                os.path.join(self.dir, f"train_state.g{gen}.shard{i}.pkl"),
+                pickle.dumps(payload), failpoint="persist.shard")
+        if payloads:
+            # the state is SELF-describing: it records which shard
+            # generation it was written with, and load() resolves shard
+            # files from the state, never the manifest — a kill between
+            # this write and the manifest commit must not let a stale
+            # manifest join generation G's skeleton with G-1's shards
+            # (every gen-G shard file is durably on disk before this
+            # write, and reaping runs only after the commit)
+            split = dict(split)
+            split["__ckpt_gen__"] = gen
+            split["__ckpt_shards__"] = len(payloads)
+        atomic_write_bytes(os.path.join(self.dir, self.STATE),
+                           pickle.dumps(_to_host(split)))
         manifest["state_path"] = self.STATE
-        manifest["checkpoints"] = int(manifest.get("checkpoints", 0)) + 1
+        manifest["checkpoints"] = committed + 1
+        manifest["state_gen"] = gen if payloads else None
+        manifest["state_shards"] = len(payloads)
         if progress:
             manifest["progress"] = progress
         self.rec.write(manifest)
+        self._reap_shard_files(keep_gen=gen)
         self.writes += 1
         self.write_s += time.monotonic() - t0
         self._last_write = time.monotonic()
         failpoints.hit("train.checkpoint")
+
+    def _shard_files(self):
+        """(generation, filename) of every shard file in the dir — the one
+        scan generation numbering and reaping both read."""
+        import re
+
+        pat = re.compile(r"train_state\.g(\d+)\.shard\d+\.pkl$")
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        return [(int(m.group(1)), name)
+                for m, name in ((pat.match(n), n) for n in names) if m]
+
+    def _shard_gens_on_disk(self) -> list:
+        return [gen for gen, _ in self._shard_files()]
+
+    def _reap_shard_files(self, keep_gen: int) -> None:
+        """Drop shard files of superseded generations (post-commit only —
+        the previous generation must survive until the new state, which is
+        durably renamed by now, references the new one). Best-effort: a
+        leftover file costs disk, never correctness (resume reads only the
+        generation the state itself records)."""
+        for gen, name in self._shard_files():
+            if gen != keep_gen:
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
 
     def mark_completed(self, model_key: str | None = None) -> None:
         manifest = self.rec.read() or {}
@@ -497,4 +667,20 @@ class TrainingRecovery:
         if manifest.get("state_path"):
             with open(os.path.join(dir, manifest["state_path"]), "rb") as fh:
                 state = _ModelUnpickler(fh).load()
+            # shard generation + count come from the STATE itself (written
+            # atomically after its shard files), not the manifest — the
+            # manifest may be one commit behind after a kill in the window
+            # between the state write and the manifest write
+            gen = state.pop("__ckpt_gen__", None) \
+                if isinstance(state, dict) else None
+            nshards = int(state.pop("__ckpt_shards__", 0) or 0) \
+                if isinstance(state, dict) else 0
+            if gen is not None and nshards:
+                payloads = []
+                for i in range(nshards):
+                    p = os.path.join(dir,
+                                     f"train_state.g{gen}.shard{i}.pkl")
+                    with open(p, "rb") as fh:
+                        payloads.append(_ModelUnpickler(fh).load())
+                state = _join_state_shards(state, payloads)
         return builder_cls, params, state, manifest

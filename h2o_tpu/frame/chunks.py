@@ -498,7 +498,15 @@ class BinnedView(Vec):
         edges_dev = jnp.asarray(np.ascontiguousarray(edges_np, np.float32))
         codes = [bin_column(_coldata(c), edges_dev[f], dtype=dtype)
                  for f, c in enumerate(cols)]
-        return BinnedView(_stack_codes(*codes), edges_np, names=names)
+        # EXPLICIT row_sharding for the packed matrix: the per-column code
+        # vectors are row-sharded, but the stacked result's placement is
+        # otherwise whatever GSPMD picked — the training-matrix layout the
+        # per-chip HBM budget depends on must be policy, not inference
+        # (each device holds exactly its plen/n_shards row slice, which the
+        # shard_map trainer consumes without any relayout)
+        matrix = jax.device_put(_stack_codes(*codes),
+                                meshmod.row_sharding(meshmod.default_mesh()))
+        return BinnedView(matrix, edges_np, names=names)
 
     def __repr__(self) -> str:
         shape = None if self._data is None else tuple(self._data.shape)

@@ -30,13 +30,15 @@ from ..backend.jobs import Job
 from ..backend.memory import hbm_budget_bytes
 from ..frame.frame import Frame
 from ..frame.vec import T_CAT, Vec
-from ..parallel.mesh import default_mesh, replicated
+from ..parallel.mesh import (ROWS, default_mesh, n_row_shards,
+                             per_shard_nbytes, put_replicated, put_sharded)
 from .distributions import Bernoulli, Gaussian, get_distribution
 from .model_base import Model, ModelBuilder, ModelOutput, Parameters, make_metrics
 from .tree.binning import (bin_matrix, compute_bin_edges,
                            compute_bin_edges_cols)
 from .tree.engine import (TreeConfig, make_train_fn, plan_hist_groups,
-                          predict_forest, sample_tree_phases)
+                          predict_forest, psum_payload_bytes,
+                          sample_tree_phases)
 
 #: last build's training-matrix accounting (mode, per-matrix bytes) — the
 #: bench binned-storage leg and the chunk-store tests read this to put the
@@ -524,7 +526,7 @@ class GBM(ModelBuilder):
             X = fr.as_matrix(names)
             edges_np = compute_bin_edges(X, is_cat, p.nbins, **bin_kw)
         mesh = default_mesh()
-        edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf), replicated(mesh))
+        edges = put_replicated(np.nan_to_num(edges_np, nan=np.inf), mesh)
         mono_np = np.zeros(len(names), dtype=np.float32)
         for col, d in (getattr(p, "monotone_constraints", None) or {}).items():
             if col not in names:
@@ -534,12 +536,12 @@ class GBM(ModelBuilder):
                 raise ValueError(f"monotone_constraints on categorical column "
                                  f"'{col}' (numeric only, as in the reference)")
             mono_np[names.index(col)] = float(np.sign(d))
-        mono = jax.device_put(mono_np, replicated(mesh))
+        mono = put_replicated(mono_np, mesh)
         imat_np = _interaction_matrix(names,
                                       getattr(p, "interaction_constraints",
                                               None))
-        imat = jax.device_put(imat_np, replicated(mesh))
-        edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))
+        imat = put_replicated(imat_np, mesh)
+        edge_ok = put_replicated(~np.isnan(edges_np), mesh)
         binned_view = None
         if use_binned:
             # device-resident coded training matrix, packed column-by-column
@@ -549,7 +551,7 @@ class GBM(ModelBuilder):
             binned_view = BinnedView.build(feat_vecs, edges_np, names=names)
             Xb = binned_view.matrix
         else:
-            Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
+            Xb = bin_matrix(X, put_replicated(edges_np, mesh))
         plen = Xb.shape[0]
         global LAST_TRAIN_MATRIX_BYTES
         LAST_TRAIN_MATRIX_BYTES = {
@@ -558,6 +560,11 @@ class GBM(ModelBuilder):
             "binned_bytes": int(Xb.size * Xb.dtype.itemsize),
             "binned_dtype": str(Xb.dtype),
             "cells": int(plen * len(names)),
+            # multi-chip accounting: the LARGEST single-device slice of the
+            # training matrix (row-sharded ⇒ ~binned_bytes/n_shards; the
+            # per-chip HBM number the sharded bench leg steers by)
+            "per_shard_bytes": per_shard_nbytes(Xb),
+            "n_row_shards": n_row_shards(mesh),
         }
 
         # initial prediction (`hex/tree/gbm/GBM.java:265` init) — one
@@ -574,8 +581,8 @@ class GBM(ModelBuilder):
         use_sets = bool(is_cat.any()) and getattr(self, "_use_set_splits",
                                                   True)
         nedges_np = (~np.isnan(edges_np)).sum(axis=1).astype(np.int32)
-        iscat_dev = jax.device_put(is_cat, replicated(mesh))
-        nedges_dev = jax.device_put(nedges_np, replicated(mesh))
+        iscat_dev = put_replicated(is_cat, mesh)
+        nedges_dev = put_replicated(nedges_np, mesh)
         # histogram accumulation plan: width-bucketed hist_groups (auto-tuned
         # from the per-column bin counts) plus a row block fitted to the live
         # HBM budget, so wide bin spaces (high-cardinality categoricals /
@@ -588,6 +595,10 @@ class GBM(ModelBuilder):
             n_lv_max=2 ** max(cfg.max_depth - 1, 0), nvals=3)
         cfg = dataclasses.replace(cfg, use_sets=use_sets, block_rows=blk,
                                   hist_groups=hist_groups)
+        # per-tree ICI reduction payload (per-level hist psums + the node-
+        # totals psum) — static accounting the sharded bench leg records
+        LAST_TRAIN_MATRIX_BYTES["psum_bytes_per_tree"] = \
+            psum_payload_bytes(cfg, len(names))
         if not self.drf_mode and K == 1 and dist.name in ("laplace",
                                                           "quantile"):
             # exact gamma leaves: median (laplace) / alpha-quantile of the
@@ -750,6 +761,16 @@ class GBM(ModelBuilder):
         # a 1000-tree run at the same scoring cadence.
         train_fn = make_train_fn(dataclasses.replace(cfg, ntrees=interval),
                                  grad_fn, mesh, cache_key=grad_key)
+        # pin the carried f to the trainer's OUTPUT sharding before the AOT
+        # lower: chunk 0's freshly-broadcast f can come back replicated
+        # (GSPMD's choice for a data-independent broadcast) while every
+        # later chunk carries the P(ROWS)-sharded train output — an AOT
+        # executable compiled for the former rejects the latter, and the
+        # whole job silently pays the jitted fallback on a multi-shard mesh
+        from jax.sharding import PartitionSpec as _Pspec
+
+        fspec = _Pspec(ROWS) if K == 1 else _Pspec(None, ROWS)
+        f = put_sharded(f, fspec, mesh)
         # AOT lower+compile the uniform-chunk step NOW (build setup), so the
         # chunk loop dispatches a prebuilt executable and the compile wall /
         # persistent-cache replay is measured at one attributable site
@@ -793,9 +814,10 @@ class GBM(ModelBuilder):
             start_ci = int(rs["chunks_done"])
             parts = [tuple(jnp.asarray(np.asarray(a)) for a in t)
                      for t in rs["parts"]]
-            # UNCOMMITTED restore: the compiled train step re-places it to
-            # match Xb's row sharding (values, not placement, carry parity)
-            f = jnp.asarray(np.asarray(rs["f"]))
+            # restore to the trainer's output sharding (values, not
+            # placement, carry parity — and matching the AOT executable's
+            # compiled sharding keeps the prebuilt step usable on resume)
+            f = put_sharded(np.asarray(rs["f"]), fspec, mesh)
             oob_sum = (None if rs.get("oob_sum") is None
                        else jnp.asarray(np.asarray(rs["oob_sum"])))
             oob_cnt = (None if rs.get("oob_cnt") is None

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as _P
 
 from ..backend.jobs import Job
 from ..frame.frame import Frame
@@ -189,6 +190,22 @@ def _iteration_kernel_args(X, y, w, beta, linkname_id):  # pragma: no cover
     raise RuntimeError("placeholder")
 
 
+def _row_shardable(X, mesh) -> bool:
+    """True when a design matrix can dispatch through the MRTask-shaped
+    shard_map Gram on ``mesh``'s rows axis: committed to that mesh (or
+    uncommitted) and NOT feature-parallel (a cols-partitioned design —
+    `_shard_cols` — keeps the GSPMD einsum path that shards the Gram over
+    the feature axis too)."""
+    sh = getattr(X, "sharding", None)
+    m = getattr(sh, "mesh", None)
+    if m is not None and m != mesh:
+        return False
+    spec = getattr(sh, "spec", None)
+    if spec is not None and len(spec) > 1 and spec[1] is not None:
+        return False
+    return True
+
+
 def _make_irls_kernel(family: Family):
     """One GLMIterationTask: (X, y, w, beta, offset) -> (Gram, XWz, dev, neff).
 
@@ -196,13 +213,23 @@ def _make_irls_kernel(family: Family):
     kernels layer (`backend/kernels/gram.py`): XᵀWX and XᵀWz accumulate in
     ONE pass over row blocks — the (R, P) weighted design never
     materializes — executed as the blocked-scan oracle or the fused Pallas
-    kernel per ``H2O_TPU_HIST_KERNEL``. The outputs stay replicated
-    (P,P)/(P,); XLA inserts the cross-shard psum (`GLMTask.java:35-37` in
-    one expression)."""
-    from ..backend.kernels import gram as gram_kernels
+    kernel per ``H2O_TPU_HIST_KERNEL``.
 
-    @jax.jit
-    def step(X, y, w, beta, offset):
+    Dispatch is the DrJAX MapReduce shape on a multi-shard mesh: the whole
+    step runs inside ``mesh.shard_map`` over the ``rows`` axis — each
+    device feeds ONLY its local row shard through the kernels layer (the
+    per-block math is shard-size-agnostic, so it slots in unchanged) and
+    the (P,P)/(P,) partials ride ONE ``psum`` over ICI, exactly
+    `GLMTask.java:35-37`'s map + cluster reduce. Feature-parallel designs
+    (`_shard_cols`) and row counts that don't divide the shard count keep
+    the jit/GSPMD fallback. Sharded-vs-single coefficients agree to
+    reduction-order ulps (the psum combines per-shard partial Grams in a
+    different order than one device's sequential block scan) — pinned at
+    tolerance in tests/test_sharded_frames.py."""
+    from ..backend.kernels import gram as gram_kernels
+    from ..parallel.mesh import ROWS, default_mesh, n_row_shards, shard_map
+
+    def _core(X, y, w, beta, offset):
         eta = X @ beta + offset
         mu = family.linkinv(eta)
         d = family.dmu_deta(eta)
@@ -212,6 +239,29 @@ def _make_irls_kernel(family: Family):
         G, b = gram_kernels.gram_accumulate(X, W, z)
         dev = jnp.sum(family.deviance(y, mu, w))
         return G, b, dev, jnp.sum(w)
+
+    jit_step = jax.jit(_core)
+    sharded: dict = {}
+
+    def step(X, y, w, beta, offset):
+        mesh = default_mesh()
+        ns = n_row_shards(mesh)
+        if (ns > 1 and X.shape[0] % ns == 0 and jnp.ndim(w) == 1
+                and jnp.ndim(offset) == 1 and _row_shardable(X, mesh)):
+            prog = sharded.get(mesh)
+            if prog is None:
+                def spmd(X, y, w, beta, offset):
+                    out = _core(X, y, w, beta, offset)
+                    return tuple(jax.lax.psum(o, ROWS) for o in out)
+
+                prog = jax.jit(shard_map(
+                    spmd, mesh=mesh,
+                    in_specs=(_P(ROWS, None), _P(ROWS), _P(ROWS), _P(),
+                              _P(ROWS)),
+                    out_specs=(_P(), _P(), _P(), _P()), check_vma=False))
+                sharded[mesh] = prog
+            return prog(X, y, w, beta, offset)
+        return jit_step(X, y, w, beta, offset)
 
     return step
 
@@ -444,9 +494,9 @@ def _shard_cols(X, y_dev, fp: int):
     them."""
     if fp <= 1:
         return X, y_dev, 0
-    from jax.sharding import NamedSharding, PartitionSpec as _P
+    from jax.sharding import PartitionSpec as _P
 
-    from ..parallel.mesh import COLS, ROWS as _R, make_mesh
+    from ..parallel.mesh import COLS, ROWS as _R, make_mesh, put_sharded
 
     ndev = len(jax.devices())
     if ndev % fp:
@@ -457,8 +507,8 @@ def _shard_cols(X, y_dev, fp: int):
         X = jnp.concatenate(
             [X, jnp.zeros((X.shape[0], pad_cols), X.dtype)], axis=1)
     mesh2 = make_mesh(row_parallel=ndev // fp)
-    X = jax.device_put(X, NamedSharding(mesh2, _P(_R, COLS)))
-    y_dev = jax.device_put(y_dev, NamedSharding(mesh2, _P(_R)))
+    X = put_sharded(X, _P(_R, COLS), mesh2)
+    y_dev = put_sharded(y_dev, _P(_R), mesh2)
     return X, y_dev, pad_cols
 
 

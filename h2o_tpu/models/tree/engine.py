@@ -676,6 +676,22 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
     return feat, thr, nanL, val, garr, catd, node
 
 
+def psum_payload_bytes(cfg: TreeConfig, F: int, nvals: int = 3) -> int:
+    """Bytes ONE tree's ICI reductions move per shard: the per-level
+    histogram psums (per-group when ``cfg.hist_groups`` is set — the wire
+    carries Σ F_g·B_g cells instead of the padded F·B_max) plus the final
+    per-node totals psum. Pure accounting off the static config — the
+    bench ``sharded`` leg records it next to the per-shard matrix bytes so
+    the compute-vs-wire tradeoff of a shard count is on the record."""
+    B = cfg.nbins + 1
+    groups = _norm_groups(cfg.hist_groups) if cfg.hist_groups else None
+    cells_per_lv = (F * B if groups is None
+                    else sum(len(idxs) * Bg for idxs, Bg, _ in groups))
+    hist_cells = sum((2 ** level) * cells_per_lv
+                     for level in range(cfg.max_depth))
+    return (hist_cells + cfg.n_nodes) * nvals * 4
+
+
 _TRAIN_FN_CACHE: dict = {}
 
 

@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 from ..backend.jobs import Job
 from ..frame.frame import Frame
 from ..frame.vec import Vec
-from ..parallel.mesh import ROWS, default_mesh, replicated, shard_map
+from ..parallel.mesh import ROWS, default_mesh, put_replicated, shard_map
 from .drf import DRFParameters
 from .metrics import ModelMetrics
 from .model_base import Model, ModelBuilder, ModelOutput
@@ -363,10 +363,9 @@ class UpliftDRF(ModelBuilder):
         cfg = dataclasses.replace(cfg, hist_groups=hist_groups,
                                   block_rows=blk)
 
-        edges = jax.device_put(np.nan_to_num(edges_np, nan=np.inf),
-                               replicated(mesh))
-        edge_ok = jax.device_put(~np.isnan(edges_np), replicated(mesh))
-        Xb = bin_matrix(X, jax.device_put(edges_np, replicated(mesh)))
+        edges = put_replicated(np.nan_to_num(edges_np, nan=np.inf), mesh)
+        edge_ok = put_replicated(~np.isnan(edges_np), mesh)
+        Xb = bin_matrix(X, put_replicated(edges_np, mesh))
 
         train_fn = make_uplift_train_fn(cfg, p.uplift_metric, mesh)
         seed = p.seed if p.seed not in (-1, None) else 1234

@@ -19,9 +19,12 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..frame.frame import Frame
 from ..frame.vec import T_STR, Vec
+from ..parallel import mesh as meshmod
+from ..parallel.mesh import ROWS, shard_map
 
 
 def sort(fr: Frame, by: list[str] | None = None, ascending: list[bool] | None = None) -> Frame:
@@ -216,6 +219,128 @@ def _merge_expand(l_cols, r_cols_s, lo, counts, cum, total: int):
     return out_l, out_r
 
 
+#: compiled sharded-expand programs keyed by (mesh, total, plen, n_l, n_r) —
+#: merges are host-driven and rare, but a grid of same-shape joins (CV fold
+#: assembly) should not re-trace per call
+_EXPAND_PROGS: dict = {}
+
+
+def _sharded_expand_program(mesh, total: int, plen: int, n_l: int, n_r: int):
+    """Phase 2 as explicit per-shard work inside ``shard_map`` — the fix for
+    the jax-0.4.x GSPMD mis-partition that kept this phase pinned replicated
+    since PR 1 (GSPMD computed the Δ-scatter + cumsum fills per-shard on
+    row-sharded operands, so outputs diverged at the first shard boundary).
+
+    The key structural fact: every phase-2 output row depends only on the
+    PRE-expansion tables (per-left-row ``lo``/``counts``/``cum`` and the
+    sorted right payload — ln/rn-sized, replicated like the pinned path
+    already held them), never on other output rows. So each shard of the
+    ``rows`` axis computes exactly its own ``L = plen / n_shards`` slice of
+    the (possibly cartesian-expanded, ≫ ln) output with offset-aware fills:
+
+    - the global ``cumsum(scatter(Δ at starts))`` fill at positions
+      [off, off+L) equals ``Σ Δ[starts < off]  +  local-cumsum of the Δs
+      landing inside the shard`` — int32 adds wrap mod 2³², so the split
+      sum is BIT-exact against the replicated oracle regardless of order;
+    - the gather-via-sort right-side expansion is slot-local: sorting the
+      shard's own (pos, slot) pairs and repeating each right row's bits
+      over its local occupancy assigns every slot ``c[pos[slot]]`` exactly,
+      independent of what other shards hold.
+
+    Outputs land row-sharded (``P(ROWS)``, padded to ``plen`` with NaN
+    tails per the Vec padding convention) — per-chip output HBM drops to
+    ~1/n_shards where the pinned path replicated the whole expansion.
+    ``tests/test_sharded_frames.py`` pins the sharded output bit-equal to
+    the replicated oracle; ``H2O_TPU_SHARDED_MERGE=0`` reverts."""
+    key = (mesh, total, plen, n_l, n_r)
+    hit = _EXPAND_PROGS.get(key)
+    if hit is not None:
+        return hit
+    shards = mesh.shape[ROWS]
+    L = plen // shards
+
+    def spmd(l_cols, r_cols_s, lo, counts, cum):
+        off = jax.lax.axis_index(ROWS).astype(jnp.int32) * L
+        rowid = off + jnp.arange(L, dtype=jnp.int32)
+        starts = jnp.concatenate([jnp.zeros(1, cum.dtype), cum[:-1]])
+
+        def fill(per_row):
+            # the shard's window of the global Δ-scatter + cumsum: deltas
+            # before the window contribute a scalar base (order-free int32
+            # wrap-around sum — exact), deltas inside it scatter locally
+            delta = jnp.diff(per_row, prepend=per_row[:1])
+            inside = (starts >= off) & (starts < off + L)
+            idx = jnp.clip(starts - off, 0, L - 1)
+            buf = jnp.zeros(L, per_row.dtype).at[idx].add(
+                jnp.where(inside, delta, jnp.zeros_like(delta)))
+            base = jnp.sum(jnp.where(starts < off, delta,
+                                     jnp.zeros_like(delta))) + per_row[0]
+            return jnp.cumsum(buf) + base
+
+        row_start = fill(starts)
+        row_lo = fill(lo)
+        row_matched = fill((counts > 0).astype(jnp.int32)) > 0
+        within = rowid - row_start
+        rn = r_cols_s[0].shape[0] if r_cols_s else 1
+        r_srt_pos = jnp.clip(row_lo + within, 0, rn - 1)
+        valid = rowid < total  # padding tail rows -> NaN (Vec convention)
+
+        def fill_f32(col):
+            bits = jax.lax.bitcast_convert_type(col.astype(jnp.float32),
+                                                jnp.int32)
+            return jax.lax.bitcast_convert_type(fill(bits), jnp.float32)
+
+        out_l = tuple(jnp.where(valid, fill_f32(c), jnp.nan)
+                      for c in l_cols)
+
+        if r_cols_s:
+            # gather-via-sort over the SHARD's slots (same bandwidth-bound
+            # construction as the oracle, applied to the local slice):
+            # sort (pos, slot), per-right-row occupancy from searchsorted
+            # bounds, repeat each c[j]'s bits over its occupancy, sort back
+            rn_i = r_cols_s[0].shape[0]
+            pos_s, i_s = jax.lax.sort(
+                (r_srt_pos, jnp.arange(L, dtype=jnp.int32)),
+                num_keys=1, is_stable=True)
+            bounds = jnp.searchsorted(pos_s,
+                                      jnp.arange(rn_i + 1, dtype=jnp.int32))
+            occ_starts = bounds[:-1]
+
+            def repeat_bits(c):
+                bits = jax.lax.bitcast_convert_type(c.astype(jnp.float32),
+                                                    jnp.int32)
+                delta = jnp.diff(bits, prepend=bits[:1])
+                buf = jnp.zeros(L, jnp.int32).at[occ_starts].add(
+                    delta, mode="drop")
+                buf = buf.at[0].add(bits[0] - delta[0])
+                return jax.lax.bitcast_convert_type(jnp.cumsum(buf),
+                                                    jnp.float32)
+
+            expanded = tuple(repeat_bits(c) for c in r_cols_s)
+            unsorted = jax.lax.sort((i_s,) + expanded, num_keys=1,
+                                    is_stable=True)[1:]
+            out_r = tuple(jnp.where(valid & row_matched, c, jnp.nan)
+                          for c in unsorted)
+        else:
+            out_r = ()
+        return out_l, out_r
+
+    in_specs = ((P(),) * n_l, (P(),) * n_r, P(), P(), P())
+    out_specs = ((P(ROWS),) * n_l, (P(ROWS),) * n_r)
+    prog = jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))
+    _EXPAND_PROGS[key] = prog
+    return prog
+
+
+def _merge_schema(left: Frame, right: Frame, key: str) -> list:
+    """Output column order + source Vec (the type/domain carrier): all
+    left columns, then right's non-key columns — ONE schema shared by the
+    zero-match short-circuit and the expansion tail."""
+    return ([(n, left.vec(n)) for n in left.names]
+            + [(n, right.vec(n)) for n in right.names if n != key])
+
+
 def _merge_device(left: Frame, right: Frame, key: str, all_x: bool) -> Frame:
     """Single-key numeric join on device in TWO compiled programs (the host
     sync between them fixes the data-dependent output size). No per-row host
@@ -232,32 +357,44 @@ def _merge_device(left: Frame, right: Frame, key: str, all_x: bool) -> Frame:
     r_payload = tuple(right.vec(n).data[:rn] for n in right.names if n != key)
     r_cols_s, lo, counts, cum = _merge_ranges(lk, rk, r_payload, all_x)
     total = int(cum[-1])  # the one host sync
+    sch = _merge_schema(left, right, key)
+    if total == 0:
+        # zero matches (inner join, disjoint keys): phase 2's fills assume
+        # ≥1 output row (`buf.at[0]`), so build the empty frame directly
+        return Frame([n for n, _ in sch],
+                     [Vec.from_numpy(np.zeros(0, np.float32), type=v.type,
+                                     domain=v.domain) for _, v in sch])
     l_cols = tuple(left.vec(n).data[:ln] for n in left.names)
-    # Phase 2 runs REPLICATED: its Δ-scatter + cumsum fills are exact only
-    # over the whole array, and the jax-0.4.x GSPMD partitioner computes
-    # them per-shard on row-sharded operands (outputs diverge at the first
-    # shard boundary — caught by __graft_entry__'s multichip dry run). A
-    # no-op on single-device meshes; multi-chip merges trade replicated
-    # HBM for correctness until the partition-aware fill lands.
-    from ..parallel.mesh import default_mesh, replicated
+    # Phase 2's Δ-scatter + cumsum fills are exact only over the whole
+    # array, and the jax-0.4.x GSPMD partitioner computes them per-shard on
+    # row-sharded operands (outputs diverge at the first shard boundary —
+    # caught by __graft_entry__'s multichip dry run). The production path
+    # therefore runs the fills as EXPLICIT per-shard work inside shard_map
+    # (`_sharded_expand_program`): pre-expansion inputs replicated, the
+    # expanded output row-sharded. `_merge_expand` stays as the replicated
+    # ORACLE the sharded output is bit-parity-pinned against
+    # (H2O_TPU_SHARDED_MERGE=0 reverts to it; single-row-shard meshes take
+    # it too — replication is a no-op there).
+    from ..utils import knobs
 
-    rep = replicated(default_mesh())
-    put = lambda t: tuple(jax.device_put(c, rep) for c in t)
-    out_l, out_r = _merge_expand(put(l_cols), put(r_cols_s),
-                                 jax.device_put(lo, rep),
-                                 jax.device_put(counts, rep),
-                                 jax.device_put(cum, rep), total)
+    mesh = meshmod.default_mesh()
+    if (meshmod.n_row_shards(mesh) > 1
+            and knobs.get_bool("H2O_TPU_SHARDED_MERGE")):
+        plen = meshmod.padded_len(total, mesh)
+        prog = _sharded_expand_program(mesh, total, plen, len(l_cols),
+                                       len(r_cols_s))
+        out_l, out_r = prog(l_cols, r_cols_s, lo, counts, cum)
+    else:
+        put = lambda t: tuple(meshmod.put_replicated(c, mesh) for c in t)
+        out_l, out_r = _merge_expand(put(l_cols), put(r_cols_s),
+                                     meshmod.put_replicated(lo, mesh),
+                                     meshmod.put_replicated(counts, mesh),
+                                     meshmod.put_replicated(cum, mesh),
+                                     total)
 
-    names, vecs = [], []
-    for n, col in zip(left.names, out_l):
-        v = left.vec(n)
-        names.append(n)
-        vecs.append(Vec.from_device(col, total, type=v.type, domain=v.domain))
-    for n, col in zip((n for n in right.names if n != key), out_r):
-        v = right.vec(n)
-        names.append(n)
-        vecs.append(Vec.from_device(col, total, type=v.type, domain=v.domain))
-    return Frame(names, vecs)
+    return Frame([n for n, _ in sch],
+                 [Vec.from_device(col, total, type=v.type, domain=v.domain)
+                  for (_, v), col in zip(sch, out_l + out_r)])
 
 
 def merge(left: Frame, right: Frame, by: list[str] | None = None,

@@ -380,12 +380,7 @@ def _prometheus_model_lines() -> list[str]:
         return []
     lines: list[str] = []
 
-    def esc(mid: str) -> str:
-        """Prometheus label-value escaping (backslash, quote, newline) —
-        serving ids are client-chosen, and one bad id must not make the
-        whole scrape unparseable."""
-        return (str(mid).replace("\\", r"\\").replace('"', r'\"')
-                .replace("\n", r"\n"))
+    esc = telemetry.prom_label_escape  # serving ids are client-chosen
 
     snaps = sorted((esc(mid), s) for mid, s in rt.stats().items())
     if not snaps:

@@ -151,6 +151,11 @@ _failpoint("train.checkpoint",
 _failpoint("persist.checkpoint",
            "backend/persist.py atomic state write, between temp-write and "
            "rename — a crash here must leave the previous state intact")
+_failpoint("persist.shard",
+           "backend/persist.py shard-aware checkpoint, before EACH "
+           "per-device shard-state write — raise@K kills the coordinator "
+           "mid-shard-fanout; the uncommitted generation must be invisible "
+           "to resume (manifest commits last)")
 _failpoint("io.remote",
            "io/hdfs.py + io/cloud.py remote-read request wrappers — "
            "raise(conn)*N exercises the typed retry without a network")

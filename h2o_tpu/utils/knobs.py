@@ -85,6 +85,17 @@ _knob("H2O_TPU_MAX_FRAME_BYTES", "int", 12 * 1024 ** 3,
 _knob("H2O_TPU_BINNED_STORE", "bool", True,
       "train trees from the chunk store's int8/int16 binned view instead "
       "of the stacked f32 matrix (frame/chunks.py); 0 reverts")
+_knob("H2O_TPU_ROW_SHARDS", "int", 0,
+      "row shards of the lazily-built default mesh (parallel/mesh.py): "
+      "how many devices split the data-parallel 'rows' axis; 0/unset = "
+      "all devices (the historic default). Read ONCE at mesh "
+      "construction — set it before any frame is placed (the bench "
+      "'sharded' leg runs each value in its own subprocess)")
+_knob("H2O_TPU_SHARDED_MERGE", "bool", True,
+      "run the rapids merge expansion phase-2 sharded over the mesh rows "
+      "axis (explicit per-shard delta-scatter+cumsum fills inside "
+      "shard_map, rapids/merge.py); 0 reverts to the replicated oracle "
+      "the sharded path is bit-parity-pinned against")
 
 # -- engine knobs -----------------------------------------------------------
 _knob("H2O_TPU_EXACT_BIN_ROWS", "int", 16384,
@@ -236,8 +247,12 @@ _knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
       "rows for the binned-store stacked-vs-binned leg")
 _knob("H2O_TPU_BENCH_WORKLOADS", "str",
       "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,serving_wire,"
-      "recovery,cold_start,airlines",
+      "recovery,cold_start,sharded,airlines",
       "comma list of bench workloads to run")
+_knob("H2O_TPU_BENCH_SHARDED_ROWS", "int", 400_000,
+      "rows for the sharded leg (same GBM at 1 vs N row shards, each in "
+      "its own subprocess; per-shard peak matrix bytes + psum payload + "
+      "wall land in the sidecar)")
 _knob("H2O_TPU_BENCH_RECOVERY_ROWS", "int", 500_000,
       "rows for the recovery leg (checkpoint overhead + resume-to-parity)")
 _knob("H2O_TPU_BENCH_COLDSTART_ROWS", "int", 60_000,

@@ -397,6 +397,15 @@ def add_prometheus_provider(fn) -> None:
         _PROM_PROVIDERS.append(fn)
 
 
+def prom_label_escape(label) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline) — the
+    ONE implementation every labelled provider shares: label values are
+    externally chosen (client model ids, backend device names) and one bad
+    value must not make the whole scrape unparseable."""
+    return (str(label).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
 def prometheus() -> str:
     """Prometheus text exposition (format 0.0.4) of the whole registry —
     dots become underscores, everything is prefixed ``h2o_tpu_``."""

@@ -129,6 +129,11 @@ def pytest_configure(config):
         "markers", "kernels: Pallas histogram/Gram kernels vs the XLA "
                    "oracle — bit-parity suite + cold-start compile cache "
                    "(pytest -m kernels, h2o_tpu/backend/kernels/)")
+    config.addinivalue_line(
+        "markers", "sharded: multi-chip sharded frames — sharded-vs-"
+                   "single parity, sharded merge vs the replicated "
+                   "oracle, shard-aware checkpoints, per-device ledger "
+                   "(pytest -m sharded, tests/test_sharded_frames.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -101,8 +101,7 @@ def main():
             train = make_train_fn(cfg, lambda y, f, w: (w * (f - y), w), m)
             args = (row_shard(Xb), row_shard(yv), row_shard(wv),
                     row_shard(f0))
-            rep = lambda a: jax.device_put(
-                jnp.asarray(a), NamedSharding(m, P()))
+            rep = lambda a: meshmod.put_replicated(jnp.asarray(a), m)
             f, osum, ocnt, trees = train(
                 *args, rep(edges), rep(edge_ok), rep(keys),
                 rep(np.ones(cfg.ntrees, np.float32)),

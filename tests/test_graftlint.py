@@ -222,6 +222,19 @@ from h2o_tpu.backend.kernels import hist
 out = hist.level_hist_blocks
 """,
     ),
+    "direct-device-put": (
+        """
+import jax
+from h2o_tpu.parallel.mesh import default_mesh, replicated
+
+arr = jax.device_put([1.0], replicated(default_mesh()))
+""",
+        """
+from h2o_tpu.parallel.mesh import put_replicated
+
+arr = put_replicated([1.0])
+""",
+    ),
 }
 
 
@@ -618,7 +631,47 @@ def test_scan_set_includes_the_advertised_tree():
 
 def test_every_rule_registered_exactly_once():
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 12
+    assert len(ids) == len(set(ids)) == 13
+
+
+def test_direct_device_put_forms():
+    """Rule 13: every mesh-sharded device_put spelling outside the
+    sanctioned placement sites fires — via-variable shardings included —
+    while device-object placement (serving replica pinning) stays clean."""
+    named = """
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+arr = jax.device_put(x, NamedSharding(mesh, P("rows")))
+"""
+    assert "direct-device-put" in _rules_hit(named)
+    via_var = """
+import jax
+from h2o_tpu.parallel.mesh import default_mesh, row_sharding
+
+rs = row_sharding(default_mesh())
+arr = jax.device_put(x, rs)
+"""
+    assert "direct-device-put" in _rules_hit(via_var)
+    kw = """
+import jax
+from h2o_tpu.parallel.mesh import replicated
+
+arr = jax.device_put(x, device=replicated())
+"""
+    assert "direct-device-put" in _rules_hit(kw)
+    # frame layer + mesh module are the sanctioned sites
+    for ok_path in ("h2o_tpu/parallel/mesh.py", "h2o_tpu/frame/vec.py",
+                    "h2o_tpu/frame/chunks.py"):
+        assert "direct-device-put" not in _rules_hit(named, relpath=ok_path)
+    # placing onto a bare Device (replica pinning) is device selection,
+    # not frame-data partitioning
+    dev = """
+import jax
+
+arr = jax.device_put(x, jax.devices()[0])
+"""
+    assert "direct-device-put" not in _rules_hit(dev)
 
 
 def test_direct_pallas_call_forms():

@@ -1,0 +1,448 @@
+"""Multi-chip sharded frames — the PR 10 acceptance pins.
+
+Everything here runs on the suite's 8-device virtual CPU mesh
+(`tests/conftest.py`), which exercises the REAL collectives:
+
+- GBM forest + predictions trained on the 8-shard mesh vs a single-device
+  mesh: tree STRUCTURE (split features, NA routing) bit-equal; float
+  components (thresholds, leaf values, margins) equal to reduction-order
+  ulps — psum's cross-device tree reduction sums in a different order than
+  one device's sequential scan, a documented pinned-tolerance;
+- GLM coefficients through the shard_map + psum Gram: sharded-vs-single
+  at the same pinned tolerance;
+- frame rollups ride `mr_reduce` and agree with host numpy exactly where
+  the monoid is order-free (min/max/counts) and to ulps elsewhere;
+- coded columns spill and rehydrate back to ROW-SHARDED placement, and
+  the Cleaner's per-device ledger tracks every device's slice;
+- the re-enabled sharded merge phase-2 is BIT-equal to the replicated
+  oracle (`H2O_TPU_SHARDED_MERGE=0`);
+- shard-aware checkpoints: per-device generation-numbered shard files,
+  manifest committed last, kill injected MID-SHARD-FANOUT (`persist.shard`
+  failpoint) leaves the previous generation resumable BIT-equal;
+- `mrtask.dispatch` armed under a sharded dispatch raises typed (no hang).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import h2o_tpu
+from h2o_tpu.backend.memory import CLEANER
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.models.gbm import GBM, GBMParameters
+from h2o_tpu.models.glm import GLM, GLMParameters
+from h2o_tpu.parallel import mesh as meshmod
+from h2o_tpu.utils import failpoints as fp
+
+pytestmark = pytest.mark.sharded
+
+_RNG = np.random.default_rng(11)
+_N = 320
+_X1 = _RNG.normal(size=_N).astype(np.float32)
+_X2 = _RNG.normal(size=_N).astype(np.float32)
+_C = _RNG.integers(0, 4, size=_N).astype(np.float32)
+_Y = ((_X1 - 0.5 * _X2 + 0.4 * _C
+       + _RNG.normal(scale=0.4, size=_N)) > 0.3).astype(np.float32)
+
+
+def _frame(mesh=None):
+    fr = Frame(["x1", "x2"], [Vec.from_numpy(_X1, mesh=mesh),
+                              Vec.from_numpy(_X2, mesh=mesh)])
+    fr.add("c", Vec.from_numpy(_C, type=T_CAT,
+                               domain=["a", "b", "c", "d"], mesh=mesh))
+    fr.add("y", Vec.from_numpy(_Y, type=T_CAT, domain=["0", "1"],
+                               mesh=mesh))
+    return fr
+
+
+def _single_mesh():
+    return meshmod.make_mesh(jax.devices()[:1])
+
+
+# ---------------------------------------------------------------------------
+# GBM: sharded-vs-single parity through the BUILDER (binned chunk store)
+# ---------------------------------------------------------------------------
+def _train_gbm(mesh):
+    with meshmod.use_mesh(mesh):
+        fr = _frame(mesh=mesh)
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=4, max_depth=3, min_rows=4.0,
+                              seed=42)).train_model()
+        probe = np.stack([np.nan_to_num(fr.vec(n).to_numpy())
+                          for n in m.output.names], axis=1).astype(np.float32)
+        margins = np.asarray(m._raw_f(jnp.asarray(probe)), np.float64)
+        forest = {k: np.asarray(v) for k, v in m.forest.items()}
+    return forest, margins
+
+
+def test_gbm_forest_and_predictions_sharded_vs_single():
+    f_n, m_n = _train_gbm(meshmod.default_mesh())
+    f_1, m_1 = _train_gbm(_single_mesh())
+    assert set(f_n) == set(f_1)
+    for k in sorted(f_n):
+        a, b = f_n[k], f_1[k]
+        if a.dtype.kind in "ib":
+            # tree STRUCTURE must be BIT-exact across mesh widths — any
+            # divergence means SPMD histograms changed a split decision
+            np.testing.assert_array_equal(a, b, err_msg=f"forest[{k}]")
+        elif k == "gain":
+            # split gains square gradient/hessian SUMS (variable-importance
+            # bookkeeping, never routing) — the quadratic amplifies the
+            # psum reduction-order ulps, so they get a looser pin
+            np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5,
+                                       err_msg=f"forest[{k}]")
+        else:
+            # floats accumulate through psum: reduction-order ulps only
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7,
+                                       err_msg=f"forest[{k}]")
+    np.testing.assert_allclose(m_n, m_1, rtol=1e-6, atol=1e-7)
+
+
+def test_gbm_per_shard_matrix_accounting():
+    from h2o_tpu.models import gbm as gbm_mod
+
+    mesh = meshmod.default_mesh()
+    shards = meshmod.n_row_shards(mesh)
+    _train_gbm(mesh)
+    acc = gbm_mod.LAST_TRAIN_MATRIX_BYTES
+    assert acc["mode"] == "binned"
+    assert acc["n_row_shards"] == shards == 8
+    # equal padded shards: each chip holds exactly 1/n of the packed bytes
+    assert acc["per_shard_bytes"] * shards <= acc["binned_bytes"] + shards
+    assert acc["psum_bytes_per_tree"] > 0
+
+
+# ---------------------------------------------------------------------------
+# GLM: the shard_map + psum Gram
+# ---------------------------------------------------------------------------
+def _fit_glm(mesh, family, yv):
+    with meshmod.use_mesh(mesh):
+        fr = Frame(["x1", "x2", "y"],
+                   [Vec.from_numpy(_X1, mesh=mesh),
+                    Vec.from_numpy(_X2, mesh=mesh),
+                    Vec.from_numpy(yv, mesh=mesh)])
+        if family == "binomial":
+            fr.replace("y", Vec.from_numpy(yv, type=T_CAT,
+                                           domain=["0", "1"], mesh=mesh))
+        m = GLM(GLMParameters(training_frame=fr, response_column="y",
+                              family=family, lambda_=0.0, standardize=True,
+                              seed=7)).train_model()
+        return m.coef()
+
+
+@pytest.mark.parametrize("family,yv", [
+    ("gaussian", (2.0 * _X1 - _X2 + 0.1 * _RNG.normal(size=_N)
+                  ).astype(np.float32)),
+    ("binomial", _Y),
+])
+def test_glm_coefficients_sharded_vs_single(family, yv):
+    c_n = _fit_glm(meshmod.default_mesh(), family, yv)
+    c_1 = _fit_glm(_single_mesh(), family, yv)
+    assert set(c_n) == set(c_1)
+    for name in c_n:
+        # pinned tolerance: the psum combines per-shard partial Grams in a
+        # different order than one device's sequential block scan
+        assert abs(c_n[name] - c_1[name]) <= 1e-4 * max(1.0, abs(c_1[name])), \
+            (name, c_n[name], c_1[name])
+
+
+# ---------------------------------------------------------------------------
+# Rollups through the MRTask driver on the sharded mesh
+# ---------------------------------------------------------------------------
+def test_rollups_via_mr_reduce_sharded():
+    vals = _RNG.normal(size=500).astype(np.float32)
+    vals[7] = np.nan
+    vals[123] = 0.0
+    fr = Frame(["a", "b"], [Vec.from_numpy(vals),
+                            Vec.from_numpy(np.abs(vals))])
+    fr.ensure_rollups()
+    r = fr.vec("a").rollups()
+    ok = vals[~np.isnan(vals)]
+    assert r.nacnt == 1 and r.nrow == 500
+    assert r.mins == pytest.approx(float(ok.min()), abs=0)
+    assert r.maxs == pytest.approx(float(ok.max()), abs=0)
+    assert r.zerocnt == 1
+    assert r.mean == pytest.approx(float(ok.mean()), rel=1e-5)
+    assert r.sigma == pytest.approx(float(ok.std(ddof=1)), rel=1e-4)
+
+
+def test_mrtask_dispatch_failpoint_is_typed_no_hang():
+    from h2o_tpu.parallel.mrtask import mr_reduce
+
+    fp.reset()
+    fp.arm("mrtask.dispatch", "raise")
+    try:
+        with pytest.raises(fp.InjectedFault):
+            mr_reduce(lambda cols, rows: jnp.sum(cols[0] * rows.maskf()),
+                      [Vec.from_numpy(_X1).data], nrow=_N)
+    finally:
+        fp.reset()
+    # disarmed: the same dispatch completes
+    out = mr_reduce(lambda cols, rows: jnp.sum(
+        jnp.nan_to_num(cols[0]) * rows.maskf()), [Vec.from_numpy(_X1).data],
+        nrow=_N)
+    assert np.isfinite(float(out))
+
+
+# ---------------------------------------------------------------------------
+# Coded columns: sharded residency, spill/rehydrate placement, ledger
+# ---------------------------------------------------------------------------
+def test_coded_vec_spill_rehydrate_keeps_row_sharding():
+    from h2o_tpu.frame.chunks import CodedVec
+
+    mesh = meshmod.default_mesh()
+    codes = _RNG.integers(0, 9, size=4096).astype(np.float32)
+    cv = CodedVec.from_vec(Vec.from_numpy(codes))
+    assert cv.meta.kind == "int8"
+    rs = meshmod.row_sharding(mesh)
+    assert cv.coded.sharding == rs
+    before = cv.to_numpy().copy()
+    assert CLEANER._spill(cv) > 0
+    assert cv._data is None and cv._spill_path is not None
+    # transparent rehydrate must land ROW-SHARDED again (Vec._put_sharding)
+    rehydrated = cv.coded
+    assert rehydrated.sharding == rs
+    np.testing.assert_array_equal(cv.to_numpy(), before)
+
+
+def test_cleaner_per_device_ledger_and_prometheus_labels():
+    from h2o_tpu.utils import telemetry
+
+    v = Vec.from_numpy(np.arange(8192, dtype=np.float32))
+    db = CLEANER.device_bytes()
+    assert len(db) == 8  # one entry per mesh device
+    assert sum(db.values()) == CLEANER.tracked_bytes()
+    # the row-sharded column splits evenly: every device holds plen/8 f32
+    per = v.plen // 8 * 4
+    for d in db:
+        assert db[d] >= per
+    peaks = CLEANER.device_peak_bytes()
+    assert peaks and all(peaks[d] >= db[d] for d in db)
+    txt = telemetry.prometheus()
+    assert 'h2o_tpu_cleaner_device_live_bytes{device="' in txt
+    assert 'h2o_tpu_cleaner_device_peak_bytes{device="' in txt
+    # spilling debits the per-device ledger
+    tot0 = sum(db.values())
+    assert CLEANER._spill(v) > 0
+    assert sum(CLEANER.device_bytes().values()) < tot0
+
+
+# ---------------------------------------------------------------------------
+# Sharded merge phase-2 vs the replicated oracle
+# ---------------------------------------------------------------------------
+def _bits_same(a, b):
+    a = np.ascontiguousarray(a, np.float32)
+    b = np.ascontiguousarray(b, np.float32)
+    return np.all((a.view(np.int32) == b.view(np.int32))
+                  | (np.isnan(a) & np.isnan(b)))
+
+
+@pytest.mark.parametrize("all_x", [False, True])
+def test_sharded_merge_bit_equal_to_replicated_oracle(monkeypatch, all_x):
+    from h2o_tpu.rapids.merge import merge
+
+    rng = np.random.default_rng(29)
+    lk = rng.integers(0, 40, size=301).astype(np.float32)
+    lk[5] = np.nan  # NA keys never match
+    lv = np.arange(301, dtype=np.float32)
+    rk = rng.integers(0, 55, size=120).astype(np.float32)  # duplicate keys
+    ry = rng.normal(size=120).astype(np.float32)
+
+    def run():
+        left = Frame(["k", "v"], [Vec.from_numpy(lk.copy()),
+                                  Vec.from_numpy(lv.copy())])
+        right = Frame(["k", "y"], [Vec.from_numpy(rk.copy()),
+                                   Vec.from_numpy(ry.copy())])
+        mg = merge(left, right, all_x=all_x)
+        return (mg.nrow, mg.vec("k").to_numpy(), mg.vec("v").to_numpy(),
+                mg.vec("y").to_numpy())
+
+    monkeypatch.setenv("H2O_TPU_SHARDED_MERGE", "1")
+    n_s, k_s, v_s, y_s = run()
+    monkeypatch.setenv("H2O_TPU_SHARDED_MERGE", "0")
+    n_o, k_o, v_o, y_o = run()
+    assert n_s == n_o
+    assert _bits_same(k_s, k_o) and _bits_same(v_s, v_o) \
+        and _bits_same(y_s, y_o)
+
+
+def test_zero_match_device_merge_returns_empty_frame():
+    # pre-existing crash the e2e drive surfaced: phase 2's fills assume
+    # >= 1 output row (`buf.at[0]`), so disjoint keys IndexError'd the
+    # whole device merge — now an explicit empty-frame short-circuit
+    from h2o_tpu.rapids.merge import merge
+
+    left = Frame(["k", "v"], [
+        Vec.from_numpy(np.arange(30, dtype=np.float32)),
+        Vec.from_numpy(np.arange(30, dtype=np.float32))])
+    right = Frame(["k", "y"], [
+        Vec.from_numpy(np.array([99.0], np.float32)),
+        Vec.from_numpy(np.array([1.0], np.float32))])
+    out = merge(left, right)
+    assert out.nrow == 0 and out.names == ["k", "v", "y"]
+
+
+def test_sharded_merge_output_is_row_sharded():
+    from h2o_tpu.rapids.merge import merge
+
+    rng = np.random.default_rng(31)
+    left = Frame(["k", "v"], [
+        Vec.from_numpy(rng.integers(0, 20, size=200).astype(np.float32)),
+        Vec.from_numpy(np.arange(200, dtype=np.float32))])
+    right = Frame(["k", "y"], [
+        Vec.from_numpy(np.arange(20, dtype=np.float32)),
+        Vec.from_numpy(np.arange(20, dtype=np.float32) * 3)])
+    mg = merge(left, right)
+    mesh = meshmod.default_mesh()
+    # the expansion output (the big side of a merge) lands row-sharded —
+    # per-chip HBM pays ~1/n_shards, not a full replicated copy
+    assert mg.vec("y").data.sharding == meshmod.row_sharding(mesh)
+
+
+# ---------------------------------------------------------------------------
+# Shard-aware checkpoints: per-device files, manifest-commit-last, resume
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def _ckpt_env(monkeypatch):
+    monkeypatch.delenv("H2O_TPU_FAILPOINTS", raising=False)
+    monkeypatch.setenv("H2O_TPU_CHECKPOINT_SECS", "0")  # every boundary
+    fp.reset()
+    yield
+    fp.reset()
+
+
+def _gbm_params(**kw):
+    base = dict(training_frame=_frame(), response_column="y", ntrees=6,
+                max_depth=3, score_tree_interval=2, seed=42)
+    base.update(kw)
+    return GBMParameters(**base)
+
+
+def _forest_equal(a, b):
+    return set(a.forest) == set(b.forest) and all(
+        np.array_equal(np.asarray(a.forest[k]), np.asarray(b.forest[k]))
+        for k in a.forest)
+
+
+def test_checkpoint_writes_per_shard_files_and_resumes(_ckpt_env, tmp_path):
+    base = GBM(_gbm_params()).train_model()
+    rdir = str(tmp_path / "shards")
+    fp.arm("train.gbm.chunk", "raise(preempt)@3")  # die before chunk 3
+    with pytest.raises(fp.InjectedPreemption):
+        GBM(_gbm_params(auto_recovery_dir=rdir)).train_model()
+    fp.reset()
+    from h2o_tpu.backend.persist import Recovery, TrainingRecovery
+
+    manifest = Recovery(rdir).read()
+    gen = manifest["state_gen"]
+    nsh = manifest["state_shards"]
+    assert gen == manifest["checkpoints"] and nsh == 8
+    for i in range(nsh):
+        assert os.path.exists(
+            os.path.join(rdir, f"train_state.g{gen}.shard{i}.pkl"))
+    # load reassembles the carried f to one full-length host array
+    _cls, _params, state, _mf = TrainingRecovery.load(rdir)
+    assert isinstance(state["f"], np.ndarray)
+    assert state["f"].shape[0] == _frame().vec("y").plen
+    assert np.isfinite(state["f"]).all()
+    m = h2o_tpu.resume_training(rdir)
+    assert _forest_equal(m, base)
+
+
+def test_kill_mid_shard_fanout_resumes_from_previous_generation(
+        _ckpt_env, tmp_path):
+    base = GBM(_gbm_params()).train_model()
+    rdir = str(tmp_path / "midfan")
+    # checkpoint 1 writes shard hits 1..8; kill INSIDE checkpoint 2's
+    # shard fan-out (hit 12 = its 4th shard file): generation 2 must stay
+    # uncommitted — manifest still references generation 1 completely
+    fp.arm("persist.shard", "raise@12")
+    with pytest.raises(fp.InjectedFault):
+        GBM(_gbm_params(auto_recovery_dir=rdir)).train_model()
+    fp.reset()
+    from h2o_tpu.backend.persist import Recovery
+
+    manifest = Recovery(rdir).read()
+    assert manifest["checkpoints"] == 1 and manifest["state_gen"] == 1
+    for i in range(manifest["state_shards"]):
+        assert os.path.exists(
+            os.path.join(rdir, f"train_state.g1.shard{i}.pkl"))
+    m = h2o_tpu.resume_training(rdir)
+    assert _forest_equal(m, base)
+
+
+def test_kill_between_state_write_and_manifest_commit_resumes_bit_equal(
+        _ckpt_env, tmp_path):
+    """The review-confirmed window: the main state (generation 2, written
+    after its shard files) lands on disk, then the process dies BEFORE the
+    manifest commit. The state is self-describing (__ckpt_gen__), so load
+    joins generation 2's state with generation 2's shard files — never the
+    stale manifest's generation 1 — and resume stays bit-equal."""
+    base = GBM(_gbm_params()).train_model()
+    rdir = str(tmp_path / "window")
+    # persist.checkpoint hit sequence: init params(1)+manifest(2);
+    # ckpt1 state(3)+manifest(4); ckpt2 state(5)+MANIFEST(6) <- kill here
+    fp.arm("persist.checkpoint", "raise@6")
+    with pytest.raises(fp.InjectedFault):
+        GBM(_gbm_params(auto_recovery_dir=rdir)).train_model()
+    fp.reset()
+    from h2o_tpu.backend.persist import Recovery
+
+    manifest = Recovery(rdir).read()
+    assert manifest["checkpoints"] == 1  # gen 2 never committed
+    m = h2o_tpu.resume_training(rdir)
+    assert _forest_equal(m, base)
+
+
+def test_missing_shard_file_raises_typed_not_garbage(_ckpt_env, tmp_path):
+    rdir = str(tmp_path / "torn")
+    fp.arm("train.gbm.chunk", "raise(preempt)@3")
+    with pytest.raises(fp.InjectedPreemption):
+        GBM(_gbm_params(auto_recovery_dir=rdir)).train_model()
+    fp.reset()
+    from h2o_tpu.backend.persist import Recovery, TrainingRecovery
+
+    gen = Recovery(rdir).read()["state_gen"]
+    os.remove(os.path.join(rdir, f"train_state.g{gen}.shard3.pkl"))
+    with pytest.raises((ValueError, FileNotFoundError)):
+        TrainingRecovery.load(rdir)
+
+
+def test_split_join_state_shards_roundtrip_bit_equal():
+    from h2o_tpu.backend.persist import (_join_state_shards,
+                                         _split_state_shards)
+
+    mesh = meshmod.default_mesh()
+    arr = meshmod.put_row_sharded(
+        np.arange(1024, dtype=np.float32) * 1.7, mesh)
+    rep = meshmod.put_replicated(np.arange(7, dtype=np.float32), mesh)
+    state = {"f": arr, "meta": {"rep": rep, "n": 3}, "parts": [(arr,)]}
+    split, payloads = _split_state_shards(state)
+    assert len(payloads) == meshmod.n_row_shards(mesh)
+    assert split["f"]["__h2o_sharded__"] is not None
+    # replicated arrays are NOT split (any one copy reassembles them)
+    assert isinstance(split["meta"]["rep"], jax.Array)
+    joined = _join_state_shards(split, payloads)
+    np.testing.assert_array_equal(joined["f"], np.asarray(arr))
+    np.testing.assert_array_equal(joined["parts"][0][0], np.asarray(arr))
+    assert joined["meta"]["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# The H2O_TPU_ROW_SHARDS knob
+# ---------------------------------------------------------------------------
+def test_row_shards_knob_shapes_default_mesh(monkeypatch):
+    prev = meshmod.default_mesh()
+    try:
+        monkeypatch.setenv("H2O_TPU_ROW_SHARDS", "2")
+        meshmod.set_mesh(None)
+        m = meshmod.default_mesh()
+        assert meshmod.n_row_shards(m) == 2
+        assert m.shape[meshmod.COLS] == 4
+    finally:
+        meshmod.set_mesh(prev)

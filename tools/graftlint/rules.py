@@ -42,6 +42,13 @@ CHANGES.md entries):
    to kernels): a Pallas kernel grown elsewhere dodges the XLA-oracle
    bit-parity contract, the interpret-mode routing off-TPU, and the
    `H2O_TPU_HIST_KERNEL` backend switch.
+13. direct-device-put    — PR 10 (multi-chip sharded frames): mesh-sharded
+   `jax.device_put` calls belong to `parallel/mesh.py`'s put_* helpers or
+   the frame layer (`frame/vec.py`, `frame/chunks.py`). Placement policy —
+   what is row-sharded, what replicates per chip — decides per-chip HBM
+   and collective layouts; a stray `device_put(x, NamedSharding(...))` in
+   a builder silently re-lays frame data outside the one reviewable
+   policy (the GSPMD merge mis-partition hid exactly there).
 """
 
 from __future__ import annotations
@@ -155,6 +162,69 @@ class DirectPallasCall(Rule):
                 dn = normalize(dotted_name(node.func), ctx.aliases)
                 if dn and "experimental.pallas" in dn:
                     out.append(self.violation(ctx, node, msg))
+        return out
+
+
+#: the sanctioned mesh-sharded placement sites — the mesh helpers
+#: themselves plus the frame layer's (re)hydration paths
+PLACEMENT_PATHS = (MESH_PATH, "h2o_tpu/frame/vec.py",
+                   "h2o_tpu/frame/chunks.py")
+
+
+class DirectDevicePut(Rule):
+    id = "direct-device-put"
+    doc = ("mesh-sharded jax.device_put outside parallel/mesh.py / the "
+           "frame layer — route frame-data placement through the mesh "
+           "put_* helpers so sharding policy stays in one place")
+
+    #: constructors whose result is a mesh sharding (placing with a bare
+    #: Device object — serving replica pinning — is NOT flagged: that is
+    #: device selection, not frame-data partitioning)
+    _SHARDING_CTORS = {"NamedSharding", "PositionalSharding",
+                       "row_sharding", "replicated"}
+
+    def _is_sharding(self, node, shard_vars) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in shard_vars
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            return bool(dn) and dn.split(".")[-1] in self._SHARDING_CTORS
+        return False
+
+    def check(self, tree, ctx):
+        if ctx.relpath in PLACEMENT_PATHS:
+            return []
+        out = []
+        for scope in function_scopes(tree):
+            shard_vars: set[str] = set()
+            stmts = sorted(scope_statements(scope),
+                           key=lambda n: (getattr(n, "lineno", 0),
+                                          getattr(n, "col_offset", 0)))
+            for node in stmts:
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and self._is_sharding(node.value, shard_vars)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            shard_vars.add(t.id)
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = _norm_func(node, ctx)
+                if not fn or not fn.endswith("device_put"):
+                    continue
+                target = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg in ("device", "sharding"):
+                        target = kw.value
+                if target is not None and self._is_sharding(target,
+                                                            shard_vars):
+                    out.append(self.violation(
+                        ctx, node,
+                        "mesh-sharded device_put outside the sanctioned "
+                        "placement sites — use parallel/mesh.py's "
+                        "put_row_sharded/put_replicated/put_sharded (or "
+                        "the frame layer) so per-chip placement policy "
+                        "stays reviewable in one place"))
         return out
 
 
@@ -791,7 +861,7 @@ class UnregisteredMetric(Rule):
         return out
 
 
-ALL_RULES = (DirectShardMap, DirectPallasCall, PSpecConcat,
+ALL_RULES = (DirectShardMap, DirectPallasCall, DirectDevicePut, PSpecConcat,
              NarrowIntAccumulate, UntrackedResident, TimingWithoutSync,
              HostSyncInTrace, NondeterminismInTrace, UnregisteredKnob,
              UnregisteredFailpoint, SwallowedRetryable, UnregisteredMetric)
