@@ -167,6 +167,12 @@ class H2OServer:
             self.httpd.shutdown()
             self.httpd.server_close()
             self.httpd = None
+        if self._thread is not None:
+            # serve_forever returns once shutdown() lands; drain the
+            # acceptor thread so stop() means STOPPED (graftlint
+            # unjoined-thread GL17-server-thread)
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     @property
     def url(self) -> str:

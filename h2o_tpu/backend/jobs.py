@@ -96,9 +96,16 @@ class Job(Keyed):
     CANCELLED = "CANCELLED"
 
     def __init__(self, description: str = "", work: float = 1.0, dest_key: str | None = None):
+        from ..utils import sanitizer
+
         super().__init__(prefix="job")
         self.description = description
         self.dest_key = dest_key
+        # status/result/exception/progress are written by the worker
+        # thread and read by pollers (REST /3/Jobs, join, progress) — one
+        # lock makes every transition atomic and publishes result+status
+        # together (graftlint unguarded-shared-field GL14-job-state)
+        self._lock = sanitizer.make_lock("Job._state")
         self.status = Job.CREATED
         self.exception: BaseException | None = None
         self.traceback: str | None = None
@@ -116,27 +123,38 @@ class Job(Keyed):
     def start(self, fn: Callable[[], Any], background: bool = True) -> "Job":
         def _run():
             # job transitions are timeline events, like the reference's
-            # TimeLine records of task start/finish packets
+            # TimeLine records of task start/finish packets. State writes
+            # happen under the lock; the builder fn and the timeline
+            # emits run OUTSIDE it (fn holds the lock for nothing, and
+            # blocking-under-lock stays clean).
             from ..utils import timeline
 
-            self.status = Job.RUNNING
-            self.start_time = time.time()
+            with self._lock:
+                self.status = Job.RUNNING
+                self.start_time = time.time()
             timeline.record("job", "start", job=str(self.key),
                             desc=self.description)
             try:
-                self.result = fn()
-                self.status = Job.CANCELLED if self._stop_requested else Job.DONE
+                result = fn()
+                with self._lock:
+                    self.result = result
+                    self.status = (Job.CANCELLED if self._stop_requested
+                                   else Job.DONE)
             except JobCancelled:
-                self.status = Job.CANCELLED
+                with self._lock:
+                    self.status = Job.CANCELLED
             except BaseException as e:  # noqa: BLE001 - mirror of Job exception capture
-                self.exception = e
-                self.traceback = traceback.format_exc()
-                self.status = Job.FAILED
+                with self._lock:
+                    self.exception = e
+                    self.traceback = traceback.format_exc()
+                    self.status = Job.FAILED
             finally:
-                self.end_time = time.time()
-                timeline.record("job", self.status, job=str(self.key),
-                                run_s=round(self.end_time
-                                            - self.start_time, 3))
+                with self._lock:
+                    self.end_time = time.time()
+                    status = self.status
+                    run_s = round(self.end_time - self.start_time, 3)
+                timeline.record("job", status, job=str(self.key),
+                                run_s=run_s)
                 _note_job_finished()
 
         if background:
@@ -154,29 +172,36 @@ class Job(Keyed):
         if self._thread is not None:
             self._thread.join(timeout)
             if timeout is not None and self._thread.is_alive():
+                with self._lock:
+                    status = self.status
                 raise JobTimeoutError(
                     f"join on {self.key} ({self.description!r}) timed out "
-                    f"with the job still {self.status}",
+                    f"with the job still {status}",
                     elapsed_s=self.run_time, budget_s=timeout)
-        if self.status == Job.FAILED and self.exception is not None:
-            raise self.exception
-        return self.result
+        with self._lock:  # status+exception+result publish atomically
+            status, exc, result = self.status, self.exception, self.result
+        if status == Job.FAILED and exc is not None:
+            raise exc
+        return result
 
     # -- progress / cancel ---------------------------------------------------
     @property
     def progress(self) -> float:
-        if self.status == Job.DONE:
-            return 1.0
-        return min(1.0, self._worked / self._work_total)
+        with self._lock:
+            if self.status == Job.DONE:
+                return 1.0
+            return min(1.0, self._worked / self._work_total)
 
     def update(self, worked: float, msg: str = "") -> None:
-        self._worked += worked
-        if msg:
-            self.progress_msg = msg
+        with self._lock:
+            self._worked += worked
+            if msg:
+                self.progress_msg = msg
 
     def stop(self) -> None:
         """Request cooperative cancellation (`Job.stop_requested` contract)."""
-        self._stop_requested = True
+        with self._lock:
+            self._stop_requested = True
 
     deadline: float | None = None     # wall-clock expiry (max_runtime_secs)
     max_runtime_s: float | None = None  # the armed budget, for typed errors
@@ -207,20 +232,23 @@ class Job(Keyed):
 
     @property
     def stop_requested(self) -> bool:
-        return self._stop_requested
+        with self._lock:
+            return self._stop_requested
 
     def check_cancelled(self) -> None:
         """Builders call this between iterations; raises to unwind the driver."""
-        if self._stop_requested:
+        if self.stop_requested:
             raise JobCancelled(self.key)
 
     @property
     def run_time(self) -> float:
-        end = self.end_time or time.time()
-        return end - self.start_time if self.start_time else 0.0
+        with self._lock:
+            end = self.end_time or time.time()
+            return end - self.start_time if self.start_time else 0.0
 
     def is_running(self) -> bool:
-        return self.status in (Job.CREATED, Job.RUNNING)
+        with self._lock:
+            return self.status in (Job.CREATED, Job.RUNNING)
 
 
 def any_running() -> bool:

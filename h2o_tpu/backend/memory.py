@@ -45,6 +45,7 @@ import weakref
 import numpy as np
 
 from ..utils import knobs, telemetry
+from ..utils.sanitizer import guarded_by
 
 _UNRESOLVED = object()
 
@@ -160,9 +161,11 @@ class Cleaner:
     def __init__(self):
         import itertools
 
+        from ..utils.sanitizer import make_lock
+
         self._vecs: "weakref.WeakValueDictionary[int, object]" = \
             weakref.WeakValueDictionary()
-        self._lock = threading.RLock()
+        self._lock = make_lock("Cleaner._lock", rlock=True)
         # atomic in CPython — Vec.data reads must not contend on a lock
         self._clock = itertools.count(1)
         # ledger keys are per-vec monotonic tokens, NOT id(vec): CPython can
@@ -200,6 +203,19 @@ class Cleaner:
         if env and int(env) > 0:  # 0 = backend resolution (optargs contract)
             telemetry.set_gauge("cleaner.hbm.limit.bytes", int(env))
             return int(env)
+        if self._stats_limit is not _UNRESOLVED:
+            # resolved: lock-free read of an immutable value — planner
+            # budget queries must not contend with a sweep holding the
+            # ledger lock
+            return self._stats_limit
+        with self._lock:
+            # first resolve under the ledger lock: serving admission and a
+            # training planner racing it must not both resolve (and
+            # double-emit the gauge)
+            return self._resolve_stats_limit_locked()
+
+    @guarded_by("_lock")
+    def _resolve_stats_limit_locked(self) -> int | None:
         if self._stats_limit is _UNRESOLVED:
             stats = hbm_stats()
             limit = (int(stats["bytes_limit"] * 0.85)
@@ -274,9 +290,11 @@ class Cleaner:
             telemetry.set_gauge("cleaner.hbm.live.bytes",
                                 max(self._resident_bytes, 0))
 
+    @guarded_by("_lock")
     def _dev_release(self, tok, keep_frac: float) -> None:
         """Scale a token's per-device residency by ``keep_frac`` (0 drops
-        it) and debit the live per-device totals. Lock held by caller."""
+        it) and debit the live per-device totals. Lock held by caller
+        (asserted under H2O_TPU_SANITIZE=guards)."""
         per = self._dev_by_tok.get(tok)
         if per is None:
             return

@@ -193,3 +193,8 @@ class AssistedClusteringApi:
             self.httpd.shutdown()
             self.httpd.server_close()
             self.httpd = None
+        if self._thread is not None:
+            # drain the sidecar acceptor thread (graftlint
+            # unjoined-thread GL17-assisted-thread)
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -154,15 +154,18 @@ class MicroBatcher:
         self._worker.join(timeout=5.0)
 
     # -- worker --------------------------------------------------------------
-    def _take_batch(self) -> list[_Pending]:
+    def _take_batch(self) -> list[_Pending] | None:
         """Block until work is available (and not paused), coalesce up to
-        max_batch rows, claim the survivors as RUNNING."""
+        max_batch rows, claim the survivors as RUNNING. Returns None on
+        shutdown — decided UNDER the queue lock, so the worker never
+        consults `_stopped` unguarded (graftlint unguarded-shared-field:
+        the old `_run` re-read it outside the cv after an empty take)."""
         with self._cv:
             while True:
                 while not self._stopped and (self._paused or not self._q):
                     self._cv.wait()
                 if self._stopped:
-                    return []
+                    return None
                 if self.max_wait_s:
                     # hold the door open for concurrent arrivals — but
                     # never past the first queued request's deadline
@@ -207,9 +210,9 @@ class MicroBatcher:
     def _run(self) -> None:
         while True:
             batch = self._take_batch()
-            if not batch:
-                if self._stopped:
-                    return
+            if batch is None:       # stop decided under the queue lock
+                return
+            if not batch:           # spurious wake / all takers expired
                 continue
             X = (batch[0].rows if len(batch) == 1
                  else np.concatenate([r.rows for r in batch], axis=0))

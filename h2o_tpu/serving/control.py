@@ -102,7 +102,9 @@ class ControlPlane:
     model's compiled executables so the freed estimate is real."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from ..utils.sanitizer import make_lock
+
+        self._lock = make_lock("ControlPlane._lock")
         self._placements: dict[str, Placement] = {}
         self.deplacer = None            # callable(model_id) -> None
 
@@ -233,7 +235,13 @@ class Replica:
         self.idx = idx
         self.device = device
         self.scorer = scorer
-        self.dead = False
+        # death is a monotone flip read by request threads while the batch
+        # worker writes it — an Event makes the handoff a synchronized
+        # publication instead of a bare bool (graftlint
+        # unguarded-shared-field GL14-replica-dead); the small lock makes
+        # the first-observation COUNT atomic too (Event has no test-and-set)
+        self._dead = threading.Event()
+        self._death_note = threading.Lock()
         self.batcher = MicroBatcher(
             f"{model_id}#r{idx}", self._score, stats,
             max_batch=min(cfg["max_batch"], max(scorer.buckets)),
@@ -241,14 +249,26 @@ class Replica:
             queue_depth=cfg["queue_depth"],
             recompile_probe=lambda: scorer.fallback_compiles)
 
+    @property
+    def dead(self) -> bool:
+        return self._dead.is_set()
+
+    def mark_dead(self) -> None:
+        """Idempotent death flip; counts the FIRST observation only
+        (test-and-set under the lock — two concurrently-failing batches
+        must report ONE death)."""
+        with self._death_note:
+            if self._dead.is_set():
+                return
+            self._dead.set()
+        telemetry.inc("serving.replica.dead.count")
+
     def _score(self, X):
         try:
             failpoints.hit("serving.replica")
             return self.scorer.score(X)
         except Exception:
-            if not self.dead:
-                self.dead = True
-                telemetry.inc("serving.replica.dead.count")
+            self.mark_dead()
             raise
 
     def info(self) -> dict:

@@ -327,7 +327,9 @@ class Router:
             self._shadow_stop = True
             self._shadow_q.clear()
             self._shadow_cv.notify_all()
-        w = self._shadow_worker
+            # read the handle under the cv: _enqueue_shadow can't create a
+            # worker after _shadow_stop lands, so this is the last word
+            w = self._shadow_worker
         if w is not None:
             w.join(timeout=5.0)
 

@@ -197,8 +197,10 @@ class ServingRuntime:
     def __init__(self):
         from .router import Router
 
+        from ..utils.sanitizer import make_lock
+
         self._models: dict[str, ServedModel] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingRuntime._lock")
         self.control = ControlPlane()
         self.control.deplacer = self._deplace
         self.router = Router(self)

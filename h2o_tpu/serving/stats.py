@@ -27,18 +27,18 @@ here remain the `GET /3/Serving/stats` payload.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 
 import numpy as np
 
 from ..utils import telemetry
+from ..utils.sanitizer import guarded_by, make_lock
 
 
 class ServingStats:
     def __init__(self, window: int = 2048):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingStats._lock")
         self.window = max(int(window), 16)
         self._lat_s: deque = deque(maxlen=self.window)
         #: (completion wall-stamp, rows) per scored batch — throughput window
@@ -90,6 +90,7 @@ class ServingStats:
         with self._lock:
             return self._rows_per_s_locked()
 
+    @guarded_by("_lock")
     def _rows_per_s_locked(self) -> float:
         if len(self._batches) < 2:
             return 0.0

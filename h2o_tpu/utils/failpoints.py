@@ -162,6 +162,11 @@ _failpoint("io.remote",
 _failpoint("client.request",
            "api/client.py H2OConnection._send — client-side transport "
            "fault before the wire")
+_failpoint("sanitizer.trip",
+           "utils/sanitizer.py SanitizedLock order check (fires on every "
+           "cross-lock acquisition while H2O_TPU_SANITIZE=locks) — arm "
+           "raise to drill the violation-handling path without a real "
+           "inversion")
 
 
 # ---------------------------------------------------------------------------

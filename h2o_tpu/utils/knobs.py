@@ -173,6 +173,16 @@ _knob("H2O_TPU_CLIENT_KEEPALIVE", "bool", True,
       "(api/client.py), auto-reconnecting on a stale socket; 0 reverts "
       "to one connection per request (the serving_wire bench baseline)")
 
+# -- concurrency sanitizer (utils/sanitizer.py) ------------------------------
+_knob("H2O_TPU_SANITIZE", "str", "",
+      "comma list of runtime concurrency-sanitizer modes "
+      "(utils/sanitizer.py): 'locks' = instrumented lock wrappers that "
+      "track per-thread acquisition stacks + the global lock-order graph "
+      "and raise a typed LockOrderViolation on an OBSERVED inversion; "
+      "'guards' = @guarded_by('_lock') assertions on lock-protected "
+      "methods. Consulted at lock construction — build the runtime after "
+      "setting it; empty = plain threading locks, zero overhead")
+
 # -- fault tolerance (failpoints / auto-checkpoints / retry) ----------------
 _knob("H2O_TPU_FAILPOINTS", "str", "",
       "comma list of site:spec deterministic fault injections "

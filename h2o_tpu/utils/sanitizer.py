@@ -1,0 +1,296 @@
+"""Runtime lock sanitizer — the dynamic twin of graftlint's interprocedural
+concurrency rules (tools/graftlint/concurrency.py).
+
+The static ``lock-order-cycle`` rule flags *possible* inversions; this
+module catches *observed* ones the moment they happen, on the real
+serving/training/Cleaner workload, behind one knob::
+
+    H2O_TPU_SANITIZE=locks            # instrumented locks + order checking
+    H2O_TPU_SANITIZE=locks,guards     # ... plus @guarded_by assertions
+
+Every lock the concurrency-audited modules create goes through
+:func:`make_lock`. With sanitizing OFF (the default) it returns a plain
+``threading.Lock``/``RLock`` — zero wrapper, zero overhead (the <2%
+disabled-cost contract is structural, not measured-and-hoped). With
+``locks`` on it returns a :class:`SanitizedLock` that
+
+- records the per-thread acquisition stack,
+- maintains the process-global lock-order graph (edge A→B when B is
+  acquired while A is held),
+- and raises the typed :class:`LockOrderViolation` the moment an
+  acquisition would invert an already-observed order — at the acquiring
+  call site, with both orders and where the first was established, BEFORE
+  the process can deadlock.
+
+Observations are keyed by lock *name* (the ``make_lock`` argument, e.g.
+``"Cleaner._lock"``), so all instances of a class's lock share one node —
+exactly the static rule's granularity. Re-acquiring the same named lock
+(RLock re-entry, or two instances of the same class) never reports.
+
+``@guarded_by("_lock")`` is the assertion decorator the fixed call sites
+adopt: with ``guards`` on, entering the method without holding
+``self._lock`` raises the typed :class:`GuardViolation`; off, the
+decorator is a pass-through wrapper whose only cost is one cached env
+check. It asserts only when the attribute actually is a SanitizedLock
+(plain locks cannot report their holder).
+
+Wired through the standard drill surfaces: the ``sanitizer.trip``
+failpoint fires inside the order check (arm ``raise`` to drill the
+violation-handling path deterministically in CI), every real violation
+bumps the ``sanitizer.violation.count`` metric and lands a typed
+``sanitizer`` timeline event before raising.
+
+The mode is read from the env on every :func:`enabled` call but cached on
+the raw string (the knobs/failpoints dynamic-read contract: monkeypatching
+tests work, steady state costs one ``os.environ.get``). NOTE: the mode is
+consulted at lock *construction* — a module singleton built before the
+env flips keeps its plain lock; tests build fresh objects (or swap
+``obj._lock``) after setting the knob.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import knobs
+
+
+class LockOrderViolation(RuntimeError):
+    """An OBSERVED lock-order inversion: this thread holds ``holding`` and
+    tried to acquire ``acquiring``, but the opposite order was already
+    observed (``established`` names the thread/site that recorded it).
+    Raised before blocking — the deadlock candidate is reported, not
+    entered."""
+
+    def __init__(self, acquiring: str, holding: str, established: str):
+        self.acquiring = acquiring
+        self.holding = holding
+        self.established = established
+        super().__init__(
+            f"lock-order inversion: acquiring '{acquiring}' while holding "
+            f"'{holding}', but the order {acquiring} -> {holding} was "
+            f"already observed ({established}); pick one global order "
+            f"(graftlint rule lock-order-cycle is the static twin)")
+
+
+class GuardViolation(AssertionError):
+    """A ``@guarded_by`` method entered without its lock held."""
+
+    def __init__(self, what: str, lock_attr: str):
+        super().__init__(
+            f"{what} requires {lock_attr} held by the calling thread "
+            f"(@guarded_by contract)")
+
+
+# ---------------------------------------------------------------------------
+# mode (dynamic read, cached on the raw knob string)
+# ---------------------------------------------------------------------------
+_mode_cache: tuple[str | None, frozenset] = (None, frozenset())
+
+
+def _modes() -> frozenset:
+    global _mode_cache
+    raw = knobs.raw("H2O_TPU_SANITIZE")  # registration check + env read
+    if raw == _mode_cache[0]:
+        return _mode_cache[1]
+    modes = frozenset(m.strip() for m in (raw or "").split(",") if m.strip())
+    unknown = modes - {"locks", "guards"}
+    if unknown:
+        raise ValueError(
+            f"unknown H2O_TPU_SANITIZE mode(s) {sorted(unknown)} — "
+            f"'locks' and/or 'guards'")
+    _mode_cache = (raw, modes)
+    return modes
+
+
+def enabled(mode: str) -> bool:
+    """Is a sanitize mode ('locks' / 'guards') currently on?"""
+    return mode in _modes()
+
+
+# ---------------------------------------------------------------------------
+# the order graph (process-global, name-keyed)
+# ---------------------------------------------------------------------------
+_GRAPH_LOCK = threading.Lock()
+#: name -> {successor name -> "thread/site that established the edge"}
+_EDGES: dict[str, dict[str, str]] = {}
+_TLS = threading.local()  # .held: list[str] of lock names, outermost first
+
+
+def _held() -> list:
+    held = getattr(_TLS, "held", None)
+    if held is None:
+        held = _TLS.held = []
+    return held
+
+
+def reset_order_graph() -> None:
+    """Drop every observed edge (test isolation)."""
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+
+
+def order_graph() -> dict:
+    """Copy of the observed order graph ({name: [successors]}) —
+    observability / test pins."""
+    with _GRAPH_LOCK:
+        return {a: sorted(bs) for a, bs in _EDGES.items()}
+
+
+def _reachable(frm: str, to: str) -> bool:
+    """Path frm -> ... -> to in the observed graph (caller holds
+    _GRAPH_LOCK)."""
+    seen = {frm}
+    stack = [frm]
+    while stack:
+        cur = stack.pop()
+        if cur == to:
+            return True
+        for nxt in _EDGES.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+class SanitizedLock:
+    """Lock/RLock wrapper recording per-thread acquisition stacks and the
+    global order graph; raises :class:`LockOrderViolation` on an observed
+    inversion BEFORE blocking on the inner lock."""
+
+    __slots__ = ("name", "_inner", "_rlock", "_owner", "_count")
+
+    def __init__(self, name: str, *, rlock: bool = False):
+        self.name = name
+        self._rlock = rlock
+        self._inner = threading.RLock() if rlock else threading.Lock()
+        self._owner: int | None = None
+        self._count = 0
+
+    # -- order bookkeeping ----------------------------------------------------
+    def _pre_acquire(self) -> None:
+        from . import failpoints
+
+        failpoints.hit("sanitizer.trip")
+        held = _held()
+        if not held or self.name in held:
+            return  # re-entry of the same named node never re-orders
+        prev = held[-1]
+        me = f"thread '{threading.current_thread().name}'"
+        with _GRAPH_LOCK:
+            if self.name in _EDGES and _reachable(self.name, prev):
+                est = _EDGES.get(self.name, {}).get(
+                    prev, "a transitive chain of observed acquisitions")
+                violation = LockOrderViolation(self.name, prev, est)
+            else:
+                _EDGES.setdefault(prev, {}).setdefault(
+                    self.name, f"{me} holding '{prev}'")
+                return
+        # emit outside the graph lock, then raise the typed error
+        from . import telemetry, timeline
+
+        telemetry.inc("sanitizer.violation.count")
+        timeline.record("sanitizer", "lock_order",
+                        acquiring=self.name, holding=prev)
+        raise violation
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking and not self._rlock \
+                and self._owner == threading.get_ident():
+            # a plain Lock re-acquired by its holder never returns — the
+            # one deadlock that needs no second thread
+            raise LockOrderViolation(
+                self.name, self.name,
+                "self-deadlock: non-reentrant lock re-acquired by its "
+                "own holder")
+        if blocking:
+            self._pre_acquire()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            tid = threading.get_ident()
+            if self._owner == tid and self._rlock:
+                self._count += 1
+            else:
+                self._owner = tid
+                self._count = 1
+                _held().append(self.name)
+        return got
+
+    def release(self) -> None:
+        tid = threading.get_ident()
+        if self._owner is not None and self._owner != tid:
+            # threading.Lock legally allows acquire-in-T1/release-in-T2
+            # handoffs, but the sanitizer's per-thread stacks cannot model
+            # them (the name would rot on T1's held stack and fabricate
+            # violations later). Refuse LOUDLY instead of corrupting the
+            # very diagnostics this mode exists for — the inner lock is
+            # released first so the refusal never deadlocks the program.
+            self._inner.release()
+            raise RuntimeError(
+                f"SanitizedLock '{self.name}' released by thread "
+                f"'{threading.current_thread().name}' but acquired by "
+                f"another thread — cross-thread lock handoff is not "
+                f"supported under H2O_TPU_SANITIZE=locks (use an Event/"
+                f"Condition for handoffs, or leave this lock plain)")
+        if self._owner == tid:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+                held = _held()
+                if held and held[-1] == self.name:
+                    held.pop()
+                elif self.name in held:  # out-of-order release
+                    held.remove(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def held_by_me(self) -> bool:
+        return self._owner == threading.get_ident()
+
+
+def make_lock(name: str, *, rlock: bool = False):
+    """The one lock factory the concurrency-audited modules use: a plain
+    ``threading.Lock``/``RLock`` when sanitizing is off (zero overhead),
+    a :class:`SanitizedLock` under ``H2O_TPU_SANITIZE=locks``."""
+    if enabled("locks"):
+        return SanitizedLock(name, rlock=rlock)
+    return threading.RLock() if rlock else threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# @guarded_by — the assertion the fixed call sites adopt
+# ---------------------------------------------------------------------------
+def guarded_by(lock_attr: str = "_lock"):
+    """Assert (under ``H2O_TPU_SANITIZE=guards``) that ``self.<lock_attr>``
+    is held by the calling thread. Asserts only when the attribute is a
+    SanitizedLock — a plain lock cannot report its holder, so with
+    sanitizing off this is a pass-through whose cost is one cached env
+    read."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            if "guards" in _modes():
+                lock = getattr(self, lock_attr, None)
+                if isinstance(lock, SanitizedLock) \
+                        and not lock.held_by_me():
+                    from . import telemetry, timeline
+
+                    telemetry.inc("sanitizer.violation.count")
+                    timeline.record("sanitizer", "guard",
+                                    method=fn.__qualname__,
+                                    lock=lock_attr)
+                    raise GuardViolation(fn.__qualname__, lock_attr)
+            return fn(self, *args, **kwargs)
+        return wrapper
+    return deco

@@ -228,6 +228,12 @@ _counter("rest.request.count", "REST requests routed")
 _counter("rest.error.count", "REST requests answered with a 5xx")
 _histogram("rest.request.seconds", "wall per routed REST request")
 
+# -- concurrency sanitizer (utils/sanitizer.py) ------------------------------
+_counter("sanitizer.violation.count",
+         "lock-order inversions observed + @guarded_by assertion "
+         "failures raised by the runtime sanitizer (contract: 0 — every "
+         "count is a typed error somewhere)")
+
 # -- XLA ---------------------------------------------------------------------
 _counter("xla.compile.count",
          "XLA backend compiles observed since utils/compilemeter.py "

@@ -630,8 +630,12 @@ def test_scan_set_includes_the_advertised_tree():
 
 
 def test_every_rule_registered_exactly_once():
+    from tools.graftlint import PROJECT_RULES
+
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 13
+    assert len(ids) == len(set(ids)) == 13  # per-file rules
+    both = ids + [cls.id for cls in PROJECT_RULES]
+    assert len(both) == len(set(both)) == 17  # + interprocedural (v2)
 
 
 def test_direct_device_put_forms():

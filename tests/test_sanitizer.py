@@ -1,0 +1,445 @@
+"""Runtime lock sanitizer (`h2o_tpu/utils/sanitizer.py`) — the dynamic
+twin of graftlint's interprocedural rules — plus regression tests for the
+races those rules surfaced (finding ids in the module comments).
+
+The load-bearing pins:
+
+- a SEEDED lock-order inversion trips the typed `LockOrderViolation`
+  (including cross-thread: order established on one thread, inverted on
+  another), bumps `sanitizer.violation.count`, and lands a typed
+  timeline event — BEFORE the process can deadlock;
+- the sanitizer stays SILENT across a real serving + train +
+  Cleaner-sweep stress pass with every audited lock instrumented;
+- `@guarded_by` raises the typed GuardViolation without the lock and
+  passes with it; everything is a no-op pass-through when the knob is
+  off (plain threading locks — the <2% disabled-overhead contract is
+  asserted PR-6 style on a timed train);
+- the `sanitizer.trip` failpoint drills the violation-handling path with
+  no real inversion;
+- race-fix regressions: batcher shutdown decided under the queue lock
+  (GL14-batcher-stopped, forced deterministically with a failpoint-
+  injected sleep), Replica death as an Event publication
+  (GL14-replica-dead), Job state transitions atomic under its lock
+  (GL14-job-state), server threads joined on stop (GL17-server-thread).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from h2o_tpu.utils import failpoints, sanitizer, telemetry, timeline
+from h2o_tpu.utils.sanitizer import (GuardViolation, LockOrderViolation,
+                                     SanitizedLock, guarded_by, make_lock)
+
+pytestmark = pytest.mark.graftlint
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph(monkeypatch):
+    monkeypatch.delenv("H2O_TPU_SANITIZE", raising=False)
+    sanitizer.reset_order_graph()
+    yield
+    sanitizer.reset_order_graph()
+    failpoints.reset()
+
+
+def _on(monkeypatch, modes="locks"):
+    monkeypatch.setenv("H2O_TPU_SANITIZE", modes)
+
+
+# ---------------------------------------------------------------------------
+# the order sanitizer
+# ---------------------------------------------------------------------------
+class TestLockOrder:
+    def test_seeded_inversion_raises_typed_error(self, monkeypatch):
+        _on(monkeypatch)
+        a, b = make_lock("A"), make_lock("B")
+        with a:
+            with b:
+                pass                      # establish A -> B
+        with pytest.raises(LockOrderViolation) as ei:
+            with b:
+                with a:
+                    pass                  # invert: B -> A
+        assert ei.value.acquiring == "A"
+        assert ei.value.holding == "B"
+        assert "A -> B" in str(ei.value)
+
+    def test_cross_thread_observation(self, monkeypatch):
+        """Order established on a worker thread; the inversion on the
+        main thread still trips — the graph is process-global."""
+        _on(monkeypatch)
+        a, b = make_lock("TA"), make_lock("TB")
+
+        def establish():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=establish)
+        t.start()
+        t.join()
+        with pytest.raises(LockOrderViolation):
+            with b:
+                with a:
+                    pass
+
+    def test_violation_counts_and_timeline(self, monkeypatch):
+        _on(monkeypatch)
+        before = telemetry.value("sanitizer.violation.count")
+        a, b = make_lock("MA"), make_lock("MB")
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderViolation):
+            with b:
+                with a:
+                    pass
+        assert telemetry.value("sanitizer.violation.count") == before + 1
+        evs = [e for e in timeline.snapshot(kind="sanitizer")
+               if e["what"] == "lock_order" and e.get("acquiring") == "MA"]
+        assert evs and evs[-1]["holding"] == "MB"
+
+    def test_consistent_order_is_silent(self, monkeypatch):
+        _on(monkeypatch)
+        a, b, c = make_lock("CA"), make_lock("CB"), make_lock("CC")
+        for _ in range(50):
+            with a:
+                with b:
+                    with c:
+                        pass
+        g = sanitizer.order_graph()
+        assert "CB" in g.get("CA", []) and "CC" in g.get("CB", [])
+
+    def test_same_name_reentry_never_reports(self, monkeypatch):
+        """Two instances of the same class's lock share one graph node;
+        nesting them (or RLock re-entry) is not an order."""
+        _on(monkeypatch)
+        a1 = make_lock("ServingStatsLike._lock")
+        a2 = make_lock("ServingStatsLike._lock")
+        with a1:
+            with a2:
+                pass
+        r = make_lock("R", rlock=True)
+        with r:
+            with r:
+                pass
+
+    def test_self_deadlock_on_plain_lock_detected(self, monkeypatch):
+        _on(monkeypatch)
+        a = make_lock("SD")
+        with pytest.raises(LockOrderViolation, match="self-deadlock"):
+            with a:
+                a.acquire()
+
+    def test_trip_failpoint_drills_the_seam(self, monkeypatch):
+        _on(monkeypatch)
+        failpoints.arm("sanitizer.trip", "raise")
+        a, b = make_lock("FA"), make_lock("FB")
+        with pytest.raises(failpoints.InjectedFault):
+            with a:
+                with b:
+                    pass
+
+    def test_cross_thread_release_refused_loudly(self, monkeypatch):
+        """threading.Lock allows acquire-in-T1/release-in-T2 handoffs;
+        the sanitizer's per-thread stacks cannot model them, so it must
+        refuse LOUDLY (after releasing the inner lock) instead of
+        silently corrupting the order graph."""
+        _on(monkeypatch)
+        lk = make_lock("XT")
+        lk.acquire()
+        caught: list = []
+
+        def other():
+            try:
+                lk.release()
+            except RuntimeError as e:
+                caught.append(e)
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert caught and "cross-thread lock handoff" in str(caught[0])
+        # the inner lock WAS released — no deadlock for the program
+        assert lk._inner.acquire(blocking=False)
+        lk._inner.release()
+        sanitizer._TLS.held.clear()   # scrub this thread's stale entry
+
+    def test_off_returns_plain_locks(self):
+        lk = make_lock("plain")
+        assert not isinstance(lk, SanitizedLock)
+        rk = make_lock("plain_r", rlock=True)
+        assert not isinstance(rk, SanitizedLock)
+
+    def test_unknown_mode_is_a_loud_error(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_SANITIZE", "lokcs")
+        with pytest.raises(ValueError, match="unknown H2O_TPU_SANITIZE"):
+            sanitizer.enabled("locks")
+
+
+# ---------------------------------------------------------------------------
+# @guarded_by
+# ---------------------------------------------------------------------------
+class TestGuardedBy:
+    class Holder:
+        def __init__(self):
+            self._lock = make_lock("Holder._lock")
+            self.x = 0
+
+        @guarded_by("_lock")
+        def bump_locked(self):
+            self.x += 1
+            return self.x
+
+    def test_guard_violation_without_lock(self, monkeypatch):
+        _on(monkeypatch, "locks,guards")
+        h = self.Holder()
+        with pytest.raises(GuardViolation):
+            h.bump_locked()
+
+    def test_passes_with_lock_held(self, monkeypatch):
+        _on(monkeypatch, "locks,guards")
+        h = self.Holder()
+        with h._lock:
+            assert h.bump_locked() == 1
+
+    def test_noop_when_off(self):
+        h = self.Holder()
+        assert h.bump_locked() == 1   # plain lock, decorator passes through
+
+    def test_adopted_site_serving_stats(self, monkeypatch):
+        _on(monkeypatch, "locks,guards")
+        from h2o_tpu.serving.stats import ServingStats
+
+        s = ServingStats(window=16)   # constructed AFTER the knob: sanitized
+        assert isinstance(s._lock, SanitizedLock)
+        with pytest.raises(GuardViolation):
+            s._rows_per_s_locked()
+        assert s.recent_rows_per_s() == 0.0  # the locked path works
+
+
+# ---------------------------------------------------------------------------
+# stress: serving + train + Cleaner sweep, all audited locks sanitized
+# ---------------------------------------------------------------------------
+def _tiny_binom_frame():
+    from h2o_tpu.frame.frame import Frame
+    from h2o_tpu.frame.vec import T_CAT, Vec
+
+    rng = np.random.default_rng(5)
+    n = 240
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    lab = (x1 + 0.5 * x2 > 0).astype(np.float32)
+    return Frame(["x1", "x2", "y"],
+                 [Vec.from_numpy(x1), Vec.from_numpy(x2),
+                  Vec.from_numpy(lab, type=T_CAT, domain=["no", "yes"])])
+
+
+class TestStressSilence:
+    def test_serving_train_sweep_stress_stays_silent(self, monkeypatch):
+        """The acceptance drill: with H2O_TPU_SANITIZE=locks live on every
+        audited lock (serving runtime/control/stats built fresh, the
+        Cleaner's lock swapped in), concurrent scoring + a real GBM train
+        + forced Cleaner sweeps observe ZERO lock-order violations."""
+        _on(monkeypatch)
+        from h2o_tpu.backend import memory
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+        from h2o_tpu.serving.runtime import ServingRuntime
+
+        before = telemetry.value("sanitizer.violation.count")
+        fr = _tiny_binom_frame()
+        model = GBM(GBMParameters(training_frame=fr, response_column="y",
+                                  ntrees=4, max_depth=3,
+                                  seed=1)).train_model()
+        monkeypatch.setattr(memory.CLEANER, "_lock",
+                            make_lock("Cleaner._lock", rlock=True))
+        rt = ServingRuntime()
+        try:
+            rt.register_model(model, "san_stress",
+                              overrides={"buckets": [1, 8]})
+            rows = [{"x1": 0.1, "x2": -0.2}]
+            errs: list = []
+
+            def client(k):
+                try:
+                    for _ in range(25):
+                        rt.score("san_stress", rows, deadline_ms=10_000)
+                except Exception as e:  # pragma: no cover - fail loudly
+                    errs.append(e)
+
+            threads = [threading.Thread(target=client, args=(k,))
+                       for k in range(6)]
+            for t in threads:
+                t.start()
+            # concurrent train + sweeps while scoring hammers the locks
+            GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=3, max_depth=2,
+                              seed=2)).train_model()
+            for _ in range(4):
+                memory.CLEANER.maybe_sweep(target_bytes=0)
+            for t in threads:
+                t.join()
+            assert not errs, errs
+        finally:
+            rt.shutdown()
+        assert telemetry.value("sanitizer.violation.count") == before
+
+
+# ---------------------------------------------------------------------------
+# disabled-overhead bound (PR 6 methodology)
+# ---------------------------------------------------------------------------
+class TestOverhead:
+    def test_sanitizer_off_overhead_under_2pct_of_train(self, monkeypatch):
+        """With the knob OFF, the only sanitizer code that can run on a
+        hot path is the cached mode check (make_lock at construction,
+        guarded_by pass-throughs). Wrap them with accumulating timers
+        through a real timed train and assert < 2% of the drained wall —
+        the PR 6 telemetry-overhead methodology."""
+        monkeypatch.delenv("H2O_TPU_SANITIZE", raising=False)
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+
+        spent = [0.0]
+
+        def timed(fn):
+            def w(*a, **k):
+                t0 = time.perf_counter()
+                try:
+                    return fn(*a, **k)
+                finally:
+                    spent[0] += time.perf_counter() - t0
+            return w
+
+        monkeypatch.setattr(sanitizer, "_modes", timed(sanitizer._modes))
+        monkeypatch.setattr(sanitizer, "make_lock",
+                            timed(sanitizer.make_lock))
+        fr = _tiny_binom_frame()
+        m = GBM(GBMParameters(training_frame=fr, response_column="y",
+                              ntrees=8, max_depth=3,
+                              seed=3)).train_model()
+        wall = m.output.run_time_ms / 1000.0  # drained-compute contract
+        assert wall > 0
+        assert spent[0] < 0.02 * wall, (
+            f"sanitizer(off) spent {spent[0]:.4f}s of a {wall:.3f}s train "
+            f"({100 * spent[0] / wall:.2f}% >= 2%)")
+
+
+# ---------------------------------------------------------------------------
+# race-fix regressions (each cites its graftlint finding id)
+# ---------------------------------------------------------------------------
+class TestRaceFixRegressions:
+    def test_batcher_stop_decided_under_lock(self):
+        """GL14-batcher-stopped: `_take_batch` returns None (stop) vs []
+        (spurious wake) UNDER the cv; a failpoint-injected sleep holds
+        the worker mid-batch so stop() lands exactly in the window the
+        old unguarded `_stopped` re-read raced."""
+        from h2o_tpu.serving.batcher import MicroBatcher
+        from h2o_tpu.serving.errors import ServingShutdownError
+        from h2o_tpu.serving.stats import ServingStats
+
+        failpoints.arm("serving.batch", "sleep(50)")
+        try:
+            b = MicroBatcher("reg", lambda X: X, ServingStats(16),
+                             max_batch=8, max_wait_us=0, queue_depth=8)
+            results: list = []
+
+            def submit():
+                try:
+                    results.append(b.submit(np.zeros((1, 2)), None))
+                except ServingShutdownError as e:
+                    results.append(e)
+
+            t = threading.Thread(target=submit)
+            t.start()
+            time.sleep(0.02)      # worker is inside the injected sleep
+            b.stop()              # lands while a batch is in flight
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+            assert len(results) == 1  # completed or typed shutdown — no hang
+            assert not b._worker.is_alive()  # worker exited via the
+        finally:                             # under-lock stop decision
+            failpoints.disarm("serving.batch")
+
+    def test_batcher_stop_on_idle_queue_terminates_promptly(self):
+        from h2o_tpu.serving.batcher import MicroBatcher
+        from h2o_tpu.serving.stats import ServingStats
+
+        b = MicroBatcher("idle", lambda X: X, ServingStats(16),
+                         max_batch=8, max_wait_us=0, queue_depth=8)
+        time.sleep(0.01)
+        b.stop()
+        assert not b._worker.is_alive()
+
+    def test_replica_death_is_event_publication(self):
+        """GL14-replica-dead: the dead flag is an Event — idempotent,
+        counted once, visible to request threads without a lock."""
+        from h2o_tpu.serving.control import Replica
+
+        class _Scorer:
+            buckets = (1,)
+            fallback_compiles = 0
+
+            def score(self, X):
+                raise RuntimeError("device gone")
+
+        before = telemetry.value("serving.replica.dead.count")
+        r = Replica(0, None, _Scorer(), __import__(
+            "h2o_tpu.serving.stats", fromlist=["ServingStats"]
+        ).ServingStats(16), {"max_batch": 4, "max_wait_us": 0,
+                             "queue_depth": 4}, "m")
+        try:
+            assert r.dead is False
+            r.mark_dead()
+            r.mark_dead()           # idempotent: one count
+            assert r.dead is True
+            assert telemetry.value(
+                "serving.replica.dead.count") == before + 1
+        finally:
+            r.batcher.stop()
+
+    def test_job_state_transitions_are_atomic(self):
+        """GL14-job-state: status+result publish together under the job
+        lock; a failpoint-free deterministic hold (an Event the builder
+        waits on) pins RUNNING, then DONE with the result visible."""
+        from h2o_tpu.backend.jobs import Job
+
+        gate = threading.Event()
+
+        def build():
+            gate.wait(timeout=10.0)
+            return 42
+
+        j = Job("atomic-state")
+        j.start(build)
+        for _ in range(100):
+            if j.status == Job.RUNNING:
+                break
+            time.sleep(0.01)
+        assert j.status == Job.RUNNING
+        assert j.progress < 1.0
+        gate.set()
+        assert j.join(timeout=10.0) == 42
+        assert j.status == Job.DONE
+        assert j.progress == 1.0
+
+    def test_job_state_lock_is_sanitized_when_enabled(self, monkeypatch):
+        _on(monkeypatch)
+        from h2o_tpu.backend.jobs import Job
+
+        j = Job("sanitized")
+        assert isinstance(j._lock, SanitizedLock)
+        j.start(lambda: "ok")
+        assert j.join(timeout=10.0) == "ok"
+
+    def test_server_stop_joins_acceptor_thread(self):
+        """GL17-server-thread: stop() drains the serve_forever thread."""
+        import h2o_tpu.api.server as srv
+
+        s = srv.H2OServer(port=0).start()
+        t = s._thread
+        assert t.is_alive()
+        s.stop()
+        assert s._thread is None
+        assert not t.is_alive()

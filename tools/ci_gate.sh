@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# CI gate — graftlint (17 rules, baseline-gated) + the tier-1 pytest line,
+# as ONE exit-coded command. Either failing fails the gate; both always
+# run so a single CI pass reports lint findings AND test failures.
+#
+# Usage:
+#   tools/ci_gate.sh                 # text findings
+#   GRAFTLINT_FORMAT=github tools/ci_gate.sh   # ::error annotations
+#   GRAFTLINT_JOBS=4 tools/ci_gate.sh          # parallel lint scan
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+fmt="${GRAFTLINT_FORMAT:-text}"
+jobs="${GRAFTLINT_JOBS:-2}"
+
+echo "== graftlint =="
+python -m tools.graftlint --format "$fmt" --jobs "$jobs"
+lint_rc=$?
+
+echo "== tier-1 pytest =="
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+test_rc=$?
+
+echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc} =="
+if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ]; then
+    exit 1
+fi
+exit 0

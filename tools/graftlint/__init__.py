@@ -5,13 +5,17 @@ Gate:   tests/test_graftlint.py (tier-1, marker `graftlint`)
 Rules:  tools/graftlint/rules.py (catalog + incident history)
 """
 
-from .core import (BASELINE_PATH, DEFAULT_PATHS, REPO_ROOT, FileContext,
-                   Rule, Violation, apply_baseline, lint_paths, lint_source,
-                   load_baseline, main, write_baseline)
+from .concurrency import PROJECT_RULES, lint_project
+from .core import (BASELINE_PATH, CACHE_DIR, DEFAULT_PATHS, REPO_ROOT,
+                   FileContext, Rule, Violation, apply_baseline, lint_paths,
+                   lint_source, load_baseline, main, render_github,
+                   render_sarif, write_baseline)
 from .rules import ALL_RULES
 
 __all__ = [
-    "ALL_RULES", "BASELINE_PATH", "DEFAULT_PATHS", "REPO_ROOT",
-    "FileContext", "Rule", "Violation", "apply_baseline", "lint_paths",
-    "lint_source", "load_baseline", "main", "write_baseline",
+    "ALL_RULES", "BASELINE_PATH", "CACHE_DIR", "DEFAULT_PATHS",
+    "PROJECT_RULES", "REPO_ROOT", "FileContext", "Rule", "Violation",
+    "apply_baseline", "lint_paths", "lint_project", "lint_source",
+    "load_baseline", "main", "render_github", "render_sarif",
+    "write_baseline",
 ]

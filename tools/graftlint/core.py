@@ -1,29 +1,48 @@
-"""graftlint core — the rule framework, file runner, suppressions, baseline.
+"""graftlint core — the rule framework, file runner, cache, baseline.
 
-A repo-native static analyzer: ~8 AST rules encoding JAX hazard classes this
-codebase has actually hit (see `tools/graftlint/rules.py` for the catalog and
-ISSUE/README for the history). Deliberately dependency-free — stdlib ``ast``
-only, no jax import, so the lint gate costs milliseconds per file and runs
-identically on a dev laptop and in the tier-1 pytest tier.
+A repo-native static analyzer: 13 per-file AST rules plus 4 interprocedural
+concurrency rules encoding hazard classes this codebase has actually hit
+(see `tools/graftlint/rules.py` and `tools/graftlint/concurrency.py` for
+the catalogs and ISSUE/README for the history). Deliberately
+dependency-free — stdlib ``ast`` only, no jax import, so the lint gate
+costs ~a second cold and much less warm, and runs identically on a dev
+laptop and in the tier-1 pytest tier.
 
 Mechanics:
 
-- every rule is a `Rule` subclass with a stable kebab-case ``id``; a run
-  parses each file once and hands the tree + a per-file `FileContext`
-  (import-alias map, traced-scope set, suppression table) to every rule;
-- inline suppressions: ``# graftlint: disable=<rule>[,<rule>...]`` (or bare
-  ``disable`` for all rules) on any physical line of the flagged statement;
-- the checked-in ``tools/graftlint/baseline.json`` grandfathers pre-existing
-  violations: entries match on (rule, path, stripped source line), so line
-  drift from unrelated edits does not resurrect them;
+- every per-file rule is a `Rule` subclass with a stable kebab-case
+  ``id``; a run parses each file once and hands the tree + a per-file
+  `FileContext` (import-alias map, traced-scope set, suppression table)
+  to every rule;
+- the interprocedural rules run as a second pass over per-file summaries
+  (`project.py` pass 1 → `concurrency.py` pass 2);
+- **incremental cache**: per-file results (violations + project summary)
+  persist under ``.graftlint_cache/`` keyed on (content hash, rule-set
+  version, selected rules). The rule-set version hashes every
+  tools/graftlint source AND the three registry files (knobs /
+  failpoints / telemetry) the registry rules read, so editing a registry
+  invalidates every cached file. The pass-2 project analysis re-runs
+  every time from the (cached) summaries — it is repo-global by nature
+  and costs ~0.1 s;
+- ``--jobs N`` scans cache misses in parallel;
+- inline suppressions: ``# graftlint: disable=<rule>[,<rule>...]`` (or
+  bare ``disable`` for all rules) on any physical line of the flagged
+  statement (interprocedural findings: on the flagged line);
+- the checked-in ``tools/graftlint/baseline.json`` grandfathers
+  pre-existing violations: entries match on (rule, path, stripped source
+  line), so line drift from unrelated edits does not resurrect them;
 - ``--baseline-update`` regenerates the file deterministically (sorted,
-  path-relative, reasons preserved) so baseline diffs stay reviewable.
+  path-relative, reasons preserved) so baseline diffs stay reviewable;
+- ``--format sarif|github`` emit machine-readable findings (SARIF 2.1.0 /
+  GitHub workflow commands) for CI annotation; `tools/ci_gate.sh` runs
+  the lint and the tier-1 pytest line as one exit-coded gate.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -229,6 +248,22 @@ def scope_statements(scope: ast.AST):
         stack.extend(ast.iter_child_nodes(node))
 
 
+def suppression_table(source: str) -> dict:
+    """1-based line -> set of rule ids suppressed there (None = all) —
+    shared by per-file FileContexts and the pass-2 project runner."""
+    table: dict[int, set | None] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if m.group(1) is None:
+            table[i] = None
+        else:
+            table[i] = {r.strip() for r in m.group(1).split(",")
+                        if r.strip()}
+    return table
+
+
 class FileContext:
     def __init__(self, relpath: str, source: str, tree: ast.Module):
         self.relpath = relpath.replace(os.sep, "/")
@@ -238,17 +273,7 @@ class FileContext:
         self.aliases = collect_aliases(tree)
         self.traced = traced_scopes(tree, self.aliases)
         # suppression table: 1-based line -> set of rule ids (None = all)
-        self.suppressions: dict[int, set[str] | None] = {}
-        for i, text in enumerate(self.lines, start=1):
-            m = _SUPPRESS_RE.search(text)
-            if not m:
-                continue
-            if m.group(1) is None:
-                self.suppressions[i] = None
-            else:
-                self.suppressions[i] = {r.strip()
-                                        for r in m.group(1).split(",")
-                                        if r.strip()}
+        self.suppressions = suppression_table(source)
 
     def line_text(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -309,20 +334,210 @@ def iter_py_files(paths, root: str = REPO_ROOT):
                     yield os.path.join(dirpath, fn)
 
 
+# ---------------------------------------------------------------------------
+# incremental cache
+# ---------------------------------------------------------------------------
+CACHE_DIR = os.path.join(REPO_ROOT, ".graftlint_cache")
+
+#: registry files whose content changes the RESULTS of per-file rules
+#: (unregistered-knob/-failpoint/-metric read them) — they invalidate the
+#: whole cache exactly like editing a rule does
+_REGISTRY_FILES = ("h2o_tpu/utils/knobs.py", "h2o_tpu/utils/failpoints.py",
+                   "h2o_tpu/utils/telemetry.py")
+
+_RULESET_VERSIONS: dict[str, str] = {}
+
+
+def ruleset_version(root: str = REPO_ROOT) -> str:
+    """Hash of every tools/graftlint source plus the three registry files
+    — the cache key component that invalidates on any rule change. Memo
+    is keyed per ``root``: the registry files live under it, so a run
+    against a fixture tree must not decide the version for the repo."""
+    if root in _RULESET_VERSIONS:
+        return _RULESET_VERSIONS[root]
+    h = hashlib.sha1()
+    tooldir = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(tooldir)):
+        if fn.endswith(".py"):
+            h.update(fn.encode())
+            with open(os.path.join(tooldir, fn), "rb") as f:
+                h.update(f.read())
+    for rel in _REGISTRY_FILES:
+        ap = os.path.join(root, rel)
+        h.update(rel.encode())
+        if os.path.exists(ap):
+            with open(ap, "rb") as f:
+                h.update(f.read())
+    _RULESET_VERSIONS[root] = h.hexdigest()
+    return _RULESET_VERSIONS[root]
+
+
+def _cache_path(rel: str, cache_dir: str) -> str:
+    return os.path.join(cache_dir, rel.replace("/", "__") + ".json")
+
+
+def _cache_load(rel: str, content_key: str, rules_sig: str,
+                cache_dir: str, version: str):
+    """(violations, summary) on a hit, None on any miss/mismatch."""
+    try:
+        with open(_cache_path(rel, cache_dir), encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (data.get("content") != content_key
+            or data.get("version") != version
+            or data.get("rules") != rules_sig):
+        return None
+    vs = [Violation(**v) for v in data.get("violations", [])]
+    return vs, data.get("summary")
+
+
+def _cache_store(rel: str, content_key: str, rules_sig: str,
+                 cache_dir: str, version: str, violations, summary) -> None:
+    payload = {"content": content_key, "version": version,
+               "rules": rules_sig,
+               "violations": [dataclasses.asdict(v) for v in violations],
+               "summary": summary}
+    path = _cache_path(rel, cache_dir)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic: parallel runs never read a torn file
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+#: per-process state for the --jobs worker pool (set by _worker_init)
+_WORKER_STATE: dict = {}
+
+
+def _worker_init(rule_ids, cache, cache_dir, version, rules_sig) -> None:
+    from . import rules as rules_mod
+
+    _WORKER_STATE["rules"] = [cls() for cls in rules_mod.ALL_RULES
+                              if cls.id in set(rule_ids)]
+    _WORKER_STATE.update(cache=cache, cache_dir=cache_dir,
+                         version=version, rules_sig=rules_sig)
+
+
+def _worker_scan(item):
+    """One file's per-file scan inside a --jobs worker process."""
+    rel, source, key = item
+    from .concurrency import in_scope
+    from .project import extract_summary
+
+    st = _WORKER_STATE
+    vs = lint_source(source, relpath=rel, rules=st["rules"])
+    summary = extract_summary(rel, source) if in_scope(rel) else None
+    if st["cache"]:
+        _cache_store(rel, key, st["rules_sig"], st["cache_dir"],
+                     st["version"], vs, summary)
+    return rel, vs, summary
+
+
 def lint_paths(paths=DEFAULT_PATHS, root: str = REPO_ROOT,
-               rules: list[Rule] | None = None) -> list[Violation]:
-    out: list[Violation] = []
+               rules: list[Rule] | None = None, *,
+               project_rules=None, jobs: int | None = None,
+               cache: bool = True, cache_dir: str | None = None,
+               stats: dict | None = None) -> list[Violation]:
+    """Two-pass repo lint. Per-file rules run (or replay from cache) per
+    file — in parallel when ``jobs`` > 1; the interprocedural pass runs
+    over the per-file summaries every time (repo-global by nature).
+
+    ``project_rules``: None = all concurrency rules; [] = skip pass 2.
+    ``stats`` (optional dict) is filled with files/hits/misses counts.
+    """
+    from .concurrency import PROJECT_RULES, check_project, in_scope
+
     rules = rules if rules is not None else _all_rules()
+    if project_rules is None:
+        project_rules = list(PROJECT_RULES)
+    cache_dir = cache_dir or CACHE_DIR
+    version = ruleset_version(root)
+    rules_sig = ",".join(sorted(r.id for r in rules))
+
+    files: list[tuple[str, str]] = []   # (relpath, source)
+    out: list[Violation] = []
     for ap in iter_py_files(paths, root):
         rel = os.path.relpath(ap, root).replace(os.sep, "/")
         try:
             with open(ap, encoding="utf-8") as f:
-                source = f.read()
+                files.append((rel, f.read()))
         except OSError as e:
             out.append(Violation(rule="io-error", path=rel, line=1, col=0,
                                  message=str(e), snippet=""))
-            continue
-        out.extend(lint_source(source, relpath=rel, rules=rules))
+
+    summaries: dict[str, dict | None] = {}
+    sources = dict(files)
+    hits = 0
+    misses: list[tuple[str, str, str]] = []  # (rel, source, content_key)
+    for rel, source in files:
+        key = hashlib.sha1(source.encode("utf-8")).hexdigest()
+        got = (_cache_load(rel, key, rules_sig, cache_dir, version)
+               if cache else None)
+        if got is not None:
+            vs, summary = got
+            out.extend(vs)
+            summaries[rel] = summary
+            hits += 1
+        else:
+            misses.append((rel, source, key))
+
+    def _scan(item):
+        rel, source, key = item
+        from .project import extract_summary
+
+        vs = lint_source(source, relpath=rel, rules=rules)
+        summary = extract_summary(rel, source) if in_scope(rel) else None
+        if cache:
+            _cache_store(rel, key, rules_sig, cache_dir, version, vs,
+                         summary)
+        return rel, vs, summary
+
+    if misses:
+        results = None
+        # the scan is GIL-bound pure-python AST work, so real parallelism
+        # needs PROCESSES (a thread pool measures SLOWER than serial);
+        # spawn context keeps the children free of the parent's jax/XLA
+        # state. Only stock rules survive reconstruction in a child —
+        # custom rule instances fall back to the serial path.
+        from . import rules as rules_mod
+
+        known = {cls.id for cls in rules_mod.ALL_RULES}
+        if jobs and jobs > 1 and len(misses) > 1 \
+                and all(r.id in known for r in rules):
+            try:
+                import multiprocessing
+                from concurrent.futures import ProcessPoolExecutor
+
+                ctx = multiprocessing.get_context("spawn")
+                init_args = (sorted(r.id for r in rules), cache, cache_dir,
+                             version, rules_sig)
+                with ProcessPoolExecutor(max_workers=jobs, mp_context=ctx,
+                                         initializer=_worker_init,
+                                         initargs=init_args) as ex:
+                    results = list(ex.map(_worker_scan, misses,
+                                          chunksize=max(
+                                              len(misses) // (jobs * 4),
+                                              1)))
+            except (OSError, ValueError, ImportError):
+                results = None   # sandboxed env without fork/sem: serial
+        if results is None:
+            results = [_scan(m) for m in misses]
+        for rel, vs, summary in results:
+            out.extend(vs)
+            summaries[rel] = summary
+
+    if project_rules:
+        out.extend(check_project(summaries, sources, rules=project_rules))
+
+    if stats is not None:
+        stats.update(files=len(files), hits=hits, misses=len(misses))
     return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))
 
 
@@ -376,17 +591,73 @@ def write_baseline(violations: list[Violation], path: str = BASELINE_PATH,
 
 
 # ---------------------------------------------------------------------------
+# machine-readable output (--format sarif|github)
+# ---------------------------------------------------------------------------
+def _rule_catalog() -> list:
+    from . import rules as rules_mod
+    from .concurrency import PROJECT_RULES
+
+    return [cls() for cls in tuple(rules_mod.ALL_RULES) + PROJECT_RULES]
+
+
+def render_sarif(violations: list[Violation]) -> str:
+    """SARIF 2.1.0 — one run, one result per violation, rules carried in
+    the tool component so CI annotators can show the doc line."""
+    docs = {r.id: r.doc for r in _rule_catalog()}
+    rule_ids = sorted({v.rule for v in violations})
+    sarif = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "tools/graftlint/",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": docs.get(rid, rid)}}
+                          for rid in rule_ids],
+            }},
+            "results": [{
+                "ruleId": v.rule,
+                "level": "error" if v.severity == "error" else "warning",
+                "message": {"text": v.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line,
+                               "startColumn": v.col + 1,
+                               "snippet": {"text": v.snippet}},
+                }}],
+            } for v in violations],
+        }],
+    }
+    return json.dumps(sarif, indent=1, sort_keys=True)
+
+
+def render_github(violations: list[Violation]) -> str:
+    """GitHub Actions workflow commands — one ::error per violation, so a
+    CI run annotates the diff inline with no extra tooling."""
+    lines = []
+    for v in violations:
+        msg = v.message.replace("%", "%25").replace("\n", "%0A")
+        lines.append(f"::error file={v.path},line={v.line},"
+                     f"col={v.col + 1},title=graftlint {v.rule}::{msg}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
     from . import rules as rules_mod
+    from .concurrency import PROJECT_RULES
 
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="repo-native static analysis for the JAX hazard classes "
-                    "this codebase keeps re-fixing")
+        description="repo-native static analysis for the JAX and "
+                    "concurrency hazard classes this codebase keeps "
+                    "re-fixing")
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
                     help="files/dirs to lint (default: %(default)s)")
     ap.add_argument("--fix", action="store_true",
@@ -404,21 +675,31 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma list of rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallel workers for the per-file scan")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and don't write .graftlint_cache/")
+    ap.add_argument("--format", choices=("text", "sarif", "github"),
+                    default="text",
+                    help="finding output format (default: %(default)s)")
     args = ap.parse_args(argv)
 
     rules = [cls() for cls in rules_mod.ALL_RULES]
+    proj_rules = [cls() for cls in PROJECT_RULES]
     if args.list_rules:
-        for r in rules:
+        for r in rules + proj_rules:
             print(f"{r.id:24} [{r.severity}] {r.doc}")
         return 0
     if args.select:
         wanted = {s.strip() for s in args.select.split(",")}
-        unknown = wanted - {r.id for r in rules}
+        known = {r.id for r in rules} | {r.id for r in proj_rules}
+        unknown = wanted - known
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
         rules = [r for r in rules if r.id in wanted]
+        proj_rules = [r for r in proj_rules if r.id in wanted]
 
     if args.fix:
         from . import fixes
@@ -436,7 +717,9 @@ def main(argv: list[str] | None = None) -> int:
               "(no --select, no explicit paths)", file=sys.stderr)
         return 2
 
-    violations = lint_paths(args.paths, rules=rules)
+    violations = lint_paths(args.paths, rules=rules,
+                            project_rules=proj_rules, jobs=args.jobs,
+                            cache=not args.no_cache)
     if args.baseline_update:
         write_baseline(violations, path=args.baseline)
         print(f"baseline: {len(violations)} entr"
@@ -444,9 +727,15 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if not args.no_baseline:
         violations = apply_baseline(violations, load_baseline(args.baseline))
-    for v in violations:
-        print(v.render())
-    n = len(violations)
-    print(f"graftlint: {n} violation{'s' if n != 1 else ''} "
-          f"({'FAIL' if n else 'ok'})")
+    if args.format == "sarif":
+        print(render_sarif(violations))
+    elif args.format == "github":
+        if violations:
+            print(render_github(violations))
+    else:
+        for v in violations:
+            print(v.render())
+        n = len(violations)
+        print(f"graftlint: {n} violation{'s' if n != 1 else ''} "
+              f"({'FAIL' if n else 'ok'})")
     return 1 if violations else 0

@@ -1,0 +1,721 @@
+"""graftlint pass 1 — the repo-wide project model the interprocedural
+rules (tools/graftlint/concurrency.py) analyze.
+
+Per-file extraction produces a plain-dict **FileSummary** (JSON-able, so
+the incremental cache under ``.graftlint_cache/`` can persist it keyed on
+content hash): every function/method with the ``self.*`` fields it reads
+and writes, the guards (locks) held at each access, the calls it makes,
+the locks it acquires, the threads it spawns and joins. A **ProjectModel**
+assembles all summaries into:
+
+- a symbol table (module functions, class methods, per-class lock attrs);
+- an approximate **call graph** — ``self.m()`` resolves within the class,
+  bare/imported names resolve through the per-file import map, and
+  ``obj.m()`` resolves through a *unique-method-name* index (if exactly
+  one class in the project defines ``m`` and the name is not on the
+  common-name blocklist, the edge is taken — deliberately
+  under-approximate: an unresolved call produces no edge, never a wrong
+  one... except where a non-unique spelling collides, which the blocklist
+  exists to prevent);
+- a **thread-entry map**: every ``threading.Thread(target=...)`` (and
+  ``Timer``), every callable handed to a ``.start(fn)``-shaped job/worker
+  dispatch, every ``do_*`` method of a ``BaseHTTPRequestHandler``
+  subclass (REST handler threads — ThreadingHTTPServer runs each request
+  on its own thread), and every callable registered through an
+  ``add_*hook``/``register_*hook`` call (Cleaner sweep hooks) is a thread
+  root; the transitive closure over the call graph is the code that runs
+  on a non-main thread.
+
+Guard tracking: ``with self._lock:`` / ``with _MODULE_LOCK:`` scopes push
+a lock token for their body; a bare ``x.acquire(...)`` holds its token
+for the remainder of the enclosing block (the try/finally idiom). Tokens:
+
+- ``self.<attr>``   — instance lock (normalized per-class in the model)
+- ``mod:<NAME>``    — module-level lock of the same file
+- ``ext:<attr>``    — a lock attribute on some OTHER object (``vec._lock``
+  in the Cleaner) — resolved per-class only when the attr names a lock in
+  exactly one class, else kept out of the cycle graph (ambiguous nodes
+  would merge distinct locks and fabricate cycles)
+
+Nested functions/lambdas are extracted as their OWN functions (their
+bodies run when called, not where defined — guards at the definition site
+do not apply), inheriting the enclosing class context so a worker closure
+that captures ``self`` still attributes its field accesses to the class
+(the `Job.start._run` shape).
+
+Stdlib ``ast`` only — the linter never imports the package it lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import collect_aliases, normalize, dotted_name
+
+#: bump when the summary shape changes — the incremental cache keys on it
+SUMMARY_FORMAT = 3
+
+#: constructors whose result is a lock-like guard (Condition guards too:
+#: `with self._cv:` owns the underlying lock)
+_LOCK_CTOR_SUFFIXES = ("threading.Lock", "threading.RLock",
+                       "threading.Condition", "sanitizer.make_lock",
+                       "make_lock")
+#: constructors of non-lock sync primitives — exempt from field analysis
+#: (an Event is its own synchronization, not shared data)
+_SYNC_CTOR_SUFFIXES = _LOCK_CTOR_SUFFIXES + (
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "contextvars.ContextVar")
+
+#: attr spellings treated as locks even without a visible declaration
+#: (helper classes whose __init__ lives in another file)
+_LOCKISH_ATTRS = ("lock", "mutex", "_cv", "cv")
+
+#: method names too common to resolve through the unique-name index — a
+#: wrong edge is worse than a missing one
+_RESOLVE_BLOCKLIST = {
+    "get", "put", "set", "add", "pop", "append", "extend", "remove",
+    "clear", "copy", "update", "items", "keys", "values", "join", "split",
+    "strip", "encode", "decode", "format", "index", "count", "insert",
+    "sort", "read", "write", "close", "open", "flush", "seek", "tell",
+    "start", "stop", "run", "send", "recv", "acquire", "release", "wait",
+    "notify", "notify_all", "is_set", "mkdir", "exists", "search",
+    "match", "group", "lower", "upper", "replace", "startswith",
+    "endswith", "info", "keys", "name", "next", "reset", "submit",
+}
+
+
+def _lockish(attr: str) -> bool:
+    a = attr.lower()
+    return any(t in a for t in _LOCKISH_ATTRS)
+
+
+def _is_lock_ctor(node: ast.AST, aliases: dict) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = normalize(dotted_name(node.func), aliases)
+    return bool(fn) and fn.endswith(_LOCK_CTOR_SUFFIXES)
+
+
+def _is_sync_ctor(node: ast.AST, aliases: dict) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = normalize(dotted_name(node.func), aliases)
+    return bool(fn) and fn.endswith(_SYNC_CTOR_SUFFIXES)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for a `self.x` attribute node, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _FnState:
+    """Mutable record of one function's summary while extracting."""
+
+    def __init__(self, qual: str, cls: str | None, name: str, line: int):
+        self.qual = qual
+        self.cls = cls
+        self.name = name
+        self.line = line
+        self.reads: list = []       # [field, [guards], line]
+        self.writes: list = []      # [field, [guards], line]
+        self.calls: list = []       # [kind, name, recv, [guards], line]
+        self.acquires: list = []    # [token, [held], line]
+        self.spawns: list = []      # [target_ref, store_attr, line]
+        self.joins: list = []       # tokens joined ("self._worker", "L")
+        self.root_hints: list = []  # ["rest-handler"]
+        self.locals_alias: dict[str, str] = {}   # local -> "self.attr"
+        self.local_threads: set[str] = set()     # locals holding a Thread
+
+    def summary(self) -> dict:
+        return {"qual": self.qual, "cls": self.cls, "name": self.name,
+                "public": not self.name.startswith("_"),
+                "line": self.line, "reads": self.reads,
+                "writes": self.writes, "calls": self.calls,
+                "acquires": self.acquires, "spawns": self.spawns,
+                "joins": sorted(set(self.joins)),
+                "root_hints": self.root_hints}
+
+
+class _Extractor:
+    """Per-file AST walk → FileSummary dict."""
+
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.tree = tree
+        self.aliases = collect_aliases(tree)
+        self.module_locks: set[str] = set()
+        self.functions: dict[str, dict] = {}
+        self.classes: dict[str, dict] = {}
+        self._collect_module_locks()
+
+    def _collect_module_locks(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value,
+                                                              self.aliases):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+
+    # -- class prep -----------------------------------------------------------
+    def _class_lock_attrs(self, cls: ast.ClassDef) -> tuple[set, set]:
+        """(lock attrs, all sync attrs) declared anywhere in the class via
+        `self.x = threading.Lock()/.../sanitizer.make_lock(...)`."""
+        locks: set[str] = set()
+        syncs: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if _is_lock_ctor(node.value, self.aliases):
+                    locks.add(attr)
+                if _is_sync_ctor(node.value, self.aliases):
+                    syncs.add(attr)
+        return locks, syncs
+
+    # -- extraction -----------------------------------------------------------
+    def extract(self) -> dict:
+        # module body as a pseudo-function (module-level spawns/locks);
+        # top-level defs are extracted by _walk_top below, not here
+        mod_stmts = [s for s in self.tree.body
+                     if not isinstance(s, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef))]
+        self._extract_scope(mod_stmts, "<module>", None, "<module>", 1,
+                            class_locks=set(), class_syncs=set())
+        for node in self.tree.body:
+            self._walk_top(node, prefix="")
+        return {
+            "path": self.relpath,
+            "format": SUMMARY_FORMAT,
+            "module_locks": sorted(self.module_locks),
+            "functions": self.functions,
+            "classes": self.classes,
+        }
+
+    def _walk_top(self, node: ast.AST, prefix: str,
+                  cls_ctx: str | None = None,
+                  class_locks: set | None = None,
+                  class_syncs: set | None = None) -> None:
+        if isinstance(node, ast.ClassDef):
+            locks, syncs = self._class_lock_attrs(node)
+            bases = [dotted_name(b) or "" for b in node.bases]
+            qual = f"{prefix}{node.name}"
+            self.classes[node.name] = {
+                "qual": qual, "locks": sorted(locks),
+                "bases": bases, "methods": [], "line": node.lineno,
+            }
+            handler = any(b.split(".")[-1] == "BaseHTTPRequestHandler"
+                          for b in bases)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    st = self._extract_scope(
+                        sub.body, f"{qual}.{sub.name}", node.name, sub.name,
+                        sub.lineno, class_locks=locks, class_syncs=syncs)
+                    if handler and sub.name.startswith("do_"):
+                        st.root_hints.append("rest-handler")
+                    self.classes[node.name]["methods"].append(sub.name)
+                else:
+                    self._walk_top(sub, prefix=f"{qual}.")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._extract_scope(node.body, f"{prefix}{node.name}", cls_ctx,
+                                node.name, node.lineno,
+                                class_locks=class_locks or set(),
+                                class_syncs=class_syncs or set())
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            for sub in ast.iter_child_nodes(node):
+                self._walk_top(sub, prefix, cls_ctx, class_locks,
+                               class_syncs)
+
+    # -- one function body ----------------------------------------------------
+    def _extract_scope(self, body: list, qual: str, cls: str | None,
+                       name: str, line: int, *, class_locks: set,
+                       class_syncs: set) -> _FnState:
+        st = _FnState(qual, cls, name, line)
+        self._nested: list[tuple] = []
+        self._walk_block(body, (), st, class_locks, class_syncs)
+        self.functions[qual] = st.summary()
+        # nested defs extracted AFTER the parent (guards do not inherit:
+        # a closure body runs when called, not where defined)
+        for sub, subqual in self._pop_nested():
+            sub_body = (sub.body if isinstance(sub, (ast.FunctionDef,
+                                                     ast.AsyncFunctionDef))
+                        else [ast.Expr(value=sub.body)])
+            self._extract_scope(sub_body, subqual, cls,
+                                subqual.rsplit(".", 1)[-1],
+                                getattr(sub, "lineno", line),
+                                class_locks=class_locks,
+                                class_syncs=class_syncs)
+        return st
+
+    def _pop_nested(self):
+        out, self._nested = self._nested, []
+        return out
+
+    def _lock_token(self, expr: ast.AST, st: _FnState,
+                    class_locks: set) -> str | None:
+        """Lock token for a with-item / acquire receiver, or None when the
+        expression is not lock-like."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if attr in class_locks or _lockish(attr):
+                return f"self.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks or _lockish(expr.id):
+                return f"mod:{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute) and _lockish(expr.attr):
+            return f"ext:{expr.attr}"
+        return None
+
+    def _walk_block(self, stmts: list, guards: tuple, st: _FnState,
+                    class_locks: set, class_syncs: set) -> None:
+        guards = tuple(guards)
+        for stmt in stmts:
+            guards = self._walk_stmt(stmt, guards, st, class_locks,
+                                     class_syncs)
+
+    def _walk_stmt(self, stmt: ast.AST, guards: tuple, st: _FnState,
+                   class_locks: set, class_syncs: set) -> tuple:
+        """Process one statement; returns the guard set for the NEXT
+        statement in the block (a bare `.acquire()` extends it)."""
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._nested.append((stmt, f"{st.qual}.{stmt.name}"))
+            return guards
+        if isinstance(stmt, ast.ClassDef):
+            self._walk_top(stmt, prefix=f"{st.qual}.")
+            return guards
+        if isinstance(stmt, ast.With):
+            inner = list(guards)
+            for item in stmt.items:
+                tok = self._lock_token(item.context_expr, st, class_locks)
+                if tok is not None:
+                    st.acquires.append([tok, list(inner), stmt.lineno,
+                                        True])
+                    inner.append(tok)
+                self._scan_expr(item.context_expr, guards, st, class_locks,
+                                class_syncs)
+            self._walk_block(stmt.body, tuple(inner), st, class_locks,
+                             class_syncs)
+            return guards
+        if isinstance(stmt, ast.Try):
+            self._walk_block(stmt.body, guards, st, class_locks, class_syncs)
+            for h in stmt.handlers:
+                self._walk_block(h.body, guards, st, class_locks,
+                                 class_syncs)
+            self._walk_block(stmt.orelse, guards, st, class_locks,
+                             class_syncs)
+            self._walk_block(stmt.finalbody, guards, st, class_locks,
+                             class_syncs)
+            return guards
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test, guards, st, class_locks, class_syncs)
+            self._walk_block(stmt.body, guards, st, class_locks, class_syncs)
+            self._walk_block(stmt.orelse, guards, st, class_locks,
+                             class_syncs)
+            return guards
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # `for t in threads:` over a local thread list — joins on the
+            # loop variable drain the whole list
+            if (isinstance(stmt.iter, ast.Name)
+                    and stmt.iter.id in st.local_threads
+                    and isinstance(stmt.target, ast.Name)):
+                st.locals_alias[stmt.target.id] = f"localiter:{stmt.iter.id}"
+            self._scan_expr(stmt.iter, guards, st, class_locks, class_syncs)
+            self._scan_expr(stmt.target, guards, st, class_locks,
+                            class_syncs)
+            self._walk_block(stmt.body, guards, st, class_locks, class_syncs)
+            self._walk_block(stmt.orelse, guards, st, class_locks,
+                             class_syncs)
+            return guards
+        # simple statement: scan expressions, track aliases/acquire
+        new_guards = self._scan_simple(stmt, guards, st, class_locks,
+                                       class_syncs)
+        return new_guards
+
+    def _scan_simple(self, stmt: ast.AST, guards: tuple, st: _FnState,
+                     class_locks: set, class_syncs: set) -> tuple:
+        # local alias tracking: `w = self._shadow_worker`
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            tgt = stmt.targets[0].id
+            src_attr = _self_attr(stmt.value)
+            if src_attr is not None:
+                st.locals_alias[tgt] = f"self.{src_attr}"
+        self._scan_expr(stmt, guards, st, class_locks, class_syncs)
+        # a bare `<lock>.acquire(...)` holds for the rest of the block;
+        # `.release()` drops it (the try/finally idiom — approximate)
+        out = list(guards)
+        for node in ast.walk(stmt):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            tok = self._lock_token(node.func.value, st, class_locks)
+            if tok is None:
+                continue
+            if node.func.attr == "acquire":
+                blocking = True
+                for kw in node.keywords:
+                    if (kw.arg == "blocking"
+                            and isinstance(kw.value, ast.Constant)):
+                        blocking = bool(kw.value.value)
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    blocking = bool(node.args[0].value)
+                # non-blocking acquires still HOLD on success — they are
+                # an edge source but never an inversion victim; keep them
+                # as held guards, the cycle rule cares about order only
+                st.acquires.append([tok, list(out), node.lineno,
+                                    blocking])
+                if tok not in out:
+                    out.append(tok)
+            elif node.func.attr == "release" and tok in out:
+                out.remove(tok)
+        return tuple(out)
+
+    def _scan_expr(self, root: ast.AST, guards: tuple, st: _FnState,
+                   class_locks: set, class_syncs: set) -> None:
+        """Collect field accesses / calls / spawns from an expression tree
+        without descending into nested function scopes."""
+        stack = [(root, "load")]
+        while stack:
+            node, mode = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._nested.append((node, f"{st.qual}.{node.name}"))
+                continue
+            if isinstance(node, ast.Lambda):
+                self._nested.append(
+                    (node, f"{st.qual}.<lambda:{node.lineno}>"))
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    stack.append((t, "store"))
+                stack.append((node.value, "load"))
+                self._check_spawn_store(node, st, guards)
+                continue
+            if isinstance(node, ast.AugAssign):
+                stack.append((node.target, "both"))
+                stack.append((node.value, "load"))
+                continue
+            if isinstance(node, ast.AnnAssign):
+                if node.target is not None:
+                    stack.append((node.target, "store"))
+                if node.value is not None:
+                    stack.append((node.value, "load"))
+                continue
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is not None and attr not in class_syncs \
+                        and not (attr in class_locks or _lockish(attr)):
+                    g = list(guards)
+                    if mode in ("store", "both"):
+                        st.writes.append([attr, g, node.lineno])
+                    if mode in ("load", "both"):
+                        st.reads.append([attr, g, node.lineno])
+                stack.append((node.value, "load"))
+                continue
+            if isinstance(node, ast.Call):
+                self._record_call(node, guards, st, class_locks)
+                for sub in ast.iter_child_nodes(node):
+                    stack.append((sub, "load"))
+                continue
+            for sub in ast.iter_child_nodes(node):
+                stack.append((sub, mode if isinstance(node, (ast.Tuple,
+                                                             ast.List))
+                              else "load"))
+
+    # -- call / spawn recording ----------------------------------------------
+    def _callable_ref(self, node: ast.AST, st: _FnState) -> str | None:
+        """Reference string for a callable expression (thread target /
+        dispatched worker fn)."""
+        attr = _self_attr(node)
+        if attr is not None:
+            return f"self.{attr}"
+        if isinstance(node, ast.Name):
+            return f"name:{node.id}"
+        if isinstance(node, ast.Lambda):
+            self._nested.append((node, f"{st.qual}.<lambda:{node.lineno}>"))
+            return f"local:{st.qual}.<lambda:{node.lineno}>"
+        dn = dotted_name(node)
+        if dn:
+            return f"dotted:{dn}"
+        return None
+
+    def _check_spawn_store(self, assign: ast.Assign, st: _FnState,
+                           guards: tuple) -> None:
+        """`self.X = threading.Thread(...)` / `t = threading.Thread(...)`
+        / `threads = [threading.Thread(...) for ...]` — record the storage
+        so joins (incl. `for t in threads: t.join()`) can be matched."""
+        call = assign.value
+        if isinstance(call, (ast.ListComp, ast.GeneratorExp)):
+            inner = next((n for n in ast.walk(call.elt)
+                          if isinstance(n, ast.Call)
+                          and (normalize(dotted_name(n.func), self.aliases)
+                               or "").endswith(("threading.Thread",
+                                                "threading.Timer"))), None)
+            if inner is not None:
+                for t in assign.targets:
+                    if isinstance(t, ast.Name):
+                        st.local_threads.add(t.id)
+                        self._note_spawn(inner, st, store=f"local:{t.id}")
+                        return
+            return
+        if not isinstance(call, ast.Call):
+            return
+        fn = normalize(dotted_name(call.func), self.aliases)
+        if not fn or not fn.endswith(("threading.Thread",
+                                      "threading.Timer")):
+            return
+        for t in assign.targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                self._note_spawn(call, st, store=f"self.{attr}")
+                return
+            if isinstance(t, ast.Name):
+                st.local_threads.add(t.id)
+                self._note_spawn(call, st, store=f"local:{t.id}")
+                return
+        self._note_spawn(call, st, store=None)
+
+    def _note_spawn(self, call: ast.Call, st: _FnState,
+                    store: str | None) -> None:
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = self._callable_ref(kw.value, st)
+        if target is None and call.args:
+            target = self._callable_ref(call.args[0], st)
+        # dedupe: _record_call sees the same Call node again
+        for sp in st.spawns:
+            if sp[2] == call.lineno:
+                return
+        st.spawns.append([target, store, call.lineno, "thread"])
+
+    def _record_call(self, node: ast.Call, guards: tuple,
+                     st: _FnState, class_locks: set) -> None:
+        fn = normalize(dotted_name(node.func), self.aliases)
+        line = node.lineno
+        g = list(guards)
+        # thread spawn (anonymous / unstored form)
+        if fn and fn.endswith(("threading.Thread", "threading.Timer")):
+            self._note_spawn(node, st, store=None)
+            return
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            recv = None
+            a = _self_attr(node.func.value)
+            if a is not None:
+                recv = f"self.{a}"
+            elif isinstance(node.func.value, ast.Name):
+                nm = node.func.value.id
+                recv = st.locals_alias.get(nm, f"name:{nm}")
+            elif isinstance(node.func.value, ast.Constant):
+                recv = "literal"
+            # join bookkeeping for unjoined-thread
+            if meth == "join" and recv and recv != "literal":
+                if recv.startswith("self."):
+                    st.joins.append(recv)
+                elif (recv.startswith("name:")
+                        and recv[5:] in st.local_threads):
+                    st.joins.append(f"local:{recv[5:]}")
+                elif recv.startswith("localiter:"):
+                    st.joins.append(f"local:{recv[10:]}")
+            # `.start(fn)` with a callable argument = a worker dispatch
+            # (Thread.start takes no args, so this is Job.start-shaped)
+            if meth == "start" and node.args:
+                ref = self._callable_ref(node.args[0], st)
+                if ref is not None:
+                    st.spawns.append([ref, None, line, "dispatch"])
+            # hook registration: the callable runs on someone else's thread
+            if (("hook" in meth and meth.startswith(("add_", "register_")))
+                    and node.args):
+                ref = self._callable_ref(node.args[0], st)
+                if ref is not None:
+                    st.spawns.append([ref, None, line, "dispatch"])
+            if self._self_call(node, st):
+                st.calls.append(["self", meth, None, g, line])
+            elif fn is not None:
+                st.calls.append(["dotted", fn, recv, g, line])
+            else:
+                st.calls.append(["attr", meth, recv, g, line])
+        elif isinstance(node.func, ast.Name):
+            st.calls.append(["name", node.func.id, None, g, line])
+        elif fn is not None:
+            st.calls.append(["dotted", fn, None, g, line])
+
+    @staticmethod
+    def _self_call(node: ast.Call, st: _FnState) -> bool:
+        return (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self")
+
+
+def extract_summary(relpath: str, source: str) -> dict | None:
+    """FileSummary for one source file (None on syntax errors — the
+    per-file rules report those)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    return _Extractor(relpath.replace(os.sep, "/"), tree).extract()
+
+
+# ---------------------------------------------------------------------------
+# the assembled model
+# ---------------------------------------------------------------------------
+class ProjectModel:
+    """All file summaries resolved into one queryable graph."""
+
+    def __init__(self, summaries: dict[str, dict]):
+        #: path -> summary (insertion order = scan order; keep sorted)
+        self.files = {p: s for p, s in sorted(summaries.items())
+                      if s is not None}
+        #: fnkey ("path::qual") -> function summary (+ "path")
+        self.functions: dict[str, dict] = {}
+        #: (path, class) -> class record
+        self.classes: dict[tuple, dict] = {}
+        #: method name -> [fnkey] across all classes (unique-name index)
+        self.method_index: dict[str, list] = {}
+        #: (path, name) -> fnkey for module-level functions
+        self.module_funcs: dict[tuple, str] = {}
+        #: module dotted path -> relpath ("h2o_tpu.serving.stats" -> file)
+        self.module_paths: dict[str, str] = {}
+        for path, summ in self.files.items():
+            mod = path[:-3].replace("/", ".") if path.endswith(".py") \
+                else path
+            self.module_paths[mod] = path
+            if mod.endswith(".__init__"):
+                self.module_paths[mod[:-9]] = path
+            for cname, crec in summ.get("classes", {}).items():
+                self.classes[(path, cname)] = crec
+            for qual, fn in summ.get("functions", {}).items():
+                key = f"{path}::{qual}"
+                rec = dict(fn)
+                rec["path"] = path
+                self.functions[key] = rec
+                if fn.get("cls"):
+                    self.method_index.setdefault(fn["name"], []).append(key)
+                elif "." not in qual and qual != "<module>":
+                    self.module_funcs[(path, qual)] = key
+
+    # -- resolution -----------------------------------------------------------
+    def resolve_call(self, caller_key: str, kind: str, name: str,
+                     recv: str | None) -> str | None:
+        fn = self.functions.get(caller_key)
+        if fn is None:
+            return None
+        path = fn["path"]
+        if kind == "self":
+            cls = fn.get("cls")
+            if cls and (path, cls) in self.classes \
+                    and name in self.classes[(path, cls)]["methods"]:
+                prefix = self.classes[(path, cls)]["qual"]
+                return f"{path}::{prefix}.{name}"
+            return self._unique_method(name)
+        if kind == "name":
+            # nested def of the same function, then module function
+            key = f"{path}::{fn['qual']}.{name}"
+            if key in self.functions:
+                return key
+            return self.module_funcs.get((path, name))
+        if kind == "dotted":
+            # "telemetry.inc" with telemetry -> h2o_tpu.utils.telemetry;
+            # relative imports resolve by unique module-path suffix
+            head, _, meth = name.rpartition(".")
+            target_path = self.module_paths.get(head)
+            if target_path is None and head:
+                cands = {p for m, p in self.module_paths.items()
+                         if m == head or m.endswith("." + head)}
+                if len(cands) == 1:
+                    target_path = next(iter(cands))
+            if target_path is not None:
+                return self.module_funcs.get((target_path, meth))
+            return None
+        if kind == "attr":
+            return self._unique_method(name)
+        return None
+
+    def _unique_method(self, name: str) -> str | None:
+        if name in _RESOLVE_BLOCKLIST:
+            return None
+        keys = self.method_index.get(name, [])
+        return keys[0] if len(keys) == 1 else None
+
+    def resolve_ref(self, caller_key: str, ref: str | None) -> str | None:
+        """Resolve a spawn-target reference string to an fnkey."""
+        if ref is None:
+            return None
+        if ref.startswith("local:"):
+            fn = self.functions.get(caller_key)
+            if fn is None:
+                return None
+            return f"{fn['path']}::{ref[6:]}" \
+                if f"{fn['path']}::{ref[6:]}" in self.functions else None
+        if ref.startswith("self."):
+            return self.resolve_call(caller_key, "self", ref[5:], None)
+        if ref.startswith("name:"):
+            return self.resolve_call(caller_key, "name", ref[5:], None)
+        if ref.startswith("dotted:"):
+            return self.resolve_call(caller_key, "dotted", ref[7:], None)
+        return None
+
+    # -- thread-entry map -----------------------------------------------------
+    def thread_roots(self) -> dict[str, str]:
+        """{fnkey: root description} — every function that starts life on
+        a non-main thread."""
+        roots: dict[str, str] = {}
+        for key, fn in self.functions.items():
+            for ref, _store, line, _kind in fn.get("spawns", []):
+                tgt = self.resolve_ref(key, ref)
+                if tgt is not None and tgt in self.functions:
+                    roots.setdefault(
+                        tgt, f"spawned at {fn['path']}:{line}")
+            if "rest-handler" in fn.get("root_hints", []):
+                roots.setdefault(key, "REST handler thread")
+        return roots
+
+    def thread_reachable(self) -> dict[str, str]:
+        """Closure of thread roots over the call graph:
+        {fnkey: originating root description}."""
+        roots = self.thread_roots()
+        out: dict[str, str] = dict(roots)
+        stack = list(roots)
+        while stack:
+            cur = stack.pop()
+            fn = self.functions.get(cur)
+            if fn is None:
+                continue
+            for kind, name, recv, _g, _line in fn.get("calls", []):
+                tgt = self.resolve_call(cur, kind, name, recv)
+                if tgt is not None and tgt not in out:
+                    out[tgt] = out[cur]
+                    stack.append(tgt)
+        return out
+
+    # -- lock identity --------------------------------------------------------
+    def lock_id(self, fnkey: str, token: str) -> str | None:
+        """Global lock node id for a held/acquired token, or None when the
+        token is ambiguous (kept out of the cycle graph)."""
+        fn = self.functions.get(fnkey)
+        if fn is None:
+            return None
+        path = fn["path"]
+        if token.startswith("self."):
+            cls = fn.get("cls") or "?"
+            return f"{path}::{cls}.{token[5:]}"
+        if token.startswith("mod:"):
+            return f"{path}::{token[4:]}"
+        if token.startswith("ext:"):
+            attr = token[4:]
+            owners = [(p, c) for (p, c), rec in self.classes.items()
+                      if attr in rec.get("locks", [])]
+            if len(owners) == 1:
+                return f"{owners[0][0]}::{owners[0][1]}.{attr}"
+            return None
+        return None
