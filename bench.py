@@ -145,13 +145,22 @@ def bench_airlines(nrow: int, ntrees: int) -> dict:
     """GBM train-to-AUC at Airlines scale: 100 trees over 7 categorical
     (SET splits, nbins_cats) + 2 numeric columns. The raw frame spills
     through the Cleaner once the binned matrix is resident (116M rows of
-    frame + binned + working columns exceed one chip's HBM)."""
+    frame + binned + working columns exceed one chip's HBM).
+
+    Since PR 12 this is also the pipelined-training scoreboard: the leg
+    trains the pipelined default (H2O_TPU_PIPELINE=1) cold + warm, then
+    the synchronous oracle (=0) warm, and records the speedup, the
+    forest/prediction BIT-parity flag, the warm run's uncached compile
+    count, and the sampled ``gbm.pipeline.overlap_ratio`` gauge —
+    acceptance: parity true, >= 1.25x, 0 uncached steady-state compiles."""
     import gc as _gc
 
     import jax
+    import numpy as np
 
     from h2o_tpu.backend.memory import CLEANER, hbm_stats
     from h2o_tpu.models.gbm import GBM, GBMParameters
+    from h2o_tpu.utils import compilemeter, knobs, telemetry
 
     t0 = time.time()
     fr = _airlines_frame(nrow)
@@ -162,21 +171,61 @@ def bench_airlines(nrow: int, ntrees: int) -> dict:
     jax.device_get([jnp.sum(v.data) for v in fr.vecs if v.data is not None])
     h2d_s = round(time.time() - t0, 2)
 
-    p = GBMParameters(training_frame=fr, response_column="IsDepDelayed",
-                      ntrees=ntrees, max_depth=5, nbins=20, seed=42,
-                      learn_rate=0.1, score_tree_interval=ntrees)
-    t0 = time.time()
-    model = GBM(p).train_model()  # drains device arrays before returning
-    wall = time.time() - t0
+    params = GBMParameters(training_frame=fr, response_column="IsDepDelayed",
+                           ntrees=ntrees, max_depth=5, nbins=20, seed=42,
+                           learn_rate=0.1, score_tree_interval=ntrees)
+
+    def train():
+        t0 = time.time()
+        m = GBM(params).train_model()  # drains device arrays on return
+        return m, time.time() - t0
+
+    prev = knobs.raw("H2O_TPU_PIPELINE")
+    try:
+        os.environ["H2O_TPU_PIPELINE"] = "1"
+        model, wall_cold = train()            # compile + allocator warm-up
+        with compilemeter.scoped() as sc:
+            model, wall = train()             # the steady-state headline
+        uncached = sc.uncached
+        os.environ["H2O_TPU_PIPELINE"] = "0"
+        # the oracle pays its own cold trace+compile first, so the
+        # recorded speedup is warm-vs-warm, never compile wall (review
+        # catch: the sync program is a fresh trace in this process)
+        sync_model, _ = train()
+        sync_model, wall_sync = train()
+    finally:
+        if prev is None:
+            os.environ.pop("H2O_TPU_PIPELINE", None)
+        else:
+            os.environ["H2O_TPU_PIPELINE"] = prev
+    parity = all(
+        bool(np.array_equal(np.asarray(model.forest[k]),
+                            np.asarray(sync_model.forest[k])))
+        for k in ("feat", "thr", "nanL", "val", "gain", "catd"))
+    Xs = model.adapt_frame(fr)
+    parity = parity and bool(np.array_equal(
+        np.asarray(model.score0(Xs)), np.asarray(sync_model.score0(Xs))))
+    del Xs
+    overlap = telemetry.snapshot().get("gbm.pipeline.overlap_ratio",
+                                       {}).get("value")
     auc = model.output.training_metrics.auc
     stats = hbm_stats() or {}
-    out = {"wall_s": round(wall, 3), "train_auc": round(float(auc), 4),
+    out = {"wall_s": round(wall, 3), "wall_cold_s": round(wall_cold, 3),
+           "wall_sync_s": round(wall_sync, 3),
+           "pipeline_speedup_x": round(wall_sync / max(wall, 1e-9), 3),
+           "forest_parity": parity,
+           "uncached_compiles_warm": uncached,
+           "overlap_ratio": overlap,
+           "train_auc": round(float(auc), 4),
            "rows": nrow, "gen_s": gen_s, "h2d_s": h2d_s,
            "cleaner_spills": CLEANER.spills,
            "hbm_peak_bytes": stats.get("peak_bytes_in_use"),
-           "note": ("train-to-AUC north-star leg; no reference band at "
-                    "116M — airlines-10m CPU band is 54-78 s (x11.6 rows)")}
-    del model, fr
+           "note": ("train-to-AUC north-star leg + pipelined-training "
+                    "scoreboard; acceptance: forest_parity true, "
+                    "pipeline_speedup_x >= 1.25, uncached_compiles_warm "
+                    "== 0. no reference band at 116M — airlines-10m CPU "
+                    "band is 54-78 s (x11.6 rows)")}
+    del model, sync_model, fr
     _gc.collect()
     return out
 

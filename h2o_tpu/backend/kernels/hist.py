@@ -213,6 +213,80 @@ def _pallas_grouped(xgs, lc, vv, groups, n_lv, na_global, rb):
 # ---------------------------------------------------------------------------
 # public entry — what engine._build_level_hist calls
 # ---------------------------------------------------------------------------
+def level_hist_one_group(xg, lc, vv, *, Bg: int, mode: str, n_lv: int,
+                         nbins_tot: int, block: int,
+                         backend: str | None = None):
+    """ONE width bucket accumulated in its own scan — the async-psum shape:
+    the caller issues this group's psum immediately after, BEFORE tracing
+    the next group's scan, so on a real ICI the collective overlaps the
+    next bucket's local accumulation. Bit-parity with the joint-scan path
+    is by construction: the per-block contribution is the same
+    `_one_group_contrib` over the same block contents in the same ascending
+    block order (the shared node outer product is recomputed per scan but
+    is an exact outer product — identical values either way)."""
+    rb = pow2_block_rows(lc.shape[0], block)
+    bk = backend or hist_backend()
+    groups1 = ((tuple(range(xg.shape[1])), Bg, mode),)
+    fn = _pallas_grouped if bk == "pallas" else _xla_grouped
+    return fn([xg], lc, vv, groups1, n_lv, nbins_tot - 1, rb)[0]
+
+
+def streamed_route_hist(Xb, node, vals, route_fn, *, offset: int, n_lv: int,
+                        nbins_tot: int, block: int, groups=None):
+    """Fused route→accumulate single pass — the double-buffered column-block
+    stream of the pipelined level program (``H2O_TPU_PIPELINE``).
+
+    The synchronous level program walks the row blocks TWICE per level: once
+    to route rows off the previous level's splits, once to accumulate the
+    new level's histogram. This pass decodes each (rb, F) block once:
+    ``route_fn`` (the previous level's routing, closure from the engine)
+    advances the block's node ids, the level window localizes them, and the
+    block's histogram contribution accumulates immediately — while the scan
+    machinery is already streaming the NEXT block's codes in (XLA pipelines
+    the decode/upcast of block i+1 against block i's contraction; on TPU
+    the Mosaic grid does the same with VMEM DMA double-buffering). Returns
+    ``(hists, node)`` with ``hists`` a tuple of per-group accumulators (one
+    flat accumulator when ``groups`` is None) and ``node`` the advanced
+    (Rl,) ids. No collectives — the caller psums, exactly like
+    `level_hist_blocks`.
+
+    Bit-parity with the two-pass shape is by construction: routing is
+    integer/boolean work (any formulation that picks the same children is
+    exact), and the histogram contributions are the same `_flat_contrib` /
+    `_group_contrib` over the same block contents in the same block order.
+    ``route_fn=None`` (level 0) skips the routing half."""
+    Rl = Xb.shape[0]
+    V = vals.shape[1]
+    rb = pow2_block_rows(Rl, block)
+    nblk = Rl // rb
+
+    def body(accs, blk):
+        xb, nd, v = blk
+        if route_fn is not None:
+            nd = route_fn(xb, nd)
+        local = nd - offset
+        active = (local >= 0) & (local < n_lv)
+        lc = jnp.clip(local, 0, n_lv - 1)
+        vz = jnp.where(active[:, None], v, 0.0)
+        if groups is None:
+            cs = (_flat_contrib(xb, lc, vz, n_lv, nbins_tot),)
+        else:
+            xgs = [xb[:, list(idxs)] for idxs, _Bg, _mode in groups]
+            cs = _group_contrib(xgs, lc, vz, groups, n_lv, nbins_tot - 1)
+        return tuple(a + c for a, c in zip(accs, cs)), nd
+
+    if groups is None:
+        init = (jnp.zeros((Xb.shape[1], n_lv, nbins_tot, V), jnp.float32),)
+    else:
+        init = tuple(jnp.zeros((len(idxs), n_lv, Bg, V), jnp.float32)
+                     for idxs, Bg, _mode in groups)
+    accs, node_b = jax.lax.scan(
+        body, init, (Xb.reshape(nblk, rb, Xb.shape[1]),
+                     node.reshape(nblk, rb),
+                     vals.reshape(nblk, rb, V)))
+    return accs, node_b.reshape(Rl)
+
+
 def level_hist_blocks(Xb, lc, vv, *, n_lv: int, nbins_tot: int, block: int,
                       groups=None, backend: str | None = None):
     """Per-shard level-histogram accumulation over row blocks.

@@ -38,7 +38,7 @@ from .tree.binning import (bin_matrix, compute_bin_edges,
                            compute_bin_edges_cols)
 from .tree.engine import (TreeConfig, make_train_fn, plan_hist_groups,
                           predict_forest, psum_payload_bytes,
-                          sample_tree_phases)
+                          sample_pipeline_phases, sample_tree_phases)
 
 #: last build's training-matrix accounting (mode, per-matrix bytes) — the
 #: bench binned-storage leg and the chunk-store tests read this to put the
@@ -65,6 +65,21 @@ def _phase_sample_due() -> bool:
     if bk in _PHASE_SAMPLED:
         return False
     _PHASE_SAMPLED.add(bk)
+    return True
+
+
+#: processes that already sampled the pipelined-stage profile (overlap
+#: ratio gauge) — tests clear it to force a fresh sample
+_PIPE_SAMPLED: set = set()
+
+
+def _pipe_sample_due() -> bool:
+    from ..backend.kernels import hist_backend
+
+    bk = hist_backend()
+    if bk in _PIPE_SAMPLED:
+        return False
+    _PIPE_SAMPLED.add(bk)
     return True
 
 
@@ -610,6 +625,14 @@ class GBM(ModelBuilder):
             # hybrid gamma leaves (`GBM.java:685`); the split-search
             # gradients still clip at unit delta (documented residue)
             cfg = dataclasses.replace(cfg, huber_leaf_alpha=p.huber_alpha)
+        # async pipelined training knobs (ISSUE 12): the pipelined level
+        # program and the overlapped reduction are BIT-equal to the
+        # synchronous oracle, so they default on; GOSS changes the forest
+        # (it is a sampler) and defaults off
+        cfg = dataclasses.replace(
+            cfg, pipeline=get_bool("H2O_TPU_PIPELINE"),
+            async_psum=get_bool("H2O_TPU_ASYNC_PSUM"),
+            goss=self._goss_config(K))
         # the cache key must pin everything grad_fn's behavior depends on;
         # custom distribution UDFs bypass the cache entirely (an id()-based
         # key could alias a new UDF at a recycled address after GC)
@@ -633,6 +656,39 @@ class GBM(ModelBuilder):
             f0=f0, grad_fn=grad_fn, cfg=cfg, grad_key=grad_key, y_k=y_k,
             f=f, iscat_dev=iscat_dev, nedges_dev=nedges_dev,
             nedges_np=nedges_np, binned_view=binned_view)
+
+    def _goss_config(self, K: int):
+        """Parse H2O_TPU_GOSS into cfg.goss — (a, b) fractions, or None.
+
+        A malformed spec fails loudly (the knobs discipline); a valid spec
+        on an ineligible build (multinomial's per-class gradients, DRF's
+        bagging-not-boosting, quantile/huber's full-row residual leaves)
+        logs and trains unsampled rather than failing a job over a global
+        env knob."""
+        from ..utils.knobs import get_str
+
+        raw = (get_str("H2O_TPU_GOSS") or "").strip()
+        if not raw:
+            return None
+        try:
+            a_s, b_s = raw.split(",")
+            a, b = float(a_s), float(b_s)
+        except ValueError:
+            raise ValueError(f"H2O_TPU_GOSS={raw!r} — expected two "
+                             f"fractions 'a,b' (e.g. 0.2,0.1)")
+        if not (0.0 <= a and 0.0 < b and a + b <= 1.0):
+            raise ValueError(f"H2O_TPU_GOSS={raw!r} — need a >= 0, b > 0 "
+                             f"and a + b <= 1")
+        if (K > 1 or self.drf_mode
+                or getattr(self.params, "distribution", None) in
+                ("laplace", "quantile", "huber")):
+            from ..utils.log import info
+
+            info("H2O_TPU_GOSS set but this build is ineligible "
+                 "(multinomial / DRF / quantile-family leaves) — training "
+                 "with full rows")
+            return None
+        return (a, b)
 
     def build_impl(self, job: Job) -> GBMModel:
         rs = self._take_resume_state()
@@ -755,22 +811,53 @@ class GBM(ModelBuilder):
         chunks = [(all_keys[i:i + interval],
                    jnp.asarray(all_rates[i:i + interval]))
                   for i in range(0, n_new, interval)]
+        from jax.sharding import PartitionSpec as _Pspec
+
+        # pipelined chunk dispatch (ISSUE 12): fold cadence scoring into
+        # the train step (the score0-layout raw predictions come out of
+        # the program that already holds the final margin), and donate the
+        # carried margin's buffer across chunk dispatches. Both ride
+        # cfg.pipeline; DRF keeps standalone scoring (its cadence metrics
+        # are the OOB path's, computed from the OOB accumulators).
+        fused_score = bool(cfg.pipeline) and not self.drf_mode
+        donate_f = bool(cfg.pipeline)
+        score_fn = score_spec = None
+        if fused_score:
+            cfg = dataclasses.replace(cfg, fused_score=True)
+            score_fn = _metrics_raw_fn(category, dist, self.drf_mode)
+            score_spec = (_Pspec(ROWS) if category == "Regression"
+                          else _Pspec(ROWS, None))
+        # trees done after each chunk (the fused score's traced nt scalar)
+        nd_after = []
+        run = n_prior
+        for keys_c, _rates_c in chunks:
+            run += int(keys_c.shape[0])
+            nd_after.append(run)
         # The compiled program depends on the CHUNK length (the scan is over
         # the per-chunk keys), never on the total tree count — keying the
         # train-fn cache on the interval makes a 10-tree warm-up compile serve
         # a 1000-tree run at the same scoring cadence.
         train_fn = make_train_fn(dataclasses.replace(cfg, ntrees=interval),
-                                 grad_fn, mesh, cache_key=grad_key)
+                                 grad_fn, mesh, cache_key=grad_key,
+                                 score_fn=score_fn, score_spec=score_spec,
+                                 donate=donate_f)
         # pin the carried f to the trainer's OUTPUT sharding before the AOT
         # lower: chunk 0's freshly-broadcast f can come back replicated
         # (GSPMD's choice for a data-independent broadcast) while every
         # later chunk carries the P(ROWS)-sharded train output — an AOT
         # executable compiled for the former rejects the latter, and the
         # whole job silently pays the jitted fallback on a multi-shard mesh
-        from jax.sharding import PartitionSpec as _Pspec
-
         fspec = _Pspec(ROWS) if K == 1 else _Pspec(None, ROWS)
         f = put_sharded(f, fspec, mesh)
+
+        def _step_args(ci, f_in):
+            keys_c, rates_c = chunks[ci]
+            args = (Xb, y_k, w, f_in, edges, edge_ok, keys_c, rates_c,
+                    mono, imat, s.iscat_dev, s.nedges_dev)
+            if fused_score:
+                args += (jnp.asarray(nd_after[ci], jnp.float32),)
+            return args
+
         # AOT lower+compile the uniform-chunk step NOW (build setup), so the
         # chunk loop dispatches a prebuilt executable and the compile wall /
         # persistent-cache replay is measured at one attributable site
@@ -779,12 +866,10 @@ class GBM(ModelBuilder):
             from ..backend.kernels import hist_backend
 
             aot_key = (dataclasses.replace(cfg, ntrees=interval), grad_key,
-                       id(mesh), hist_backend())
+                       id(mesh), hist_backend(), donate_f)
             try:
                 train_step = _aot_train_step(
-                    train_fn, (Xb, y_k, w, f, edges, edge_ok, chunks[0][0],
-                               chunks[0][1], mono, imat, s.iscat_dev,
-                               s.nedges_dev), aot_key)
+                    train_fn, _step_args(0, f), aot_key)
             except Exception as e:  # AOT is an optimization, never a gate
                 from ..utils.log import warn
 
@@ -826,6 +911,16 @@ class GBM(ModelBuilder):
             stop_metric_series = list(rs["stop_series"])
         from ..utils import telemetry
 
+        # dispatch-ahead engages when nothing at a boundary needs the
+        # carried margin back on host: fused scoring supplies the metric
+        # input, no early stopping / time budget / auto-recovery reads
+        # in-flight state mid-sequence
+        dispatch_ahead = (fused_score and len(chunks) > 1
+                          and p.stopping_rounds <= 0
+                          and not getattr(p, "max_runtime_secs", 0)
+                          and not p.export_checkpoints_dir
+                          and self._recovery is None)
+        ahead = None
         for ci in range(start_ci, len(chunks)):
             keys, rates = chunks[ci]
             failpoints.hit("train.gbm.chunk")
@@ -862,26 +957,64 @@ class GBM(ModelBuilder):
                         from ..utils.log import warn  # kill a training job
 
                         warn(f"tree phase sample skipped ({e!r})")
-                step_args = (Xb, y_k, w, f, edges, edge_ok, keys, rates,
-                             mono, imat, s.iscat_dev, s.nedges_dev)
-                use_aot = (train_step is not None
-                           and keys.shape[0] == len(chunks[0][0]))
-                try:
-                    f, osum, ocnt, trees = (train_step if use_aot
-                                            else train_fn)(*step_args)
-                except (TypeError, ValueError) as e:
-                    if not use_aot:
-                        raise
-                    # the AOT executable is stricter than jit (it refuses
-                    # argument shardings/layouts it was not lowered for —
-                    # e.g. a resume-restored f placed differently); the
-                    # jitted twin re-places and proceeds
-                    from ..utils.log import warn
+                if (ci == start_ci and K == 1 and telemetry.enabled()
+                        and cfg.pipeline and _pipe_sample_due()):
+                    # pipelined-stage profile: h2d / local-accum /
+                    # psum-wait / split walls + the overlap-ratio gauge
+                    # (how much of the h2d+collective wall the pipeline
+                    # hides) — once per process, same rationale as above
+                    try:
+                        g_s, h_s = grad_fn(y_k, f, w)
+                        sample_pipeline_phases(
+                            Xb, jnp.stack([w, g_s, h_s], axis=1), cfg,
+                            mesh)
+                    except Exception as e:
+                        from ..utils.log import warn
 
-                    warn(f"AOT train step rejected its arguments ({e!r}) "
-                         f"— jitted fallback for this job")
-                    train_step = None
-                    f, osum, ocnt, trees = train_fn(*step_args)
+                        warn(f"pipeline phase sample skipped ({e!r})")
+
+                def _dispatch(cj, f_in):
+                    nonlocal train_step
+                    args = _step_args(cj, f_in)
+                    use_aot = (train_step is not None
+                               and chunks[cj][0].shape[0]
+                               == len(chunks[0][0]))
+                    try:
+                        return (train_step if use_aot else train_fn)(*args)
+                    except (TypeError, ValueError):
+                        if not use_aot:
+                            raise
+                        # the AOT executable is stricter than jit (it
+                        # refuses argument shardings/layouts it was not
+                        # lowered for — e.g. a resume-restored f placed
+                        # differently); the jitted twin re-places and
+                        # proceeds
+                        from ..utils.log import warn
+
+                        warn("AOT train step rejected its arguments "
+                             "— jitted fallback for this job")
+                        train_step = None
+                        return train_fn(*args)
+
+                outs = ahead if ahead is not None else _dispatch(ci, f)
+                ahead = None
+                if fused_score:
+                    f, osum, ocnt, trees, mraw = outs
+                else:
+                    f, osum, ocnt, trees = outs
+                    mraw = None
+                if dispatch_ahead and ci + 1 < len(chunks):
+                    # dispatch-ahead: enqueue the NEXT chunk's step before
+                    # this boundary's metrics/history host work drains —
+                    # the device trains chunk ci+1 while the host scores
+                    # chunk ci. The margin passed on is DONATED; nothing
+                    # below may read f again (fused scoring consumes mraw,
+                    # and the dispatch_ahead gate keeps every f-reading
+                    # boundary consumer — recovery, export, stopping —
+                    # out of this mode; pinned by tests/test_pipeline.py,
+                    # which is the real guard here: the *step_args
+                    # dispatch is invisible to the use-after-donate lint).
+                    ahead = _dispatch(ci + 1, f)
                 oob_sum = osum if oob_sum is None else oob_sum + osum
                 oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
                 parts.append(trees)
@@ -900,6 +1033,7 @@ class GBM(ModelBuilder):
                         m.description = "Reported on OOB data"
                 if m is None:
                     m = make_metrics(category, s.ym,
+                                     mraw if mraw is not None else
                                      _metrics_raw(category, dist, f,
                                                   self.drf_mode,
                                                   ntrees_done),
@@ -1256,6 +1390,33 @@ def _interaction_matrix(names, groups) -> np.ndarray:
 _METRICS_RAW_CACHE: dict = {}
 
 
+def _metrics_raw_fn(category, dist, drf_mode):
+    """The carried-link → score0-layout conversion as a pure function of
+    (f, ntrees) — consumed by `_metrics_raw`'s standalone jitted program
+    AND, under fused cadence scoring (cfg.fused_score), traced straight
+    into the chunk train step so the margin never rematerializes."""
+    def raw(f, nt):
+        if category == "Regression":
+            # DRF carries the SUM of per-tree leaf means; the
+            # prediction is the average (prediction path divides in
+            # _raw_f — metrics must too)
+            return f / nt if drf_mode else dist.linkinv(f)
+        if category == "Binomial":
+            p1 = (dist.linkinv(f) if not drf_mode
+                  else jnp.clip(f / nt, 0, 1))
+            return jnp.stack([(p1 > 0.5).astype(jnp.float32),
+                              1 - p1, p1], axis=1)
+        if drf_mode:
+            p = jnp.clip(f.T / nt, 1e-9, 1.0)
+            p = p / jnp.sum(p, axis=1, keepdims=True)
+        else:
+            p = jax.nn.softmax(f, axis=0).T
+        label = jnp.argmax(p, axis=1).astype(jnp.float32)
+        return jnp.concatenate([label[:, None], p], axis=1)
+
+    return raw
+
+
 def _metrics_raw(category, dist, f, drf_mode, ntrees):
     """Convert carried link predictions to the score0 output layout —
     ONE compiled program per (category, dist, drf) shape family; the tree
@@ -1267,26 +1428,7 @@ def _metrics_raw(category, dist, f, drf_mode, ntrees):
     # capture, and an id() key could alias a recycled address)
     fn = _METRICS_RAW_CACHE.get(key) if builtin else None
     if fn is None:
-        def raw(f, nt):
-            if category == "Regression":
-                # DRF carries the SUM of per-tree leaf means; the
-                # prediction is the average (prediction path divides in
-                # _raw_f — metrics must too)
-                return f / nt if drf_mode else dist.linkinv(f)
-            if category == "Binomial":
-                p1 = (dist.linkinv(f) if not drf_mode
-                      else jnp.clip(f / nt, 0, 1))
-                return jnp.stack([(p1 > 0.5).astype(jnp.float32),
-                                  1 - p1, p1], axis=1)
-            if drf_mode:
-                p = jnp.clip(f.T / nt, 1e-9, 1.0)
-                p = p / jnp.sum(p, axis=1, keepdims=True)
-            else:
-                p = jax.nn.softmax(f, axis=0).T
-            label = jnp.argmax(p, axis=1).astype(jnp.float32)
-            return jnp.concatenate([label[:, None], p], axis=1)
-
-        fn = jax.jit(raw)
+        fn = jax.jit(_metrics_raw_fn(category, dist, drf_mode))
         if builtin:
             fn = _METRICS_RAW_CACHE.setdefault(key, fn)
     return fn(f, jnp.float32(max(ntrees, 1)))

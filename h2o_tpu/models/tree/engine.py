@@ -101,6 +101,48 @@ class TreeConfig:
                                    # code<=cut splits (pre-round-4 behavior,
                                    # kept for RuleFit's threshold-language
                                    # rules and models without categoricals).
+    pipeline: bool = False         # async pipelined level program
+                                   # (H2O_TPU_PIPELINE): route(L-1) fuses
+                                   # into level L's histogram pass (one
+                                   # streamed decode per block instead of
+                                   # two), node-localized routing reads ride
+                                   # integer gathers instead of one-hot
+                                   # matmuls, and the carried margin is
+                                   # donated across chunk dispatches.
+                                   # Bit-equal to the synchronous oracle
+                                   # (pipeline=False) by construction —
+                                   # routing is integer/boolean work and
+                                   # every float accumulation keeps the
+                                   # oracle's per-block math and order.
+    async_psum: bool = False       # overlapped per-level reduction
+                                   # (H2O_TPU_ASYNC_PSUM): each hist
+                                   # group's psum is issued as soon as its
+                                   # local accumulation completes, before
+                                   # the next group's scan is traced, so
+                                   # the ICI collective overlaps the next
+                                   # bucket's compute. Off = the PR 10
+                                   # shape (one joint scan, psums after).
+    fused_score: bool = False      # cadence scoring fused into the train
+                                   # program: the chunk step emits the
+                                   # score0-layout raw predictions as an
+                                   # extra output while the final margin is
+                                   # still resident, instead of the chunk
+                                   # loop rematerializing them from f in a
+                                   # standalone program per scoring
+                                   # interval. Changes the train fn's
+                                   # signature (extra ntrees-done scalar
+                                   # arg + extra output) — see
+                                   # make_train_fn.
+    goss: tuple | None = None      # (a, b) GOSS-style gradient-based row
+                                   # sampling: per shard, the top-a
+                                   # fraction of rows by |gradient| plus a
+                                   # uniform b fraction of the rest (their
+                                   # channels amplified by (1-a)/b) feed
+                                   # the histogram and leaf accumulations;
+                                   # routing and the carried margin still
+                                   # cover every row. Deterministic under
+                                   # the train seed (keys fold from the
+                                   # per-tree row key). None = off.
 
     @property
     def n_nodes(self) -> int:
@@ -189,7 +231,7 @@ def plan_hist_groups(nedges, B_hist: int, block_rows: int,
 # Histogram build (the ScoreBuildHistogram2 analog) — runs inside shard_map.
 # ---------------------------------------------------------------------------
 def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
-                      groups=None):
+                      groups=None, async_psum=False):
     """Accumulate hist (F, n_lv, nbins_tot, V) for nodes [offset, offset+n_lv).
 
     Xb: (Rl, F) int32 bins; node: (Rl,) int32 global node ids; vals: (Rl, V)
@@ -227,20 +269,164 @@ def _build_level_hist(Xb, node, vals, offset, n_lv, nbins_tot, block,
             Xb, lc, v, n_lv=n_lv, nbins_tot=nbins_tot, block=block)
         return jax.lax.psum(hist, ROWS)
 
-    na_global = nbins_tot - 1
     groups = _norm_groups(groups)
-    hists = hist_kernels.level_hist_blocks(
-        Xb, lc, v, n_lv=n_lv, nbins_tot=nbins_tot, block=block,
-        groups=groups)
+    if async_psum:
+        # overlapped reduction (H2O_TPU_ASYNC_PSUM): one scan PER group,
+        # each group's psum issued before the next group's scan is traced —
+        # on a real ICI the collective for bucket g overlaps bucket g+1's
+        # local accumulation. Values are bit-equal to the joint scan (same
+        # per-block contributions, same block order, same per-group psum).
+        hists = [jax.lax.psum(hist_kernels.level_hist_one_group(
+            Xb[:, list(idxs)], lc, v, Bg=Bg, mode=mode, n_lv=n_lv,
+            nbins_tot=nbins_tot, block=block), ROWS)
+            for idxs, Bg, mode in groups]
+    else:
+        hists = [jax.lax.psum(hg, ROWS)
+                 for hg in hist_kernels.level_hist_blocks(
+                     Xb, lc, v, n_lv=n_lv, nbins_tot=nbins_tot, block=block,
+                     groups=groups)]
     # psum per group BEFORE the scatter-back: the wire carries Σ F_g·B_g
     # cells instead of the padded F·B_max the flat path reduces
-    full = jnp.zeros((F, n_lv, nbins_tot, vals.shape[1]), jnp.float32)
+    return _scatter_group_hists(hists, groups, F, n_lv, nbins_tot,
+                                vals.shape[1])
+
+
+def _scatter_group_hists(hists, groups, F, n_lv, nbins_tot, V):
+    """Per-group accumulators back into the global (F, n_lv, B, V) layout,
+    each group's NA slot (its LAST bin) restored to the global NA bucket.
+    The ONE definition both the synchronous and pipelined level programs
+    scatter through — bit-parity between them rides on this block staying
+    single-sourced."""
+    na_global = nbins_tot - 1
+    full = jnp.zeros((F, n_lv, nbins_tot, V), jnp.float32)
     for (idxs, Bg, _mode), hg in zip(groups, hists):
-        hg = jax.lax.psum(hg, ROWS)
         ia = jnp.asarray(idxs)
         full = full.at[ia, :, :Bg - 1, :].set(hg[:, :, :Bg - 1, :])
         full = full.at[ia, :, na_global, :].set(hg[:, :, Bg - 1, :])
     return full
+
+
+# ---------------------------------------------------------------------------
+# Pipelined level program (H2O_TPU_PIPELINE) — fused route→hist streaming.
+# ---------------------------------------------------------------------------
+def _route_rows_gather(xb_blk, node_blk, route_args, cfg: "TreeConfig"):
+    """One block's routing off the previous level's splits, formulated as
+    integer gathers. Routing is integer/boolean work end to end — the
+    row's code at its node's split feature, the cut comparison, the set-
+    split direction-table read — so this produces node ids BIT-identical
+    to the one-hot-matmul `_route` in `_grow_tree` (which exists because
+    per-row gathers are slow on the TPU's serial gather path; the
+    pipelined program accepts them to keep each streamed block's decode
+    single-pass, and the real-TPU tradeoff is a ROADMAP campaign item)."""
+    bf, bb, bnal, do_split, catd_lv, isset, offset, n_lv = route_args
+    local = node_blk - offset
+    active = (local >= 0) & (local < n_lv)
+    lc = jnp.clip(local, 0, n_lv - 1)
+    bf_r = jnp.take(bf, lc)                                       # (rb,)
+    xv = jnp.take_along_axis(xb_blk, bf_r[:, None], axis=1)[:, 0]
+    xv = xv.astype(jnp.int32)
+    row_bb = jnp.take(bb, lc)
+    row_nal = jnp.take(bnal, lc)
+    row_split = jnp.take(do_split, lc) & active
+    num_right = xv > row_bb
+    if catd_lv is not None:
+        # set-split direction read: the node's direction row at the row's
+        # bin — one flat gather instead of the (rb, nbins) bin one-hot
+        flatd = catd_lv.reshape(-1)
+        idx = lc * cfg.nbins + jnp.clip(xv, 0, cfg.nbins - 1)
+        cat_right = jnp.take(flatd, idx) > 0.5
+        row_isset = jnp.take(isset, lc)
+        num_right = jnp.where(row_isset, cat_right, num_right)
+    go_right = jnp.where(xv == cfg.nbins, ~row_nal, num_right)
+    return jnp.where(row_split,
+                     2 * node_blk + 1 + go_right.astype(jnp.int32),
+                     node_blk)
+
+
+def _route_all(Xb, node, route_args, cfg: "TreeConfig"):
+    """Blocked standalone routing pass (gather formulation) — the pipelined
+    path's final route after the last level's splits, and the route half
+    when the fused stream does not apply (GOSS rows, pallas backend)."""
+    Rl, F = Xb.shape
+    rb = _block_rows(Rl, cfg.block_rows)
+    _, node_b = jax.lax.scan(
+        lambda c, blk: (c, _route_rows_gather(blk[0], blk[1], route_args,
+                                              cfg)),
+        None, (Xb.reshape(Rl // rb, rb, F), node.reshape(Rl // rb, rb)))
+    return node_b.reshape(Rl)
+
+
+def _pipelined_level_hist(Xb, node, vals3, route_args, offset, n_lv,
+                          nbins_tot, cfg: "TreeConfig", goss_ctx=None):
+    """One pipelined level: advance ``node`` off the previous level's
+    splits and accumulate this level's histogram, returning ``(hist,
+    node)`` with ``hist`` already psummed and scattered back into the
+    global (F, n_lv, B, V) layout — the drop-in replacement for the
+    synchronous route-then-`_build_level_hist` pair.
+
+    Default shape: ONE streamed pass per level (`kernels.hist.
+    streamed_route_hist`) — each row block is decoded once, routed, and
+    accumulated while the next block streams in. With ``cfg.async_psum``
+    and a grouped plan, the stream carries the routing plus the FIRST
+    width bucket and issues its psum before the remaining buckets' scans
+    are traced (collective overlaps local accumulation); with async off,
+    all buckets ride the single stream and psum after (the PR 10 shape).
+    GOSS rows (``goss_ctx``) and the pallas backend split the pass back
+    into route + hist halves — the histogram then runs over the sampled
+    row set / inside the Mosaic kernel respectively."""
+    from ...backend import kernels
+
+    F = Xb.shape[1]
+    groups = _norm_groups(cfg.hist_groups) if cfg.hist_groups else None
+
+    if goss_ctx is not None or kernels.hist_backend() == "pallas":
+        if route_args is not None:
+            node = _route_all(Xb, node, route_args, cfg)
+        if goss_ctx is not None:
+            Xb_s, take, vals_s = goss_ctx
+            hist = _build_level_hist(Xb_s, jnp.take(node, take), vals_s,
+                                     offset, n_lv, nbins_tot,
+                                     cfg.block_rows, groups=cfg.hist_groups,
+                                     async_psum=cfg.async_psum)
+        else:
+            hist = _build_level_hist(Xb, node, vals3, offset, n_lv,
+                                     nbins_tot, cfg.block_rows,
+                                     groups=cfg.hist_groups,
+                                     async_psum=cfg.async_psum)
+        return hist, node
+
+    route_fn = (None if route_args is None
+                else lambda xb, nd: _route_rows_gather(xb, nd, route_args,
+                                                       cfg))
+    if groups is None:
+        (h,), node = hist_kernels.streamed_route_hist(
+            Xb, node, vals3, route_fn, offset=offset, n_lv=n_lv,
+            nbins_tot=nbins_tot, block=cfg.block_rows)
+        return jax.lax.psum(h, ROWS), node
+
+    if cfg.async_psum:
+        # stream = route + lead bucket; its psum issues while the later
+        # buckets' scans accumulate
+        (h0,), node = hist_kernels.streamed_route_hist(
+            Xb, node, vals3, route_fn, offset=offset, n_lv=n_lv,
+            nbins_tot=nbins_tot, block=cfg.block_rows, groups=groups[:1])
+        hists = [jax.lax.psum(h0, ROWS)]
+        local = node - offset
+        active = (local >= 0) & (local < n_lv)
+        lc = jnp.clip(local, 0, n_lv - 1)
+        v = jnp.where(active[:, None], vals3, 0.0)
+        for idxs, Bg, mode in groups[1:]:
+            hg = hist_kernels.level_hist_one_group(
+                Xb[:, list(idxs)], lc, v, Bg=Bg, mode=mode, n_lv=n_lv,
+                nbins_tot=nbins_tot, block=cfg.block_rows)
+            hists.append(jax.lax.psum(hg, ROWS))
+    else:
+        hs, node = hist_kernels.streamed_route_hist(
+            Xb, node, vals3, route_fn, offset=offset, n_lv=n_lv,
+            nbins_tot=nbins_tot, block=cfg.block_rows, groups=groups)
+        hists = [jax.lax.psum(h, ROWS) for h in hs]
+    return _scatter_group_hists(hists, groups, F, n_lv, nbins_tot,
+                                vals3.shape[1]), node
 
 
 def _leaf_quantile_vals(resid, w, node, n_nodes, q, block, qbins=256):
@@ -480,7 +666,7 @@ def _find_splits(hist, colmask, edge_ok, cfg: TreeConfig, mono=None,
 # ---------------------------------------------------------------------------
 def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
                mono=None, imat=None, resid=None, w_full=None,
-               iscat=None, nedges=None):
+               iscat=None, nedges=None, goss_ctx=None):
     """Returns (feat (N,), thr (N,), nanL (N,), val (N,), gain (N,),
     catd (N, nb|1), node (Rl,)).
 
@@ -520,11 +706,24 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
                  < cfg.col_sample_rate_per_tree)
     tree_cols = jnp.where(jnp.any(tree_cols), tree_cols, True)
 
+    route_args = None   # pipelined: previous level's splits, routed lazily
     for level in range(cfg.max_depth):
         n_lv = 2 ** level
         offset = n_lv - 1
-        hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B,
-                                 cfg.block_rows, groups=cfg.hist_groups)
+        if cfg.pipeline:
+            hist, node = _pipelined_level_hist(Xb, node, vals3, route_args,
+                                               offset, n_lv, B, cfg,
+                                               goss_ctx=goss_ctx)
+        elif goss_ctx is not None:
+            Xb_s, take, vals_s = goss_ctx
+            hist = _build_level_hist(Xb_s, jnp.take(node, take), vals_s,
+                                     offset, n_lv, B, cfg.block_rows,
+                                     groups=cfg.hist_groups,
+                                     async_psum=cfg.async_psum)
+        else:
+            hist = _build_level_hist(Xb, node, vals3, offset, n_lv, B,
+                                     cfg.block_rows, groups=cfg.hist_groups,
+                                     async_psum=cfg.async_psum)
 
         cmask = _level_col_mask(jax.random.fold_in(colkey, level), F, n_lv,
                                 cfg, tree_cols, level)
@@ -572,6 +771,16 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
             garr, jnp.where(do_split, gain, 0.0).astype(jnp.float32), (offset,))
         if use_sets:
             catd = jax.lax.dynamic_update_slice(catd, catd_lv, (offset, 0))
+
+        if cfg.pipeline:
+            # defer this level's routing into the NEXT level's streamed
+            # pass (or the final route below) — the split params are all
+            # the route needs, and carrying them keeps each row block's
+            # decode single-pass
+            route_args = (bf, bb.astype(jnp.int32), bnal, do_split,
+                          catd_lv if use_sets else None, isset, offset,
+                          n_lv)
+            continue
 
         # Route rows: only rows at split nodes of this level descend.
         # Per-row dynamic gathers (bf[lc], Xb[r, bf]) are catastrophically
@@ -629,9 +838,22 @@ def _grow_tree(Xb, g, h, w, edges, edge_ok, colkey, cfg: TreeConfig,
         else:
             node = _route(Xb, node)
 
+    if cfg.pipeline and route_args is not None:
+        # the last level's routing was deferred — apply it so leaf/stop
+        # totals see the final node assignment
+        node = _route_all(Xb, node, route_args, cfg)
+
     # Leaf/stop-node values from one final per-node accumulation (covers both
     # max-depth leaves and early-stopped internal nodes).
-    tot = _node_totals(node, vals3, N, cfg.block_rows)
+    if goss_ctx is not None:
+        # GOSS leaf stats come from the sampled rows with the standard
+        # amplification weights (LightGBM's estimator) — the same channel
+        # sums the split search consumed
+        _Xb_s, take_g, vals_s = goss_ctx
+        tot = _node_totals(jnp.take(node, take_g), vals_s, N,
+                           cfg.block_rows)
+    else:
+        tot = _node_totals(node, vals3, N, cfg.block_rows)
     scale = 1.0 if cfg.drf_mode else cfg.learn_rate
     if cfg.huber_leaf_alpha is not None and resid is not None:
         # huber hybrid gamma (`GBM.java:685`): per-leaf median, then the
@@ -696,7 +918,8 @@ _TRAIN_FN_CACHE: dict = {}
 
 
 def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
-                  cache_key=None):
+                  cache_key=None, score_fn=None, score_spec=None,
+                  donate=False):
     """Build the jitted multi-tree trainer.
 
     grad_fn(y, f, w) -> (g, h) with f the running link-scale prediction carried
@@ -715,6 +938,21 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     out-of-bag tree outputs for DRF's OOB scoring (zeros when
     sample_rate == 1). ``iscat``/``nedges`` are (F,) bool/int32 arrays (only
     read under cfg.use_sets — pass zeros otherwise).
+
+    With ``cfg.fused_score`` the signature grows a trailing traced scalar
+    ``ntd`` (trees done after this chunk) and the outputs a trailing
+    ``mraw`` — the score0-layout raw predictions ``score_fn(f, ntd)``
+    computed INSIDE the program while the final margin is still resident,
+    so the chunk loop's cadence scoring never rematerializes an (R,)
+    margin in a standalone program (``score_spec`` is mraw's
+    PartitionSpec). ``donate=True`` donates the carried margin argument's
+    buffer to the output (double-buffer chunk dispatch; the caller must
+    not read the donated input again). graftlint rule `use-after-donate`
+    pins that discipline for direct positional dispatches of a trainer
+    bound from `make_train_fn(..., donate=True)` or a literal donating
+    `jax.jit`; the chunk loop's own ``*step_args`` dispatch is outside
+    any positional lint's reach — tests/test_pipeline.py's cadence +
+    donation pins cover it at runtime.
     """
     mesh = mesh or default_mesh()
     # the kernels backend is resolved at TRACE time (kernels.hist_backend
@@ -722,14 +960,17 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     # under one backend must never serve a process that flipped the knob
     full_key = None
     if cache_key is not None:
-        full_key = (cfg, cache_key, id(mesh), kernels.hist_backend())
+        full_key = (cfg, cache_key, id(mesh), kernels.hist_backend(),
+                    donate)
         hit = _TRAIN_FN_CACHE.get(full_key)
         if hit is not None:
             return hit
     K = cfg.nclass
 
+    fused = cfg.fused_score and score_fn is not None
+
     def spmd(Xb, y, w, f, edges, edge_ok, keys, rates, mono, imat, iscat,
-             nedges):
+             nedges, *ntd):
         mono_arg = mono if cfg.use_monotone else None
         imat_arg = imat if cfg.use_interaction else None
         iscat_arg = iscat if cfg.use_sets else None
@@ -757,6 +998,11 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
             # leaf-value broadcast rides the MXU too (vl[node] is a per-row
             # dynamic gather otherwise — see the routing comment in _grow_tree)
             def leaf_delta(vlk, nodek):
+                if cfg.pipeline:
+                    # the pipelined program accepts the gather (exact: a
+                    # gather IS the element) — same real-TPU tradeoff note
+                    # as _route_rows_gather
+                    return jnp.take(vlk, nodek)
                 # leaf values are real f32 — hi/lo split keeps the carried
                 # residuals f32-grade without Precision.HIGHEST's fusion cost
                 oh = jax.nn.one_hot(nodek, cfg.n_nodes, dtype=jnp.float32)
@@ -766,10 +1012,38 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
                 resid = ((y - f) if (cfg.leaf_quantile is not None or
                                      cfg.huber_leaf_alpha is not None)
                          else None)
+                goss_ctx = None
+                if cfg.goss is not None:
+                    # GOSS-style sampling (`PAPERS.md: XGBoost gpu_hist` /
+                    # LightGBM GOSS): per shard, keep the top-a rows by
+                    # |gradient| plus a uniform b of the rest, the latter
+                    # amplified by (1-a)/b; histogram and leaf passes then
+                    # touch ~(a+b)·R rows while routing/margins stay full.
+                    # Static shapes: the sample size is padded to a 256
+                    # multiple, pad slots carry zero weight.
+                    a_frac, b_frac = cfg.goss
+                    Rl = w.shape[-1]
+                    na = int(round(a_frac * Rl))
+                    n_sel = max(min(na + int(round(b_frac * Rl)), Rl), 1)
+                    n_pad = min(-(-n_sel // 256) * 256, Rl)
+                    gk = jax.random.fold_in(rowkey, 101)
+                    ag = jnp.abs(g * s)
+                    rank = jnp.argsort(jnp.argsort(-ag, stable=True),
+                                       stable=True)
+                    topmask = rank < na
+                    prio = jnp.where(topmask, -1.0,
+                                     jax.random.uniform(gk, (Rl,)))
+                    take = jnp.argsort(prio, stable=True)[:n_pad]
+                    amp = jnp.where(jnp.take(topmask, take), 1.0,
+                                    (1.0 - a_frac) / b_frac)
+                    amp = amp * (jnp.arange(n_pad) < n_sel)
+                    vals_s = (jnp.take(jnp.stack([w * s, g * s, h * s], 1),
+                                       take, axis=0) * amp[:, None])
+                    goss_ctx = (jnp.take(Xb, take, axis=0), take, vals_s)
                 ft, th, nl, vl, ga, cd, node = _grow_tree(
                     Xb, g * s, h * s, w * s, edges, edge_ok, key, cfg,
                     mono_arg, imat_arg, resid, w_full=w,
-                    iscat=iscat_arg, nedges=nedges_arg)
+                    iscat=iscat_arg, nedges=nedges_arg, goss_ctx=goss_ctx)
                 vl = scale_leaves(vl)
                 delta = leaf_delta(vl, node)
             else:
@@ -793,17 +1067,34 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
 
         init = (f, jnp.zeros_like(f), jnp.zeros(w.shape[-1:], jnp.float32))
         (f, osum, ocnt), trees = jax.lax.scan(tree_step, init, (keys, rates))
+        if fused:
+            # cadence scoring folded into the chunk step: the score0-layout
+            # raw predictions come out while the final margin is still
+            # resident — the chunk loop never redispatches a standalone
+            # margin→score0 program per scoring interval
+            return f, osum, ocnt, trees, score_fn(f, ntd[0])
         return f, osum, ocnt, trees
 
     fspec = P(ROWS) if K == 1 else P(None, ROWS)
+    in_specs = (P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P(),
+                P(), P(), P(), P())
+    out_specs = (fspec, fspec, P(ROWS), (P(), P(), P(), P(), P(), P()))
+    if fused:
+        in_specs = in_specs + (P(),)
+        out_specs = out_specs + (score_spec if score_spec is not None
+                                 else P(ROWS),)
     fn = shard_map(
         spmd, mesh=mesh,
-        in_specs=(P(ROWS, None), fspec, P(ROWS), fspec, P(), P(), P(), P(),
-                  P(), P(), P(), P()),
-        out_specs=(fspec, fspec, P(ROWS), (P(), P(), P(), P(), P(), P())),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
-    jitted = jax.jit(fn)
+    # double-buffered chunk dispatch: the carried margin's input buffer is
+    # donated to the output, so back-to-back chunk dispatches reuse it
+    # instead of allocating a fresh (R,) carry per chunk. The caller owns
+    # the use-after-donate discipline (rule 18 lints what it can see;
+    # tests pin the chunk loop — see the docstring).
+    jitted = jax.jit(fn, donate_argnums=(3,)) if donate else jax.jit(fn)
     if full_key is not None:
         _TRAIN_FN_CACHE[full_key] = jitted
     return jitted
@@ -891,6 +1182,84 @@ def sample_tree_phases(Xb, vals3, edge_ok, cfg: TreeConfig,
             tot = jnp.einsum("rn,rv->nv", n_oh, vals3[:rb])
             jax.block_until_ready(tot)
     return sp.phases
+
+
+def sample_pipeline_phases(Xb, vals3, cfg: TreeConfig, mesh=None):
+    """Measure one representative pipelined-level stage sequence — h2d /
+    local-accum / psum-wait / split — and how much of the H2D + collective
+    wall the pipeline actually hides.
+
+    Like `sample_tree_phases`, the production loop is one fused program, so
+    this replays level 0's stages as standalone dispatches inside a
+    ``train.gbm.pipeline`` span: ``h2d`` stages one column block onto the
+    mesh (the double-buffer's stream-in), ``local-accum`` drains the
+    shard-local histogram, ``psum-wait`` drains a psum of the same payload
+    across the ``rows`` axis, ``split`` drains `_find_splits`. A second,
+    UNdrained replay then dispatches h2d→accum→psum back to back and the
+    difference — sequential wall minus pipelined wall — over the h2d+psum
+    wall is recorded as the ``gbm.pipeline.overlap_ratio`` gauge (clipped
+    to [0, 1]; ~0 on a single-shard CPU mesh where both hidden stages are
+    already negligible, which is itself the honest record). One sample per
+    process (gbm.py gates); the bench sidecar picks the gauge out of the
+    telemetry delta."""
+    import time as _time
+
+    from ...parallel.mesh import put_row_sharded
+    from ...utils import telemetry
+
+    mesh = mesh or default_mesh()
+    Rl, F = Xb.shape
+    B = cfg.nbins + 1
+    groups = _norm_groups(cfg.hist_groups) if cfg.hist_groups else None
+    idxs = list(groups[0][0]) if groups else list(range(F))
+    Bg = groups[0][1] if groups else B
+    mode = groups[0][2] if groups else "onehot"
+    host_blk = np.asarray(Xb[:, idxs])      # the host-side coded block
+    node = jnp.zeros((Rl,), jnp.int32)
+
+    def _accum(xg, lc, vv):
+        return hist_kernels.level_hist_one_group(
+            xg, lc, vv, Bg=Bg, mode=mode, n_lv=1, nbins_tot=Bg,
+            block=cfg.block_rows)
+
+    accum = jax.jit(shard_map(
+        _accum, mesh=mesh, in_specs=(P(ROWS, None), P(ROWS), P(ROWS, None)),
+        out_specs=P(), check_vma=False))
+    psum_fn = jax.jit(shard_map(
+        lambda h: jax.lax.psum(h, ROWS), mesh=mesh, in_specs=P(),
+        out_specs=P(), check_vma=False))
+
+    with telemetry.span("train.gbm.pipeline",
+                        groups=0 if groups is None else len(groups)) as sp:
+        with sp.phase("h2d"):
+            staged = put_row_sharded(host_blk, mesh)
+            jax.block_until_ready(staged)
+        with sp.phase("local-accum"):
+            hloc = accum(staged, node, vals3)
+            jax.block_until_ready(hloc)
+        with sp.phase("psum-wait"):
+            hred = psum_fn(hloc)
+            jax.block_until_ready(hred)
+        with sp.phase("split"):
+            colmask = jnp.ones((F, 1), dtype=jnp.bool_)
+            hist = jnp.zeros((F, 1, B, 3), jnp.float32)
+            out = _find_splits(hist, colmask,
+                               jnp.ones((F, cfg.nbins - 1), jnp.bool_), cfg)
+            jax.block_until_ready([o for o in out if o is not None])
+        # pipelined replay: dispatch-ahead, one drain at the end — what the
+        # sequential walls above paid in h2d+psum, minus what this still
+        # pays, is the hidden fraction
+        t0 = _time.perf_counter()
+        staged2 = put_row_sharded(host_blk, mesh)
+        hred2 = psum_fn(accum(staged2, node, vals3))
+        jax.block_until_ready(hred2)
+        piped = _time.perf_counter() - t0
+        seq = sp.phases["h2d"] + sp.phases["local-accum"] + sp.phases["psum-wait"]
+        hidden_wall = max(sp.phases["h2d"] + sp.phases["psum-wait"], 1e-9)
+        ratio = min(max((seq - piped) / hidden_wall, 0.0), 1.0)
+        sp.attrs["overlap_ratio"] = round(ratio, 4)
+    telemetry.set_gauge("gbm.pipeline.overlap_ratio", ratio)
+    return ratio
 
 
 # ---------------------------------------------------------------------------

@@ -105,6 +105,23 @@ _knob("H2O_TPU_HIST_SEG_WIDTH", "int", 8,
       "one-hot matmul in the histogram scan (0 disables the path); also "
       "bounds the widest VMEM accumulator slab a narrow group hands the "
       "pallas hist kernel (backend/kernels/hist.py)")
+_knob("H2O_TPU_PIPELINE", "bool", True,
+      "async pipelined GBM/DRF level program: route->hist fused into one "
+      "streamed pass per row block, gather-formulated routing, cadence "
+      "scoring fused into the chunk step, donated margin carry. "
+      "BIT-equal to the synchronous oracle; 0 reverts to the two-pass "
+      "level program (models/tree/engine.py)")
+_knob("H2O_TPU_ASYNC_PSUM", "bool", True,
+      "overlapped per-level histogram reduction: each width bucket's ICI "
+      "psum is issued before the next bucket's local scan so the "
+      "collective hides under compute; 0 reverts to the PR 10 shape "
+      "(one joint scan, psums after). Bit-equal either way")
+_knob("H2O_TPU_GOSS", "str", "",
+      "GOSS-style gradient-based row sampling for GBM, 'a,b' fractions "
+      "(e.g. 0.2,0.1): per shard the top-a rows by |gradient| plus a "
+      "uniform b of the rest (amplified by (1-a)/b) feed the histogram "
+      "and leaf passes. Deterministic under the train seed; changes the "
+      "forest (a sampler, not an oracle-parity mode); empty = off")
 _knob("H2O_TPU_HIST_KERNEL", "str", "auto",
       "kernels-layer backend for the level-histogram and Gram "
       "accumulations (backend/kernels/): 'pallas' = fused pl.pallas_call "

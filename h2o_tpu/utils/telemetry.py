@@ -160,6 +160,12 @@ _histogram("train.hist.kernel",
            "(backend/kernels/hist.py), observed once per GBM/DRF training "
            "job from the in-boundary phase sample; the backend "
            "(pallas/xla) rides the train.gbm.phases span/timeline detail")
+_gauge("gbm.pipeline.overlap_ratio",
+       "fraction of the h2d + collective wall the pipelined GBM level "
+       "program hides under local accumulation, from the once-per-process "
+       "train.gbm.pipeline stage sample (engine.sample_pipeline_phases); "
+       "~0 on a single-shard CPU mesh where both hidden stages are "
+       "already negligible")
 _histogram("train.compile.seconds",
            "drained wall of the AOT lower+compile of the tree train step "
            "at build setup (near-zero when the persistent compile cache "

@@ -134,6 +134,11 @@ def pytest_configure(config):
                    "single parity, sharded merge vs the replicated "
                    "oracle, shard-aware checkpoints, per-device ledger "
                    "(pytest -m sharded, tests/test_sharded_frames.py)")
+    config.addinivalue_line(
+        "markers", "pipeline: async pipelined GBM training — pipelined-"
+                   "vs-synchronous bit parity across the knob matrix, "
+                   "GOSS sampling, donated-margin chunk dispatch "
+                   "(pytest -m pipeline, tests/test_pipeline.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -235,6 +235,26 @@ from h2o_tpu.parallel.mesh import put_replicated
 arr = put_replicated([1.0])
 """,
     ),
+    "use-after-donate": (
+        """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(1,))
+
+def run(x, f):
+    out = step(x, f)
+    return out + f
+""",
+        """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(1,))
+
+def run(x, f):
+    f = step(x, f)
+    return f + 1.0
+""",
+    ),
 }
 
 
@@ -263,6 +283,37 @@ def test_rule_suppressed_inline(rule_id):
     for ln in flagged:
         lines[ln - 1] += f"  # graftlint: disable={rule_id}"
     assert rule_id not in _rules_hit("\n".join(lines))
+
+
+def test_use_after_donate_factory_and_ifexp_forms():
+    """Rule 18 also tracks donating FACTORIES (make_train_fn(...,
+    donate=True) donates the returned trainer's argument 3) and
+    IfExp-wrapped jit bindings (review catch: the literal-jax.jit-only
+    form missed the exact donation sites PR 12 introduces)."""
+    factory = """
+from h2o_tpu.models.tree.engine import make_train_fn
+
+def run(cfg, grad, Xb, y, w, f, rest):
+    step = make_train_fn(cfg, grad, donate=True)
+    out = step(Xb, y, w, f, rest)
+    return out, f
+"""
+    assert "use-after-donate" in _rules_hit(factory)
+    rebound = factory.replace("out = step(Xb, y, w, f, rest)\n    return out, f",
+                              "f = step(Xb, y, w, f, rest)\n    return f")
+    assert "use-after-donate" not in _rules_hit(rebound)
+    ifexp = """
+import jax
+
+def build(fn, donate):
+    return jax.jit(fn, donate_argnums=(0,)) if donate else jax.jit(fn)
+
+def run(fn, x):
+    step = jax.jit(fn, donate_argnums=(0,)) if True else jax.jit(fn)
+    y = step(x)
+    return y + x
+"""
+    assert "use-after-donate" in _rules_hit(ifexp)
 
 
 def test_swallowed_retryable_catches_tuple_and_dotted_forms():
@@ -633,9 +684,9 @@ def test_every_rule_registered_exactly_once():
     from tools.graftlint import PROJECT_RULES
 
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 13  # per-file rules
+    assert len(ids) == len(set(ids)) == 14  # per-file rules
     both = ids + [cls.id for cls in PROJECT_RULES]
-    assert len(both) == len(set(both)) == 17  # + interprocedural (v2)
+    assert len(both) == len(set(both)) == 18  # + interprocedural (v2)
 
 
 def test_direct_device_put_forms():

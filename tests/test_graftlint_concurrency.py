@@ -622,9 +622,9 @@ def test_ci_gate_script_exists_and_is_executable():
     assert "pytest" in text
 
 
-def test_rule_catalog_is_seventeen():
+def test_rule_catalog_is_eighteen():
     ids = [cls.id for cls in ALL_RULES] + [cls.id for cls in PROJECT_RULES]
-    assert len(ids) == len(set(ids)) == 17
+    assert len(ids) == len(set(ids)) == 18
     assert {"unguarded-shared-field", "lock-order-cycle",
             "blocking-under-lock", "unjoined-thread"} <= set(ids)
 

@@ -1,17 +1,32 @@
 #!/usr/bin/env bash
-# CI gate — graftlint (17 rules, baseline-gated) + the tier-1 pytest line,
+# CI gate — graftlint (18 rules, baseline-gated) + the tier-1 pytest line,
 # as ONE exit-coded command. Either failing fails the gate; both always
 # run so a single CI pass reports lint findings AND test failures.
 #
 # Usage:
 #   tools/ci_gate.sh                 # text findings
+#   tools/ci_gate.sh --bench-smoke   # + the 50k-row pipelined GBM bench leg
 #   GRAFTLINT_FORMAT=github tools/ci_gate.sh   # ::error annotations
 #   GRAFTLINT_JOBS=4 tools/ci_gate.sh          # parallel lint scan
+#
+# --bench-smoke runs the airlines bench leg (the pipelined-training
+# scoreboard) at 50k rows with H2O_TPU_PIPELINE on and asserts rc=0,
+# forest_parity=true (pipelined forest + predictions bit-equal to the
+# synchronous oracle) and 0 steady-state uncached compiles on the warm
+# train. The >=1.25x speedup stays a recorded number, not a gate — CI
+# machines' walls are noisy; parity and compile hygiene are not.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 fmt="${GRAFTLINT_FORMAT:-text}"
 jobs="${GRAFTLINT_JOBS:-2}"
+bench_smoke=0
+for arg in "$@"; do
+    case "$arg" in
+        --bench-smoke) bench_smoke=1 ;;
+        *) echo "ci_gate.sh: unknown argument '$arg'" >&2; exit 2 ;;
+    esac
+done
 
 echo "== graftlint =="
 python -m tools.graftlint --format "$fmt" --jobs "$jobs"
@@ -23,8 +38,44 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 test_rc=$?
 
-echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc} =="
-if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ]; then
+bench_rc=0
+if [ "$bench_smoke" -eq 1 ]; then
+    echo "== bench smoke (pipelined 50k-row GBM) =="
+    sidecar="$(mktemp /tmp/h2o_tpu_bench_smoke.XXXXXX.jsonl)"
+    timeout -k 10 900 env JAX_PLATFORMS=cpu \
+        H2O_TPU_BENCH_WORKLOADS=airlines \
+        H2O_TPU_BENCH_AIRLINES_ROWS=50000 \
+        H2O_TPU_PIPELINE=1 \
+        H2O_TPU_BENCH_SIDECAR="$sidecar" \
+        python bench.py > /dev/null
+    bench_rc=$?
+    if [ "$bench_rc" -eq 0 ]; then
+        python - "$sidecar" <<'EOF'
+import json, sys
+
+rec = None
+for line in open(sys.argv[1]):
+    d = json.loads(line)
+    if d.get("workload") == "airlines116m":
+        rec = d["record"]
+assert rec is not None, "airlines leg record missing from sidecar"
+assert rec["forest_parity"] is True, \
+    f"pipelined forest NOT bit-equal to the synchronous oracle: {rec}"
+assert rec["uncached_compiles_warm"] == 0, \
+    f"steady-state uncached compiles: {rec['uncached_compiles_warm']}"
+print(json.dumps({"bench_smoke": "ok",
+                  "wall_s": rec["wall_s"],
+                  "wall_sync_s": rec["wall_sync_s"],
+                  "pipeline_speedup_x": rec["pipeline_speedup_x"],
+                  "overlap_ratio": rec["overlap_ratio"]}))
+EOF
+        bench_rc=$?
+    fi
+    rm -f "$sidecar"
+fi
+
+echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc} =="
+if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ]; then
     exit 1
 fi
 exit 0

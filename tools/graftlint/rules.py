@@ -49,6 +49,14 @@ CHANGES.md entries):
    and collective layouts; a stray `device_put(x, NamedSharding(...))` in
    a builder silently re-lays frame data outside the one reviewable
    policy (the GSPMD merge mis-partition hid exactly there).
+18. use-after-donate     — PR 12 (async pipelined training): a variable
+   passed through a `donate_argnums` position of a jitted callable hands
+   its buffer to the runtime — reading it afterwards dies at dispatch
+   time with "array has been deleted" (or silently copies on backends
+   without donation). The pipelined GBM chunk loop donates the carried
+   margin across dispatches; this rule pins the rebind-or-copy
+   discipline everywhere the pattern spreads. (Rules 14-17 are the
+   interprocedural concurrency pass in `concurrency.py`.)
 """
 
 from __future__ import annotations
@@ -861,7 +869,122 @@ class UnregisteredMetric(Rule):
         return out
 
 
+class UseAfterDonate(Rule):
+    id = "use-after-donate"
+    doc = ("variable read after being passed through a donate_argnums "
+           "position of the same jitted callable — the donated buffer is "
+           "gone at dispatch; rebind the result or copy first")
+
+    @staticmethod
+    def _donated_positions(call: ast.Call):
+        """frozenset of donated positions from a jax.jit call's
+        donate_argnums (int or tuple/list of int literals), else None."""
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset([v.value])
+            if isinstance(v, (ast.Tuple, ast.List)):
+                vals = frozenset(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int))
+                if vals:
+                    return vals
+        return None
+
+    #: factory callables known to return a donating trainer: callee name
+    #: -> donated positions of the RETURNED callable when the factory is
+    #: called with donate=True (engine.make_train_fn donates the carried
+    #: margin, argument 3). The chunk loop's own `*step_args` dispatch is
+    #: invisible to any positional analysis — that discipline is pinned by
+    #: tests (tests/test_pipeline.py cadence/donation pins), not here.
+    _DONATING_FACTORIES = {"make_train_fn": frozenset([3])}
+
+    def _binding_positions(self, value: ast.expr, ctx) -> frozenset | None:
+        """Donated positions for a callable bound from ``value``: a
+        literal `jax.jit(..., donate_argnums=...)` call, a known donating
+        factory called with donate=True, or an IfExp with either arm one
+        of those (conservative: donation assumed when any arm donates)."""
+        if isinstance(value, ast.IfExp):
+            return (self._binding_positions(value.body, ctx)
+                    or self._binding_positions(value.orelse, ctx))
+        if not isinstance(value, ast.Call):
+            return None
+        fn = _norm_func(value, ctx)
+        if fn and fn.endswith("jax.jit"):
+            return self._donated_positions(value)
+        tail = (fn or "").rsplit(".", 1)[-1]
+        if tail in self._DONATING_FACTORIES:
+            for kw in value.keywords:
+                if (kw.arg == "donate"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    return self._DONATING_FACTORIES[tail]
+        return None
+
+    def check(self, tree, ctx):
+        # pass 1, file-wide: bindings of donating callables — literal
+        # `name = jax.jit(..., donate_argnums=...)`, donating factories,
+        # and IfExp-wrapped variants
+        donating: dict[str, frozenset] = {}
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                pos = self._binding_positions(node.value, ctx)
+                if pos:
+                    donating[node.targets[0].id] = pos
+        if not donating:
+            return []
+        out = []
+        msg = ("read of {name!r} after it was donated to {fn!r} "
+               "(donate_argnums) — the buffer is deleted at dispatch; "
+               "rebind the call's result or copy before dispatching")
+        for scope in function_scopes(tree):
+            # line-ordered event stream: loads check against the donated
+            # set, call-site donations mark at the call's END line (args
+            # may span lines), stores/dels clear at their statement's END
+            # line (RHS evaluates before targets bind — `f, o = fn(x, f)`
+            # donates the old f and rebinds, which is the clean idiom)
+            events = []   # (line, phase, name, node, fn)
+            for node in scope_statements(scope):
+                if isinstance(node, ast.stmt):
+                    end = node.end_lineno or node.lineno
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Name)
+                                and isinstance(sub.ctx, (ast.Store,
+                                                         ast.Del))):
+                            events.append((end, 2, sub.id, None, None))
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in donating):
+                    end = node.end_lineno or node.lineno
+                    for p in donating[node.func.id]:
+                        if (p < len(node.args)
+                                and isinstance(node.args[p], ast.Name)):
+                            events.append((end, 1, node.args[p].id, None,
+                                           node.func.id))
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)):
+                    events.append((node.lineno, 0, node.id, node, None))
+            donated: dict[str, str] = {}   # name -> donating fn
+            for _line, phase, name, node, fn in sorted(
+                    events, key=lambda e: (e[0], e[1])):
+                if phase == 0 and name in donated:
+                    out.append(self.violation(
+                        ctx, node, msg.format(name=name,
+                                              fn=donated[name])))
+                    del donated[name]   # one report per donation
+                elif phase == 1:
+                    donated[name] = fn
+                elif phase == 2:
+                    donated.pop(name, None)
+        return out
+
+
 ALL_RULES = (DirectShardMap, DirectPallasCall, DirectDevicePut, PSpecConcat,
              NarrowIntAccumulate, UntrackedResident, TimingWithoutSync,
              HostSyncInTrace, NondeterminismInTrace, UnregisteredKnob,
-             UnregisteredFailpoint, SwallowedRetryable, UnregisteredMetric)
+             UnregisteredFailpoint, SwallowedRetryable, UnregisteredMetric,
+             UseAfterDonate)
