@@ -1104,7 +1104,15 @@ def _sidecar_path() -> str:
         os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.jsonl")
 
 
+#: sidecar record-format version — bumped when line shape changes so
+#: tools/bench_gate.py and future re-anchors parse ONE documented format
+#: (schema doc: README "Benchmarks" — v2 = v1 + schema_version stamps +
+#: the per-leg record["programs"] program-cost delta block)
+SIDECAR_SCHEMA_VERSION = 2
+
+
 def _sidecar_start(header: dict) -> None:
+    header = dict(header, schema_version=SIDECAR_SCHEMA_VERSION)
     with open(_sidecar_path(), "a") as f:
         f.write(json.dumps({"bench_run": header}) + "\n")
         f.flush()
@@ -1114,7 +1122,9 @@ def _sidecar_start(header: dict) -> None:
 def _emit_workload(workloads: dict, name: str, rec: dict) -> None:
     workloads[name] = rec
     with open(_sidecar_path(), "a") as f:
-        f.write(json.dumps({"workload": name, "record": rec}) + "\n")
+        f.write(json.dumps({"workload": name,
+                            "schema_version": SIDECAR_SCHEMA_VERSION,
+                            "record": rec}) + "\n")
         f.flush()
         os.fsync(f.fileno())
 
@@ -1123,12 +1133,18 @@ def _leg(workloads: dict, name: str, fn) -> dict:
     """Run one workload with a telemetry snapshot taken around it and embed
     the registry DELTA in the fsync'd sidecar record — every leg's numbers
     now carry compile counts, MRTask dispatch/payload totals, spill bytes
-    and the HBM watermark next to its wall times (utils/telemetry.py)."""
-    from h2o_tpu.utils import telemetry
+    and the HBM watermark next to its wall times (utils/telemetry.py) —
+    plus the PROGRAM-COST delta: every executable the leg compiled lands
+    with its XLA flops/bytes/memory figures (utils/programs.py), so a
+    re-anchor records what each leg's programs cost, not just how long
+    they ran."""
+    from h2o_tpu.utils import programs, telemetry
 
     before = telemetry.snapshot()
+    before_programs = programs.ids()
     rec = dict(fn())
     rec["telemetry"] = telemetry.snapshot_delta(before)
+    rec["programs"] = programs.snapshot_delta(before_programs)
     _emit_workload(workloads, name, rec)
     return rec
 

@@ -918,6 +918,45 @@ def serving_control() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# fleet observability plane (PR 13 — programs / fleet metrics / profiler
+# capture / flight recorder)
+# ---------------------------------------------------------------------------
+def programs() -> dict:
+    """`GET /3/Programs` → {program_id: record}: per compiled program,
+    XLA cost-model flops / bytes accessed, memory assignment, measured
+    dispatch walls and the roofline fraction (null off-TPU)."""
+    return connection().request("GET", "/3/Programs")["programs"]
+
+
+def fleet_metrics(force: bool = False) -> dict:
+    """`GET /3/Metrics?fleet=1` — the merged multi-process view: counters
+    summed, gauges max'd, histogram quantiles count-weight-merged, every
+    value also labeled per process. ``force`` bypasses the
+    H2O_TPU_FLEET_INTERVAL_MS scrape cache."""
+    path = "/3/Metrics?fleet=1" + ("&force=1" if force else "")
+    return connection().request("GET", path)["fleet"]
+
+
+def profiler_capture(ms: int = 1000) -> str:
+    """`POST /3/Profiler/capture?ms=N` — bounded live jax.profiler device
+    capture on the server process; returns the capture directory (load it
+    in Perfetto / tensorboard-profile)."""
+    return connection().request(
+        "POST", f"/3/Profiler/capture?ms={int(ms)}")["dir"]
+
+
+def flight_bundles() -> dict:
+    """`GET /3/Flight` — flight-recorder state: armed?, dir, bundles."""
+    return connection().request("GET", "/3/Flight")
+
+
+def flight_bundle(name: str) -> dict:
+    """`GET /3/Flight/{name}` — one diagnostics bundle's full content."""
+    return connection().request(
+        "GET", f"/3/Flight/{urllib.parse.quote(name)}")["bundle"]
+
+
+# ---------------------------------------------------------------------------
 # H2OFrame handle (`h2o-py/h2o/frame.py` + the lazy `h2o-py/h2o/expr.py`
 # ExprNode DAG: frame-producing ops build a pending rapids expression and
 # only materialize — one `(tmp= name expr)` POST — when the frame's identity

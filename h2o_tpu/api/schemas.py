@@ -333,3 +333,31 @@ def model_schema(model) -> dict:
         if disp is not None:
             out["output"]["dispersion"] = _clean(disp)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fleet observability plane (PR 13)
+# ---------------------------------------------------------------------------
+def program_schema(pid: str, rec: dict) -> dict:
+    """One `/3/Programs` entry, JSON-cleaned: the wire shape tools parse
+    (the bench sidecar's program block uses the compact subset of these
+    field names, so a consumer learns ONE schema)."""
+    return {"program_id": pid, "kind": rec.get("kind"),
+            "name": rec.get("name"), "labels": _clean(rec.get("labels")),
+            "flops": _clean(rec.get("flops")),
+            "bytes_accessed": _clean(rec.get("bytes_accessed")),
+            "memory": _clean(rec.get("memory")),
+            "dispatch_count": rec.get("dispatch_count"),
+            "wall": _clean(rec.get("wall")),
+            "achieved_flops_per_s": _clean(rec.get(
+                "achieved_flops_per_s")),
+            "roofline_fraction": _clean(rec.get("roofline_fraction")),
+            "registered_ms": rec.get("registered_ms")}
+
+
+def programs_schema(snapshot: dict, peak_flops) -> dict:
+    """The full `GET /3/Programs` payload."""
+    return {"programs": {pid: program_schema(pid, rec)
+                         for pid, rec in snapshot.items()},
+            "count": len(snapshot),
+            "peak_flops_per_s": _clean(peak_flops)}

@@ -2331,15 +2331,59 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                      "capacity": tl.capacity()}
     if head == "Metrics":
         # the unified telemetry registry — JSON by default, Prometheus
-        # text exposition via ?format=prometheus (scrape-ready)
+        # text exposition via ?format=prometheus (scrape-ready), and the
+        # MERGED multi-process view via ?fleet=1 (utils/fleetobs.py
+        # scrapes H2O_TPU_FLEET_PEERS + the spool dir and merges with
+        # per-process labels — the observability substrate the
+        # multi-process serving tier and multi-HOST ingest assume)
         from ..utils import telemetry
 
+        if _truthy(p.get("fleet")):
+            from ..utils import fleetobs
+
+            return 200, {"fleet": fleetobs.collect(
+                force=_truthy(p.get("force")))}
         if (p.get("format") or "").lower() in ("prometheus", "text"):
             return 200, {"__raw__": telemetry.prometheus(),
                          "__ctype__": "text/plain; version=0.0.4"}
         return 200, {"metrics": telemetry.snapshot(),
                      "trace_path": telemetry.trace_path(),
+                     "pid": os.getpid(), "name": server.name,
                      "ts_ms": int(time.time() * 1000)}
+    if head == "Programs":
+        # the program cost registry (utils/programs.py): per compiled
+        # program, XLA cost_analysis flops/bytes + memory_analysis
+        # figures, measured dispatch walls, achieved FLOP/s and the
+        # roofline fraction (null off-TPU — see README caveats)
+        from ..utils import programs as _programs
+        from .schemas import programs_schema
+
+        payload = programs_schema(_programs.snapshot(),
+                                  _programs.device_peak_flops())
+        payload["ts_ms"] = int(time.time() * 1000)
+        return 200, payload
+    if head == "Flight":
+        # flight-recorder bundles (utils/flightrec.py): listing, or one
+        # bundle's full content by name
+        from ..utils import flightrec as _flight
+
+        if rest[1:]:
+            return 200, {"bundle": _flight.read_bundle(rest[1])}
+        return 200, {"dir": _flight.flight_dir(),
+                     "armed": _flight.enabled(),
+                     "bundles": _flight.list_bundles()}
+    if head == "Profiler" and rest[1:] and rest[1] == "capture":
+        # POST /3/Profiler/capture?ms=N — bounded LIVE device capture on
+        # this process (the tool the real-v5e campaign points at a serving
+        # replica); returns the capture directory. 400 when a session is
+        # already running or ms is out of range.
+        if method != "POST":
+            return _err(405, "capture requires POST")
+        from ..utils import telemetry as _telemetry
+
+        ms = int(p.get("ms", 1000))
+        path = _telemetry.capture(ms, out_dir=p.get("dir") or None)
+        return 200, {"dir": path, "ms": ms}
     if head == "Profiler":
         # `water/api/ProfilerHandler`: cluster stack-sample aggregation; here
         # the controller process is sampled for `depth` rounds
@@ -2559,7 +2603,15 @@ _ROUTES_DOC = [
          "one node's log file, filtered by level"),
         ("GET", "/3/Timeline", "typed event timeline ring (limit/kind)"),
         ("GET", "/3/Metrics",
-         "unified telemetry registry (JSON; ?format=prometheus)"),
+         "unified telemetry registry (JSON; ?format=prometheus; "
+         "?fleet=1 merges peer processes with per-process labels)"),
+        ("GET", "/3/Programs",
+         "program cost registry: per-executable XLA flops/bytes/memory, "
+         "measured walls, roofline fraction"),
+        ("GET", "/3/Flight", "flight-recorder bundle listing"),
+        ("GET", "/3/Flight/{name}", "one flight bundle's content"),
+        ("POST", "/3/Profiler/capture",
+         "bounded live jax.profiler device capture (?ms=N)"),
         ("GET", "/3/Profiler", "stack samples + task phase aggregation"),
         ("GET", "/3/WaterMeterCpuTicks/{node}", "cpu tick counters"),
         ("GET", "/3/WaterMeterIo", "io counters"),

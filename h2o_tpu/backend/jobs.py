@@ -80,6 +80,13 @@ def _note_job_finished() -> None:
         merge_mod = _sys.modules.get("h2o_tpu.rapids.merge")
         if merge_mod is not None:
             merge_mod._EXPAND_PROGS.clear()
+        # Tracked program wrappers (utils/programs.py) hold their compiled
+        # executables directly too — same invisibility to jax.clear_caches
+        # as the AOT caches above; records (pure numbers) survive, the
+        # executables recompile on next dispatch
+        prog_mod = _sys.modules.get("h2o_tpu.utils.programs")
+        if prog_mod is not None:
+            prog_mod.clear_compiled()
         gc.collect()
         jax.clear_caches()
         from ..utils.log import info

@@ -241,7 +241,18 @@ class Vec(Keyed):
                 exclude=getattr(self, "_cleaner_token", None))
             warn(f"device OOM rehydrating {self.key}: emergency-spilled "
                  f"{freed} bytes, retrying")
-            return put()  # a still-armed injection fails this too — typed
+            try:
+                return put()  # a still-armed injection fails this too
+            except Exception as e2:  # noqa: BLE001 — typed below
+                if "RESOURCE_EXHAUSTED" in str(e2):
+                    # OOM that survived the spill-everything sweep: the
+                    # process genuinely cannot fit this buffer — the
+                    # flight recorder's canonical terminal event (no-op
+                    # unless H2O_TPU_FLIGHT_DIR is set)
+                    from ..utils import flightrec
+
+                    flightrec.dump("device-oom", e2)
+                raise
 
     @data.setter
     def data(self, value):

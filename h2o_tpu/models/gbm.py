@@ -108,6 +108,15 @@ def _aot_train_step(train_fn, args, key_base):
             compiled = train_fn.lower(*args).compile()
         sp.attrs["compiles"] = sc.compiles
         sp.attrs["uncached"] = sc.uncached
+    # the tree train program's XLA cost/memory analyses land in the
+    # program registry here — the one site every cached train fn's
+    # executable passes through (engine._TRAIN_FN_CACHE programs reach
+    # XLA via this AOT step; the jitted twin fallback re-runs the SAME
+    # program, so one registration covers both dispatch paths)
+    from ..utils import programs
+
+    programs.register_compiled("train.tree.step", compiled, "train",
+                               sig=sig, wall_metric="train.chunk.seconds")
     _AOT_STEP_CACHE[key] = compiled
     return compiled
 
@@ -1063,6 +1072,13 @@ class GBM(ModelBuilder):
                     progress={"ntrees_done": int(ntrees_done),
                               "ntrees_total": int(p.ntrees)})
             telemetry.inc("train.chunk.count")
+            # flight-recorder drill window — AFTER the chunk completes, so
+            # a raise@K drill bundles the drilled train's OWN progress
+            # (chunk counters, history, margins), not pre-train state; the
+            # injected fault is consumed, the loop continues
+            from ..utils import flightrec
+
+            flightrec.maybe_drill()
             if self._should_stop(m, stop_metric_series):
                 break
         output.scoring_history = history

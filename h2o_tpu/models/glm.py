@@ -240,7 +240,15 @@ def _make_irls_kernel(family: Family):
         dev = jnp.sum(family.deviance(y, mu, w))
         return G, b, dev, jnp.sum(w)
 
-    jit_step = jax.jit(_core)
+    from ..utils import programs
+
+    fam = getattr(family, "name", "family")
+    # cost-registry instrumentation at the IRLS choke point: the tracked
+    # wrapper registers each compiled step's flops/bytes/memory under a
+    # stable id and degrades to the plain jit dispatch on any signature
+    # the AOT executable rejects (utils/programs.py)
+    jit_step = programs.tracked(f"train.glm.irls.{fam}", jax.jit(_core),
+                                "train")
     sharded: dict = {}
 
     def step(X, y, w, beta, offset):
@@ -254,11 +262,15 @@ def _make_irls_kernel(family: Family):
                     out = _core(X, y, w, beta, offset)
                     return tuple(jax.lax.psum(o, ROWS) for o in out)
 
-                prog = jax.jit(shard_map(
-                    spmd, mesh=mesh,
-                    in_specs=(_P(ROWS, None), _P(ROWS), _P(ROWS), _P(),
-                              _P(ROWS)),
-                    out_specs=(_P(), _P(), _P(), _P()), check_vma=False))
+                prog = programs.tracked(
+                    f"train.glm.irls.{fam}.sharded",
+                    jax.jit(shard_map(
+                        spmd, mesh=mesh,
+                        in_specs=(_P(ROWS, None), _P(ROWS), _P(ROWS), _P(),
+                                  _P(ROWS)),
+                        out_specs=(_P(), _P(), _P(), _P()),
+                        check_vma=False)),
+                    "train", shards=ns)
                 sharded[mesh] = prog
             return prog(X, y, w, beta, offset)
         return jit_step(X, y, w, beta, offset)

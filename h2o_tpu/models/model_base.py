@@ -445,9 +445,15 @@ class ModelBuilder:
             # foreground train inside a REST handler nests under the
             # request's span instead — deliberately.
             compilemeter.install()  # compiles are countable from now on
-            with telemetry.span(f"train.{self.algo_name}",
-                                algo=self.algo_name,
-                                job=str(self.job.key)):
+            # H2O_TPU_PROFILE_DIR arms a span-scoped jax.profiler capture
+            # of the whole job: the root span below (and every span nested
+            # under it) mirrors into TraceAnnotations, so XLA ops nest
+            # under train.gbm.chunk in Perfetto. Contextmanager yields
+            # None (no session) when the knob is unset — zero overhead.
+            with telemetry.device_profile(f"train.{self.algo_name}"), \
+                    telemetry.span(f"train.{self.algo_name}",
+                                   algo=self.algo_name,
+                                   job=str(self.job.key)):
                 # arm auto-recovery BEFORE the encoding swap: the persisted
                 # params/frames must be the ORIGINAL inputs so a resumed
                 # process replays the (deterministic) encoding itself
@@ -484,7 +490,27 @@ class ModelBuilder:
                 self._recovery.mark_completed(model.key)
             return model
 
-        self.job.start(run, background=background)
+        def run_guarded():
+            from ..backend.jobs import JobCancelled
+
+            try:
+                return run()
+            except JobCancelled:
+                # a user cancel is a HANDLED outcome (Job maps it to
+                # status CANCELLED), not a terminal event — bundling it
+                # would rotate real crash bundles out of the flight dir
+                raise
+            except Exception as e:  # noqa: BLE001 — re-raised verbatim
+                # unhandled training crash: flight-record the terminal
+                # state (metrics/timeline/threads/ledger/programs/knobs)
+                # before the Job surfaces the failure. No-op unless
+                # H2O_TPU_FLIGHT_DIR is set; never masks the real error.
+                from ..utils import flightrec
+
+                flightrec.dump("train-crash", e)
+                raise
+
+        self.job.start(run_guarded, background=background)
         return self.job
 
     def train_model(self) -> Model:

@@ -1222,9 +1222,19 @@ def sample_pipeline_phases(Xb, vals3, cfg: TreeConfig, mesh=None):
             xg, lc, vv, Bg=Bg, mode=mode, n_lv=1, nbins_tot=Bg,
             block=cfg.block_rows)
 
-    accum = jax.jit(shard_map(
-        _accum, mesh=mesh, in_specs=(P(ROWS, None), P(ROWS), P(ROWS, None)),
-        out_specs=P(), check_vma=False))
+    from ...utils import programs
+
+    # the kernels-layer face of the program cost registry: the sampled
+    # level-hist accumulation is the one standalone dispatch of the hist
+    # kernel (the production loop fuses it into the train program), so its
+    # cost/memory analyses stand in for the kernel backend in /3/Programs
+    accum = programs.tracked(
+        "kernel.hist.level_group",
+        jax.jit(shard_map(
+            _accum, mesh=mesh,
+            in_specs=(P(ROWS, None), P(ROWS), P(ROWS, None)),
+            out_specs=P(), check_vma=False)),
+        "kernel", backend=kernels.hist_backend(), mode=mode, nbins=Bg)
     psum_fn = jax.jit(shard_map(
         lambda h: jax.lax.psum(h, ROWS), mesh=mesh, in_specs=P(),
         out_specs=P(), check_vma=False))

@@ -89,7 +89,7 @@ def _driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
 
 def _build_driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
                           out_rows: bool):
-    from ..utils import telemetry
+    from ..utils import programs, telemetry
 
     telemetry.inc("mrtask.program.build.count")
     reduce = reduce_key if isinstance(reduce_key, (str, type(None))) \
@@ -112,8 +112,17 @@ def _build_driver_program(map_fn, mesh: Mesh, nrow: int, reduce_key, avt,
     in_specs = tuple(P(ROWS, *([None] * (len(shape) - 1)))
                      for shape, _ in avt)
     out_specs = P(ROWS) if out_rows else P()
-    return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs))
+    jitted = jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs))
+    # every driver program registers its XLA cost/memory analyses under a
+    # stable id (utils/programs.py): the tracked wrapper AOT-compiles on
+    # first dispatch — the same one compile the jit dispatch would pay —
+    # and falls back to the jitted twin on any signature the executable
+    # rejects, so dispatch behavior can only degrade to exactly this line
+    return programs.tracked(
+        f"mrtask.{getattr(map_fn, '__name__', 'map_fn')}", jitted,
+        "dispatch", wall_metric="mrtask.dispatch.seconds",
+        rows=nrow, out_rows=out_rows)
 
 
 def _avt(arrays) -> tuple:

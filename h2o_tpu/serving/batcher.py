@@ -218,11 +218,21 @@ class MicroBatcher:
                  else np.concatenate([r.rows for r in batch], axis=0))
             recompiles_before = self._recompiles()
             try:
-                from ..utils import failpoints
+                from ..utils import failpoints, flightrec
 
+                # flight-recorder drill window on the serving path (the
+                # armed flightrec.dump failpoint writes a bundle here and
+                # the batch proceeds)
+                flightrec.maybe_drill()
                 failpoints.hit("serving.batch")
                 out = self._score(X)
             except Exception as e:  # noqa: BLE001 — fan the failure out
+                # a scoring-path fault is the serving tier's terminal
+                # event: bundle it (no-op unless H2O_TPU_FLIGHT_DIR set),
+                # then fail the coalesced requests as before
+                from ..utils import flightrec as _fr
+
+                _fr.dump("serving-crash", e)
                 for req in batch:
                     req.state = _FAILED
                     req.error = e

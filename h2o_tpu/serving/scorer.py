@@ -141,6 +141,11 @@ class CompiledScorer(_BucketedScorer):
                          device=device)
         self._jit = jax.jit(model.score_raw)
         self._compiled: dict[int, object] = {}
+        #: program-registry identity (utils/programs.py) — stable across
+        #: processes serving the same model
+        self._program_name = (f"serving.score."
+                              f"{type(model).__name__.lower()}")
+        self._model_key = str(getattr(model, "key", "?"))
 
     def warmup(self) -> int:
         """Compile every bucket and prime it with one scored batch of
@@ -155,6 +160,8 @@ class CompiledScorer(_BucketedScorer):
         before = compilemeter.count()
         pin = (jax.default_device(self.device) if self.device is not None
                else contextlib.nullcontext())
+        from ..utils import programs
+
         with pin:
             for b in self.buckets:
                 spec = jax.ShapeDtypeStruct((b, self.n_features),
@@ -165,6 +172,14 @@ class CompiledScorer(_BucketedScorer):
                 # not under load
                 self._score_bucket(
                     np.zeros((b, self.n_features), np.float32), b)
+                # one cost-registry entry per bucket executable — the
+                # serving face of /3/Programs (what does a scored batch
+                # COST, statically, per bucket)
+                programs.register_compiled(
+                    self._program_name, self._compiled[b], "serving",
+                    sig=(((b, self.n_features), "float32"),),
+                    wall_metric="serving.request.seconds",
+                    model=self._model_key, bucket=b)
         self.warmup_compiles = compilemeter.count() - before
         return self.warmup_compiles
 

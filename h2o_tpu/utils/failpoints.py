@@ -167,6 +167,12 @@ _failpoint("sanitizer.trip",
            "cross-lock acquisition while H2O_TPU_SANITIZE=locks) — arm "
            "raise to drill the violation-handling path without a real "
            "inversion")
+_failpoint("flightrec.dump",
+           "utils/flightrec.py drill site, polled at the GBM/DRF chunk "
+           "boundary and the serving batch worker (flightrec.maybe_drill) "
+           "— arm raise@K to force a flight-recorder bundle at an exact "
+           "iteration without a real crash; the injected fault is "
+           "consumed by the recorder, the job continues")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,292 @@
+"""Fleet observability — cross-process metric/trace aggregation.
+
+The reference's diagnostic surface is CLUSTER-wide by construction: any
+node answers `/3/Logs`/`/3/Timeline` for every node, because the water
+cloud gossips state. This repo's processes are symmetric but isolated —
+PR 6's registry is process-global, so the multi-process serving tier,
+multi-HOST ingest workers and bench subprocesses each kept a private
+view. This module is the merge point: a PULL-based collector that
+scrapes N peer processes' `/3/Metrics` snapshots (plus a shared spool
+directory for processes with no HTTP surface), merges them with
+per-process labels, and concatenates per-process chrome-trace files into
+one Perfetto session.
+
+Sources, in merge order:
+
+- **self** — this process's registry, read directly;
+- **peers** — ``H2O_TPU_FLEET_PEERS`` (comma ``host:port`` or full URLs),
+  each scraped as ``GET <peer>/3/Metrics`` with a bounded per-peer
+  timeout (a dead replica bounds, never blocks, the view);
+- **spool** — ``H2O_TPU_FLEET_SPOOL``: ``*.json`` snapshot files dropped
+  by :func:`write_spool` from processes that serve no REST port (bench
+  subprocesses, multihost workers).
+
+Merge semantics (documented here because they are the contract the
+serving tier and multi-HOST items build on):
+
+- counters: SUM across processes + per-process values;
+- gauges: per-process values + the max (``peak`` merged as max too);
+- histograms: count/sum SUM; quantiles merged as the COUNT-WEIGHTED mean
+  of per-process quantiles plus the max across processes — approximate
+  by nature (true quantile merge needs mergeable sketches; the ROADMAP
+  Rapids item grows those), and labeled as such in the payload.
+
+Trace merge: every span event already carries its ``pid``, so one
+Perfetto session is literally the concatenation of each process's event
+list — ``merge_traces`` reads every ``trace_*.trace.json`` in a
+directory (tolerating torn tails via ``telemetry.read_trace``) and
+writes one well-formed JSON array.
+
+This module is also a sanctioned ``jax.profiler`` site for graftlint
+rule 19 (`unscoped-profiler-capture`) — it holds no capture today, but
+a fleet-coordinated capture (start on every peer, pull the sessions)
+lands here, not in ad-hoc scripts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from . import knobs, telemetry
+
+
+def peers() -> list[str]:
+    """Normalized peer scrape URLs from ``H2O_TPU_FLEET_PEERS``."""
+    raw = knobs.get_str("H2O_TPU_FLEET_PEERS")
+    out = []
+    for tok in filter(None, (t.strip() for t in raw.split(","))):
+        if not tok.startswith("http"):
+            tok = f"http://{tok}"
+        out.append(tok.rstrip("/"))
+    return out
+
+
+def _scrape_one(url: str, timeout_s: float) -> dict:
+    """One peer's /3/Metrics snapshot, or a typed failure entry."""
+    telemetry.inc("fleet.scrape.count")
+    try:
+        with urllib.request.urlopen(f"{url}/3/Metrics",
+                                    timeout=timeout_s) as r:
+            payload = json.loads(r.read().decode())
+        return {"source": url, "ok": True,
+                "pid": payload.get("pid"),
+                "name": payload.get("name"),
+                "ts_ms": payload.get("ts_ms"),
+                "metrics": payload.get("metrics", {})}
+    except Exception as e:  # noqa: BLE001 — a dead peer is a data point
+        return {"source": url, "ok": False, "error": repr(e)}
+
+
+def _spool_dir() -> str | None:
+    return knobs.get_str("H2O_TPU_FLEET_SPOOL") or None
+
+
+def write_spool(label: str | None = None) -> str | None:
+    """Drop this process's snapshot into the shared spool (atomic rename
+    — a concurrent collector never reads a torn file). The seam bench
+    subprocesses and multihost workers use to join the fleet view without
+    serving a port. No-op (None) when no spool is configured."""
+    d = _spool_dir()
+    if d is None:
+        return None
+    os.makedirs(d, exist_ok=True)
+    rec = {"source": f"spool:{label or os.getpid()}", "ok": True,
+           "pid": os.getpid(), "name": label or f"pid{os.getpid()}",
+           "ts_ms": int(time.time() * 1000),
+           "metrics": telemetry.snapshot()}
+    path = os.path.join(d, f"{label or os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _read_spool() -> list[dict]:
+    d = _spool_dir()
+    if d is None or not os.path.isdir(d):
+        return []
+    max_age_s = knobs.get_int("H2O_TPU_FLEET_SPOOL_MAX_AGE_MS") / 1000.0
+    out = []
+    now = time.time()
+    for fn in sorted(os.listdir(d)):
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(d, fn)
+        try:
+            age_s = now - os.stat(path).st_mtime
+            if max_age_s > 0 and age_s > max_age_s:
+                # a snapshot a dead process left behind must not merge as
+                # live data forever — surfaced, not summed
+                out.append({"source": f"spool:{fn}", "ok": False,
+                            "error": f"stale spool snapshot "
+                                     f"(age {age_s:.0f}s)"})
+                continue
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            out.append({"source": f"spool:{fn}", "ok": False,
+                        "error": repr(e)})
+            continue
+        if not isinstance(rec, dict):
+            # a stray JSON array/scalar (e.g. a merged trace file dropped
+            # into the same shared dir) must degrade to a typed entry,
+            # not 500 the fleet endpoint
+            out.append({"source": f"spool:{fn}", "ok": False,
+                        "error": f"spool snapshot is "
+                                 f"{type(rec).__name__}, expected object"})
+            continue
+        rec.setdefault("source", f"spool:{fn}")
+        rec.setdefault("ok", True)
+        out.append(rec)
+    return out
+
+
+def _label(proc: dict) -> str:
+    pid = proc.get("pid")
+    src = proc.get("source", "?")
+    return f"{pid}@{src}" if pid is not None else src
+
+
+_QKEYS = ("p50", "p95", "p99")
+
+
+def _merge(procs: list[dict]) -> dict:
+    metrics: dict[str, dict] = {}
+    for proc in procs:
+        if not proc.get("ok"):
+            continue
+        lbl = _label(proc)
+        for name, rec in (proc.get("metrics") or {}).items():
+            kind = rec.get("kind")
+            m = metrics.setdefault(
+                name, {"kind": kind, "per_process": {}})
+            if kind == "counter":
+                v = rec.get("value", 0) or 0
+                m["per_process"][lbl] = v
+                m["value"] = m.get("value", 0) + v
+            elif kind == "gauge":
+                v = rec.get("value", 0)
+                pp = {"value": v}
+                if "peak" in rec:
+                    pp["peak"] = rec["peak"]
+                    m["peak"] = max(m.get("peak", rec["peak"]), rec["peak"])
+                m["per_process"][lbl] = pp
+                m["max"] = max(m.get("max", v), v) if v is not None \
+                    else m.get("max")
+            else:  # histogram
+                cnt = rec.get("count", 0) or 0
+                m["per_process"][lbl] = {
+                    k: rec.get(k) for k in ("count", "sum") + _QKEYS}
+                m["count"] = m.get("count", 0) + cnt
+                m["sum"] = round(m.get("sum", 0.0)
+                                 + (rec.get("sum", 0.0) or 0.0), 6)
+                for q in _QKEYS:
+                    qv = rec.get(q)
+                    if qv is None or not cnt:
+                        continue
+                    wsum, w = m.get(f"_{q}", (0.0, 0))
+                    m[f"_{q}"] = (wsum + qv * cnt, w + cnt)
+                    m[f"{q}_max"] = max(m.get(f"{q}_max", qv), qv)
+    for m in metrics.values():
+        if m.get("kind") == "histogram":
+            for q in _QKEYS:
+                acc = m.pop(f"_{q}", None)
+                if acc and acc[1]:
+                    # count-weighted mean of per-process quantiles — an
+                    # APPROXIMATION (flagged below); the max is exact
+                    m[q] = round(acc[0] / acc[1], 6)
+            m["quantile_merge"] = "count-weighted mean (approximate)"
+    return metrics
+
+
+# one cached merge per process (H2O_TPU_FLEET_INTERVAL_MS window): a
+# dashboard polling ?fleet=1 at 1s must not multiply every peer's scrape
+# load by every poller
+_CACHE_LOCK = threading.Lock()
+_CACHE: dict = {"at": 0.0, "view": None}
+
+
+def collect(force: bool = False) -> dict:
+    """The merged fleet view behind ``GET /3/Metrics?fleet=1``."""
+    interval_s = knobs.get_int("H2O_TPU_FLEET_INTERVAL_MS") / 1000.0
+    with _CACHE_LOCK:
+        if (not force and _CACHE["view"] is not None
+                and time.monotonic() - _CACHE["at"] < interval_s):
+            return _CACHE["view"]
+    t0 = time.perf_counter()
+    timeout_s = max(knobs.get_int("H2O_TPU_FLEET_TIMEOUT_MS"), 1) / 1000.0
+    procs = [{"source": "self", "ok": True, "pid": os.getpid(),
+              "ts_ms": int(time.time() * 1000),
+              "metrics": telemetry.snapshot()}]
+    plist = peers()
+    if plist:
+        # bounded parallel scrape: total wall ~= slowest peer, not the sum
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(max_workers=min(len(plist), 16)) as ex:
+            procs.extend(ex.map(
+                lambda u: _scrape_one(u, timeout_s), plist))
+    procs.extend(_read_spool())
+    # dedup by pid, first entry wins (merge order: self > peers > spool):
+    # a peer list that includes this process's own port, or a process
+    # that both serves /3/Metrics and writes a spool snapshot, must not
+    # have its counters SUMmed twice. (Caveat: pids can collide across
+    # hosts — rare, and the alternative is the guaranteed double-count
+    # of the uniform-peer-list deployment; the skipped entry stays
+    # visible in `processes` with the reason.)
+    seen_pids: set = set()
+    for p in procs:
+        pid = p.get("pid")
+        if not p.get("ok") or pid is None:
+            continue
+        if pid in seen_pids:
+            p["ok"] = False
+            p["error"] = (f"duplicate pid {pid} — already merged from "
+                          f"another source")
+        else:
+            seen_pids.add(pid)
+    view = {
+        "processes": [{k: p.get(k) for k in
+                       ("source", "ok", "pid", "name", "ts_ms", "error")
+                       if k in p} for p in procs],
+        "live": sum(1 for p in procs if p.get("ok")),
+        "metrics": _merge(procs),
+        "ts_ms": int(time.time() * 1000),
+    }
+    telemetry.observe("fleet.scrape.seconds", time.perf_counter() - t0)
+    with _CACHE_LOCK:
+        _CACHE["at"] = time.monotonic()
+        _CACHE["view"] = view
+    return view
+
+
+def invalidate_cache() -> None:
+    """Drop the cached merge (tests / topology changes)."""
+    with _CACHE_LOCK:
+        _CACHE["at"] = 0.0
+        _CACHE["view"] = None
+
+
+def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
+    """Concatenate every per-process ``trace_*.trace.json`` in
+    ``trace_dir`` into one well-formed chrome-trace array (events keep
+    their ``pid``, so Perfetto renders one track group per process).
+    Returns the merged file's path."""
+    events: list[dict] = []
+    for fn in sorted(os.listdir(trace_dir)):
+        if fn.startswith("trace_") and fn.endswith(".trace.json"):
+            events.extend(telemetry.read_trace(os.path.join(trace_dir, fn)))
+    events.sort(key=lambda e: e.get("ts", 0))
+    out_path = out_path or os.path.join(trace_dir, "trace_merged.json")
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(events, f)
+    os.replace(tmp, out_path)
+    return out_path

@@ -1,0 +1,284 @@
+"""Crash flight recorder — atomic diagnostics bundles on terminal events.
+
+The reference's operational story for "a node just died" is the log
+bundle: `/3/Logs` + JStack + Timeline pulled from every node and zipped.
+This repo's equivalent must survive the PROCESS dying — so instead of a
+pull surface, typed terminal events push one atomic bundle to disk
+(``H2O_TPU_FLIGHT_DIR``) at the moment of failure, while the process
+still can. A bundle is one JSON file containing everything a post-mortem
+needs and nothing it has to reconstruct:
+
+- the metrics registry snapshot and the typed timeline ring (the last
+  N events BEFORE the crash — the reference TimeLine's whole purpose);
+- the log ring (every level, not just what reached stderr);
+- an all-thread stack dump (the JStack parity piece);
+- the Cleaner's per-device ledger + limits (was it memory?);
+- the program cost registry (WHAT was running, and how big);
+- every registered knob's effective value + armed failpoints (the
+  configuration that reproduced this).
+
+Triggers wired through the stack (each a no-op unless the knob is set):
+
+- device OOM that survives the Cleaner's emergency sweep
+  (`frame/vec.py _rehydrate_put` — the "we really are out of HBM" path);
+- :class:`~h2o_tpu.utils.sanitizer.LockOrderViolation` raised by the
+  runtime sanitizer (the inversion IS the diagnosis — record it);
+- unhandled training crash (`models/model_base.py` train root) and a
+  serving batch-worker fault (`serving/batcher.py`);
+- the ``flightrec.dump`` drill failpoint (:func:`maybe_drill`, polled at
+  the GBM chunk boundary and the serving batch worker) — CI's way to
+  exercise the whole bundle path at an exact iteration.
+
+Writes are atomic (temp + fsync + rename — a reader or a second crash
+never sees a torn bundle), reentrancy-guarded (a crash INSIDE the
+recorder must not recurse), and bounded (``H2O_TPU_FLIGHT_MAX_BUNDLES``
+rotates the oldest out). ``GET /3/Flight`` lists bundles;
+``GET /3/Flight/{name}`` serves one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from . import failpoints, knobs, log, telemetry, timeline
+
+_SCHEMA_VERSION = 1
+
+_LOCK = threading.Lock()
+_SEQ = 0
+#: per-thread reentrancy guard — a fault raised while bundling (e.g. a
+#: sick metrics provider) must not trigger a second bundle of itself
+_IN_DUMP = threading.local()
+
+
+def flight_dir() -> str | None:
+    return knobs.get_str("H2O_TPU_FLIGHT_DIR") or None
+
+
+def enabled() -> bool:
+    return flight_dir() is not None
+
+
+def _thread_dump() -> list[dict]:
+    """All-thread stacks with names — `Thread.getAllStackTraces` parity."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append({
+            "thread_id": tid,
+            "name": names.get(tid, "?"),
+            "stack": [ln.rstrip() for ln in
+                      traceback.format_stack(frame, limit=40)],
+        })
+    return out
+
+
+def _cleaner_state() -> dict:
+    """The Cleaner's per-device ledger — lazily imported and failure-proof
+    (the recorder may fire before any frame ever touched the backend)."""
+    mem = sys.modules.get("h2o_tpu.backend.memory")
+    if mem is None:
+        return {"note": "backend.memory not loaded"}
+    try:
+        c = mem.CLEANER
+        return {"tracked_bytes": c.tracked_bytes(),
+                "device_bytes": {str(k): v
+                                 for k, v in c.device_bytes().items()},
+                "device_peak_bytes": {str(k): v for k, v in
+                                      c.device_peak_bytes().items()},
+                "limit_bytes": c.limit_bytes(),
+                "reserved_bytes": mem.reserved_bytes()}
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        return {"error": repr(e)}
+
+
+def _knob_state() -> dict:
+    """Effective value of every registered knob: the env value when set,
+    the registered default otherwise (``set`` lists which is which)."""
+    vals = {}
+    set_names = []
+    for name, k in sorted(knobs.KNOBS.items()):
+        env = os.environ.get(name)
+        if env is not None:
+            vals[name] = env
+            set_names.append(name)
+        else:
+            vals[name] = k.default
+    return {"values": vals, "set_in_env": set_names}
+
+
+def _bundle(reason: str, error: BaseException | None) -> dict:
+    from . import programs
+
+    b = {
+        "schema_version": _SCHEMA_VERSION,
+        "reason": reason,
+        "ts_ms": int(time.time() * 1000),
+        "pid": os.getpid(),
+        "error": None if error is None else {
+            "type": type(error).__name__,
+            "message": str(error),
+            "traceback": traceback.format_exception(
+                type(error), error, error.__traceback__),
+        },
+        "metrics": telemetry.snapshot(),
+        "timeline": timeline.snapshot(limit=1024),
+        "logs": log.get_records(limit=512),
+        "threads": _thread_dump(),
+        "cleaner": _cleaner_state(),
+        "programs": programs.snapshot(),
+        "knobs": _knob_state(),
+        "failpoints": failpoints.active(),
+    }
+    return b
+
+
+def _reap(d: str) -> None:
+    keep = max(knobs.get_int("H2O_TPU_FLIGHT_MAX_BUNDLES"), 1)
+    names = sorted(n for n in os.listdir(d)
+                   if n.startswith("flight_") and n.endswith(".json"))
+    for n in names[:-keep] if len(names) > keep else []:
+        try:
+            os.remove(os.path.join(d, n))
+        except OSError:  # pragma: no cover — concurrent reap
+            pass
+
+
+def dump(reason: str, error: BaseException | None = None) -> str | None:
+    """Write one bundle; returns its path, or None when the recorder is
+    disarmed (no ``H2O_TPU_FLIGHT_DIR``) or reentered. NEVER raises — the
+    recorder rides failure paths, and a recorder fault must not mask the
+    real error the caller is about to surface."""
+    d = flight_dir()
+    if d is None or getattr(_IN_DUMP, "active", False):
+        return None
+    _IN_DUMP.active = True
+    try:
+        global _SEQ
+        with _LOCK:
+            _SEQ += 1
+            seq = _SEQ
+        safe_reason = "".join(c if (c.isalnum() or c in "-_") else "-"
+                              for c in reason)
+        name = (f"flight_{int(time.time() * 1000)}_{os.getpid()}_"
+                f"{seq}_{safe_reason}.json")
+        path = os.path.join(d, name)
+        os.makedirs(d, exist_ok=True)
+        data = json.dumps(_bundle(reason, error),
+                          default=repr).encode()
+        # atomic write inlined (not persist.atomic_write_bytes: that hits
+        # the persist.checkpoint failpoint — a recorder drill must not
+        # perturb the checkpoint kill-windows' deterministic hit counts,
+        # and a dump triggered BY a persist fault must not re-enter
+        # failpoints at all)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _reap(d)
+        telemetry.inc("flight.dump.count")
+        timeline.record("flight", reason, path=path)
+        log.err(f"flight recorder: wrote {path} ({reason})")
+        return path
+    except Exception as e:  # noqa: BLE001 — see docstring
+        try:
+            log.err(f"flight recorder FAILED for {reason!r}: {e!r}")
+        except Exception:  # pragma: no cover
+            pass
+        return None
+    finally:
+        _IN_DUMP.active = False
+
+
+#: in-flight async dump threads — joined (bounded) at interpreter exit so
+#: a violation that escalates straight to process shutdown still gets its
+#: bundle on disk before teardown kills daemon threads
+_ASYNC_DUMPS: list = []
+_ATEXIT_ARMED = False
+
+
+def _drain_async() -> None:
+    for t in list(_ASYNC_DUMPS):
+        t.join(timeout=5.0)
+
+
+def dump_async(reason: str, error: BaseException | None = None):
+    """Write a bundle from a DETACHED thread — for callers that cannot
+    block or hold application locks through the bundle's fsync (the lock
+    sanitizer's violation path: the violating thread still HOLDS the
+    inverted locks, and bundling acquires foreign subsystem locks).
+    The thread is tracked and joined at interpreter exit, so the bundle
+    survives even when the triggering error takes the process down.
+    Returns the thread (None when the recorder is disarmed)."""
+    global _ATEXIT_ARMED
+    if not enabled():
+        return None
+    # joined via _ASYNC_DUMPS in the atexit _drain_async hook — the lint
+    # can't see a join that walks a list, so: (the caller must NOT join
+    # inline; it holds the very locks the bundle collection acquires)
+    t = threading.Thread(target=dump, args=(reason, error),  # graftlint: disable=unjoined-thread
+                         name="flightrec-dump", daemon=True)
+    with _LOCK:
+        if not _ATEXIT_ARMED:
+            import atexit
+
+            atexit.register(_drain_async)
+            _ATEXIT_ARMED = True
+        _ASYNC_DUMPS[:] = [x for x in _ASYNC_DUMPS if x.is_alive()]
+        _ASYNC_DUMPS.append(t)
+    t.start()
+    return t
+
+
+def maybe_drill() -> str | None:
+    """The ``flightrec.dump`` failpoint's consumption site: polled at the
+    GBM/DRF chunk boundary and the serving batch worker; an armed hit
+    becomes a bundle (reason ``drill``) and the caller continues — the
+    one injected fault the registry documents as NOT propagating."""
+    try:
+        failpoints.hit("flightrec.dump")
+    except failpoints.InjectedFault as e:
+        return dump("drill", e)
+    return None
+
+
+def list_bundles(d: str | None = None) -> list[dict]:
+    """The ``GET /3/Flight`` listing: newest last."""
+    d = d or flight_dir()
+    if d is None or not os.path.isdir(d):
+        return []
+    out = []
+    for n in sorted(os.listdir(d)):
+        if not (n.startswith("flight_") and n.endswith(".json")):
+            continue
+        parts = n[len("flight_"):-len(".json")].split("_", 3)
+        st = os.stat(os.path.join(d, n))
+        out.append({"name": n, "bytes": st.st_size,
+                    "ts_ms": int(parts[0]) if parts[0].isdigit() else None,
+                    "pid": int(parts[1]) if len(parts) > 1
+                    and parts[1].isdigit() else None,
+                    "reason": parts[3] if len(parts) > 3 else None})
+    return out
+
+
+def read_bundle(name: str, d: str | None = None) -> dict:
+    """One bundle's content (``GET /3/Flight/{name}``). The name is
+    validated against the listing pattern — no path traversal."""
+    d = d or flight_dir()
+    if d is None:
+        raise KeyError("flight recorder is not armed (H2O_TPU_FLIGHT_DIR)")
+    if (os.path.basename(name) != name or not name.startswith("flight_")
+            or not name.endswith(".json")):
+        raise KeyError(f"no such flight bundle: {name!r}")
+    path = os.path.join(d, name)
+    if not os.path.isfile(path):
+        raise KeyError(f"no such flight bundle: {name!r}")
+    with open(path) as f:
+        return json.load(f)

@@ -247,6 +247,44 @@ _knob("H2O_TPU_TRACE_DIR", "str", "",
       "directory for per-process chrome-tracing span exports "
       "(trace_<pid>.trace.json, loadable in Perfetto); empty = off")
 
+# -- fleet observability plane (programs / profiler / fleetobs / flightrec) --
+_knob("H2O_TPU_PROFILE_DIR", "str", "",
+      "arm span-scoped jax.profiler device capture: training jobs wrap "
+      "their root span in a bounded profiler session written under this "
+      "directory, and the live span stack mirrors into TraceAnnotations "
+      "so XLA ops nest under the telemetry span names in Perfetto "
+      "(utils/telemetry.py device_profile — the only sanctioned capture "
+      "site, graftlint rule unscoped-profiler-capture); empty = off")
+_knob("H2O_TPU_FLIGHT_DIR", "str", "",
+      "crash flight-recorder bundle directory (utils/flightrec.py): "
+      "typed terminal events (device OOM after emergency sweep, "
+      "LockOrderViolation, unhandled train/serving crash, the armed "
+      "flightrec.dump drill failpoint) write an atomic diagnostics "
+      "bundle — metrics/timeline/logs/thread-dump/Cleaner ledger/program "
+      "registry/knobs — here; empty = off")
+_knob("H2O_TPU_FLIGHT_MAX_BUNDLES", "int", 32,
+      "most flight bundles kept in H2O_TPU_FLIGHT_DIR before the oldest "
+      "are reaped (a crash storm must not fill the disk)")
+_knob("H2O_TPU_FLEET_PEERS", "str", "",
+      "comma list of peer-process /3/Metrics endpoints "
+      "(host:port or full http:// URLs) the fleet collector scrapes for "
+      "GET /3/Metrics?fleet=1 (utils/fleetobs.py); empty = self (+ spool)")
+_knob("H2O_TPU_FLEET_SPOOL", "str", "",
+      "shared spool directory where non-HTTP processes (bench "
+      "subprocesses, batch workers) drop metric snapshots "
+      "(fleetobs.write_spool) for the fleet merge; empty = off")
+_knob("H2O_TPU_FLEET_TIMEOUT_MS", "int", 500,
+      "per-peer scrape timeout for the fleet collector — one slow/dead "
+      "replica bounds, not blocks, the merged view")
+_knob("H2O_TPU_FLEET_SPOOL_MAX_AGE_MS", "int", 900_000,
+      "spool snapshots older than this (file mtime) are reported stale "
+      "instead of merged — a dead process's last snapshot must not sum "
+      "into the fleet totals forever (0 = no cutoff)")
+_knob("H2O_TPU_FLEET_INTERVAL_MS", "int", 0,
+      "minimum ms between live fleet scrapes; within the window "
+      "GET /3/Metrics?fleet=1 serves the cached merge (0 = scrape on "
+      "every request)")
+
 # -- security ---------------------------------------------------------------
 _knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
       "allow python: UDF references uploaded over the wire to execute")
@@ -298,6 +336,11 @@ _knob("H2O_TPU_BENCH_SKIP_CADENCE", "bool", False,
 _knob("H2O_TPU_BENCH_SIDECAR", "str", "",
       "path of the crash-proof per-workload JSONL sidecar "
       "(default: BENCH_partial.jsonl next to bench.py)")
+_knob("H2O_TPU_BENCH_GATE_BANDS", "str", "",
+      "tolerance-band overrides for tools/bench_gate.py as "
+      "'metric=frac' pairs, comma-separated, optionally leg-scoped "
+      "('wall=0.4,peak=0.5,gbm.wall=0.6'); empty = the gate's documented "
+      "defaults (wall +25%, peak bytes +25%, AUC drop 0.02)")
 
 # -- test harness -----------------------------------------------------------
 _knob("H2O_TPU_TEST_CACHE", "str", "",

@@ -1,0 +1,395 @@
+"""Program cost registry — per-program XLA cost/memory accounting.
+
+The reference answers "where did the cluster's cycles go" with WaterMeter
+and per-task `MRTask.profile()`; the TPU-native equivalent question is
+"what does each COMPILED PROGRAM cost" — XLA knows (the compiler emits a
+per-executable cost model and a memory assignment), but until this module
+those numbers evaporated the moment an executable left the compile path.
+
+Every compiled executable that passes through the repo's jit/AOT choke
+points registers here under a STABLE program id:
+
+- ``models/gbm.py _aot_train_step`` — the tree chunk step (the engine's
+  ``_TRAIN_FN_CACHE`` programs reach XLA through this AOT site);
+- ``parallel/mrtask.py _dispatch`` — every DrJAX-style driver program
+  (rollups, binning, generic mr_reduce/mr_map), via :func:`tracked`;
+- ``models/glm.py _make_irls_kernel`` — the GLM IRLS step (jit and
+  shard_map shapes), via :func:`tracked`;
+- ``serving/scorer.py CompiledScorer.warmup`` — one entry per bucket
+  executable;
+- ``models/tree/engine.py`` phase samples — the standalone kernels-layer
+  replays.
+
+Each record pairs the STATIC cost (``cost_analysis()``: flops, bytes
+accessed; ``memory_analysis()``: argument/output/temp/generated-code
+bytes) with MEASURED dispatch walls (a bounded per-program ring fed by
+:class:`Tracked` dispatches or explicit :func:`note_wall` calls) to derive
+achieved-FLOPs and a roofline fraction per program. Caveat the README
+spells out: dispatch walls are HOST walls — async dispatch means they are
+an upper bound on queue-insert cost, not device compute, unless the caller
+drains (the tree chunk loop and bench legs do); and on the CPU mesh there
+is no meaningful peak-FLOPs figure, so ``roofline_fraction`` is ``null``
+off-TPU by design.
+
+:func:`tracked` wraps a jitted callable: the first dispatch per argument
+signature AOT-lowers and compiles (the SAME single compile the jit
+dispatch would have paid — the jitted twin's own cache is never populated),
+registers the executable's analyses, and dispatches the compiled object;
+any mismatch (tracers, re-sharded inputs, executable input rejection)
+falls back to the jitted twin permanently for that signature, so behavior
+can only ever degrade to exactly the pre-registry dispatch. Results are
+bit-identical either way: both paths execute the XLA program lowered from
+the same arguments.
+
+Surfaced as ``GET /3/Programs`` (JSON + Prometheus families via the
+telemetry provider hook), embedded per-leg in the bench sidecar
+(``record["programs"]``), and included in every flight-recorder bundle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import weakref
+from collections import deque
+
+from . import telemetry
+
+#: measured dispatch walls kept per program (host seconds)
+_WALL_WINDOW = 128
+
+_LOCK = threading.Lock()
+_REGISTRY: dict[str, "ProgramRecord"] = {}
+
+#: live Tracked instances, weakly held — a Tracked's lifetime belongs to
+#: its caller (mrtask caches them on the map function so the gc reclaims
+#: program + closure together); the jobs.py CLEAR_CACHES_EVERY sweep
+#: calls :func:`clear_compiled` over whatever is still alive so
+#: directly-held executables honor the same long-server hygiene bound as
+#: the AOT caches
+_TRACKED: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class ProgramRecord:
+    __slots__ = ("pid", "kind", "name", "labels", "flops", "bytes_accessed",
+                 "memory", "registered_ms", "dispatch_count", "walls")
+
+    def __init__(self, pid, kind, name, labels, flops, bytes_accessed,
+                 memory):
+        self.pid = pid
+        self.kind = kind            # "train" | "dispatch" | "serving" | "kernel"
+        self.name = name
+        self.labels = labels
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.memory = memory
+        self.registered_ms = int(time.time() * 1000)
+        self.dispatch_count = 0
+        self.walls: deque = deque(maxlen=_WALL_WINDOW)
+
+
+def _cost_of(compiled) -> tuple[float, float]:
+    """(flops, bytes accessed) from an executable's XLA cost model; a
+    backend that reports neither yields (0, 0) rather than failing the
+    registration (accounting must never gate a train)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # pragma: no cover — backend without a cost model
+        return 0.0, 0.0
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return 0.0, 0.0
+    return (float(ca.get("flops", 0.0) or 0.0),
+            float(ca.get("bytes accessed", 0.0) or 0.0))
+
+
+def _memory_of(compiled) -> dict:
+    """Memory assignment of the executable: the figures the real-TPU HBM
+    budget planning needs next to the Cleaner's runtime ledger."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # pragma: no cover
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for field, key in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "alias_bytes"),
+                       ("generated_code_size_in_bytes",
+                        "generated_code_bytes")):
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[key] = int(v)
+    return out
+
+
+def _stable_pid(kind: str, name: str, sig, labels: dict) -> str:
+    """Readable + stable program id: same program shape in two processes
+    (or two runs) gets the same id — no ``id()``s, no pointers. The short
+    hash disambiguates programs that share name and leading shape."""
+    mat = repr((kind, name, sig, tuple(sorted(labels.items()))))
+    h = hashlib.sha1(mat.encode()).hexdigest()[:8]
+    shape = ""
+    if sig:
+        first = sig[0]
+        if isinstance(first, tuple) and first and isinstance(first[0], tuple):
+            shape = "x".join(str(d) for d in first[0])
+    base = f"{name}[{shape}]" if shape else name
+    return f"{base}#{h}"
+
+
+def register_compiled(name: str, compiled, kind: str, sig=None,
+                      wall_metric: str | None = None, **labels) -> str:
+    """Register one compiled executable's analyses; idempotent per id
+    (re-registration refreshes the static figures, keeps the wall ring).
+    ``wall_metric`` names the DECLARED telemetry histogram whose walls
+    already time this program's dispatches (the /3/Programs join)."""
+    flops, nbytes = _cost_of(compiled)
+    memory = _memory_of(compiled)
+    if wall_metric is not None:
+        labels["wall_metric"] = wall_metric
+    pid = _stable_pid(kind, name, sig, labels)
+    with _LOCK:
+        rec = _REGISTRY.get(pid)
+        if rec is None:
+            rec = ProgramRecord(pid, kind, name, dict(labels), flops,
+                                nbytes, memory)
+            _REGISTRY[pid] = rec
+        else:
+            rec.flops, rec.bytes_accessed, rec.memory = flops, nbytes, memory
+    telemetry.inc("programs.registered.count")
+    return pid
+
+
+def note_wall(pid: str, seconds: float) -> None:
+    """Record one measured dispatch wall for a registered program (host
+    wall — see the module caveat on async dispatch)."""
+    with _LOCK:
+        rec = _REGISTRY.get(pid)
+        if rec is None:
+            return
+        rec.dispatch_count += 1
+        rec.walls.append(seconds)
+
+
+class Tracked:
+    """Per-signature AOT dispatch wrapper over a jitted callable — the
+    instrumentation shape of ``gbm._aot_train_step`` made reusable. One
+    compile per signature either way; the compiled object additionally
+    yields its cost/memory analyses and a measured dispatch wall."""
+
+    __slots__ = ("name", "kind", "labels", "_jitted", "_compiled", "_pids",
+                 "__weakref__")
+
+    def __init__(self, name: str, jitted, kind: str, **labels):
+        self.name = name
+        self.kind = kind
+        self.labels = labels
+        self._jitted = jitted
+        #: sig -> compiled executable, or False once a signature fell back
+        self._compiled: dict = {}
+        self._pids: dict = {}
+        with _LOCK:
+            _TRACKED.add(self)
+
+    # AOT passthrough so a Tracked can stand wherever the jitted stood
+    def lower(self, *args, **kw):
+        return self._jitted.lower(*args, **kw)
+
+    @staticmethod
+    def _sig_of(a):
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            return (type(a).__name__,)
+        sharding = getattr(a, "sharding", None)
+        try:
+            hash(sharding)
+        except TypeError:  # pragma: no cover — unhashable sharding
+            sharding = str(sharding)
+        return (tuple(shape), str(getattr(a, "dtype", "?")), sharding)
+
+    def _concrete(self, args) -> bool:
+        from jax.core import Tracer
+
+        return not any(isinstance(a, Tracer) for a in args)
+
+    def clear(self) -> None:
+        """Drop the compiled executables (long-server cache hygiene); the
+        next dispatch per signature recompiles and re-registers."""
+        self._compiled.clear()
+
+    def __call__(self, *args):
+        if not self._concrete(args):
+            # under an enclosing trace the wrapper steps aside entirely
+            return self._jitted(*args)
+        key = tuple(self._sig_of(a) for a in args)
+        ent = self._compiled.get(key)
+        if ent is None:
+            try:
+                ent = self._jitted.lower(*args).compile()
+                sig = tuple((s[0], s[1]) for s in key
+                            if isinstance(s, tuple) and len(s) == 3)
+                self._pids[key] = register_compiled(
+                    self.name, ent, self.kind, sig=sig, **self.labels)
+            except Exception:
+                ent = False
+            self._compiled[key] = ent
+        if ent is False:
+            return self._jitted(*args)
+        t0 = time.perf_counter()
+        try:
+            out = ent(*args)
+        except (TypeError, ValueError) as e:
+            # executable input REJECTION — a compiled object refuses
+            # mismatched dtypes/shapes/pytrees with TypeError and
+            # mismatched shardings with ValueError (measured on this
+            # jax) — permanently degrade this signature to the jitted
+            # twin, exactly the pre-registry dispatch. Genuine device
+            # runtime faults (XlaRuntimeError: OOM, INTERNAL, failed
+            # collectives) do NOT match and surface unchanged: silently
+            # re-running a whole program on a faulting device would
+            # double time-to-failure and bury the real traceback.
+            from . import log
+
+            log.warn(f"program {self.name}: compiled executable rejected "
+                     f"its inputs ({e!r:.200}) — signature degrades to "
+                     f"jit dispatch")
+            self._compiled[key] = False
+            return self._jitted(*args)
+        pid = self._pids.get(key)
+        if pid is not None:
+            note_wall(pid, time.perf_counter() - t0)
+        return out
+
+
+def tracked(name: str, jitted, kind: str, **labels) -> Tracked:
+    return Tracked(name, jitted, kind, **labels)
+
+
+def clear_compiled() -> None:
+    """Drop every Tracked executable (the jobs.py CLEAR_CACHES_EVERY sweep
+    — directly-held compiled objects are invisible to jax.clear_caches).
+    Registry records (pure numbers) survive; only executables drop."""
+    with _LOCK:
+        live = list(_TRACKED)
+    for t in live:
+        t.clear()
+
+
+def device_peak_flops() -> float | None:
+    """Per-chip peak dense-matmul FLOP/s for the roofline denominator —
+    bf16/MXU peaks from the published TPU specs (the units real-TPU
+    campaign numbers are quoted in). None off-TPU: a CPU mesh has no
+    honest single figure, so /3/Programs reports roofline as null there
+    (README caveats this explicitly)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if getattr(dev, "platform", "") != "tpu":
+            return None
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+    except Exception:  # pragma: no cover — no backend yet
+        return None
+    table = {"v4": 275e12, "v5 lite": 197e12, "v5litepod": 197e12,
+             "v5e": 197e12, "v5p": 459e12, "v6 lite": 918e12,
+             "v6e": 918e12}
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return None
+
+
+def snapshot() -> dict:
+    """{pid: record} — the /3/Programs payload. Static cost + memory
+    figures, measured wall quantiles (telemetry's nearest-rank formula —
+    ONE quantile definition across /3/Metrics and /3/Programs), achieved
+    FLOP/s (flops / p50 wall) and the roofline fraction against
+    :func:`device_peak_flops`."""
+    peak = device_peak_flops()
+    out: dict[str, dict] = {}
+    with _LOCK:
+        recs = list(_REGISTRY.values())
+    for rec in recs:
+        walls = list(rec.walls)
+        pcts = telemetry._percentiles(walls)
+        p50 = pcts["p50"]
+        achieved = (rec.flops / p50) if (p50 and rec.flops) else None
+        entry = {
+            "kind": rec.kind, "name": rec.name, "labels": rec.labels,
+            "flops": rec.flops, "bytes_accessed": rec.bytes_accessed,
+            "memory": rec.memory, "registered_ms": rec.registered_ms,
+            "dispatch_count": rec.dispatch_count,
+            "wall": {"count": len(walls),
+                     "p50_s": p50, "p95_s": pcts["p95"],
+                     "total_s": round(sum(walls), 6) if walls else 0.0},
+            "achieved_flops_per_s": achieved,
+            "roofline_fraction": ((achieved / peak)
+                                  if (achieved and peak) else None),
+        }
+        out[rec.pid] = entry
+    return out
+
+
+def ids() -> set:
+    with _LOCK:
+        return set(_REGISTRY)
+
+
+def snapshot_delta(before_ids: set) -> dict:
+    """Programs registered since ``before_ids`` (the bench sidecar's
+    per-leg program-cost block): static figures only, compact."""
+    out = {}
+    for pid, rec in snapshot().items():
+        if pid in before_ids:
+            continue
+        out[pid] = {"kind": rec["kind"], "flops": rec["flops"],
+                    "bytes_accessed": rec["bytes_accessed"],
+                    "memory": rec["memory"],
+                    "dispatch_count": rec["dispatch_count"]}
+    return out
+
+
+def prometheus_lines() -> list:
+    """Per-program Prometheus families (telemetry provider hook — the
+    registry proper stays label-free, like serving's per-model stats)."""
+    lines = []
+    snap = snapshot()
+    if not snap:
+        return lines
+    esc = telemetry.prom_label_escape
+    lines.append("# HELP h2o_tpu_program_flops XLA cost-model flops per "
+                 "dispatch of a registered program")
+    lines.append("# TYPE h2o_tpu_program_flops gauge")
+    for pid, rec in snap.items():
+        lbl = f'program="{esc(pid)}",kind="{esc(rec["kind"])}"'
+        lines.append(f'h2o_tpu_program_flops{{{lbl}}} {rec["flops"]:g}')
+    lines.append("# HELP h2o_tpu_program_bytes_accessed XLA cost-model "
+                 "bytes accessed per dispatch")
+    lines.append("# TYPE h2o_tpu_program_bytes_accessed gauge")
+    for pid, rec in snap.items():
+        lbl = f'program="{esc(pid)}",kind="{esc(rec["kind"])}"'
+        lines.append(f'h2o_tpu_program_bytes_accessed{{{lbl}}} '
+                     f'{rec["bytes_accessed"]:g}')
+    lines.append("# HELP h2o_tpu_program_dispatch_count measured dispatches "
+                 "through the registry's tracked paths")
+    lines.append("# TYPE h2o_tpu_program_dispatch_count counter")
+    for pid, rec in snap.items():
+        lbl = f'program="{esc(pid)}",kind="{esc(rec["kind"])}"'
+        lines.append(f'h2o_tpu_program_dispatch_count{{{lbl}}} '
+                     f'{rec["dispatch_count"]:g}')
+    return lines
+
+
+telemetry.add_prometheus_provider(prometheus_lines)
+
+
+def reset() -> None:
+    """Drop every record and tracked executable (test isolation)."""
+    clear_compiled()
+    with _LOCK:
+        _REGISTRY.clear()

@@ -192,6 +192,17 @@ class SanitizedLock:
         telemetry.inc("sanitizer.violation.count")
         timeline.record("sanitizer", "lock_order",
                         acquiring=self.name, holding=prev)
+        # the inversion IS the diagnosis — bundle the thread dump + order
+        # graph while the offending threads are still in flight (no-op
+        # unless H2O_TPU_FLIGHT_DIR is set). The dump runs on a DETACHED
+        # thread: this thread still HOLDS the application locks of the
+        # inversion, and bundling acquires foreign locks (Cleaner ledger,
+        # telemetry) + fsyncs — doing that inline could deadlock the very
+        # tool built to catch deadlocks, and would record synthetic
+        # crash-path-only edges into the order graph
+        from . import flightrec
+
+        flightrec.dump_async("lock-order-violation", violation)
         raise violation
 
     def acquire(self, blocking: bool = True, timeout: float = -1):

@@ -245,6 +245,26 @@ _counter("xla.compile.count",
          "XLA backend compiles observed since utils/compilemeter.py "
          "installed its jax.monitoring listener")
 
+# -- fleet observability plane (utils/programs.py / fleetobs.py / -----------
+# -- flightrec.py + the profiler capture surface) ----------------------------
+_counter("programs.registered.count",
+         "compiled executables registered in the program cost registry "
+         "(utils/programs.py — one per (program, signature))")
+_counter("profiler.capture.count",
+         "jax.profiler device-trace captures completed (span-scoped "
+         "H2O_TPU_PROFILE_DIR sessions + POST /3/Profiler/capture)")
+_counter("fleet.scrape.count",
+         "peer-process metric scrapes attempted by the fleet collector "
+         "(utils/fleetobs.py; failures count too — they carry an error "
+         "in the merged view)")
+_histogram("fleet.scrape.seconds",
+           "wall per full fleet collection (every peer + spool read + "
+           "merge) behind GET /3/Metrics?fleet=1")
+_counter("flight.dump.count",
+         "flight-recorder diagnostic bundles written to "
+         "H2O_TPU_FLIGHT_DIR (utils/flightrec.py; contract: every count "
+         "is a terminal event somewhere)")
+
 
 def _lookup(name: str) -> Metric:
     try:
@@ -527,11 +547,29 @@ def span(name: str, metric: str | None = None, **attrs):
     sp = Span(name, metric, attrs, trace_id, span_id,
               parent[1] if parent else None)
     token = _CTX.set((trace_id, span_id))
+    # while a device-profiler session is live, mirror the span stack into
+    # jax TraceAnnotations so XLA ops nest under the SAME names in
+    # Perfetto (train.gbm.chunk wraps its device ops) — one global read
+    # when no capture is running, nothing on the steady-state span path
+    ann = None
+    if _PROFILE_ACTIVE[0]:
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:  # pragma: no cover — profiler backend quirk
+            ann = None
     sp.t0_ns = time.perf_counter_ns()
     try:
         yield sp
     finally:
         dur_ns = time.perf_counter_ns() - sp.t0_ns
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:  # pragma: no cover
+                pass
         _CTX.reset(token)
         if _enabled():
             detail = dict(sp.attrs)
@@ -620,24 +658,163 @@ def _trace_emit(sp: Span, dur_ns: int) -> None:
     with _TRACE_LOCK:
         if _TRACE_FILE is None or _TRACE_DIR_SEEN != d:
             os.makedirs(d, exist_ok=True)
+            if _TRACE_FILE is not None:
+                try:
+                    _TRACE_FILE.close()
+                except OSError:  # pragma: no cover
+                    pass
             _TRACE_FILE = open(trace_path(), "a")
             _TRACE_DIR_SEEN = d
         # chrome's JSON Array Format: "[" then comma-separated events; the
         # closing "]" is explicitly optional, so an append-only stream
-        # stays loadable after a crash (read_trace normalizes for tests)
-        if _TRACE_FILE.tell() == 0:
-            _TRACE_FILE.write("[\n")
-        else:
-            _TRACE_FILE.write(",\n")
-        _TRACE_FILE.write(line)
+        # stays loadable after a crash (read_trace normalizes). ONE write
+        # call per event, leader + record + newline fused, and the whole
+        # emit serialized under _TRACE_LOCK: concurrent span exits from N
+        # threads can never interleave partial JSON lines, and a reader
+        # sees whole lines (plus at most one torn tail mid-flush, which
+        # read_trace drops)
+        ldr = "[\n" if _TRACE_FILE.tell() == 0 else ",\n"
+        _TRACE_FILE.write(ldr + line)
         _TRACE_FILE.flush()
 
 
 def read_trace(path: str) -> list[dict]:
-    """Load a chrome-trace export back as a list of event dicts (appends
-    the optional closing bracket the streaming writer omits)."""
+    """Load a chrome-trace export back as a list of event dicts.
+
+    Normalizes what the streaming writer legitimately leaves: the missing
+    closing bracket, a trailing comma, and — when read while a writer is
+    mid-flush or after a crash tore the tail — an incomplete final record,
+    which is dropped rather than failing the whole load (the flight
+    recorder and the fleet trace merge both read live files)."""
     with open(path) as f:
         text = f.read().rstrip().rstrip(",")
-    if not text.endswith("]"):
-        text += "\n]"
-    return json.loads(text)
+    if not text:
+        return []
+    try:
+        return json.loads(text if text.endswith("]") else text + "\n]")
+    except json.JSONDecodeError:
+        pass
+    # torn tail: every complete record is one ",\n"-led line — reparse
+    # line-wise and drop whatever the crash/in-flight write left behind
+    out = []
+    for ln in text.lstrip("[").split(",\n"):
+        ln = ln.strip().rstrip(",").rstrip("]").strip()
+        if not ln:
+            continue
+        try:
+            out.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# on-demand device profiling (jax.profiler, span-scoped)
+# ---------------------------------------------------------------------------
+# The ONLY sanctioned jax.profiler capture site (with fleetobs.py) —
+# graftlint rule 19 `unscoped-profiler-capture` pins that: a start_trace
+# grown elsewhere would skip the span annotations and could leak a
+# never-stopped session. One session per process (jax's own limit); the
+# span stack mirrors into TraceAnnotations while a session is live, so
+# XLA ops nest under train.gbm.chunk / mrtask.dispatch in Perfetto.
+
+#: one-element list so span()'s hot-path read is a plain load (no lock);
+#: flipped only under _PROFILE_LOCK
+_PROFILE_ACTIVE = [False]
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_SEQ = itertools.count(1)
+
+
+def profile_dir() -> str | None:
+    """H2O_TPU_PROFILE_DIR when set — arms span-scoped device capture."""
+    return knobs.get_str("H2O_TPU_PROFILE_DIR") or None
+
+
+@contextlib.contextmanager
+def device_profile(what: str, out_dir: str | None = None):
+    """Span-scoped ``jax.profiler`` capture around the caller's region.
+
+    Yields the capture directory (``<dir>/<what>_<pid>_<n>``), or None
+    when profiling is not armed (no ``H2O_TPU_PROFILE_DIR`` and no
+    explicit ``out_dir``) or another session already runs in this process
+    — the caller's region executes unchanged either way. stop_trace is
+    guaranteed on exit, which is the whole point of scoping captures."""
+    d = out_dir or profile_dir()
+    if not d:
+        yield None
+        return
+    with _PROFILE_LOCK:
+        busy = _PROFILE_ACTIVE[0]
+        if not busy:
+            _PROFILE_ACTIVE[0] = True
+    if busy:
+        # NEVER yield while holding _PROFILE_LOCK: the caller's body runs
+        # at the yield point, and anything there touching the lock (the
+        # capture() busy-vs-failed diagnosis does) would self-deadlock
+        yield None
+        return
+    path = os.path.join(
+        d, f"{what.replace('/', '_')}_{os.getpid()}_{next(_PROFILE_SEQ)}")
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+    except Exception as e:  # pragma: no cover — backend without profiler
+        from . import log
+
+        log.err(f"jax.profiler start_trace failed: {e!r}")
+        with _PROFILE_LOCK:
+            _PROFILE_ACTIVE[0] = False
+        yield None
+        return
+    try:
+        yield path
+    finally:
+        try:
+            # a stop failure (backend trace-collection error) must never
+            # replace the caller's in-flight exception — the flight
+            # recorder would bundle the profiler's error instead of the
+            # crash that matters
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover — backend quirk
+                pass
+            else:
+                inc("profiler.capture.count")
+                timeline.record("profiler", what, dir=path)
+        finally:
+            with _PROFILE_LOCK:
+                _PROFILE_ACTIVE[0] = False
+
+
+def capture(ms: int, out_dir: str | None = None) -> str:
+    """Bounded LIVE capture — the ``POST /3/Profiler/capture?ms=N`` body:
+    profile whatever this process is doing for ``ms`` milliseconds and
+    return the capture directory. Runs on the calling (REST handler)
+    thread; concurrent training/serving work is what gets captured.
+    Raises ValueError when a session is already live (REST: 400)."""
+    import tempfile
+
+    ms = int(ms)
+    if not 0 < ms <= 60_000:
+        raise ValueError(f"capture ms must be in (0, 60000], got {ms}")
+    d = out_dir or profile_dir() or tempfile.mkdtemp(
+        prefix="h2o_tpu_profile_")
+    with device_profile("capture", out_dir=d) as path:
+        if path is None:
+            # device_profile yields None for BOTH "busy" and "start_trace
+            # failed" — tell the operator which one this was (a 400 'busy'
+            # on a process where no session ever started sends them
+            # hunting a phantom concurrent capture)
+            with _PROFILE_LOCK:
+                busy = _PROFILE_ACTIVE[0]
+            if busy:
+                raise ValueError(
+                    "a profiler session is already live in this process "
+                    "— one capture at a time (jax.profiler limit)")
+            raise RuntimeError(
+                "jax.profiler failed to start a session on this backend "
+                "— see the server log for the start_trace error")
+        time.sleep(ms / 1000.0)
+    return path

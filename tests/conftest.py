@@ -139,6 +139,11 @@ def pytest_configure(config):
                    "vs-synchronous bit parity across the knob matrix, "
                    "GOSS sampling, donated-margin chunk dispatch "
                    "(pytest -m pipeline, tests/test_pipeline.py)")
+    config.addinivalue_line(
+        "markers", "fleetobs: fleet observability plane — program cost "
+                   "registry, cross-process metric/trace merge, device "
+                   "profiler capture, flight recorder, bench gate "
+                   "(pytest -m fleetobs, tests/test_fleetobs.py)")
 
 
 def pytest_collection_modifyitems(config, items):

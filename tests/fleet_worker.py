@@ -1,0 +1,65 @@
+"""Stub fleet peer for tests/test_fleetobs.py — a LIVE process serving a
+REAL telemetry-registry snapshot at ``/3/Metrics`` over stdlib
+``http.server``.
+
+The fleet collector's contract is about PROCESS boundaries (distinct
+registries, distinct pids, a real socket between them), not about the
+full REST stack — so this worker boots the telemetry registry, seeds it
+with a known number of counter increments and histogram observations,
+and serves the same JSON shape ``GET /3/Metrics`` serves. Binding port 0
+and printing ``READY <port>`` lets the parent test avoid port races.
+
+Usage: ``python tests/fleet_worker.py <n_incs> <latency_s>``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+# invoked by script path — the repo root (not tests/) must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n_incs = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    latency_s = float(sys.argv[2]) if len(sys.argv) > 2 else 0.01
+
+    from h2o_tpu.utils import telemetry
+
+    for _ in range(n_incs):
+        telemetry.inc("rest.request.count")
+        telemetry.observe("rest.request.seconds", latency_s)
+    telemetry.set_gauge("cleaner.hbm.live.bytes", 1000.0 * n_incs)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if not self.path.startswith("/3/Metrics"):
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = json.dumps({
+                "metrics": telemetry.snapshot(),
+                "pid": os.getpid(),
+                "name": f"fleet_worker_{os.getpid()}",
+                "ts_ms": 0}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    print(f"READY {srv.server_address[1]}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

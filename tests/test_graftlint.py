@@ -255,6 +255,23 @@ def run(x, f):
     return f + 1.0
 """,
     ),
+    "unscoped-profiler-capture": (
+        """
+import jax
+
+def grab(workdir):
+    jax.profiler.start_trace(workdir)
+    do_work()
+    jax.profiler.stop_trace()
+""",
+        """
+from h2o_tpu.utils import telemetry
+
+def grab(workdir):
+    with telemetry.device_profile("grab", out_dir=workdir):
+        do_work()
+""",
+    ),
 }
 
 
@@ -684,9 +701,9 @@ def test_every_rule_registered_exactly_once():
     from tools.graftlint import PROJECT_RULES
 
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 14  # per-file rules
+    assert len(ids) == len(set(ids)) == 15  # per-file rules
     both = ids + [cls.id for cls in PROJECT_RULES]
-    assert len(both) == len(set(both)) == 18  # + interprocedural (v2)
+    assert len(both) == len(set(both)) == 19  # + interprocedural (v2)
 
 
 def test_direct_device_put_forms():

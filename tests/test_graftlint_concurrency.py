@@ -622,11 +622,12 @@ def test_ci_gate_script_exists_and_is_executable():
     assert "pytest" in text
 
 
-def test_rule_catalog_is_eighteen():
+def test_rule_catalog_is_nineteen():
     ids = [cls.id for cls in ALL_RULES] + [cls.id for cls in PROJECT_RULES]
-    assert len(ids) == len(set(ids)) == 18
+    assert len(ids) == len(set(ids)) == 19
     assert {"unguarded-shared-field", "lock-order-cycle",
-            "blocking-under-lock", "unjoined-thread"} <= set(ids)
+            "blocking-under-lock", "unjoined-thread",
+            "unscoped-profiler-capture"} <= set(ids)
 
 
 def test_rules_docs_name_real_constructs():

@@ -1,0 +1,284 @@
+#!/usr/bin/env python3
+"""Perf-regression gate — a fresh bench sidecar run vs a pinned baseline.
+
+Until this tool, the bench trajectory was a human ritual: run bench.py,
+eyeball the JSONL against the last re-anchor, hope nobody ships a silent
+2x slowdown. This gate makes the comparison exit-coded: per-leg tolerance
+bands on walls, peak HBM bytes, compile hygiene and parity flags, with a
+human-readable delta table and a nonzero exit naming the first offending
+(leg, metric) pair.
+
+Usage::
+
+    python tools/bench_gate.py --run BENCH_partial.jsonl \
+        [--baseline BENCH_r06_baseline.jsonl] [--bands wall=0.4,peak=0.5]
+
+Semantics:
+
+- Only legs present in BOTH files are compared; extra/missing legs are
+  reported, never failed (a smoke run gates the legs it ran).
+- Walls/throughput compare ONLY when the two runs' configs match (the
+  ``bench_run`` header rows/trees, and per-record ``rows`` where the leg
+  carries one) — cross-scale wall deltas are noise, not regressions.
+  Parity flags and compile hygiene compare unconditionally.
+- Bands are fractional slack: ``wall=0.25`` fails a wall more than 25%
+  over baseline. Leg-scoped overrides (``gbm.wall=0.6``) win over metric
+  ones; ``--bands`` wins over ``H2O_TPU_BENCH_GATE_BANDS`` (registered in
+  knobs.py; read directly here so the gate needs no h2o_tpu import).
+- Sidecar files may contain several appended runs — the LAST complete
+  run (from the final ``bench_run`` header) is compared.
+
+Exit codes: 0 = within bands, 1 = regression (named), 2 = usage/parse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: default fractional bands. wall: +25% (the seeded regression fixture is
+#: a 30% slowdown — it must fail); peak bytes: +25%; AUC: absolute drop
+#: 0.02; throughput (rows/s, req/s): -25%.
+DEFAULT_BANDS = {"wall": 0.25, "peak": 0.25, "auc": 0.02, "thru": 0.25}
+
+#: per-leg comparable metrics: (record key, band kind, direction).
+#: keys may be dotted paths into nested record blocks
+#: ("concurrent.pooled_req_s"). direction: "up" = bigger is worse
+#: (walls, bytes), "down" = smaller is worse (AUC, throughput)
+LEG_METRICS = {
+    "gbm": [("score_once_s", "wall", "up"),
+            ("cadence10_s", "wall", "up"),
+            ("train_auc", "auc", "down")],
+    "glm_irlsm": [("wall_s", "wall", "up")],
+    "glm_cod": [("wall_s", "wall", "up")],
+    "gam_irlsm": [("wall_s", "wall", "up")],
+    "rulefit": [("wall_s", "wall", "up")],
+    "sort": [("wall_s", "wall", "up")],
+    "merge": [("wall_s", "wall", "up")],
+    "airlines116m": [("wall_s", "wall", "up"),
+                     ("train_auc", "auc", "down"),
+                     ("pipeline_speedup_x", "thru", "down")],
+    "serving": [("rows_per_s", "thru", "down")],
+    "serving_wire": [("concurrent.pooled_req_s", "thru", "down"),
+                     ("sequential.pooled_req_s", "thru", "down")],
+    "recovery": [("train_wall_s", "wall", "up")],
+    "binned_store": [("reduction_x", "thru", "down")],
+}
+
+#: flags that must hold whenever both records carry them (scale-free)
+LEG_FLAGS = {
+    "airlines116m": [("forest_parity", True),
+                     ("uncached_compiles_warm", 0)],
+    "sharded": [("forest_struct_equal", True), ("per_shard_bytes_ok", True)],
+    "recovery": [("resume_bit_parity", True)],
+    "serving": [("recompiles", 0)],
+    "serving_wire": [("recompiles", 0)],
+}
+
+
+def _get(rec: dict, key: str):
+    """Record lookup with dotted-path support into nested blocks."""
+    cur = rec
+    for part in key.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def parse_bands(spec: str) -> dict:
+    out = {}
+    for tok in filter(None, (t.strip() for t in (spec or "").split(","))):
+        k, _, v = tok.partition("=")
+        try:
+            out[k.strip()] = float(v)
+        except ValueError:
+            raise SystemExit(f"bench_gate: bad band spec {tok!r} "
+                             f"(expected metric=frac)")
+    return out
+
+
+def band_for(bands: dict, leg: str, kind: str) -> float:
+    return bands.get(f"{leg}.{kind}", bands.get(kind, DEFAULT_BANDS[kind]))
+
+
+def load_last_run(path: str) -> tuple[dict, dict]:
+    """(header, {workload: record}) of the LAST run in a sidecar file."""
+    header: dict = {}
+    legs: dict = {}
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    d = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # torn tail of a crashed run
+                if "bench_run" in d:
+                    header, legs = d["bench_run"], {}
+                elif "workload" in d:
+                    legs[d["workload"]] = d.get("record", {})
+    except OSError as e:
+        raise SystemExit(f"bench_gate: cannot read {path}: {e}")
+    return header, legs
+
+
+def telemetry_peak(rec: dict):
+    t = rec.get("telemetry") or {}
+    g = t.get("cleaner.hbm.live.bytes") or {}
+    return g.get("peak")
+
+
+def comparable_scale(bhdr, rhdr, bleg, rleg) -> bool:
+    for k in ("rows", "ntrees"):
+        if k in bleg and k in rleg and bleg[k] != rleg[k]:
+            return False
+    for k in ("rows", "ntrees", "sort_rows"):
+        if bhdr.get(k) is not None and rhdr.get(k) is not None \
+                and bhdr[k] != rhdr[k]:
+            return False
+    return True
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(prog="python tools/bench_gate.py")
+    ap.add_argument("--run", required=True,
+                    help="fresh bench sidecar JSONL to gate")
+    ap.add_argument("--baseline",
+                    default=os.path.join(root, "BENCH_r06_baseline.jsonl"))
+    ap.add_argument("--bands", default=None,
+                    help="metric=frac[,leg.metric=frac] overrides "
+                         "(default: H2O_TPU_BENCH_GATE_BANDS, then "
+                         f"{DEFAULT_BANDS})")
+    args = ap.parse_args(argv)
+
+    # registered in knobs.py (H2O_TPU_BENCH_GATE_BANDS); read via
+    # os.environ so this tool stays import-free of the jax stack
+    bands = parse_bands(args.bands if args.bands is not None
+                        else os.environ.get("H2O_TPU_BENCH_GATE_BANDS", ""))
+
+    bhdr, base = load_last_run(args.baseline)
+    rhdr, run = load_last_run(args.run)
+    if not base:
+        print(f"bench_gate: no records in baseline {args.baseline}")
+        return 2
+    if not run:
+        print(f"bench_gate: no records in run {args.run}")
+        return 2
+
+    rows = []
+    failures = []
+
+    def check(leg, metric, bval, rval, band, worse_dir, scaled=True):
+        if bval is None or rval is None:
+            rows.append((leg, metric, bval, rval, "-", "n/a"))
+            return
+        if not scaled:
+            rows.append((leg, metric, bval, rval, "-", "skip (scale)"))
+            return
+        if isinstance(bval, bool) or isinstance(rval, bool):
+            ok = bval == rval
+            rows.append((leg, metric, bval, rval, "==",
+                         "ok" if ok else "FAIL"))
+            if not ok:
+                failures.append((leg, metric, bval, rval))
+            return
+        try:
+            delta = (rval - bval) / bval if bval else 0.0
+        except TypeError:
+            rows.append((leg, metric, bval, rval, "-", "n/a"))
+            return
+        if worse_dir == "up":
+            ok = delta <= band
+        else:
+            ok = -delta <= band
+        rows.append((leg, metric, bval, rval, f"{delta:+.1%}",
+                     "ok" if ok else "FAIL"))
+        if not ok:
+            failures.append((leg, metric, bval, rval))
+
+    for leg in sorted(set(base) & set(run)):
+        bleg, rleg = base[leg], run[leg]
+        scaled = comparable_scale(bhdr, rhdr, bleg, rleg)
+        for key, kind, direction in LEG_METRICS.get(leg, []):
+            bval, rval = _get(bleg, key), _get(rleg, key)
+            if bval is None and rval is None:
+                continue
+            if kind == "auc":
+                # absolute drop band, not relative
+                if bval is not None and rval is not None and scaled:
+                    band = band_for(bands, leg, "auc")
+                    ok = (bval - rval) <= band
+                    rows.append((leg, key, bval, rval,
+                                 f"{rval - bval:+.4f}",
+                                 "ok" if ok else "FAIL"))
+                    if not ok:
+                        failures.append((leg, key, bval, rval))
+                else:
+                    rows.append((leg, key, bval, rval, "-",
+                                 "n/a" if None in (bval, rval)
+                                 else "skip (scale)"))
+                continue
+            check(leg, key, bval, rval, band_for(bands, leg, kind),
+                  direction, scaled=scaled)
+        for key, want in LEG_FLAGS.get(leg, []):
+            # display the baseline's RECORDED value (older baselines may
+            # predate a flag — then the required value stands in); the
+            # verdict always compares the run against the requirement
+            bval, rval = _get(bleg, key), _get(rleg, key)
+            if bval is None:
+                bval = want
+            if rval is None:
+                continue
+            ok = rval == want
+            rows.append((leg, key, bval, rval, "==", "ok" if ok else "FAIL"))
+            if not ok:
+                failures.append((leg, key, want, rval))
+        bpk, rpk = telemetry_peak(bleg), telemetry_peak(rleg)
+        if bpk and rpk:
+            check(leg, "hbm_peak_bytes", bpk, rpk,
+                  band_for(bands, leg, "peak"), "up", scaled=scaled)
+
+    missing = sorted(set(base) - set(run))
+    extra = sorted(set(run) - set(base))
+
+    wl = max([len(r[0]) for r in rows] + [8])
+    ml = max([len(str(r[1])) for r in rows] + [6])
+    print(f"bench_gate: run={args.run} vs baseline={args.baseline}")
+    print(f"{'leg'.ljust(wl)}  {'metric'.ljust(ml)}  "
+          f"{'baseline':>14}  {'run':>14}  {'delta':>8}  verdict")
+    for leg, metric, bval, rval, delta, verdict in rows:
+        def fmt(v):
+            if isinstance(v, float):
+                return f"{v:.4g}"
+            return str(v)
+        print(f"{leg.ljust(wl)}  {str(metric).ljust(ml)}  "
+              f"{fmt(bval):>14}  {fmt(rval):>14}  {delta:>8}  {verdict}")
+    if missing:
+        print(f"legs in baseline only (not gated): {', '.join(missing)}")
+    if extra:
+        print(f"legs in run only (not gated): {', '.join(extra)}")
+    gated = [r for r in rows if r[5] in ("ok", "FAIL")]
+    if not gated:
+        # a run that shares no gateable metric with the baseline (typo'd
+        # workload list, renamed legs) must NOT read as a green gate
+        print("\nbench_gate: FAIL — no metric was actually compared "
+              "(no overlapping legs, or every comparison skipped); "
+              "check the run's workload list against the baseline")
+        return 1
+    if failures:
+        print(f"\nbench_gate: FAIL — {len(failures)} regression(s):")
+        for leg, metric, bval, rval in failures:
+            print(f"  {leg}.{metric}: baseline {bval!r} -> run {rval!r}")
+        return 1
+    print("\nbench_gate: ok — all compared legs within bands")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

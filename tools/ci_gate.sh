@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
-# CI gate — graftlint (18 rules, baseline-gated) + the tier-1 pytest line,
+# CI gate — graftlint (19 rules, baseline-gated) + the tier-1 pytest line,
 # as ONE exit-coded command. Either failing fails the gate; both always
 # run so a single CI pass reports lint findings AND test failures.
 #
 # Usage:
 #   tools/ci_gate.sh                 # text findings
 #   tools/ci_gate.sh --bench-smoke   # + the 50k-row pipelined GBM bench leg
+#   tools/ci_gate.sh --bench-gate    # + smoke bench at baseline config,
+#                                    #   gated vs BENCH_r06_baseline.jsonl
 #   GRAFTLINT_FORMAT=github tools/ci_gate.sh   # ::error annotations
 #   GRAFTLINT_JOBS=4 tools/ci_gate.sh          # parallel lint scan
 #
@@ -15,15 +17,23 @@
 # synchronous oracle) and 0 steady-state uncached compiles on the warm
 # train. The >=1.25x speedup stays a recorded number, not a gate — CI
 # machines' walls are noisy; parity and compile hygiene are not.
+#
+# --bench-gate runs the gbm+glm legs at the BENCH_r06 baseline's exact
+# config (60k rows / 100 trees, so walls are comparable) and pipes the
+# sidecar through tools/bench_gate.py: per-leg tolerance bands on wall,
+# peak HBM bytes, AUC, parity flags — nonzero exit names the regressed
+# (leg, metric). Band overrides: H2O_TPU_BENCH_GATE_BANDS.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 fmt="${GRAFTLINT_FORMAT:-text}"
 jobs="${GRAFTLINT_JOBS:-2}"
 bench_smoke=0
+bench_gate=0
 for arg in "$@"; do
     case "$arg" in
         --bench-smoke) bench_smoke=1 ;;
+        --bench-gate) bench_gate=1 ;;
         *) echo "ci_gate.sh: unknown argument '$arg'" >&2; exit 2 ;;
     esac
 done
@@ -74,8 +84,26 @@ EOF
     rm -f "$sidecar"
 fi
 
-echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc} =="
-if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ]; then
+gate_rc=0
+if [ "$bench_gate" -eq 1 ]; then
+    echo "== bench gate (gbm+glm @ BENCH_r06 config vs baseline bands) =="
+    sidecar="$(mktemp /tmp/h2o_tpu_bench_gate.XXXXXX.jsonl)"
+    timeout -k 10 1500 env JAX_PLATFORMS=cpu \
+        H2O_TPU_BENCH_WORKLOADS=gbm,glm \
+        H2O_TPU_BENCH_ROWS=60000 \
+        H2O_TPU_BENCH_TREES=100 \
+        H2O_TPU_BENCH_SIDECAR="$sidecar" \
+        python bench.py > /dev/null
+    gate_rc=$?
+    if [ "$gate_rc" -eq 0 ]; then
+        python tools/bench_gate.py --run "$sidecar"
+        gate_rc=$?
+    fi
+    rm -f "$sidecar"
+fi
+
+echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc} =="
+if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ]; then
     exit 1
 fi
 exit 0

@@ -57,6 +57,14 @@ CHANGES.md entries):
    margin across dispatches; this rule pins the rebind-or-copy
    discipline everywhere the pattern spreads. (Rules 14-17 are the
    interprocedural concurrency pass in `concurrency.py`.)
+19. unscoped-profiler-capture — PR 13 (fleet observability): jax.profiler
+   `start_trace`/`stop_trace`/`trace` outside `utils/telemetry.py` /
+   `utils/fleetobs.py`. Captures must ride the span-scoped API
+   (`telemetry.device_profile`/`capture`): it mirrors the live span
+   stack into TraceAnnotations (XLA ops nest under `train.gbm.chunk` in
+   Perfetto), enforces one session per process, and guarantees
+   stop_trace on every exit path — an ad-hoc start_trace leaks a
+   session the next capture then cannot open.
 """
 
 from __future__ import annotations
@@ -983,8 +991,67 @@ class UseAfterDonate(Rule):
         return out
 
 
+#: the sanctioned jax.profiler capture sites — telemetry owns the
+#: span-scoped capture API (annotations + guaranteed stop_trace),
+#: fleetobs the fleet-coordinated captures
+PROFILER_PATHS = ("h2o_tpu/utils/telemetry.py", "h2o_tpu/utils/fleetobs.py")
+
+
+class UnscopedProfilerCapture(Rule):
+    id = "unscoped-profiler-capture"
+    doc = ("jax.profiler start_trace/stop_trace/trace outside "
+           "utils/telemetry.py / utils/fleetobs.py — captures must ride "
+           "the span-scoped API (telemetry.device_profile / capture) so "
+           "TraceAnnotations nest XLA ops under the span names and "
+           "stop_trace is guaranteed on every exit path")
+
+    _CAPTURE_NAMES = ("start_trace", "stop_trace", "trace",
+                      "start_server")
+
+    def _is_capture(self, dn: str) -> bool:
+        if not dn or "profiler" not in dn:
+            return False
+        tail = dn.rsplit(".", 1)[-1]
+        return tail in self._CAPTURE_NAMES
+
+    def check(self, tree, ctx):
+        if ctx.relpath in PROFILER_PATHS:
+            return []
+        out = []
+        spans: list[tuple] = []
+        msg = ("unscoped jax.profiler capture — route it through "
+               "utils/telemetry.py's device_profile()/capture() (the "
+               "span-scoped API: annotations nest XLA ops under telemetry "
+               "span names, one session per process is enforced, and "
+               "stop_trace cannot be leaked on an error path)")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = {a.name for a in node.names}
+                if (mod.endswith("jax.profiler") or mod == "jax.profiler") \
+                        and names & set(self._CAPTURE_NAMES):
+                    out.append(self.violation(ctx, node, msg))
+            elif isinstance(node, ast.Attribute):
+                dn = normalize(dotted_name(node), ctx.aliases)
+                if dn and self._is_capture(dn):
+                    # outermost matching attribute chain only (the
+                    # direct-pallas-call span discipline)
+                    lo = (node.lineno, node.col_offset)
+                    hi = (node.end_lineno, node.end_col_offset)
+                    if not any(s0 <= lo and hi <= s1 for s0, s1 in spans):
+                        spans.append((lo, hi))
+                        out.append(self.violation(ctx, node, msg))
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)):
+                # bare `start_trace(...)` resolved through an import alias
+                dn = normalize(dotted_name(node.func), ctx.aliases)
+                if dn and self._is_capture(dn) and "profiler" in dn:
+                    out.append(self.violation(ctx, node, msg))
+        return out
+
+
 ALL_RULES = (DirectShardMap, DirectPallasCall, DirectDevicePut, PSpecConcat,
              NarrowIntAccumulate, UntrackedResident, TimingWithoutSync,
              HostSyncInTrace, NondeterminismInTrace, UnregisteredKnob,
              UnregisteredFailpoint, SwallowedRetryable, UnregisteredMetric,
-             UseAfterDonate)
+             UseAfterDonate, UnscopedProfilerCapture)
