@@ -397,6 +397,8 @@ class Cleaner:
 
     def maybe_sweep(self, exclude: int | None = None,
                     target_bytes: int | None = None) -> int:
+        from ..utils import sanitizer
+
         limit = self.limit_bytes() if target_bytes is None else target_bytes
         if limit is None:
             return 0
@@ -417,10 +419,14 @@ class Cleaner:
                            and aliases.get(id(v._data), 1) == 1),
                           key=lambda v: getattr(v, "_last_access", 0))
         freed = 0
-        for v in vecs:
-            if used - freed <= limit:
-                break
-            freed += self._spill(v)
+        # H2O_TPU_SANITIZE=transfers: the sweep's only sanctioned
+        # device->host move is the spill's explicit device_get — an
+        # implicit conversion anywhere in the loop raises typed
+        with sanitizer.transfer_scope("cleaner.sweep"):
+            for v in vecs:
+                if used - freed <= limit:
+                    break
+                freed += self._spill(v)
         return freed
 
     def _spill(self, vec) -> int:
@@ -435,6 +441,8 @@ class Cleaner:
             vec._lock.release()
 
     def _spill_locked(self, vec) -> int:
+        import jax
+
         from ..utils import failpoints
 
         failpoints.hit("cleaner.spill")
@@ -445,7 +453,10 @@ class Cleaner:
         if self.spill_dir is None:
             self.spill_dir = tempfile.mkdtemp(prefix="h2o_tpu_ice_")
         path = os.path.join(self.spill_dir, f"{vec.key}.npy")
-        np.save(path, np.asarray(arr))  # device -> host -> ice
+        # EXPLICIT device->host fetch (not np.asarray): the spill is a
+        # declared sync point, so it stays silent under the sweep's
+        # transfer guard and the graftlint host-transfer-in-hot-path rule
+        np.save(path, jax.device_get(arr))  # device -> host -> ice
         vec._spill_path = path
         vec._data = None                # HBM buffer becomes collectable
         self._debit(vec, nbytes)

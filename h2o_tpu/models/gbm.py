@@ -930,6 +930,14 @@ class GBM(ModelBuilder):
                           and not p.export_checkpoints_dir
                           and self._recovery is None)
         ahead = None
+        # H2O_TPU_SANITIZE=recompiles: after the first boundary completes
+        # (the model_base post-setup warmup: train step + boundary metric
+        # programs all compiled) every later chunk dispatch is declared
+        # steady — an uncached compile there raises typed. Only uniform
+        # chunk plans declare it: a ragged tail chunk legitimately
+        # compiles its own shape on first dispatch.
+        uniform_chunks = len({len(k) for k, _ in chunks}) <= 1
+        steady = [False]
         for ci in range(start_ci, len(chunks)):
             keys, rates = chunks[ci]
             failpoints.hit("train.gbm.chunk")
@@ -984,12 +992,32 @@ class GBM(ModelBuilder):
 
                 def _dispatch(cj, f_in):
                     nonlocal train_step
+                    import contextlib as _ctx
+
+                    from ..utils import compilemeter, sanitizer
                     args = _step_args(cj, f_in)
                     use_aot = (train_step is not None
                                and chunks[cj][0].shape[0]
                                == len(chunks[0][0]))
+                    # transfers: an implicit device->host sync inside the
+                    # chunk dispatch raises typed; recompiles: once steady
+                    # (post-first-boundary), an uncached compile raises
+                    # typed — incl. the AOT-rejection jitted retrace below,
+                    # which is exactly the mid-job resharding hazard the
+                    # sanitizer exists to surface. Both no-ops when off.
+                    # Fresh scope objects per entry: a @contextmanager
+                    # cannot be re-entered on the fallback path.
+                    def _scopes():
+                        return (sanitizer.transfer_scope("train.gbm.chunk"),
+                                compilemeter.no_compile_scope(
+                                    "train.gbm.chunk") if steady[0]
+                                else _ctx.nullcontext())
+
                     try:
-                        return (train_step if use_aot else train_fn)(*args)
+                        t_sc, c_sc = _scopes()
+                        with t_sc, c_sc:
+                            return (train_step if use_aot
+                                    else train_fn)(*args)
                     except (TypeError, ValueError):
                         if not use_aot:
                             raise
@@ -1003,7 +1031,9 @@ class GBM(ModelBuilder):
                         warn("AOT train step rejected its arguments "
                              "— jitted fallback for this job")
                         train_step = None
-                        return train_fn(*args)
+                        t_sc, c_sc = _scopes()
+                        with t_sc, c_sc:
+                            return train_fn(*args)
 
                 outs = ahead if ahead is not None else _dispatch(ci, f)
                 ahead = None
@@ -1016,14 +1046,18 @@ class GBM(ModelBuilder):
                     # dispatch-ahead: enqueue the NEXT chunk's step before
                     # this boundary's metrics/history host work drains —
                     # the device trains chunk ci+1 while the host scores
-                    # chunk ci. The margin passed on is DONATED; nothing
-                    # below may read f again (fused scoring consumes mraw,
-                    # and the dispatch_ahead gate keeps every f-reading
-                    # boundary consumer — recovery, export, stopping —
-                    # out of this mode; pinned by tests/test_pipeline.py,
-                    # which is the real guard here: the *step_args
-                    # dispatch is invisible to the use-after-donate lint).
+                    # chunk ci. The margin passed on is DONATED — the
+                    # rebind to None makes that explicit: any accidental
+                    # read below this boundary fails loudly on None
+                    # instead of "array has been deleted" at dispatch,
+                    # graftlint rule donate-across-calls sees the
+                    # *step_args donation through the call graph, and
+                    # tests/test_pipeline.py pins the runtime behavior.
+                    # (Fused scoring consumes mraw; the dispatch_ahead
+                    # gate keeps every f-reading boundary consumer —
+                    # recovery, export, stopping — out of this mode.)
                     ahead = _dispatch(ci + 1, f)
+                    f = None
                 oob_sum = osum if oob_sum is None else oob_sum + osum
                 oob_cnt = ocnt if oob_cnt is None else oob_cnt + ocnt
                 parts.append(trees)
@@ -1072,6 +1106,11 @@ class GBM(ModelBuilder):
                     progress={"ntrees_done": int(ntrees_done),
                               "ntrees_total": int(p.ntrees)})
             telemetry.inc("train.chunk.count")
+            # the first boundary IS the warmup boundary: the train step,
+            # boundary metric programs, and (when fused) the score layout
+            # all compiled above — from here every chunk dispatch is
+            # declared steady for H2O_TPU_SANITIZE=recompiles
+            steady[0] = uniform_chunks
             # flight-recorder drill window — AFTER the chunk completes, so
             # a raise@K drill bundles the drilled train's OWN progress
             # (chunk counters, history, margins), not pre-train state; the

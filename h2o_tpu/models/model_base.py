@@ -407,6 +407,15 @@ class ModelBuilder:
         """(y array, model_category, response domain)."""
         p = self.params
         v = p.training_frame.vec(p.response_column)
+        if v.is_string():
+            # a T_STR vec is host-only (data=None) — letting it through
+            # dies as an opaque TypeError deep in the jitted y/w prep
+            raise ValueError(
+                f"{self.algo_name}: response_column '{p.response_column}' "
+                f"is a string column — convert it to categorical first "
+                f"(h2o contract: frame['{p.response_column}']."
+                f"asfactor(), or load via from_pandas which factorizes "
+                f"object columns)")
         if v.is_categorical():
             k = len(v.domain)
             cat = "Binomial" if k == 2 else "Multinomial"

@@ -1091,9 +1091,12 @@ def make_train_fn(cfg: TreeConfig, grad_fn: Callable, mesh=None,
     )
     # double-buffered chunk dispatch: the carried margin's input buffer is
     # donated to the output, so back-to-back chunk dispatches reuse it
-    # instead of allocating a fresh (R,) carry per chunk. The caller owns
-    # the use-after-donate discipline (rule 18 lints what it can see;
-    # tests pin the chunk loop — see the docstring).
+    # instead of allocating a fresh (R,) carry per chunk. The caller's
+    # use-after-donate discipline is lint-enforced end to end: graftlint's
+    # pass-3 `donate-across-calls` resolves this factory's donating return
+    # through the call graph and follows the margin through the chunk
+    # loop's `*step_args` star-dispatch (tests/test_pipeline.py pins the
+    # runtime behavior on top).
     jitted = jax.jit(fn, donate_argnums=(3,)) if donate else jax.jit(fn)
     if full_key is not None:
         _TRAIN_FN_CACHE[full_key] = jitted

@@ -164,7 +164,7 @@ def _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows: bool):
     (the map/reduce/psum itself runs inside the one compiled program; its
     device wall drains at the caller's sync point). Payload bytes in/out
     come from array metadata, so the accounting costs no transfers."""
-    from ..utils import telemetry
+    from ..utils import sanitizer, telemetry
 
     in_bytes = sum(getattr(a, "nbytes", 0) for a in arrays)
     with telemetry.span("mrtask.dispatch", metric="mrtask.dispatch.seconds",
@@ -173,7 +173,11 @@ def _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows: bool):
         with sp.phase("build"):
             fn = _driver_program(map_fn, mesh, nrow, reduce_key,
                                  _avt(arrays), out_rows)
-        with sp.phase("dispatch"):
+        # H2O_TPU_SANITIZE=transfers: an implicit device->host sync inside
+        # the driver dispatch raises typed (graftlint rule
+        # host-transfer-in-hot-path is the static twin); no-op when off
+        with sp.phase("dispatch"), \
+                sanitizer.transfer_scope("mrtask.dispatch"):
             out = fn(*arrays)
     telemetry.inc("mrtask.dispatch.count")
     telemetry.inc("mrtask.payload.in.bytes", in_bytes)

@@ -24,6 +24,8 @@ compile count.
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from ..utils import compilemeter, knobs
@@ -166,7 +168,11 @@ class CompiledScorer(_BucketedScorer):
             for b in self.buckets:
                 spec = jax.ShapeDtypeStruct((b, self.n_features),
                                             jnp.float32)
-                self._compiled[b] = self._jit.lower(spec).compile()
+                # warmup IS the declared compile window: one AOT lower+
+                # compile per registered bucket, counted and frozen — the
+                # steady-state scorer never compiles again (the recompile
+                # sanitizer arms only AFTER this boundary)
+                self._compiled[b] = self._jit.lower(spec).compile()  # graftlint: disable=recompile-hazard
                 # one real execution per bucket: surfaces runtime-only
                 # errors (bad gather bounds, NaN traps) at registration,
                 # not under load
@@ -196,15 +202,32 @@ class CompiledScorer(_BucketedScorer):
 
     def _score_bucket(self, Xp: np.ndarray, b: int) -> np.ndarray:
         import jax
-        import jax.numpy as jnp
+
+        from ..utils import sanitizer
 
         fn = self._compiled.get(b)
+        aot = fn is not None
+        # post-warmup the WHOLE score path is declared steady — a bucket-
+        # miss landing on the jit fallback below is exactly the uncached
+        # compile H2O_TPU_SANITIZE=recompiles raises typed on. With the
+        # sanitizer off the miss keeps degrading to a counted compile.
+        steady = bool(self._compiled)
         if fn is None:  # unreachable after warmup(); kept non-fatal so a
             fn = self._jit  # mis-sized bucket degrades to a counted compile
             self.fallback_compiles += 1
-        X = (jax.device_put(Xp, self.device) if self.device is not None
-             else jnp.asarray(Xp))
-        return np.asarray(fn(X))
+        # staging and result fetch are EXPLICIT transfers (device_put /
+        # device_get), so the steady-state path runs silent under the full
+        # transfer guard in both directions — any other implicit transfer
+        # on the score path is a bug the sanitizer raises typed. The h2d
+        # guard arms only on the AOT path: the jit fallback TRACES, and
+        # tracing stages constants host->device legitimately.
+        X = jax.device_put(Xp, self.device)
+        with sanitizer.transfer_scope("serving.score",
+                                      host_to_device=aot), \
+                (compilemeter.no_compile_scope("serving.score") if steady
+                 else contextlib.nullcontext()):
+            out = fn(X)
+            return np.asarray(jax.device_get(out))
 
 
 class HostScorer(_BucketedScorer):

@@ -47,6 +47,22 @@ _count = 0
 _cache_hits = 0
 
 
+#: thread-local steady-state guard (H2O_TPU_SANITIZE=recompiles): jax
+#: compiles synchronously on the dispatching thread, so a per-thread
+#: scope stack attributes every compile event to the section that
+#: dispatched it — concurrent training/registration on OTHER threads
+#: never trips a serving scope (the blame problem the global counter's
+#: docstring warns about, solved by construction)
+_STEADY = threading.local()
+
+
+def _steady_stack() -> list:
+    stack = getattr(_STEADY, "stack", None)
+    if stack is None:
+        stack = _STEADY.stack = []
+    return stack
+
+
 def _listener(name: str, secs: float, **kw) -> None:
     global _count
     if name == _COMPILE_EVENT:
@@ -61,6 +77,21 @@ def _listener(name: str, secs: float, **kw) -> None:
         telemetry.inc("xla.compile.count")
         timeline.record("compile", "backend_compile",
                         secs=round(float(secs), 4))
+        stack = _steady_stack()
+        if stack:
+            # a persistent-cache replay fires its cache_hits event INSIDE
+            # compile_or_get_cached, i.e. BEFORE this duration event on
+            # the same thread — consuming one pending hit pairs them, so
+            # replays (zero XLA wall) never raise
+            if getattr(_STEADY, "hits", 0) > 0:
+                _STEADY.hits -= 1
+                return
+            from . import sanitizer
+
+            err = sanitizer.SteadyStateCompileError(stack[-1])
+            sanitizer._emit_violation("steady_compile", err,
+                                      section=stack[-1])
+            raise err
 
 
 def _event_listener(name: str, **kw) -> None:
@@ -68,6 +99,8 @@ def _event_listener(name: str, **kw) -> None:
     if name == _CACHE_HIT_EVENT:
         with _lock:
             _cache_hits += 1
+        if _steady_stack():
+            _STEADY.hits = getattr(_STEADY, "hits", 0) + 1
 
 
 def install() -> None:
@@ -159,3 +192,32 @@ def scoped():
     finally:
         sc._end = count()
         sc._end_hits = cache_hits()
+
+
+@contextlib.contextmanager
+def no_compile_scope(section: str):
+    """Declare the enclosed dispatches steady-state: under
+    ``H2O_TPU_SANITIZE=recompiles`` any UNCACHED compile inside raises the
+    typed :class:`~h2o_tpu.utils.sanitizer.SteadyStateCompileError` at the
+    dispatching call site, naming ``section``. Persistent-cache replays
+    are paired off against their cache-hit events and never raise (they
+    cost no XLA wall). Thread-local: a concurrent registration or
+    training job compiling on another thread is its own business.
+
+    No-op (one cached env read) when the mode is off — the hot-path
+    wiring sites (GBM chunk dispatch, serving _score_bucket) pay nothing
+    in production."""
+    from . import sanitizer
+
+    if not sanitizer.enabled("recompiles"):
+        yield
+        return
+    install()
+    stack = _steady_stack()
+    if not stack:
+        _STEADY.hits = 0
+    stack.append(section)
+    try:
+        yield
+    finally:
+        stack.pop()

@@ -167,6 +167,12 @@ _failpoint("sanitizer.trip",
            "cross-lock acquisition while H2O_TPU_SANITIZE=locks) — arm "
            "raise to drill the violation-handling path without a real "
            "inversion")
+_failpoint("sanitizer.transfer",
+           "utils/sanitizer.py transfer_scope entry (fires on every hot-"
+           "section entry while H2O_TPU_SANITIZE=transfers) — arm raise "
+           "to drill the typed TransferGuardViolation + flight-recorder "
+           "seam on backends where the jax guard itself cannot trip (CPU "
+           "arrays are host memory, so device->host is free there)")
 _failpoint("flightrec.dump",
            "utils/flightrec.py drill site, polled at the GBM/DRF chunk "
            "boundary and the serving batch worker (flightrec.maybe_drill) "

@@ -190,15 +190,21 @@ _knob("H2O_TPU_CLIENT_KEEPALIVE", "bool", True,
       "(api/client.py), auto-reconnecting on a stale socket; 0 reverts "
       "to one connection per request (the serving_wire bench baseline)")
 
-# -- concurrency sanitizer (utils/sanitizer.py) ------------------------------
+# -- runtime sanitizers (utils/sanitizer.py) ---------------------------------
 _knob("H2O_TPU_SANITIZE", "str", "",
-      "comma list of runtime concurrency-sanitizer modes "
-      "(utils/sanitizer.py): 'locks' = instrumented lock wrappers that "
-      "track per-thread acquisition stacks + the global lock-order graph "
-      "and raise a typed LockOrderViolation on an OBSERVED inversion; "
-      "'guards' = @guarded_by('_lock') assertions on lock-protected "
-      "methods. Consulted at lock construction — build the runtime after "
-      "setting it; empty = plain threading locks, zero overhead")
+      "comma list of runtime sanitizer modes (utils/sanitizer.py): "
+      "'locks' = instrumented lock wrappers that track per-thread "
+      "acquisition stacks + the global lock-order graph and raise a "
+      "typed LockOrderViolation on an OBSERVED inversion; 'guards' = "
+      "@guarded_by('_lock') assertions on lock-protected methods; "
+      "'transfers' = jax transfer guards scoped over the hot sections "
+      "(train chunk dispatch, MRTask dispatch, serving score path, "
+      "Cleaner sweep) raising a typed TransferGuardViolation on an "
+      "implicit device->host conversion; 'recompiles' = any uncached "
+      "XLA compile inside a declared-steady section (GBM post-first-"
+      "boundary, serving post-registration) raises a typed "
+      "SteadyStateCompileError. locks are consulted at construction — "
+      "build the runtime after setting it; empty = zero overhead")
 
 # -- fault tolerance (failpoints / auto-checkpoints / retry) ----------------
 _knob("H2O_TPU_FAILPOINTS", "str", "",

@@ -1,12 +1,42 @@
-"""Runtime lock sanitizer — the dynamic twin of graftlint's interprocedural
-concurrency rules (tools/graftlint/concurrency.py).
+"""Runtime sanitizers — the dynamic twins of graftlint's interprocedural
+rules (tools/graftlint/concurrency.py + tools/graftlint/dataflow.py).
 
-The static ``lock-order-cycle`` rule flags *possible* inversions; this
-module catches *observed* ones the moment they happen, on the real
-serving/training/Cleaner workload, behind one knob::
+The static rules flag *possible* hazards; this module catches *observed*
+ones the moment they happen, on the real serving/training/Cleaner
+workload, behind one knob::
 
     H2O_TPU_SANITIZE=locks            # instrumented locks + order checking
     H2O_TPU_SANITIZE=locks,guards     # ... plus @guarded_by assertions
+    H2O_TPU_SANITIZE=transfers        # jax transfer guards over hot sections
+    H2O_TPU_SANITIZE=recompiles       # steady-state compiles raise typed
+
+``transfers`` is rule ``host-transfer-in-hot-path``'s twin:
+:func:`transfer_scope` wraps the hot sections that rule names (train
+chunk dispatch, MRTask dispatch, serving score path, Cleaner sweep) in a
+``jax.transfer_guard_device_to_host("disallow")`` — an implicit
+device→host conversion inside one raises the typed
+:class:`TransferGuardViolation` naming the section (explicit
+``jax.device_get`` stays allowed: it is the sanctioned spelling the
+static rule pushes toward). The steady-state serving score path adds the
+host→device guard too (``host_to_device=True``): after warmup every
+staging transfer there is explicit by construction. NOTE the CPU backend
+exposes device buffers as host memory, so device→host never trips there
+— the live CPU drill goes through the host→device direction, and the
+``sanitizer.transfer`` failpoint drills the violation path (typed error +
+flight bundle) on any backend.
+
+``recompiles`` is rule ``recompile-hazard``'s twin: the compilemeter's
+:func:`~h2o_tpu.utils.compilemeter.no_compile_scope` (armed only under
+this mode) raises the typed :class:`SteadyStateCompileError` on any
+UNCACHED compile inside a declared-steady section — the GBM chunk loop
+after its first boundary (model_base post-setup) and the serving score
+path after registration warmup. Persistent-cache replays do not count
+(they cost no XLA wall); the bucket-miss fallback the serving stats
+gauge tracks is exactly what raises here.
+
+Both violations feed the PR 13 flight recorder (one diagnostics bundle
+per violation when ``H2O_TPU_FLIGHT_DIR`` is set) next to the metric and
+timeline breadcrumbs the lock sanitizer already leaves.
 
 Every lock the concurrency-audited modules create goes through
 :func:`make_lock`. With sanitizing OFF (the default) it returns a plain
@@ -50,6 +80,7 @@ env flips keeps its plain lock; tests build fresh objects (or swap
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from . import knobs
@@ -82,6 +113,41 @@ class GuardViolation(AssertionError):
             f"(@guarded_by contract)")
 
 
+class TransferGuardViolation(RuntimeError):
+    """An implicit transfer OBSERVED inside a guarded hot section under
+    ``H2O_TPU_SANITIZE=transfers`` — the runtime twin of graftlint's
+    ``host-transfer-in-hot-path``. Carries the section so a flight bundle
+    / log line names the hot path, not just the jax frame."""
+
+    def __init__(self, section: str, detail: str = ""):
+        self.section = section
+        super().__init__(
+            f"implicit transfer inside hot section '{section}' "
+            f"(H2O_TPU_SANITIZE=transfers)"
+            + (f": {detail}" if detail else "")
+            + " — hot paths stage data with explicit jax.device_put and "
+              "read results with explicit jax.device_get (graftlint rule "
+              "host-transfer-in-hot-path is the static twin)")
+
+
+class SteadyStateCompileError(RuntimeError):
+    """An UNCACHED XLA compile observed inside a declared-steady section
+    under ``H2O_TPU_SANITIZE=recompiles`` — the runtime twin of graftlint's
+    ``recompile-hazard``. Raised at the dispatching call site (the compile
+    completed; jax state stays healthy — the error names the cache-key
+    churn, it does not corrupt the backend)."""
+
+    def __init__(self, section: str):
+        self.section = section
+        super().__init__(
+            f"uncached XLA compile inside steady-state section "
+            f"'{section}' (H2O_TPU_SANITIZE=recompiles) — every steady-"
+            f"state cache key was declared stable at the warmup boundary "
+            f"(model_base post-setup / serving post-registration); a "
+            f"compile here is a shape/static-arg that escaped warmup "
+            f"(graftlint rule recompile-hazard is the static twin)")
+
+
 # ---------------------------------------------------------------------------
 # mode (dynamic read, cached on the raw knob string)
 # ---------------------------------------------------------------------------
@@ -94,11 +160,11 @@ def _modes() -> frozenset:
     if raw == _mode_cache[0]:
         return _mode_cache[1]
     modes = frozenset(m.strip() for m in (raw or "").split(",") if m.strip())
-    unknown = modes - {"locks", "guards"}
+    unknown = modes - {"locks", "guards", "transfers", "recompiles"}
     if unknown:
         raise ValueError(
             f"unknown H2O_TPU_SANITIZE mode(s) {sorted(unknown)} — "
-            f"'locks' and/or 'guards'")
+            f"any of 'locks', 'guards', 'transfers', 'recompiles'")
     _mode_cache = (raw, modes)
     return modes
 
@@ -275,6 +341,68 @@ def make_lock(name: str, *, rlock: bool = False):
     if enabled("locks"):
         return SanitizedLock(name, rlock=rlock)
     return threading.RLock() if rlock else threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# transfer guard — H2O_TPU_SANITIZE=transfers (rule 20's runtime twin)
+# ---------------------------------------------------------------------------
+def _emit_violation(what: str, violation, **detail) -> None:
+    """Shared breadcrumb trail for the typed sanitizer violations: metric,
+    timeline event, and an async flight bundle (async because the caller
+    may hold application locks — the lock-order path's rationale)."""
+    from . import flightrec, telemetry, timeline
+
+    telemetry.inc("sanitizer.violation.count")
+    timeline.record("sanitizer", what, **detail)
+    flightrec.dump_async(f"{what}-violation", violation)
+
+
+@contextlib.contextmanager
+def transfer_scope(section: str, *, host_to_device: bool = False):
+    """Scope a jax transfer guard over one hot section (no-op unless
+    ``H2O_TPU_SANITIZE=transfers``): implicit device→host conversions
+    inside raise the typed :class:`TransferGuardViolation` naming the
+    section. ``host_to_device=True`` additionally disallows implicit
+    host→device staging — ONLY for sections whose staging is explicit by
+    construction and which never trace (tracing materializes constants
+    host→device; the steady-state serving score path qualifies, the
+    chunk-0 train dispatch does not).
+
+    The ``sanitizer.transfer`` failpoint fires on entry so CI can drill
+    the violation path (typed error + flight bundle) deterministically on
+    backends where the guard itself cannot trip (CPU arrays are host
+    memory — device→host is free there, and real TPU hardware is where
+    the d2h guard earns its keep)."""
+    if not enabled("transfers"):
+        yield
+        return
+    from . import failpoints
+
+    try:
+        failpoints.hit("sanitizer.transfer")
+    except failpoints.InjectedFault as e:
+        v = TransferGuardViolation(section, f"drill: {e}")
+        _emit_violation("transfer", v, section=section, drill=True)
+        raise v from e
+    import jax
+
+    guards = [jax.transfer_guard_device_to_host("disallow")]
+    if host_to_device:
+        guards.append(jax.transfer_guard_host_to_device("disallow"))
+    try:
+        with contextlib.ExitStack() as stack:
+            for g in guards:
+                stack.enter_context(g)
+            yield
+    except TransferGuardViolation:
+        raise  # an inner (nested) scope already typed + bundled it
+    except Exception as e:
+        msg = str(e)
+        if "transfer" in msg.lower() and "isallow" in msg:
+            v = TransferGuardViolation(section, msg.splitlines()[0])
+            _emit_violation("transfer", v, section=section)
+            raise v from e
+        raise
 
 
 # ---------------------------------------------------------------------------

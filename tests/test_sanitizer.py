@@ -1,6 +1,14 @@
-"""Runtime lock sanitizer (`h2o_tpu/utils/sanitizer.py`) — the dynamic
-twin of graftlint's interprocedural rules — plus regression tests for the
+"""Runtime sanitizers (`h2o_tpu/utils/sanitizer.py`) — the dynamic twins
+of graftlint's interprocedural rules — plus regression tests for the
 races those rules surfaced (finding ids in the module comments).
+
+Four arms: `locks` / `guards` (PR 11, the concurrency pass's twins) and
+`transfers` / `recompiles` (the dataflow pass's twins): a live
+host→device guard drill on the CPU mesh, the `sanitizer.transfer`
+failpoint drill (typed violation + flight bundle on any backend), a
+steady-state recompile drill that registers a serving model then forces
+a bucket-miss, and the serving+train+sweep stress pass re-run with ALL
+four arms armed, asserting silence.
 
 The load-bearing pins:
 
@@ -29,9 +37,12 @@ import time
 import numpy as np
 import pytest
 
-from h2o_tpu.utils import failpoints, sanitizer, telemetry, timeline
+from h2o_tpu.utils import (compilemeter, failpoints, flightrec, sanitizer,
+                           telemetry, timeline)
 from h2o_tpu.utils.sanitizer import (GuardViolation, LockOrderViolation,
-                                     SanitizedLock, guarded_by, make_lock)
+                                     SanitizedLock, SteadyStateCompileError,
+                                     TransferGuardViolation, guarded_by,
+                                     make_lock)
 
 pytestmark = pytest.mark.graftlint
 
@@ -222,6 +233,200 @@ class TestGuardedBy:
 
 
 # ---------------------------------------------------------------------------
+# transfer guard — H2O_TPU_SANITIZE=transfers (rule 20's runtime twin)
+# ---------------------------------------------------------------------------
+class TestTransferSanitizer:
+    def test_noop_when_off(self):
+        ran = []
+        with sanitizer.transfer_scope("serving.score",
+                                      host_to_device=True):
+            ran.append(1)
+        assert ran == [1]
+
+    def test_live_h2d_guard_trips_typed_on_cpu_mesh(self, monkeypatch):
+        """The live CPU drill: on this backend device buffers ARE host
+        memory so device→host never trips, but an implicit host→device
+        staging inside a full-guard section does — and surfaces as the
+        TYPED violation naming the section, with the metric bump and the
+        timeline breadcrumb."""
+        _on(monkeypatch, "transfers")
+        import jax.numpy as jnp
+
+        before = telemetry.value("sanitizer.violation.count")
+        dev = jnp.asarray(np.ones(8, np.float32))
+        with pytest.raises(TransferGuardViolation) as ei:
+            with sanitizer.transfer_scope("serving.score",
+                                          host_to_device=True):
+                # the python scalar is implicitly staged host->device at
+                # dispatch — the guard converts the raw XLA error into
+                # the typed, section-naming violation
+                (dev + 1.0).block_until_ready()
+        assert ei.value.section == "serving.score"
+        assert "host-transfer-in-hot-path" in str(ei.value)  # static twin
+        assert telemetry.value("sanitizer.violation.count") == before + 1
+        evs = [e for e in timeline.snapshot(kind="sanitizer")
+               if e["what"] == "transfer"
+               and e.get("section") == "serving.score"]
+        assert evs
+
+    def test_explicit_staging_stays_silent(self, monkeypatch):
+        """The sanctioned spelling runs silent under the FULL guard:
+        explicit device_put in, compiled compute, explicit device_get
+        out — the steady-state serving shape."""
+        _on(monkeypatch, "transfers")
+        import jax
+        import jax.numpy as jnp
+
+        jf = jax.jit(lambda x: x * 2.0)
+        x0 = jax.device_put(np.ones(4, np.float32))
+        jf(x0).block_until_ready()        # trace+compile OUTSIDE the scope
+        with sanitizer.transfer_scope("serving.score",
+                                      host_to_device=True):
+            x = jax.device_put(np.ones(4, np.float32))
+            out = np.asarray(jax.device_get(jf(x)))
+        assert out.shape == (4,)
+
+    def test_failpoint_drill_types_and_bundles(self, monkeypatch,
+                                               tmp_path):
+        """`sanitizer.transfer` drills the violation path on ANY backend:
+        typed error + flight-recorder bundle, no real transfer needed."""
+        _on(monkeypatch, "transfers")
+        monkeypatch.setenv("H2O_TPU_FLIGHT_DIR", str(tmp_path))
+        failpoints.arm("sanitizer.transfer", "raise")
+        try:
+            with pytest.raises(TransferGuardViolation) as ei:
+                with sanitizer.transfer_scope("mrtask.dispatch"):
+                    pass  # pragma: no cover - entry raises
+        finally:
+            failpoints.disarm("sanitizer.transfer")
+        assert ei.value.section == "mrtask.dispatch"
+        flightrec._drain_async()
+        reasons = [b["reason"]
+                   for b in flightrec.list_bundles(str(tmp_path))]
+        assert "transfer-violation" in reasons
+
+    def test_hot_sections_run_silent_with_guard_armed(self, monkeypatch):
+        """The wired hot sections (MRTask dispatch, Cleaner sweep) stay
+        silent with the guard live — their transfers are explicit by
+        construction."""
+        _on(monkeypatch, "transfers")
+        import jax.numpy as jnp
+
+        from h2o_tpu.backend import memory
+        from h2o_tpu.frame.vec import Vec
+        from h2o_tpu.parallel.mrtask import mr_reduce
+
+        v = Vec.from_numpy(np.arange(64, dtype=np.float32))
+        before = telemetry.value("sanitizer.violation.count")
+        total = mr_reduce(lambda cols, rows: jnp.sum(cols[0]),
+                          [v.data], nrow=64)
+        assert float(np.asarray(total)) == float(np.arange(64).sum())
+        memory.CLEANER.maybe_sweep(target_bytes=0)
+        assert telemetry.value("sanitizer.violation.count") == before
+
+
+# ---------------------------------------------------------------------------
+# steady-state compile guard — H2O_TPU_SANITIZE=recompiles (rule 22's twin)
+# ---------------------------------------------------------------------------
+class TestRecompileSanitizer:
+    def test_noop_when_off(self):
+        with compilemeter.no_compile_scope("train.gbm.chunk"):
+            pass
+
+    def test_uncached_compile_inside_steady_scope_raises_typed(
+            self, monkeypatch):
+        _on(monkeypatch, "recompiles")
+        import jax
+        import jax.numpy as jnp
+
+        jf = jax.jit(lambda x: x * 3.0)
+        x = jnp.ones(5)
+        before = telemetry.value("sanitizer.violation.count")
+        with pytest.raises(SteadyStateCompileError) as ei:
+            with compilemeter.no_compile_scope("train.gbm.chunk"):
+                jf(x)
+        assert ei.value.section == "train.gbm.chunk"
+        assert "recompile-hazard" in str(ei.value)      # static twin
+        assert telemetry.value("sanitizer.violation.count") == before + 1
+        # outside the scope the same dispatch compiles freely
+        assert float(jf(x)[0]) == 3.0
+
+    def test_cached_dispatch_is_silent(self, monkeypatch):
+        _on(monkeypatch, "recompiles")
+        import jax
+        import jax.numpy as jnp
+
+        jf = jax.jit(lambda x: x + 1.0)
+        x = jnp.ones(5)
+        jf(x).block_until_ready()         # warm BEFORE the boundary
+        with compilemeter.no_compile_scope("serving.score"):
+            for _ in range(3):
+                out = jf(x)
+        assert float(out[0]) == 2.0
+
+    def test_scope_is_thread_local(self, monkeypatch):
+        """A concurrent compile on ANOTHER thread (a registration, a
+        training job) never trips this thread's steady scope."""
+        _on(monkeypatch, "recompiles")
+        import jax
+        import jax.numpy as jnp
+
+        errs: list = []
+
+        def other_thread_compiles():
+            try:
+                jax.jit(lambda x: x - 7.0)(jnp.ones(3)).block_until_ready()
+            except Exception as e:  # pragma: no cover - fail loudly
+                errs.append(e)
+
+        jf = jax.jit(lambda x: x * 0.5)
+        x = jnp.ones(3)
+        jf(x).block_until_ready()
+        with compilemeter.no_compile_scope("serving.score"):
+            t = threading.Thread(target=other_thread_compiles)
+            t.start()
+            t.join()
+            jf(x)
+        assert not errs, errs
+
+    def test_serving_bucket_miss_raises_typed_and_bundles(
+            self, monkeypatch, tmp_path):
+        """The acceptance drill: register a serving model (warmup freezes
+        the bucket executables), then force a bucket-miss — the fallback
+        compile is exactly the steady-state recompile the sanitizer
+        raises typed on, with a flight bundle."""
+        _on(monkeypatch, "recompiles")
+        monkeypatch.setenv("H2O_TPU_FLIGHT_DIR", str(tmp_path))
+        from h2o_tpu.models.gbm import GBM, GBMParameters
+        from h2o_tpu.serving.runtime import ServingRuntime
+
+        fr = _tiny_binom_frame()
+        model = GBM(GBMParameters(training_frame=fr, response_column="y",
+                                  ntrees=3, max_depth=2,
+                                  seed=7)).train_model()
+        rt = ServingRuntime()
+        try:
+            rt.register_model(model, "rec_drill",
+                              overrides={"buckets": [1, 8]})
+            scorer = rt._models["rec_drill"].replicas.replicas[0].scorer
+            # steady-state scoring through a REGISTERED bucket is silent
+            rows = [{"x1": 0.3, "x2": 0.1}]
+            rt.score("rec_drill", rows, deadline_ms=10_000)
+            misses_before = scorer.fallback_compiles
+            with pytest.raises(SteadyStateCompileError) as ei:
+                scorer._score_bucket(
+                    np.zeros((3, scorer.n_features), np.float32), 3)
+            assert ei.value.section == "serving.score"
+            assert scorer.fallback_compiles == misses_before + 1
+        finally:
+            rt.shutdown()
+        flightrec._drain_async()
+        reasons = [b["reason"]
+                   for b in flightrec.list_bundles(str(tmp_path))]
+        assert "steady_compile-violation" in reasons
+
+
+# ---------------------------------------------------------------------------
 # stress: serving + train + Cleaner sweep, all audited locks sanitized
 # ---------------------------------------------------------------------------
 def _tiny_binom_frame():
@@ -239,12 +444,18 @@ def _tiny_binom_frame():
 
 
 class TestStressSilence:
-    def test_serving_train_sweep_stress_stays_silent(self, monkeypatch):
-        """The acceptance drill: with H2O_TPU_SANITIZE=locks live on every
+    @pytest.mark.parametrize(
+        "modes", ["locks", "locks,guards,transfers,recompiles"])
+    def test_serving_train_sweep_stress_stays_silent(self, monkeypatch,
+                                                     modes):
+        """The acceptance drill: with H2O_TPU_SANITIZE live on every
         audited lock (serving runtime/control/stats built fresh, the
         Cleaner's lock swapped in), concurrent scoring + a real GBM train
-        + forced Cleaner sweeps observe ZERO lock-order violations."""
-        _on(monkeypatch)
+        + forced Cleaner sweeps observe ZERO violations — and the same
+        pass stays silent with ALL FOUR arms armed (transfer guards over
+        every hot section, steady-compile scopes on the chunk loop and
+        the score path)."""
+        _on(monkeypatch, modes)
         from h2o_tpu.backend import memory
         from h2o_tpu.models.gbm import GBM, GBMParameters
         from h2o_tpu.serving.runtime import ServingRuntime
@@ -295,9 +506,12 @@ class TestOverhead:
     def test_sanitizer_off_overhead_under_2pct_of_train(self, monkeypatch):
         """With the knob OFF, the only sanitizer code that can run on a
         hot path is the cached mode check (make_lock at construction,
-        guarded_by pass-throughs). Wrap them with accumulating timers
-        through a real timed train and assert < 2% of the drained wall —
-        the PR 6 telemetry-overhead methodology."""
+        guarded_by pass-throughs, and the transfer/steady scope entries
+        the chunk loop + dispatch now pay per call). Wrap them all with
+        accumulating timers through a real timed train and assert < 2%
+        of the drained wall — the PR 6 telemetry-overhead methodology."""
+        import contextlib
+
         monkeypatch.delenv("H2O_TPU_SANITIZE", raising=False)
         from h2o_tpu.models.gbm import GBM, GBMParameters
 
@@ -312,9 +526,28 @@ class TestOverhead:
                     spent[0] += time.perf_counter() - t0
             return w
 
+        def timed_cm(fn):
+            @contextlib.contextmanager
+            def w(*a, **k):
+                t0 = time.perf_counter()
+                cm = fn(*a, **k)
+                cm.__enter__()
+                spent[0] += time.perf_counter() - t0
+                try:
+                    yield
+                finally:
+                    t0 = time.perf_counter()
+                    cm.__exit__(None, None, None)
+                    spent[0] += time.perf_counter() - t0
+            return w
+
         monkeypatch.setattr(sanitizer, "_modes", timed(sanitizer._modes))
         monkeypatch.setattr(sanitizer, "make_lock",
                             timed(sanitizer.make_lock))
+        monkeypatch.setattr(sanitizer, "transfer_scope",
+                            timed_cm(sanitizer.transfer_scope))
+        monkeypatch.setattr(compilemeter, "no_compile_scope",
+                            timed_cm(compilemeter.no_compile_scope))
         fr = _tiny_binom_frame()
         m = GBM(GBMParameters(training_frame=fr, response_column="y",
                               ntrees=8, max_depth=3,

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# CI gate — graftlint (19 rules, baseline-gated) + the tier-1 pytest line,
+# CI gate — graftlint (23 rules, baseline-gated) + the tier-1 pytest line,
 # as ONE exit-coded command. Either failing fails the gate; both always
 # run so a single CI pass reports lint findings AND test failures.
 #
@@ -8,6 +8,8 @@
 #   tools/ci_gate.sh --bench-smoke   # + the 50k-row pipelined GBM bench leg
 #   tools/ci_gate.sh --bench-gate    # + smoke bench at baseline config,
 #                                    #   gated vs BENCH_r06_baseline.jsonl
+#   tools/ci_gate.sh --sanitize-stress  # + serving+train+sweep stress with
+#                                    #   ALL FOUR sanitizer arms armed
 #   GRAFTLINT_FORMAT=github tools/ci_gate.sh   # ::error annotations
 #   GRAFTLINT_JOBS=4 tools/ci_gate.sh          # parallel lint scan
 #
@@ -23,6 +25,19 @@
 # sidecar through tools/bench_gate.py: per-leg tolerance bands on wall,
 # peak HBM bytes, AUC, parity flags — nonzero exit names the regressed
 # (leg, metric). Band overrides: H2O_TPU_BENCH_GATE_BANDS.
+#
+# --sanitize-stress re-runs the PR 11 serving+train+sweep stress pass
+# with H2O_TPU_SANITIZE=locks,guards,transfers,recompiles all armed
+# (instrumented locks + guard assertions + transfer guards over every
+# hot section + steady-state compile scopes) and asserts SILENCE —
+# zero typed violations across concurrent scoring, a real GBM train,
+# and forced Cleaner sweeps. The drill twins (failpoint + live
+# host->device trip + serving bucket-miss) ride along so the typed
+# violation -> flight-bundle seams stay exercised. These tests also run
+# inside the tier-1 line above; the flag is the DELIBERATE duplicate — a
+# named, exit-coded leg a nightly/hardware pipeline can point at without
+# parsing the 1100-test tier-1 summary, re-run in a fresh interpreter so
+# sanitizer arming never inherits tier-1 process state.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -30,10 +45,12 @@ fmt="${GRAFTLINT_FORMAT:-text}"
 jobs="${GRAFTLINT_JOBS:-2}"
 bench_smoke=0
 bench_gate=0
+sanitize_stress=0
 for arg in "$@"; do
     case "$arg" in
         --bench-smoke) bench_smoke=1 ;;
         --bench-gate) bench_gate=1 ;;
+        --sanitize-stress) sanitize_stress=1 ;;
         *) echo "ci_gate.sh: unknown argument '$arg'" >&2; exit 2 ;;
     esac
 done
@@ -102,8 +119,20 @@ if [ "$bench_gate" -eq 1 ]; then
     rm -f "$sidecar"
 fi
 
-echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc} =="
-if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ]; then
+stress_rc=0
+if [ "$sanitize_stress" -eq 1 ]; then
+    echo "== sanitize stress (serving+train+sweep, all four arms armed) =="
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        "tests/test_sanitizer.py::TestStressSilence::test_serving_train_sweep_stress_stays_silent[locks,guards,transfers,recompiles]" \
+        "tests/test_sanitizer.py::TestTransferSanitizer::test_live_h2d_guard_trips_typed_on_cpu_mesh" \
+        "tests/test_sanitizer.py::TestTransferSanitizer::test_failpoint_drill_types_and_bundles" \
+        "tests/test_sanitizer.py::TestRecompileSanitizer::test_serving_bucket_miss_raises_typed_and_bundles"
+    stress_rc=$?
+fi
+
+echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc}, sanitize-stress rc=${stress_rc} =="
+if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ] || [ "$stress_rc" -ne 0 ]; then
     exit 1
 fi
 exit 0

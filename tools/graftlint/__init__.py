@@ -2,7 +2,9 @@
 
 CLI:    python -m tools.graftlint [paths ...] [--fix] [--baseline-update]
 Gate:   tests/test_graftlint.py (tier-1, marker `graftlint`)
-Rules:  tools/graftlint/rules.py (catalog + incident history)
+Rules:  tools/graftlint/rules.py (catalog + incident history);
+        tools/graftlint/concurrency.py (interprocedural pass 2);
+        tools/graftlint/dataflow.py (array-provenance pass 3)
 """
 
 from .concurrency import PROJECT_RULES, lint_project
@@ -10,12 +12,13 @@ from .core import (BASELINE_PATH, CACHE_DIR, DEFAULT_PATHS, REPO_ROOT,
                    FileContext, Rule, Violation, apply_baseline, lint_paths,
                    lint_source, load_baseline, main, render_github,
                    render_sarif, write_baseline)
+from .dataflow import DATAFLOW_RULES
 from .rules import ALL_RULES
 
 __all__ = [
-    "ALL_RULES", "BASELINE_PATH", "CACHE_DIR", "DEFAULT_PATHS",
-    "PROJECT_RULES", "REPO_ROOT", "FileContext", "Rule", "Violation",
-    "apply_baseline", "lint_paths", "lint_project", "lint_source",
-    "load_baseline", "main", "render_github", "render_sarif",
+    "ALL_RULES", "BASELINE_PATH", "CACHE_DIR", "DATAFLOW_RULES",
+    "DEFAULT_PATHS", "PROJECT_RULES", "REPO_ROOT", "FileContext", "Rule",
+    "Violation", "apply_baseline", "lint_paths", "lint_project",
+    "lint_source", "load_baseline", "main", "render_github", "render_sarif",
     "write_baseline",
 ]

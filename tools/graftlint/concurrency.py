@@ -458,6 +458,15 @@ PROJECT_RULES = (UnguardedSharedField, LockOrderCycle, BlockingUnderLock,
                  UnjoinedThread)
 
 
+def default_project_rules() -> tuple:
+    """Pass 2 (concurrency) + pass 3 (dataflow) rule classes — the full
+    interprocedural rule set a default run executes. Lazy import: dataflow
+    imports from this module."""
+    from .dataflow import DATAFLOW_RULES
+
+    return tuple(PROJECT_RULES) + tuple(DATAFLOW_RULES)
+
+
 # ---------------------------------------------------------------------------
 # runner
 # ---------------------------------------------------------------------------
@@ -467,15 +476,21 @@ def check_project(summaries: dict, sources: dict,
 
     ``sources`` maps relpath -> source text (for snippets/suppressions);
     files without a summary (syntax errors, out of scope) are skipped.
+    Rules yield ``(path, line, msg)`` or ``(path, line, msg, col,
+    col_end)`` — the dataflow rules carry column spans so SARIF/GitHub
+    annotations underline the exact expression.
     """
     model = ProjectModel({p: s for p, s in summaries.items()
                           if s is not None and in_scope(p)})
     out: list[Violation] = []
     suppress_cache: dict[str, dict] = {}
     lines_cache: dict[str, list] = {}
-    for cls in (rules if rules is not None else PROJECT_RULES):
+    for cls in (rules if rules is not None else default_project_rules()):
         rule = cls() if isinstance(cls, type) else cls
-        for path, line, message in rule.check(model):
+        for finding in rule.check(model):
+            path, line, message = finding[0], finding[1], finding[2]
+            col = finding[3] if len(finding) > 3 else 0
+            col_end = finding[4] if len(finding) > 4 else 0
             src = sources.get(path)
             if src is None:
                 snippet, suppressed = "", False
@@ -492,9 +507,9 @@ def check_project(summaries: dict, sources: dict,
                               or (ids != "absent" and rule.id in ids))
             if suppressed:
                 continue
-            out.append(Violation(rule=rule.id, path=path, line=line, col=0,
-                                 message=message, snippet=snippet,
-                                 severity=rule.severity))
+            out.append(Violation(rule=rule.id, path=path, line=line,
+                                 col=col, message=message, snippet=snippet,
+                                 severity=rule.severity, col_end=col_end))
     return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))
 
 

@@ -1,9 +1,11 @@
 """graftlint core — the rule framework, file runner, cache, baseline.
 
-A repo-native static analyzer: 13 per-file AST rules plus 4 interprocedural
-concurrency rules encoding hazard classes this codebase has actually hit
-(see `tools/graftlint/rules.py` and `tools/graftlint/concurrency.py` for
-the catalogs and ISSUE/README for the history). Deliberately
+A repo-native static analyzer: 15 per-file AST rules plus 8 interprocedural
+rules — 4 concurrency (pass 2) and 4 array-provenance dataflow (pass 3) —
+encoding hazard classes this codebase has actually hit (see
+`tools/graftlint/rules.py`, `tools/graftlint/concurrency.py`, and
+`tools/graftlint/dataflow.py` for the catalogs and ISSUE/README for the
+history). Deliberately
 dependency-free — stdlib ``ast`` only, no jax import, so the lint gate
 costs ~a second cold and much less warm, and runs identically on a dev
 laptop and in the tier-1 pytest tier.
@@ -14,16 +16,18 @@ Mechanics:
   ``id``; a run parses each file once and hands the tree + a per-file
   `FileContext` (import-alias map, traced-scope set, suppression table)
   to every rule;
-- the interprocedural rules run as a second pass over per-file summaries
-  (`project.py` pass 1 → `concurrency.py` pass 2);
+- the interprocedural rules run over per-file summaries (`project.py`
+  pass 1 → `concurrency.py` pass 2 → `dataflow.py` pass 3);
 - **incremental cache**: per-file results (violations + project summary)
   persist under ``.graftlint_cache/`` keyed on (content hash, rule-set
   version, selected rules). The rule-set version hashes every
-  tools/graftlint source AND the three registry files (knobs /
-  failpoints / telemetry) the registry rules read, so editing a registry
-  invalidates every cached file. The pass-2 project analysis re-runs
-  every time from the (cached) summaries — it is repo-global by nature
-  and costs ~0.1 s;
+  tools/graftlint source — including `dataflow.py` and the provenance
+  event shapes in `project.py`, so a stale cache can never hide a
+  new-rule finding — AND the three registry files (knobs / failpoints /
+  telemetry) the registry rules read, so editing a registry invalidates
+  every cached file. The pass-2/3 project analyses re-run every time
+  from the (cached) summaries — they are repo-global by nature and cost
+  ~0.1 s;
 - ``--jobs N`` scans cache misses in parallel;
 - inline suppressions: ``# graftlint: disable=<rule>[,<rule>...]`` (or
   bare ``disable`` for all rules) on any physical line of the flagged
@@ -70,6 +74,9 @@ class Violation:
     snippet: str       # stripped source of the flagged line (baseline key)
     severity: str = "error"
     line_end: int = 0  # last physical line of the flagged node (0 = line)
+    col_end: int = 0   # 0-based end column (ast end_col_offset; 0 = unknown)
+                       # — SARIF regions carry it so GitHub annotations
+                       # underline the expression, not just its first char
 
     def span(self) -> range:
         return range(self.line, max(self.line_end, self.line) + 1)
@@ -99,7 +106,8 @@ class Rule:
         return Violation(rule=self.id, path=ctx.relpath, line=line,
                          col=getattr(node, "col_offset", 0), message=message,
                          snippet=ctx.line_text(line), severity=self.severity,
-                         line_end=getattr(node, "end_lineno", line) or line)
+                         line_end=getattr(node, "end_lineno", line) or line,
+                         col_end=getattr(node, "end_col_offset", 0) or 0)
 
 
 # ---------------------------------------------------------------------------
@@ -449,14 +457,16 @@ def lint_paths(paths=DEFAULT_PATHS, root: str = REPO_ROOT,
     file — in parallel when ``jobs`` > 1; the interprocedural pass runs
     over the per-file summaries every time (repo-global by nature).
 
-    ``project_rules``: None = all concurrency rules; [] = skip pass 2.
+    ``project_rules``: None = all interprocedural rules (pass-2
+    concurrency + pass-3 dataflow); [] = skip both passes.
     ``stats`` (optional dict) is filled with files/hits/misses counts.
     """
-    from .concurrency import PROJECT_RULES, check_project, in_scope
+    from .concurrency import (check_project, default_project_rules,
+                              in_scope)
 
     rules = rules if rules is not None else _all_rules()
     if project_rules is None:
-        project_rules = list(PROJECT_RULES)
+        project_rules = list(default_project_rules())
     cache_dir = cache_dir or CACHE_DIR
     version = ruleset_version(root)
     rules_sig = ",".join(sorted(r.id for r in rules))
@@ -595,9 +605,10 @@ def write_baseline(violations: list[Violation], path: str = BASELINE_PATH,
 # ---------------------------------------------------------------------------
 def _rule_catalog() -> list:
     from . import rules as rules_mod
-    from .concurrency import PROJECT_RULES
+    from .concurrency import default_project_rules
 
-    return [cls() for cls in tuple(rules_mod.ALL_RULES) + PROJECT_RULES]
+    return [cls() for cls in
+            tuple(rules_mod.ALL_RULES) + default_project_rules()]
 
 
 def render_sarif(violations: list[Violation]) -> str:
@@ -623,9 +634,16 @@ def render_sarif(violations: list[Violation]) -> str:
                 "message": {"text": v.message},
                 "locations": [{"physicalLocation": {
                     "artifactLocation": {"uri": v.path},
-                    "region": {"startLine": v.line,
-                               "startColumn": v.col + 1,
-                               "snippet": {"text": v.snippet}},
+                    # endLine/endColumn make GitHub underline the flagged
+                    # expression instead of a zero-width caret at its
+                    # start (endColumn is 1-based exclusive, so the
+                    # 0-based-exclusive ast end_col_offset maps via +1)
+                    "region": {**{"startLine": v.line,
+                                  "startColumn": v.col + 1,
+                                  "snippet": {"text": v.snippet}},
+                               **({"endLine": max(v.line_end, v.line),
+                                   "endColumn": v.col_end + 1}
+                                  if v.col_end > 0 else {})},
                 }}],
             } for v in violations],
         }],
@@ -639,8 +657,11 @@ def render_github(violations: list[Violation]) -> str:
     lines = []
     for v in violations:
         msg = v.message.replace("%", "%25").replace("\n", "%0A")
+        span = (f",endLine={max(v.line_end, v.line)},"
+                f"endColumn={v.col_end + 1}" if v.col_end > 0 else "")
         lines.append(f"::error file={v.path},line={v.line},"
-                     f"col={v.col + 1},title=graftlint {v.rule}::{msg}")
+                     f"col={v.col + 1}{span},title=graftlint "
+                     f"{v.rule}::{msg}")
     return "\n".join(lines)
 
 
@@ -651,7 +672,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     from . import rules as rules_mod
-    from .concurrency import PROJECT_RULES
+    from .concurrency import default_project_rules
 
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
@@ -685,7 +706,7 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     rules = [cls() for cls in rules_mod.ALL_RULES]
-    proj_rules = [cls() for cls in PROJECT_RULES]
+    proj_rules = [cls() for cls in default_project_rules()]
     if args.list_rules:
         for r in rules + proj_rules:
             print(f"{r.id:24} [{r.severity}] {r.doc}")

@@ -1,0 +1,638 @@
+"""graftlint pass 3 — array-provenance dataflow rules (20-23).
+
+The failure classes that actually kill TPU performance are not syntax-
+local: an implicit device→host transfer hides two calls away from the
+chunk loop, a row-sharded array meets a replicated one in a builder that
+never mentions sharding, a jitted callable quietly gets a fresh cache key
+every iteration, a donated buffer crosses a function boundary before it
+is read. These rules run over the repo-wide :class:`ProjectModel`
+(pass 1's symbol table + call graph) extended with the per-function
+**provenance event stream** (`project.py` — where values acquire a
+device placement or a host domain, which ops touch them, which calls
+carry them):
+
+20. host-transfer-in-hot-path — ``np.*`` / ``float()`` / ``.item()`` /
+    implicit-bool applied to a device-provenance value inside the HOT
+    sections (train chunk loop, MRTask dispatch, serving score path,
+    Cleaner sweep — the roots, closed over the call graph). Unlike the
+    per-file ``host-sync-in-trace`` rule this is interprocedural: a
+    device value handed to a helper that host-syncs its parameter flags
+    at the call site. The sanctioned spelling is an EXPLICIT
+    ``jax.device_get`` at a declared sync point (which the runtime twin
+    ``H2O_TPU_SANITIZE=transfers`` permits and implicit conversions
+    violate) — that is why ``device_get`` is never flagged.
+21. mixed-sharding-combine — a row-sharded and a replicated provenance
+    meeting in one host-level op: GSPMD silently inserts a resharding
+    collective. Inside ``shard_map``-traced bodies the mix is the
+    sanctioned shape (per-shard compute + replicated metadata) and is
+    exempt; so is an operand that was explicitly re-placed (a
+    ``mesh.put_*`` call is not a bare ref, so it never records).
+22. recompile-hazard — a jit/AOT cache key that cannot stabilize:
+    compiled-callable construction (``jax.jit`` / ``programs.tracked`` /
+    ``.lower(...)``) inside a loop; a per-iteration Python value in a
+    ``static_argnums`` position; a non-hashable container literal as a
+    static argument; a per-iteration comprehension argument (pytree
+    length churn) to a jit-bound callable. The runtime twin
+    (``H2O_TPU_SANITIZE=recompiles``) raises on the compile this rule
+    predicts.
+23. donate-across-calls — rule 18 made interprocedural. Donating
+    callables are discovered through the call graph (a factory returning
+    ``jax.jit(..., donate_argnums=...)`` marks every binding of its
+    result, across modules), donation propagates through tuple packs and
+    ``f(*args)`` star-dispatch, and a function that forwards a parameter
+    into a donated position is itself summarized as donating that
+    parameter — so the GBM chunk loop's ``train_fn(*step_args)`` margin
+    dispatch is lint-visible, not just test-pinned.
+
+All four stay deliberately under-approximate (an unknown provenance or
+an unresolved call produces no finding, never a wrong one); everything
+they DO flag is either fixed or baselined with a written reason — the
+empty-baseline discipline of rules 1-19.
+"""
+
+from __future__ import annotations
+
+from .concurrency import ProjectRule, in_scope
+from .project import ProjectModel
+
+#: provenance tags that mean "device-resident"
+_DEVICE_TAGS = {"row", "rep", "dev"}
+#: bounded recursion for interprocedural summaries (real chains are short)
+_DEPTH = 6
+
+#: the hot roots — (path suffix, function name, section label). Functions
+#: reachable from a root over the call graph inherit its label. These are
+#: the sections the runtime twin (`H2O_TPU_SANITIZE=transfers`) scopes a
+#: jax transfer guard over; the rule and the guard must name the same
+#: code or the static and dynamic stories diverge.
+HOT_ROOTS = (
+    ("parallel/mrtask.py", "_dispatch", "MRTask dispatch"),
+    ("models/gbm.py", "build_impl", "train chunk loop"),
+    ("serving/batcher.py", "_run", "serving batch worker"),
+    ("serving/scorer.py", "score", "serving score path"),
+    ("serving/scorer.py", "_score_bucket", "serving score path"),
+    ("serving/runtime.py", "score", "serving score path"),
+    ("backend/memory.py", "maybe_sweep", "Cleaner sweep"),
+    ("backend/memory.py", "emergency_sweep", "Cleaner sweep"),
+)
+
+
+class ProvInfo:
+    """Shared pass-3 analysis over one ProjectModel, computed lazily and
+    memoized per query (the rules below all read it). Attached to the
+    model object so the four rules share one instance per run."""
+
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        self._ret_tag: dict = {}
+        self._returns_don: dict = {}
+        self._ret_pack: dict = {}
+        self._donates_params: dict = {}
+        self._host_param: dict = {}
+        self.hot = self._hot_closure()
+
+    @classmethod
+    def of(cls, model: ProjectModel) -> "ProvInfo":
+        info = getattr(model, "_prov_info", None)
+        if info is None:
+            info = cls(model)
+            model._prov_info = info
+        return info
+
+    # -- basics ----------------------------------------------------------------
+    def events(self, key: str) -> list:
+        fn = self.model.functions.get(key)
+        return (fn or {}).get("prov") or []
+
+    def params(self, key: str) -> list:
+        fn = self.model.functions.get(key)
+        return (fn or {}).get("params") or []
+
+    def _resolve(self, key: str, kind: str, name: str) -> str | None:
+        return self.model.resolve_call(key, kind, name, None)
+
+    # -- hot closure -----------------------------------------------------------
+    def _hot_closure(self) -> dict:
+        roots: dict[str, str] = {}
+        for key, fn in self.model.functions.items():
+            for suffix, name, desc in HOT_ROOTS:
+                if fn["path"].endswith(suffix) and fn["name"] == name:
+                    roots[key] = desc
+        out = dict(roots)
+        stack = list(roots)
+        while stack:
+            cur = stack.pop()
+            fn = self.model.functions.get(cur)
+            if fn is None:
+                continue
+            for kind, name, recv, _g, _line in fn.get("calls", []):
+                tgt = self.model.resolve_call(cur, kind, name, recv)
+                if tgt is not None and tgt not in out:
+                    out[tgt] = out[cur]
+                    stack.append(tgt)
+        return out
+
+    # -- provenance tag env ----------------------------------------------------
+    def tag_walk(self, key: str, depth: int = _DEPTH):
+        """Yield (event, env) in line order for the flaggable events
+        (host/truth/combine/dcall), with ``env`` the {ref: tag} map at
+        that point. Phase order at one line: flags < unbind < bind."""
+        # sort key: (line, phase) — flags 0, unbind 1, src/bindcall 2
+        seq = []
+        for ev in self.events(key):
+            k = ev[0]
+            if k in ("host", "combine"):
+                seq.append((ev[3], 0, ev))
+            elif k == "truth":
+                seq.append((ev[2], 0, ev))
+            elif k == "dcall":
+                seq.append((ev[4], 0, ev))
+            elif k == "unbind":
+                seq.append((ev[2], 1, ev))
+            elif k in ("src", "bindcall"):
+                seq.append((ev[-1], 2, ev))
+        env: dict[str, str] = {}
+        for _line, _ph, ev in sorted(seq, key=lambda t: (t[0], t[1])):
+            k = ev[0]
+            if k == "unbind":
+                env.pop(ev[1], None)
+            elif k == "src":
+                env[ev[1]] = ev[2]
+            elif k == "bindcall":
+                tgt = self._resolve(key, ev[2], ev[3])
+                tag = (self.ret_tag(tgt, depth - 1)
+                       if tgt is not None and depth > 0 else None)
+                if tag is not None:
+                    env[ev[1]] = tag
+                else:
+                    env.pop(ev[1], None)
+            else:
+                yield ev, env
+
+    def ret_tag(self, key: str | None, depth: int = _DEPTH) -> str | None:
+        """Provenance tag of a function's return value, or None when
+        unknown/ambiguous (ambiguity never produces a finding)."""
+        if key is None or depth <= 0:
+            return None
+        if key in self._ret_tag:
+            return self._ret_tag[key]
+        self._ret_tag[key] = None  # recursion guard
+        tags = set()
+        env: dict[str, str] = {}
+        seq = []
+        for ev in self.events(key):
+            k = ev[0]
+            if k == "unbind":
+                seq.append((ev[2], 1, ev))
+            elif k in ("src", "bindcall"):
+                seq.append((ev[-1], 2, ev))
+            elif k in ("ret", "rettag", "retcall"):
+                seq.append((ev[-1], 0, ev))
+        for _line, _ph, ev in sorted(seq, key=lambda t: (t[0], t[1])):
+            k = ev[0]
+            if k == "unbind":
+                env.pop(ev[1], None)
+            elif k == "src":
+                env[ev[1]] = ev[2]
+            elif k == "bindcall":
+                tgt = self._resolve(key, ev[2], ev[3])
+                tag = self.ret_tag(tgt, depth - 1)
+                if tag is not None:
+                    env[ev[1]] = tag
+                else:
+                    env.pop(ev[1], None)
+            elif k == "rettag":
+                tags.add(ev[1])
+            elif k == "ret":
+                tags.add(env.get(ev[1]))
+            elif k == "retcall":
+                tgt = self._resolve(key, ev[1], ev[2])
+                tags.add(self.ret_tag(tgt, depth - 1))
+        out = tags.pop() if len(tags) == 1 else None
+        self._ret_tag[key] = out
+        return out
+
+    # -- host ops on parameters (rule 20 lookthrough) --------------------------
+    def host_param_ops(self, key: str | None) -> dict:
+        """{param name: (op, line)} — host-transfer ops a function applies
+        DIRECTLY to its own parameters (one lookthrough level)."""
+        if key is None:
+            return {}
+        if key in self._host_param:
+            return self._host_param[key]
+        params = set(self.params(key))
+        out = {}
+        for ev in self.events(key):
+            if ev[0] == "host" and ev[2] in params and ev[2] not in out:
+                out[ev[2]] = (ev[1], ev[3])
+        self._host_param[key] = out
+        return out
+
+    # -- donation summaries (rule 23) ------------------------------------------
+    def _lookup_chain(self, key: str):
+        """The function plus its lexical ancestors (closures read the
+        enclosing scope's bindings — `_dispatch` calling the parent's
+        `train_fn`)."""
+        yield key
+        fn = self.model.functions.get(key)
+        if fn is None:
+            return
+        path, qual = fn["path"], fn["qual"]
+        while "." in qual:
+            qual = qual.rsplit(".", 1)[0]
+            anc = f"{path}::{qual}"
+            if anc in self.model.functions:
+                yield anc
+
+    def donating_locals(self, key: str, depth: int = _DEPTH) -> dict:
+        """{local name: frozenset(donated positions)} in ONE function:
+        literal donating jit binds plus bindings from callees that return
+        a donating callable (factories, across modules). Memoized per
+        (key, depth) — lookup_donating replays it per dcall."""
+        memo = getattr(self, "_donating_memo", None)
+        if memo is None:
+            memo = self._donating_memo = {}
+        mk = (key, depth)
+        if mk in memo:
+            return memo[mk]
+        out: dict[str, frozenset] = {}
+        memo[mk] = out
+        for ev in self.events(key):
+            if ev[0] == "don":
+                out[ev[1]] = frozenset(ev[2])
+            elif ev[0] == "bindcall" and depth > 0:
+                tgt = self._resolve(key, ev[2], ev[3])
+                pos = self.returns_donating(tgt, depth - 1)
+                if pos:
+                    out[ev[1]] = pos
+        return out
+
+    def lookup_donating(self, key: str, name: str,
+                        depth: int = _DEPTH) -> frozenset | None:
+        for k in self._lookup_chain(key):
+            got = self.donating_locals(k, depth).get(name)
+            if got:
+                return got
+        return None
+
+    def returns_donating(self, key: str | None,
+                         depth: int = _DEPTH) -> frozenset:
+        """Donated positions of the callable a function RETURNS (empty
+        when it does not return one)."""
+        if key is None or depth <= 0:
+            return frozenset()
+        if key in self._returns_don:
+            return self._returns_don[key]
+        self._returns_don[key] = frozenset()  # recursion guard
+        locals_don = self.donating_locals(key, depth - 1)
+        out: frozenset = frozenset()
+        for ev in self.events(key):
+            if ev[0] == "ret" and ev[1] in locals_don:
+                out = out | locals_don[ev[1]]
+            elif ev[0] == "retcall":
+                tgt = self._resolve(key, ev[1], ev[2])
+                out = out | self.returns_donating(tgt, depth - 1)
+        self._returns_don[key] = out
+        return out
+
+    def ret_pack(self, key: str | None) -> dict:
+        """{tuple position: param index} for functions returning a packed
+        tuple that carries their own parameters (`_step_args`)."""
+        if key is None:
+            return {}
+        if key in self._ret_pack:
+            return self._ret_pack[key]
+        params = {p: i for i, p in enumerate(self.params(key))}
+        packs: dict[str, list] = {}
+        out: dict[int, int] = {}
+        for ev in self.events(key):
+            if ev[0] == "pack":
+                packs[ev[1]] = list(ev[2])
+            elif ev[0] == "packext":
+                if ev[1] in packs:
+                    packs[ev[1]].extend(ev[2])
+            elif ev[0] == "retpack":
+                for pos, ref in enumerate(ev[1]):
+                    if ref in params:
+                        out[pos] = params[ref]
+            elif ev[0] == "ret" and ev[1] in packs:
+                for pos, ref in enumerate(packs[ev[1]]):
+                    if ref in params:
+                        out[pos] = params[ref]
+        self._ret_pack[key] = out
+        return out
+
+    def donates_params(self, key: str | None,
+                       depth: int = _DEPTH) -> frozenset:
+        """Parameter indices a CALL to this function donates (the
+        function forwards them into a donated position)."""
+        if key is None or depth <= 0:
+            return frozenset()
+        if key in self._donates_params:
+            return self._donates_params[key]
+        self._donates_params[key] = frozenset()  # recursion guard
+        params = {p: i for i, p in enumerate(self.params(key))}
+        out = set()
+        for _site, donated in self._donation_sites(key, depth - 1):
+            for name in donated:
+                if name in params:
+                    out.add(params[name])
+        self._donates_params[key] = frozenset(out)
+        return frozenset(out)
+
+    def _donation_sites(self, key: str, depth: int = _DEPTH) -> list:
+        """[( (line, col, endline, endcol), [donated names] )] — every
+        dcall in ``key`` that donates arguments, with the names donated.
+        Memoized per (key, depth)."""
+        memo = getattr(self, "_sites_memo", None)
+        if memo is None:
+            memo = self._sites_memo = {}
+        mk = (key, depth)
+        if mk in memo:
+            return memo[mk]
+        memo[mk] = []
+        packs: dict[str, list] = {}
+        bindcalls: dict[str, tuple] = {}
+        out = []
+        for ev in self.events(key):
+            if ev[0] == "pack":
+                packs[ev[1]] = list(ev[2])
+            elif ev[0] == "packext" and ev[1] in packs:
+                packs[ev[1]].extend(ev[2])
+            elif ev[0] == "bindcall":
+                bindcalls[ev[1]] = (ev[2], ev[3], ev[4])
+            elif ev[0] == "dcall":
+                kind, name, descs = ev[1], ev[2], ev[3]
+                ln, col, eln, ecol = ev[4], ev[5], ev[6], ev[7]
+                positions = None
+                callee_offset = 0
+                if kind == "name":
+                    positions = self.lookup_donating(key, name, depth)
+                if positions is None:
+                    tgt = self._resolve(key, kind, name)
+                    pp = self.donates_params(tgt, depth)
+                    if pp:
+                        cparams = self.params(tgt)
+                        callee_offset = (1 if cparams
+                                         and cparams[0] == "self"
+                                         and kind in ("self", "attr")
+                                         else 0)
+                        positions = frozenset(p - callee_offset
+                                              for p in pp
+                                              if p >= callee_offset)
+                if not positions:
+                    continue
+                donated = []
+                star = next((d for d in descs if d[0] == "star"), None)
+                if star is not None and star[1]:
+                    elts = packs.get(star[1])
+                    if elts is None and star[1] in bindcalls:
+                        bkind, bname, bargs = bindcalls[star[1]]
+                        btgt = self._resolve(key, bkind, bname)
+                        rp = self.ret_pack(btgt)
+                        elts = {}
+                        for pos, pidx in rp.items():
+                            if pidx < len(bargs) and bargs[pidx]:
+                                elts[pos] = bargs[pidx]
+                        elts = [elts.get(i) for i in
+                                range(max(elts, default=-1) + 1)]
+                    if elts:
+                        for p in positions:
+                            if p < len(elts) and elts[p]:
+                                donated.append(elts[p])
+                else:
+                    for p in positions:
+                        if p < len(descs) and descs[p][0] == "name" \
+                                and descs[p][1]:
+                            donated.append(descs[p][1])
+                if donated:
+                    out.append(((ln, col, eln, ecol), donated))
+        memo[mk] = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule 20 — host-transfer-in-hot-path
+# ---------------------------------------------------------------------------
+class HostTransferInHotPath(ProjectRule):
+    id = "host-transfer-in-hot-path"
+    doc = ("np.*/float()/.item()/implicit-bool on a device-provenance "
+           "value inside a hot section (train chunk loop, MRTask "
+           "dispatch, serving score path, Cleaner sweep) — each one is a "
+           "blocking device->host sync per iteration; use an explicit "
+           "jax.device_get at a declared sync point")
+
+    def check(self, model: ProjectModel) -> list:
+        info = ProvInfo.of(model)
+        out = []
+        for key in sorted(info.hot):
+            fn = model.functions.get(key)
+            if fn is None or not in_scope(fn["path"]):
+                continue
+            root = info.hot[key]
+            for ev, env in info.tag_walk(key):
+                if ev[0] == "host" and env.get(ev[2]) in _DEVICE_TAGS:
+                    out.append((fn["path"], ev[3],
+                                f"{ev[1]} on device-provenance value "
+                                f"'{ev[2]}' inside the {root} hot path — "
+                                f"an implicit device->host sync per "
+                                f"iteration; fetch once via an explicit "
+                                f"jax.device_get at a declared sync "
+                                f"point (H2O_TPU_SANITIZE=transfers is "
+                                f"the runtime twin)",
+                                ev[4], ev[5]))
+                elif ev[0] == "truth" and env.get(ev[1]) in _DEVICE_TAGS:
+                    out.append((fn["path"], ev[2],
+                                f"implicit bool() of device-provenance "
+                                f"value '{ev[1]}' inside the {root} hot "
+                                f"path — a hidden device->host sync; "
+                                f"read it once explicitly",
+                                ev[3], ev[4]))
+                elif ev[0] == "dcall":
+                    tgt = info._resolve(key, ev[1], ev[2])
+                    hp = info.host_param_ops(tgt)
+                    if not hp:
+                        continue
+                    cparams = info.params(tgt)
+                    off = (1 if cparams and cparams[0] == "self"
+                           and ev[1] in ("self", "attr") else 0)
+                    for i, d in enumerate(ev[3]):
+                        if d[0] != "name" or env.get(d[1]) \
+                                not in _DEVICE_TAGS:
+                            continue
+                        pidx = i + off
+                        if pidx < len(cparams) \
+                                and cparams[pidx] in hp:
+                            op, _l = hp[cparams[pidx]]
+                            out.append((
+                                fn["path"], ev[4],
+                                f"device-provenance value '{d[1]}' "
+                                f"passed to {ev[2]}(), which applies "
+                                f"{op} to it — an implicit device->"
+                                f"host sync hidden one call below the "
+                                f"{root} hot path",
+                                ev[5], ev[7]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule 21 — mixed-sharding-combine
+# ---------------------------------------------------------------------------
+class MixedShardingCombine(ProjectRule):
+    id = "mixed-sharding-combine"
+    doc = ("row-sharded and replicated provenance meeting in one host-"
+           "level op outside shard_map — GSPMD silently inserts a "
+           "resharding collective; re-place one operand via mesh.put_* "
+           "or move the op into shard_map")
+
+    def check(self, model: ProjectModel) -> list:
+        info = ProvInfo.of(model)
+        out = []
+        for key in sorted(model.functions):
+            fn = model.functions[key]
+            if not in_scope(fn["path"]):
+                continue
+            for ev, env in info.tag_walk(key):
+                if ev[0] != "combine":
+                    continue
+                tags = {env.get(ev[1]), env.get(ev[2])}
+                if tags == {"row", "rep"}:
+                    out.append((fn["path"], ev[3],
+                                f"row-sharded '{ev[1] if env.get(ev[1]) == 'row' else ev[2]}' "
+                                f"combined with replicated "
+                                f"'{ev[2] if env.get(ev[2]) == 'rep' else ev[1]}' "
+                                f"outside shard_map — GSPMD will "
+                                f"silently reshard one side per call; "
+                                f"re-place one operand (mesh.put_*) or "
+                                f"move the op into shard_map",
+                                ev[4], ev[5]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule 22 — recompile-hazard
+# ---------------------------------------------------------------------------
+class RecompileHazard(ProjectRule):
+    id = "recompile-hazard"
+    doc = ("jit cache key that cannot stabilize: jit/tracked/.lower "
+           "construction inside a loop, a per-iteration Python value or "
+           "non-hashable literal in a static_argnums position, or a "
+           "per-iteration comprehension argument — every call compiles; "
+           "H2O_TPU_SANITIZE=recompiles raises on the compile this "
+           "predicts")
+
+    def check(self, model: ProjectModel) -> list:
+        out = []
+        for key in sorted(model.functions):
+            fn = model.functions[key]
+            if not in_scope(fn["path"]):
+                continue
+            jit_static: dict[str, list] = {}
+            for ev in (fn.get("prov") or []):
+                if ev[0] == "jit":
+                    jit_static[ev[1]] = list(ev[2])
+                elif ev[0] == "don":
+                    jit_static.setdefault(ev[1], [])
+                elif ev[0] == "jitloop":
+                    what = ("jax.jit/programs.tracked" if ev[1] == "jit"
+                            else ".lower(...)")
+                    out.append((fn["path"], ev[2],
+                                f"{what} constructed inside a loop — a "
+                                f"fresh callable per iteration gets a "
+                                f"fresh compile cache entry every time; "
+                                f"hoist the construction out of the "
+                                f"loop", ev[3], ev[4]))
+                elif ev[0] == "dcall" and ev[1] == "name" \
+                        and ev[2] in jit_static:
+                    descs = ev[3]
+                    ln, col, ecol = ev[4], ev[5], ev[7]
+                    for p in jit_static[ev[2]]:
+                        if p >= len(descs):
+                            continue
+                        d = descs[p]
+                        if d[0] == "name" and d[2]:
+                            out.append((
+                                fn["path"], ln,
+                                f"per-iteration value '{d[1]}' in "
+                                f"static_argnums position {p} of "
+                                f"jitted '{ev[2]}' — a new cache key "
+                                f"(and a recompile) every call; make "
+                                f"it a traced argument or hoist it",
+                                col, ecol))
+                        elif d[0] in ("list", "dict", "set"):
+                            out.append((
+                                fn["path"], ln,
+                                f"non-hashable {d[0]} literal in "
+                                f"static_argnums position {p} of "
+                                f"jitted '{ev[2]}' — jit static "
+                                f"arguments must be hashable (this "
+                                f"raises, or worse: a tuple-ified "
+                                f"copy keys the cache per identity)",
+                                col, ecol))
+                    for i, d in enumerate(descs):
+                        if d[0] == "comp" and d[2]:
+                            out.append((
+                                fn["path"], ln,
+                                f"per-iteration comprehension as "
+                                f"argument {i} of jitted '{ev[2]}' — "
+                                f"pytree length churn gives a new "
+                                f"cache key whenever the length "
+                                f"moves; pad to a fixed shape or "
+                                f"hoist", col, ecol))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule 23 — donate-across-calls
+# ---------------------------------------------------------------------------
+class DonateAcrossCalls(ProjectRule):
+    id = "donate-across-calls"
+    doc = ("variable read after riding a donated position across a call "
+           "boundary — donating factories resolve through the call "
+           "graph, donation propagates through tuple packs and f(*args) "
+           "star-dispatch, and param-forwarding helpers summarize as "
+           "donating; rule 18's file-local analysis made "
+           "interprocedural")
+
+    def check(self, model: ProjectModel) -> list:
+        info = ProvInfo.of(model)
+        out = []
+        for key in sorted(model.functions):
+            fn = model.functions[key]
+            if not in_scope(fn["path"]):
+                continue
+            sites = info._donation_sites(key)
+            if not sites:
+                continue
+            seq = []
+            for (ln, col, eln, ecol), donated in sites:
+                for name in donated:
+                    seq.append((eln, 1, ("don", name, ln)))
+            for ev in info.events(key):
+                if ev[0] == "use":
+                    seq.append((ev[2], 0, ("use", ev[1], ev[2], ev[3],
+                                           ev[4])))
+                elif ev[0] == "kill":
+                    seq.append((ev[2], 2, ("kill", ev[1])))
+            donated_now: dict[str, int] = {}
+            for _line, _ph, item in sorted(seq, key=lambda t: (t[0],
+                                                               t[1])):
+                if item[0] == "use" and item[1] in donated_now:
+                    out.append((fn["path"], item[2],
+                                f"read of '{item[1]}' after it rode a "
+                                f"donated position across a call "
+                                f"boundary (donated at line "
+                                f"{donated_now[item[1]]}) — the buffer "
+                                f"is deleted at dispatch; rebind the "
+                                f"result or copy before dispatching",
+                                item[3], item[4]))
+                    del donated_now[item[1]]
+                elif item[0] == "don":
+                    donated_now[item[1]] = item[2]
+                elif item[0] == "kill":
+                    donated_now.pop(item[1], None)
+        return out
+
+
+DATAFLOW_RULES = (HostTransferInHotPath, MixedShardingCombine,
+                  RecompileHazard, DonateAcrossCalls)

@@ -43,6 +43,17 @@ do not apply), inheriting the enclosing class context so a worker closure
 that captures ``self`` still attributes its field accesses to the class
 (the `Job.start._run` shape).
 
+Pass 3 (``tools/graftlint/dataflow.py``) consumes an additional per-
+function **provenance event stream** extracted here: where values acquire
+a device placement (``mesh.put_*``, ``BinnedView.build``, ``jnp.*``) or a
+host domain (``np.*``, ``device_get``), which host-transfer ops touch
+them, which calls carry them (with positional argument refs, so donation
+and placement can be traced ACROSS functions), and which jitted callables
+are constructed/called where. The events are deliberately shallow —
+plain-name and ``self.attr`` refs only, last-bind-wins — so the dataflow
+rules stay under-approximate the same way the call graph is: a missing
+tag produces no finding, never a wrong one.
+
 Stdlib ``ast`` only — the linter never imports the package it lints.
 """
 
@@ -51,10 +62,11 @@ from __future__ import annotations
 import ast
 import os
 
-from .core import collect_aliases, normalize, dotted_name
+from .core import collect_aliases, normalize, dotted_name, traced_scopes
 
 #: bump when the summary shape changes — the incremental cache keys on it
-SUMMARY_FORMAT = 3
+#: (4: the pass-3 provenance event stream / params / traced flags)
+SUMMARY_FORMAT = 4
 
 #: constructors whose result is a lock-like guard (Condition guards too:
 #: `with self._cv:` owns the underlying lock)
@@ -140,6 +152,442 @@ class _FnState:
                 "root_hints": self.root_hints}
 
 
+# ---------------------------------------------------------------------------
+# pass-3 provenance extraction (consumed by tools/graftlint/dataflow.py)
+# ---------------------------------------------------------------------------
+#: attribute spellings the frame layer uses for device-resident payloads —
+#: `arr = vec._data` / `codes = view.codes` taints the local as device
+_DEVICE_ATTRS = {"data", "_data", "codes"}
+
+#: host-cast builtins (flagged only on device-tagged operands)
+_HOST_CASTS = {"float", "int", "bool"}
+
+
+def _ref_of(node) -> str | None:
+    """'x' for a Name, 'self.x' for a self-attribute, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    attr = _self_attr(node)
+    if attr is not None:
+        return f"self.{attr}"
+    return None
+
+
+def _static_valued(node) -> bool:
+    """Trace/host-static expressions: literals or anything derived from
+    .shape/.ndim/len() — python values, never a device sync."""
+    if isinstance(node, ast.Constant):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype", "itemsize", "nbytes"):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"):
+            return True
+    return False
+
+
+def _int_positions(value) -> list:
+    """Sorted int literals out of an int / tuple-of-ints AST value."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, int):
+        return [value.value]
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return sorted(e.value for e in value.elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, int))
+    return []
+
+
+class _ProvVisitor:
+    """One function body → the provenance event stream. Walks statements
+    in order WITHOUT entering nested function scopes (each nested scope
+    gets its own stream); maintains the active-loop stack so per-iteration
+    bindings are distinguishable from loop-invariant ones."""
+
+    def __init__(self, aliases: dict, traced: bool):
+        self.aliases = aliases
+        self.traced = traced
+        self.events: list = []
+        self._loops: list[set] = []      # stack of loop-assigned name sets
+        self._uses: list = []            # raw Name loads, filtered at end
+        self._kills: list = []           # raw stores, filtered at end
+        self._interesting: set = set()   # dcall args + pack elts
+
+    # -- source classification -------------------------------------------------
+    def _norm(self, node) -> str | None:
+        return normalize(dotted_name(node), self.aliases)
+
+    def _src_tag(self, value) -> str | None:
+        """Provenance tag of a bound expression: 'row'/'rep'/'dev'/'host',
+        or None when unknown (unknown never produces a finding)."""
+        if isinstance(value, ast.Attribute) and value.attr in _DEVICE_ATTRS:
+            return "dev"
+        if not isinstance(value, ast.Call):
+            return None
+        fn = self._norm(value.func) or ""
+        tail = fn.rsplit(".", 1)[-1]
+        if tail == "put_row_sharded" or fn.endswith("BinnedView.build"):
+            return "row"
+        if tail == "put_replicated":
+            return "rep"
+        if fn == "jax.device_put":
+            # refine by the sharding argument when it names a mesh helper
+            target = value.args[1] if len(value.args) >= 2 else None
+            for kw in value.keywords:
+                if kw.arg in ("device", "sharding"):
+                    target = kw.value
+            if isinstance(target, ast.Call):
+                t = (self._norm(target.func) or "").rsplit(".", 1)[-1]
+                if t == "row_sharding":
+                    return "row"
+                if t == "replicated":
+                    return "rep"
+            return "dev"
+        if (fn.startswith(("jnp.", "lax."))
+                or tail in ("put_sharded", "mr_map", "mr_reduce")):
+            return "dev"
+        if (fn.startswith("np.") or fn == "jax.device_get"
+                or tail in ("to_numpy", "tolist")):
+            return "host"
+        return None
+
+    def _callee(self, func) -> tuple | None:
+        """(kind, name) for a call's callee — kinds match
+        ProjectModel.resolve_call; bare names imported from another module
+        resolve through the alias map into 'dotted' form."""
+        if isinstance(func, ast.Name):
+            full = self.aliases.get(func.id)
+            if full and "." in full:
+                return ("dotted", full)
+            return ("name", func.id)
+        a = _self_attr(func)
+        if a is not None:
+            return ("self", a)
+        dn = self._norm(func)
+        if dn and "." in dn:
+            return ("dotted", dn)
+        if isinstance(func, ast.Attribute):
+            return ("attr", func.attr)
+        return None
+
+    def _loopvar(self, node) -> bool:
+        """Does the expression read any name assigned inside an enclosing
+        loop (i.e. vary per iteration)?"""
+        if not self._loops:
+            return False
+        live = set().union(*self._loops)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in live:
+                return True
+        return False
+
+    def _span(self, node) -> tuple:
+        return (node.lineno, node.col_offset,
+                getattr(node, "end_lineno", node.lineno) or node.lineno,
+                getattr(node, "end_col_offset", 0) or 0)
+
+    # -- binding classification ------------------------------------------------
+    def _donate_positions(self, value) -> list:
+        """Donated positions of a LITERAL donating jit bind (IfExp arms
+        unioned — donation assumed when any arm donates, rule 18's
+        convention)."""
+        if isinstance(value, ast.IfExp):
+            return sorted(set(self._donate_positions(value.body))
+                          | set(self._donate_positions(value.orelse)))
+        if not isinstance(value, ast.Call):
+            return []
+        fn = self._norm(value.func) or ""
+        if not (fn.endswith("jax.jit") or fn == "jit"):
+            return []
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                return _int_positions(kw.value)
+        return []
+
+    def _first_call(self, value):
+        if isinstance(value, ast.IfExp):
+            return self._first_call(value.body) or \
+                self._first_call(value.orelse)
+        return value if isinstance(value, ast.Call) else None
+
+    def _bind(self, target: str, value, line: int) -> None:
+        tag = self._src_tag(value)
+        if tag is not None:
+            self.events.append(["src", target, tag, line])
+            return
+        don = self._donate_positions(value)
+        if don:
+            self.events.append(["don", target, don, line])
+        call = self._first_call(value)
+        if isinstance(call, ast.Call):
+            fn = self._norm(call.func) or ""
+            if fn.endswith("jax.jit") or fn == "jit":
+                static: list = []
+                for kw in call.keywords:
+                    # merge across both spellings — static_argnames yields
+                    # no int positions, and must not ERASE static_argnums'
+                    if kw.arg in ("static_argnums", "static_argnames"):
+                        static += _int_positions(kw.value)
+                self.events.append(["jit", target, sorted(set(static)),
+                                    line])
+                return
+            callee = self._callee(call.func)
+            if callee is not None:
+                argrefs = [(_ref_of(a) if not isinstance(a, ast.Starred)
+                            else None) for a in call.args]
+                self.events.append(["bindcall", target, callee[0],
+                                    callee[1], argrefs, line])
+            return
+        if isinstance(value, (ast.Tuple, ast.List)):
+            elts = [_ref_of(e) for e in value.elts]
+            self.events.append(["pack", target, elts, line])
+            self._interesting.update(e for e in elts if e)
+
+    # -- statement walk --------------------------------------------------------
+    def walk(self, stmts: list) -> list:
+        for s in stmts:
+            self._stmt(s)
+        # finalize: filter use/kill streams to the names the donation
+        # analysis can actually reason about (dcall args + pack elements)
+        keep = self._interesting
+        for name, line, col, ecol in self._uses:
+            if name in keep:
+                self.events.append(["use", name, line, col, ecol])
+        for name, endline in self._kills:
+            if name in keep:
+                self.events.append(["kill", name, endline])
+        return self.events
+
+    def _stmt(self, s) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return  # nested scopes extracted on their own
+        # stores clear donated state at the STATEMENT's end (RHS evaluates
+        # before targets bind — `f, o = step(x, f)` is the clean idiom).
+        # Synthesized wrappers (a lambda body re-boxed as an Expr) carry
+        # no position of their own
+        self._stmt_end = (getattr(s, "end_lineno", None)
+                          or getattr(s, "lineno", 0) or 0)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            assigned = {n.id for n in ast.walk(s.target)
+                        if isinstance(n, ast.Name)}
+            for sub in ast.walk(s):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    assigned.add(sub.id)
+            self._scan_expr(s.iter)
+            self._loops.append(assigned)
+            for b in s.body + s.orelse:
+                self._stmt(b)
+            self._loops.pop()
+            for n in ast.walk(s.target):
+                if isinstance(n, ast.Name):
+                    self._kills.append((n.id, s.lineno))
+            return
+        if isinstance(s, ast.While):
+            assigned = {n.id for n in ast.walk(s)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, (ast.Store, ast.Del))}
+            self._truth(s.test)
+            self._scan_expr(s.test)
+            self._loops.append(assigned)
+            for b in s.body + s.orelse:
+                self._stmt(b)
+            self._loops.pop()
+            return
+        if isinstance(s, ast.If):
+            self._truth(s.test)
+            self._scan_expr(s.test)
+            for b in s.body + s.orelse:
+                self._stmt(b)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._scan_expr(item.context_expr)
+            for b in s.body:
+                self._stmt(b)
+            return
+        if isinstance(s, ast.Try):
+            for b in s.body + s.orelse + s.finalbody:
+                self._stmt(b)
+            for h in s.handlers:
+                for b in h.body:
+                    self._stmt(b)
+            return
+        if isinstance(s, ast.Return):
+            if s.value is not None:
+                self._scan_expr(s.value)
+                ref = _ref_of(s.value)
+                if ref is not None:
+                    self.events.append(["ret", ref, s.lineno])
+                elif isinstance(s.value, (ast.Tuple, ast.List)):
+                    self.events.append(
+                        ["retpack", [_ref_of(e) for e in s.value.elts],
+                         s.lineno])
+                elif isinstance(s.value, ast.Call):
+                    tag = self._src_tag(s.value)
+                    if tag is not None:
+                        self.events.append(["rettag", tag, s.lineno])
+                    else:
+                        callee = self._callee(s.value.func)
+                        if callee is not None:
+                            self.events.append(
+                                ["retcall", callee[0], callee[1],
+                                 s.lineno])
+            return
+        if isinstance(s, ast.Assign):
+            # rebinds drop stale provenance tags FIRST (phase order in the
+            # pass-3 env walk: flag < unbind < bind at the same line) — a
+            # stale tag could otherwise fabricate a finding. Anchored at
+            # the statement's FIRST line, same as the bind: on a wrapped
+            # `v = mesh.put_*(\n x)` an end-line unbind would sort after
+            # the bind and erase the tag the statement just established
+            for t in s.targets:
+                for n in ast.walk(t):
+                    ref = _ref_of(n)
+                    if ref is not None and not isinstance(
+                            getattr(n, "ctx", None), ast.Load):
+                        self.events.append(["unbind", ref, s.lineno])
+            if len(s.targets) == 1:
+                tgt = _ref_of(s.targets[0])
+                if tgt is not None:
+                    self._bind(tgt, s.value, s.lineno)
+        if isinstance(s, ast.AugAssign) and isinstance(s.op, ast.Add) \
+                and isinstance(s.target, ast.Name) \
+                and isinstance(s.value, (ast.Tuple, ast.List)):
+            # `args += (x,)` — tuple append preserves existing positions
+            self.events.append(["packext", s.target.id,
+                                [_ref_of(e) for e in s.value.elts],
+                                s.lineno])
+            self._interesting.update(_ref_of(e) for e in s.value.elts
+                                     if _ref_of(e))
+        self._scan_expr(s)
+
+    def _truth(self, test) -> None:
+        """Implicit-bool reads: `if x:` / `while x:` / `if not x:` /
+        BoolOp operands that are bare refs."""
+        nodes = [test]
+        if isinstance(test, ast.BoolOp):
+            nodes = list(test.values)
+        elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            nodes = [test.operand]
+        for n in nodes:
+            ref = _ref_of(n)
+            if ref is not None:
+                ln, col, _eln, ecol = self._span(n)
+                self.events.append(["truth", ref, ln, col, ecol])
+
+    def _scan_expr(self, root) -> None:
+        """Event extraction from one statement's expressions, skipping
+        nested function scopes."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    ln, col, eln, ecol = self._span(node)
+                    self._uses.append((node.id, ln, col, ecol))
+                else:
+                    self._kills.append(
+                        (node.id,
+                         getattr(self, "_stmt_end", None)
+                         or getattr(node, "end_lineno", node.lineno)
+                         or node.lineno))
+            elif isinstance(node, ast.BinOp) and not self.traced:
+                lref, rref = _ref_of(node.left), _ref_of(node.right)
+                if lref and rref:
+                    ln, col, eln, ecol = self._span(node)
+                    self.events.append(
+                        ["combine", lref, rref, ln, col, ecol])
+            elif isinstance(node, ast.Call):
+                self._call(node)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _argdesc(self, a) -> list:
+        if isinstance(a, ast.Starred):
+            return ["star", _ref_of(a.value), False]
+        ref = _ref_of(a)
+        if ref is not None:
+            return ["name", ref, self._loopvar(a)]
+        if isinstance(a, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            return ["comp", None, self._loopvar(a)]
+        if isinstance(a, ast.List):
+            return ["list", None, self._loopvar(a)]
+        if isinstance(a, ast.Dict):
+            return ["dict", None, self._loopvar(a)]
+        if isinstance(a, ast.Set):
+            return ["set", None, self._loopvar(a)]
+        if isinstance(a, ast.Constant):
+            return ["const", None, False]
+        return ["other", None, self._loopvar(a)]
+
+    def _call(self, node: ast.Call) -> None:
+        fn = self._norm(node.func) or ""
+        ln, col, eln, ecol = self._span(node)
+        # compiled-callable construction inside a loop (rule 22): a fresh
+        # jit / tracked wrapper / AOT lower per iteration compiles every
+        # time (the jit cache is keyed on the callable's identity)
+        if self._loops:
+            is_jit_ctor = (fn.endswith("jax.jit") or fn == "jit"
+                           or fn.endswith("programs.tracked"))
+            is_lower = (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "lower" and bool(node.args))
+            if is_jit_ctor or is_lower:
+                what = "jit" if is_jit_ctor else "lower"
+                self.events.append(["jitloop", what, ln, col, ecol])
+        # host-transfer ops (rule 20) — explicit jax.device_get is the
+        # sanctioned spelling and deliberately NOT recorded here
+        ref = None
+        op = None
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _HOST_CASTS and node.args \
+                and not _static_valued(node.args[0]):
+            ref = _ref_of(node.args[0])
+            op = f"{node.func.id}()"
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in ("item", "tolist")):
+            ref = _ref_of(node.func.value)
+            op = f".{node.func.attr}()"
+        elif fn.startswith("np.") and node.args:
+            ref = _ref_of(node.args[0])
+            op = fn
+        if ref is not None and op is not None:
+            self.events.append(["host", op, ref, ln, col, ecol])
+        # calls with traceable positional refs (rules 22/23): the callee
+        # IfExp form `(a if c else b)(*args)` records one dcall per arm
+        callees = []
+        if isinstance(node.func, ast.IfExp):
+            for arm in (node.func.body, node.func.orelse):
+                if isinstance(arm, ast.Name):
+                    callees.append(("name", arm.id))
+        else:
+            c = self._callee(node.func)
+            if c is not None:
+                callees.append(c)
+        if not callees or not node.args:
+            return
+        descs = [self._argdesc(a) for a in node.args]
+        if not any(d[0] in ("name", "star", "list", "dict", "set", "comp")
+                   for d in descs):
+            return
+        for kind, name in callees:
+            self.events.append(["dcall", kind, name, descs, ln, col, eln,
+                                ecol])
+        for d in descs:
+            if d[0] in ("name", "star") and d[1]:
+                self._interesting.add(d[1])
+
+
+def _extract_prov(body: list, aliases: dict, traced: bool) -> list:
+    return _ProvVisitor(aliases, traced).walk(body)
+
+
 class _Extractor:
     """Per-file AST walk → FileSummary dict."""
 
@@ -150,7 +598,17 @@ class _Extractor:
         self.module_locks: set[str] = set()
         self.functions: dict[str, dict] = {}
         self.classes: dict[str, dict] = {}
+        #: function/lambda nodes under a jax trace — pass-3 skips combine
+        #: events in them (in-shard_map mixing is the sanctioned shape)
+        self.traced_nodes = traced_scopes(tree, self.aliases)
         self._collect_module_locks()
+
+    @staticmethod
+    def _params_of(node) -> list:
+        args = getattr(node, "args", None)
+        if args is None:
+            return []
+        return [a.arg for a in getattr(args, "posonlyargs", []) + args.args]
 
     def _collect_module_locks(self) -> None:
         for node in self.tree.body:
@@ -217,7 +675,9 @@ class _Extractor:
                 if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     st = self._extract_scope(
                         sub.body, f"{qual}.{sub.name}", node.name, sub.name,
-                        sub.lineno, class_locks=locks, class_syncs=syncs)
+                        sub.lineno, class_locks=locks, class_syncs=syncs,
+                        params=self._params_of(sub),
+                        traced=sub in self.traced_nodes)
                     if handler and sub.name.startswith("do_"):
                         st.root_hints.append("rest-handler")
                     self.classes[node.name]["methods"].append(sub.name)
@@ -227,7 +687,9 @@ class _Extractor:
             self._extract_scope(node.body, f"{prefix}{node.name}", cls_ctx,
                                 node.name, node.lineno,
                                 class_locks=class_locks or set(),
-                                class_syncs=class_syncs or set())
+                                class_syncs=class_syncs or set(),
+                                params=self._params_of(node),
+                                traced=node in self.traced_nodes)
         elif isinstance(node, (ast.If, ast.Try, ast.With)):
             for sub in ast.iter_child_nodes(node):
                 self._walk_top(sub, prefix, cls_ctx, class_locks,
@@ -236,11 +698,16 @@ class _Extractor:
     # -- one function body ----------------------------------------------------
     def _extract_scope(self, body: list, qual: str, cls: str | None,
                        name: str, line: int, *, class_locks: set,
-                       class_syncs: set) -> _FnState:
+                       class_syncs: set, params=(),
+                       traced: bool = False) -> _FnState:
         st = _FnState(qual, cls, name, line)
         self._nested: list[tuple] = []
         self._walk_block(body, (), st, class_locks, class_syncs)
-        self.functions[qual] = st.summary()
+        summary = st.summary()
+        summary["params"] = list(params)
+        summary["traced"] = bool(traced)
+        summary["prov"] = _extract_prov(body, self.aliases, traced)
+        self.functions[qual] = summary
         # nested defs extracted AFTER the parent (guards do not inherit:
         # a closure body runs when called, not where defined)
         for sub, subqual in self._pop_nested():
@@ -251,7 +718,9 @@ class _Extractor:
                                 subqual.rsplit(".", 1)[-1],
                                 getattr(sub, "lineno", line),
                                 class_locks=class_locks,
-                                class_syncs=class_syncs)
+                                class_syncs=class_syncs,
+                                params=self._params_of(sub),
+                                traced=sub in self.traced_nodes)
         return st
 
     def _pop_nested(self):
@@ -607,6 +1076,21 @@ class ProjectModel:
     # -- resolution -----------------------------------------------------------
     def resolve_call(self, caller_key: str, kind: str, name: str,
                      recv: str | None) -> str | None:
+        """Memoized — the dataflow pass resolves the same (caller, callee)
+        pairs once per summary query, and the dotted suffix-scan is the
+        single hottest operation of a warm full-repo run."""
+        cache = getattr(self, "_resolve_cache", None)
+        if cache is None:
+            cache = self._resolve_cache = {}
+        ck = (caller_key, kind, name, recv)
+        if ck in cache:
+            return cache[ck]
+        out = self._resolve_call(caller_key, kind, name, recv)
+        cache[ck] = out
+        return out
+
+    def _resolve_call(self, caller_key: str, kind: str, name: str,
+                      recv: str | None) -> str | None:
         fn = self.functions.get(caller_key)
         if fn is None:
             return None
@@ -619,10 +1103,24 @@ class ProjectModel:
                 return f"{path}::{prefix}.{name}"
             return self._unique_method(name)
         if kind == "name":
-            # nested def of the same function, then module function
-            key = f"{path}::{fn['qual']}.{name}"
-            if key in self.functions:
-                return key
+            # own nested def, then lexical ancestors' nested defs (a
+            # closure calls its SIBLING closures through the enclosing
+            # scope — the `_dispatch` -> `_step_args` shape), then
+            # module function. CLASS scopes are skipped: python never
+            # resolves a bare name through the enclosing class body, so
+            # `helper(x)` inside C.method must not resolve to C.helper
+            # (that edge would shadow a real module-level `helper` and
+            # fabricate call-graph facts downstream)
+            qual = fn["qual"]
+            cls_quals = self._class_quals(path)
+            while True:
+                if qual not in cls_quals:
+                    key = f"{path}::{qual}.{name}"
+                    if key in self.functions:
+                        return key
+                if "." not in qual:
+                    break
+                qual = qual.rsplit(".", 1)[0]
             return self.module_funcs.get((path, name))
         if kind == "dotted":
             # "telemetry.inc" with telemetry -> h2o_tpu.utils.telemetry;
@@ -640,6 +1138,19 @@ class ProjectModel:
         if kind == "attr":
             return self._unique_method(name)
         return None
+
+    def _class_quals(self, path: str) -> frozenset:
+        """Qual prefixes in ``path`` that are CLASS scopes (memoized) —
+        the bare-name resolution walk must step over them."""
+        cache = getattr(self, "_cls_quals_cache", None)
+        if cache is None:
+            cache = self._cls_quals_cache = {}
+        got = cache.get(path)
+        if got is None:
+            got = cache[path] = frozenset(
+                rec["qual"] for (p, _c), rec in self.classes.items()
+                if p == path)
+        return got
 
     def _unique_method(self, name: str) -> str | None:
         if name in _RESOLVE_BLOCKLIST:

@@ -905,9 +905,13 @@ class UseAfterDonate(Rule):
     #: factory callables known to return a donating trainer: callee name
     #: -> donated positions of the RETURNED callable when the factory is
     #: called with donate=True (engine.make_train_fn donates the carried
-    #: margin, argument 3). The chunk loop's own `*step_args` dispatch is
-    #: invisible to any positional analysis — that discipline is pinned by
-    #: tests (tests/test_pipeline.py cadence/donation pins), not here.
+    #: margin, argument 3). This per-file rule still can't see the chunk
+    #: loop's `*step_args` dispatch — the pass-3 `donate-across-calls`
+    #: rule (tools/graftlint/dataflow.py) resolves donating factories
+    #: through the call graph and star-dispatch through tuple packs, so
+    #: that flow IS lint-visible now; this list keeps the cheap per-file
+    #: rule useful for same-file reads (tests included — pass 3 scopes
+    #: to h2o_tpu/ + bench.py).
     _DONATING_FACTORIES = {"make_train_fn": frozenset([3])}
 
     def _binding_positions(self, value: ast.expr, ctx) -> frozenset | None:
