@@ -40,7 +40,7 @@ from .client import (H2OServingOverloadError, H2OServingTimeoutError,
                      unregister_serving, create_route, route_score,
                      route_stats, delete_route, serving_control,
                      programs, fleet_metrics, profiler_capture,
-                     flight_bundles, flight_bundle)
+                     flight_bundles, flight_bundle, health, slow_traces)
 from .client import H2OAutoML, H2OGridSearch, load_grid, save_grid
 from .client import (create_frame, download_csv, insert_missing_values,
                      log_and_echo, remove_all, split_frame_rest)

@@ -188,13 +188,21 @@ class H2OConnection:
 
     def _send(self, method: str, pathq: str, body, headers: dict,
               raw: bool, save_to: str | None):
-        from ..utils import failpoints, knobs
+        from ..utils import failpoints, knobs, telemetry
 
         failpoints.hit("client.request")
         keepalive = knobs.get_bool("H2O_TPU_CLIENT_KEEPALIVE")
         conn = getattr(self._pool, "conn", None) if keepalive else None
         pooled = conn is not None
         hdrs = dict(headers)
+        # wire trace propagation: a request issued inside an open span
+        # carries its W3C-style traceparent so the server roots the
+        # request span under THIS caller's trace id — client→REST→job→
+        # chunk spans merge into one Perfetto session across processes.
+        # Outside any span no header is sent (no trace to continue).
+        tp = telemetry.current_traceparent()
+        if tp is not None:
+            hdrs.setdefault("traceparent", tp)
         if not keepalive:
             hdrs["Connection"] = "close"
         try:
@@ -954,6 +962,26 @@ def flight_bundle(name: str) -> dict:
     """`GET /3/Flight/{name}` — one diagnostics bundle's full content."""
     return connection().request(
         "GET", f"/3/Flight/{urllib.parse.quote(name)}")["bundle"]
+
+
+# ---------------------------------------------------------------------------
+# causal observability plane (PR 15 — health / SLO burn / slow traces)
+# ---------------------------------------------------------------------------
+def health() -> dict:
+    """`GET /3/Health` — liveness/readiness with typed degradation
+    reasons (device visibility, Cleaner headroom vs the reservation
+    ledger, serving queue saturation, job heartbeats, watchdog trips,
+    SLO burn). ``ready`` is the poll target for autoscalers and rollout
+    gates; ``degraded`` names exactly what is wrong."""
+    return connection().request("GET", "/3/Health")
+
+
+def slow_traces(limit: int | None = None) -> list:
+    """`GET /3/SlowTraces` — the tail-based capture ring: full span trees
+    (+ program dispatch walls) of requests that breached their SLO p99
+    target, newest last."""
+    path = "/3/SlowTraces" + (f"?limit={int(limit)}" if limit else "")
+    return connection().request("GET", path)["slow_traces"]
 
 
 # ---------------------------------------------------------------------------

@@ -361,3 +361,23 @@ def programs_schema(snapshot: dict, peak_flops) -> dict:
                          for pid, rec in snapshot.items()},
             "count": len(snapshot),
             "peak_flops_per_s": _clean(peak_flops)}
+
+
+# ---------------------------------------------------------------------------
+# causal observability plane (PR 15)
+# ---------------------------------------------------------------------------
+def health_schema(snap: dict) -> dict:
+    """The `GET /3/Health` payload (utils/health.py snapshot), JSON-
+    cleaned. ``ready``/``live`` and the typed ``degraded`` reasons are
+    the contract autoscalers and rollout gates switch on; ``checks`` and
+    the per-SLO ``slo`` burn block carry the supporting numbers."""
+    return _clean(dict(snap))
+
+
+def slow_traces_schema(traces: list, total: int) -> dict:
+    """The `GET /3/SlowTraces` payload: the tail-capture ring (each entry
+    = SLO verdict + full span tree + program dispatch walls), plus the
+    ever-captured total so a poller can detect rotation."""
+    return {"slow_traces": _clean(list(traces)),
+            "count": len(traces),
+            "total_captured": int(total)}

@@ -157,9 +157,18 @@ class H2OServer:
             ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
             self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
                                                 server_side=True)
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True, name="h2o-rest")
+        # the acceptor owns no spans and serves EVERY request's context —
+        # carrying the boot thread's trace into it would fabricate
+        # causality
+        self._thread = threading.Thread(  # graftlint: disable=thread-without-trace-context
+            target=self.httpd.serve_forever, daemon=True, name="h2o-rest")
         self._thread.start()
+        # arm the watchdog supervisor with the server (idempotent; no-op
+        # unless H2O_TPU_WATCHDOG_MS > 0) — hung jobs / stalled dispatch /
+        # Cleaner thrash / queue stalls become typed events + bundles
+        from ..utils import watchdog
+
+        watchdog.ensure_started()
         return self
 
     def stop(self):
@@ -317,10 +326,18 @@ def _make_handler(server: H2OServer):
             query = {k: v[0] if len(v) == 1 else v
                      for k, v in urllib.parse.parse_qs(parsed.query).items()}
             # monitoring polls don't count as activity for SteamMetrics'
-            # idle clock (`water/api/SteamMetricsHandler` semantics)
+            # idle clock (`water/api/SteamMetricsHandler` semantics);
+            # Health rides the same exclusion — a 1s readiness prober
+            # must not look like user traffic or cycle the timeline ring.
+            # Timeline too: since the ?since= cursor exists precisely for
+            # frequent polling, a timeline poll recording a timeline
+            # event would FEED the ring it drains — every pull returns
+            # the event of the previous pull and the cursor never
+            # catches up (observed driving the cursor loop end-to-end)
             head = parts[1] if len(parts) > 1 else (parts[0] if parts else "")
             is_monitor_poll = head in ("Cloud", "Ping", "Jobs",
-                                       "SteamMetrics", "Sample")
+                                       "SteamMetrics", "Sample", "Health",
+                                       "Timeline")
             if not is_monitor_poll:
                 server.last_activity = time.time()
             if method == "POST" and parts and \
@@ -333,40 +350,63 @@ def _make_handler(server: H2OServer):
                                            stacktrace=traceback.format_exc())
                 self._reply(status, payload)
                 return
-            from ..utils import telemetry
+            import contextlib
+
+            from ..utils import slowtrace, telemetry
 
             t_route = time.perf_counter()
-            try:
-                from ..utils import failpoints
+            # wire trace propagation: an incoming W3C-style traceparent
+            # (attached by api/client.py _send) roots this request's span
+            # under the REMOTE caller's trace — same trace id reused,
+            # remote parent recorded — so client→REST→job→chunk spans
+            # merge into one Perfetto session across processes. Non-
+            # monitor requests also ride the tail-based slow-request
+            # capture: the request span tree persists when the wall
+            # breaches the rest.request SLO p99 (GET /3/SlowTraces).
+            tp_header = self.headers.get("traceparent")
+            capture = (contextlib.nullcontext() if is_monitor_poll
+                       else slowtrace.request(
+                           "rest.request", f"{method} {parsed.path}",
+                           endpoint=head, remote=int(bool(tp_header))))
+            with telemetry.remote_context(tp_header), capture as _cap:
+                try:
+                    from ..utils import failpoints
 
-                # read the body BEFORE the failpoint (or any other early
-                # reply) can short-circuit routing: on a keep-alive
-                # connection, unread body bytes would be parsed as the
-                # NEXT request's start line — a wire-protocol desync the
-                # pooled client turns from latent to immediate
-                body = (self._body() if method in ("POST", "PUT") else {})
-                failpoints.hit("rest.route")
-                status, payload = route(server, method, parts, query, body)
-            except failpoints.InjectedHTTPError as e:
-                # deterministic flaky-server injection: reply the injected
-                # status; 429/503 carry Retry-After so client retry paths
-                # can be driven end-to-end over a real socket
-                status, payload = _err(e.status, str(e))
-                if e.status in (429, 503):
-                    payload["__headers__"] = {
-                        "Retry-After": f"{e.retry_after_s:g}"}
-            except KeyError as e:
-                status, payload = _err(404, str(e))
-            except (ValueError, TypeError) as e:
-                status, payload = _err(400, str(e))
-            except Exception as e:  # noqa: BLE001 — surface as H2OError
-                status, payload = _err(500, repr(e),
-                                       stacktrace=traceback.format_exc())
-                from ..utils.log import err as _log_err
+                    # read the body BEFORE the failpoint (or any other
+                    # early reply) can short-circuit routing: on a
+                    # keep-alive connection, unread body bytes would be
+                    # parsed as the NEXT request's start line — a
+                    # wire-protocol desync the pooled client turns from
+                    # latent to immediate
+                    body = (self._body() if method in ("POST", "PUT")
+                            else {})
+                    failpoints.hit("rest.route")
+                    status, payload = route(server, method, parts, query,
+                                            body)
+                except failpoints.InjectedHTTPError as e:
+                    # deterministic flaky-server injection: reply the
+                    # injected status; 429/503 carry Retry-After so client
+                    # retry paths can be driven end-to-end over a socket
+                    status, payload = _err(e.status, str(e))
+                    if e.status in (429, 503):
+                        payload["__headers__"] = {
+                            "Retry-After": f"{e.retry_after_s:g}"}
+                except KeyError as e:
+                    status, payload = _err(404, str(e))
+                except (ValueError, TypeError) as e:
+                    status, payload = _err(400, str(e))
+                except Exception as e:  # noqa: BLE001 — as H2OError
+                    status, payload = _err(500, repr(e),
+                                           stacktrace=traceback.format_exc())
+                    from ..utils.log import err as _log_err
 
-                # a 500 that only ever reached the wire was invisible to
-                # /3/Logs — now the ring keeps it
-                _log_err(f"{method} {parsed.path} -> 500: {e!r}")
+                    # a 500 that only ever reached the wire was invisible
+                    # to /3/Logs — now the ring keeps it
+                    _log_err(f"{method} {parsed.path} -> 500: {e!r}")
+                if _cap is not None and status >= 500:
+                    # a 500 reply IS an SLO error even though no exception
+                    # unwinds this handler
+                    _cap.note_error()
             # every routed request lands in the registry (the reference
             # TimeLine records every RPC packet; the REST control plane is
             # this repo's packet stream) — but monitoring polls stay OUT of
@@ -823,7 +863,10 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         return 200, {"entries": [{"name": "Build version", "value": __version__},
                                  {"name": "Backend", "value": "jax/tpu"}]}
     if head == "Shutdown" and method == "POST":
-        threading.Thread(target=server.stop, daemon=True).start()
+        # detached teardown thread — the process is ending, there is no
+        # trace to continue
+        threading.Thread(target=server.stop,  # graftlint: disable=thread-without-trace-context
+                         daemon=True).start()
         return 200, {}
 
     # -- import / parse ------------------------------------------------------
@@ -2322,13 +2365,48 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
 
         # full typed events (seq/ns/ms/kind/what + kind-specific detail),
         # newest-biased cap so a full 4096-event ring doesn't make every
-        # poll serialize megabytes (`?limit=N`, `?kind=span` filter)
+        # poll serialize megabytes (`?limit=N`, `?kind=span` filter).
+        # `?since=<seq>` is the incremental cursor: only events with a
+        # larger seq return, OLDEST-first under `limit` (a >limit gap
+        # drains losslessly across pulls — resume from `next_since`,
+        # which echoes `since` when nothing new arrived); detect ring
+        # overwrite by comparing the first returned seq against since+1
         limit = int(p.get("limit", 1000) or 0)
+        # an EXPLICIT ?since= — including 0 (bootstrap from the start) —
+        # selects cursor mode; absent = the newest-biased human view
+        since_raw = p.get("since")
+        since = (int(since_raw) if since_raw not in (None, "")
+                 else None)
         events = tl.snapshot(limit=limit or None,
-                             kind=p.get("kind") or None)
+                             kind=p.get("kind") or None,
+                             since=since)
         return 200, {"events": events,
+                     "since": since,
+                     "next_since": (events[-1]["seq"] if events
+                                    else (since or 0)),
                      "total_recorded": tl.total_recorded(),
                      "capacity": tl.capacity()}
+    if head == "Health":
+        # liveness/readiness with typed degradation reasons — the signal
+        # the autoscaling loop polls (utils/health.py); excluded from the
+        # timeline ring like the monitoring polls above
+        from ..utils import health as _health
+
+        snap = _health.snapshot()
+        return 200, schemas.health_schema(snap)
+    if head == "SlowTraces":
+        # the tail-based capture ring (utils/slowtrace.py): full span
+        # trees + program dispatch walls of requests that breached their
+        # SLO p99 target
+        from ..utils import slowtrace as _slowtrace
+
+        if method == "DELETE":
+            _slowtrace.clear()
+            return 200, {}
+        limit = int(p.get("limit", 0) or 0)
+        return 200, schemas.slow_traces_schema(
+            _slowtrace.snapshot(limit=limit or None),
+            _slowtrace.total_captured())
     if head == "Metrics":
         # the unified telemetry registry — JSON by default, Prometheus
         # text exposition via ?format=prometheus (scrape-ready), and the
@@ -2338,6 +2416,12 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
         # multi-process serving tier and multi-HOST ingest assume)
         from ..utils import telemetry
 
+        from ..utils import slo as _slo
+
+        # refresh the slo.worst_burn gauge before ANY metrics serve: a
+        # Prometheus scraper that never polls /3/Health must still read
+        # a current burn, not whatever the last health poll left behind
+        _slo.burn_snapshot()
         if _truthy(p.get("fleet")):
             from ..utils import fleetobs
 
@@ -2487,6 +2571,7 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
                    "RapidsSchemaV3", "ImportFilesV3", "ParseV3",
                    "ParseSetupV3", "InitIDV3", "ShutdownV3", "LogsV3",
                    "TimelineV3", "MetricsV3", "ProfilerV3", "NetworkTestV3",
+                   "HealthV3", "SlowTracesV3",
                    "PartialDependenceV3", "PermutationVarImpV3",
                    "TwoDimTableV3", "KeyV3", "H2OErrorV3"})
             if rest[2:]:
@@ -2601,7 +2686,17 @@ _ROUTES_DOC = [
         ("GET", "/3/Logs", "node log ring"),
         ("GET", "/3/Logs/nodes/{nodeidx}/files/{name}",
          "one node's log file, filtered by level"),
-        ("GET", "/3/Timeline", "typed event timeline ring (limit/kind)"),
+        ("GET", "/3/Timeline",
+         "typed event timeline ring (limit/kind; ?since=<seq> is the "
+         "incremental poll cursor)"),
+        ("GET", "/3/Health",
+         "liveness/readiness with typed degradation reasons + SLO burn "
+         "(devices, Cleaner headroom, serving queues, job heartbeats, "
+         "watchdog trips)"),
+        ("GET", "/3/SlowTraces",
+         "tail-based slow-request capture ring: span trees + program "
+         "dispatch walls of SLO p99 breachers"),
+        ("DELETE", "/3/SlowTraces", "clear the slow-trace ring"),
         ("GET", "/3/Metrics",
          "unified telemetry registry (JSON; ?format=prometheus; "
          "?fleet=1 merges peer processes with per-process labels)"),

@@ -124,7 +124,16 @@ class Job(Keyed):
         self._stop_requested = False
         self._thread: threading.Thread | None = None
         self.result: Any = None
+        #: last progress heartbeat (wall clock) — refreshed by update()
+        #: and check_cancelled(), i.e. at every chunk/epoch boundary; the
+        #: watchdog's hung-job detector and /3/Health's job check read it
+        self.last_beat = time.time()
         STORE.put_keyed(self)
+
+    def beat(self) -> None:
+        """Mark forward progress (hung-job watchdog heartbeat)."""
+        with self._lock:
+            self.last_beat = time.time()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, fn: Callable[[], Any], background: bool = True) -> "Job":
@@ -138,7 +147,7 @@ class Job(Keyed):
 
             with self._lock:
                 self.status = Job.RUNNING
-                self.start_time = time.time()
+                self.start_time = self.last_beat = time.time()
             timeline.record("job", "start", job=str(self.key),
                             desc=self.description)
             try:
@@ -165,7 +174,15 @@ class Job(Keyed):
                 _note_job_finished()
 
         if background:
-            self._thread = threading.Thread(target=_run, daemon=True, name=self.key)
+            from ..utils import telemetry
+
+            # the worker thread adopts the SUBMITTER's span context
+            # (captured here, in the REST handler / caller thread), so a
+            # background training job's spans nest under the request that
+            # started it instead of minting an orphan trace id
+            self._thread = threading.Thread(
+                target=telemetry.carry_context(_run), daemon=True,
+                name=self.key)
             self._thread.start()
         else:
             _run()
@@ -202,6 +219,7 @@ class Job(Keyed):
     def update(self, worked: float, msg: str = "") -> None:
         with self._lock:
             self._worked += worked
+            self.last_beat = time.time()
             if msg:
                 self.progress_msg = msg
 
@@ -243,7 +261,11 @@ class Job(Keyed):
             return self._stop_requested
 
     def check_cancelled(self) -> None:
-        """Builders call this between iterations; raises to unwind the driver."""
+        """Builders call this between iterations; raises to unwind the
+        driver. Doubling as the heartbeat: reaching a cancellation poll
+        IS forward progress, so every chunk/epoch boundary refreshes
+        ``last_beat`` without a second instrumentation site."""
+        self.beat()
         if self.stop_requested:
             raise JobCancelled(self.key)
 

@@ -449,10 +449,13 @@ class ModelBuilder:
             # one root span per training job: everything recorded under it
             # (chunk/epoch spans, MRTask dispatches, checkpoints) shares
             # its trace id, so /3/Timeline and the chrome-trace export can
-            # reassemble the whole job. Background jobs run on a fresh
-            # thread (fresh contextvars) so the trace starts here; a
-            # foreground train inside a REST handler nests under the
-            # request's span instead — deliberately.
+            # reassemble the whole job. Background jobs used to start a
+            # fresh trace here (thread = fresh contextvars); since
+            # Job.start adopts telemetry.carry_context, a REST-started
+            # job nests under the request span — and through its
+            # traceparent, under the REMOTE client's trace — while a
+            # directly-driven train with no enclosing span still roots
+            # its own trace here.
             compilemeter.install()  # compiles are countable from now on
             # H2O_TPU_PROFILE_DIR arms a span-scoped jax.profiler capture
             # of the whole job: the root span below (and every span nested

@@ -157,6 +157,18 @@ def mr_reduce(
     return _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows=False)
 
 
+#: thread-id -> (monotonic start, map_fn name) of driver dispatches
+#: currently executing — the watchdog's mrtask-stall detector scans this
+#: (a dispatch that never returns is otherwise invisible until a human
+#: reads the timeline). Each thread writes only its own key.
+_INFLIGHT: dict[int, tuple[float, str]] = {}
+
+
+def inflight_dispatches() -> dict[int, tuple[float, str]]:
+    """Atomic copy of the in-flight dispatch table (utils/watchdog.py)."""
+    return dict(_INFLIGHT)
+
+
 def _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows: bool):
     """Shared instrumented dispatch — DrJAX-style per-stage accounting for
     the driver: the ``build`` phase is the host-side program resolution
@@ -164,21 +176,29 @@ def _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows: bool):
     (the map/reduce/psum itself runs inside the one compiled program; its
     device wall drains at the caller's sync point). Payload bytes in/out
     come from array metadata, so the accounting costs no transfers."""
+    import threading
+    import time
+
     from ..utils import sanitizer, telemetry
 
     in_bytes = sum(getattr(a, "nbytes", 0) for a in arrays)
+    fn_name = getattr(map_fn, "__name__", "map_fn")
+    tid = threading.get_ident()
     with telemetry.span("mrtask.dispatch", metric="mrtask.dispatch.seconds",
-                        fn=getattr(map_fn, "__name__", "map_fn"),
-                        rows=nrow, in_bytes=in_bytes) as sp:
-        with sp.phase("build"):
-            fn = _driver_program(map_fn, mesh, nrow, reduce_key,
-                                 _avt(arrays), out_rows)
-        # H2O_TPU_SANITIZE=transfers: an implicit device->host sync inside
-        # the driver dispatch raises typed (graftlint rule
-        # host-transfer-in-hot-path is the static twin); no-op when off
-        with sp.phase("dispatch"), \
-                sanitizer.transfer_scope("mrtask.dispatch"):
-            out = fn(*arrays)
+                        fn=fn_name, rows=nrow, in_bytes=in_bytes) as sp:
+        _INFLIGHT[tid] = (time.monotonic(), fn_name)
+        try:
+            with sp.phase("build"):
+                fn = _driver_program(map_fn, mesh, nrow, reduce_key,
+                                     _avt(arrays), out_rows)
+            # H2O_TPU_SANITIZE=transfers: an implicit device->host sync
+            # inside the driver dispatch raises typed (graftlint rule
+            # host-transfer-in-hot-path is the static twin); no-op when off
+            with sp.phase("dispatch"), \
+                    sanitizer.transfer_scope("mrtask.dispatch"):
+                out = fn(*arrays)
+        finally:
+            _INFLIGHT.pop(tid, None)
     telemetry.inc("mrtask.dispatch.count")
     telemetry.inc("mrtask.payload.in.bytes", in_bytes)
     telemetry.inc("mrtask.payload.out.bytes",

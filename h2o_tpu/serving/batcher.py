@@ -42,7 +42,8 @@ _WAITING, _RUNNING, _DONE, _TIMED_OUT, _FAILED = range(5)
 
 
 class _Pending:
-    __slots__ = ("rows", "n", "deadline", "state", "event", "result", "error")
+    __slots__ = ("rows", "n", "deadline", "state", "event", "result",
+                 "error", "enqueued")
 
     def __init__(self, rows: np.ndarray, deadline: float | None):
         self.rows = rows
@@ -52,6 +53,7 @@ class _Pending:
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.enqueued = time.monotonic()  # queue-stall watchdog probe
 
 
 class MicroBatcher:
@@ -73,8 +75,18 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._paused = False
         self._stopped = False
+        from ..utils import telemetry
+
+        # the worker adopts the REGISTERING thread's span context: any
+        # span it opens shares the registration trace instead of minting
+        # per-batch orphan ids (telemetry.carry_context — the
+        # thread-without-trace-context contract). Per-REQUEST attribution
+        # is deliberately NOT attempted here: one batch serves N
+        # coalesced requests, so there is no single request context a
+        # batch could honestly adopt — the request-side serving.submit
+        # span (runtime.score_rows) owns the queue+device wall instead
         self._worker = threading.Thread(
-            target=self._run, daemon=True,
+            target=telemetry.carry_context(self._run), daemon=True,
             name=f"h2o-serving-batch[{model_id}]")
         self._worker.start()
 
@@ -83,6 +95,17 @@ class MicroBatcher:
     def depth(self) -> int:
         with self._cv:
             return len(self._q)
+
+    def oldest_wait_s(self) -> float | None:
+        """Age of the oldest still-WAITING queued request (None when the
+        queue is empty) — the watchdog's queue-stall probe: a wedged or
+        paused worker shows up as this number growing past budget."""
+        now = time.monotonic()
+        with self._cv:
+            for req in self._q:
+                if req.state == _WAITING:
+                    return now - req.enqueued
+        return None
 
     def submit(self, rows: np.ndarray, deadline_s: float | None):
         """Block until the batch worker scores these rows; returns the

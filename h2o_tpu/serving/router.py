@@ -272,15 +272,43 @@ class Router:
                 telemetry.inc("serving.route.shadow.dropped.count")
                 return
             # primary predictions ride along BY VALUE: the shadow compare
-            # can never reach back into the response
-            self._shadow_q.append((route, primary, shadows,
+            # can never reach back into the response. The span context is
+            # carried PER JOB (captured here, in the request thread): the
+            # long-lived worker must not pin its FIRST request's context
+            # forever and attribute every later shadow score to a
+            # long-dead trace
+            job = telemetry.carry_context(self._score_one_shadow)
+            self._shadow_q.append((job, primary, shadows,
                                    list(rows), list(preds)))
             if self._shadow_worker is None:
+                # the worker's own (span-free) loop still adopts a
+                # context for the rule-24 contract; real causality rides
+                # the per-job wrappers above
                 self._shadow_worker = threading.Thread(
-                    target=self._shadow_run, daemon=True,
-                    name="h2o-serving-shadow")
+                    target=telemetry.carry_context(self._shadow_run),
+                    daemon=True, name="h2o-serving-shadow")
                 self._shadow_worker.start()
             self._shadow_cv.notify()
+
+    def _score_one_shadow(self, primary, shadows, rows, preds) -> None:
+        base = [_pred_scalar(p) for p in preds]
+        base_labels = [_pred_label(p) for p in preds]
+        for v in shadows:
+            try:
+                # slo=False: shadow work is droppable by definition — it
+                # must not feed the serving.score SLO window, flip
+                # /3/Health to slo-burn, or pollute the slow-trace ring
+                sh = self._runtime.score(v.model_id, rows, slo=False)
+            except Exception:   # model gone / overloaded: shadow work
+                continue        # is droppable by definition
+            deltas = [abs(_pred_scalar(p) - b)
+                      for p, b in zip(sh, base)]
+            dis = sum(1 for p, lb in zip(sh, base_labels)
+                      if _pred_label(p) != lb)
+            v.note_shadow(deltas, dis)
+            telemetry.inc("serving.route.shadow.rows", len(deltas))
+            for d in deltas:
+                telemetry.observe("serving.route.divergence", d)
 
     def _shadow_run(self) -> None:
         while True:
@@ -291,24 +319,10 @@ class Router:
                     self._shadow_cv.wait()
                 if self._shadow_stop and not self._shadow_q:
                     return
-                route, primary, shadows, rows, preds = \
+                job, primary, shadows, rows, preds = \
                     self._shadow_q.popleft()
                 self._shadow_busy = True
-            base = [_pred_scalar(p) for p in preds]
-            base_labels = [_pred_label(p) for p in preds]
-            for v in shadows:
-                try:
-                    sh = self._runtime.score(v.model_id, rows)
-                except Exception:   # model gone / overloaded: shadow work
-                    continue        # is droppable by definition
-                deltas = [abs(_pred_scalar(p) - b)
-                          for p, b in zip(sh, base)]
-                dis = sum(1 for p, lb in zip(sh, base_labels)
-                          if _pred_label(p) != lb)
-                v.note_shadow(deltas, dis)
-                telemetry.inc("serving.route.shadow.rows", len(deltas))
-                for d in deltas:
-                    telemetry.observe("serving.route.divergence", d)
+            job(primary, shadows, rows, preds)
 
     def drain_shadow(self, timeout_s: float = 10.0) -> bool:
         """Block until the shadow queue is empty AND the worker is idle

@@ -36,7 +36,7 @@ import time
 
 import numpy as np
 
-from ..utils import knobs, telemetry
+from ..utils import knobs, slowtrace, telemetry
 from .control import (ControlPlane, Replica, ReplicaSet,
                       estimate_model_bytes, replica_devices)
 from .errors import AdmissionError, ModelNotRegisteredError
@@ -134,18 +134,48 @@ class ServedModel:
             self.warmup()
 
     # -- request path --------------------------------------------------------
-    def score_rows(self, rows: list, deadline_ms=None) -> list:
+    def score_rows(self, rows: list, deadline_ms=None,
+                   slo: bool = True) -> list:
         if not rows:
             return []
+        if not slo:
+            # shadow/background scoring: droppable-by-definition work
+            # must not feed the serving.score SLO window or the
+            # slow-trace ring — a slow shadow candidate flipping
+            # /3/Health to slo-burn would page on a signal no
+            # user-facing request produced
+            return self._score_impl(rows, deadline_ms)
+        # the serving.score SLO boundary: latency feeds the existing
+        # serving.request.seconds ring (observe_request below), the error
+        # flag feeds the SLO window (a 429/408/scoring fault unwinds as a
+        # typed exception and counts), and a request breaching the
+        # serving.score p99 target persists its span tree behind
+        # GET /3/SlowTraces (the span is ring=False — request-rate spans
+        # must not cycle the timeline ring)
+        with slowtrace.request("serving.score", self.model_id,
+                               model=self.model_id, rows=len(rows)):
+            return self._score_impl(rows, deadline_ms)
+
+    def _score_impl(self, rows: list, deadline_ms) -> list:
         t0 = time.perf_counter()
         self.ensure_placed()
         if self._control is not None:
             self._control.note_hit(self.model_id)
-        X = self.encoder.encode(rows)
+        # child spans on the CALLER thread split a slow request's
+        # tree into encode vs queue+device wall (the submit span
+        # covers queueing, coalescing AND the batch's device call —
+        # the batch worker serves N coalesced requests at once, so
+        # per-request attribution finer than this is structurally
+        # impossible); ring=False — request-rate spans must not
+        # cycle the timeline ring
+        with telemetry.span("serving.encode", ring=False):
+            X = self.encoder.encode(rows)
         if deadline_ms is None:
             deadline_ms = self.cfg["deadline_ms"]
         deadline_s = None if not deadline_ms else float(deadline_ms) / 1e3
-        out = self.replicas.submit(X, deadline_s)
+        with telemetry.span("serving.submit", ring=False,
+                            rows=int(X.shape[0])):
+            out = self.replicas.submit(X, deadline_s)
         preds = self._format(np.asarray(out))
         self.stats.observe_request(time.perf_counter() - t0, len(rows))
         return preds
@@ -329,8 +359,10 @@ class ServingRuntime:
         with self._lock:
             return sorted(self._models)
 
-    def score(self, model_id: str, rows: list, deadline_ms=None) -> list:
-        return self.model(model_id).score_rows(rows, deadline_ms=deadline_ms)
+    def score(self, model_id: str, rows: list, deadline_ms=None,
+              slo: bool = True) -> list:
+        return self.model(model_id).score_rows(rows, deadline_ms=deadline_ms,
+                                               slo=slo)
 
     def stats(self, model_id: str | None = None) -> dict:
         if model_id is not None:

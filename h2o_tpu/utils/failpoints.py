@@ -173,6 +173,13 @@ _failpoint("sanitizer.transfer",
            "to drill the typed TransferGuardViolation + flight-recorder "
            "seam on backends where the jax guard itself cannot trip (CPU "
            "arrays are host memory, so device->host is free there)")
+_failpoint("watchdog.trip",
+           "utils/watchdog.py detector evaluation (hit once per detector "
+           "per sweep, in DETECTORS order: hung-job, mrtask-stall, "
+           "cleaner-thrash, queue-stall) — arm raise*4 to force-trip all "
+           "four detectors in one sweep (each writes its typed timeline "
+           "event + gauge + flight bundle with nothing actually wrong), "
+           "raise@K to drill exactly the K-th detector")
 _failpoint("flightrec.dump",
            "utils/flightrec.py drill site, polled at the GBM/DRF chunk "
            "boundary and the serving batch worker (flightrec.maybe_drill) "

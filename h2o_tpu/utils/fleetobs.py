@@ -231,8 +231,12 @@ def collect(force: bool = False) -> dict:
         import concurrent.futures as cf
 
         with cf.ThreadPoolExecutor(max_workers=min(len(plist), 16)) as ex:
+            # pool threads adopt the collector's span context so scrape
+            # telemetry lands under the caller's trace (carry_context —
+            # executor submits don't propagate contextvars)
             procs.extend(ex.map(
-                lambda u: _scrape_one(u, timeout_s), plist))
+                telemetry.carry_context(
+                    lambda u: _scrape_one(u, timeout_s)), plist))
     procs.extend(_read_spool())
     # dedup by pid, first entry wins (merge order: self > peers > spool):
     # a peer list that includes this process's own port, or a process
@@ -274,15 +278,20 @@ def invalidate_cache() -> None:
         _CACHE["view"] = None
 
 
-def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
+def merge_traces(trace_dir: str, out_path: str | None = None,
+                 extra_dirs=()) -> str:
     """Concatenate every per-process ``trace_*.trace.json`` in
-    ``trace_dir`` into one well-formed chrome-trace array (events keep
-    their ``pid``, so Perfetto renders one track group per process).
-    Returns the merged file's path."""
+    ``trace_dir`` (and any ``extra_dirs`` — processes that exported into
+    their own directories merge into the same Perfetto session) into one
+    well-formed chrome-trace array. Events keep their ``pid``, so
+    Perfetto renders one track group per process — and since PR 15's
+    wire/thread propagation, one REQUEST's spans carry one trace id
+    across all of them. Returns the merged file's path."""
     events: list[dict] = []
-    for fn in sorted(os.listdir(trace_dir)):
-        if fn.startswith("trace_") and fn.endswith(".trace.json"):
-            events.extend(telemetry.read_trace(os.path.join(trace_dir, fn)))
+    for d in (trace_dir, *extra_dirs):
+        for fn in sorted(os.listdir(d)):
+            if fn.startswith("trace_") and fn.endswith(".trace.json"):
+                events.extend(telemetry.read_trace(os.path.join(d, fn)))
     events.sort(key=lambda e: e.get("ts", 0))
     out_path = out_path or os.path.join(trace_dir, "trace_merged.json")
     tmp = f"{out_path}.tmp.{os.getpid()}"

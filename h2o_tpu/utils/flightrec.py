@@ -222,8 +222,10 @@ def dump_async(reason: str, error: BaseException | None = None):
         return None
     # joined via _ASYNC_DUMPS in the atexit _drain_async hook — the lint
     # can't see a join that walks a list, so: (the caller must NOT join
-    # inline; it holds the very locks the bundle collection acquires)
-    t = threading.Thread(target=dump, args=(reason, error),  # graftlint: disable=unjoined-thread
+    # inline; it holds the very locks the bundle collection acquires).
+    # No trace context either: a bundle is a process-terminal diagnostic,
+    # not part of any request's causality.
+    t = threading.Thread(target=dump, args=(reason, error),  # graftlint: disable=unjoined-thread,thread-without-trace-context
                          name="flightrec-dump", daemon=True)
     with _LOCK:
         if not _ATEXIT_ARMED:

@@ -1,0 +1,167 @@
+"""`GET /3/Health` — liveness/readiness with TYPED degradation reasons.
+
+The autoscaling loop, the promote/rollback gate, and a multi-process
+router's health-checker all need the same answer: "is this process fit
+to take work, and if not, exactly why". The reference's `/3/Cloud`
+``cloud_healthy`` is a bare boolean; this endpoint decomposes it into
+checks over state the subsystems already publish, each reporting
+``ok`` + a machine-readable degradation reason:
+
+- **devices** — the mesh backend answers and has visible devices (a
+  TPU runtime that lost its chips serves nothing);
+- **cleaner-headroom** — Cleaner live bytes + the serving reservation
+  ledger against the resolved HBM budget: headroom under
+  ``H2O_TPU_HEALTH_HEADROOM_PCT`` means the next placement/rehydrate
+  likely sweeps or OOMs;
+- **serving-queue** — any served model's live queue past
+  ``H2O_TPU_HEALTH_QUEUE_PCT`` of its bounded depth (the router should
+  spray elsewhere BEFORE submits start bouncing 429);
+- **job-heartbeat** — a RUNNING job with a stale progress beat (the
+  watchdog's hung-job signal, evaluated inline so health works with the
+  watchdog disarmed);
+- **watchdog** — recent watchdog trips that haven't aged out;
+- **slo-burn** — any declared SLO burning its budget faster than
+  ``H2O_TPU_HEALTH_BURN_MAX``.
+
+``ready`` is the AND of every check; ``live`` is the fact the handler
+answered. Health polls are excluded from the timeline ring exactly like
+the PR 6 monitoring polls — a 1-second readiness prober must not evict
+the training spans the timeline exists to show.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from . import knobs, slo, telemetry, watchdog
+
+
+def _pct(name: str) -> float:
+    return max(knobs.get_int(name), 0) / 100.0
+
+
+def _check_devices() -> dict:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        # a control-plane process that never touched the backend is not
+        # degraded — it has no device work to be unfit for
+        return {"ok": True, "note": "backend not initialized"}
+    try:
+        devs = jax.devices()
+    except Exception as e:  # noqa: BLE001 — a dead backend IS the finding
+        return {"ok": False, "reason": "no-devices", "error": repr(e)}
+    if not devs:
+        return {"ok": False, "reason": "no-devices", "devices": 0}
+    return {"ok": True, "devices": len(devs),
+            "backend": jax.default_backend()}
+
+
+def _check_cleaner() -> dict:
+    mem = sys.modules.get("h2o_tpu.backend.memory")
+    if mem is None:
+        return {"ok": True, "note": "backend.memory not loaded"}
+    try:
+        live = mem.CLEANER.tracked_bytes()
+        reserved = mem.reserved_bytes()
+        limit = mem.CLEANER.limit_bytes()
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "reason": "cleaner-unreadable",
+                "error": repr(e)}
+    out = {"live_bytes": live, "reserved_bytes": reserved,
+           "limit_bytes": limit}
+    if not limit:
+        out["ok"] = True            # unlimited budget: headroom undefined
+        return out
+    # the Cleaner sweep threshold already subtracts reservations; health
+    # judges the SAME accounting: committed = tracked + reserved vs the
+    # base budget the reservations were debited from
+    base = limit + reserved
+    headroom = max(base - live - reserved, 0) / base
+    out["headroom_fraction"] = round(headroom, 4)
+    if headroom < _pct("H2O_TPU_HEALTH_HEADROOM_PCT"):
+        out["ok"] = False
+        out["reason"] = "cleaner-headroom"
+    else:
+        out["ok"] = True
+    return out
+
+
+def _check_serving() -> dict:
+    rt_mod = sys.modules.get("h2o_tpu.serving.runtime")
+    rt = getattr(rt_mod, "_RUNTIME", None) if rt_mod else None
+    if rt is None:
+        return {"ok": True, "note": "serving runtime not started"}
+    saturation = _pct("H2O_TPU_HEALTH_QUEUE_PCT")
+    hot = []
+    with rt._lock:
+        models = dict(rt._models)
+    for mid, served in models.items():
+        cap = served.cfg["queue_depth"] * max(len(served.replicas.replicas),
+                                              1)
+        depth = served.depth
+        if cap and depth / cap >= saturation:
+            hot.append({"model": mid, "depth": depth, "capacity": cap})
+    if hot:
+        return {"ok": False, "reason": "serving-queue-saturation",
+                "models": hot}
+    return {"ok": True, "models": len(models)}
+
+
+def _check_jobs() -> dict:
+    # the ONE hung-job rule (watchdog.stale_running_jobs) — evaluated
+    # inline so the check works with the watchdog supervisor disarmed
+    stale = watchdog.stale_running_jobs()
+    if stale:
+        return {"ok": False, "reason": "job-heartbeat", "jobs": stale}
+    return {"ok": True}
+
+
+def _check_watchdog() -> dict:
+    dog = watchdog.instance()
+    if dog is None:
+        return {"ok": True, "note": "watchdog disarmed"}
+    trips = dog.recent_trips()
+    if trips:
+        return {"ok": False, "reason": "watchdog-trip", "trips": trips}
+    return {"ok": True, "sweeps": dog._sweeps}
+
+
+def _check_slo(burns: dict) -> dict:
+    max_burn = max(knobs.get_int("H2O_TPU_HEALTH_BURN_MAX"), 1)
+    burning = {name: rec["burn"] for name, rec in burns.items()
+               if (rec["burn"] or 0) > max_burn}
+    if burning:
+        return {"ok": False, "reason": "slo-burn", "burning": burning,
+                "max_burn": max_burn}
+    return {"ok": True, "max_burn": max_burn}
+
+
+def snapshot() -> dict:
+    """The full `GET /3/Health` payload. ``ready`` = every check ok;
+    ``degraded`` lists the typed reasons (stable strings a poller can
+    switch on) with their check details."""
+    telemetry.inc("health.poll.count")
+    burns = slo.burn_snapshot()
+    checks = {
+        "devices": _check_devices(),
+        "cleaner": _check_cleaner(),
+        "serving": _check_serving(),
+        "jobs": _check_jobs(),
+        "watchdog": _check_watchdog(),
+        "slo": _check_slo(burns),
+    }
+    degraded = [{"check": name, "reason": rec.get("reason", name),
+                 **{k: v for k, v in rec.items()
+                    if k not in ("ok", "reason")}}
+                for name, rec in checks.items() if not rec.get("ok", True)]
+    return {
+        "live": True,
+        "ready": not degraded,
+        "degraded": degraded,
+        "checks": checks,
+        "slo": burns,
+        "pid": os.getpid(),
+        "ts_ms": int(time.time() * 1000),
+    }

@@ -291,6 +291,60 @@ _knob("H2O_TPU_FLEET_INTERVAL_MS", "int", 0,
       "GET /3/Metrics?fleet=1 serves the cached merge (0 = scrape on "
       "every request)")
 
+# -- causal observability plane (slo / watchdog / slowtrace / health) --------
+_knob("H2O_TPU_SLO", "str", "",
+      "per-deployment SLO overrides (utils/slo.py) as comma-separated "
+      "'<slo>.p99_ms=<ms>' / '<slo>.error_budget=<frac>' pairs, e.g. "
+      "'serving.score.p99_ms=50,rest.request.error_budget=0.05'; "
+      "undeclared SLO names raise KeyError (the knobs discipline); "
+      "empty = the declared defaults")
+_knob("H2O_TPU_SLO_WINDOW_S", "int", 300,
+      "rolling window (seconds) the SLO error-burn rate is computed "
+      "over; latency burn rides the telemetry histogram rings' own "
+      "H2O_TPU_METRICS_HIST_WINDOW observation window")
+_knob("H2O_TPU_SLOWTRACE_KEEP", "int", 64,
+      "slow-request capture ring size (utils/slowtrace.py): how many "
+      "SLO-p99-breaching requests keep their full span tree + program "
+      "dispatch walls behind GET /3/SlowTraces (newest win)")
+_knob("H2O_TPU_SLOWTRACE_MIN_MS", "int", 0,
+      "floor for slow-request capture: requests faster than this never "
+      "persist even when their SLO p99 target is lower (a deliberately "
+      "tight test SLO must not flood the ring in production); 0 = the "
+      "SLO targets alone decide")
+_knob("H2O_TPU_WATCHDOG_MS", "int", 0,
+      "watchdog supervisor sweep interval (utils/watchdog.py): every "
+      "interval one thread checks for hung jobs, stalled MRTask "
+      "dispatch, Cleaner spill/rehydrate thrash and serving queue "
+      "stalls, each trip landing a typed timeline event + Prometheus "
+      "gauge + proactive flight bundle; 0 = disarmed (no thread)")
+_knob("H2O_TPU_WATCHDOG_JOB_BUDGET_MS", "int", 120_000,
+      "a RUNNING job whose progress heartbeat (Job.beat — fed by every "
+      "update/check_cancelled at chunk/epoch boundaries) is older than "
+      "this trips the hung-job detector")
+_knob("H2O_TPU_WATCHDOG_DISPATCH_BUDGET_MS", "int", 60_000,
+      "an MRTask driver dispatch in flight longer than this trips the "
+      "mrtask-stall detector (parallel/mrtask.py in-flight table)")
+_knob("H2O_TPU_WATCHDOG_QUEUE_BUDGET_MS", "int", 10_000,
+      "a serving batcher whose OLDEST queued request has waited longer "
+      "than this trips the queue-stall detector (worker wedged or "
+      "paused under live traffic)")
+_knob("H2O_TPU_WATCHDOG_THRASH_OPS", "int", 16,
+      "Cleaner spill AND rehydrate counters both advancing more than "
+      "this within one watchdog interval trips the cleaner-thrash "
+      "detector (evict/reload churn — the memory death spiral)")
+_knob("H2O_TPU_HEALTH_HEADROOM_PCT", "int", 5,
+      "GET /3/Health reports cleaner-headroom degradation when free HBM "
+      "under the resolved budget (Cleaner live bytes + the serving "
+      "reservation ledger both debited) falls below this percent")
+_knob("H2O_TPU_HEALTH_QUEUE_PCT", "int", 80,
+      "GET /3/Health reports serving-queue-saturation when any served "
+      "model's live queue depth reaches this percent of its bounded "
+      "capacity (the router should spray elsewhere BEFORE 429s start)")
+_knob("H2O_TPU_HEALTH_BURN_MAX", "int", 10,
+      "GET /3/Health reports slo-burn degradation when any declared "
+      "SLO's burn rate exceeds this multiple of its error budget "
+      "(burn 1.0 = exactly consuming the budget)")
+
 # -- security ---------------------------------------------------------------
 _knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
       "allow python: UDF references uploaded over the wire to execute")

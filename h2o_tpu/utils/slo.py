@@ -1,0 +1,237 @@
+"""SLO registry — declared latency/error objectives + rolling burn rates.
+
+The serving-for-millions loop (ROADMAP: load-driven autoscaling,
+promote/rollback gates) needs ONE number per surface answering "are we
+inside our objective, and how fast are we spending the error budget" —
+not a dashboard of raw percentiles a human has to interpret. This module
+is that number's registry, with the `utils/knobs.py` discipline applied
+to objectives: every SLO is DECLARED here with a doc, a p99 latency
+target and an error budget; accessors raise ``KeyError`` on undeclared
+names, and per-deployment overrides ride ``H2O_TPU_SLO`` without code
+edits.
+
+Burn semantics (the classic multi-window burn-rate shape, collapsed to
+one rolling window sized ``H2O_TPU_SLO_WINDOW_S``):
+
+- **latency burn** — the fraction of recent SLO-relevant requests
+  breaching the p99 target, divided by the allowed 1%: burn 1.0 =
+  exactly at budget, 10 = paging territory. Computed from the per-SLO
+  note window (the same samples the error burn uses), because the raw
+  telemetry histogram rings also hold monitoring/health polls — a 1 Hz
+  readiness prober's 5 ms samples would dilute the breach fraction and
+  mask a real user-facing breach. When an SLO declares a backing
+  ``hist`` and its note window is empty (a process that serves traffic
+  through a path that only feeds the telemetry ring), the EXISTING
+  histogram ring (``telemetry.hist_values``) is the fallback.
+- **error burn** — windowed error fraction / declared error budget,
+  from the same bounded per-SLO ring of ``(wall stamp, dur, error?)``
+  samples fed by :func:`note` at the request boundaries (REST
+  `_route`, the serving score path).
+
+``burn_snapshot()`` is the `GET /3/Health` payload's ``slo`` block and
+sets the ``slo.worst_burn`` gauge (Prometheus-scraped — the autoscaler's
+poll target); ``objective()`` hands `utils/slowtrace.py` the per-request
+p99 threshold that decides which span trees are worth persisting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+from . import knobs, telemetry
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    name: str
+    doc: str
+    p99_ms: float           # latency objective the tail must stay under
+    error_budget: float     # allowed error fraction over the window
+    hist: str | None        # telemetry histogram ring backing latency burn
+
+
+SLOS: dict[str, SLO] = {}
+
+#: allowed tail fraction the p99 objective implies — breach_fraction is
+#: divided by this to get the latency burn rate
+_P99_ALLOWANCE = 0.01
+
+
+def declare(name: str, doc: str, p99_ms: float, error_budget: float,
+            hist: str | None = None) -> None:
+    """Register an objective (idempotent by name — re-declaration
+    replaces, which is how per-model serving SLOs refresh on
+    re-registration). ``hist`` names a DECLARED telemetry histogram whose
+    ring backs the latency burn; None keeps latency burn off and leaves
+    only the error budget."""
+    if hist is not None:
+        telemetry.hist_values(hist)     # KeyError on undeclared/non-hist
+    SLOS[name] = SLO(name, doc, float(p99_ms), float(error_budget), hist)
+
+
+declare("rest.request",
+        "REST control-plane requests (api/server.py _route; monitoring "
+        "polls included — they ride the same handler)",
+        p99_ms=2500.0, error_budget=0.02, hist="rest.request.seconds")
+declare("serving.score",
+        "online scoring requests end to end — encode + queue + device "
+        "call (serving/runtime.py score path)",
+        p99_ms=250.0, error_budget=0.01, hist="serving.request.seconds")
+
+
+def _lookup(name: str) -> SLO:
+    try:
+        return SLOS[name]
+    except KeyError:
+        raise KeyError(
+            f"undeclared SLO {name!r} — declare it in h2o_tpu/utils/slo.py "
+            f"(or slo.declare() for per-model objectives)") from None
+
+
+def _overrides() -> dict:
+    """``H2O_TPU_SLO`` = comma list of ``<slo>.<field>=<value>`` pairs
+    (fields: p99_ms, error_budget) — parsed per read so tests/operators
+    can retune a live process. Unknown names/fields raise the registry's
+    typed KeyError/ValueError loudly; a silently ignored override is an
+    SLO nobody is actually holding."""
+    raw = knobs.get_str("H2O_TPU_SLO")
+    out: dict[str, dict] = {}
+    for tok in filter(None, (t.strip() for t in raw.split(","))):
+        key, _, val = tok.partition("=")
+        name, _, field = key.rpartition(".")
+        if field not in ("p99_ms", "error_budget"):
+            raise ValueError(
+                f"bad H2O_TPU_SLO entry {tok!r} — grammar: "
+                f"<slo>.p99_ms=<ms> | <slo>.error_budget=<frac>")
+        _lookup(name)
+        out.setdefault(name, {})[field] = float(val)
+    return out
+
+
+def objective(name: str) -> SLO:
+    """The EFFECTIVE objective: the declared SLO with any ``H2O_TPU_SLO``
+    override applied. KeyError on undeclared names."""
+    s = _lookup(name)
+    ov = _overrides().get(name)
+    if not ov:
+        return s
+    return dataclasses.replace(s, **ov)
+
+
+# ---------------------------------------------------------------------------
+# rolling error windows (latency rides the telemetry hist rings)
+# ---------------------------------------------------------------------------
+class _Window:
+    __slots__ = ("ring", "lock")
+
+    def __init__(self):
+        #: (wall stamp, duration s, error?) per noted request
+        self.ring: deque = deque(maxlen=4096)
+        self.lock = threading.Lock()
+
+
+_WINDOWS: dict[str, _Window] = {}
+_WINDOWS_LOCK = threading.Lock()
+
+
+def _window(name: str) -> _Window:
+    w = _WINDOWS.get(name)
+    if w is None:
+        with _WINDOWS_LOCK:
+            w = _WINDOWS.setdefault(name, _Window())
+    return w
+
+
+def note(name: str, dur_s: float, error: bool = False) -> None:
+    """One finished request against SLO ``name`` — the request boundaries
+    (REST route, serving score) call this. The window backs BOTH burns:
+    it holds exactly the SLO-relevant requests, unlike the raw telemetry
+    rings which also see monitoring/health polls. Honors the metrics
+    master switch."""
+    _lookup(name)
+    if not telemetry.enabled():
+        return
+    w = _window(name)
+    with w.lock:
+        w.ring.append((time.time(), float(dur_s), bool(error)))
+
+
+def window_s() -> float:
+    return max(float(knobs.get_int("H2O_TPU_SLO_WINDOW_S")), 1.0)
+
+
+def _recent(name: str) -> list[tuple]:
+    w = _WINDOWS.get(name)
+    if w is None:
+        return []
+    horizon = time.time() - window_s()
+    with w.lock:
+        return [rec for rec in w.ring if rec[0] >= horizon]
+
+
+def _error_burn(recent: list[tuple], budget: float) -> dict:
+    n = len(recent)
+    frac = (sum(1 for (_, _, e) in recent if e) / n) if n else 0.0
+    return {"window": n, "error_fraction": round(frac, 6),
+            "burn": round(frac / budget, 4) if budget > 0 else None}
+
+
+def _latency_burn(s: SLO, recent: list[tuple]) -> dict:
+    thr = s.p99_ms / 1000.0
+    if recent:
+        # the note window holds exactly the SLO-relevant requests — the
+        # raw telemetry ring would let monitor-poll samples dilute the
+        # breach fraction (a 1 Hz health prober masking a real breach)
+        n = len(recent)
+        breach = sum(1 for (_, d, _) in recent if d > thr) / n
+        src = "window"
+    elif s.hist is not None:
+        vals = telemetry.hist_values(s.hist)
+        n = len(vals)
+        breach = (sum(1 for v in vals if v > thr) / n) if n else 0.0
+        src = s.hist
+    else:
+        n, breach, src = 0, 0.0, "window"
+    return {"window": n, "p99_target_ms": s.p99_ms, "source": src,
+            "breach_fraction": round(breach, 6),
+            "burn": round(breach / _P99_ALLOWANCE, 4)}
+
+
+def burn_snapshot() -> dict:
+    """{slo: {objective, latency, errors, burn}} for every declared SLO —
+    the `/3/Health` ``slo`` block. ``burn`` is the max of the latency and
+    error burns; the overall max lands on the ``slo.worst_burn`` gauge."""
+    out: dict[str, dict] = {}
+    worst = 0.0
+    for name in sorted(SLOS):
+        s = objective(name)
+        recent = _recent(name)
+        lat = _latency_burn(s, recent)
+        err = _error_burn(recent, s.error_budget)
+        burn = max(lat["burn"] or 0.0, err["burn"] or 0.0)
+        worst = max(worst, burn)
+        out[name] = {"doc": s.doc, "p99_ms": s.p99_ms,
+                     "error_budget": s.error_budget,
+                     "latency": lat, "errors": err,
+                     "burn": round(burn, 4)}
+    telemetry.set_gauge("slo.worst_burn", worst)
+    return out
+
+
+def describe() -> str:
+    """Human-readable registry dump (the knobs.describe analog)."""
+    lines = []
+    for s in sorted(SLOS.values(), key=lambda s: s.name):
+        lines.append(f"{s.name}  [p99 {s.p99_ms:g} ms, error budget "
+                     f"{s.error_budget:g}]")
+        lines.append(f"    {s.doc}")
+    return "\n".join(lines)
+
+
+def reset() -> None:
+    """Drop every error window (test isolation)."""
+    with _WINDOWS_LOCK:
+        _WINDOWS.clear()

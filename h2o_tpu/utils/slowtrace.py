@@ -1,0 +1,167 @@
+"""Tail-based slow-request capture — keep the WHOLE story of the worst
+requests, drop everything else.
+
+Sampling every request's span tree would drown the process in its own
+observability; sampling none means the p99.9 outlier that matters is
+gone by the time anyone asks. Tail-based capture is the standard answer
+(OpenTelemetry tail sampling, Dapper's slow-trace stores): hold each
+request's finished span tree briefly, persist it ONLY when the request
+breached its SLO p99 target — the exact requests a latency post-mortem
+needs, at a cost proportional to how badly things are going.
+
+Mechanics: :func:`request` wraps a request boundary in a ``ring=False``
+telemetry span rooted on a :class:`~h2o_tpu.utils.telemetry.SpanSink`
+(the bounded subtree collector; spans from carry_context'd worker
+threads land in it too). On exit the wall is compared against the
+request's declared SLO (`utils/slo.py` ``objective().p99_ms``); breaches
+— and any request slower than the ``H2O_TPU_SLOWTRACE_MIN_MS`` floor —
+persist a bundle into a bounded in-process ring (newest wins,
+``H2O_TPU_SLOWTRACE_KEEP``): the span tree, the SLO verdict, and the
+program-dispatch walls snapshot (`utils/programs.py` — WHAT was
+dispatching while this request crawled). ``GET /3/SlowTraces`` serves
+the ring; ``h2o.slow_traces()`` is the client helper.
+
+The capture also feeds the SLO error/latency windows via ``slo.note`` —
+one boundary instrumentation, both consumers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from . import knobs, slo, telemetry
+
+
+class _Handle:
+    """What :func:`request` yields: the caller marks failures on it
+    (``error = True`` / ``note_error()``) before the block exits — a 500
+    reply is an SLO error even though no exception unwinds the server's
+    route handler."""
+
+    __slots__ = ("error",)
+
+    def __init__(self):
+        self.error = False
+
+    def note_error(self) -> None:
+        self.error = True
+
+
+_RING: deque = deque()
+_LOCK = threading.Lock()
+_SEQ = 0
+
+
+def _keep() -> int:
+    return max(knobs.get_int("H2O_TPU_SLOWTRACE_KEEP"), 1)
+
+
+def _min_ms() -> float:
+    return float(knobs.get_int("H2O_TPU_SLOWTRACE_MIN_MS"))
+
+
+def _program_walls() -> list[dict]:
+    """Compact dispatch-wall view of the program registry at capture time
+    — lazily resolved and failure-proof (a control-plane process with no
+    compiled programs must still capture slow requests)."""
+    mod = sys.modules.get("h2o_tpu.utils.programs")
+    if mod is None:
+        return []
+    try:
+        out = []
+        for pid, rec in sorted(mod.snapshot().items()):
+            wall = rec.get("wall") or {}
+            if not wall.get("count"):
+                continue
+            out.append({"program": pid, "name": rec.get("name"),
+                        "dispatches": wall.get("count"),
+                        "p50_s": wall.get("p50_s"),
+                        "max_s": wall.get("max_s")})
+        return out
+    except Exception:  # noqa: BLE001 — diagnostics must not fail requests
+        return []
+
+
+@contextlib.contextmanager
+def request(slo_name: str, what: str, **attrs):
+    """Wrap one request against SLO ``slo_name``: opens the capture-root
+    span (``ring=False`` — request-rate spans must not cycle the timeline
+    ring), feeds the SLO window on exit, and persists the span tree when
+    the request breached its p99 target. An exception unwinding through
+    counts as an error AND propagates untouched."""
+    sink = telemetry.SpanSink()
+    handle = _Handle()
+    sp = None
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span(slo_name, ring=False, sink=sink,
+                            what=what, **attrs) as sp:
+            try:
+                yield handle
+            except BaseException:
+                handle.error = True
+                raise
+    finally:
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        slo.note(slo_name, dur_ms / 1000.0, error=handle.error)
+        try:
+            target = slo.objective(slo_name).p99_ms
+        except KeyError:
+            target = None
+        if (telemetry.enabled() and sp is not None and target is not None
+                and dur_ms > max(target, _min_ms())):
+            _capture(slo_name, what, sp, dur_ms, target, handle.error,
+                     sink.close())
+
+
+def _capture(slo_name, what, sp, dur_ms, target_ms, error, tree) -> None:
+    global _SEQ
+    rec = {
+        "slo": slo_name, "what": what,
+        "trace": sp.trace_id,
+        "dur_ms": round(dur_ms, 3),
+        "p99_target_ms": target_ms,
+        "error": bool(error),
+        "ts_ms": int(time.time() * 1000),
+        "pid": os.getpid(),
+        "spans": tree,
+        "program_walls": _program_walls(),
+    }
+    with _LOCK:
+        _SEQ += 1
+        rec["seq"] = _SEQ
+        _RING.append(rec)
+        keep = _keep()
+        while len(_RING) > keep:
+            _RING.popleft()
+    telemetry.inc("slowtrace.captured.count")
+    from . import timeline
+
+    timeline.record("slowtrace", what, slo=slo_name,
+                    dur_ms=rec["dur_ms"], trace=sp.trace_id)
+
+
+def snapshot(limit: int | None = None) -> list[dict]:
+    """Captured bundles, oldest first — the `GET /3/SlowTraces` payload
+    (``limit`` keeps the newest N)."""
+    with _LOCK:
+        out = list(_RING)
+    if limit is not None and limit > 0:
+        out = out[-limit:]
+    return out
+
+
+def total_captured() -> int:
+    with _LOCK:
+        return _SEQ
+
+
+def clear() -> None:
+    """Empty the ring (test isolation / `DELETE /3/SlowTraces`)."""
+    with _LOCK:
+        _RING.clear()

@@ -31,6 +31,24 @@ ALSO appended to a per-process chrome-tracing file
 (``trace_<pid>.trace.json``) loadable in Perfetto / chrome://tracing, so
 a whole training run can be opened in a trace viewer.
 
+Causality does not stop at process or thread boundaries:
+
+- **wire propagation** — trace ids are 32-hex (W3C trace-context shaped);
+  :func:`current_traceparent` renders the innermost open span as a
+  ``traceparent`` header value (the client wire attaches it), and
+  :func:`remote_context` adopts an incoming header so the server's
+  request span nests under the REMOTE parent with the same trace id —
+  one Perfetto session shows client→REST→job→train-chunk under one id.
+- **thread propagation** — contextvars do not cross ``threading.Thread``
+  or executor submits, so a worker thread's spans silently orphan into
+  fresh trace ids. :func:`carry_context` wraps a callable with the
+  context captured AT WRAP TIME (the submitting thread's open span);
+  every span-bearing module that spawns threads routes targets through
+  it (graftlint rule ``thread-without-trace-context`` pins that).
+- **span sinks** — a root span opened with ``sink=`` collects its whole
+  finished subtree (bounded, closed at root exit) — the raw material of
+  the tail-based slow-request capture (`utils/slowtrace.py`).
+
 Recording is always-on (the reference's ring never turns off) and cheap:
 a disabled registry (``H2O_TPU_METRICS_ENABLED=0``) still validates names
 but skips the writes. Span durations measure HOST wall between enter and
@@ -265,6 +283,40 @@ _counter("flight.dump.count",
          "H2O_TPU_FLIGHT_DIR (utils/flightrec.py; contract: every count "
          "is a terminal event somewhere)")
 
+# -- causal observability plane (utils/slo.py / watchdog.py / ---------------
+# -- slowtrace.py / health.py) ----------------------------------------------
+_counter("watchdog.trip.count",
+         "watchdog detector trips (utils/watchdog.py — hung job, stalled "
+         "MRTask dispatch, Cleaner thrash, serving queue stall; each trip "
+         "also lands a typed timeline event + a proactive flight bundle)")
+_gauge("watchdog.hung_jobs",
+       "running jobs with no progress heartbeat within "
+       "H2O_TPU_WATCHDOG_JOB_BUDGET_MS, as of the last watchdog sweep")
+_gauge("watchdog.stalled_dispatch",
+       "MRTask driver dispatches in flight longer than "
+       "H2O_TPU_WATCHDOG_DISPATCH_BUDGET_MS, as of the last sweep")
+_gauge("watchdog.cleaner_thrash",
+       "1 while the Cleaner spilled AND rehydrated more than "
+       "H2O_TPU_WATCHDOG_THRASH_OPS times within one watchdog interval "
+       "(spill/reload churn — the memory death spiral), else 0")
+_gauge("watchdog.queue_stall",
+       "serving batchers whose oldest queued request has waited past "
+       "H2O_TPU_WATCHDOG_QUEUE_BUDGET_MS, as of the last sweep")
+_gauge("slo.worst_burn",
+       "max burn rate across every declared SLO (utils/slo.py): 1.0 = "
+       "exactly consuming the error budget, >1 = burning faster — the "
+       "autoscaling/rollback loops' one-number health signal; refreshed "
+       "by every GET /3/Metrics and /3/Health serve (slo.burn_snapshot), "
+       "so both poll surfaces read a current value")
+_counter("slowtrace.captured.count",
+         "requests whose full span tree was persisted by the tail-based "
+         "slow-request capture (utils/slowtrace.py — SLO p99 breachers "
+         "only, behind GET /3/SlowTraces)")
+_counter("health.poll.count",
+         "GET /3/Health evaluations (excluded from the timeline ring "
+         "like the PR 6 monitoring polls — a 1s readiness poller must "
+         "not cycle the event ring)")
+
 
 def _lookup(name: str) -> Metric:
     try:
@@ -338,6 +390,17 @@ def value(name: str) -> float:
     if m.kind == "gauge":
         return _GAUGES[name].value
     return _HISTS[name].count.value()
+
+
+def hist_values(name: str) -> list:
+    """The recent-window ring of a declared histogram, oldest first — the
+    raw observations behind the snapshot percentiles. `utils/slo.py`
+    computes rolling latency-breach fractions off these SAME rings instead
+    of keeping a second latency window."""
+    m = _lookup(name)
+    if m.kind != "histogram":
+        raise KeyError(f"metric {name!r} is a {m.kind}, not a histogram")
+    return list(_HISTS[name].ring)
 
 
 # ---------------------------------------------------------------------------
@@ -498,10 +561,134 @@ def reset() -> None:
 # ---------------------------------------------------------------------------
 # span tracing
 # ---------------------------------------------------------------------------
-#: (trace_id, span_id) of the innermost open span in this context
+#: (trace_id, span_id, sink) of the innermost open span in this context.
+#: trace_id is 32 lowercase hex (W3C trace-context shaped, wire-portable);
+#: span_id is a process-local int for local spans or the 16-hex string of
+#: a REMOTE parent adopted from a traceparent header; sink is the span
+#: tree collector of the enclosing captured request (None outside one).
 _CTX: contextvars.ContextVar = contextvars.ContextVar("h2o_tpu_trace",
                                                       default=None)
 _IDS = itertools.count(1)
+
+
+def _mint_trace_id() -> str:
+    import uuid
+
+    return uuid.uuid4().hex
+
+
+class SpanSink:
+    """Bounded collector of finished span records under one root span.
+
+    Every span whose context inherits the sink appends its record on exit
+    — including spans from worker threads that adopted the context via
+    :func:`carry_context`. The root CLOSES the sink when it exits, so a
+    long-lived descendant (a background training job rooted under a REST
+    request) cannot grow a dead request's tree forever; ``cap`` bounds
+    the live tree the same way the timeline ring is bounded."""
+
+    __slots__ = ("items", "cap", "closed")
+
+    def __init__(self, cap: int = 512):
+        self.items: list[dict] = []
+        self.cap = cap
+        self.closed = False
+
+    def add(self, rec: dict) -> None:
+        # list.append is atomic under the GIL; a dropped record past the
+        # cap/close loses detail, never correctness
+        if not self.closed and len(self.items) < self.cap:
+            self.items.append(rec)
+
+    def close(self) -> list[dict]:
+        self.closed = True
+        return self.items
+
+
+# -- cross-boundary propagation ---------------------------------------------
+_TRACEPARENT_RE = None  # compiled lazily (re import stays top-level-free)
+
+
+def _traceparent_parse(header):
+    """(trace_id, parent_span) from a W3C-style ``traceparent`` header, or
+    None when absent/malformed — a bad header must degrade to a fresh
+    trace, never 400 the request."""
+    global _TRACEPARENT_RE
+    if not header or not isinstance(header, str):
+        return None
+    if _TRACEPARENT_RE is None:
+        import re
+
+        _TRACEPARENT_RE = re.compile(
+            r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None or m.group(1) == "ff" or set(m.group(2)) == {"0"} \
+            or set(m.group(3)) == {"0"}:
+        return None
+    return m.group(2), m.group(3)
+
+
+def current_traceparent() -> str | None:
+    """The innermost open span as a ``traceparent`` header value
+    (``00-<trace32>-<span16>-01``), or None outside any span — what
+    `api/client.py`'s ``_send`` attaches to every request so the server
+    side can root its request span under the caller's."""
+    cur = _CTX.get()
+    if cur is None:
+        return None
+    trace, span_id = cur[0], cur[1]
+    if isinstance(span_id, int):
+        span_hex = f"{span_id & 0xFFFFFFFFFFFFFFFF:016x}"
+    else:                       # re-forwarding an adopted remote parent
+        span_hex = str(span_id)[-16:].rjust(16, "0")
+    # legacy/foreign trace ids normalize to 32 hex by hashing — the header
+    # must always parse on the far side
+    if len(trace) != 32 or not all(c in "0123456789abcdef" for c in trace):
+        import hashlib
+
+        trace = hashlib.sha256(trace.encode()).hexdigest()[:32]
+    return f"00-{trace}-{span_hex}-01"
+
+
+@contextlib.contextmanager
+def remote_context(traceparent: str | None):
+    """Adopt an incoming ``traceparent`` for the duration of the block:
+    spans opened inside reuse the REMOTE trace id and record the remote
+    span as their parent — the server half of wire propagation. A
+    missing/malformed header makes this a no-op (fresh local trace)."""
+    parsed = _traceparent_parse(traceparent)
+    if parsed is None:
+        yield None
+        return
+    trace, parent = parsed
+    token = _CTX.set((trace, parent, None))
+    try:
+        yield trace
+    finally:
+        _CTX.reset(token)
+
+
+def carry_context(fn):
+    """Bind ``fn`` to the CURRENT span context (captured at wrap time) so
+    running it on another thread keeps the trace id and parent linkage —
+    ``Thread(target=carry_context(run))`` / ``ex.submit(carry_context(f),
+    x)``. Contextvars do not cross thread starts or executor submits;
+    without this, worker-thread spans mint orphan trace ids (the
+    shadow-scorer/MicroBatcher hole this helper closes — graftlint rule
+    ``thread-without-trace-context`` enforces adoption)."""
+    import functools
+
+    captured = _CTX.get()
+
+    @functools.wraps(fn)
+    def _carried(*args, **kwargs):
+        token = _CTX.set(captured)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _CTX.reset(token)
+
+    return _carried
 
 
 class Span:
@@ -514,7 +701,7 @@ class Span:
         self.attrs = attrs
         self.trace_id = trace_id
         self.span_id = span_id
-        self.parent_id = parent_id
+        self.parent_id = parent_id          # int (local) | str (remote)
         self.phases: dict[str, float] = {}
         self.t0_ns = 0
 
@@ -531,22 +718,33 @@ class Span:
 
 
 @contextlib.contextmanager
-def span(name: str, metric: str | None = None, **attrs):
+def span(name: str, metric: str | None = None, ring: bool = True,
+         sink: SpanSink | None = None, **attrs):
     """Open a traced span: nests (contextvars), shares the enclosing trace
-    id or mints one, records a typed ``span`` timeline event on exit (plus
-    the chrome-trace line when ``H2O_TPU_TRACE_DIR`` is set), and observes
-    ``metric`` (a declared histogram) with its duration. ``attrs`` are
-    small JSON-able labels; keep them cheap — this runs on hot-path
-    boundaries."""
+    id or mints a 32-hex one, records a typed ``span`` timeline event on
+    exit (plus the chrome-trace line when ``H2O_TPU_TRACE_DIR`` is set),
+    and observes ``metric`` (a declared histogram) with its duration.
+    ``attrs`` are small JSON-able labels; keep them cheap — this runs on
+    hot-path boundaries.
+
+    ``ring=False`` keeps the span OUT of the timeline ring (trace file
+    and sink still see it) — for per-request spans whose rate would cycle
+    the 4096-event ring the way monitoring polls would. ``sink=`` makes
+    this span a capture root: its whole finished subtree (across
+    carry_context'd threads) accumulates into the sink, which closes at
+    root exit; children inherit the enclosing sink automatically."""
     if metric is not None and metric not in _HISTS:
         _lookup(metric)  # typed KeyError for undeclared / non-histogram
         raise KeyError(f"span metric {metric!r} must be a histogram")
     parent = _CTX.get()
     span_id = next(_IDS)
-    trace_id = parent[0] if parent else f"{os.getpid()}-{span_id}"
+    trace_id = parent[0] if parent else _mint_trace_id()
+    root_sink = sink
+    if sink is None and parent is not None and len(parent) > 2:
+        sink = parent[2]
     sp = Span(name, metric, attrs, trace_id, span_id,
               parent[1] if parent else None)
-    token = _CTX.set((trace_id, span_id))
+    token = _CTX.set((trace_id, span_id, sink))
     # while a device-profiler session is live, mirror the span stack into
     # jax TraceAnnotations so XLA ops nest under the SAME names in
     # Perfetto (train.gbm.chunk wraps its device ops) — one global read
@@ -579,10 +777,27 @@ def span(name: str, metric: str | None = None, **attrs):
                 detail["parent"] = sp.parent_id
             for k, v in sp.phases.items():
                 detail[f"{k}_s"] = round(v, 6)
-            timeline.record("span", name, dur_us=dur_ns // 1000, **detail)
+            if ring:
+                timeline.record("span", name,
+                                dur_us=dur_ns // 1000, **detail)
             if sp.metric is not None:
                 observe(sp.metric, dur_ns / 1e9)
+            if sink is not None:
+                sink.add({"name": name, "dur_us": dur_ns // 1000,
+                          "t0_us": sp.t0_ns // 1000,
+                          "tid": threading.get_ident(), **detail})
             _trace_emit(sp, dur_ns)
+        if root_sink is not None:
+            items = root_sink.close()
+            # a NESTED capture root (a serving.score request inside a
+            # rest.request capture) must not sever the enclosing tree:
+            # fold the finished subtree into the parent's sink so the
+            # outer slow-trace still carries the inner latency detail
+            outer = parent[2] if (parent is not None and len(parent) > 2) \
+                else None
+            if outer is not None and outer is not root_sink:
+                for rec in items:
+                    outer.add(rec)
 
 
 def trace_id() -> str | None:

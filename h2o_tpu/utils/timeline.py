@@ -55,18 +55,39 @@ def record(kind: str, what: str, **detail):
         _RING.append(ev)
 
 
-def snapshot(limit: int | None = None, kind: str | None = None) -> list[dict]:
+def snapshot(limit: int | None = None, kind: str | None = None,
+             since: int | None = None) -> list[dict]:
     """Ordered copy of the ring — the `/3/Timeline` payload. ``limit`` keeps
     only the most recent N events (serialization cost cap for the REST
-    path); ``kind`` filters by event kind first."""
+    path); ``kind`` filters by event kind first; ``since`` keeps only
+    events with a LARGER seq — the incremental poll cursor (seq is the
+    monotone sort key, so `?since=<last seen seq>` resumes exactly where
+    the previous pull stopped instead of re-serializing the whole ring).
+
+    The bias of ``limit`` FLIPS with the cursor: a plain snapshot keeps
+    the NEWEST N (a human asking "what just happened"), but a cursored
+    pull keeps the OLDEST N past the cursor — a catch-up poller advances
+    its cursor to the last returned seq and re-polls, so a >N-event gap
+    drains losslessly over several pulls instead of silently dropping
+    its middle."""
     with _LOCK:
         # seq assignment and append share the lock above, so the deque is
         # already seq-ordered — no sort needed
         evs = list(_RING)
+    # since=0 IS a cursor (a collector bootstrapping from the beginning,
+    # oldest-first); None means no cursor (the newest-biased human view)
+    cursored = since is not None
+    if cursored and since > 0:
+        # the ring is ordered: bisect by seq instead of filtering 4096
+        # events per poll (the cursor exists to make polling cheap)
+        import bisect
+
+        idx = bisect.bisect_right([e["seq"] for e in evs], since)
+        evs = evs[idx:]
     if kind is not None:
         evs = [e for e in evs if e["kind"] == kind]
     if limit is not None and limit > 0:
-        evs = evs[-limit:]
+        evs = evs[:limit] if cursored else evs[-limit:]
     return evs
 
 

@@ -1,0 +1,304 @@
+"""Watchdog supervisor — one thread that notices what stopped moving.
+
+Every hang this repo has shipped so far was only diagnosable after the
+fact, by a human reading `/3/Timeline` — a wedged MRTask dispatch, a
+Cleaner thrashing between spill and rehydrate, a serving queue whose
+worker died. The watchdog turns those into PROACTIVE typed events while
+the process still lives: one sweep thread (interval
+``H2O_TPU_WATCHDOG_MS``, sanitizer-lock-disciplined) runs four
+detectors, each reading state the subsystems already publish:
+
+1. **hung-job** — a RUNNING `backend/jobs.py` Job whose progress
+   heartbeat (``Job.beat()``, fed by ``update``/``check_cancelled`` at
+   every chunk/epoch boundary) is older than
+   ``H2O_TPU_WATCHDOG_JOB_BUDGET_MS``.
+2. **mrtask-stall** — a driver dispatch in flight (the
+   ``parallel/mrtask.py`` in-flight table) past
+   ``H2O_TPU_WATCHDOG_DISPATCH_BUDGET_MS``.
+3. **cleaner-thrash** — spill AND rehydrate counters both advancing more
+   than ``H2O_TPU_WATCHDOG_THRASH_OPS`` within one interval (the memory
+   death spiral: evict, reload, evict again).
+4. **queue-stall** — a serving MicroBatcher whose oldest queued request
+   has waited past ``H2O_TPU_WATCHDOG_QUEUE_BUDGET_MS`` (worker wedged
+   or paused while traffic queues).
+
+Every trip lands a typed ``watchdog`` timeline event, bumps
+``watchdog.trip.count``, sets the per-detector gauge (Prometheus-visible
+— the autoscaler/rollback loops read the same registry), and writes a
+proactive flight-recorder bundle (``watchdog-<detector>``) with the full
+thread dump — while the guarded work CONTINUES: a watchdog observes, it
+never kills. Per-subject cooldown stops a persistent condition from
+rotating the flight dir every sweep.
+
+The registered ``watchdog.trip`` failpoint drills every detector: armed,
+each detector's evaluation consumes one hit and reports a forced finding
+— CI exercises all four trip paths (event + gauge + bundle) in one sweep
+with nothing actually wrong.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import failpoints, knobs, telemetry, timeline
+
+#: detector name -> gauge metric, in fixed evaluation order (the
+#: failpoint drill's @K semantics depend on this order being stable)
+DETECTORS = (
+    ("hung-job", "watchdog.hung_jobs"),
+    ("mrtask-stall", "watchdog.stalled_dispatch"),
+    ("cleaner-thrash", "watchdog.cleaner_thrash"),
+    ("queue-stall", "watchdog.queue_stall"),
+)
+
+
+def _ms(name: str) -> float:
+    return max(knobs.get_int(name), 1) / 1000.0
+
+
+def stale_running_jobs(budget_s: float | None = None) -> list[dict]:
+    """RUNNING jobs whose progress heartbeat (``Job.last_beat``) is older
+    than ``budget_s`` (default: the H2O_TPU_WATCHDOG_JOB_BUDGET_MS knob)
+    — the ONE hung-job rule, shared by the watchdog's detector and the
+    /3/Health job check (which must work with the watchdog disarmed)."""
+    import sys
+
+    jobs_mod = sys.modules.get("h2o_tpu.backend.jobs")
+    if jobs_mod is None:
+        return []
+    from ..backend.kvstore import STORE
+
+    if budget_s is None:
+        budget_s = _ms("H2O_TPU_WATCHDOG_JOB_BUDGET_MS")
+    now = time.time()
+    out = []
+    for job in STORE.values(jobs_mod.Job):
+        if not job.is_running() or not job.start_time:
+            continue
+        stale = now - job.last_beat
+        if stale > budget_s:
+            out.append({"subject": str(job.key),
+                        "desc": job.description,
+                        "stale_s": round(stale, 3),
+                        "budget_s": budget_s})
+    return out
+
+
+class Watchdog:
+    """One supervisor instance; the process singleton lives behind
+    :func:`ensure_started` (server boot arms it when the interval knob is
+    set), tests drive private instances via :meth:`sweep`."""
+
+    #: sweeps a (detector, subject) pair stays quiet after tripping — a
+    #: wedged job must not write a bundle per sweep
+    COOLDOWN_SWEEPS = 30
+
+    def __init__(self, interval_s: float | None = None):
+        from . import sanitizer
+
+        # resolved HERE (not in start) so the field is written once,
+        # before the sweep thread can read it
+        self.interval_s = (interval_s if interval_s is not None
+                           else knobs.get_int("H2O_TPU_WATCHDOG_MS")
+                           / 1000.0)
+        self._lock = sanitizer.make_lock("Watchdog._state")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sweeps = 0
+        #: (detector, subject) -> sweep number of the last trip
+        self._tripped: dict[tuple, int] = {}
+        #: last-seen Cleaner counter values for the thrash delta
+        self._spill0 = self._rehydrate0 = None
+        #: recent trips for /3/Health: detector -> (wall stamp, detail)
+        self.last_trips: dict[str, tuple[float, dict]] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Watchdog":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            # the supervisor outlives any request context and roots its
+            # own events — carrying a creator's trace would fabricate
+            # causality
+            self._thread = threading.Thread(  # graftlint: disable=thread-without-trace-context
+                target=self._run, daemon=True, name="h2o-watchdog")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            # join OUTSIDE the state lock (the sweep takes it)
+            t.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sweep()
+            except Exception as e:  # noqa: BLE001 — the supervisor must
+                from . import log   # outlive anything it observes
+
+                log.err(f"watchdog sweep failed: {e!r}")
+
+    # -- one sweep -----------------------------------------------------------
+    def sweep(self) -> dict:
+        """Run every detector once; returns {detector: [findings]} (tests
+        drive this directly — no thread required)."""
+        with self._lock:
+            self._sweeps += 1
+            sweep_no = self._sweeps
+        checks = {"hung-job": self._hung_jobs,
+                  "mrtask-stall": self._stalled_dispatch,
+                  "cleaner-thrash": self._cleaner_thrash,
+                  "queue-stall": self._queue_stall}
+        out: dict[str, list] = {}
+        for detector, gauge in DETECTORS:
+            forced = False
+            try:
+                # the drill seam: armed `watchdog.trip` turns this
+                # detector's evaluation into a forced positive (*4 drills
+                # all four in one sweep, @K exactly one)
+                failpoints.hit("watchdog.trip")
+            except failpoints.InjectedFault as e:
+                forced = True
+                findings = [{"subject": "drill", "forced": True,
+                             "hit": e.hit_no}]
+            if not forced:
+                try:
+                    findings = checks[detector]()
+                except Exception as e:  # noqa: BLE001 — a sick subsystem
+                    findings = []       # must not kill the other detectors
+                    from . import log
+
+                    log.err(f"watchdog {detector} check failed: {e!r}")
+            out[detector] = findings
+            telemetry.set_gauge(gauge, float(len(findings)))
+            for f in findings:
+                self._trip(detector, f, sweep_no)
+        return out
+
+    def _trip(self, detector: str, finding: dict, sweep_no: int) -> None:
+        key = (detector, finding.get("subject"))
+        with self._lock:
+            last = self._tripped.get(key)
+            if last is not None and sweep_no - last < self.COOLDOWN_SWEEPS:
+                return
+            self._tripped[key] = sweep_no
+            self.last_trips[detector] = (time.time(), dict(finding))
+        telemetry.inc("watchdog.trip.count")
+        timeline.record("watchdog", detector, **finding)
+        from . import flightrec, log
+
+        log.warn(f"watchdog tripped: {detector} {finding}")
+        # proactive bundle, job continues — dump() never raises; the
+        # watchdog thread holds no application locks here so the inline
+        # (non-async) write is safe and keeps bundle ordering deterministic
+        flightrec.dump(f"watchdog-{detector}")
+
+    def recent_trips(self, max_age_s: float | None = None) -> dict:
+        """{detector: {age_s, ...finding}} of trips newer than
+        ``max_age_s`` (default: 10 sweep intervals) — the /3/Health
+        degradation source; an old trip ages out to 'recovered'."""
+        if max_age_s is None:
+            max_age_s = (self.interval_s or
+                         knobs.get_int("H2O_TPU_WATCHDOG_MS") / 1000.0) * 10
+        now = time.time()
+        with self._lock:
+            items = dict(self.last_trips)
+        return {d: {"age_s": round(now - ts, 3), **f}
+                for d, (ts, f) in items.items()
+                if now - ts <= max_age_s}
+
+    # -- detectors -----------------------------------------------------------
+    def _hung_jobs(self) -> list[dict]:
+        return stale_running_jobs()
+
+    def _stalled_dispatch(self) -> list[dict]:
+        import sys
+
+        mr = sys.modules.get("h2o_tpu.parallel.mrtask")
+        if mr is None:
+            return []
+        budget_s = _ms("H2O_TPU_WATCHDOG_DISPATCH_BUDGET_MS")
+        now = time.monotonic()
+        out = []
+        for tid, (t0, fn) in list(mr.inflight_dispatches().items()):
+            if now - t0 > budget_s:
+                out.append({"subject": f"thread-{tid}", "fn": fn,
+                            "in_flight_s": round(now - t0, 3),
+                            "budget_s": budget_s})
+        return out
+
+    def _cleaner_thrash(self) -> list[dict]:
+        spill = telemetry.value("cleaner.spill.count")
+        rehydrate = telemetry.value("cleaner.rehydrate.count")
+        s0, r0 = self._spill0, self._rehydrate0
+        self._spill0, self._rehydrate0 = spill, rehydrate
+        if s0 is None:
+            return []
+        threshold = max(knobs.get_int("H2O_TPU_WATCHDOG_THRASH_OPS"), 1)
+        churn = min(spill - s0, rehydrate - r0)
+        if churn > threshold:
+            return [{"subject": "cleaner", "spills": spill - s0,
+                     "rehydrates": rehydrate - r0,
+                     "threshold": threshold}]
+        return []
+
+    def _queue_stall(self) -> list[dict]:
+        import sys
+
+        rt_mod = sys.modules.get("h2o_tpu.serving.runtime")
+        rt = getattr(rt_mod, "_RUNTIME", None) if rt_mod else None
+        if rt is None:
+            return []
+        budget_s = _ms("H2O_TPU_WATCHDOG_QUEUE_BUDGET_MS")
+        out = []
+        with rt._lock:
+            models = dict(rt._models)
+        for mid, served in models.items():
+            for rep in served.replicas.replicas:
+                wait = rep.batcher.oldest_wait_s()
+                if wait is not None and wait > budget_s:
+                    out.append({"subject": f"{mid}#r{rep.idx}",
+                                "oldest_wait_s": round(wait, 3),
+                                "depth": rep.batcher.depth,
+                                "budget_s": budget_s})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# process singleton
+# ---------------------------------------------------------------------------
+_DOG: Watchdog | None = None
+_DOG_LOCK = threading.Lock()
+
+
+def ensure_started() -> Watchdog | None:
+    """Arm the process watchdog when ``H2O_TPU_WATCHDOG_MS`` > 0 (server
+    boot calls this; idempotent). Returns the running instance or None
+    while disarmed."""
+    global _DOG
+    if knobs.get_int("H2O_TPU_WATCHDOG_MS") <= 0:
+        return _DOG
+    with _DOG_LOCK:
+        if _DOG is None:
+            _DOG = Watchdog().start()
+        return _DOG
+
+
+def instance() -> Watchdog | None:
+    """The running process watchdog, if armed (health checks read it)."""
+    return _DOG
+
+
+def stop() -> None:
+    """Tear down the singleton (tests)."""
+    global _DOG
+    with _DOG_LOCK:
+        dog, _DOG = _DOG, None
+    if dog is not None:
+        dog.stop()

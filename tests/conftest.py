@@ -144,6 +144,12 @@ def pytest_configure(config):
                    "registry, cross-process metric/trace merge, device "
                    "profiler capture, flight recorder, bench gate "
                    "(pytest -m fleetobs, tests/test_fleetobs.py)")
+    config.addinivalue_line(
+        "markers", "causal: causal observability — cross-process trace "
+                   "propagation, carry_context thread adoption, SLO/"
+                   "health plane, watchdog drills, tail-based slow-"
+                   "request capture (pytest -m causal, "
+                   "tests/test_causal_obs.py)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,42 @@
+"""Full REST server in its own process — the far side of the
+cross-process trace-propagation tests (tests/test_causal_obs.py).
+
+Unlike tests/fleet_worker.py (a stub that only serves /3/Metrics), this
+boots the REAL `api/server.py` stack: the test drives an actual train
+over the wire with a ``traceparent`` header attached, the server roots
+its request span under the remote parent, Job.start carries the context
+into the worker thread, and the GBM chunk spans land in THIS process's
+chrome-trace file — which `fleetobs.merge_traces` then joins with the
+client process's into one Perfetto session under one trace id.
+
+Env contract: the parent sets ``H2O_TPU_TRACE_DIR`` (this process's
+span export target) before spawning. Prints ``READY <port>`` once the
+socket listens; serves until killed.
+
+Usage: ``python tests/rest_server_worker.py [base_port]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# invoked by script path — the repo root (not tests/) must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    base_port = int(sys.argv[1]) if len(sys.argv) > 1 else 54920
+
+    from h2o_tpu.api.server import H2OServer
+
+    srv = H2OServer(port=base_port, name="trace_worker").start()
+    print(f"READY {srv.port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -272,6 +272,43 @@ def grab(workdir):
         do_work()
 """,
     ),
+    "thread-without-trace-context": (
+        """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from h2o_tpu.utils import telemetry
+
+def work():
+    with telemetry.span("worker.op"):
+        pass
+
+def spawn(items):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        list(ex.map(work, items))
+    return t
+""",
+        """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from h2o_tpu.utils import telemetry
+
+def work():
+    with telemetry.span("worker.op"):
+        pass
+
+def spawn(items):
+    t = threading.Thread(target=telemetry.carry_context(work),
+                         daemon=True)
+    t.start()
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        list(ex.map(telemetry.carry_context(work), items))
+    return t
+""",
+    ),
 }
 
 
@@ -300,6 +337,27 @@ def test_rule_suppressed_inline(rule_id):
     for ln in flagged:
         lines[ln - 1] += f"  # graftlint: disable={rule_id}"
     assert rule_id not in _rules_hit("\n".join(lines))
+
+
+def test_thread_without_trace_context_positional_form():
+    """Rule 24 on positional Thread(...) style: args[0] is GROUP — the
+    callable is args[1] (a carried positional target must stay clean, an
+    uncarried one must flag)."""
+    carried = """
+import threading
+
+from h2o_tpu.utils import telemetry
+
+def work():
+    with telemetry.span("w"):
+        pass
+
+def spawn():
+    threading.Thread(None, telemetry.carry_context(work)).start()
+"""
+    assert "thread-without-trace-context" not in _rules_hit(carried)
+    bare = carried.replace("telemetry.carry_context(work)", "work")
+    assert "thread-without-trace-context" in _rules_hit(bare)
 
 
 def test_use_after_donate_factory_and_ifexp_forms():
@@ -701,9 +759,9 @@ def test_every_rule_registered_exactly_once():
     from tools.graftlint import PROJECT_RULES
 
     ids = [cls.id for cls in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 15  # per-file rules
+    assert len(ids) == len(set(ids)) == 16  # per-file rules
     both = ids + [cls.id for cls in PROJECT_RULES]
-    assert len(both) == len(set(both)) == 19  # + interprocedural (v2)
+    assert len(both) == len(set(both)) == 20  # + interprocedural (v2)
 
 
 def test_direct_device_put_forms():

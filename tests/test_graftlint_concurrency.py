@@ -622,16 +622,17 @@ def test_ci_gate_script_exists_and_is_executable():
     assert "pytest" in text
 
 
-def test_rule_catalog_is_twenty_three():
+def test_rule_catalog_is_twenty_four():
     from tools.graftlint import DATAFLOW_RULES
 
     ids = ([cls.id for cls in ALL_RULES]
            + [cls.id for cls in PROJECT_RULES]
            + [cls.id for cls in DATAFLOW_RULES])
-    assert len(ids) == len(set(ids)) == 23
+    assert len(ids) == len(set(ids)) == 24
     assert {"unguarded-shared-field", "lock-order-cycle",
             "blocking-under-lock", "unjoined-thread",
-            "unscoped-profiler-capture"} <= set(ids)
+            "unscoped-profiler-capture",
+            "thread-without-trace-context"} <= set(ids)
 
 
 def test_rules_docs_name_real_constructs():

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# CI gate — graftlint (23 rules, baseline-gated) + the tier-1 pytest line,
+# CI gate — graftlint (24 rules, baseline-gated) + the tier-1 pytest line,
 # as ONE exit-coded command. Either failing fails the gate; both always
 # run so a single CI pass reports lint findings AND test failures.
 #
@@ -10,6 +10,9 @@
 #                                    #   gated vs BENCH_r06_baseline.jsonl
 #   tools/ci_gate.sh --sanitize-stress  # + serving+train+sweep stress with
 #                                    #   ALL FOUR sanitizer arms armed
+#   tools/ci_gate.sh --health-gate   # + boot a server, assert /3/Health
+#                                    #   ready -> wedged (typed reason) ->
+#                                    #   recovered across a failpoint drill
 #   GRAFTLINT_FORMAT=github tools/ci_gate.sh   # ::error annotations
 #   GRAFTLINT_JOBS=4 tools/ci_gate.sh          # parallel lint scan
 #
@@ -25,6 +28,13 @@
 # sidecar through tools/bench_gate.py: per-leg tolerance bands on wall,
 # peak HBM bytes, AUC, parity flags — nonzero exit names the regressed
 # (leg, metric). Band overrides: H2O_TPU_BENCH_GATE_BANDS.
+#
+# --health-gate boots a REAL server (watchdog armed at a 100ms sweep),
+# asserts GET /3/Health reports ready over the wire, arms the registered
+# watchdog.trip failpoint to force-wedge every detector, asserts the
+# endpoint degrades with the TYPED watchdog-trip reason, disarms, and
+# asserts recovery once the trips age out — the full signal path the
+# autoscaling loop will poll, exit-coded.
 #
 # --sanitize-stress re-runs the PR 11 serving+train+sweep stress pass
 # with H2O_TPU_SANITIZE=locks,guards,transfers,recompiles all armed
@@ -46,11 +56,13 @@ jobs="${GRAFTLINT_JOBS:-2}"
 bench_smoke=0
 bench_gate=0
 sanitize_stress=0
+health_gate=0
 for arg in "$@"; do
     case "$arg" in
         --bench-smoke) bench_smoke=1 ;;
         --bench-gate) bench_gate=1 ;;
         --sanitize-stress) sanitize_stress=1 ;;
+        --health-gate) health_gate=1 ;;
         *) echo "ci_gate.sh: unknown argument '$arg'" >&2; exit 2 ;;
     esac
 done
@@ -131,8 +143,62 @@ if [ "$sanitize_stress" -eq 1 ]; then
     stress_rc=$?
 fi
 
-echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc}, sanitize-stress rc=${stress_rc} =="
-if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ] || [ "$stress_rc" -ne 0 ]; then
+health_rc=0
+if [ "$health_gate" -eq 1 ]; then
+    echo "== health gate (/3/Health ready -> wedged -> recovered) =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        H2O_TPU_WATCHDOG_MS=100 \
+        python - <<'EOF'
+import json
+import time
+import urllib.request
+
+from h2o_tpu.api.server import H2OServer
+from h2o_tpu.utils import failpoints
+
+srv = H2OServer(port=54941).start()
+
+
+def health():
+    with urllib.request.urlopen(f"{srv.url}/3/Health", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+h = health()
+assert h["live"] and h["ready"], \
+    f"expected ready on boot, degraded: {h['degraded']}"
+
+# wedge: the registered watchdog.trip failpoint force-trips all four
+# detectors on the next sweep — nothing is actually wrong, which is the
+# point: the gate drills the SIGNAL path, not a real outage
+failpoints.arm("watchdog.trip", "raise*4")
+deadline = time.time() + 20
+while time.time() < deadline:
+    h = health()
+    if not h["ready"]:
+        break
+    time.sleep(0.1)
+assert not h["ready"], "health never degraded under the armed drill"
+reasons = {d["reason"] for d in h["degraded"]}
+assert "watchdog-trip" in reasons, f"wrong typed reasons: {reasons}"
+
+# recover: disarm, trips age out after 10 sweep intervals (~1s here)
+failpoints.disarm("watchdog.trip")
+deadline = time.time() + 30
+while time.time() < deadline:
+    h = health()
+    if h["ready"]:
+        break
+    time.sleep(0.2)
+assert h["ready"], f"health never recovered after disarm: {h['degraded']}"
+srv.stop()
+print(json.dumps({"health_gate": "ok"}))
+EOF
+    health_rc=$?
+fi
+
+echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc}, sanitize-stress rc=${stress_rc}, health rc=${health_rc} =="
+if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ] || [ "$stress_rc" -ne 0 ] || [ "$health_rc" -ne 0 ]; then
     exit 1
 fi
 exit 0

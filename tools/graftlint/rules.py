@@ -65,6 +65,17 @@ CHANGES.md entries):
    Perfetto), enforces one session per process, and guarantees
    stop_trace on every exit path — an ad-hoc start_trace leaks a
    session the next capture then cannot open.
+24. thread-without-trace-context — PR 15 (causal observability):
+   contextvars do not cross `threading.Thread(target=...)` starts or
+   executor submits, so a worker thread spawned in a span-bearing module
+   (one that imports `utils/telemetry`) mints ORPHAN trace ids for every
+   span it opens — the MicroBatcher and shadow-scorer spans silently
+   fell out of their requests' traces for two PRs before anyone noticed.
+   Thread targets and executor submissions in such modules must route
+   through `telemetry.carry_context(fn)` (capture-at-wrap semantics);
+   threads that legitimately own no causality (the REST acceptor, the
+   teardown thread, the watchdog) carry an inline suppression with the
+   reason. (Rules 20-23 are the dataflow pass in `dataflow.py`.)
 """
 
 from __future__ import annotations
@@ -1054,8 +1065,100 @@ class UnscopedProfilerCapture(Rule):
         return out
 
 
+class ThreadWithoutTraceContext(Rule):
+    id = "thread-without-trace-context"
+    doc = ("threading.Thread(target=...) / executor submit in a module "
+           "that imports utils/telemetry must wrap the callable in "
+           "telemetry.carry_context(...) — contextvars do not cross "
+           "thread starts, so the worker's spans orphan into fresh trace "
+           "ids (the MicroBatcher/shadow-scorer hole PR 15 closed)")
+
+    _MSG = ("worker thread/submit in a span-bearing module without "
+            "telemetry.carry_context() — the thread's spans will mint "
+            "orphan trace ids instead of nesting under the submitter's "
+            "(wrap the target: Thread(target=telemetry.carry_context(fn)) "
+            "/ ex.submit(telemetry.carry_context(fn), ...); threads that "
+            "own no causality suppress inline with the reason)")
+
+    @staticmethod
+    def _bears_spans(tree) -> bool:
+        """Module imports utils/telemetry (module- or function-level) —
+        the modules whose spans can orphan."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if any(a.name == "telemetry" for a in node.names) or \
+                        (node.module or "").endswith("telemetry"):
+                    return True
+            elif isinstance(node, ast.Import):
+                if any(a.name.endswith(".telemetry") for a in node.names):
+                    return True
+        return False
+
+    @staticmethod
+    def _is_carried(node) -> bool:
+        """True when the callable expression routes through
+        carry_context (telemetry.carry_context(fn) or an alias of it)."""
+        if not isinstance(node, ast.Call):
+            return False
+        dn = dotted_name(node.func)
+        return bool(dn) and dn.rsplit(".", 1)[-1] == "carry_context"
+
+    def _executor_vars(self, tree, ctx) -> set:
+        """Names bound to ThreadPoolExecutor/ProcessPoolExecutor
+        instances — via assignment or `with ...() as ex:`."""
+        out = set()
+
+        def _note(target, value):
+            if isinstance(target, ast.Name) and isinstance(value, ast.Call):
+                dn = normalize(dotted_name(value.func), ctx.aliases) or ""
+                if dn.rsplit(".", 1)[-1] in ("ThreadPoolExecutor",
+                                             "ProcessPoolExecutor"):
+                    out.add(target.id)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    _note(t, node.value)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        _note(item.optional_vars, item.context_expr)
+        return out
+
+    def check(self, tree, ctx):
+        if not ctx.relpath.startswith("h2o_tpu/"):
+            return []           # the span-bearing tree; tests/tools spawn
+        if ctx.relpath == TELEMETRY_PATH:
+            return []           # carry_context's own home
+        if not self._bears_spans(tree):
+            return []
+        out = []
+        executors = self._executor_vars(tree, ctx)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = normalize(dotted_name(node.func), ctx.aliases) or ""
+            if dn == "threading.Thread" or dn.endswith(".threading.Thread"):
+                # positional signature is Thread(group, target, ...) —
+                # args[0] is GROUP, the callable is args[1]
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"),
+                              node.args[1] if len(node.args) > 1 else None)
+                if target is not None and not self._is_carried(target):
+                    out.append(self.violation(ctx, node, self._MSG))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("submit", "map") \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in executors:
+                fn = node.args[0] if node.args else None
+                if fn is not None and not self._is_carried(fn):
+                    out.append(self.violation(ctx, node, self._MSG))
+        return out
+
+
 ALL_RULES = (DirectShardMap, DirectPallasCall, DirectDevicePut, PSpecConcat,
              NarrowIntAccumulate, UntrackedResident, TimingWithoutSync,
              HostSyncInTrace, NondeterminismInTrace, UnregisteredKnob,
              UnregisteredFailpoint, SwallowedRetryable, UnregisteredMetric,
-             UseAfterDonate, UnscopedProfilerCapture)
+             UseAfterDonate, UnscopedProfilerCapture,
+             ThreadWithoutTraceContext)
