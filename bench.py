@@ -395,6 +395,142 @@ def bench_recovery(nrow: int, ntrees: int) -> dict:
                      "resume_bit_parity true")}
 
 
+def bench_workload(nrow: int, n_tenants: int) -> dict:
+    """Multi-tenant scheduler leg: N tenants × (ingest + train + score)
+    contending for 2 managed slots under weighted fair-share dispatch,
+    with a failpoint-injected chunk-boundary preemption (auto-resumed by
+    the maintenance thread) and one injected shed decision. Records
+    per-tenant ingest/train walls, scoring p99, queue-wait burn and
+    preemption counts — the numbers the multi-tenant acceptance bands
+    gate on (all tenants complete, preemption observed and healed)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from h2o_tpu import workload
+    from h2o_tpu.backend.kvstore import STORE
+    from h2o_tpu.models.gbm import GBM, GBMParameters
+    from h2o_tpu.utils import failpoints, knobs
+    from h2o_tpu.workload import tenants
+
+    prev = {k: knobs.raw(k) for k in ("H2O_TPU_WORKLOAD_SLOTS",
+                                      "H2O_TPU_WORKLOAD_TICK_MS",
+                                      "H2O_TPU_CHECKPOINT_SECS")}
+    os.environ["H2O_TPU_WORKLOAD_SLOTS"] = "2"
+    os.environ["H2O_TPU_WORKLOAD_TICK_MS"] = "100"
+    os.environ["H2O_TPU_CHECKPOINT_SECS"] = "0"
+    names = [f"tenant{i}" for i in range(n_tenants)]
+    for i, name in enumerate(names):
+        tenants.configure(name, weight=float(n_tenants - i))
+    failpoints.reset()
+    # one boundary somewhere in the contending builds preempts — the
+    # manager must park + auto-resume it while the others keep running
+    failpoints.arm("workload.preempt", "raise(preempt)@3")
+    mgr = workload.manager()
+    per_tenant: dict = {}
+    rdirs: list = []
+    lock = threading.Lock()
+    t_leg = time.time()
+
+    def one_tenant(i: int, name: str) -> None:
+        rec: dict = {}
+        t0 = time.time()
+        fr = _higgs_frame(nrow)                       # per-tenant ingest
+        rec["ingest_s"] = round(time.time() - t0, 3)
+        rdir = tempfile.mkdtemp(prefix=f"h2o_tpu_bench_wl_{name}_")
+        with lock:
+            rdirs.append(rdir)
+        params = GBMParameters(
+            training_frame=fr, response_column="response", ntrees=10,
+            max_depth=4, nbins=20, learn_rate=0.1, seed=42 + i,
+            score_tree_interval=2, auto_recovery_dir=rdir)
+        t0 = time.time()
+        with tenants.request_scope(
+                name, "interactive" if i == 0 else "batch"):
+            job = GBM(params).train(background=True)
+        eid = None
+        deadline = time.time() + 600
+        model = None
+        while time.time() < deadline:
+            with mgr._lock:
+                entries = mgr._live_entries() + list(mgr._done)
+            if eid is None:
+                mine = [e for e in entries
+                        if e.job is not None and e.job.key == job.key]
+                eid = mine[0].id if mine else None
+            ent = next((e for e in entries if e.id == eid), None)
+            if ent is not None and ent.state == "FINISHED" \
+                    and ent.job.status == "DONE":
+                model = STORE.get(str(ent.job.dest_key))
+                rec["preemptions"] = ent.preempt_count
+                break
+            time.sleep(0.1)
+        rec["train_wall_s"] = round(time.time() - t0, 3)
+        rec["completed"] = model is not None
+        if model is not None:
+            adapted = model.adapt_frame(fr)
+            walls = []
+            for _ in range(20):
+                t0 = time.time()
+                np.asarray(model.score0(adapted))
+                walls.append(time.time() - t0)
+            rec["score_p99_ms"] = round(
+                float(np.percentile(walls, 99)) * 1000.0, 3)
+        with lock:
+            per_tenant[name] = rec
+
+    threads = [threading.Thread(target=one_tenant, args=(i, n),
+                                name=f"bench-wl-{n}")
+               for i, n in enumerate(names)]
+    shed_decisions: list = []
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        # an injected serving-pressure health snapshot mid-contention:
+        # the policy picks WHICH tenant sheds (typed decision string)
+        shed_decisions = mgr.shed_check(
+            {"degraded": [{"check": "serving",
+                           "reason": "serving-queue-saturation"}],
+             "slo": {}})
+        for t in threads:
+            t.join(timeout=900)
+    finally:
+        failpoints.reset()
+        mgr.stop()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for d in rdirs:
+            shutil.rmtree(d, ignore_errors=True)
+        gc.collect()
+
+    snap = workload.snapshot()
+    for name in names:
+        if name in per_tenant:
+            per_tenant[name]["burn"] = snap["tenants"][name]["burn"]
+    preempts = sum(r.get("preemptions", 0) for r in per_tenant.values())
+    return {"rows": nrow, "tenants": n_tenants, "slots": 2,
+            "per_tenant": per_tenant,
+            "total_wall_s": round(time.time() - t_leg, 3),
+            "score_p99_ms_max": max(
+                (r["score_p99_ms"] for r in per_tenant.values()
+                 if "score_p99_ms" in r), default=None),
+            "preemptions_total": preempts,
+            "preemption_observed": preempts >= 1,
+            "shed_decisions": shed_decisions,
+            "all_completed": (len(per_tenant) == n_tenants
+                              and all(r.get("completed")
+                                      for r in per_tenant.values())),
+            "note": ("N tenants × (ingest+train+score) over 2 managed "
+                     "slots; acceptance: all_completed, "
+                     "preemption_observed (injected kill auto-resumed)")}
+
+
 def bench_gbm(fr, ntrees: int, skip_cadence: bool) -> dict:
     from h2o_tpu.models.gbm import GBM, GBMParameters
 
@@ -1228,6 +1364,10 @@ def main():
         _leg(workloads, "recovery", lambda: bench_recovery(
             knobs.get_int("H2O_TPU_BENCH_RECOVERY_ROWS"),
             min(ntrees, 20)))
+    if "workload" in wanted:
+        _leg(workloads, "workload", lambda: bench_workload(
+            knobs.get_int("H2O_TPU_BENCH_WORKLOAD_ROWS"),
+            knobs.get_int("H2O_TPU_BENCH_WORKLOAD_TENANTS")))
     if "cold_start" in wanted:
         _leg(workloads, "cold_start", lambda: bench_cold_start(
             knobs.get_int("H2O_TPU_BENCH_COLDSTART_ROWS")))

@@ -203,6 +203,12 @@ class H2OConnection:
         tp = telemetry.current_traceparent()
         if tp is not None:
             hdrs.setdefault("traceparent", tp)
+        # tenant attribution: the H2O_TPU_TENANT knob stamps every request
+        # so the server-side workload manager books admission, fair-share
+        # tickets and per-tenant metrics against this client's tenant.
+        tenant = knobs.get_str("H2O_TPU_TENANT")
+        if tenant:
+            hdrs.setdefault("X-H2O-TPU-Tenant", tenant)
         if not keepalive:
             hdrs["Connection"] = "close"
         try:
@@ -974,6 +980,28 @@ def health() -> dict:
     SLO burn). ``ready`` is the poll target for autoscalers and rollout
     gates; ``degraded`` names exactly what is wrong."""
     return connection().request("GET", "/3/Health")
+
+
+def workload() -> dict:
+    """`GET /3/Workload` — the multi-tenant workload manager snapshot:
+    tenants (weights, quota fractions, preempt/shed/reject counters),
+    scheduler entries with their QUEUED/RUNNING/PARKED/FINISHED state,
+    and the dispatch configuration (slots, seed)."""
+    return connection().request("GET", "/3/Workload")
+
+
+def workload_configure(tenant: str, weight: float | None = None,
+                       quota_fraction: float | None = None) -> dict:
+    """`POST /3/Workload` — configure a tenant's fair-share weight
+    (lottery tickets relative to other tenants) and/or HBM quota
+    fraction (share of the reservation ledger admission debits against).
+    Returns the refreshed workload snapshot."""
+    body: dict = {"tenant": tenant}
+    if weight is not None:
+        body["weight"] = float(weight)
+    if quota_fraction is not None:
+        body["quota_fraction"] = float(quota_fraction)
+    return connection().request("POST", "/3/Workload", data=body)
 
 
 def slow_traces(limit: int | None = None) -> list:

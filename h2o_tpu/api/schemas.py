@@ -90,6 +90,8 @@ def job_schema(job: Job) -> dict:
         "dest": key_schema(job.dest_key) if job.dest_key else None,
         "exception": None if job.exception is None else repr(job.exception),
         "stacktrace": job.traceback,
+        "tenant": job.tenant,
+        "priority": job.priority,
     }
 
 
@@ -371,6 +373,14 @@ def health_schema(snap: dict) -> dict:
     cleaned. ``ready``/``live`` and the typed ``degraded`` reasons are
     the contract autoscalers and rollout gates switch on; ``checks`` and
     the per-SLO ``slo`` burn block carry the supporting numbers."""
+    return _clean(dict(snap))
+
+
+def workload_schema(snap: dict) -> dict:
+    """The `GET /3/Workload` payload (workload/manager.py snapshot),
+    JSON-cleaned. ``tenants`` carries weights/quotas/counters, ``entries``
+    the per-job scheduler state (QUEUED/RUNNING/PARKED/FINISHED) and
+    ``slots``/``seed`` the dispatch configuration."""
     return _clean(dict(snap))
 
 

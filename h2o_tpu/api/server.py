@@ -34,6 +34,7 @@ from ..frame.frame import Frame
 from ..frame.vec import Vec
 from ..models import registry
 from ..rapids.exec import Rapids, Session
+from ..workload import WorkloadAdmissionError
 from . import schemas
 
 _SESSIONS: dict[str, Session] = {}
@@ -337,7 +338,7 @@ def _make_handler(server: H2OServer):
             head = parts[1] if len(parts) > 1 else (parts[0] if parts else "")
             is_monitor_poll = head in ("Cloud", "Ping", "Jobs",
                                        "SteamMetrics", "Sample", "Health",
-                                       "Timeline")
+                                       "Timeline", "Workload")
             if not is_monitor_poll:
                 server.last_activity = time.time()
             if method == "POST" and parts and \
@@ -381,8 +382,17 @@ def _make_handler(server: H2OServer):
                     body = (self._body() if method in ("POST", "PUT")
                             else {})
                     failpoints.hit("rest.route")
-                    status, payload = route(server, method, parts, query,
-                                            body)
+                    # tenant identity rides the request: every Job/quota
+                    # decision under this route sees the caller's tenant
+                    # (X-H2O-TPU-Tenant, attached by api/client.py) and
+                    # requested priority lane
+                    from ..workload import tenants as _tenants
+
+                    with _tenants.request_scope(
+                            self.headers.get("X-H2O-TPU-Tenant"),
+                            self.headers.get("X-H2O-TPU-Priority")):
+                        status, payload = route(server, method, parts,
+                                                query, body)
                 except failpoints.InjectedHTTPError as e:
                     # deterministic flaky-server injection: reply the
                     # injected status; 429/503 carry Retry-After so client
@@ -391,6 +401,18 @@ def _make_handler(server: H2OServer):
                     if e.status in (429, 503):
                         payload["__headers__"] = {
                             "Retry-After": f"{e.retry_after_s:g}"}
+                except WorkloadAdmissionError as e:
+                    # over-quota tenant submission — ONE central mapping
+                    # covers every submitting route (model builds, grids,
+                    # AutoML): retryable-later, other tenants untouched
+                    status, payload = _err(
+                        429, str(e), error_type="quota_rejected",
+                        tenant=e.tenant,
+                        retry_after_s=round(e.retry_after_s, 3),
+                        cost_bytes=e.cost_bytes,
+                        quota_bytes=e.quota_bytes)
+                    payload["__headers__"] = {
+                        "Retry-After": max(1, int(np.ceil(e.retry_after_s)))}
                 except KeyError as e:
                     status, payload = _err(404, str(e))
                 except (ValueError, TypeError) as e:
@@ -2235,7 +2257,13 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
             aml.train(y=y, training_frame=fr, job=job)
             return aml
 
-        job.start(run_automl, background=True)
+        # managed dispatch: the AutoML run is tenant-stamped from the
+        # request scope, lane-classed, and quota-checked like any build
+        from .. import workload as _workload
+
+        _workload.submit(job, run_automl, background=True,
+                         cost_bytes=_workload.frame_cost(fr),
+                         priority=aml.priority)
         return 200, {"job": schemas.job_schema(job),
                      "build_control": {"project_name": aml.key}}
     if head == "AutoML" and rest[1:]:
@@ -2394,6 +2422,23 @@ def route(server: H2OServer, method: str, parts: list[str], query: dict,
 
         snap = _health.snapshot()
         return 200, schemas.health_schema(snap)
+    if head == "Workload":
+        # the multi-tenant scheduler surface (h2o_tpu/workload/): GET =
+        # tenants/quotas/lanes/per-tenant burn + every live entry; POST =
+        # configure a tenant's fair-share weight / quota fraction
+        from .. import workload as _workload
+
+        if method == "POST":
+            name = p.get("tenant") or ""
+            if not name:
+                return _err(400, "POST /3/Workload needs 'tenant'")
+            w = p.get("weight")
+            q = p.get("quota_fraction")
+            _workload.tenants.configure(
+                name,
+                weight=None if w in (None, "") else float(w),
+                quota_fraction=None if q in (None, "") else float(q))
+        return 200, schemas.workload_schema(_workload.snapshot())
     if head == "SlowTraces":
         # the tail-based capture ring (utils/slowtrace.py): full span
         # trees + program dispatch walls of requests that breached their
@@ -2693,6 +2738,13 @@ _ROUTES_DOC = [
          "liveness/readiness with typed degradation reasons + SLO burn "
          "(devices, Cleaner headroom, serving queues, job heartbeats, "
          "watchdog trips)"),
+        ("GET", "/3/Workload",
+         "multi-tenant workload manager snapshot: tenants (weights, "
+         "quotas, preempt/shed counters), scheduler entries and dispatch "
+         "configuration"),
+        ("POST", "/3/Workload",
+         "configure a tenant: weight (fair-share tickets) and "
+         "quota_fraction (share of the HBM reservation ledger)"),
         ("GET", "/3/SlowTraces",
          "tail-based slow-request capture ring: span trees + program "
          "dispatch walls of SLO p99 breachers"),

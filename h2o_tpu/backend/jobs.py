@@ -21,6 +21,20 @@ class JobCancelled(Exception):
     pass
 
 
+class JobPreempted(Exception):
+    """Cooperative chunk-boundary preemption (h2o_tpu/workload/): the
+    training loop observed a preempt request at a safe boundary, force-
+    checkpointed its state and unwound. Unlike a cancel, the work is NOT
+    lost — ``recovery_dir`` names the checkpoint ``resume_training``
+    replays to a bit-equal model once the job is re-admitted."""
+
+    def __init__(self, job_key: str, recovery_dir: str | None):
+        self.recovery_dir = recovery_dir
+        super().__init__(
+            f"{job_key} preempted at a chunk boundary"
+            + (f" (state parked in {recovery_dir})" if recovery_dir else ""))
+
+
 class JobTimeoutError(Exception):
     """Typed wall-clock expiry: raised by ``Job.join(timeout=...)`` when the
     wait runs out, and by ``Job.check_max_runtime()`` when the
@@ -101,6 +115,12 @@ class Job(Keyed):
     DONE = "DONE"
     FAILED = "FAILED"
     CANCELLED = "CANCELLED"
+    PREEMPTED = "PREEMPTED"
+
+    #: priority classes, strongest first — the reference's H2O.submitTask
+    #: priority queues collapsed to four lanes. The workload manager's
+    #: lottery weights and arrival-preemption both key off the ordinal.
+    PRIORITIES = ("realtime", "interactive", "batch", "background")
 
     def __init__(self, description: str = "", work: float = 1.0, dest_key: str | None = None):
         from ..utils import sanitizer
@@ -124,6 +144,18 @@ class Job(Keyed):
         self._stop_requested = False
         self._thread: threading.Thread | None = None
         self.result: Any = None
+        #: workload-manager identity (h2o_tpu/workload/): which tenant
+        #: owns this job and which priority lane it dispatches under.
+        #: Stamped at submit; "default"/"batch" for legacy direct starts.
+        self.tenant = "default"
+        self.priority = "batch"
+        #: True once the builder armed auto-recovery — only then can a
+        #: preempt request be honored without losing work
+        self.preemptible = False
+        self._preempt_requested = False
+        #: checkpoint dir captured when a preemption lands (the resume
+        #: handle /3/Jobs pollers and the manager read back)
+        self.preempt_dir: str | None = None
         #: last progress heartbeat (wall clock) — refreshed by update()
         #: and check_cancelled(), i.e. at every chunk/epoch boundary; the
         #: watchdog's hung-job detector and /3/Health's job check read it
@@ -159,6 +191,12 @@ class Job(Keyed):
             except JobCancelled:
                 with self._lock:
                     self.status = Job.CANCELLED
+            except JobPreempted as e:
+                # not a failure: the boundary checkpointed, the workload
+                # manager parks the entry and resumes it bit-equal later
+                with self._lock:
+                    self.preempt_dir = e.recovery_dir
+                    self.status = Job.PREEMPTED
             except BaseException as e:  # noqa: BLE001 - mirror of Job exception capture
                 with self._lock:
                     self.exception = e
@@ -259,6 +297,25 @@ class Job(Keyed):
     def stop_requested(self) -> bool:
         with self._lock:
             return self._stop_requested
+
+    # -- preemption (h2o_tpu/workload/) --------------------------------------
+    def request_preempt(self) -> None:
+        """Ask the running builder to yield at its NEXT chunk/epoch
+        boundary (model_base._recovery_tick polls this). Cooperative like
+        stop(), but the builder checkpoints and raises ``JobPreempted``
+        instead of discarding work. A no-op on non-preemptible jobs —
+        the boundary poll ignores the flag when no recovery is armed."""
+        with self._lock:
+            self._preempt_requested = True
+
+    @property
+    def preempt_requested(self) -> bool:
+        with self._lock:
+            return self._preempt_requested
+
+    def clear_preempt(self) -> None:
+        with self._lock:
+            self._preempt_requested = False
 
     def check_cancelled(self) -> None:
         """Builders call this between iterations; raises to unwind the

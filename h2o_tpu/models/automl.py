@@ -304,8 +304,10 @@ class H2OAutoML(Keyed):
                  stopping_metric: str = "AUTO",
                  keep_cross_validation_predictions: bool = True,
                  modeling_plan: list | None = None,
-                 ignored_columns: list | None = None):
+                 ignored_columns: list | None = None,
+                 priority: str = "batch"):
         super().__init__(key=project_name, prefix="automl")
+        self.priority = priority     # workload lane the plan runs under
         self.ignored_columns = list(ignored_columns or [])
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0  # the reference's default total budget
@@ -392,9 +394,27 @@ class H2OAutoML(Keyed):
         if job is not None:
             job.work = float(len(self.plan))
             self.job = job
+            self._run_plan(log)
         else:
-            self.job = Job("AutoML", work=float(len(self.plan)))
+            # direct-API runs get a REAL started job too: the plan loop
+            # dispatches through the workload manager (priority-laned,
+            # tenant-stamped, heartbeating via the per-step updates, and
+            # visible in /3/Workload) instead of an orphan Job that
+            # never left CREATED
+            from .. import workload
 
+            self.job = Job("AutoML", work=float(len(self.plan)))
+            workload.submit(self.job, lambda: self._run_plan(log),
+                            background=False,
+                            cost_bytes=workload.frame_cost(training_frame),
+                            priority=self.priority)
+            self.job.join()      # surface a failed step loop typed
+        log.log("Workflow",
+                f"AutoML build done: {self._model_count()} models, "
+                f"leader={self.leader.key if self.leader else None}")
+        return self
+
+    def _run_plan(self, log) -> None:
         for step in self.plan:
             if self._budget_exhausted(step):
                 log.log("Workflow", f"budget exhausted; skipping {step.algo}_{step.id}")
@@ -416,10 +436,6 @@ class H2OAutoML(Keyed):
                         f"({self.leaderboard.sort_metric}="
                         f"{self.leaderboard._metric(m, self.leaderboard.sort_metric)})")
             self.job.update(1.0)
-        log.log("Workflow",
-                f"AutoML build done: {self._model_count()} models, "
-                f"leader={self.leader.key if self.leader else None}")
-        return self
 
     # -- results -------------------------------------------------------------
     @property

@@ -111,7 +111,7 @@ class GridSearch:
     def __init__(self, builder_cls, params, hyper_params: dict,
                  search_criteria: SearchCriteria | None = None,
                  recovery_dir: str | None = None, parallelism: int = 1,
-                 grid_id: str | None = None):
+                 grid_id: str | None = None, priority: str = "batch"):
         self.builder_cls = builder_cls
         self.base_params = params
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
@@ -119,6 +119,7 @@ class GridSearch:
         self.recovery_dir = recovery_dir
         self.parallelism = max(1, int(parallelism))  # ParallelModelBuilder
         self.grid_id = grid_id
+        self.priority = priority     # workload lane the search runs under
         self._recovered_models: list = []
         self._recovered_done: list = []
 
@@ -206,7 +207,17 @@ class GridSearch:
 
                 combos = [o for o in self._walk() if not skip(o)]
                 with cf.ThreadPoolExecutor(max_workers=self.parallelism) as ex:
-                    futs = {ex.submit(build_one, o): o for o in combos}
+                    # each candidate runs under a COPY of this thread's
+                    # context, so the workload scope (tenant, priority,
+                    # the managed slot the grid occupies) and the trace
+                    # context follow the build into the pool — without
+                    # it, candidates would re-enter the scheduler as
+                    # anonymous top-level submissions and deadlock a
+                    # bounded slot count against their own parent
+                    import contextvars
+
+                    futs = {ex.submit(contextvars.copy_context().run,
+                                      build_one, o): o for o in combos}
                     try:
                         for fut in cf.as_completed(futs):
                             if (job.stop_requested
@@ -238,7 +249,14 @@ class GridSearch:
                     break
             return grid
 
-        job.start(run, background=background)
+        # the search dispatches through the workload manager like any
+        # training job: tenant-stamped, priority-laned, visible in
+        # /3/Workload; candidate builds run nested inside its slot
+        from .. import workload
+
+        workload.submit(job, run, background=background,
+                        cost_bytes=workload.frame_cost(self.base_params),
+                        priority=self.priority)
         return job if background else job.join()
 
     # -- auto-recovery (`hex/faulttolerance/Recovery.java`) -------------------

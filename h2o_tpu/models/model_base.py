@@ -503,14 +503,15 @@ class ModelBuilder:
             return model
 
         def run_guarded():
-            from ..backend.jobs import JobCancelled
+            from ..backend.jobs import JobCancelled, JobPreempted
 
             try:
                 return run()
-            except JobCancelled:
-                # a user cancel is a HANDLED outcome (Job maps it to
-                # status CANCELLED), not a terminal event — bundling it
-                # would rotate real crash bundles out of the flight dir
+            except (JobCancelled, JobPreempted):
+                # a user cancel / boundary preemption is a HANDLED
+                # outcome (Job maps them to CANCELLED / PREEMPTED), not
+                # a terminal event — bundling it would rotate real crash
+                # bundles out of the flight dir
                 raise
             except Exception as e:  # noqa: BLE001 — re-raised verbatim
                 # unhandled training crash: flight-record the terminal
@@ -522,7 +523,15 @@ class ModelBuilder:
                 flightrec.dump("train-crash", e)
                 raise
 
-        self.job.start(run_guarded, background=background)
+        # every training build dispatches through the workload manager:
+        # tenant stamped + quota debited, and under H2O_TPU_WORKLOAD_SLOTS
+        # the job queues for the fair-share lottery instead of starting
+        # unconditionally. Unmanaged (the default) this is exactly the
+        # old self.job.start(run_guarded, background) dispatch.
+        from .. import workload
+
+        workload.submit(self.job, run_guarded, background=background,
+                        cost_bytes=workload.frame_cost(self.params))
         return self.job
 
     def train_model(self) -> Model:
@@ -581,6 +590,9 @@ class ModelBuilder:
                  f"unusable ({e!r})")
             return
         self._recovery = rec
+        # armed recovery is what makes boundary preemption lossless —
+        # only now may the workload manager preempt this job
+        self.job.preemptible = True
 
     def _recovery_tick(self, state_fn, progress: dict | None = None) -> None:
         """Builders call this at every iteration boundary they can resume
@@ -589,6 +601,7 @@ class ModelBuilder:
         iteration state — device arrays welcome, they are pulled to host by
         the writer — such that restoring it and replaying the remaining
         iterations is bit-equal to never having stopped."""
+        self._preempt_tick(state_fn, progress)
         rec = self._recovery
         if rec is None or not rec.due():
             return
@@ -611,6 +624,40 @@ class ModelBuilder:
             warn(f"auto-recovery disabled mid-train: checkpoint write to "
                  f"{rec.dir!r} failed ({e!r})")
             self._recovery = None
+
+    def _preempt_tick(self, state_fn, progress: dict | None = None) -> None:
+        """The workload preemption poll, riding the same boundaries as
+        the checkpoint tick: a preempt request (Job.request_preempt or
+        the ``workload.preempt`` failpoint) observed here force-
+        checkpoints the iteration state — bypassing the due() interval,
+        a preemption cannot wait for the clock — and unwinds with the
+        typed ``JobPreempted`` the Job/manager park on. Ignored when no
+        recovery is armed: a non-preemptible job never loses work."""
+        from ..utils import failpoints
+
+        job = self.job
+        want = job is not None and job.preempt_requested
+        try:
+            failpoints.hit("workload.preempt")
+        except failpoints.InjectedFault:
+            # the injection IS the preempt request (raise(preempt)@K =
+            # "preempt exactly before boundary K"), consumed here
+            want = True
+        if not want:
+            return
+        rec = self._recovery
+        if rec is None:
+            return
+        from ..utils import telemetry
+
+        rec.save_state(state_fn(), progress)
+        telemetry.inc("train.checkpoint.count")
+        telemetry.inc("workload.preempt.count")
+        if job is not None:
+            job.clear_preempt()
+        from ..backend.jobs import JobPreempted
+
+        raise JobPreempted(str(job.key) if job else "<no job>", rec.dir)
 
     def _take_resume_state(self):
         """The iteration state `resume_training` injected (None on a fresh

@@ -180,12 +180,18 @@ def _dispatch(map_fn, mesh, nrow, reduce_key, arrays, out_rows: bool):
     import time
 
     from ..utils import sanitizer, telemetry
+    from ..workload import fairshare
 
     in_bytes = sum(getattr(a, "nbytes", 0) for a in arrays)
     fn_name = getattr(map_fn, "__name__", "map_fn")
     tid = threading.get_ident()
-    with telemetry.span("mrtask.dispatch", metric="mrtask.dispatch.seconds",
-                        fn=fn_name, rows=nrow, in_bytes=in_bytes) as sp:
+    # tenant fair-share over the dispatch choke point: under
+    # H2O_TPU_WORKLOAD_DISPATCH_SLOTS, concurrent drivers queue here and
+    # wake lowest-virtual-time-first so one tenant's dispatch storm
+    # cannot starve another's; free (one int read) when the knob is 0
+    with fairshare.dispatch_slot(), \
+            telemetry.span("mrtask.dispatch", metric="mrtask.dispatch.seconds",
+                           fn=fn_name, rows=nrow, in_bytes=in_bytes) as sp:
         _INFLIGHT[tid] = (time.monotonic(), fn_name)
         try:
             with sp.phase("build"):

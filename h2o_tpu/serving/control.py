@@ -164,6 +164,14 @@ class ControlPlane:
                         pl.placed = True
                         pl.evictions -= 1
                     telemetry.inc("serving.admission.rejected.count")
+                    # serving placement is the hottest arrival there is:
+                    # ask the workload tier to preempt its weakest
+                    # running training job at the next chunk boundary so
+                    # the client's Retry-After retry finds the HBM free.
+                    # No-op (one module-global read) without a manager.
+                    from .. import workload as _workload
+
+                    _workload.note_serving_pressure()
                     raise AdmissionError(model_id, cost_bytes, budget, used)
             pl = Placement(model_id, priority, replicas, cost_bytes)
             if prior is not None:

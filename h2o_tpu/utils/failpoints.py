@@ -186,6 +186,12 @@ _failpoint("flightrec.dump",
            "— arm raise@K to force a flight-recorder bundle at an exact "
            "iteration without a real crash; the injected fault is "
            "consumed by the recorder, the job continues")
+_failpoint("workload.preempt",
+           "workload preemption poll at every training chunk/epoch "
+           "boundary (model_base._recovery_tick) — raise@K preempts the "
+           "job exactly before boundary K trains: state force-"
+           "checkpointed, HBM released through the ledger, job parked "
+           "PREEMPTED; resume_training replays to a bit-equal model")
 
 
 # ---------------------------------------------------------------------------

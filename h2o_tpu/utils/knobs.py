@@ -345,6 +345,52 @@ _knob("H2O_TPU_HEALTH_BURN_MAX", "int", 10,
       "SLO's burn rate exceeds this multiple of its error budget "
       "(burn 1.0 = exactly consuming the budget)")
 
+# -- workload manager (h2o_tpu/workload/) ------------------------------------
+_knob("H2O_TPU_TENANT", "str", "",
+      "tenant this process submits work as (workload/tenants.py); the "
+      "client attaches it as the X-H2O-TPU-Tenant request header, the "
+      "server scopes each request's jobs/quota to it; empty = the "
+      "'default' tenant (legacy single-tenant callers)")
+_knob("H2O_TPU_WORKLOAD_SLOTS", "int", 0,
+      "concurrent managed jobs the workload manager dispatches "
+      "(workload/manager.py); excess submissions queue and drain under "
+      "weighted fair-share, and preempted jobs auto-resume when a slot "
+      "frees; 0 = unmanaged (every submit dispatches immediately — the "
+      "legacy single-tenant behavior, no queueing, no auto-resume)")
+_knob("H2O_TPU_WORKLOAD_SEED", "int", 42,
+      "seed for the fair-share dispatch lottery (splitmix64, the PR 8 "
+      "router construction) — same seed + same submission sequence = "
+      "same dispatch order")
+_knob("H2O_TPU_WORKLOAD_AGING", "int", 8,
+      "starvation bound for the fair-share lottery: an entry that loses "
+      "this many consecutive drawings is force-dispatched next, so the "
+      "worst-case queue delay is bounded deterministically")
+_knob("H2O_TPU_WORKLOAD_QUOTA", "str", "",
+      "per-tenant HBM quota fractions as comma-separated "
+      "'<tenant>=<frac>' pairs (e.g. 'team-a=0.5,team-b=0.25'); each "
+      "fraction is taken of backend/memory.py base_hbm_limit_bytes() "
+      "and debited through the one reservation ledger; unlisted tenants "
+      "are unlimited; ignored entirely when no HBM budget resolves")
+_knob("H2O_TPU_WORKLOAD_TICK_MS", "int", 1000,
+      "workload maintenance cadence: how often the manager re-pumps the "
+      "queue, re-admits parked (preempted) jobs and evaluates the "
+      "SLO/health shed policy while managed work exists")
+_knob("H2O_TPU_WORKLOAD_SHED_BURN", "int", 0,
+      "shed policy trigger: when slo.worst_burn exceeds this multiple "
+      "(or /3/Health degrades with cleaner-headroom / "
+      "serving-queue-saturation), the highest-pressure tenant's lowest-"
+      "priority running job is preempted at its next boundary; 0 = "
+      "shed only on typed health degradation, never on burn alone")
+_knob("H2O_TPU_WORKLOAD_RETRY_S", "int", 5,
+      "seconds a shed (load-shed, not priority-preempted) job stays "
+      "parked before re-admission is considered; also the Retry-After "
+      "hint on 429 quota rejections")
+_knob("H2O_TPU_WORKLOAD_DISPATCH_SLOTS", "int", 0,
+      "concurrent MRTask driver dispatches allowed across tenants "
+      "(workload/fairshare.py gate at parallel/mrtask.py _dispatch); "
+      "waiters wake in weighted-fair order (lowest virtual time first); "
+      "0 = ungated (the single-tenant default)")
+
 # -- security ---------------------------------------------------------------
 _knob("H2O_TPU_ALLOW_WIRE_UDF", "bool", True,
       "allow python: UDF references uploaded over the wire to execute")
@@ -372,8 +418,13 @@ _knob("H2O_TPU_BENCH_BINNED_ROWS", "int", 8_000_000,
       "rows for the binned-store stacked-vs-binned leg")
 _knob("H2O_TPU_BENCH_WORKLOADS", "str",
       "gbm,glm,cod,gam,rulefit,sort,merge,binned,serving,serving_wire,"
-      "recovery,cold_start,sharded,airlines",
+      "recovery,cold_start,sharded,airlines,workload",
       "comma list of bench workloads to run")
+_knob("H2O_TPU_BENCH_WORKLOAD_TENANTS", "int", 3,
+      "tenants for the multi-tenant workload bench leg (each runs "
+      "ingest + train + score under the managed scheduler)")
+_knob("H2O_TPU_BENCH_WORKLOAD_ROWS", "int", 40_000,
+      "rows per tenant frame in the workload bench leg")
 _knob("H2O_TPU_BENCH_SHARDED_ROWS", "int", 400_000,
       "rows for the sharded leg (same GBM at 1 vs N row shards, each in "
       "its own subprocess; per-shard peak matrix bytes + psum payload + "

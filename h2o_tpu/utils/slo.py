@@ -80,6 +80,12 @@ declare("serving.score",
         "online scoring requests end to end — encode + queue + device "
         "call (serving/runtime.py score path)",
         p99_ms=250.0, error_budget=0.01, hist="serving.request.seconds")
+declare("workload.wait",
+        "managed-job queue wait — submission (or re-admission after "
+        "preemption) to slot grant under the workload manager's "
+        "fair-share dispatch (workload/manager.py)",
+        p99_ms=60_000.0, error_budget=0.05,
+        hist="workload.queue.wait.seconds")
 
 
 def _lookup(name: str) -> SLO:

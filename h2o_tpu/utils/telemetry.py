@@ -317,6 +317,37 @@ _counter("health.poll.count",
          "like the PR 6 monitoring polls — a 1s readiness poller must "
          "not cycle the event ring)")
 
+# -- workload manager (h2o_tpu/workload/ — tenants, lanes, preemption) -------
+_counter("workload.submitted.count",
+         "jobs submitted through the workload manager (all tenants; the "
+         "per-tenant split rides the h2o_tpu_tenant_* Prometheus lines)")
+_counter("workload.rejected.count",
+         "submissions rejected by tenant quota admission (REST surfaces "
+         "them as 429 + Retry-After)")
+_counter("workload.dispatch.count",
+         "queue entries handed a slot by the fair-share lottery "
+         "(includes force-dispatches from the aging starvation bound)")
+_counter("workload.preempt.count",
+         "running jobs preempted at a chunk/epoch boundary (priority "
+         "arrival, serving pressure, or the shed policy) — state force-"
+         "checkpointed, HBM reservation released")
+_counter("workload.resume.count",
+         "parked (preempted) jobs re-admitted and resumed from their "
+         "boundary checkpoint")
+_counter("workload.shed.count",
+         "shed-policy preemptions specifically (SLO burn / typed health "
+         "degradation picked the victim tenant)")
+_counter("workload.requeue.count",
+         "managed jobs requeued by a watchdog hung-job/trip signal "
+         "instead of paging (the PR 15 watchdog feeding the scheduler)")
+_gauge("workload.running", "managed jobs currently holding a slot")
+_gauge("workload.queue.depth", "managed jobs waiting for a slot")
+_gauge("workload.parked",
+       "preempted jobs parked host-side awaiting re-admission")
+_histogram("workload.queue.wait.seconds",
+           "queue wait per managed dispatch (submission or re-admission "
+           "to slot grant) — backs the workload.wait SLO burn")
+
 
 def _lookup(name: str) -> Metric:
     try:

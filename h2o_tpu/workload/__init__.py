@@ -1,0 +1,22 @@
+"""h2o_tpu.workload — the multi-tenant scheduler tier.
+
+Training, serving and ingest share one process group; this package
+makes them share it under admission control: tenant quotas debiting
+the one reservation ledger (`workload/tenants.py`), weighted fair-share
+dispatch with priority lanes and chunk-boundary preemption
+(`workload/manager.py`), and a deterministic MRTask dispatch gate
+(`workload/fairshare.py`). Surface: `GET/POST /3/Workload`, the
+`workload.*` metrics, per-tenant `h2o_tpu_tenant_*` Prometheus lines
+and the `workload.preempt` failpoint.
+"""
+
+from . import fairshare, tenants  # noqa: F401
+from .manager import (  # noqa: F401
+    WorkloadAdmissionError,
+    WorkloadManager,
+    frame_cost,
+    manager,
+    note_serving_pressure,
+    snapshot,
+    submit,
+)

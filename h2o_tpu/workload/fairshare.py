@@ -1,0 +1,131 @@
+"""Weighted fair-share primitives: the dispatch lottery hash and the
+MRTask dispatch gate.
+
+Two deterministic mechanisms, no RNG state:
+
+- :func:`draw` — the PR 8 router's splitmix64 construction mapping
+  ``(seed, drawing ordinal)`` to a unit float. The job-queue lottery
+  uses it so the same seed + the same submission sequence replays the
+  same dispatch order (the property the router's traffic splits pin).
+- :class:`FairGate` — a weighted-fair semaphore for MRTask driver
+  dispatch: waiters wake lowest-virtual-time-first (``dispatches so
+  far / tenant weight``, FIFO on ties), so a tenant hammering the mesh
+  cannot monopolize the dispatch choke point while another starves.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & _MASK
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+    return z ^ (z >> 31)
+
+
+def draw(seed: int, ordinal: int) -> float:
+    """Unit float in [0, 1) for the ``ordinal``-th lottery drawing under
+    ``seed`` — same finalizer chain as serving/router.py's spray hash."""
+    h = _splitmix64((seed & _MASK) ^ _splitmix64(ordinal & _MASK))
+    return (h >> 11) / float(1 << 53)
+
+
+class FairGate:
+    """Bounded concurrent dispatch with weighted-fair wakeup order.
+
+    ``acquire`` blocks while ``slots`` are busy; among the blocked, the
+    waiter whose tenant has the lowest virtual time (grants so far
+    divided by weight) goes first, with FIFO breaking ties. Purely
+    host-side — it gates the MRTask driver's program launch, never the
+    device work itself.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._active = 0
+        self._grants: dict[str, int] = {}
+        self._waiters: list[tuple[float, int]] = []
+        self._seq = 0
+
+    def _vtime(self, tenant: str, weight: float) -> float:
+        return self._grants.get(tenant, 0) / max(weight, 1e-9)
+
+    def acquire(self, tenant: str, slots: int, weight: float) -> None:
+        with self._cond:
+            if self._active < slots and not self._waiters:
+                self._grant(tenant)
+                return
+            me = (self._vtime(tenant, weight), self._seq)
+            self._seq += 1
+            self._waiters.append(me)
+            try:
+                while not (self._active < slots
+                           and min(self._waiters) == me):
+                    # bounded wait: a missed notify degrades to a 100ms
+                    # re-check, never a hang
+                    self._cond.wait(timeout=0.1)
+            finally:
+                self._waiters.remove(me)
+            self._grant(tenant)
+            self._cond.notify_all()     # min(waiters) changed
+
+    def _grant(self, tenant: str) -> None:
+        self._active += 1
+        self._grants[tenant] = self._grants.get(tenant, 0) + 1
+
+    def release(self) -> None:
+        with self._cond:
+            self._active -= 1
+            self._cond.notify_all()
+
+    def grants(self) -> dict[str, int]:
+        with self._cond:
+            return dict(self._grants)
+
+
+_GATE: FairGate | None = None
+_GATE_LOCK = threading.Lock()
+
+
+def _gate() -> FairGate:
+    global _GATE
+    if _GATE is None:
+        with _GATE_LOCK:
+            if _GATE is None:
+                _GATE = FairGate()
+    return _GATE
+
+
+@contextmanager
+def dispatch_slot():
+    """Gate one MRTask driver dispatch under the tenant fair-share
+    (parallel/mrtask.py `_dispatch`). Free when the
+    H2O_TPU_WORKLOAD_DISPATCH_SLOTS knob is 0 — one int read on the
+    single-tenant default path."""
+    from ..utils import knobs
+
+    slots = knobs.get_int("H2O_TPU_WORKLOAD_DISPATCH_SLOTS")
+    if slots <= 0:
+        yield
+        return
+    from . import tenants
+
+    name = tenants.current()
+    gate = _gate()
+    gate.acquire(name, slots, tenants.weight(name))
+    try:
+        yield
+    finally:
+        gate.release()
+
+
+def _reset_for_tests() -> None:
+    global _GATE
+    with _GATE_LOCK:
+        _GATE = None

@@ -1,0 +1,745 @@
+"""Workload manager — admission, fair-share dispatch, chunk-boundary
+preemption over the one process group.
+
+The scheduler tier the reference keeps in `H2O.submitTask`'s priority
+fork-join queues, rebuilt for the TPU platform's actual contention
+points: HBM (tenant quotas debit the PR 8 reservation ledger — ONE
+accounting), the training slot (jobs queue and drain under weighted
+fair-share, deterministic under H2O_TPU_WORKLOAD_SEED), and the SLO
+plane (PR 15's `slo.worst_burn` + `/3/Health` typed reasons decide
+WHICH tenant sheds under pressure).
+
+Lifecycle of a managed job::
+
+    submit ──quota──▶ QUEUED ──lottery──▶ RUNNING ──▶ FINISHED
+                 │                  ▲        │
+                 ▼ over-quota       │        ▼ preempt @ chunk boundary
+       WorkloadAdmissionError       └──── PARKED  (state checkpointed
+       (REST: 429 + Retry-After)           host-side, HBM reservation
+                                           released, re-admitted when
+                                           pressure drops — resumed
+                                           forest bit-equal, PR 5)
+
+Preemption is cooperative and boundary-aligned: `request_preempt()`
+flags the job, the training loop's `_recovery_tick` observes it at the
+next chunk/epoch boundary, force-checkpoints through `TrainingRecovery`
+and unwinds with ``JobPreempted``. A job that never armed recovery is
+not preemptible — the manager never discards work.
+
+With ``H2O_TPU_WORKLOAD_SLOTS=0`` (the default) every submit dispatches
+immediately: legacy single-tenant behavior, no queueing, no threads.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextvars import ContextVar
+
+from ..backend import memory
+from ..backend.jobs import Job, JobPreempted
+from ..utils import knobs, sanitizer, slo, telemetry
+from . import fairshare, tenants
+
+#: lower ordinal = stronger lane (Job.PRIORITIES order)
+_PRIO_ORD = {p: i for i, p in enumerate(Job.PRIORITIES)}
+
+#: finished-entry history kept for /3/Workload
+_HISTORY = 64
+
+
+class WorkloadAdmissionError(Exception):
+    """Typed over-quota rejection — api/server.py maps it to HTTP 429
+    with a Retry-After header, mirroring serving's AdmissionError."""
+
+    def __init__(self, tenant: str, cost_bytes: int, quota_bytes: int,
+                 used_bytes: int, retry_after_s: float):
+        self.tenant = tenant
+        self.cost_bytes = int(cost_bytes)
+        self.quota_bytes = int(quota_bytes)
+        self.used_bytes = int(used_bytes)
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"tenant {tenant!r} over quota: submit needs {cost_bytes} B "
+            f"with {used_bytes} B already reserved of a {quota_bytes} B "
+            f"quota — retry after {retry_after_s:.0f}s")
+
+
+class _Entry:
+    """One managed submission, queue→slot→park lifecycle included."""
+
+    __slots__ = ("id", "job", "fn", "tenant", "priority", "cost_bytes",
+                 "state", "losses", "submit_ts", "queued_ts", "start_ts",
+                 "end_ts", "recovery_dir", "preempt_count", "reserved",
+                 "event", "resume", "resume_pending", "shed", "ready_ts")
+
+    def __init__(self, eid: int, job: Job, fn, tenant: str, priority: str,
+                 cost_bytes: int):
+        self.id = eid
+        self.job = job
+        self.fn = fn
+        self.tenant = tenant
+        self.priority = priority
+        self.cost_bytes = int(cost_bytes)
+        self.state = "QUEUED"
+        self.losses = 0                 # consecutive lottery losses (aging)
+        self.submit_ts = time.time()
+        self.queued_ts: float | None = None
+        self.start_ts: float | None = None
+        self.end_ts: float | None = None
+        self.recovery_dir: str | None = None
+        self.preempt_count = 0
+        self.reserved = False           # holds a ledger reservation now
+        self.event: threading.Event | None = None  # foreground handshake
+        self.resume = False             # dispatch = resume_training replay
+        self.resume_pending = False     # next nested job attach wins
+        self.shed = False               # parked by the shed policy
+        self.ready_ts: float | None = None  # parked: earliest re-admission
+
+    def describe(self) -> dict:
+        job = self.job
+        state = self.state
+        if state == "FINISHED" and job is not None:
+            state = job.status
+        out = {"id": f"wl-{self.id}", "job": str(job.key) if job else None,
+               "tenant": self.tenant, "priority": self.priority,
+               "state": state, "preemptions": self.preempt_count,
+               "cost_bytes": self.cost_bytes}
+        if self.recovery_dir:
+            out["recovery_dir"] = self.recovery_dir
+        return out
+
+
+#: the entry whose slot the calling context runs under — nested builds
+#: (CV folds, grid candidates, resume replays) dispatch inline in the
+#: parent's slot instead of queueing (which would deadlock a bounded
+#: slot count against its own children)
+_SCOPE: ContextVar["_Entry | None"] = ContextVar("h2o_tpu_workload_scope",
+                                                 default=None)
+
+
+class WorkloadManager:
+    def __init__(self):
+        self._lock = sanitizer.make_lock("Workload._state")
+        self._ids = itertools.count(1)
+        self._queue: list[_Entry] = []
+        self._running: dict[int, _Entry] = {}
+        self._parked: list[_Entry] = []
+        self._done: deque = deque(maxlen=_HISTORY)
+        self._ordinal = 0               # lottery drawing counter
+        self._wait_windows: dict[str, deque] = {}
+        self._thread: threading.Thread | None = None
+        self._resume_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- knobs ---------------------------------------------------------------
+    @staticmethod
+    def _slots() -> int:
+        return knobs.get_int("H2O_TPU_WORKLOAD_SLOTS")
+
+    @staticmethod
+    def _retry_s() -> float:
+        return float(max(knobs.get_int("H2O_TPU_WORKLOAD_RETRY_S"), 1))
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, job: Job, fn, *, background: bool = True,
+               cost_bytes: int = 0, tenant: str | None = None,
+               priority: str | None = None) -> Job:
+        """Admit + dispatch one job. Stamps tenant/priority on the Job,
+        debits the tenant quota through the reservation ledger, then
+        either dispatches (free slot / unmanaged), queues for the
+        fair-share lottery, or raises WorkloadAdmissionError."""
+        parent = _SCOPE.get()
+        if parent is not None:
+            # nested build inside a managed slot (CV fold, grid
+            # candidate, resume replay): the parent's admission and
+            # reservation already cover it — inherit identity, attach
+            # to a pending resume, run in place
+            job.tenant, job.priority = parent.tenant, parent.priority
+            if parent.resume_pending:
+                parent.job = job
+                parent.resume_pending = False
+            job.start(fn, background=background)
+            return job
+
+        name = tenant or tenants.current()
+        prio = priority or tenants.current_priority() or "batch"
+        if prio not in _PRIO_ORD:
+            raise ValueError(
+                f"unknown priority {prio!r} — one of {Job.PRIORITIES}")
+        job.tenant, job.priority = name, prio
+        entry = _Entry(next(self._ids), job, fn, name, prio, cost_bytes)
+
+        victim = None
+        with self._lock:
+            self._admit_locked(entry)       # raises over-quota
+            telemetry.inc("workload.submitted.count")
+            slots = self._slots()
+            if slots <= 0 or len(self._running) < slots:
+                self._grant_locked(entry)
+            else:
+                entry.queued_ts = time.time()
+                if not background:
+                    entry.event = threading.Event()
+                self._queue.append(entry)
+                victim = self._preempt_victim_locked(entry)
+            self._sync_gauges_locked()
+        if victim is not None:
+            victim.job.request_preempt()
+        if self._slots() > 0:
+            self._ensure_thread()
+
+        if entry.state == "RUNNING":
+            job.start(self._wrap(entry, fn), background=background)
+            return job
+        if entry.event is not None:
+            # foreground submission that had to queue: block the caller
+            # until the lottery grants the slot, then run in place
+            entry.event.wait()
+            job.start(self._wrap(entry, fn), background=False)
+            return job
+        return job
+
+    def _admit_locked(self, entry: _Entry) -> None:
+        quota = tenants.quota_bytes(entry.tenant)
+        if quota is None:
+            return                      # unlimited tenant / no HBM budget
+        used = sum(e.cost_bytes for e in self._live_entries()
+                   if e.tenant == entry.tenant and e.reserved)
+        if used + entry.cost_bytes > quota:
+            tenants.get(entry.tenant).rejected += 1
+            telemetry.inc("workload.rejected.count")
+            raise WorkloadAdmissionError(
+                entry.tenant, entry.cost_bytes, quota, used,
+                retry_after_s=self._retry_s())
+        self._reserve(entry)
+
+    def _reserve(self, entry: _Entry) -> None:
+        if entry.cost_bytes > 0 and tenants.quota_bytes(entry.tenant) is not None:
+            memory.reserve_bytes(self._owner(entry), entry.cost_bytes)
+            entry.reserved = True
+
+    def _release(self, entry: _Entry) -> None:
+        if entry.reserved:
+            memory.release_bytes(self._owner(entry))
+            entry.reserved = False
+
+    @staticmethod
+    def _owner(entry: _Entry) -> str:
+        return f"workload:{entry.tenant}:{entry.id}"
+
+    def _live_entries(self):
+        return list(self._queue) + list(self._running.values()) \
+            + list(self._parked)
+
+    # -- dispatch ------------------------------------------------------------
+    def _grant_locked(self, entry: _Entry) -> None:
+        now = time.time()
+        entry.state = "RUNNING"
+        entry.start_ts = now
+        entry.losses = 0
+        self._running[entry.id] = entry
+        telemetry.inc("workload.dispatch.count")
+        if entry.queued_ts is not None:
+            wait = max(now - entry.queued_ts, 0.0)
+            telemetry.observe("workload.queue.wait.seconds", wait)
+            slo.note("workload.wait", wait)
+            win = self._wait_windows.setdefault(entry.tenant,
+                                                deque(maxlen=512))
+            win.append((now, wait))
+            entry.queued_ts = None
+
+    def _pick_locked(self) -> _Entry:
+        """The fair-share lottery: strongest priority lane present wins
+        the drawing; within the lane, tickets are tenant weights and the
+        draw is splitmix64(seed, ordinal) — deterministic replay under a
+        seed. Entries past the aging bound are force-dispatched FIFO
+        regardless of lane (the starvation bound)."""
+        q = self._queue
+        aging = max(knobs.get_int("H2O_TPU_WORKLOAD_AGING"), 1)
+        aged = [e for e in q if e.losses >= aging]
+        if aged:
+            chosen = aged[0]
+        else:
+            best = min(_PRIO_ORD[e.priority] for e in q)
+            lane = [e for e in q if _PRIO_ORD[e.priority] == best]
+            total = sum(tenants.weight(e.tenant) for e in lane)
+            r = fairshare.draw(knobs.get_int("H2O_TPU_WORKLOAD_SEED"),
+                               self._ordinal) * total
+            self._ordinal += 1
+            acc, chosen = 0.0, lane[-1]
+            for e in lane:
+                acc += tenants.weight(e.tenant)
+                if r < acc:
+                    chosen = e
+                    break
+        for e in q:
+            if e is not chosen:
+                e.losses += 1
+        q.remove(chosen)
+        return chosen
+
+    def _preempt_victim_locked(self, arrival: _Entry) -> "_Entry | None":
+        """A stronger-lane arrival with no free slot preempts the
+        weakest running PREEMPTIBLE entry (latest start on ties — least
+        sunk work lost). Returns the victim; the caller requests the
+        preempt outside the manager lock."""
+        cand = [e for e in self._running.values()
+                if e.job is not None and e.job.preemptible
+                and _PRIO_ORD[e.priority] > _PRIO_ORD[arrival.priority]]
+        if not cand:
+            return None
+        return max(cand, key=lambda e: (_PRIO_ORD[e.priority],
+                                        e.start_ts or 0.0))
+
+    def _pump(self) -> None:
+        """Re-admit due parked entries, then fill free slots from the
+        queue. Launches happen outside the lock."""
+        to_launch: list[_Entry] = []
+        victim = None
+        with self._lock:
+            slots = self._slots()
+            now = time.time()
+            if slots > 0:
+                for e in list(self._parked):
+                    if e.ready_ts is not None and now >= e.ready_ts:
+                        self._parked.remove(e)
+                        e.state = "QUEUED"
+                        e.queued_ts = now
+                        e.losses = 0
+                        e.resume = True
+                        self._queue.append(e)
+                while self._queue and len(self._running) < slots:
+                    e = self._pick_locked()
+                    try:
+                        self._admit_locked(e)
+                    except WorkloadAdmissionError:
+                        # quota re-filled by a later finish/park — park
+                        # the entry rather than dropping it
+                        e.state = "PARKED"
+                        e.ready_ts = now + self._retry_s()
+                        self._parked.append(e)
+                        continue
+                    self._grant_locked(e)
+                    to_launch.append(e)
+                if self._queue and len(self._running) >= slots:
+                    strongest = min(
+                        self._queue, key=lambda e: _PRIO_ORD[e.priority])
+                    victim = self._preempt_victim_locked(strongest)
+            self._sync_gauges_locked()
+        if victim is not None:
+            victim.job.request_preempt()
+        for e in to_launch:
+            self._launch(e)
+
+    def _launch(self, entry: _Entry) -> None:
+        if entry.resume:
+            self._spawn_resume(entry)
+        elif entry.event is not None:
+            entry.event.set()           # foreground caller runs it
+        else:
+            entry.job.start(self._wrap(entry, entry.fn), background=True)
+
+    # -- the managed run wrapper --------------------------------------------
+    def _wrap(self, entry: _Entry, fn):
+        def run():
+            with tenants.request_scope(entry.tenant, entry.priority):
+                stok = _SCOPE.set(entry)
+                try:
+                    result = fn()
+                except JobPreempted as e:
+                    self._park(entry, e.recovery_dir)
+                    raise
+                except BaseException:
+                    self._finish(entry)
+                    raise
+                finally:
+                    _SCOPE.reset(stok)
+            inner = entry.job
+            if inner is not None and inner.status == Job.PREEMPTED:
+                # a nested resume replay was preempted again: its _run
+                # absorbed the JobPreempted, so re-raise to park and to
+                # mark the outer job PREEMPTED too
+                self._park(entry, inner.preempt_dir)
+                raise JobPreempted(str(inner.key), inner.preempt_dir)
+            self._finish(entry)
+            return result
+
+        return run
+
+    def _finish(self, entry: _Entry) -> None:
+        with self._lock:
+            self._release(entry)
+            self._running.pop(entry.id, None)
+            entry.state = "FINISHED"
+            entry.end_ts = time.time()
+            self._done.append(entry)
+            self._sync_gauges_locked()
+        self._pump()
+
+    def _park(self, entry: _Entry, recovery_dir: str | None) -> None:
+        with self._lock:
+            self._release(entry)        # HBM back through the one ledger
+            self._running.pop(entry.id, None)
+            entry.state = "PARKED"
+            entry.recovery_dir = recovery_dir or entry.recovery_dir
+            entry.preempt_count += 1
+            tenants.get(entry.tenant).preemptions += 1
+            if entry.shed:
+                entry.shed = False
+                entry.ready_ts = time.time() + self._retry_s()
+                tenants.get(entry.tenant).sheds += 1
+                telemetry.inc("workload.shed.count")
+            else:
+                entry.ready_ts = time.time()
+            if entry.recovery_dir is None:
+                # preempted without a checkpoint to replay (shouldn't
+                # happen — the boundary hook refuses preemption when no
+                # recovery is armed) — nothing to resume, record as done
+                entry.state = "FINISHED"
+                self._done.append(entry)
+            else:
+                self._parked.append(entry)
+            self._sync_gauges_locked()
+        self._pump()
+
+    def _spawn_resume(self, entry: _Entry) -> None:
+        telemetry.inc("workload.resume.count")
+        entry.resume = False
+        entry.resume_pending = True
+        wrapped = self._wrap(entry, self._resume_fn(entry))
+
+        def guard():
+            try:
+                wrapped()
+            except BaseException:  # noqa: BLE001 — outcome lives on the entry/job
+                pass
+
+        # drained through _resume_threads in stop(); the analyzer cannot
+        # see joins through list membership
+        t = threading.Thread(  # graftlint: disable=unjoined-thread
+            target=telemetry.carry_context(guard),
+            daemon=True, name=f"workload-resume-{entry.id}")
+        with self._lock:
+            self._resume_threads = [r for r in self._resume_threads
+                                    if r.is_alive()]
+            self._resume_threads.append(t)
+        t.start()
+
+    @staticmethod
+    def _resume_fn(entry: _Entry):
+        def run():
+            from ..models.model_base import resume_training
+
+            return resume_training(entry.recovery_dir)
+
+        return run
+
+    # -- shed policy (the PR 15 signal plane feeding the scheduler) ----------
+    def shed_check(self, snap: dict | None = None) -> list[str]:
+        """One shed-policy evaluation. Reads the /3/Health payload
+        (injectable for tests): typed memory/serving pressure — or an
+        SLO burn past H2O_TPU_WORKLOAD_SHED_BURN — preempts the highest-
+        pressure tenant's weakest running job (parked with a retry
+        delay); watchdog hung-job/trip reasons requeue the implicated
+        managed job instead of paging. Returns the typed decisions."""
+        if snap is None:
+            from ..utils import health
+
+            snap = health.snapshot()
+        reasons = {d.get("reason") for d in snap.get("degraded", ())}
+        decisions: list[str] = []
+        burn_max = knobs.get_int("H2O_TPU_WORKLOAD_SHED_BURN")
+        worst = 0.0
+        for rec in (snap.get("slo") or {}).values():
+            worst = max(worst, rec.get("burn") or 0.0)
+        pressure = bool(reasons & {"cleaner-headroom",
+                                   "serving-queue-saturation"})
+        if burn_max > 0 and worst > burn_max:
+            pressure = True
+        victims: list[_Entry] = []
+        if pressure:
+            with self._lock:
+                v = self._shed_victim_locked()
+                if v is not None:
+                    v.shed = True
+                    victims.append(v)
+                    decisions.append(f"shed:{v.tenant}:wl-{v.id}")
+        if reasons & {"job-heartbeat", "watchdog-trip"}:
+            stale = set()
+            for d in snap.get("degraded", ()):
+                for j in d.get("jobs", ()) or ():
+                    key = j.get("subject") or j.get("job")
+                    if key:
+                        stale.add(str(key))
+            with self._lock:
+                for e in self._running.values():
+                    if (e.job is not None and e.job.preemptible
+                            and str(e.job.key) in stale):
+                        victims.append(e)
+                        decisions.append(f"requeue:{e.tenant}:wl-{e.id}")
+                        telemetry.inc("workload.requeue.count")
+        for v in victims:
+            v.job.request_preempt()
+        return decisions
+
+    def _shed_victim_locked(self) -> "_Entry | None":
+        """WHICH tenant sheds: the one holding the most pressure per
+        unit of fair-share weight (reservation bytes + a slot each per
+        running job); within it, the weakest-priority, latest-started
+        running preemptible entry."""
+        cand = [e for e in self._running.values()
+                if e.job is not None and e.job.preemptible]
+        if not cand:
+            return None
+        by_tenant: dict[str, list[_Entry]] = {}
+        for e in cand:
+            by_tenant.setdefault(e.tenant, []).append(e)
+
+        def pressure(name: str) -> float:
+            es = by_tenant[name]
+            held = sum(e.cost_bytes for e in es if e.reserved)
+            return (held + len(es)) / tenants.weight(name)
+
+        worst = max(by_tenant, key=pressure)
+        return max(by_tenant[worst],
+                   key=lambda e: (_PRIO_ORD[e.priority], e.start_ts or 0.0))
+
+    def preempt_weakest(self) -> bool:
+        """Serving placement pressure hook (serving/control.py): yield
+        HBM by preempting the weakest running preemptible entry. Returns
+        whether a preempt was requested."""
+        with self._lock:
+            cand = [e for e in self._running.values()
+                    if e.job is not None and e.job.preemptible]
+            victim = max(cand, key=lambda e: (_PRIO_ORD[e.priority],
+                                              e.start_ts or 0.0)) \
+                if cand else None
+            if victim is not None:
+                victim.shed = True
+        if victim is None:
+            return False
+        victim.job.request_preempt()
+        return True
+
+    # -- maintenance thread --------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        tick_ms = knobs.get_int("H2O_TPU_WORKLOAD_TICK_MS")
+        if tick_ms <= 0:
+            return
+        self._stop.clear()
+        # self-rooted supervisor: spans it emits must not nest under
+        # whichever request happened to start it
+        self._thread = threading.Thread(  # graftlint: disable=thread-without-trace-context
+            target=self._loop, daemon=True, name="workload-manager")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(
+                max(knobs.get_int("H2O_TPU_WORKLOAD_TICK_MS"), 100) / 1000.0):
+            try:
+                self._pump()
+            except Exception:  # noqa: BLE001 — the pump must outlive one bad entry
+                pass
+            try:
+                with self._lock:
+                    active = bool(self._running or self._parked
+                                  or self._queue)
+                if active:
+                    self.shed_check()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- introspection -------------------------------------------------------
+    def _sync_gauges_locked(self) -> None:
+        telemetry.set_gauge("workload.running", len(self._running))
+        telemetry.set_gauge("workload.queue.depth", len(self._queue))
+        telemetry.set_gauge("workload.parked", len(self._parked))
+
+    def tenant_burn(self, name: str) -> float | None:
+        """Per-tenant queue-wait burn against the workload.wait SLO
+        (same construction as slo.py's latency burn, scoped to the
+        tenant's own dispatch window)."""
+        win = self._wait_windows.get(name)
+        if not win:
+            return None
+        obj = slo.objective("workload.wait")
+        thr = obj.p99_ms / 1000.0
+        horizon = time.time() - slo.window_s()
+        recent = [w for (ts, w) in win if ts >= horizon]
+        if not recent:
+            return None
+        breach = sum(1 for w in recent if w > thr) / len(recent)
+        return round(breach / 0.01, 4)
+
+    def snapshot(self) -> dict:
+        """The `GET /3/Workload` payload: scheduler config, per-tenant
+        accounting (quota, reservations, lanes, burn), and every live +
+        recently finished entry."""
+        with self._lock:
+            live = self._live_entries()
+            entries = [e.describe() for e in live] \
+                + [e.describe() for e in self._done]
+            running = dict(self._running)
+            queue = list(self._queue)
+            parked = list(self._parked)
+        names = {t.name for t in tenants.all_tenants()} \
+            | {e.tenant for e in live}
+        per_tenant = {}
+        for name in sorted(names):
+            t = tenants.get(name)
+            per_tenant[name] = {
+                **t.asdict(),
+                "quota_bytes": tenants.quota_bytes(name),
+                "reserved_bytes": sum(
+                    e.cost_bytes for e in live
+                    if e.tenant == name and e.reserved),
+                "running": sum(1 for e in running.values()
+                               if e.tenant == name),
+                "queued": sum(1 for e in queue if e.tenant == name),
+                "parked": sum(1 for e in parked if e.tenant == name),
+                "burn": self.tenant_burn(name),
+            }
+        return {
+            "managed": self._slots() > 0,
+            "slots": self._slots(),
+            "seed": knobs.get_int("H2O_TPU_WORKLOAD_SEED"),
+            "aging": knobs.get_int("H2O_TPU_WORKLOAD_AGING"),
+            "priorities": list(Job.PRIORITIES),
+            "tenants": per_tenant,
+            "entries": entries,
+            "counters": {
+                name: telemetry.value(f"workload.{name}.count")
+                for name in ("submitted", "rejected", "dispatch",
+                             "preempt", "resume", "shed", "requeue")},
+        }
+
+    def _prom_lines(self) -> list[str]:
+        """Per-tenant Prometheus series (h2o_tpu_tenant_*{tenant=...}) —
+        the PR 8 provider pattern, labels escaped."""
+        esc = telemetry.prom_label_escape
+        with self._lock:
+            live = self._live_entries()
+            running = list(self._running.values())
+            queue = list(self._queue)
+        names = sorted({t.name for t in tenants.all_tenants()}
+                       | {e.tenant for e in live})
+        if not names:
+            return []
+        gauges = [
+            ("h2o_tpu_tenant_running_jobs", "gauge",
+             "managed jobs of this tenant holding a slot",
+             lambda n: sum(1 for e in running if e.tenant == n)),
+            ("h2o_tpu_tenant_queued_jobs", "gauge",
+             "managed jobs of this tenant waiting for a slot",
+             lambda n: sum(1 for e in queue if e.tenant == n)),
+            ("h2o_tpu_tenant_reserved_bytes", "gauge",
+             "HBM this tenant holds in the reservation ledger",
+             lambda n: sum(e.cost_bytes for e in live
+                           if e.tenant == n and e.reserved)),
+            ("h2o_tpu_tenant_preemptions_total", "counter",
+             "boundary preemptions of this tenant's jobs",
+             lambda n: tenants.get(n).preemptions),
+            ("h2o_tpu_tenant_shed_total", "counter",
+             "shed-policy preemptions charged to this tenant",
+             lambda n: tenants.get(n).sheds),
+        ]
+        lines = []
+        for metric, kind, doc, fn in gauges:
+            lines.append(f"# HELP {metric} {doc}")
+            lines.append(f"# TYPE {metric} {kind}")
+            for n in names:
+                lines.append(f'{metric}{{tenant="{esc(n)}"}} {fn(n)}')
+        return lines
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self._thread = None
+        with self._lock:
+            pending = list(self._resume_threads)
+            self._resume_threads = []
+        for t in pending:
+            if t.is_alive():
+                t.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# module surface
+# ---------------------------------------------------------------------------
+_MANAGER: WorkloadManager | None = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def manager() -> WorkloadManager:
+    global _MANAGER
+    if _MANAGER is None:
+        with _MANAGER_LOCK:
+            if _MANAGER is None:
+                _MANAGER = WorkloadManager()
+    return _MANAGER
+
+
+def submit(job: Job, fn, **kw) -> Job:
+    return manager().submit(job, fn, **kw)
+
+
+def snapshot() -> dict:
+    return manager().snapshot()
+
+
+def note_serving_pressure() -> bool:
+    """serving/control.py calls this when placement admission fails:
+    training yields HBM at its next boundary so the placement's retry
+    (the client honors Retry-After) finds room. No-op without a live
+    manager — existing serving paths pay nothing."""
+    m = _MANAGER
+    if m is None:
+        return False
+    return m.preempt_weakest()
+
+
+def frame_cost(obj) -> int:
+    """Submission cost estimate when the caller has no better number:
+    the training frame's full-precision footprint (nrow × ncol × 4).
+    Accepts a params object (reads ``training_frame``) or a Frame."""
+    fr = getattr(obj, "training_frame", obj)
+    if fr is None:
+        return 0
+    try:
+        return int(fr.nrow) * max(len(fr.names), 1) * 4
+    except Exception:  # noqa: BLE001 — an estimate, never a failure source
+        return 0
+
+
+def _prometheus_tenant_lines() -> list[str]:
+    m = _MANAGER
+    if m is None:
+        return []
+    return m._prom_lines()
+
+
+telemetry.add_prometheus_provider(_prometheus_tenant_lines)
+
+
+def _reset_for_tests() -> None:
+    """Stop the maintenance thread, release every managed reservation
+    and drop all scheduler + tenant state (test isolation)."""
+    global _MANAGER
+    m = _MANAGER
+    if m is not None:
+        m.stop()
+        with m._lock:
+            for e in m._live_entries():
+                if e.reserved:
+                    memory.release_bytes(m._owner(e))
+                    e.reserved = False
+    with _MANAGER_LOCK:
+        _MANAGER = None
+    tenants._reset_for_tests()
+    fairshare._reset_for_tests()

@@ -1,0 +1,173 @@
+"""Tenant registry + request-scoped tenant/priority context.
+
+The reference platform's "millions of users" story assumes isolated
+tenants on shared pools; its mechanism is priority fork-join queues
+(`H2O.submitTask`), with no per-tenant accounting at all. Here a tenant
+is a named principal with a fair-share **weight** and an optional HBM
+**quota fraction**; everything it submits — training jobs, grid
+searches, ingest — is stamped with its name and debits the ONE
+reservation ledger in `backend/memory.py` (PR 8's `reserve_bytes`
+generalized past serving; no scheduler-only shadow accounting).
+
+Identity flows by context, not plumbing: `H2O_TPU_TENANT` names the
+tenant a process submits as, the REST client forwards it as the
+``X-H2O-TPU-Tenant`` header, and the server scopes each request with
+:func:`request_scope` so every Job created underneath lands on the
+right tenant. Legacy callers that never mention tenants run as
+``default`` — unlimited quota, weight 1, exactly the old behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+
+from ..utils import knobs
+
+DEFAULT = "default"
+
+
+@dataclass
+class Tenant:
+    name: str
+    #: fair-share tickets multiplier in the dispatch lottery and the
+    #: MRTask gate's virtual-time denominator
+    weight: float = 1.0
+    #: fraction of memory.base_hbm_limit_bytes() this tenant may hold in
+    #: reservations; None = the H2O_TPU_WORKLOAD_QUOTA knob (or unlimited)
+    quota_fraction: float | None = None
+    # lifetime counters (written under the workload manager's lock; read
+    # by /3/Workload and the Prometheus provider)
+    preemptions: int = 0
+    sheds: int = 0
+    rejected: int = 0
+
+    def asdict(self) -> dict:
+        return {"name": self.name, "weight": self.weight,
+                "quota_fraction": self.quota_fraction,
+                "preemptions": self.preemptions, "sheds": self.sheds,
+                "rejected": self.rejected}
+
+
+_REGISTRY: dict[str, Tenant] = {}
+_LOCK = threading.Lock()
+
+#: request/job-scoped identity — set by the server around each routed
+#: request and by the manager around each dispatched job, so nested
+#: builds (CV folds, grid candidates) inherit without plumbing
+_CURRENT: ContextVar[str] = ContextVar("h2o_tpu_tenant", default="")
+_PRIORITY: ContextVar[str] = ContextVar("h2o_tpu_priority", default="")
+
+
+def get(name: str) -> Tenant:
+    """The tenant record, created on first reference (a tenant is a name,
+    not a provisioning step — quota/weight attach via configure())."""
+    t = _REGISTRY.get(name)
+    if t is None:
+        with _LOCK:
+            t = _REGISTRY.setdefault(name, Tenant(name=name))
+    return t
+
+
+def configure(name: str, weight: float | None = None,
+              quota_fraction: float | None = None) -> Tenant:
+    """Set a tenant's fair-share weight and/or quota fraction (the
+    `POST /3/Workload` body). Explicit configuration wins over the
+    H2O_TPU_WORKLOAD_QUOTA knob."""
+    t = get(name)
+    with _LOCK:
+        if weight is not None:
+            if weight <= 0:
+                raise ValueError(f"tenant weight must be > 0, got {weight}")
+            t.weight = float(weight)
+        if quota_fraction is not None:
+            if not (0.0 < quota_fraction <= 1.0):
+                raise ValueError(
+                    f"quota_fraction must be in (0, 1], got {quota_fraction}")
+            t.quota_fraction = float(quota_fraction)
+    return t
+
+
+def all_tenants() -> list[Tenant]:
+    with _LOCK:
+        return list(_REGISTRY.values())
+
+
+def weight(name: str) -> float:
+    return get(name).weight
+
+
+def _knob_quota_map() -> dict[str, float]:
+    """H2O_TPU_WORKLOAD_QUOTA = 'tenant=frac,...' parsed per read so
+    operators/tests can retune a live process; malformed entries raise
+    loudly (a silently dropped quota is an isolation hole)."""
+    raw = knobs.get_str("H2O_TPU_WORKLOAD_QUOTA")
+    out: dict[str, float] = {}
+    for tok in filter(None, (t.strip() for t in raw.split(","))):
+        name, sep, val = tok.partition("=")
+        if not sep or not name:
+            raise ValueError(
+                f"bad H2O_TPU_WORKLOAD_QUOTA entry {tok!r} — grammar: "
+                f"'<tenant>=<fraction>,...'")
+        out[name] = float(val)
+    return out
+
+
+def quota_fraction(name: str) -> float | None:
+    t = get(name)
+    if t.quota_fraction is not None:
+        return t.quota_fraction
+    return _knob_quota_map().get(name)
+
+
+def quota_bytes(name: str) -> int | None:
+    """The tenant's reservation budget in bytes, or None for unlimited.
+    Fractions are taken of the PRE-reservation HBM budget (the same
+    base the serving quota uses); with no resolvable budget (CPU dev
+    without H2O_TPU_HBM_LIMIT_BYTES) admission stays open — quotas are
+    a deployment posture, not a dev-box tax."""
+    frac = quota_fraction(name)
+    if frac is None:
+        return None
+    from ..backend import memory
+
+    base = memory.base_hbm_limit_bytes()
+    if not base:
+        return None
+    return int(frac * base)
+
+
+# -- request/job context ------------------------------------------------------
+def current() -> str:
+    """The tenant the calling context submits as: request/job scope if
+    set, else the H2O_TPU_TENANT knob, else 'default'."""
+    return _CURRENT.get() or knobs.get_str("H2O_TPU_TENANT") or DEFAULT
+
+
+def current_priority() -> str | None:
+    """Priority class requested by the surrounding scope (X-H2O-TPU-
+    Priority header / managed dispatch), or None when unset."""
+    return _PRIORITY.get() or None
+
+
+@contextmanager
+def request_scope(tenant: str | None = None, priority: str | None = None):
+    """Scope tenant/priority identity around a request or a dispatched
+    job body; None leaves the enclosing value in place."""
+    toks = []
+    if tenant:
+        toks.append((_CURRENT, _CURRENT.set(tenant)))
+    if priority:
+        toks.append((_PRIORITY, _PRIORITY.set(priority)))
+    try:
+        yield
+    finally:
+        for var, tok in reversed(toks):
+            var.reset(tok)
+
+
+def _reset_for_tests() -> None:
+    with _LOCK:
+        _REGISTRY.clear()

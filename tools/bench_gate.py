@@ -65,6 +65,8 @@ LEG_METRICS = {
                      ("sequential.pooled_req_s", "thru", "down")],
     "recovery": [("train_wall_s", "wall", "up")],
     "binned_store": [("reduction_x", "thru", "down")],
+    "workload": [("total_wall_s", "wall", "up"),
+                 ("score_p99_ms_max", "wall", "up")],
 }
 
 #: flags that must hold whenever both records carry them (scale-free)
@@ -75,6 +77,7 @@ LEG_FLAGS = {
     "recovery": [("resume_bit_parity", True)],
     "serving": [("recompiles", 0)],
     "serving_wire": [("recompiles", 0)],
+    "workload": [("all_completed", True), ("preemption_observed", True)],
 }
 
 

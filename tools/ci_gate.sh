@@ -13,6 +13,10 @@
 #   tools/ci_gate.sh --health-gate   # + boot a server, assert /3/Health
 #                                    #   ready -> wedged (typed reason) ->
 #                                    #   recovered across a failpoint drill
+#   tools/ci_gate.sh --workload-gate # + boot a server with 2 managed
+#                                    #   slots, 3-tenant mixed stress with
+#                                    #   boundary kills auto-resumed, SLO
+#                                    #   held, zero sanitizer violations
 #   GRAFTLINT_FORMAT=github tools/ci_gate.sh   # ::error annotations
 #   GRAFTLINT_JOBS=4 tools/ci_gate.sh          # parallel lint scan
 #
@@ -36,6 +40,15 @@
 # asserts recovery once the trips age out — the full signal path the
 # autoscaling loop will poll, exit-coded.
 #
+# --workload-gate boots a REAL server with H2O_TPU_WORKLOAD_SLOTS=2 and
+# the recompile sanitizer armed, then (1) kills a REST-submitted GBM at
+# EVERY chunk boundary via the workload.preempt failpoint and asserts the
+# scheduler entry auto-resumes to DONE each time, (2) runs a 3-tenant
+# mixed-priority stress (three concurrent REST builds + a serving score
+# loop) and asserts every tenant's job completes (no starvation), GET
+# /3/Health stays ready (per-tenant serving SLO held) and the sanitizer
+# + steady-state recompile counters read ZERO.
+#
 # --sanitize-stress re-runs the PR 11 serving+train+sweep stress pass
 # with H2O_TPU_SANITIZE=locks,guards,transfers,recompiles all armed
 # (instrumented locks + guard assertions + transfer guards over every
@@ -57,12 +70,14 @@ bench_smoke=0
 bench_gate=0
 sanitize_stress=0
 health_gate=0
+workload_gate=0
 for arg in "$@"; do
     case "$arg" in
         --bench-smoke) bench_smoke=1 ;;
         --bench-gate) bench_gate=1 ;;
         --sanitize-stress) sanitize_stress=1 ;;
         --health-gate) health_gate=1 ;;
+        --workload-gate) workload_gate=1 ;;
         *) echo "ci_gate.sh: unknown argument '$arg'" >&2; exit 2 ;;
     esac
 done
@@ -197,8 +212,160 @@ EOF
     health_rc=$?
 fi
 
-echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc}, sanitize-stress rc=${stress_rc}, health rc=${health_rc} =="
-if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ] || [ "$stress_rc" -ne 0 ] || [ "$health_rc" -ne 0 ]; then
+workload_rc=0
+if [ "$workload_gate" -eq 1 ]; then
+    echo "== workload gate (3-tenant stress, boundary kills, SLO held) =="
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        H2O_TPU_WORKLOAD_SLOTS=2 \
+        H2O_TPU_WORKLOAD_TICK_MS=100 \
+        H2O_TPU_CHECKPOINT_SECS=0 \
+        H2O_TPU_SANITIZE=recompiles \
+        python - <<'EOF'
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from h2o_tpu.api.server import H2OServer
+from h2o_tpu.frame.frame import Frame
+from h2o_tpu.frame.vec import T_CAT, Vec
+from h2o_tpu.utils import failpoints
+
+srv = H2OServer(port=54946).start()
+
+
+def req(method, path, body=None, hdrs=None):
+    r = urllib.request.Request(
+        srv.url + path, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json", **(hdrs or {})})
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return json.loads(resp.read().decode())
+
+
+rng = np.random.default_rng(5)
+n = 2000
+x1 = rng.normal(size=n).astype(np.float32)
+x2 = rng.normal(size=n).astype(np.float32)
+y = ((x1 - 0.4 * x2 + rng.normal(scale=0.4, size=n)) > 0.1) \
+    .astype(np.float32)
+fr = Frame.from_dict({"x1": x1, "x2": x2})
+fr.add("y", Vec.from_numpy(y, type=T_CAT, domain=["0", "1"]))
+fid = str(fr.key)
+
+
+def build(tenant, prio, rdir=None):
+    body = {"training_frame": fid, "response_column": "y", "ntrees": 6,
+            "max_depth": 3, "seed": 42, "score_tree_interval": 2}
+    if rdir:
+        body["auto_recovery_dir"] = rdir
+    out = req("POST", "/3/ModelBuilders/gbm", body,
+              {"X-H2O-TPU-Tenant": tenant, "X-H2O-TPU-Priority": prio})
+    return out["job"]["key"]["name"]
+
+
+def entry_of(job_key, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for e in req("GET", "/3/Workload")["entries"]:
+            if e["job"] == job_key:
+                return e["id"]
+        time.sleep(0.1)
+    raise AssertionError(f"no scheduler entry for {job_key}")
+
+
+def wait_entry_done(eid, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ent = next(e for e in req("GET", "/3/Workload")["entries"]
+                   if e["id"] == eid)
+        if ent["state"] in ("DONE", "FAILED", "CANCELLED"):
+            return ent
+        time.sleep(0.2)
+    raise AssertionError(f"entry {eid} never finished")
+
+
+def wait_job(key, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        j = req("GET", f"/3/Jobs/{key}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+            return j
+        time.sleep(0.2)
+    raise AssertionError(f"job {key} never finished")
+
+
+# -- phase 1: kill a managed build at EVERY chunk boundary over the wire.
+# The REST job lands PREEMPTED; the scheduler entry must auto-resume and
+# finish DONE with >= 1 preemption recorded — no operator action.
+for k in (1, 2, 3):
+    failpoints.reset()
+    failpoints.arm("workload.preempt", f"raise(preempt)@{k}")
+    key = build("drill", "batch", rdir=f"/tmp/h2o_tpu_wl_gate_k{k}")
+    eid = entry_of(key)
+    ent = wait_entry_done(eid)
+    failpoints.reset()
+    assert ent["state"] == "DONE", f"boundary-{k} kill not healed: {ent}"
+    assert ent["preemptions"] >= 1, f"boundary-{k} never preempted: {ent}"
+print(json.dumps({"boundary_kills": "ok", "boundaries": 3}))
+
+# -- phase 2: 3-tenant mixed-priority stress with serving scores between
+scorer_model = wait_job(build("serving", "interactive"))["dest"]["name"]
+stop_scores = threading.Event()
+score_errors = []
+
+
+def score_loop():
+    while not stop_scores.is_set():
+        try:
+            req("POST",
+                f"/3/Predictions/models/{scorer_model}/frames/{fid}",
+                body={})
+        except Exception as e:  # noqa: BLE001
+            score_errors.append(repr(e))
+            return
+        time.sleep(0.05)
+
+
+scorer = threading.Thread(target=score_loop, daemon=True)
+scorer.start()
+keys = {t: build(t, p) for t, p in
+        (("acme", "interactive"), ("beta", "batch"),
+         ("gamma", "background"))}
+jobs = {t: wait_job(k) for t, k in keys.items()}
+stop_scores.set()
+scorer.join(timeout=10)
+assert not score_errors, f"serving failed mid-stress: {score_errors[0]}"
+for t, j in jobs.items():
+    assert j["status"] == "DONE", f"tenant {t} starved/failed: {j}"
+    assert j["tenant"] == t, f"tenant stamp lost: {j}"
+
+# the SLO/health plane held through the stress, and the sanitizer arms
+# stayed silent: zero violations, zero steady-state recompiles
+h = req("GET", "/3/Health")
+assert h["live"] and h["ready"], f"health degraded: {h['degraded']}"
+metrics = req("GET", "/3/Metrics")["metrics"]
+for name in ("sanitizer.violation.count", "serving.recompile.count"):
+    v = (metrics.get(name) or {}).get("value")
+    assert not v, f"{name} = {v}"
+snap = req("GET", "/3/Workload")
+assert {"acme", "beta", "gamma"} <= set(snap["tenants"]), snap["tenants"]
+prom = urllib.request.urlopen(
+    srv.url + "/3/Metrics?format=prometheus", timeout=30).read().decode()
+assert 'h2o_tpu_tenant_running_jobs{tenant="acme"}' in prom
+srv.stop()
+print(json.dumps({"workload_gate": "ok",
+                  "tenants": sorted(keys),
+                  "preempt_count": metrics["workload.preempt.count"]
+                  ["value"]}))
+EOF
+    workload_rc=$?
+fi
+
+echo "== gate: lint rc=${lint_rc}, tests rc=${test_rc}, bench rc=${bench_rc}, bench-gate rc=${gate_rc}, sanitize-stress rc=${stress_rc}, health rc=${health_rc}, workload rc=${workload_rc} =="
+if [ "$lint_rc" -ne 0 ] || [ "$test_rc" -ne 0 ] || [ "$bench_rc" -ne 0 ] || [ "$gate_rc" -ne 0 ] || [ "$stress_rc" -ne 0 ] || [ "$health_rc" -ne 0 ] || [ "$workload_rc" -ne 0 ]; then
     exit 1
 fi
 exit 0
